@@ -1,0 +1,21 @@
+from .msgpack import packb, unpackb, unpack_all, Unpacker, ExtType, EventTime
+from .events import (
+    LogEvent,
+    encode_event,
+    encode_events,
+    decode_events,
+    iter_events,
+    reencode_event,
+    count_records,
+    now_event_time,
+    GROUP_START,
+    GROUP_END,
+)
+from .chunk import Chunk, ChunkPool, CHUNK_TARGET_SIZE
+
+__all__ = [
+    "packb", "unpackb", "unpack_all", "Unpacker", "ExtType", "EventTime",
+    "LogEvent", "encode_event", "encode_events", "decode_events", "iter_events",
+    "reencode_event", "count_records", "now_event_time", "GROUP_START", "GROUP_END",
+    "Chunk", "ChunkPool", "CHUNK_TARGET_SIZE",
+]

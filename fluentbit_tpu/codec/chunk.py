@@ -1,0 +1,141 @@
+"""Tagged chunks — the unit of buffering, routing and flushing.
+
+Reference semantics (src/flb_input_chunk.c): each input owns a pool of
+chunks keyed by tag; appends go to the active chunk for that tag until it
+reaches the ~2MB target size (FLB_INPUT_CHUNK_FS_MAX_SIZE class constants),
+at which point it is "locked" (src/flb_input_chunk.c:3135) and a new chunk
+is opened. Dispatch walks ready chunks and creates one task per chunk.
+
+This module is pure data — storage backends (memory/filesystem, CRC32
+persistence) live in fluentbit_tpu.core.storage.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, Iterator, List, Optional
+
+from .events import LogEvent, decode_events
+
+# Reference: chunks are locked once above ~2MB so flushes stay bounded.
+CHUNK_TARGET_SIZE = 2 * 1024 * 1024
+
+_chunk_ids = itertools.count(1)
+
+# Event types carried by a chunk (reference: FLB_INPUT_LOGS/METRICS/TRACES/
+# PROFILES/BLOBS in include/fluent-bit/flb_input.h).
+EVENT_TYPE_LOGS = "logs"
+EVENT_TYPE_METRICS = "metrics"
+EVENT_TYPE_TRACES = "traces"
+EVENT_TYPE_PROFILES = "profiles"
+EVENT_TYPE_BLOBS = "blobs"
+
+
+class Chunk:
+    """A tagged, append-only buffer of concatenated msgpack events."""
+
+    __slots__ = (
+        "id",
+        "tag",
+        "event_type",
+        "buf",
+        "records",
+        "created",
+        "locked",
+        "routes_mask",
+        "in_name",
+    )
+
+    def __init__(self, tag: str, event_type: str = EVENT_TYPE_LOGS, in_name: str = ""):
+        self.id = next(_chunk_ids)
+        self.tag = tag
+        self.event_type = event_type
+        self.buf = bytearray()
+        self.records = 0
+        self.created = time.time()
+        self.locked = False
+        self.routes_mask = 0
+        self.in_name = in_name
+
+    @property
+    def size(self) -> int:
+        return len(self.buf)
+
+    def append(self, data: bytes, n_records: int) -> None:
+        if self.locked:
+            raise RuntimeError("append to locked chunk")
+        self.buf += data
+        self.records += n_records
+        if len(self.buf) >= CHUNK_TARGET_SIZE:
+            self.locked = True
+
+    def get_bytes(self) -> bytes:
+        return bytes(self.buf)
+
+    def decode(self) -> List[LogEvent]:
+        return decode_events(bytes(self.buf))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"Chunk(id={self.id}, tag={self.tag!r}, type={self.event_type}, "
+            f"size={self.size}, records={self.records})"
+        )
+
+
+class ChunkPool:
+    """Per-input chunk pool keyed by (event_type, tag).
+
+    Reference: ht_log_chunks hashtable per input (src/flb_input_log.c:1524);
+    input_chunk_get selects/creates the active chunk
+    (src/flb_input_chunk.c:3000).
+    """
+
+    def __init__(self, in_name: str = ""):
+        self.in_name = in_name
+        self._active: Dict[tuple, Chunk] = {}
+        self._ready: List[Chunk] = []
+        self.total_bytes = 0
+
+    def append(self, tag: str, data: bytes, n_records: int,
+               event_type: str = EVENT_TYPE_LOGS) -> Chunk:
+        key = (event_type, tag)
+        chunk = self._active.get(key)
+        if chunk is None or chunk.locked:
+            if chunk is not None and chunk.locked:
+                self._ready.append(chunk)
+            chunk = Chunk(tag, event_type, self.in_name)
+            self._active[key] = chunk
+        chunk.append(data, n_records)
+        self.total_bytes += len(data)
+        if chunk.locked:
+            self._ready.append(chunk)
+            del self._active[key]
+        return chunk
+
+    def drain(self) -> List[Chunk]:
+        """Take all flushable chunks (locked + currently active non-empty)."""
+        out = list(self._ready)
+        self._ready.clear()
+        for key in list(self._active):
+            c = self._active.pop(key)
+            if c.records > 0:
+                c.locked = True
+                out.append(c)
+        for c in out:
+            self.total_bytes -= c.size
+        if not self._active and not self._ready:
+            self.total_bytes = 0
+        return out
+
+    def iter_pending(self) -> Iterator[Chunk]:
+        yield from self._ready
+        yield from self._active.values()
+
+    @property
+    def pending_bytes(self) -> int:
+        return self.total_bytes
+
+    @property
+    def pending_chunks(self) -> int:
+        return len(self._ready) + sum(1 for c in self._active.values() if c.records)

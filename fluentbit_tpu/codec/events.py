@@ -1,0 +1,139 @@
+"""Log event codec — Fluent Bit log event format V2.
+
+A log event is msgpack ``[[timestamp, metadata-map], body-map]``
+(reference: include/fluent-bit/flb_log_event.h:29-62). Legacy (Forward/V1)
+events are ``[timestamp, body-map]``; the decoder accepts both and the
+encoder emits V2 by default.
+
+Group markers (reference include/fluent-bit/flb_log_event.h:48-49):
+timestamp == -1 opens an OTel-style group (resource/scope metadata in the
+header map), timestamp == -2 closes it.
+
+The decoder exposes per-record raw byte spans so filters can re-emit
+surviving records byte-identical (the grep contract,
+plugins/filter_grep/grep.c:286-392).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .msgpack import EventTime, Unpacker, packb
+
+GROUP_START = -1
+GROUP_END = -2
+
+
+@dataclass
+class LogEvent:
+    """A decoded log event."""
+
+    timestamp: Any  # EventTime | int | float (GROUP_START/GROUP_END for markers)
+    body: Dict[str, Any] = field(default_factory=dict)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    # raw msgpack span of this record within the source buffer (if decoded)
+    raw: Optional[bytes] = None
+
+    @property
+    def ts_float(self) -> float:
+        ts = self.timestamp
+        if isinstance(ts, EventTime):
+            return float(ts)
+        return float(ts)
+
+    def is_group_start(self) -> bool:
+        return _marker_value(self.timestamp) == GROUP_START
+
+    def is_group_end(self) -> bool:
+        return _marker_value(self.timestamp) == GROUP_END
+
+
+def _marker_value(ts: Any) -> Optional[int]:
+    if isinstance(ts, int):
+        return ts
+    if isinstance(ts, float) and ts in (-1.0, -2.0):
+        return int(ts)
+    return None
+
+
+def now_event_time() -> EventTime:
+    t = _time.time()
+    return EventTime.from_float(t)
+
+
+def encode_event(
+    body: Dict[str, Any],
+    timestamp: Any = None,
+    metadata: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """Encode one V2 log event to msgpack bytes."""
+    if timestamp is None:
+        timestamp = now_event_time()
+    return packb([[timestamp, metadata or {}], body])
+
+
+def encode_events(events: List[Tuple[Any, Dict[str, Any]]]) -> bytes:
+    """Encode (timestamp, body) pairs into a concatenated V2 buffer."""
+    out = []
+    for ts, body in events:
+        out.append(encode_event(body, ts))
+    return b"".join(out)
+
+
+def decode_events(buf: bytes) -> List[LogEvent]:
+    """Decode all log events in a concatenated msgpack buffer.
+
+    Accepts V2 ``[[ts, meta], body]`` and legacy ``[ts, body]`` records.
+    Each returned event carries its raw byte span (``event.raw``).
+    """
+    events: List[LogEvent] = []
+    u = Unpacker(buf)
+    pos = 0
+    for obj in u:
+        end = u.tell()
+        raw = buf[pos:end]
+        pos = end
+        events.append(_to_event(obj, raw))
+    return events
+
+
+def iter_events(buf: bytes) -> Iterator[LogEvent]:
+    u = Unpacker(buf)
+    pos = 0
+    for obj in u:
+        end = u.tell()
+        raw = buf[pos:end]
+        pos = end
+        yield _to_event(obj, raw)
+
+
+def _to_event(obj: Any, raw: Optional[bytes] = None) -> LogEvent:
+    if not isinstance(obj, list) or not obj:
+        raise ValueError(f"invalid log event: {obj!r}")
+    header = obj[0]
+    if isinstance(header, list):
+        # V2: [[ts, metadata], body]
+        ts = header[0] if header else 0
+        meta = header[1] if len(header) > 1 and isinstance(header[1], dict) else {}
+        body = obj[1] if len(obj) > 1 and isinstance(obj[1], dict) else {}
+        return LogEvent(timestamp=ts, body=body, metadata=meta, raw=raw)
+    # legacy: [ts, body]
+    ts = header
+    body = obj[1] if len(obj) > 1 and isinstance(obj[1], dict) else {}
+    return LogEvent(timestamp=ts, body=body, metadata={}, raw=raw)
+
+
+def reencode_event(ev: LogEvent) -> bytes:
+    """Re-encode a (possibly modified) event as V2."""
+    return packb([[ev.timestamp, ev.metadata], ev.body])
+
+
+def count_records(buf: bytes) -> int:
+    """Count log records in a buffer (flb_mp_count_log_records equivalent,
+    reference src/flb_mp.c)."""
+    n = 0
+    for _ in Unpacker(buf):
+        n += 1
+    return n

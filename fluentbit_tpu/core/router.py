@@ -1,0 +1,64 @@
+"""Tag → output routing.
+
+Reference: src/flb_router.c:140 (flb_router_match) — Match patterns support
+'*' wildcards (each '*' matches any run of characters, so 'kube.*' matches
+'kube.var.log'); Match_Regex uses a full regex instead. Routes are computed
+per chunk as a bitmask over outputs (src/flb_routes_mask.c).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+
+def tag_match(pattern: str, tag: str) -> bool:
+    """Wildcard tag match (flb_router_match equivalent).
+
+    '*' matches any sequence of characters (including '.'), '**' degenerates
+    to the same. Comparison is exact otherwise (case sensitive, like the
+    reference's strncmp-based loop).
+    """
+    # fast paths
+    if pattern == "*" or pattern == "**":
+        return True
+    if "*" not in pattern:
+        return pattern == tag
+    rx = _pattern_cache.get(pattern)
+    if rx is None:
+        parts = [re.escape(p) for p in pattern.split("*")]
+        rx = re.compile("^" + ".*".join(parts) + "$", re.S)
+        _pattern_cache[pattern] = rx
+    return rx.match(tag) is not None
+
+
+_pattern_cache: dict = {}
+
+
+class Route:
+    """A match rule binding an instance to tags."""
+
+    def __init__(self, match: Optional[str] = None, match_regex: Optional[str] = None):
+        self.match = match
+        self.match_regex = re.compile(match_regex) if match_regex else None
+
+    def matches(self, tag: str) -> bool:
+        if self.match_regex is not None:
+            return self.match_regex.search(tag) is not None
+        if self.match is not None:
+            return tag_match(self.match, tag)
+        return False
+
+
+def match_outputs(tag: str, outputs: List) -> List:
+    """Return output instances whose route matches ``tag``."""
+    return [o for o in outputs if o.route.matches(tag)]
+
+
+def routes_mask(tag: str, outputs: List) -> int:
+    """Bitmask over the ordered output list (flb_routes_mask equivalent)."""
+    mask = 0
+    for i, o in enumerate(outputs):
+        if o.route.matches(tag):
+            mask |= 1 << i
+    return mask

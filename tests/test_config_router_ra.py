@@ -1,0 +1,179 @@
+"""Config map, router, record accessor tests
+(mirrors tests/internal/config_map.c, input_chunk_routes.c, record_accessor.c)."""
+
+import pytest
+
+from fluentbit_tpu.core.config import (
+    ConfigMapEntry,
+    Properties,
+    ServiceConfig,
+    apply_config_map,
+    parse_bool,
+    parse_size,
+    parse_time,
+)
+from fluentbit_tpu.core.record_accessor import RecordAccessor, Template
+from fluentbit_tpu.core.router import Route, tag_match
+
+
+# -- value coercion --
+
+def test_parse_size():
+    assert parse_size("10") == 10
+    assert parse_size("4k") == 4096
+    assert parse_size("2K") == 2048
+    assert parse_size("10M") == 10 * 1024**2
+    assert parse_size("1g") == 1024**3
+    assert parse_size("1.5k") == 1536
+    assert parse_size(77) == 77
+    with pytest.raises(ValueError):
+        parse_size("abc")
+
+
+def test_parse_time():
+    assert parse_time("5") == 5.0
+    assert parse_time("5s") == 5.0
+    assert parse_time("100ms") == 0.1
+    assert parse_time("2m") == 120.0
+    assert parse_time("1h") == 3600.0
+
+
+def test_parse_bool():
+    for t in ("true", "On", "YES", "1"):
+        assert parse_bool(t) is True
+    for f in ("false", "Off", "no", "0"):
+        assert parse_bool(f) is False
+    with pytest.raises(ValueError):
+        parse_bool("maybe")
+
+
+# -- config map --
+
+class Ctx:
+    pass
+
+
+def test_apply_config_map():
+    cm = [
+        ConfigMapEntry("rate", "int", default=1),
+        ConfigMapEntry("dummy", "str", default='{"message":"dummy"}'),
+        ConfigMapEntry("flush_on_startup", "bool", default="false"),
+        ConfigMapEntry("mem_limit", "size"),
+        ConfigMapEntry("interval", "time", default="1s"),
+        ConfigMapEntry("regex", "slist", multiple=True, slist_max_split=1),
+    ]
+    props = Properties()
+    props.set("Rate", "50")
+    props.set("Mem_Limit", "5M")
+    props.set("Regex", "key pat with spaces")
+    props.set("Regex", "other ^x$")
+    ctx = Ctx()
+    apply_config_map(cm, props, ctx)
+    assert ctx.rate == 50
+    assert ctx.dummy == '{"message":"dummy"}'
+    assert ctx.flush_on_startup is False
+    assert ctx.mem_limit == 5 * 1024**2
+    assert ctx.interval == 1.0
+    assert ctx.regex == [["key", "pat with spaces"], ["other", "^x$"]]
+
+
+def test_unknown_property_rejected():
+    props = Properties()
+    props.set("nope", "1")
+    with pytest.raises(ValueError):
+        apply_config_map([], props, Ctx())
+
+
+def test_core_keys_pass_through():
+    props = Properties()
+    props.set("Match", "*")
+    props.set("Alias", "x")
+    apply_config_map([], props, Ctx())  # no raise
+
+
+def test_service_config():
+    svc = ServiceConfig()
+    svc.set("Flush", "250ms")
+    svc.set("scheduler.base", "3")
+    svc.set("scheduler.cap", "30")
+    assert svc.flush == 0.25
+    assert svc.scheduler_base == 3.0 and svc.scheduler_cap == 30.0
+
+
+# -- router --
+
+@pytest.mark.parametrize(
+    "pattern,tag,expect",
+    [
+        ("*", "anything.at.all", True),
+        ("kube.*", "kube.var.log.pod", True),
+        ("kube.*", "notkube", False),
+        ("app.log", "app.log", True),
+        ("app.log", "app.logs", False),
+        ("*.log", "x.log", True),
+        ("a*c", "abc", True),
+        ("a*c", "ac", True),
+        ("a*c", "ab", False),
+        ("t.*.end", "t.mid.end", True),
+        ("**", "x.y", True),
+    ],
+)
+def test_tag_match(pattern, tag, expect):
+    assert tag_match(pattern, tag) is expect
+
+
+def test_route_regex():
+    r = Route(match_regex=r"^kube\.(prod|staging)\.")
+    assert r.matches("kube.prod.app")
+    assert not r.matches("kube.dev.app")
+
+
+# -- record accessor --
+
+def test_ra_simple():
+    ra = RecordAccessor("$log")
+    assert ra.get({"log": "x"}) == "x"
+    assert ra.get({}) is None
+
+
+def test_ra_nested_brackets():
+    ra = RecordAccessor("$kubernetes['labels']['app']")
+    rec = {"kubernetes": {"labels": {"app": "web"}}}
+    assert ra.get(rec) == "web"
+
+
+def test_ra_dotted():
+    ra = RecordAccessor("$kubernetes.labels.app")
+    rec = {"kubernetes": {"labels": {"app": "web"}}}
+    assert ra.get(rec) == "web"
+
+
+def test_ra_array_index():
+    ra = RecordAccessor("$items[1]")
+    assert ra.get({"items": [10, 20, 30]}) == 20
+    assert RecordAccessor("$items[5]").get({"items": [1]}) is None
+
+
+def test_ra_bare_key():
+    assert RecordAccessor("message").get({"message": "hi"}) == "hi"
+
+
+def test_ra_update_delete():
+    ra = RecordAccessor("$a['b']")
+    rec = {}
+    assert ra.update(rec, 5)
+    assert rec == {"a": {"b": 5}}
+    assert ra.delete(rec)
+    assert rec == {"a": {}}
+    assert not ra.delete(rec)
+
+
+def test_template_render():
+    t = Template("rewritten.$TAG[1].$name.$0")
+    out = t.render({"name": "svc"}, tag="orig.part.x", captures=("cap0",))
+    assert out == "rewritten.part.svc.cap0"
+
+
+def test_template_tag_and_missing():
+    t = Template("pre.$TAG.post.$missing")
+    assert t.render({}, tag="t1") == "pre.t1.post."

@@ -1,0 +1,92 @@
+"""Log event V2 codec + chunk pool tests
+(mirrors tests/internal/log_event_encoder.c / input_chunk coverage)."""
+
+from fluentbit_tpu.codec import (
+    CHUNK_TARGET_SIZE,
+    Chunk,
+    ChunkPool,
+    EventTime,
+    count_records,
+    decode_events,
+    encode_event,
+    encode_events,
+    packb,
+    reencode_event,
+)
+
+
+def test_v2_roundtrip():
+    buf = encode_event({"log": "hello"}, EventTime(100, 5), {"source": "t"})
+    evs = decode_events(buf)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev.body == {"log": "hello"}
+    assert ev.metadata == {"source": "t"}
+    assert ev.timestamp == EventTime(100, 5)
+    assert ev.raw == buf
+    assert reencode_event(ev) == buf
+
+
+def test_legacy_v1_decode():
+    buf = packb([1234.5, {"msg": "legacy"}])
+    evs = decode_events(buf)
+    assert evs[0].body == {"msg": "legacy"}
+    assert evs[0].ts_float == 1234.5
+    assert evs[0].metadata == {}
+
+
+def test_multiple_events_raw_spans():
+    a = encode_event({"i": 1}, 1)
+    b = encode_event({"i": 2}, 2)
+    c = encode_event({"i": 3}, 3)
+    evs = decode_events(a + b + c)
+    assert [e.body["i"] for e in evs] == [1, 2, 3]
+    assert [e.raw for e in evs] == [a, b, c]
+    assert count_records(a + b + c) == 3
+
+
+def test_group_markers():
+    buf = encode_event({}, -1, {"resource": {"x": 1}}) + encode_event(
+        {"log": "in group"}, 5
+    ) + encode_event({}, -2)
+    evs = decode_events(buf)
+    assert evs[0].is_group_start()
+    assert not evs[1].is_group_start() and not evs[1].is_group_end()
+    assert evs[2].is_group_end()
+
+
+def test_encode_events_batch():
+    buf = encode_events([(1, {"a": 1}), (2, {"b": 2})])
+    assert count_records(buf) == 2
+
+
+def test_chunk_pool_tag_keying():
+    pool = ChunkPool("in_test")
+    c1 = pool.append("app.a", encode_event({"x": 1}), 1)
+    c2 = pool.append("app.b", encode_event({"x": 2}), 1)
+    c3 = pool.append("app.a", encode_event({"x": 3}), 1)
+    assert c1 is c3 and c1 is not c2
+    assert c1.records == 2 and c2.records == 1
+    drained = pool.drain()
+    assert {c.tag for c in drained} == {"app.a", "app.b"}
+    assert pool.drain() == []
+
+
+def test_chunk_lock_at_target_size():
+    pool = ChunkPool()
+    big = b"\x00" * (CHUNK_TARGET_SIZE // 2 + 1)
+    ca = pool.append("t", big, 10)
+    assert not ca.locked
+    cb = pool.append("t", big, 10)
+    assert cb is ca and ca.locked
+    cc = pool.append("t", b"\x01", 1)
+    assert cc is not ca and not cc.locked
+    drained = pool.drain()
+    assert ca in drained and cc in drained
+
+
+def test_chunk_decode():
+    pool = ChunkPool()
+    pool.append("t", encode_events([(1, {"n": i}) for i in range(5)]), 5)
+    (chunk,) = pool.drain()
+    assert [e.body["n"] for e in chunk.decode()] == list(range(5))

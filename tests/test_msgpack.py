@@ -1,0 +1,144 @@
+"""msgpack codec unit tests (mirrors tests/internal/msgpack-* coverage)."""
+
+import math
+import struct
+
+import pytest
+
+from fluentbit_tpu.codec.msgpack import (
+    EventTime,
+    ExtType,
+    Unpacker,
+    packb,
+    unpackb,
+    unpack_all,
+)
+
+try:
+    import msgpack as refmp  # cross-check against the C implementation
+except ImportError:  # pragma: no cover
+    refmp = None
+
+
+ROUNDTRIP_CASES = [
+    None,
+    True,
+    False,
+    0,
+    1,
+    127,
+    128,
+    255,
+    256,
+    65535,
+    65536,
+    2**32 - 1,
+    2**32,
+    2**64 - 1,
+    -1,
+    -32,
+    -33,
+    -128,
+    -129,
+    -32768,
+    -32769,
+    -(2**31),
+    -(2**63),
+    1.5,
+    -3.25,
+    0.0,
+    "",
+    "hello",
+    "x" * 31,
+    "x" * 32,
+    "x" * 255,
+    "x" * 256,
+    "x" * 70000,
+    "héllo wörld ✓ 🎉",
+    b"",
+    b"raw",
+    b"\x00" * 300,
+    [],
+    [1, 2, 3],
+    list(range(20)),
+    list(range(70000)),
+    {},
+    {"a": 1},
+    {"k" + str(i): i for i in range(20)},
+    [1, "two", {"three": [4, 5.0, None, True]}],
+    {"nested": {"deep": {"deeper": [1, {"x": b"bytes"}]}}},
+]
+
+
+@pytest.mark.parametrize("obj", ROUNDTRIP_CASES, ids=lambda o: repr(o)[:40])
+def test_roundtrip(obj):
+    assert unpackb(packb(obj)) == obj
+
+
+@pytest.mark.skipif(refmp is None, reason="msgpack-python not installed")
+@pytest.mark.parametrize("obj", ROUNDTRIP_CASES, ids=lambda o: repr(o)[:40])
+def test_cross_check_pack(obj):
+    """Our unpacker must read what msgpack-c writes and vice versa."""
+    assert unpackb(refmp.packb(obj)) == obj
+    assert refmp.unpackb(packb(obj), strict_map_key=False, raw=False) == obj
+
+
+def test_event_time_roundtrip():
+    et = EventTime(1700000000, 123456789)
+    data = packb(et)
+    # fixext8 type 0 per the Fluentd spec
+    assert data[:2] == b"\xd7\x00"
+    back = unpackb(data)
+    assert isinstance(back, EventTime)
+    assert back.sec == 1700000000 and back.nsec == 123456789
+    assert math.isclose(float(back), 1700000000.123456789)
+
+
+def test_event_time_from_float():
+    et = EventTime.from_float(12.5)
+    assert et.sec == 12 and et.nsec == 500000000
+    assert EventTime.from_float(1.9999999999).sec == 2
+
+
+def test_ext_type_roundtrip():
+    for n in (1, 2, 4, 8, 16, 5, 300, 70000):
+        e = ExtType(42, b"z" * n)
+        assert unpackb(packb(e)) == e
+
+
+def test_streaming_unpacker_offsets():
+    a = packb({"m": 1})
+    b = packb([1, 2])
+    c = packb("tail")
+    u = Unpacker(a + b + c)
+    objs = []
+    offs = [0]
+    for obj in u:
+        objs.append(obj)
+        offs.append(u.tell())
+    assert objs == [{"m": 1}, [1, 2], "tail"]
+    assert offs == [0, len(a), len(a) + len(b), len(a) + len(b) + len(c)]
+
+
+def test_partial_buffer_stops_cleanly():
+    full = packb({"key": "value", "n": 12345})
+    u = Unpacker(full[:-3])
+    assert list(u) == []
+    u.feed(full[-3:] + packb(7))
+    # previous partial bytes retained
+    assert list(Unpacker(full)) == [{"key": "value", "n": 12345}]
+
+
+def test_unpack_all():
+    buf = packb(1) + packb("two") + packb([3])
+    assert unpack_all(buf) == [1, "two", [3]]
+
+
+def test_float32_decode():
+    raw = struct.pack(">Bf", 0xCA, 2.5)
+    assert unpackb(raw) == 2.5
+
+
+def test_invalid_byte():
+    with pytest.raises(ValueError):
+        unpackb(b"\xc1")
