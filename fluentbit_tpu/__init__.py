@@ -1,0 +1,23 @@
+"""fluentbit_tpu — a TPU-native telemetry-pipeline framework.
+
+Capabilities of fluent/fluent-bit (collect/process/route logs, metrics,
+traces through a tagged-chunk pipeline), with the record-processing stage
+(regex grep, parser extraction, tag rewriting, log-to-metrics aggregation)
+executed as vectorized JAX kernels across TPU cores.
+
+Public embedding API mirrors the reference's library mode
+(include/fluent-bit/flb_lib.h): create/input/filter/output/start/push/stop.
+"""
+
+__version__ = "0.1.0"
+
+from .lib import FLBContext, create  # noqa: F401
+from .core.plugin import (  # noqa: F401
+    FilterPlugin,
+    FilterResult,
+    FlushResult,
+    InputPlugin,
+    OutputPlugin,
+    ProcessorPlugin,
+    registry,
+)

@@ -78,6 +78,7 @@ def split_slist(v: Any, max_split: int = -1) -> List[str]:
 
 
 _COERCERS = {
+    "raw": lambda v: v,  # pass-through (python-object properties, e.g. out_lib callback)
     "str": lambda v: str(v),
     "int": lambda v: int(str(v), 0),
     "double": lambda v: float(v),

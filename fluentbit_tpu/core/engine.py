@@ -1,0 +1,469 @@
+"""The engine — event loop, ingest path, dispatch, flush, retries.
+
+Reference: src/flb_engine.c (flb_engine_start event loop),
+src/flb_engine_dispatch.c (chunk → task → per-route flush),
+src/flb_task.c (task refcounting/retries), src/flb_input_chunk.c
+(ingest + synchronous filter chain at append, :3078).
+
+Architecture (TPU-first, not a port): the engine is a host-side asyncio
+loop running in its own thread (the reference runs its engine in a pthread
+spawned by flb_start, src/flb_lib.c). Inputs append records; the filter
+chain runs synchronously at ingest exactly like the reference; chunks
+accumulate per (input, tag); a flush timer drains ready chunks into tasks
+and one async flush per (task × route) — the coroutine-per-flush model of
+include/fluent-bit/flb_output.h:730 mapped onto asyncio. Device (TPU)
+work happens inside filters via the ops layer; the engine itself never
+blocks on the device.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..codec.chunk import Chunk, EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES
+from ..codec.events import LogEvent, decode_events, reencode_event
+from .config import ServiceConfig
+from .metrics import MetricsRegistry
+from .plugin import (
+    FilterInstance,
+    FilterResult,
+    FlushResult,
+    InputInstance,
+    OutputInstance,
+    registry as default_registry,
+)
+from .scheduler import backoff_full_jitter
+
+log = logging.getLogger("flb.engine")
+
+_task_ids = itertools.count(1)
+
+
+class Task:
+    """One flushable chunk + its routes + retry state
+    (reference struct flb_task, include/fluent-bit/flb_task.h:82-98)."""
+
+    __slots__ = ("id", "chunk", "routes", "retries", "users", "engine")
+
+    def __init__(self, chunk: Chunk, routes: List[OutputInstance]):
+        self.id = next(_task_ids)
+        self.chunk = chunk
+        self.routes = routes
+        self.retries: Dict[str, int] = {}  # output name → attempts
+        self.users = 0
+
+
+class Engine:
+    """The pipeline runtime for one configuration context."""
+
+    def __init__(self, service: Optional[ServiceConfig] = None, registry=None):
+        self.service = service or ServiceConfig()
+        self.registry = registry or default_registry
+        self.inputs: List[InputInstance] = []
+        self.filters: List[FilterInstance] = []
+        self.outputs: List[OutputInstance] = []
+        self.customs: List = []
+        self.metrics = MetricsRegistry()
+        self.storage = None  # set by core.storage when storage_path configured
+
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._stopping = False
+        self._ingest_lock = threading.RLock()
+        self._pending_flushes: set = set()
+        self._notification_subs: List = []
+        self.started_at: float = 0.0
+        self.reload_count = 0
+
+        self._init_metrics()
+
+    # ------------------------------------------------------------------
+    # metrics (names mirror the reference's fluentbit_* families)
+    # ------------------------------------------------------------------
+
+    def _init_metrics(self) -> None:
+        m = self.metrics
+        self.m_in_records = m.counter("fluentbit", "input", "records_total",
+                                      "Input records", ("name",))
+        self.m_in_bytes = m.counter("fluentbit", "input", "bytes_total",
+                                    "Input bytes", ("name",))
+        self.m_filter_add = m.counter("fluentbit", "filter", "add_records_total",
+                                      "Records added by filter", ("name",))
+        self.m_filter_drop = m.counter("fluentbit", "filter", "drop_records_total",
+                                       "Records dropped by filter", ("name",))
+        self.m_out_proc_records = m.counter("fluentbit", "output", "proc_records_total",
+                                            "Records delivered", ("name",))
+        self.m_out_proc_bytes = m.counter("fluentbit", "output", "proc_bytes_total",
+                                          "Bytes delivered", ("name",))
+        self.m_out_errors = m.counter("fluentbit", "output", "errors_total",
+                                      "Flush errors", ("name",))
+        self.m_out_retries = m.counter("fluentbit", "output", "retries_total",
+                                       "Flush retries", ("name",))
+        self.m_out_retries_failed = m.counter("fluentbit", "output", "retries_failed_total",
+                                              "Retries exhausted", ("name",))
+        self.m_out_dropped = m.counter("fluentbit", "output", "dropped_records_total",
+                                       "Records dropped at output", ("name",))
+        self.m_uptime = m.gauge("fluentbit", "", "uptime", "Uptime seconds")
+        # end-to-end latency histogram (reference src/flb_engine.c:400-405)
+        self.m_latency = m.histogram("fluentbit", "output", "latency_seconds",
+                                     "chunk create → delivered latency", ("name",))
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+
+    def _number_instance(self, ins, peers) -> None:
+        n = sum(1 for p in peers if p.plugin.name == ins.plugin.name)
+        ins.name = f"{ins.plugin.name}.{n}"
+        pool = getattr(ins, "pool", None)
+        if pool is not None:
+            pool.in_name = ins.name
+
+    def input(self, name: str, **props) -> InputInstance:
+        ins = self.registry.create_input(name)
+        self._number_instance(ins, self.inputs)
+        for k, v in props.items():
+            ins.set(k, v)
+        self.inputs.append(ins)
+        return ins
+
+    def filter(self, name: str, **props) -> FilterInstance:
+        ins = self.registry.create_filter(name)
+        self._number_instance(ins, self.filters)
+        for k, v in props.items():
+            ins.set(k, v)
+        self.filters.append(ins)
+        return ins
+
+    def output(self, name: str, **props) -> OutputInstance:
+        ins = self.registry.create_output(name)
+        self._number_instance(ins, self.outputs)
+        for k, v in props.items():
+            ins.set(k, v)
+        self.outputs.append(ins)
+        return ins
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn the engine thread (flb_start → flb_engine_start)."""
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        for ins in self.inputs + self.filters + self.outputs:
+            ins.configure()
+            ins.plugin.init(ins, self)
+        self.started_at = time.time()
+        self._stopping = False
+        self._thread = threading.Thread(target=self._run, name="flb-engine", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("engine failed to start")
+
+    def _run(self) -> None:
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+        try:
+            self.loop.run_until_complete(self._main())
+        finally:
+            self.loop.close()
+
+    async def _main(self) -> None:
+        # start collectors (flb_input_collectors_start, src/flb_engine.c:1090)
+        for ins in self.inputs:
+            plugin = ins.plugin
+            if plugin.collect_interval is not None:
+                ins.collector_task = asyncio.ensure_future(self._collector(ins))
+            elif getattr(plugin, "server_task_needed", False):
+                ins.collector_task = asyncio.ensure_future(plugin.start_server(self))
+        self._started.set()
+        flush_interval = max(0.02, self.service.flush)
+        try:
+            while not self._stopping:
+                await asyncio.sleep(flush_interval)
+                self.flush_all()
+            # graceful drain (grace period, src/flb_engine.c:1137-1160)
+            self.flush_all()
+            await asyncio.sleep(0.05)  # let queued _create callbacks run
+            deadline = time.time() + self.service.grace
+            while self._pending_flushes and time.time() < deadline:
+                await asyncio.sleep(0.02)
+            # cancel stragglers (e.g. retries sleeping out their backoff)
+            for fut in list(self._pending_flushes):
+                fut.cancel()
+            if self._pending_flushes:
+                await asyncio.gather(*self._pending_flushes, return_exceptions=True)
+        finally:
+            for ins in self.inputs:
+                if ins.collector_task is not None:
+                    ins.collector_task.cancel()
+            self._started.clear()
+
+    async def _collector(self, ins: InputInstance) -> None:
+        """Interval collector (flb_input_set_collector_time)."""
+        interval = ins.plugin.collect_interval or 1.0
+        while True:
+            try:
+                if not ins.paused:
+                    ins.plugin.collect(self)
+            except Exception:
+                log.exception("input %s collect failed", ins.display_name)
+            await asyncio.sleep(interval)
+
+    def stop(self) -> None:
+        """Graceful stop with drain (flb_stop)."""
+        if self._thread is None:
+            return
+        self._stopping = True
+        self._thread.join(timeout=self.service.grace + 10)
+        self._thread = None
+        for ins in self.inputs + self.filters + self.outputs:
+            try:
+                ins.plugin.exit()
+            except Exception:
+                log.exception("%s exit failed", ins.display_name)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------------------------
+    # ingest path (reference: flb_input_log_append → input_chunk_append_raw)
+    # ------------------------------------------------------------------
+
+    def input_log_append(self, ins: InputInstance, tag: Optional[str],
+                         data: bytes, n_records: Optional[int] = None) -> int:
+        """Append encoded log events; runs processors then the filter chain
+        synchronously (src/flb_input_chunk.c:3078), then writes the chunk.
+
+        Returns number of records written (post-filter). Thread-safe.
+        """
+        tag = tag or ins.tag or ins.plugin.name
+        events = decode_events(data)
+        if n_records is None:
+            n_records = len(events)
+
+        # backpressure (mem_buf_limit, src/flb_input.c:157,740-746)
+        if ins.mem_buf_limit and ins.pool.pending_bytes >= ins.mem_buf_limit:
+            if not ins.paused:
+                ins.paused = True
+                try:
+                    ins.plugin.pause()
+                except Exception:
+                    pass
+            return 0
+
+        self.m_in_records.inc(n_records, (ins.display_name,))
+        self.m_in_bytes.inc(len(data), (ins.display_name,))
+
+        # input-side processors (flb_processor_run, src/flb_input_log.c:1562)
+        for proc in ins.processors:
+            events = proc.plugin.process_logs(events, tag, self)
+            if not events:
+                return 0
+
+        # filter chain — synchronous, pre-storage
+        events = self._run_filters(events, tag)
+        if not events:
+            return 0
+
+        out = bytearray()
+        for ev in events:
+            out += ev.raw if ev.raw is not None else reencode_event(ev)
+        with self._ingest_lock:
+            ins.pool.append(tag, bytes(out), len(events))
+        return len(events)
+
+    def input_event_append(self, ins: InputInstance, tag: Optional[str],
+                           data: bytes, event_type: str, n_records: int = 1) -> int:
+        """Non-log telemetry append (metrics/traces/profiles): no filter
+        chain (reference typed appends, src/flb_input_metric.c etc.)."""
+        tag = tag or ins.tag or ins.plugin.name
+        self.m_in_records.inc(n_records, (ins.display_name,))
+        self.m_in_bytes.inc(len(data), (ins.display_name,))
+        with self._ingest_lock:
+            ins.pool.append(tag, data, n_records, event_type)
+        return n_records
+
+    def _run_filters(self, events: List[LogEvent], tag: str) -> List[LogEvent]:
+        """flb_filter_do equivalent (src/flb_filter.c:119-330)."""
+        for f in self.filters:
+            if not events:
+                break
+            if not f.route.matches(tag):
+                continue
+            before = len(events)
+            try:
+                result, new_events = f.plugin.filter(events, tag, self)
+            except Exception:
+                log.exception("filter %s failed", f.display_name)
+                continue
+            if result == FilterResult.MODIFIED:
+                events = new_events if new_events is not None else []
+                # modified events lose raw identity unless the filter kept it
+                after = len(events)
+                if after > before:
+                    self.m_filter_add.inc(after - before, (f.display_name,))
+                elif after < before:
+                    self.m_filter_drop.inc(before - after, (f.display_name,))
+        return events
+
+    # ------------------------------------------------------------------
+    # dispatch + flush (reference: flb_engine_flush → flb_engine_dispatch)
+    # ------------------------------------------------------------------
+
+    def flush_all(self) -> None:
+        """Drain ready chunks into tasks and start per-route flushes."""
+        if self.started_at:
+            self.m_uptime.set(time.time() - self.started_at)
+        with self._ingest_lock:
+            chunks: List[tuple] = []
+            for ins in self.inputs:
+                for chunk in ins.pool.drain():
+                    chunks.append((ins, chunk))
+                # resume paused inputs once the buffer drains
+                if ins.paused and (
+                    not ins.mem_buf_limit or ins.pool.pending_bytes < ins.mem_buf_limit
+                ):
+                    ins.paused = False
+                    try:
+                        ins.plugin.resume()
+                    except Exception:
+                        pass
+        for ins, chunk in chunks:
+            routes = [
+                o for o in self.outputs
+                if o.route.matches(chunk.tag) and chunk.event_type in o.plugin.event_types
+            ]
+            if not routes:
+                continue
+            task = Task(chunk, routes)
+            for out in routes:
+                task.users += 1
+                self._spawn_flush(task, out)
+
+    def _spawn_flush(self, task: Task, out: OutputInstance, delay: float = 0.0) -> None:
+        coro = self._flush_one(task, out, delay)
+        if self.loop is None or not self.running:
+            # synchronous fallback (engine not started: unit tests)
+            asyncio.run(coro)
+            return
+        def _create():
+            fut = asyncio.ensure_future(coro)
+            self._pending_flushes.add(fut)
+            fut.add_done_callback(self._pending_flushes.discard)
+        try:
+            self.loop.call_soon_threadsafe(_create)
+        except RuntimeError:
+            coro.close()  # loop shut down mid-stop; chunk stays accounted as dropped
+
+    async def _flush_one(self, task: Task, out: OutputInstance, delay: float) -> None:
+        """One (task × output) flush coroutine, including its retries
+        (reference flb_output_flush_create/output_pre_cb_flush; backoff stays
+        inside the coroutine rather than re-dispatching through the
+        scheduler)."""
+        while True:
+            if delay > 0:
+                await asyncio.sleep(delay)
+            chunk = task.chunk
+            data = chunk.get_bytes()
+            # output-side processors (flb_processor_run at flush-create,
+            # include/fluent-bit/flb_output.h:794)
+            if out.processors and chunk.event_type == EVENT_TYPE_LOGS:
+                events = decode_events(data)
+                for proc in out.processors:
+                    events = proc.plugin.process_logs(events, chunk.tag, self)
+                data = b"".join(
+                    ev.raw if ev.raw is not None else reencode_event(ev) for ev in events
+                )
+            # test formatter hook (src/flb_engine_dispatch.c:101-137)
+            if out.test_formatter is not None:
+                try:
+                    out.test_formatter(data, chunk.tag)
+                    result = FlushResult.OK
+                except Exception:
+                    log.exception("test formatter failed")
+                    result = FlushResult.ERROR
+            else:
+                try:
+                    result = await out.plugin.flush(data, chunk.tag, self)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("output %s flush raised", out.display_name)
+                    result = FlushResult.ERROR
+            delay = self._handle_flush_result(task, out, result)
+            if delay is None:
+                return
+
+    def _handle_flush_result(self, task: Task, out: OutputInstance,
+                             result: FlushResult) -> Optional[float]:
+        """handle_output_event equivalent (src/flb_engine.c:302-540).
+        Returns the backoff delay when the flush must be retried, else None."""
+        name = out.display_name
+        chunk = task.chunk
+        if result == FlushResult.OK:
+            self.m_out_proc_records.inc(chunk.records, (name,))
+            self.m_out_proc_bytes.inc(chunk.size, (name,))
+            self.m_latency.observe(time.time() - chunk.created, (name,))
+            task.users -= 1
+            return None
+        if result == FlushResult.RETRY:
+            attempts = task.retries.get(out.name, 0) + 1
+            task.retries[out.name] = attempts
+            limit = out.retry_limit if out.retry_limit is not None else self.service.retry_limit
+            if limit == -1 or attempts <= limit:
+                self.m_out_retries.inc(1, (name,))
+                return backoff_full_jitter(
+                    self.service.scheduler_base, self.service.scheduler_cap, attempts
+                )
+            self.m_out_retries_failed.inc(1, (name,))
+        # ERROR or retries exhausted → drop (+ DLQ quarantine when storage on)
+        self.m_out_errors.inc(1, (name,))
+        self.m_out_dropped.inc(chunk.records, (name,))
+        if self.storage is not None:
+            try:
+                self.storage.quarantine(chunk)
+            except Exception:
+                log.exception("DLQ quarantine failed")
+        task.users -= 1
+        return None
+
+    # ------------------------------------------------------------------
+    # notifications (src/flb_notification.c)
+    # ------------------------------------------------------------------
+
+    def notify(self, event: dict) -> None:
+        for cb in self._notification_subs:
+            try:
+                cb(event)
+            except Exception:
+                log.exception("notification callback failed")
+
+    def subscribe(self, cb) -> None:
+        self._notification_subs.append(cb)
+
+    # convenience for tests / lib mode
+    def flush_now(self) -> None:
+        """Force a flush cycle and wait for pending flushes to settle."""
+        self.flush_all()
+        if self.loop is None or not self.running:
+            return
+        # call_soon_threadsafe callbacks run FIFO: once this sentinel fires,
+        # every _create queued by flush_all has populated _pending_flushes.
+        settled = threading.Event()
+        try:
+            self.loop.call_soon_threadsafe(settled.set)
+        except RuntimeError:
+            return
+        settled.wait(timeout=2)
+        deadline = time.time() + 5
+        while self._pending_flushes and time.time() < deadline:
+            time.sleep(0.01)

@@ -1,0 +1,225 @@
+"""Internal metrics — cmetrics equivalent.
+
+Reference: lib/cmetrics (cmt_counter/cmt_gauge/cmt_histogram) used
+throughout the engine (fluentbit_input_records_total at ingest
+src/flb_input_chunk.c:3053-3070, filter add/drop src/flb_filter.c:218-303,
+output proc/retry/drop src/flb_engine.c:382-467). Provides Prometheus text
+exposition (the /api/v1/metrics/prometheus endpoint) and msgpack encoding so
+metrics can flow *as data* through the pipeline (in_fluentbit_metrics).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, registry: "MetricsRegistry", ns: str, subsystem: str,
+                 name: str, desc: str, label_keys: Sequence[str] = ()):
+        self.ns = ns
+        self.subsystem = subsystem
+        self.name = name
+        self.desc = desc
+        self.label_keys = tuple(label_keys)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = registry._lock
+        registry._add(self)
+
+    @property
+    def fqname(self) -> str:
+        parts = [p for p in (self.ns, self.subsystem, self.name) if p]
+        return "_".join(parts)
+
+    def _key(self, labels: Sequence[str]) -> Tuple[str, ...]:
+        labels = tuple(str(x) for x in labels)
+        if len(labels) != len(self.label_keys):
+            raise ValueError(
+                f"{self.fqname}: expected {len(self.label_keys)} labels, got {len(labels)}"
+            )
+        return labels
+
+    def samples(self) -> List[Tuple[Tuple[str, ...], float]]:
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, labels: Sequence[str] = ()) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    add = inc
+
+    def get(self, labels: Sequence[str] = ()) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, labels: Sequence[str] = ()) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, labels: Sequence[str] = ()) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, labels: Sequence[str] = ()) -> None:
+        self.inc(-value, labels)
+
+    def get(self, labels: Sequence[str] = ()) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, ns, subsystem, name, desc,
+                 label_keys: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, ns, subsystem, name, desc, label_keys)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+
+    def observe(self, value: float, labels: Sequence[str] = ()) -> None:
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.get(k)
+            if counts is None:
+                counts = [0] * (len(self.buckets) + 1)  # +inf bucket
+                self._counts[k] = counts
+            idx = bisect.bisect_left(self.buckets, value)
+            counts[idx] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._values[k] = self._values.get(k, 0.0) + 1  # total count
+
+    def hist_samples(self):
+        with self._lock:
+            return {k: (list(v), self._sums.get(k, 0.0)) for k, v in self._counts.items()}
+
+
+class MetricsRegistry:
+    """A cmt context."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _add(self, metric: _Metric) -> None:
+        with self._lock:
+            self._metrics[metric.fqname] = metric
+
+    def counter(self, ns: str, subsystem: str, name: str, desc: str = "",
+                label_keys: Sequence[str] = ()) -> Counter:
+        key = "_".join(p for p in (ns, subsystem, name) if p)
+        m = self._metrics.get(key)
+        if isinstance(m, Counter):
+            return m
+        return Counter(self, ns, subsystem, name, desc, label_keys)
+
+    def gauge(self, ns: str, subsystem: str, name: str, desc: str = "",
+              label_keys: Sequence[str] = ()) -> Gauge:
+        key = "_".join(p for p in (ns, subsystem, name) if p)
+        m = self._metrics.get(key)
+        if isinstance(m, Gauge):
+            return m
+        return Gauge(self, ns, subsystem, name, desc, label_keys)
+
+    def histogram(self, ns: str, subsystem: str, name: str, desc: str = "",
+                  label_keys: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        key = "_".join(p for p in (ns, subsystem, name) if p)
+        m = self._metrics.get(key)
+        if isinstance(m, Histogram):
+            return m
+        return Histogram(self, ns, subsystem, name, desc, label_keys, buckets)
+
+    def metrics(self) -> Iterable[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- exposition --
+
+    def to_prometheus(self) -> str:
+        """Prometheus text format (api/v1/metrics/prometheus equivalent)."""
+        out: List[str] = []
+        for m in self.metrics():
+            fq = m.fqname
+            if m.desc:
+                out.append(f"# HELP {fq} {m.desc}")
+            out.append(f"# TYPE {fq} {m.kind}")
+            if isinstance(m, Histogram):
+                for labels, (counts, total) in m.hist_samples().items():
+                    base = _fmt_labels(m.label_keys, labels)
+                    cum = 0
+                    for b, c in zip(m.buckets, counts):
+                        cum += c
+                        le = _fmt_labels(m.label_keys + ("le",), labels + (_fmt_float(b),))
+                        out.append(f"{fq}_bucket{le} {cum}")
+                    cum += counts[-1]
+                    le = _fmt_labels(m.label_keys + ("le",), labels + ("+Inf",))
+                    out.append(f"{fq}_bucket{le} {cum}")
+                    out.append(f"{fq}_sum{base} {_fmt_float(total)}")
+                    out.append(f"{fq}_count{base} {cum}")
+            else:
+                for labels, value in m.samples():
+                    out.append(f"{fq}{_fmt_labels(m.label_keys, labels)} {_fmt_float(value)}")
+        return "\n".join(out) + "\n"
+
+    def to_msgpack_obj(self) -> dict:
+        """Encode as a plain structure for the metrics pipeline."""
+        ts = time.time()
+        metrics = []
+        for m in self.metrics():
+            entry = {
+                "name": m.fqname,
+                "type": m.kind,
+                "desc": m.desc,
+                "labels": list(m.label_keys),
+                "ts": ts,
+                "values": [
+                    {"labels": list(k), "value": v} for k, v in m.samples()
+                ],
+            }
+            if isinstance(m, Histogram):
+                entry["buckets"] = list(m.buckets)
+                entry["hist"] = [
+                    {"labels": list(k), "counts": c, "sum": s}
+                    for k, (c, s) in m.hist_samples().items()
+                ]
+            metrics.append(entry)
+        return {"meta": {"ts": ts}, "metrics": metrics}
+
+
+def _fmt_labels(keys: Sequence[str], values: Sequence[str]) -> str:
+    if not keys:
+        return ""
+    pairs = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in zip(keys, values)
+    )
+    return "{" + pairs + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_float(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))

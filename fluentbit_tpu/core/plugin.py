@@ -1,0 +1,253 @@
+"""Plugin model — vtables, instances, registry.
+
+Reference: the C plugin vtables flb_input_plugin / flb_filter_plugin /
+flb_output_plugin (include/fluent-bit/flb_input.h, flb_filter.h,
+flb_output.h) with cb_init/cb_collect/cb_filter/cb_flush/cb_exit, and the
+per-instance property machinery in src/flb_input.c / flb_output.c /
+flb_filter.c. Plugins here are Python classes registered by name; the
+registry replaces the cmake plugin gating (cmake/plugins_options.cmake).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Any, Callable, Dict, List, Optional, Type
+
+from .config import ConfigMapEntry, Properties, apply_config_map
+from .router import Route
+from ..codec.chunk import Chunk, ChunkPool, EVENT_TYPE_LOGS
+
+log = logging.getLogger("flb")
+
+
+class FlushResult(enum.Enum):
+    """Output flush verdicts (reference FLB_OK/FLB_RETRY/FLB_ERROR,
+    include/fluent-bit/flb_output.h FLB_OUTPUT_RETURN)."""
+
+    OK = 1
+    RETRY = 2
+    ERROR = 3
+
+
+class FilterResult(enum.Enum):
+    """Filter verdicts (FLB_FILTER_NOTOUCH / FLB_FILTER_MODIFIED)."""
+
+    NOTOUCH = 1
+    MODIFIED = 2
+
+
+class Plugin:
+    """Common plugin base."""
+
+    name: str = ""
+    description: str = ""
+    config_map: List[ConfigMapEntry] = []
+    # event types the plugin handles (logs/metrics/traces); logs by default
+    event_types = (EVENT_TYPE_LOGS,)
+
+    def __init__(self) -> None:
+        self.instance: Optional["Instance"] = None
+
+    # lifecycle
+    def init(self, instance: "Instance", engine) -> None:  # cb_init
+        pass
+
+    def exit(self) -> None:  # cb_exit
+        pass
+
+
+class InputPlugin(Plugin):
+    """Input vtable. Collect models supported:
+    - interval collectors: declare ``collect_interval`` (seconds) and
+      implement ``collect(engine)`` — flb_input_set_collector_time
+    - server inputs: implement ``start_server(engine)`` returning an
+      awaitable/task — the in_http/in_forward style
+    - library inputs: expose ``push`` for direct injection (in_lib)
+    """
+
+    default_tag: Optional[str] = None
+    collect_interval: Optional[float] = None
+    threaded_capable: bool = False
+
+    def collect(self, engine) -> None:
+        pass
+
+    async def start_server(self, engine) -> None:
+        pass
+
+    def pause(self) -> None:  # cb_pause (backpressure)
+        pass
+
+    def resume(self) -> None:  # cb_resume
+        pass
+
+
+class FilterPlugin(Plugin):
+    """Filter vtable: ``filter(events, tag) -> (FilterResult, events')``.
+
+    The reference cb_filter gets the whole chunk msgpack buffer
+    (src/flb_filter.c:202-210); here filters get the decoded event list for
+    the chunk-sized append and return a replacement list (or the same list
+    with NOTOUCH). Byte-level identity for untouched records is preserved
+    because events carry their raw spans (event.raw) and the chunk writer
+    re-uses them verbatim.
+    """
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        return (FilterResult.NOTOUCH, events)
+
+
+class OutputPlugin(Plugin):
+    """Output vtable: async ``flush(chunk_bytes, tag) -> FlushResult``."""
+
+    synchronous: bool = False  # FLB_OUTPUT_SYNCHRONOUS
+    no_multiplex: bool = False  # FLB_OUTPUT_NO_MULTIPLEX
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        return FlushResult.OK
+
+
+class ProcessorPlugin(Plugin):
+    """Processor vtable — per-instance pipelines with stages/conditions
+    (reference src/flb_processor.c). Runs on decoded events at input ingest
+    or output flush."""
+
+    def process_logs(self, events: list, tag: str, engine) -> list:
+        return events
+
+    def process_metrics(self, contexts: list, tag: str, engine) -> list:
+        return contexts
+
+    def process_traces(self, spans: list, tag: str, engine) -> list:
+        return spans
+
+
+class Instance:
+    """A configured plugin instance (flb_input_instance etc.)."""
+
+    def __init__(self, plugin: Plugin, kind: str):
+        self.plugin = plugin
+        self.kind = kind  # input|filter|output|processor|custom
+        # provisional name; the engine re-numbers per context
+        # (reference: instance names are in_emitter.0 style, per flb_config)
+        self.name = f"{plugin.name}.0"
+        self.alias: Optional[str] = None
+        self.properties = Properties()
+        self.route = Route(match="*")
+        plugin.instance = self
+
+    def set(self, key: str, value: Any) -> None:
+        self.properties.set(key, value)
+
+    def prop(self, key: str, default: Any = None) -> Any:
+        return self.properties.get(key, default)
+
+    def configure(self) -> None:
+        """Apply config_map + core keys."""
+        apply_config_map(self.plugin.config_map, self.properties, self.plugin)
+        self.alias = self.properties.get("alias")
+        match = self.properties.get("match")
+        match_regex = self.properties.get("match_regex")
+        if match or match_regex:
+            self.route = Route(match=match, match_regex=match_regex)
+
+    @property
+    def display_name(self) -> str:
+        return self.alias or self.name
+
+
+class InputInstance(Instance):
+    def __init__(self, plugin: InputPlugin):
+        super().__init__(plugin, "input")
+        self.pool = ChunkPool(self.name)
+        self.tag: Optional[str] = None
+        self.mem_buf_limit: int = 0  # 0 = unlimited
+        self.paused = False
+        self.storage_type = "memory"
+        self.processors: List = []  # input-side processor pipeline
+        self.collector_task = None
+
+    def configure(self) -> None:
+        super().configure()
+        self.tag = self.properties.get("tag") or self.plugin.default_tag or self.plugin.name
+        from .config import parse_size
+        mbl = self.properties.get("mem_buf_limit")
+        self.mem_buf_limit = parse_size(mbl) if mbl else 0
+        self.storage_type = self.properties.get("storage.type", "memory")
+
+
+class FilterInstance(Instance):
+    def __init__(self, plugin: FilterPlugin):
+        super().__init__(plugin, "filter")
+
+
+class OutputInstance(Instance):
+    def __init__(self, plugin: OutputPlugin):
+        super().__init__(plugin, "output")
+        self.retry_limit: Optional[int] = None  # None → service default
+        self.workers: int = 0
+        self.processors: List = []
+        # test hooks (reference: flb_output_set_test / test_formatter mode,
+        # src/flb_engine_dispatch.c:101-137)
+        self.test_formatter: Optional[Callable] = None
+
+    def configure(self) -> None:
+        super().configure()
+        rl = self.properties.get("retry_limit")
+        if rl is not None:
+            if str(rl).lower() in ("no_limits", "false", "no_retries_forever", "unlimited"):
+                self.retry_limit = -1
+            else:
+                self.retry_limit = int(rl)
+        w = self.properties.get("workers")
+        if w is not None:
+            self.workers = int(w)
+
+
+class Registry:
+    """Plugin name → class registry for all plugin kinds."""
+
+    def __init__(self) -> None:
+        self.inputs: Dict[str, Type[InputPlugin]] = {}
+        self.filters: Dict[str, Type[FilterPlugin]] = {}
+        self.outputs: Dict[str, Type[OutputPlugin]] = {}
+        self.processors: Dict[str, Type[ProcessorPlugin]] = {}
+
+    def register(self, cls: Type[Plugin]) -> Type[Plugin]:
+        if issubclass(cls, InputPlugin):
+            self.inputs[cls.name] = cls
+        elif issubclass(cls, FilterPlugin):
+            self.filters[cls.name] = cls
+        elif issubclass(cls, OutputPlugin):
+            self.outputs[cls.name] = cls
+        elif issubclass(cls, ProcessorPlugin):
+            self.processors[cls.name] = cls
+        else:
+            raise TypeError(f"unknown plugin kind {cls!r}")
+        return cls
+
+    def create_input(self, name: str) -> InputInstance:
+        return InputInstance(self._get(self.inputs, name, "input")())
+
+    def create_filter(self, name: str) -> FilterInstance:
+        return FilterInstance(self._get(self.filters, name, "filter")())
+
+    def create_output(self, name: str) -> OutputInstance:
+        return OutputInstance(self._get(self.outputs, name, "output")())
+
+    def create_processor(self, name: str):
+        inst = Instance(self._get(self.processors, name, "processor")(), "processor")
+        return inst
+
+    @staticmethod
+    def _get(table: dict, name: str, kind: str):
+        cls = table.get(name)
+        if cls is None:
+            raise ValueError(f"unknown {kind} plugin {name!r} (have: {sorted(table)})")
+        return cls
+
+
+#: Global default registry; plugins self-register at import via
+#: ``@registry.register``.
+registry = Registry()

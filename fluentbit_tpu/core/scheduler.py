@@ -1,0 +1,35 @@
+"""Retry scheduler — capped full-jitter exponential backoff.
+
+Reference: src/flb_scheduler.c:253-300 (backoff_full_jitter; random ms in
+[0, min(cap, base * 2^attempt)]), base FLB_SCHED_BASE=5s and cap
+FLB_SCHED_CAP=2000s (include/fluent-bit/flb_scheduler.h:29-30). Timers are
+asyncio-based rather than timerfd.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+def backoff_full_jitter(base: float, cap: float, attempt: int,
+                        rng: Optional[random.Random] = None) -> float:
+    """Delay in seconds for retry number ``attempt`` (1-based)."""
+    attempt = max(1, attempt)
+    exp = min(cap, base * (2 ** attempt))
+    r = rng or random
+    # reference waits at least 1s so retries never hot-loop
+    return max(1.0, r.uniform(0, exp))
+
+
+class Timer:
+    """A permanent or oneshot timer handle (flb_sched_timer equivalent)."""
+
+    def __init__(self, handle):
+        self._handle = handle
+        self.active = True
+
+    def cancel(self) -> None:
+        if self.active:
+            self._handle.cancel()
+            self.active = False

