@@ -217,6 +217,13 @@ class Engine:
                 log.exception("input %s collect failed", ins.display_name)
             await asyncio.sleep(interval)
 
+    def request_stop(self) -> None:
+        """Ask the engine loop to shut down gracefully (the in-pipeline
+        stop used by out_exit / filter_expect's exit action / in_exec's
+        exit_after_oneshot). The loop drains and exits; call stop() to
+        join the thread."""
+        self._stopping = True
+
     def stop(self) -> None:
         """Graceful stop with drain (flb_stop)."""
         if self._thread is None:
@@ -243,12 +250,14 @@ class Engine:
         """Append encoded log events; runs processors then the filter chain
         synchronously (src/flb_input_chunk.c:3078), then writes the chunk.
 
-        Returns number of records written (post-filter). Thread-safe.
+        Returns number of records written (post-filter), or -1 when the
+        append was rejected by backpressure (reference
+        flb_input_chunk_append_raw returns -1 on paused/overlimit).
+        Thread-safe: the whole ingest path (processors + filters + append)
+        runs under the ingest lock, serializing stateful filters exactly
+        like the reference's single engine thread does.
         """
         tag = tag or ins.tag or ins.plugin.name
-        events = decode_events(data)
-        if n_records is None:
-            n_records = len(events)
 
         # backpressure (mem_buf_limit, src/flb_input.c:157,740-746)
         if ins.mem_buf_limit and ins.pool.pending_bytes >= ins.mem_buf_limit:
@@ -258,26 +267,29 @@ class Engine:
                     ins.plugin.pause()
                 except Exception:
                     pass
-            return 0
+            return -1
 
-        self.m_in_records.inc(n_records, (ins.display_name,))
-        self.m_in_bytes.inc(len(data), (ins.display_name,))
+        with self._ingest_lock:
+            events = decode_events(data)
+            if n_records is None:
+                n_records = len(events)
+            self.m_in_records.inc(n_records, (ins.display_name,))
+            self.m_in_bytes.inc(len(data), (ins.display_name,))
 
-        # input-side processors (flb_processor_run, src/flb_input_log.c:1562)
-        for proc in ins.processors:
-            events = proc.plugin.process_logs(events, tag, self)
+            # input-side processors (flb_processor_run, src/flb_input_log.c:1562)
+            for proc in ins.processors:
+                events = proc.plugin.process_logs(events, tag, self)
+                if not events:
+                    return 0
+
+            # filter chain — synchronous, pre-storage
+            events = self._run_filters(events, tag)
             if not events:
                 return 0
 
-        # filter chain — synchronous, pre-storage
-        events = self._run_filters(events, tag)
-        if not events:
-            return 0
-
-        out = bytearray()
-        for ev in events:
-            out += ev.raw if ev.raw is not None else reencode_event(ev)
-        with self._ingest_lock:
+            out = bytearray()
+            for ev in events:
+                out += ev.raw if ev.raw is not None else reencode_event(ev)
             ins.pool.append(tag, bytes(out), len(events))
         return len(events)
 
@@ -362,7 +374,11 @@ class Engine:
         try:
             self.loop.call_soon_threadsafe(_create)
         except RuntimeError:
-            coro.close()  # loop shut down mid-stop; chunk stays accounted as dropped
+            # loop shut down mid-stop: account the chunk as dropped
+            coro.close()
+            self.m_out_errors.inc(1, (out.display_name,))
+            self.m_out_dropped.inc(task.chunk.records, (out.display_name,))
+            task.users -= 1
 
     async def _flush_one(self, task: Task, out: OutputInstance, delay: float) -> None:
         """One (task × output) flush coroutine, including its retries

@@ -16,12 +16,12 @@ byte-identical (raw span reuse).
 
 from __future__ import annotations
 
-import re
 from typing import List, Optional, Tuple
 
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FilterPlugin, FilterResult, registry
 from ..core.record_accessor import RecordAccessor
+from ..regex import FlbRegex
 
 LEGACY, AND, OR = "legacy", "AND", "OR"
 
@@ -39,20 +39,25 @@ def _to_text(v) -> Optional[str]:
 
 
 class Rule:
-    __slots__ = ("is_exclude", "ra", "pattern", "regex", "dfa")
+    __slots__ = ("is_exclude", "ra", "pattern", "regex")
 
     def __init__(self, is_exclude: bool, field: str, pattern: str):
         self.is_exclude = is_exclude
         self.ra = RecordAccessor(field)
         self.pattern = pattern
-        self.regex = re.compile(pattern)
-        self.dfa = None  # set by the TPU path when the pattern is DFA-able
+        # Ruby-semantics engine; .dfa is the device-executable table when
+        # the pattern is DFA-expressible (fluentbit_tpu.ops.grep uses it)
+        self.regex = FlbRegex(pattern)
+
+    @property
+    def dfa(self):
+        return self.regex.dfa
 
     def match(self, body: dict) -> bool:
         val = _to_text(self.ra.get(body))
         if val is None:
             return False
-        return self.regex.search(val) is not None
+        return self.regex.match(val)
 
 
 @registry.register

@@ -186,9 +186,10 @@ class NestFilter(FilterPlugin):
 
     def filter(self, events, tag, engine):
         op = (self.operation or "nest").lower()
-        touched = False
+        any_touched = False
         for ev in events:
             body = ev.body
+            touched = False
             if op == "nest" and self.nest_under:
                 moved = {}
                 for pat in self.wildcard:
@@ -217,7 +218,8 @@ class NestFilter(FilterPlugin):
                     body[self.nested_under] = nested
             if touched:
                 ev.raw = None
-        return (FilterResult.MODIFIED, events) if touched else (FilterResult.NOTOUCH, events)
+                any_touched = True
+        return (FilterResult.MODIFIED, events) if any_touched else (FilterResult.NOTOUCH, events)
 
 
 @registry.register
@@ -269,7 +271,7 @@ class ExpectFilter(FilterPlugin):
             if fail is not None:
                 self.failures += 1
                 if self.action == "exit":
-                    engine._stopping = True
+                    engine.request_stop()
                 elif self.action == "result_key":
                     ev.body["matched"] = False
                     ev.raw = None

@@ -75,8 +75,9 @@ class DummyInput(InputPlugin):
         buf = b"".join(
             encode_event(dict(self._body), ts, dict(self._meta)) for _ in range(n)
         )
-        engine.input_log_append(self._ins, self._ins.tag, buf, n)
-        self._emitted += n
+        ret = engine.input_log_append(self._ins, self._ins.tag, buf, n)
+        if ret >= 0:  # -1 = rejected by backpressure: don't burn the budget
+            self._emitted += n
 
 
 @registry.register
@@ -134,7 +135,8 @@ class LibInput(InputPlugin):
             encode_event(body, EventTime.from_float(ts) if ts is not None else None)
             for ts, body in records
         )
-        return self._engine.input_log_append(self._ins, self._ins.tag, buf, len(records))
+        ret = self._engine.input_log_append(self._ins, self._ins.tag, buf, len(records))
+        return max(0, ret)  # -1 (backpressure) → 0 ingested, like flb_lib_push
 
 
 @registry.register
@@ -177,6 +179,7 @@ class StdinInput(InputPlugin):
     def init(self, instance, engine) -> None:
         self._ins = instance
         self._eof = False
+        self._partial = ""  # line fragment straddling two reads
         os.set_blocking(sys.stdin.fileno(), False)
 
     def collect(self, engine) -> None:
@@ -188,11 +191,19 @@ class StdinInput(InputPlugin):
             return
         if chunk is None:  # non-blocking stream: no data yet
             return
-        if chunk == "":  # EOF
+        if chunk == "":  # EOF — flush any trailing partial line
             self._eof = True
-            return
+            chunk = "\n" if self._partial else ""
+        data = self._partial + chunk
+        if data.endswith("\n") or self._eof:
+            self._partial = ""
+            lines = data.splitlines()
+        else:
+            parts = data.splitlines(keepends=False)
+            self._partial = parts[-1] if parts else ""
+            lines = parts[:-1]
         records = []
-        for line in chunk.splitlines():
+        for line in lines:
             line = line.strip()
             if not line:
                 continue
@@ -292,4 +303,4 @@ class ExecInput(InputPlugin):
             buf = b"".join(encode_event(r) for r in records)
             engine.input_log_append(self._ins, self._ins.tag, buf, len(records))
         if self.oneshot and self.exit_after_oneshot:
-            engine._stopping = True
+            engine.request_stop()

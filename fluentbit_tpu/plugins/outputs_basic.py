@@ -32,12 +32,12 @@ def _json_default(o):
     return str(o)
 
 
-def format_json_lines(data: bytes, with_ts: bool = True) -> str:
+def format_json_lines(data: bytes, with_ts: bool = True, date_key: str = "date") -> str:
     lines = []
     for ev in decode_events(data):
         if with_ts:
             lines.append(json.dumps(
-                {"date": round(ev.ts_float, 9), **ev.body}, default=_json_default,
+                {date_key: round(ev.ts_float, 9), **ev.body}, default=_json_default,
                 separators=(",", ":"),
             ))
         else:
@@ -63,7 +63,7 @@ class StdoutOutput(OutputPlugin):
         if fmt == "msgpack":
             out.buffer.write(data)
         elif fmt in ("json", "json_lines", "json_stream"):
-            text = format_json_lines(data)
+            text = format_json_lines(data, date_key=self.json_date_key or "date")
             if fmt == "json":
                 text = "[" + text.replace("\n", ",") + "]"
             out.write(text + "\n")
@@ -188,7 +188,7 @@ class ExitOutput(OutputPlugin):
     async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
         self._seen += 1
         if self._seen >= self.flush_count:
-            engine._stopping = True
+            engine.request_stop()
         return FlushResult.OK
 
 

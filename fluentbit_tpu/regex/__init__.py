@@ -1,0 +1,140 @@
+"""Regex engine — Onigmo-equivalent matching for the TPU build.
+
+Two execution tiers, same semantics (ONIG_SYNTAX_RUBY, UTF-8 bytes):
+
+- ``compile_dfa`` → table-driven scan DFA for device execution
+  (fluentbit_tpu.ops.grep) and fast CPU batch matching.
+- ``FlbRegex`` → the user-facing wrapper (flb_regex_create/do/match
+  equivalent, src/flb_regex.c): DFA when possible, Python ``re`` fallback
+  (translated to Ruby semantics) for patterns with backrefs/lookaround,
+  plus named-capture extraction for the parser path.
+"""
+
+from __future__ import annotations
+
+import re as _pyre
+from typing import Dict, Optional
+
+from .parser import ParsedRegex, UnsupportedRegex, parse
+from .dfa import DFA, compile_dfa
+
+__all__ = ["FlbRegex", "DFA", "compile_dfa", "parse", "UnsupportedRegex",
+           "ParsedRegex", "to_python_regex"]
+
+
+def to_python_regex(pattern: str) -> str:
+    """Translate Ruby-syntax pattern to Python re syntax.
+
+    - ``(?<name>`` → ``(?P<name>``   (keep lookbehind ``(?<=`` / ``(?<!``)
+    - ``\\Z`` (Ruby: end-or-before-final-newline) → ``(?=\\n?\\Z)``
+    - ``\\z`` → ``\\Z``
+    - ``\\h``/``\\H`` (hex digit) → character classes
+    """
+    out = []
+    i = 0
+    n = len(pattern)
+    in_class = False
+    class_start = -1  # position just after '[' (or '[^')
+    while i < n:
+        c = pattern[i]
+        if c == "\\" and i + 1 < n:
+            nxt = pattern[i + 1]
+            if in_class:
+                # inside a class: \h expands to its ranges; anchors are
+                # not special in classes
+                if nxt == "h":
+                    out.append("0-9a-fA-F")
+                elif nxt == "H":
+                    # non-hex-digit as explicit ranges (valid inside a class,
+                    # unlike a nested [^...])
+                    out.append("\\x00-\\x2f\\x3a-\\x40\\x47-\\x60\\x67-\\uffff")
+                else:
+                    out.append(c + nxt)
+            elif nxt == "z":
+                out.append(r"\Z")
+            elif nxt == "Z":
+                out.append(r"(?=\n?\Z)")
+            elif nxt == "h":
+                out.append("[0-9a-fA-F]")
+            elif nxt == "H":
+                out.append("[^0-9a-fA-F]")
+            else:
+                out.append(c + nxt)
+            i += 2
+            continue
+        if in_class:
+            if c == "]" and i > class_start:
+                in_class = False
+            out.append(c)
+            i += 1
+            continue
+        if c == "[":
+            in_class = True
+            out.append(c)
+            i += 1
+            if i < n and pattern[i] == "^":
+                out.append("^")
+                i += 1
+            class_start = i  # a ']' at this exact position is literal
+            continue
+        if pattern.startswith("(?<", i) and not (
+            pattern.startswith("(?<=", i) or pattern.startswith("(?<!", i)
+        ):
+            out.append("(?P<")
+            i += 3
+            continue
+        if pattern.startswith("(?'", i):
+            j = pattern.index("'", i + 3)
+            out.append("(?P<" + pattern[i + 3 : j] + ">")
+            i = j + 1
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+class FlbRegex:
+    """flb_regex equivalent: compile once, match/parse many.
+
+    Ruby ^/$ are line anchors → the Python fallback compiles with
+    re.MULTILINE (exactly the ONIG_OPTION_NONE default of
+    src/flb_regex.c:146).
+    """
+
+    def __init__(self, pattern: str, ignorecase: bool = False):
+        self.pattern = pattern
+        self.dfa: Optional[DFA] = None
+        self.parsed: Optional[ParsedRegex] = None
+        try:
+            self.parsed = parse(pattern, ignorecase=ignorecase)
+            self.dfa = compile_dfa(self.parsed)
+        except UnsupportedRegex:
+            pass
+        flags = _pyre.MULTILINE
+        if ignorecase:
+            flags |= _pyre.IGNORECASE
+        self._py = _pyre.compile(to_python_regex(pattern), flags)
+
+    @property
+    def dfa_capable(self) -> bool:
+        return self.dfa is not None
+
+    def match(self, text) -> bool:
+        """Search semantics (flb_regex_match): True if found anywhere."""
+        if isinstance(text, str):
+            data = text.encode("utf-8")
+        else:
+            data = bytes(text)
+        if self.dfa is not None:
+            return self.dfa.match_bytes(data)
+        return self._py.search(data.decode("utf-8", "surrogateescape")) is not None
+
+    def parse_record(self, text) -> Optional[Dict[str, str]]:
+        """Named-capture extraction (flb_regex_parse with callback per
+        named group). Returns None when the pattern does not match."""
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", "surrogateescape")
+        m = self._py.search(text)
+        if m is None:
+            return None
+        return {k: v for k, v in m.groupdict().items() if v is not None}

@@ -1,0 +1,347 @@
+"""NFA construction + subset-construction DFA over byte classes.
+
+The TPU regex execution model (replacing Onigmo, lib/onigmo — the thing
+the north star re-expresses as a vectorized automaton kernel):
+
+- Thompson NFA over a 258-symbol alphabet: bytes 0..255, EOL (end of
+  input), BOS (begin of input).
+- Ruby-syntax zero-width anchors become *constraint epsilon edges*:
+  ``^`` crossable only when the previously consumed symbol ∈ {BOS, \\n},
+  ``$`` crossable only when the next symbol ∈ {EOL, \\n}, \\A/\\z/\\Z
+  analogous. This gives exact ONIG_SYNTAX_RUBY line-anchor semantics
+  (src/flb_regex.c:146) without lookaround machinery.
+- Unanchored search is a scan self-loop state with an epsilon into the
+  pattern (RE2-style), so one pass answers "match anywhere".
+- The accept NFA state is absorbing (self-loop on every symbol): a DFA
+  run needs NO per-position accept check — feed bytes then EOL(s);
+  matched ⟺ final state == ACC. Padding positions map to the EOL class,
+  which makes fixed-shape ``[B, L]`` batches trivially correct on device.
+- Subset construction compresses 258 symbols into equivalence classes;
+  the kernel table is ``trans[S, C] : int32`` + ``class_map[257] : uint8``
+  (entry 256 = EOL class, used for padding).
+
+DFA state ids: 0 = DEAD (absorbing reject), 1 = ACC (absorbing accept),
+2 = start (after BOS folded in).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+from .parser import (
+    ALL_BYTES,
+    Alt,
+    Anchor,
+    Group,
+    Lit,
+    Node,
+    ParsedRegex,
+    Rep,
+    Seq,
+    UnsupportedRegex,
+    parse,
+)
+
+EOL = 256
+BOS = 257
+EOL_BIT = 1 << EOL
+BOS_BIT = 1 << BOS
+NL_BIT = 1 << 10
+ALL_SYMS = (1 << 258) - 1
+
+DEAD = 0
+ACC = 1
+START = 2
+
+
+class _NFA:
+    """Mutable NFA being built. Edge kinds:
+    byte edges: consume a symbol in mask; eps edges: zero-width, with an
+    optional ('prev'|'next', mask) constraint."""
+
+    def __init__(self) -> None:
+        self.byte_edges: List[List[Tuple[int, int]]] = []  # state -> [(mask, dst)]
+        self.eps_edges: List[List[Tuple[Optional[str], int, int]]] = []  # (kind, mask, dst)
+
+    def new_state(self) -> int:
+        self.byte_edges.append([])
+        self.eps_edges.append([])
+        return len(self.byte_edges) - 1
+
+    def add_byte(self, src: int, mask: int, dst: int) -> None:
+        self.byte_edges[src].append((mask, dst))
+
+    def add_eps(self, src: int, dst: int, kind: Optional[str] = None, mask: int = 0) -> None:
+        self.eps_edges[src].append((kind, mask, dst))
+
+
+def _build(nfa: _NFA, node: Node, start: int) -> int:
+    """Thompson construction; returns the fragment's end state."""
+    if isinstance(node, Lit):
+        end = nfa.new_state()
+        nfa.add_byte(start, node.mask, end)
+        return end
+    if isinstance(node, Seq):
+        cur = start
+        for item in node.items:
+            cur = _build(nfa, item, cur)
+        return cur
+    if isinstance(node, Group):
+        return _build(nfa, node.node, start)
+    if isinstance(node, Alt):
+        end = nfa.new_state()
+        for item in node.items:
+            b_start = nfa.new_state()
+            nfa.add_eps(start, b_start)
+            b_end = _build(nfa, item, b_start)
+            nfa.add_eps(b_end, end)
+        return end
+    if isinstance(node, Rep):
+        cur = start
+        for _ in range(node.min):
+            cur = _build(nfa, node.node, cur)
+        if node.max is None:
+            # star/plus tail: loop state
+            loop = nfa.new_state()
+            nfa.add_eps(cur, loop)
+            inner_start = nfa.new_state()
+            nfa.add_eps(loop, inner_start)
+            inner_end = _build(nfa, node.node, inner_start)
+            nfa.add_eps(inner_end, loop)
+            return loop
+        else:
+            # up to (max-min) optional copies
+            ends = [cur]
+            for _ in range(node.max - node.min):
+                cur = _build(nfa, node.node, cur)
+                ends.append(cur)
+            end = nfa.new_state()
+            for e in ends:
+                nfa.add_eps(e, end)
+            return end
+    if isinstance(node, Anchor):
+        end = nfa.new_state()
+        if node.kind == "bol":
+            nfa.add_eps(start, end, "prev", BOS_BIT | NL_BIT)
+        elif node.kind == "bos":
+            nfa.add_eps(start, end, "prev", BOS_BIT)
+        elif node.kind == "eol":
+            nfa.add_eps(start, end, "next", EOL_BIT | NL_BIT)
+        elif node.kind == "eos":
+            nfa.add_eps(start, end, "next", EOL_BIT)
+        elif node.kind == "eos_nl":
+            # \Z: end of string, or before a final newline
+            nfa.add_eps(start, end, "next", EOL_BIT)
+            mid = nfa.new_state()
+            nfa.add_eps(start, mid, "next", NL_BIT)
+            mid2 = nfa.new_state()
+            nfa.add_byte(mid, NL_BIT, mid2)
+            nfa.add_eps(mid2, end, "next", EOL_BIT)
+        else:
+            raise UnsupportedRegex(f"anchor {node.kind}")
+        return end
+    raise TypeError(f"unknown AST node {node!r}")
+
+
+@dataclass
+class DFA:
+    """Compiled table-driven DFA (the kernel input).
+
+    trans[S, C] int32, class_map[257] uint8 (index 256 = EOL class, used
+    for padded positions), start id, ACC==1 absorbing accept, DEAD==0.
+    """
+
+    trans: np.ndarray
+    class_map: np.ndarray
+    start: int
+    n_states: int
+    n_classes: int
+    pattern: str
+
+    @property
+    def eol_class(self) -> int:
+        return int(self.class_map[EOL])
+
+    def match_bytes(self, data: bytes) -> bool:
+        """CPU reference matcher (search semantics, like flb_regex_match)."""
+        state = self.start
+        trans = self.trans
+        cmap = self.class_map
+        for b in data:
+            state = trans[state, cmap[b]]
+            if state <= ACC:  # DEAD or ACC — both absorbing
+                return state == ACC
+        state = trans[state, cmap[EOL]]
+        return state == ACC
+
+    def match_batch_np(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Vectorized numpy matcher over [B, L] uint8 padded batch
+        (test oracle for the device kernel)."""
+        B, L = batch.shape
+        cls = self.class_map[batch]  # [B, L]
+        pad = np.arange(L)[None, :] >= lengths[:, None]
+        cls[pad] = self.eol_class
+        state = np.full((B,), self.start, dtype=np.int32)
+        trans = self.trans
+        for i in range(L):
+            state = trans[state, cls[:, i]]
+        state = trans[state, np.full((B,), self.eol_class)]
+        return state == ACC
+
+
+def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,
+                max_states: int = 4096) -> DFA:
+    """Compile a pattern (str or ParsedRegex) to a scan DFA.
+
+    Raises UnsupportedRegex for non-DFA-expressible constructs; callers
+    fall back to the CPU engine (the same split the north star requires).
+    """
+    if isinstance(pattern, ParsedRegex):
+        parsed = pattern
+    else:
+        parsed = parse(pattern, ignorecase=ignorecase, dot_all=dot_all)
+
+    nfa = _NFA()
+    pre = nfa.new_state()         # consumes the virtual BOS symbol
+    scan = nfa.new_state()        # unanchored search loop
+    nfa.add_byte(pre, BOS_BIT, scan)
+    nfa.add_byte(scan, ALL_BYTES, scan)
+    p_start = nfa.new_state()
+    nfa.add_eps(scan, p_start)
+    p_end = _build(nfa, parsed.root, p_start)
+    accept = nfa.new_state()
+    nfa.add_eps(p_end, accept)
+    # absorbing accept: self-loop on every symbol incl. EOL/BOS
+    nfa.add_byte(accept, ALL_SYMS, accept)
+
+    n = len(nfa.byte_edges)
+
+    # ---- symbol equivalence classes ----
+    # refine {0..257} by every mask used anywhere (byte edges + constraints)
+    masks = set()
+    for st in range(n):
+        for m, _ in nfa.byte_edges[st]:
+            masks.add(m & ALL_SYMS)
+        for kind, m, _ in nfa.eps_edges[st]:
+            if kind is not None:
+                masks.add(m & ALL_SYMS)
+    masks.add(EOL_BIT)
+    masks.add(BOS_BIT)
+    sig_map: Dict[Tuple[bool, ...], int] = {}
+    sym_class = np.zeros(258, dtype=np.int32)
+    mask_list = sorted(masks)
+    for sym in range(258):
+        sig = tuple(bool(m >> sym & 1) for m in mask_list)
+        cid = sig_map.setdefault(sig, len(sig_map))
+        sym_class[sym] = cid
+    n_classes = len(sig_map)
+    # one representative symbol per class
+    rep: List[int] = [0] * n_classes
+    for sym in range(257, -1, -1):
+        rep[sym_class[sym]] = sym
+
+    # ---- closures ----
+    def closure_plain(states: FrozenSet[int]) -> FrozenSet[int]:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for kind, m, dst in nfa.eps_edges[s]:
+                if kind is None and dst not in out:
+                    out.add(dst)
+                    stack.append(dst)
+        return frozenset(out)
+
+    def closure_after(states: set, sym: int) -> FrozenSet[int]:
+        """Cross plain eps + prev-constraint eps (prev symbol = sym)."""
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for kind, m, dst in nfa.eps_edges[s]:
+                if kind == "next":
+                    continue
+                if kind == "prev" and not (m >> sym & 1):
+                    continue
+                if dst not in out:
+                    out.add(dst)
+                    stack.append(dst)
+        return frozenset(out)
+
+    def pre_closure(states: FrozenSet[int], sym: int) -> set:
+        """Cross plain eps + next-constraint eps (next symbol = sym)."""
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for kind, m, dst in nfa.eps_edges[s]:
+                if kind == "prev":
+                    continue
+                if kind == "next" and not (m >> sym & 1):
+                    continue
+                if dst not in out:
+                    out.add(dst)
+                    stack.append(dst)
+        return out
+
+    def move(states: FrozenSet[int], sym: int) -> FrozenSet[int]:
+        src = pre_closure(states, sym)
+        stepped = set()
+        for s in src:
+            for m, dst in nfa.byte_edges[s]:
+                if m >> sym & 1:
+                    stepped.add(dst)
+        return closure_after(stepped, sym)
+
+    # ---- subset construction ----
+    init = closure_plain(frozenset([pre]))
+    start_set = move(init, BOS)  # fold BOS into the start state
+
+    def canon(states: FrozenSet[int]) -> object:
+        if accept in states:
+            return "ACC"
+        if not states:
+            return "DEAD"
+        return states
+
+    dfa_ids: Dict[object, int] = {"DEAD": DEAD, "ACC": ACC}
+    table: List[List[int]] = [[DEAD] * n_classes, [ACC] * n_classes]
+    worklist: List[FrozenSet[int]] = []
+
+    def get_id(states: FrozenSet[int]) -> int:
+        key = canon(states)
+        if key in dfa_ids:
+            return dfa_ids[key]
+        sid = len(table)
+        if sid > max_states:
+            raise UnsupportedRegex(
+                f"DFA exceeds {max_states} states for pattern {parsed.pattern!r}"
+            )
+        dfa_ids[key] = sid
+        table.append([DEAD] * n_classes)
+        worklist.append(states)
+        return sid
+
+    start_id = get_id(start_set)
+    while worklist:
+        states = worklist.pop()
+        sid = dfa_ids[canon(states)]
+        for cid in range(n_classes):
+            sym = rep[cid]
+            if sym == BOS:
+                continue  # BOS never appears mid-stream
+            table[sid][cid] = get_id(move(states, sym))
+
+    trans = np.asarray(table, dtype=np.int32)
+    class_map = sym_class[:257].astype(np.uint8)
+    return DFA(
+        trans=trans,
+        class_map=class_map,
+        start=start_id,
+        n_states=len(table),
+        n_classes=n_classes,
+        pattern=parsed.pattern,
+    )

@@ -1,0 +1,205 @@
+"""Regex engine tests: DFA vs Python-re differential (Ruby semantics),
+apache2 parser pattern, anchors, classes, quantifiers.
+
+The oracle is Python re with re.MULTILINE (= ONIG_SYNTAX_RUBY ^/$ line
+anchors, src/flb_regex.c:146)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from fluentbit_tpu.regex import (
+    FlbRegex,
+    UnsupportedRegex,
+    compile_dfa,
+    to_python_regex,
+)
+
+APACHE2 = (
+    r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
+    r'"(?<method>\S+)(?: +(?<path>[^ ]*) +\S*)?" '
+    r'(?<code>[^ ]*) (?<size>[^ ]*)'
+    r'(?: "(?<referer>[^\"]*)" "(?<agent>.*)")?$'
+)
+
+APACHE_LINE = (
+    '192.168.1.10 - frank [10/Oct/2000:13:55:36 -0700] '
+    '"GET /apache_pb.gif HTTP/1.0" 200 2326 '
+    '"http://www.example.com/start.html" "Mozilla/4.08 [en] (Win98; I ;Nav)"'
+)
+
+
+def oracle(pattern: str, text: str) -> bool:
+    return re.search(to_python_regex(pattern), text, re.MULTILINE) is not None
+
+
+CASES = [
+    # (pattern, [texts...])
+    ("abc", ["abc", "xxabcxx", "ab", "ABC", "aabbcc", ""]),
+    ("a+b*c?", ["ac", "aaabbb", "c", "abc", "b"]),
+    ("^abc$", ["abc", "abc\n", "xabc", "abcx", "zz\nabc", "zz\nabc\nyy", "abc\nx"]),
+    ("a|b|cd", ["a", "b", "cd", "c", "d", "xcdy"]),
+    ("[a-f0-9]+", ["deadbeef", "xyz", "123", "ghij", "g1h"]),
+    ("[^ ]+", ["hello", " ", "", "a b"]),
+    (r"\d{3}-\d{4}", ["555-1234", "55-1234", "5555-123", "x555-9999y"]),
+    (r"(foo|bar)+baz", ["foobaz", "barfoobaz", "baz", "fobaz"]),
+    (r"^\[error\]", ["[error] disk", "info [error]", "x\n[error] y"]),
+    (r"done$", ["done", "done\n", "done\nmore", "not quite", "well done\nok"]),
+    (r"\Astart", ["start here", "\nstart", "restart"]),
+    (r"end\z", ["the end", "end\n", "ending"]),
+    (r"end\Z", ["the end", "end\n", "end\n\n", "ending"]),
+    (r"a.c", ["abc", "a\nc", "ac", "axc"]),
+    (r"x{2,3}", ["x", "xx", "xxx", "xxxx", "y"]),
+    (r"(?:ab){2}", ["abab", "ab", "aabb", "xababy"]),
+    (r"colou?r", ["color", "colour", "colr"]),
+    (r"\s+\S+", ["  word", "nospace", "\t\ntab", " "]),
+    (r"[\d\-]+", ["1-2-3", "abc", "--"]),
+    (r"\.log", ["app.log", "applog", "x.LOG"]),
+    (r"(a|ab)(c|bcd)", ["abcd", "ac", "abbcd", "abc"]),
+    (r"[]a]+", ["]", "a]", "b"]),          # ] first in class is literal
+    (r"[a^]", ["a", "^", "b"]),              # ^ not first is literal
+    (r"q[^u]", ["qa", "qu", "q"]),
+    (r"^$", ["", "a", "\n", "a\n", "a\n\n", "x\n\ny"]),
+    (r"a$\nb", ["a\nb", "ab", "a\n\nb"]),   # mid-pattern $ (Ruby line anchor)
+    (r"", ["", "anything"]),
+]
+
+
+@pytest.mark.parametrize("pattern,texts", CASES, ids=[c[0][:25] for c in CASES])
+def test_dfa_vs_python(pattern, texts):
+    dfa = compile_dfa(pattern)
+    for text in texts:
+        expect = oracle(pattern, text)
+        got = dfa.match_bytes(text.encode())
+        assert got == expect, f"pattern {pattern!r} on {text!r}: dfa={got} re={expect}"
+
+
+def test_apache2_dfa_compiles():
+    dfa = compile_dfa(APACHE2)
+    assert dfa.n_states < 4096
+    assert dfa.match_bytes(APACHE_LINE.encode())
+    assert not dfa.match_bytes(b"not an apache line at all")
+    # no quotes section is optional
+    assert dfa.match_bytes(b'1.2.3.4 - bob [1/Jan/2024:00:00:00 +0000] "GET / HTTP/1.1" 200 5')
+
+
+def test_apache2_vs_oracle_corpus():
+    dfa = compile_dfa(APACHE2)
+    corpus = [
+        APACHE_LINE,
+        '10.0.0.1 - - [01/Jan/2024:10:00:00 +0000] "POST /api/v1 HTTP/1.1" 500 0 "-" "-"',
+        'bad line',
+        '1.1.1.1 - alice [x] "PUT /p Z" 201 77',
+        'host user [time] no quotes here',
+        '- - - [] "" 0 0',
+        "",
+        "   ",
+        'a b c [d] "E f g" h i "j" "k"',
+    ]
+    for line in corpus:
+        assert dfa.match_bytes(line.encode()) == oracle(APACHE2, line), line
+
+
+def test_batch_matcher_matches_scalar():
+    dfa = compile_dfa(r"^\d+ (GET|POST) /[a-z]*")
+    lines = [
+        b"123 GET /index",
+        b"99 POST /",
+        b"GET /nope",
+        b"7 PUT /x",
+        b"456 GET /abc extra",
+        b"",
+    ]
+    L = 32
+    batch = np.zeros((len(lines), L), dtype=np.uint8)
+    lengths = np.zeros(len(lines), dtype=np.int32)
+    for i, ln in enumerate(lines):
+        arr = np.frombuffer(ln[:L], dtype=np.uint8)
+        batch[i, : len(arr)] = arr
+        lengths[i] = len(arr)
+    got = dfa.match_batch_np(batch, lengths)
+    expect = np.array([dfa.match_bytes(ln) for ln in lines])
+    assert (got == expect).all()
+
+
+def test_unsupported_fallback():
+    with pytest.raises(UnsupportedRegex):
+        compile_dfa(r"(\w+) \1")  # backreference
+    with pytest.raises(UnsupportedRegex):
+        compile_dfa(r"foo(?=bar)")  # lookahead
+    with pytest.raises(UnsupportedRegex):
+        compile_dfa(r"\bword\b")  # word boundary
+    rx = FlbRegex(r"foo(?=bar)")
+    assert not rx.dfa_capable
+    assert rx.match("foobar")
+    assert not rx.match("foobaz")
+
+
+def test_flbregex_named_captures():
+    rx = FlbRegex(APACHE2)
+    assert rx.dfa_capable
+    fields = rx.parse_record(APACHE_LINE)
+    assert fields["host"] == "192.168.1.10"
+    assert fields["user"] == "frank"
+    assert fields["method"] == "GET"
+    assert fields["path"] == "/apache_pb.gif"
+    assert fields["code"] == "200"
+    assert fields["size"] == "2326"
+    assert fields["agent"] == "Mozilla/4.08 [en] (Win98; I ;Nav)"
+    assert rx.parse_record("garbage") is None
+
+
+def test_ignorecase():
+    rx = FlbRegex("error", ignorecase=True)
+    assert rx.match("ERROR: disk full")
+    assert rx.match("Error")
+    dfa = compile_dfa("error", ignorecase=True)
+    assert dfa.match_bytes(b"SOME ERROR HERE")
+    assert not dfa.match_bytes(b"fine")
+
+
+def test_utf8_bytes():
+    # multi-byte literals expand to byte sequences
+    dfa = compile_dfa("héllo")
+    assert dfa.match_bytes("say héllo now".encode("utf-8"))
+    assert not dfa.match_bytes(b"say hello now")
+    # negated class consumes multi-byte chars bytewise
+    dfa2 = compile_dfa(r"^[^ ]+ x$")
+    assert dfa2.match_bytes("héllo🎉 x".encode("utf-8"))
+
+
+def test_fuzz_against_python():
+    """Randomized differential test over a safe pattern alphabet."""
+    import random
+
+    rng = random.Random(42)
+    atoms = ["a", "b", "c", "0", r"\d", r"\w", r"\s", "[ab]", "[^a]", ".", " "]
+    quants = ["", "*", "+", "?", "{2}", "{1,2}"]
+    for _ in range(300):
+        n = rng.randint(1, 6)
+        pat = ""
+        for _ in range(n):
+            pat += rng.choice(atoms) + rng.choice(quants)
+        if rng.random() < 0.3:
+            pat = "^" + pat
+        if rng.random() < 0.3:
+            pat = pat + "$"
+        if rng.random() < 0.2:
+            half = max(1, len(pat) // 2)
+            pat = pat[:half] + "|" + pat[half:]
+        try:
+            re.compile(to_python_regex(pat))
+        except re.error:
+            continue  # invalid for the oracle too (e.g. '|*' split)
+        try:
+            dfa = compile_dfa(pat)
+        except Exception as e:  # parser stricter than re is a bug
+            pytest.fail(f"compile failed for {pat!r}: {e}")
+        for _ in range(20):
+            text = "".join(
+                rng.choice("abc01 \nxyz") for _ in range(rng.randint(0, 12))
+            )
+            expect = oracle(pat, text)
+            got = dfa.match_bytes(text.encode())
+            assert got == expect, f"pattern {pat!r} text {text!r}: dfa={got} re={expect}"
