@@ -1,0 +1,76 @@
+"""Batch assembly — variable-length records → fixed-shape device arrays.
+
+The staging layer between msgpack chunks and the TPU kernels: field values
+(or whole lines) become a ``[B, L] uint8`` padded matrix + ``lengths`` i32.
+Records longer than L take the CPU fallback path (the same pattern the
+reference uses for locked oversized chunks, src/flb_input_chunk.c:3135).
+
+A C++ packer (native/staging.cpp) can replace the numpy loop; this is the
+semantic reference implementation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Batch:
+    """Fixed-shape batch of byte strings.
+
+    batch   : uint8 [B, L]  padded with 0 (pad positions are identified by
+              lengths, not by the pad byte value)
+    lengths : int32 [B]     valid byte count per row; -1 marks an INVALID
+              row (missing field) which must never match
+    overflow: indices of source strings longer than L (CPU fallback)
+    """
+
+    __slots__ = ("batch", "lengths", "overflow", "n")
+
+    def __init__(self, batch: np.ndarray, lengths: np.ndarray,
+                 overflow: List[int], n: int):
+        self.batch = batch
+        self.lengths = lengths
+        self.overflow = overflow
+        self.n = n
+
+
+def assemble(
+    values: Sequence[Optional[bytes]],
+    max_len: int = 512,
+    pad_batch_to: Optional[int] = None,
+) -> Batch:
+    """Pack byte strings into a padded [B, L] uint8 matrix.
+
+    ``None`` entries (missing record-accessor field) get length -1.
+    Strings longer than ``max_len`` are recorded in ``overflow`` and get
+    length -2 (kernel treats them as invalid; caller resolves on CPU).
+    ``pad_batch_to`` rounds B up (to a multiple of the device count or a
+    fixed bucket) so jit sees a stable shape and never recompiles.
+    """
+    n = len(values)
+    B = pad_batch_to if pad_batch_to and pad_batch_to >= n else n
+    batch = np.zeros((B, max_len), dtype=np.uint8)
+    lengths = np.full((B,), -1, dtype=np.int32)
+    overflow: List[int] = []
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        ln = len(v)
+        if ln > max_len:
+            overflow.append(i)
+            lengths[i] = -2
+            continue
+        if ln:
+            batch[i, :ln] = np.frombuffer(v, dtype=np.uint8)
+        lengths[i] = ln
+    return Batch(batch, lengths, overflow, n)
+
+
+def bucket_size(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)) -> int:
+    """Round a batch size up to a small set of jit-stable shapes."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return ((n + buckets[-1] - 1) // buckets[-1]) * buckets[-1]

@@ -1,0 +1,156 @@
+"""Device DFA execution — vectorized regex matching on TPU.
+
+The Onigmo-replacement kernel (SURVEY §2.2: "the thing the TPU build must
+re-express as a vectorized/compiled automaton kernel"). A compiled scan
+DFA (fluentbit_tpu.regex.dfa) runs over a ``[B, L] uint8`` batch as a
+``lax.scan`` of table gathers:
+
+    state[b] = trans[state[b], class(byte[b, t])]        t = 0..L
+
+- Multi-rule: R DFAs run in one kernel over ``[R, B, L]`` (each grep rule
+  may address a different record field, hence per-rule batches).
+- k-byte super-steps: transition tables are pre-composed to ``C^k``
+  columns (T2[s, c1*C+c2] = T[T[s,c1],c2]), cutting sequential scan steps
+  by k at the cost of a larger (still VMEM-resident) table. k is chosen
+  so the table stays under a size budget.
+- Padding positions map to the EOL symbol class, which is absorbing after
+  the first step — fixed shapes stay exact, no masking in the inner loop.
+- matched == (final_state == ACC): single comparison at scan end, no
+  per-position accept reduction.
+
+This module works on any JAX backend (tests force a CPU mesh); on TPU the
+gathers vectorize across the batch dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    HAVE_JAX = True
+except Exception:  # pragma: no cover
+    HAVE_JAX = False
+
+from ..regex.dfa import ACC, DFA, EOL
+
+# table budget for k-byte super-stepping (bytes); C^k columns * S rows * 4
+_TABLE_BUDGET = 4 * 1024 * 1024
+
+
+def choose_k(n_states: int, n_classes: int, budget: int = _TABLE_BUDGET) -> int:
+    k = 1
+    while k < 4:
+        cols = n_classes ** (k + 1)
+        if n_states * cols * 4 > budget:
+            break
+        k += 1
+    return k
+
+
+def compose_table(trans: np.ndarray, k: int) -> np.ndarray:
+    """Pre-compose a [S, C] table to k-byte super-steps: [S, C^k]."""
+    S, C = trans.shape
+    out = trans
+    for _ in range(k - 1):
+        # out[s, w] = state after word w; extend by one byte:
+        # new[s, w*C + c] = trans[out[s, w], c]
+        out = trans[out.reshape(-1)].reshape(S, -1)
+        # careful: trans[out[s,w]] gives [S*W, C]; reshape to [S, W*C]
+    return out
+
+
+class GrepProgram:
+    """R compiled DFAs fused into one device program.
+
+    Produces ``match(batch_u8[R,B,L], lengths[R,B]) -> bool[R,B]``.
+    """
+
+    def __init__(self, dfas: Sequence[DFA], max_len: int = 512):
+        if not HAVE_JAX:
+            raise RuntimeError("jax is unavailable")
+        self.dfas = list(dfas)
+        self.max_len = max_len
+        R = len(self.dfas)
+
+        # shared k so the combined-index math is uniform
+        self.k = min(choose_k(d.n_states, d.n_classes) for d in self.dfas)
+        tables = [compose_table(d.trans, self.k) for d in self.dfas]
+        max_flat = max(t.shape[0] * t.shape[1] for t in tables)
+        flat = np.zeros((R, max_flat), dtype=np.int32)
+        for r, t in enumerate(tables):
+            flat[r, : t.size] = t.reshape(-1)
+        self.trans_flat = jnp.asarray(flat)
+        self.n_cols = jnp.asarray(
+            [t.shape[1] for t in tables], dtype=np.int32
+        )  # C^k per rule (unused in math; cols folded in flat index)
+        self.C = jnp.asarray([d.n_classes for d in self.dfas], dtype=np.int32)
+        self.Ck = jnp.asarray(
+            [d.n_classes ** self.k for d in self.dfas], dtype=np.int32
+        )
+        cmaps = np.zeros((R, 257), dtype=np.int32)
+        for r, d in enumerate(self.dfas):
+            cmaps[r] = d.class_map.astype(np.int32)
+        self.class_maps = jnp.asarray(cmaps)
+        self.eol_cls = jnp.asarray(
+            [d.eol_class for d in self.dfas], dtype=np.int32
+        )
+        self.starts = jnp.asarray([d.start for d in self.dfas], dtype=np.int32)
+        self._jit = jax.jit(self._match_impl)
+
+    # -- the kernel --
+
+    def _match_impl(self, batch: "jnp.ndarray", lengths: "jnp.ndarray"):
+        R, B, L = batch.shape
+        k = self.k
+        # byte → class, per rule
+        cls = jax.vmap(lambda cm, bt: cm[bt])(self.class_maps, batch)  # [R,B,L] i32
+        pos = jnp.arange(L, dtype=jnp.int32)
+        pad = pos[None, None, :] >= lengths[:, :, None]  # [R,B,L]
+        cls = jnp.where(pad, self.eol_cls[:, None, None], cls)
+        # append EOL block: guarantees >=1 EOL and rounds L to multiple of k
+        extra = (k - (L % k)) % k + k
+        eol_block = jnp.broadcast_to(
+            self.eol_cls[:, None, None], (R, B, extra)
+        )
+        cls = jnp.concatenate([cls, eol_block], axis=2)
+        Lk = cls.shape[2] // k
+        cls = cls.reshape(R, B, Lk, k)
+        # combine k classes into one super-symbol, per-rule radix C_r
+        comb = cls[..., 0]
+        for j in range(1, k):
+            comb = comb * self.C[:, None, None] + cls[..., j]
+        comb_t = jnp.moveaxis(comb, 2, 0)  # [Lk, R, B]
+
+        state0 = jnp.broadcast_to(self.starts[:, None], (R, B))
+
+        def step(state, c_t):
+            idx = state * self.Ck[:, None] + c_t
+            ns = jnp.take_along_axis(self.trans_flat, idx, axis=1)
+            return ns, None
+
+        final, _ = lax.scan(step, state0, comb_t)
+        return (final == ACC) & (lengths >= 0)
+
+    def match(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Run the kernel; returns bool [R, B] (numpy)."""
+        out = self._jit(jnp.asarray(batch), jnp.asarray(lengths))
+        return np.asarray(out)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_program(patterns: Tuple[str, ...], max_len: int) -> "GrepProgram":
+    from ..regex.dfa import compile_dfa
+
+    return GrepProgram([compile_dfa(p) for p in patterns], max_len)
+
+
+def program_for(patterns: Sequence[str], max_len: int = 512) -> "GrepProgram":
+    """Compiled-program cache keyed by the pattern tuple."""
+    return _cached_program(tuple(patterns), max_len)

@@ -1,0 +1,123 @@
+"""Device DFA kernel tests — bit-exactness vs the CPU matcher.
+
+Runs on the virtual 8-device CPU backend (conftest). The contract under
+test is the north star's: device keep/exclude decisions must be
+bit-exact vs the CPU chain."""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluentbit_tpu.ops.batch import assemble, bucket_size
+from fluentbit_tpu.ops.grep import GrepProgram, choose_k, compose_table, program_for
+from fluentbit_tpu.regex.dfa import compile_dfa
+
+APACHE2 = (
+    r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
+    r'"(?<method>\S+)(?: +(?<path>[^ ]*) +\S*)?" '
+    r'(?<code>[^ ]*) (?<size>[^ ]*)'
+    r'(?: "(?<referer>[^\"]*)" "(?<agent>.*)")?$'
+)
+
+
+def make_lines(n, rng):
+    lines = []
+    for i in range(n):
+        kind = rng.randrange(4)
+        if kind == 0:
+            lines.append(
+                f'10.0.{rng.randrange(256)}.{rng.randrange(256)} - user{i} '
+                f'[10/Oct/2024:13:55:36 -0700] "GET /p{i} HTTP/1.1" '
+                f'{rng.choice([200, 404, 500])} {rng.randrange(10000)}'.encode()
+            )
+        elif kind == 1:
+            lines.append(b"random junk line " + str(i).encode())
+        elif kind == 2:
+            lines.append(b"")
+        else:
+            lines.append(
+                f'host{i} - u [t] "POST /x Z" 201 7 "r" "agent {i}"'.encode()
+            )
+    return lines
+
+
+def test_compose_table_equivalence():
+    dfa = compile_dfa(r"ab+c")
+    t2 = compose_table(dfa.trans, 2)
+    S, C = dfa.trans.shape
+    for s in (0, 1, dfa.start):
+        for c1 in range(C):
+            for c2 in range(C):
+                assert t2[s, c1 * C + c2] == dfa.trans[dfa.trans[s, c1], c2]
+
+
+def test_choose_k_budget():
+    assert choose_k(10, 4) >= 2
+    assert choose_k(100000, 200) == 1
+
+
+@pytest.mark.parametrize("pattern", ["abc", r"^\d+ GET", APACHE2, r"a*b|c$"])
+def test_kernel_vs_cpu(pattern):
+    rng = random.Random(7)
+    dfa = compile_dfa(pattern)
+    lines = make_lines(64, rng)
+    b = assemble(lines, max_len=256)
+    prog = GrepProgram([dfa], max_len=256)
+    got = prog.match(b.batch[None], b.lengths[None])[0]
+    expect = np.array([dfa.match_bytes(ln) for ln in lines])
+    assert (got == expect).all(), pattern
+
+
+def test_kernel_multi_rule_different_shapes():
+    rng = random.Random(9)
+    patterns = ["GET", r"^\d", APACHE2]
+    dfas = [compile_dfa(p) for p in patterns]
+    lines = make_lines(32, rng)
+    b = assemble(lines, max_len=128)
+    # rule 1 uses a different field: vary the batch per rule
+    other = [ln[::-1] for ln in lines]
+    b2 = assemble(other, max_len=128)
+    batch = np.stack([b.batch, b2.batch, b.batch])
+    lengths = np.stack([b.lengths, b2.lengths, b.lengths])
+    prog = GrepProgram(dfas, max_len=128)
+    got = prog.match(batch, lengths)
+    assert (got[0] == np.array([dfas[0].match_bytes(ln) for ln in lines])).all()
+    assert (got[1] == np.array([dfas[1].match_bytes(ln) for ln in other])).all()
+    assert (got[2] == np.array([dfas[2].match_bytes(ln) for ln in lines])).all()
+
+
+def test_invalid_rows_never_match():
+    dfa = compile_dfa(r"x*")  # matches everything incl. empty
+    b = assemble([b"abc", None, b"x" * 999], max_len=16)
+    assert b.overflow == [2]
+    prog = GrepProgram([dfa], max_len=16)
+    got = prog.match(b.batch[None], b.lengths[None])[0]
+    assert got[0]  # valid row matches
+    assert not got[1]  # missing field
+    assert not got[2]  # overflow → resolved on CPU by caller
+
+
+def test_padded_batch_rows_inert():
+    dfa = compile_dfa("a")
+    b = assemble([b"a", b"b"], max_len=8, pad_batch_to=bucket_size(2))
+    assert b.batch.shape[0] == 256
+    prog = GrepProgram([dfa], max_len=8)
+    got = prog.match(b.batch[None], b.lengths[None])[0]
+    assert got[0] and not got[1]
+    assert not got[2:].any()
+
+
+def test_apache2_bulk_bit_exact():
+    rng = random.Random(1234)
+    dfa = compile_dfa(APACHE2)
+    lines = make_lines(512, rng)
+    b = assemble(lines, max_len=512)
+    prog = program_for([APACHE2], max_len=512)
+    got = prog.match(b.batch[None], b.lengths[None])[0]
+    expect = dfa.match_batch_np(
+        b.batch, np.where(b.lengths < 0, 0, b.lengths)
+    ) & (b.lengths >= 0)
+    assert (got == expect).all()
+    scalar = np.array([dfa.match_bytes(ln) for ln in lines])
+    assert (got == scalar).all()
