@@ -170,7 +170,10 @@ class InputInstance(Instance):
 
     def configure(self) -> None:
         super().configure()
-        self.tag = self.properties.get("tag") or self.plugin.default_tag or self.plugin.name
+        # default tag = per-instance name (dummy.0, dummy.1, ...) so two
+        # instances of the same plugin never merge streams (reference:
+        # instance tag defaults to the instance name)
+        self.tag = self.properties.get("tag") or self.plugin.default_tag or self.name
         from .config import parse_size
         mbl = self.properties.get("mem_buf_limit")
         self.mem_buf_limit = parse_size(mbl) if mbl else 0

@@ -1,9 +1,10 @@
 """Retry scheduler — capped full-jitter exponential backoff.
 
-Reference: src/flb_scheduler.c:253-300 (backoff_full_jitter; random ms in
-[0, min(cap, base * 2^attempt)]), base FLB_SCHED_BASE=5s and cap
-FLB_SCHED_CAP=2000s (include/fluent-bit/flb_scheduler.h:29-30). Timers are
-asyncio-based rather than timerfd.
+Reference: src/flb_scheduler.c:253-300 (backoff_full_jitter; random
+seconds in [base, min(cap, base * 2^attempt)] plus one), base
+FLB_SCHED_BASE=5s and cap FLB_SCHED_CAP=2000s
+(include/fluent-bit/flb_scheduler.h:29-30). Timers are asyncio-based
+rather than timerfd.
 """
 
 from __future__ import annotations
@@ -18,8 +19,9 @@ def backoff_full_jitter(base: float, cap: float, attempt: int,
     attempt = max(1, attempt)
     exp = min(cap, base * (2 ** attempt))
     r = rng or random
-    # reference waits at least 1s so retries never hot-loop
-    return max(1.0, r.uniform(0, exp))
+    # reference draws from [base, exp] then adds one second so the first
+    # retry never fires before base+1 (src/flb_scheduler.c:259-264)
+    return r.uniform(min(base, exp), exp) + 1.0
 
 
 class Timer:

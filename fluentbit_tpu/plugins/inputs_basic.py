@@ -30,7 +30,6 @@ class DummyInput(InputPlugin):
     """
 
     name = "dummy"
-    default_tag = "dummy.0"
     config_map = [
         ConfigMapEntry("dummy", "str", default='{"message":"dummy"}'),
         ConfigMapEntry("rate", "int", default=1),
@@ -87,7 +86,6 @@ class LibInput(InputPlugin):
     NDJSON lines."""
 
     name = "lib"
-    default_tag = "lib.0"
 
     def init(self, instance, engine) -> None:
         self._ins = instance
@@ -144,7 +142,6 @@ class RandomInput(InputPlugin):
     """plugins/in_random: emits {"rand_value": N} at interval."""
 
     name = "random"
-    default_tag = "random.0"
     config_map = [
         ConfigMapEntry("samples", "int", default=-1),
         ConfigMapEntry("interval_sec", "int", default=1),
@@ -169,7 +166,6 @@ class StdinInput(InputPlugin):
     """plugins/in_stdin: NDJSON/raw lines from stdin (used by CLI mode)."""
 
     name = "stdin"
-    default_tag = "stdin.0"
     collect_interval = 0.05
     config_map = [
         ConfigMapEntry("parser", "str"),
@@ -224,7 +220,6 @@ class HeadInput(InputPlugin):
     """plugins/in_head: reads the first N bytes/lines of a file per tick."""
 
     name = "head"
-    default_tag = "head.0"
     config_map = [
         ConfigMapEntry("file", "str"),
         ConfigMapEntry("buf_size", "size", default="256"),
@@ -273,7 +268,6 @@ class ExecInput(InputPlugin):
     """plugins/in_exec: runs a command per tick, one record per output line."""
 
     name = "exec"
-    default_tag = "exec.0"
     config_map = [
         ConfigMapEntry("command", "str"),
         ConfigMapEntry("interval_sec", "int", default=1),

@@ -15,11 +15,42 @@ from __future__ import annotations
 import re as _pyre
 from typing import Dict, Optional
 
-from .parser import ParsedRegex, UnsupportedRegex, parse
+from .parser import (ALL_BYTES, _POSIX_CLASSES, ParsedRegex,
+                     UnsupportedRegex, parse)
 from .dfa import DFA, compile_dfa
 
 __all__ = ["FlbRegex", "DFA", "compile_dfa", "parse", "UnsupportedRegex",
            "ParsedRegex", "to_python_regex"]
+
+
+def _class_content(mask: int) -> str:
+    """Render a 256-bit byte mask as Python character-class content."""
+    out = []
+    b = 0
+    while b < 256:
+        if mask >> b & 1:
+            start = b
+            while b < 256 and mask >> b & 1:
+                b += 1
+            end = b - 1
+            # a run reaching 0xFF means "any non-ASCII byte"; in decoded
+            # text that is any astral/BMP char (incl. surrogateescape)
+            hi = "\\U0010ffff" if end == 0xFF else "\\x%02x" % end
+            if start == end:
+                out.append("\\x%02x" % start)
+            else:
+                out.append("\\x%02x-%s" % (start, hi))
+        else:
+            b += 1
+    return "".join(out)
+
+
+def _posix_content(name: str) -> str:
+    neg = name.startswith("^")
+    mask = _POSIX_CLASSES.get(name[1:] if neg else name)
+    if mask is None:
+        raise UnsupportedRegex(f"unknown POSIX class [:{name}:]")
+    return _class_content(ALL_BYTES & ~mask if neg else mask)
 
 
 def to_python_regex(pattern: str) -> str:
@@ -29,6 +60,8 @@ def to_python_regex(pattern: str) -> str:
     - ``\\Z`` (Ruby: end-or-before-final-newline) → ``(?=\\n?\\Z)``
     - ``\\z`` → ``\\Z``
     - ``\\h``/``\\H`` (hex digit) → character classes
+    - ``\\e`` (escape char, Ruby-only) → ``\\x1b``
+    - POSIX classes ``[[:alpha:]]`` → expanded ranges
     """
     out = []
     i = 0
@@ -48,6 +81,8 @@ def to_python_regex(pattern: str) -> str:
                     # non-hex-digit as explicit ranges (valid inside a class,
                     # unlike a nested [^...])
                     out.append("\\x00-\\x2f\\x3a-\\x40\\x47-\\x60\\x67-\\uffff")
+                elif nxt == "e":
+                    out.append("\\x1b")
                 else:
                     out.append(c + nxt)
             elif nxt == "z":
@@ -58,11 +93,21 @@ def to_python_regex(pattern: str) -> str:
                 out.append("[0-9a-fA-F]")
             elif nxt == "H":
                 out.append("[^0-9a-fA-F]")
+            elif nxt == "e":
+                out.append("\\x1b")
             else:
                 out.append(c + nxt)
             i += 2
             continue
         if in_class:
+            if c == "[" and pattern.startswith("[:", i):
+                j = pattern.find(":]", i + 2)
+                # a name spanning ']' means the '[:' was literal class
+                # content, not a POSIX class (e.g. "[a[:b]")
+                if j > 0 and "]" not in pattern[i + 2 : j]:
+                    out.append(_posix_content(pattern[i + 2 : j]))
+                    i = j + 2
+                    continue
             if c == "]" and i > class_start:
                 in_class = False
             out.append(c)
@@ -103,6 +148,7 @@ class FlbRegex:
 
     def __init__(self, pattern: str, ignorecase: bool = False):
         self.pattern = pattern
+        self.ignorecase = ignorecase
         self.dfa: Optional[DFA] = None
         self.parsed: Optional[ParsedRegex] = None
         try:
@@ -110,10 +156,19 @@ class FlbRegex:
             self.dfa = compile_dfa(self.parsed)
         except UnsupportedRegex:
             pass
-        flags = _pyre.MULTILINE
-        if ignorecase:
-            flags |= _pyre.IGNORECASE
-        self._py = _pyre.compile(to_python_regex(pattern), flags)
+        # the Python fallback is compiled lazily: a DFA-capable pattern may
+        # use Ruby-valid constructs Python rejects, and must still work
+        self._py_cached = None
+        if self.dfa is None:
+            self._py()  # no engine can run it → raise at construction
+
+    def _py(self):
+        if self._py_cached is None:
+            flags = _pyre.MULTILINE
+            if self.ignorecase:
+                flags |= _pyre.IGNORECASE
+            self._py_cached = _pyre.compile(to_python_regex(self.pattern), flags)
+        return self._py_cached
 
     @property
     def dfa_capable(self) -> bool:
@@ -127,14 +182,14 @@ class FlbRegex:
             data = bytes(text)
         if self.dfa is not None:
             return self.dfa.match_bytes(data)
-        return self._py.search(data.decode("utf-8", "surrogateescape")) is not None
+        return self._py().search(data.decode("utf-8", "surrogateescape")) is not None
 
     def parse_record(self, text) -> Optional[Dict[str, str]]:
         """Named-capture extraction (flb_regex_parse with callback per
         named group). Returns None when the pattern does not match."""
         if isinstance(text, bytes):
             text = text.decode("utf-8", "surrogateescape")
-        m = self._py.search(text)
+        m = self._py().search(text)
         if m is None:
             return None
         return {k: v for k, v in m.groupdict().items() if v is not None}

@@ -103,6 +103,31 @@ _CHAR_ESCAPES = {
 }
 
 
+def _range_mask(lo: int, hi: int) -> int:
+    return ((1 << (hi + 1)) - 1) & ~((1 << lo) - 1)
+
+
+#: POSIX bracket classes ``[:name:]`` (ASCII ranges — consistent with the
+#: ASCII interpretation this engine uses for \\w/\\d/\\s; Onigmo syntax).
+_POSIX_CLASSES = {
+    "alnum": _D | _mask_of("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"),
+    "alpha": _mask_of("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"),
+    "ascii": _range_mask(0x00, 0x7F),
+    "blank": _mask_of(" \t"),
+    "cntrl": _range_mask(0x00, 0x1F) | (1 << 0x7F),
+    "digit": _D,
+    "graph": _range_mask(0x21, 0x7E),
+    "lower": _mask_of("abcdefghijklmnopqrstuvwxyz"),
+    "print": _range_mask(0x20, 0x7E),
+    "punct": _range_mask(0x21, 0x2F) | _range_mask(0x3A, 0x40)
+             | _range_mask(0x5B, 0x60) | _range_mask(0x7B, 0x7E),
+    "space": _S,
+    "upper": _mask_of("ABCDEFGHIJKLMNOPQRSTUVWXYZ"),
+    "word": _W,
+    "xdigit": _H,
+}
+
+
 class _Parser:
     def __init__(self, pattern: str, ignorecase: bool = False,
                  dot_all: bool = False):
@@ -350,6 +375,10 @@ class _Parser:
                 self.next()
                 break
             first = False
+            # POSIX bracket class [:name:] / [:^name:] (Onigmo syntax)
+            if c == "[" and self.pos + 1 < self.n and self.pat[self.pos + 1] == ":":
+                mask |= self._parse_posix_class()
+                continue
             self.next()
             if c == "\\":
                 e = self.next()
@@ -401,6 +430,27 @@ class _Parser:
         if negate:
             mask = ALL_BYTES & ~mask
         return mask
+
+    def _parse_posix_class(self) -> int:
+        """``[:name:]`` / ``[:^name:]`` inside a class; cursor at ``[``."""
+        save = self.pos
+        self.next()  # '['
+        self.next()  # ':'
+        neg = self.eat("^")
+        name = ""
+        while self.peek() is not None and self.peek() not in (":", "]"):
+            name += self.next()
+        if self.peek() == ":" and self.pos + 1 < self.n and self.pat[self.pos + 1] == "]":
+            self.next()
+            self.next()
+            m = _POSIX_CLASSES.get(name)
+            if m is None:
+                raise UnsupportedRegex(f"unknown POSIX class [:{name}:]")
+            return ALL_BYTES & ~m if neg else m
+        # not actually a POSIX class (e.g. "[a[:b]"): rewind, treat '[' literal
+        self.pos = save
+        self.next()
+        return 1 << ord("[")
 
 
 @dataclass

@@ -4,17 +4,28 @@ Force JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver validates the real multi-chip
 path separately via __graft_entry__.dryrun_multichip).
 
-Must run before any jax import, hence top of conftest.
+The bench environment registers a TPU PJRT plugin from sitecustomize and
+force-selects it via ``jax.config.update("jax_platforms", ...)`` — which
+OVERRIDES the JAX_PLATFORMS env var. So setting the env var alone is not
+enough (measured: platform init then blocks for minutes); we must issue
+our own config.update before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax absent: ops tests skip themselves
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
