@@ -1,0 +1,165 @@
+"""North-star benchmark — apache2 grep through the device filter stage.
+
+BASELINE config 1: in_dummy → filter_grep (apache2 regex,
+/root/reference/conf/parsers.conf:9) → out_null. This harness measures the
+filter stage itself at the engine's filter boundary (decoded events in,
+surviving events out — the fluentbit_tpu filter contract), which is where
+the reference runs cb_grep_filter per chunk
+(plugins/filter_grep/grep.c:286-392).
+
+Prints ONE JSON line:
+  {"metric": "grep_filter_lines_per_sec", "value": N, "unit": "lines/sec",
+   "vs_baseline": N/50e6, ...extras}
+
+vs_baseline is against the north-star target (≥50M lines/sec, BASELINE.md);
+the reference publishes no number of its own. bit_exact asserts the device
+path's surviving records are byte-identical to the CPU verdict chain.
+
+Run on TPU: plain `python bench.py` (platform from the environment).
+Local CPU dev: BENCH_FORCE_CPU=1 python bench.py.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+if os.environ.get("BENCH_FORCE_CPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        # the env var alone loses to a sitecustomize PJRT registration
+        # that force-selects its platform via config.update
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+APACHE2 = (
+    r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
+    r'"(?<method>\S+)(?: +(?<path>[^ ]*) +\S*)?" (?<code>[^ ]*) '
+    r'(?<size>[^ ]*)(?: "(?<referer>[^\"]*)" "(?<agent>.*)")?$'
+)
+
+CHUNK_RECORDS = 8192
+N_CHUNKS = 8
+TARGET = 50e6  # north-star lines/sec (BASELINE.md)
+
+
+def make_corpus(n_chunks, records_per_chunk, seed=1234):
+    """Distinct pre-encoded chunks of apache-ish access log records
+    (~25% deliberately non-matching)."""
+    from fluentbit_tpu.codec.events import decode_events, encode_event
+
+    rng = random.Random(seed)
+    methods = ["GET", "POST", "PUT", "DELETE", "HEAD"]
+    agents = ["Mozilla/5.0 (X11; Linux x86_64)", "curl/8.5.0", "kube-probe/1.29"]
+    chunks = []
+    for c in range(n_chunks):
+        buf = bytearray()
+        for i in range(records_per_chunk):
+            if rng.random() < 0.25:
+                line = f"kernel: oom-killer invoked pid={rng.randrange(1 << 16)}"
+            else:
+                line = (
+                    f"10.{rng.randrange(256)}.{rng.randrange(256)}.{rng.randrange(256)} "
+                    f"- {'frank' if rng.random() < 0.5 else '-'} "
+                    f"[10/Oct/2000:13:55:{i % 60:02d} -0700] "
+                    f'"{rng.choice(methods)} /path/{rng.randrange(10000)} HTTP/1.1" '
+                    f"{rng.choice([200, 301, 404, 500])} {rng.randrange(1 << 20)} "
+                    f'"http://referer.example/{c}" "{rng.choice(agents)}"'
+                )
+            buf += encode_event({"log": line}, float(i))
+        chunks.append(decode_events(bytes(buf)))
+    return chunks
+
+
+def build_filter(device: bool):
+    from fluentbit_tpu.core.plugin import registry
+
+    ins = registry.create_filter("grep")
+    ins.set("regex", f"log {APACHE2}")
+    ins.set("tpu_batch_records", "1")
+    if not device:
+        ins.set("tpu.enable", "off")
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def main():
+    t_setup = time.time()
+    chunks = make_corpus(N_CHUNKS, CHUNK_RECORDS)
+    f_dev = build_filter(device=True)
+    f_cpu = build_filter(device=False)
+    device_path = f_dev._program is not None
+
+    # -- bit-exactness: device vs CPU verdict chain on every chunk --
+    bit_exact = True
+    for ch in chunks[:2]:
+        _, kept_dev = f_dev.filter(list(ch), "bench", None)
+        _, kept_cpu = f_cpu.filter(list(ch), "bench", None)
+        if [e.raw for e in kept_dev] != [e.raw for e in kept_cpu]:
+            bit_exact = False
+
+    # -- warmup (jit compile) --
+    f_dev.filter(list(chunks[0]), "bench", None)
+
+    # -- timed: full filter stage (staging + kernel + verdict + compaction) --
+    t_end = time.time() + 3.0
+    lines = 0
+    chunk_times = []
+    i = 0
+    while time.time() < t_end:
+        ch = chunks[i % N_CHUNKS]
+        t0 = time.perf_counter()
+        f_dev.filter(ch, "bench", None)
+        chunk_times.append(time.perf_counter() - t0)
+        lines += len(ch)
+        i += 1
+    elapsed = sum(chunk_times)
+    lps = lines / elapsed if elapsed else 0.0
+    p50_ms = sorted(chunk_times)[len(chunk_times) // 2] * 1e3
+
+    # -- kernel-only: pre-staged batch, device matching alone --
+    kernel_lps = None
+    if device_path:
+        from fluentbit_tpu.ops.batch import assemble, bucket_size
+
+        vals = [
+            (v.encode() if isinstance(v, str) else v)
+            for v in (ev.body.get("log") for ev in chunks[0])
+        ]
+        b = assemble(vals, f_dev.tpu_max_record_len, bucket_size(len(vals)))
+        batch = np.stack([b.batch])
+        lengths = np.stack([b.lengths])
+        f_dev._program.match(batch, lengths)  # warm
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 2.0:
+            f_dev._program.match(batch, lengths)
+            reps += 1
+        kernel_lps = reps * len(vals) / (time.perf_counter() - t0)
+
+    result = {
+        "metric": "grep_filter_lines_per_sec",
+        "value": round(lps),
+        "unit": "lines/sec",
+        "vs_baseline": round(lps / TARGET, 6),
+        "p50_chunk_ms": round(p50_ms, 3),
+        "bit_exact": bit_exact,
+        "device_path": device_path,
+        "kernel_only_lines_per_sec": round(kernel_lps) if kernel_lps else None,
+        "chunk_records": CHUNK_RECORDS,
+        "setup_seconds": round(time.time() - t_setup, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,7 @@ class GrepProgram:
         )
         self.starts = jnp.asarray([d.start for d in self.dfas], dtype=np.int32)
         self._jit = jax.jit(self._match_impl)
+        self._sharded_cache: dict = {}
 
     # -- the kernel --
 
@@ -128,7 +129,10 @@ class GrepProgram:
             comb = comb * self.C[:, None, None] + cls[..., j]
         comb_t = jnp.moveaxis(comb, 2, 0)  # [Lk, R, B]
 
-        state0 = jnp.broadcast_to(self.starts[:, None], (R, B))
+        # + 0*lengths: ties the carry to the (possibly mesh-sharded) batch
+        # so its varying-axes annotation matches the scan output under
+        # shard_map; a no-op single-device
+        state0 = jnp.broadcast_to(self.starts[:, None], (R, B)) + 0 * lengths
 
         def step(state, c_t):
             idx = state * self.Ck[:, None] + c_t
@@ -142,6 +146,58 @@ class GrepProgram:
         """Run the kernel; returns bool [R, B] (numpy)."""
         out = self._jit(jnp.asarray(batch), jnp.asarray(lengths))
         return np.asarray(out)
+
+    # -- multi-device (SPMD over a 1-D device mesh) --
+
+    def sharded_matcher(self, mesh, axis: str = "batch"):
+        """Build the SPMD matcher for ``mesh``: the batch dimension is
+        sharded across devices (the DP axis of SURVEY §2.4 — chunks →
+        fixed-width arrays), the per-rule transition tables replicate, and
+        global per-rule match counts reduce with ``lax.psum`` over ICI
+        (the metrics-reduction contract of BASELINE/SURVEY §2.4).
+
+        Returns ``fn(batch[R, B, L], lengths[R, B]) -> (mask[R, B],
+        counts[R])`` with ``B`` divisible by the mesh size; ``counts`` is
+        the global (all-device) per-rule match total.
+        """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def step(batch, lengths):
+            mask = self._match_impl(batch, lengths)
+            counts = lax.psum(
+                jnp.sum(mask.astype(jnp.int32), axis=1), axis_name=axis
+            )
+            return mask, counts
+
+        return jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(None, axis, None), P(None, axis)),
+                out_specs=(P(None, axis), P()),
+            )
+        )
+
+    def match_sharded(self, mesh, batch: np.ndarray, lengths: np.ndarray):
+        """Pad B up to the mesh size and run the SPMD matcher; returns
+        (mask[R, B] numpy, counts[R] numpy, matcher-padded batch size)."""
+        n_dev = mesh.devices.size
+        R, B, L = batch.shape
+        Bp = ((B + n_dev - 1) // n_dev) * n_dev
+        if Bp != B:
+            batch = np.concatenate(
+                [batch, np.zeros((R, Bp - B, L), dtype=batch.dtype)], axis=1
+            )
+            lengths = np.concatenate(
+                [lengths, np.full((R, Bp - B), -1, dtype=lengths.dtype)], axis=1
+            )
+        fn = self._sharded_cache.get(id(mesh))
+        if fn is None:
+            fn = self.sharded_matcher(mesh, axis=mesh.axis_names[0])
+            self._sharded_cache[id(mesh)] = fn
+        mask, counts = fn(jnp.asarray(batch), jnp.asarray(lengths))
+        return np.asarray(mask)[:, :B], np.asarray(counts), Bp
 
 
 @functools.lru_cache(maxsize=64)

@@ -7,16 +7,21 @@ Exclude-hit ⇒ EXCLUDE, Regex-hit ⇒ KEEP, fallthrough ⇒ KEEP,
 grep.c:167-194), AND, OR (grep.c:250-284 — note the verdict uses the type
 of the *last examined* rule, matching the reference exactly).
 
-Execution: when the engine has the TPU ops layer enabled and every rule
-pattern compiles to a DFA, matching runs vectorized on device via
-fluentbit_tpu.ops.grep (chunk batch → keep mask); otherwise a CPU regex
-path with identical semantics. Surviving records are re-emitted
-byte-identical (raw span reuse).
+Execution: when every rule pattern compiles to a DFA (and ``tpu.enable``
+is on, jax present), matching runs vectorized on device via
+fluentbit_tpu.ops.grep — field values are staged into a ``[R, B, L]``
+batch, the fused DFA kernel produces the per-rule match matrix, and the
+legacy/AND/OR verdict is applied as vector ops on the mask. Records whose
+field overflows ``tpu_max_record_len`` (or batches smaller than
+``tpu_batch_records``) resolve on the CPU path with identical semantics.
+Surviving records are re-emitted byte-identical (raw span reuse).
 """
 
 from __future__ import annotations
 
 from typing import List, Optional, Tuple
+
+import numpy as np
 
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FilterPlugin, FilterResult, registry
@@ -70,6 +75,13 @@ class GrepFilter(FilterPlugin):
         ConfigMapEntry("exclude", "slist", multiple=True, slist_max_split=1,
                        desc="exclude rule: <field> <pattern>"),
         ConfigMapEntry("logical_op", "str", default="legacy"),
+        ConfigMapEntry("tpu.enable", "bool", default=True,
+                       desc="vectorized device matching when rules allow"),
+        ConfigMapEntry("tpu_batch_records", "int", default=32,
+                       desc="min records per append to use the device path"),
+        ConfigMapEntry("tpu_max_record_len", "int", default=512,
+                       desc="field byte length staged on device; longer "
+                            "values resolve on the CPU fallback"),
     ]
 
     def init(self, instance, engine) -> None:
@@ -93,6 +105,17 @@ class GrepFilter(FilterPlugin):
             kinds = {r.is_exclude for r in self.rules}
             if len(kinds) > 1:
                 raise ValueError("grep: AND/OR mode cannot mix Regex and Exclude rules")
+        # device program: all rules DFA-expressible + jax importable
+        self._program = None
+        if self.tpu_enable and self.rules and all(r.dfa is not None for r in self.rules):
+            try:
+                from ..ops.grep import program_for
+
+                self._program = program_for(
+                    tuple(r.pattern for r in self.rules), self.tpu_max_record_len
+                )
+            except Exception:
+                self._program = None
 
     # -- verdicts (bit-exact vs grep.c) --
 
@@ -119,8 +142,74 @@ class GrepFilter(FilterPlugin):
             return found
         return not found
 
+    # -- vectorized verdicts over the device match matrix --
+
+    def keep_mask(self, mask: np.ndarray) -> np.ndarray:
+        """mask[R, B] per-rule match matrix → keep[B], same semantics as
+        keep_record (grep.c verdict logic applied as vector ops)."""
+        B = mask.shape[1]
+        if self.op == LEGACY:
+            keep = np.ones(B, dtype=bool)
+            undecided = np.ones(B, dtype=bool)
+            for r, rule in enumerate(self.rules):
+                m = mask[r]
+                if rule.is_exclude:
+                    keep &= ~(undecided & m)  # Exclude-hit → drop
+                    undecided &= ~m
+                else:
+                    # a Regex rule decides every still-undecided record
+                    keep = np.where(undecided, m, keep)
+                    break
+            return keep
+        found = mask.any(axis=0) if self.op == OR else mask.all(axis=0)
+        # AND/OR rules are all the same kind (enforced in init)
+        return ~found if self.rules[0].is_exclude else found
+
+    def _match_matrix_device(self, events: list) -> np.ndarray:
+        """Stage field values, run the fused DFA kernel, resolve overflow
+        rows on CPU. Returns mask[R, B] bool."""
+        from ..ops.batch import assemble, bucket_size
+
+        B = len(events)
+        R = len(self.rules)
+        # rules addressing the same field share one extraction + staging
+        # pass (the staging loop is the hot-path bottleneck)
+        by_path: dict = {}
+        for r, rule in enumerate(self.rules):
+            by_path.setdefault(rule.ra.pattern, (rule.ra, []))[1].append(r)
+        Bp = bucket_size(B)
+        L = self.tpu_max_record_len
+        values: List[Optional[List[Optional[bytes]]]] = [None] * R
+        batches = [None] * R
+        for ra, idxs in by_path.values():
+            vals: List[Optional[bytes]] = []
+            for ev in events:
+                v = _to_text(ra.get(ev.body))
+                vals.append(v.encode("utf-8") if v is not None else None)
+            staged = assemble(vals, L, Bp)
+            for r in idxs:
+                values[r] = vals
+                batches[r] = staged
+        batch = np.stack([b.batch for b in batches])
+        lengths = np.stack([b.lengths for b in batches])
+        mask = self._program.match(batch, lengths)
+        mask = np.array(mask[:, :B])
+        for r, brec in enumerate(batches):
+            rule = self.rules[r]
+            for i in brec.overflow:
+                mask[r, i] = rule.regex.match(values[r][i])
+        return mask
+
     def filter(self, events: list, tag: str, engine) -> tuple:
-        kept = [ev for ev in events if self.keep_record(ev.body)]
+        if (
+            self._program is not None
+            and len(events) >= self.tpu_batch_records
+            and self.rules
+        ):
+            keep = self.keep_mask(self._match_matrix_device(events))
+            kept = [ev for ev, k in zip(events, keep) if k]
+        else:
+            kept = [ev for ev in events if self.keep_record(ev.body)]
         if len(kept) == len(events):
             return (FilterResult.NOTOUCH, events)
         return (FilterResult.MODIFIED, kept)

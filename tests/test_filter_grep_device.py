@@ -1,0 +1,99 @@
+"""Device-path filter_grep: bit-exact equivalence vs the CPU verdict path.
+
+The north star contract (BASELINE.md): surviving records byte-identical to
+the CPU chain. We run the same event list through GrepFilter with the
+device path forced on and forced off and require identical surviving raw
+bytes, across legacy/AND/OR modes, missing fields, and overflow rows.
+"""
+
+import random
+
+import pytest
+
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.core.plugin import registry
+
+APACHE_HOSTISH = r"^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\]"
+
+
+def make_filter(props):
+    ins = registry.create_filter("grep")
+    for k, v in props:
+        ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def make_events(n, seed=0, long_every=None):
+    rng = random.Random(seed)
+    events = []
+    for i in range(n):
+        method = rng.choice(["GET", "POST", "PUT", "DELETE"])
+        code = rng.choice(["200", "404", "500"])
+        body = {"log": f"{method} /path/{i} HTTP/1.1 {code}", "n": i}
+        if rng.random() < 0.1:
+            body.pop("log")  # missing field rows
+        if long_every and i % long_every == 0:
+            body["log"] = "x" * 2000 + " GET /long 200"
+        buf = encode_event(body, float(i))
+        events.append(decode_events(buf)[0])
+    return events
+
+
+def run_both(props, events):
+    f_dev = make_filter(props)
+    if f_dev._program is None:
+        pytest.skip("device program unavailable for these rules")
+    f_cpu = make_filter(props + [("tpu.enable", "off")])
+    assert f_cpu._program is None
+    _, kept_dev = f_dev.filter(list(events), "t", None)
+    _, kept_cpu = f_cpu.filter(list(events), "t", None)
+    assert [e.raw for e in kept_dev] == [e.raw for e in kept_cpu]
+    return kept_dev
+
+
+@pytest.mark.parametrize("props", [
+    [("regex", "log GET"), ("tpu_batch_records", "1")],
+    [("exclude", "log 500$"), ("tpu_batch_records", "1")],
+    [("regex", "log ^(GET|POST)"), ("exclude", "log 404"),
+     ("tpu_batch_records", "1")],
+    [("exclude", "log 404"), ("regex", "log ^(GET|POST)"),
+     ("tpu_batch_records", "1")],
+    [("regex", "log GET"), ("regex", "log 200"), ("logical_op", "AND"),
+     ("tpu_batch_records", "1")],
+    [("regex", "log GET"), ("regex", "log 500"), ("logical_op", "OR"),
+     ("tpu_batch_records", "1")],
+    [("exclude", "log GET"), ("exclude", "log 500"), ("logical_op", "OR"),
+     ("tpu_batch_records", "1")],
+    [("exclude", "log GET"), ("exclude", "log POST"), ("logical_op", "AND"),
+     ("tpu_batch_records", "1")],
+])
+def test_device_equals_cpu(props):
+    events = make_events(257, seed=hash(str(props)) & 0xFFFF)
+    run_both(props, events)
+
+
+def test_overflow_rows_resolve_on_cpu():
+    events = make_events(200, seed=7, long_every=13)
+    kept = run_both(
+        [("regex", "log GET"), ("tpu_batch_records", "1"),
+         ("tpu_max_record_len", "256")], events)
+    # some long rows match "GET" and must survive via the CPU fallback
+    assert any(len(e.body.get("log", "")) > 256 for e in kept)
+
+
+def test_small_batches_use_cpu_path():
+    f = make_filter([("regex", "log GET"), ("tpu_batch_records", "64")])
+    events = make_events(8)
+    _, kept = f.filter(list(events), "t", None)
+    expected = [e for e in events if f.keep_record(e.body)]
+    assert [e.raw for e in kept] == [e.raw for e in expected]
+
+
+def test_program_built_only_when_capable():
+    # backreference-free rules → program; lookahead rule → CPU only
+    f = make_filter([("regex", "log GET")])
+    assert f._program is not None
+    f2 = make_filter([("regex", r"log (?=G)GET")])
+    assert f2._program is None
