@@ -70,6 +70,7 @@ class Engine:
         self.customs: List = []
         self.metrics = MetricsRegistry()
         self.storage = None  # set by core.storage when storage_path configured
+        self.parsers: Dict[str, Any] = {}  # named parsers (flb_parser registry)
 
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -148,6 +149,14 @@ class Engine:
             ins.set(k, v)
         self.outputs.append(ins)
         return ins
+
+    def parser(self, name: str, **props):
+        """Create + register a named parser (flb_parser_create)."""
+        from ..parsers import create_parser
+
+        p = create_parser(name, **props)
+        self.parsers[p.name] = p
+        return p
 
     # ------------------------------------------------------------------
     # lifecycle

@@ -57,6 +57,11 @@ class FLBContext:
         self._handles.append(ins)
         return len(self._handles) - 1
 
+    def parser(self, name: str, **props):
+        """Create + register a named parser (flb_parser_create /
+        parsers_file [PARSER] section equivalent)."""
+        return self.engine.parser(name, **props)
+
     def set(self, ffd: int, **props) -> None:
         """flb_input_set / flb_output_set / flb_filter_set."""
         ins = self._handles[ffd]

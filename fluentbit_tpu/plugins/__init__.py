@@ -5,4 +5,5 @@ all on)."""
 from . import inputs_basic  # noqa: F401
 from . import outputs_basic  # noqa: F401
 from . import filter_grep  # noqa: F401
+from . import filter_parser  # noqa: F401
 from . import filters_basic  # noqa: F401

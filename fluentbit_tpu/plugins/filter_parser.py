@@ -1,0 +1,167 @@
+"""filter_parser — apply a named parser to a record field.
+
+Reference: plugins/filter_parser/filter_parser.c. For each record, look
+up ``key_name`` (or a record-accessor path when it starts with ``$``,
+:122-126), run the configured parsers in order on its string value
+(:268-303); on first success the parsed map replaces the body,
+``reserve_data`` appends the other original fields (:237),
+``preserve_key`` keeps the parsed source key (:238-240); a parsed
+non-zero time overrides the record timestamp; on failure the record
+passes through untouched. With an RA path, the reference keeps ALL
+original fields under reserve_data (the matched kv is not identified in
+that branch) — mirrored here.
+
+Divergence note: the reference appends reserved originals after the
+parsed fields in the msgpack map, allowing duplicate keys (first wins on
+record-accessor lookups). Python dicts cannot hold duplicates, so on key
+collision the parsed value wins — the same value a reference RA lookup
+would return.
+
+Device path: with a single DFA-expressible regex parser and a large
+append, the match decision runs vectorized on device
+(fluentbit_tpu.ops.grep) and capture extraction runs only for matching
+records (match-then-extract two-pass).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..codec.events import LogEvent
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..core.record_accessor import RecordAccessor
+
+
+def _to_str(v) -> Optional[str]:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return None  # msgpackobj2char: only string/bin values are parseable
+
+
+@registry.register
+class ParserFilter(FilterPlugin):
+    name = "parser"
+    description = "parse a field with a named parser"
+    config_map = [
+        ConfigMapEntry("key_name", "str", desc="field to parse"),
+        ConfigMapEntry("parser", "str", multiple=True,
+                       desc="parser name (may repeat; tried in order)"),
+        ConfigMapEntry("reserve_data", "bool", default=False,
+                       desc="keep the other original fields"),
+        ConfigMapEntry("preserve_key", "bool", default=False,
+                       desc="keep the parsed source key"),
+        ConfigMapEntry("tpu.enable", "bool", default=True,
+                       desc="device match prefilter when the parser allows"),
+        ConfigMapEntry("tpu_batch_records", "int", default=64),
+        ConfigMapEntry("tpu_max_record_len", "int", default=512),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.key_name:
+            raise ValueError("parser filter requires Key_Name")
+        if not self.parser:
+            raise ValueError("parser filter requires at least one Parser")
+        self.parsers = []
+        for pname in self.parser:
+            p = (engine.parsers if engine is not None else {}).get(pname)
+            if p is None:
+                raise ValueError(f"parser filter: unknown parser {pname!r}")
+            self.parsers.append(p)
+        self.ra: Optional[RecordAccessor] = None
+        if self.key_name.startswith("$"):
+            self.ra = RecordAccessor(self.key_name)
+        # device prefilter: single regex parser with a compiled DFA
+        self._prefilter = None
+        if (
+            self.tpu_enable
+            and len(self.parsers) == 1
+            and self.parsers[0].fmt == "regex"
+            and self.parsers[0].regex.dfa is not None
+        ):
+            try:
+                from ..ops.grep import program_for
+
+                self._prefilter = program_for(
+                    (self.parsers[0].regex.pattern,), self.tpu_max_record_len
+                )
+            except Exception:
+                self._prefilter = None
+
+    # -- per-record semantics --
+
+    def _get_value(self, body: dict) -> Optional[str]:
+        if self.ra is not None:
+            return _to_str(self.ra.get(body))
+        v = body.get(self.key_name) if isinstance(body, dict) else None
+        return _to_str(v)
+
+    def _apply(self, ev: LogEvent, value: str) -> Optional[LogEvent]:
+        """Try the parsers in order; build the replacement event."""
+        for p in self.parsers:
+            got = p.do(value)
+            if got is None:
+                continue
+            fields, ts = got
+            body = dict(fields)
+            if self.reserve_data:
+                for k, v in ev.body.items():
+                    if (
+                        self.ra is None
+                        and k == self.key_name
+                        and not self.preserve_key
+                    ):
+                        continue
+                    body.setdefault(k, v)
+            elif self.preserve_key and self.ra is None:
+                body.setdefault(self.key_name, ev.body.get(self.key_name))
+            new_ts = ev.timestamp if (ts is None or ts == 0) else ts
+            return LogEvent(
+                timestamp=new_ts, body=body, metadata=ev.metadata, raw=None
+            )
+        return None
+
+    def _device_match_mask(self, values: List[Optional[str]]):
+        """Vectorized match prefilter; None → row handled on CPU."""
+        import numpy as np
+
+        from ..ops.batch import assemble, bucket_size
+
+        vals = [
+            v.encode("utf-8") if isinstance(v, str) else None for v in values
+        ]
+        staged = assemble(vals, self.tpu_max_record_len, bucket_size(len(vals)))
+        batch = np.stack([staged.batch])
+        lengths = np.stack([staged.lengths])
+        mask = np.array(self._prefilter.match(batch, lengths)[0, : len(vals)])
+        rx = self.parsers[0].regex
+        for i in staged.overflow:
+            mask[i] = rx.match(vals[i])
+        return mask
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        values = [
+            self._get_value(ev.body) if isinstance(ev.body, dict) else None
+            for ev in events
+        ]
+        mask = None
+        if self._prefilter is not None and len(events) >= self.tpu_batch_records:
+            mask = self._device_match_mask(values)
+        out: List[LogEvent] = []
+        modified = False
+        for i, ev in enumerate(events):
+            v = values[i]
+            if v is None or (mask is not None and not mask[i]):
+                out.append(ev)
+                continue
+            new_ev = self._apply(ev, v)
+            if new_ev is None:
+                out.append(ev)
+            else:
+                out.append(new_ev)
+                modified = True
+        if not modified:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, out)

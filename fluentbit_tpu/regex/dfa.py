@@ -189,7 +189,9 @@ class DFA:
         for i in range(L):
             state = trans[state, cls[:, i]]
         state = trans[state, np.full((B,), self.eol_class)]
-        return state == ACC
+        # negative lengths mark invalid rows (missing field -1 / overflow
+        # -2) which must never match — same guard as the device kernel
+        return (state == ACC) & (lengths >= 0)
 
 
 def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,

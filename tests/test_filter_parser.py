@@ -1,0 +1,170 @@
+"""filter_parser runtime semantics vs the reference
+(plugins/filter_parser/filter_parser.c:237-303) + device prefilter
+equivalence + BASELINE config 2 shape (json parse of NDJSON-ish logs).
+"""
+
+import json
+
+import pytest
+
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.core.engine import Engine
+from fluentbit_tpu.core.plugin import FilterResult, registry
+
+APACHE2 = (
+    r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
+    r'"(?<method>\S+)(?: +(?<path>[^ ]*) +\S*)?" (?<code>[^ ]*) '
+    r'(?<size>[^ ]*)(?: "(?<referer>[^\"]*)" "(?<agent>.*)")?$'
+)
+LINE = (
+    '10.0.0.1 - bob [10/Oct/2000:13:55:36 -0700] '
+    '"GET /i.gif HTTP/1.0" 200 99 "r" "a"'
+)
+
+
+def engine_with_parsers():
+    e = Engine()
+    e.parser("apache2", Format="regex", Regex=APACHE2,
+             Time_Key="time", Time_Format="%d/%b/%Y:%H:%M:%S %z")
+    e.parser("js", Format="json")
+    return e
+
+
+def make_filter(engine, **props):
+    ins = registry.create_filter("parser")
+    for k, v in props.items():
+        if isinstance(v, list):
+            for item in v:  # repeated option (Parser appears N times)
+                ins.set(k, item)
+        else:
+            ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, engine)
+    return ins.plugin
+
+
+def ev(body, ts=5.0):
+    return decode_events(encode_event(body, ts))[0]
+
+
+def test_replaces_body_and_time():
+    f = make_filter(engine_with_parsers(), key_name="log", parser="apache2")
+    res, out = f.filter([ev({"log": LINE, "extra": 1})], "t", None)
+    assert res == FilterResult.MODIFIED
+    b = out[0].body
+    assert b["host"] == "10.0.0.1"
+    assert "extra" not in b          # reserve_data off drops other fields
+    assert "log" not in b            # source key dropped
+    assert "time" not in b
+    assert out[0].timestamp == 971211336  # parsed time overrides
+    assert out[0].metadata == {}
+
+
+def test_reserve_data_and_preserve_key():
+    e = engine_with_parsers()
+    f = make_filter(e, key_name="log", parser="apache2",
+                    reserve_data="true", preserve_key="true")
+    res, out = f.filter([ev({"a": 1, "log": LINE, "z": "q"})], "t", None)
+    b = out[0].body
+    assert b["a"] == 1 and b["z"] == "q"
+    assert b["log"] == LINE
+    assert b["host"] == "10.0.0.1"
+
+
+def test_reserve_data_without_preserve_key_drops_source():
+    f = make_filter(engine_with_parsers(), key_name="log", parser="apache2",
+                    reserve_data="on")
+    _, out = f.filter([ev({"a": 1, "log": LINE})], "t", None)
+    assert "log" not in out[0].body
+    assert out[0].body["a"] == 1
+
+
+def test_preserve_key_without_reserve_data():
+    f = make_filter(engine_with_parsers(), key_name="log", parser="apache2",
+                    preserve_key="true")
+    _, out = f.filter([ev({"a": 1, "log": LINE})], "t", None)
+    assert out[0].body["log"] == LINE
+    assert "a" not in out[0].body
+
+
+def test_parse_failure_passes_untouched():
+    f = make_filter(engine_with_parsers(), key_name="log", parser="apache2")
+    events = [ev({"log": "nope"}), ev({"other": 1})]
+    res, out = f.filter(events, "t", None)
+    assert res == FilterResult.NOTOUCH
+    assert out is events
+
+
+def test_parsers_tried_in_order():
+    e = engine_with_parsers()
+    f = make_filter(e, key_name="log", parser=["apache2", "js"])
+    _, out = f.filter([ev({"log": '{"k": 1}'})], "t", None)
+    assert out[0].body == {"k": 1}
+
+
+def test_ra_path_key():
+    e = engine_with_parsers()
+    f = make_filter(e, key_name="$nested['log']", parser="js",
+                    reserve_data="true")
+    _, out = f.filter([ev({"nested": {"log": '{"x": 2}'}, "keep": 3})], "t", None)
+    b = out[0].body
+    assert b["x"] == 2
+    assert b["keep"] == 3
+    # RA branch: reference keeps ALL original fields under reserve_data
+    assert b["nested"] == {"log": '{"x": 2}'}
+
+
+def test_json_time_zero_does_not_override():
+    e = Engine()
+    e.parser("js", Format="json")
+    f = make_filter(e, key_name="log", parser="js")
+    _, out = f.filter([ev({"log": '{"m": 1}'}, ts=42.5)], "t", None)
+    assert out[0].timestamp == 42.5
+
+
+def test_device_prefilter_equivalence():
+    e = engine_with_parsers()
+    f_dev = make_filter(e, key_name="log", parser="apache2",
+                        tpu_batch_records="1", reserve_data="true")
+    f_cpu = make_filter(e, key_name="log", parser="apache2",
+                        **{"tpu.enable": "off"}, reserve_data="true")
+    if f_dev._prefilter is None:
+        pytest.skip("no device program")
+    events = []
+    for i in range(100):
+        if i % 3 == 0:
+            events.append(ev({"log": LINE, "i": i}))
+        elif i % 3 == 1:
+            events.append(ev({"log": f"garbage {i}"}))
+        else:
+            events.append(ev({"n": i}))
+    _, out_dev = f_dev.filter(list(events), "t", None)
+    _, out_cpu = f_cpu.filter(list(events), "t", None)
+    assert len(out_dev) == len(out_cpu)
+    for a, b in zip(out_dev, out_cpu):
+        assert a.body == b.body
+        assert a.timestamp == b.timestamp
+
+
+def test_baseline_config2_end_to_end():
+    """in_lib NDJSON → filter_parser json → out_lib (BASELINE config 2)."""
+    import fluentbit_tpu as flb
+
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.parser("js", Format="json")
+    in_ffd = ctx.input("lib", tag="ndjson")
+    ctx.filter("parser", match="ndjson", key_name="log", parser="js",
+               reserve_data="true")
+    got = []
+    ctx.output("lib", match="ndjson", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"log": '{"emoji": "🎉", "n": 1}'}))
+        ctx.push(in_ffd, json.dumps({"log": "not json"}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    events = [e for d in got for e in decode_events(d)]
+    assert len(events) == 2
+    assert events[0].body == {"emoji": "🎉", "n": 1}
+    assert events[1].body == {"log": "not json"}

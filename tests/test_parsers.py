@@ -1,0 +1,175 @@
+"""Parsers subsystem: regex/json/logfmt/ltsv + strptime time handling.
+
+Differential targets: the reference's conf/parsers.conf apache2 + json
+parsers and flb_parser_do semantics (src/flb_parser.c:1784-1800,
+src/flb_parser_regex.c cb_results, src/flb_strptime.c).
+"""
+
+import calendar
+
+import pytest
+
+from fluentbit_tpu.parsers import Parser, ParserError, create_parser
+from fluentbit_tpu.parsers.strptime import (
+    Tm,
+    flb_strptime,
+    parse_tzone_offset,
+    time_lookup,
+)
+
+APACHE2 = (
+    r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
+    r'"(?<method>\S+)(?: +(?<path>[^ ]*) +\S*)?" (?<code>[^ ]*) '
+    r'(?<size>[^ ]*)(?: "(?<referer>[^\"]*)" "(?<agent>.*)")?$'
+)
+APACHE_LINE = (
+    '192.168.1.10 - frank [10/Oct/2000:13:55:36 -0700] '
+    '"GET /apache_pb.gif HTTP/1.0" 200 2326 "http://ref" "Mozilla/4.08"'
+)
+
+
+# ---------------------------------------------------------------- strptime
+
+def test_strptime_basic():
+    tm = Tm()
+    used = flb_strptime("10/Oct/2000:13:55:36 -0700", "%d/%b/%Y:%H:%M:%S %z", tm)
+    assert used is not None
+    assert (tm.year, tm.mon, tm.mday, tm.hour, tm.min, tm.sec) == (2000, 10, 10, 13, 55, 36)
+    assert tm.gmtoff == -7 * 3600
+    # epoch: 2000-10-10T13:55:36-07:00 == 20:55:36 UTC
+    assert tm.to_epoch() == calendar.timegm((2000, 10, 10, 20, 55, 36, 0, 1, 0))
+
+
+def test_strptime_mismatch_returns_none():
+    assert flb_strptime("nonsense", "%d/%b/%Y", Tm()) is None
+    assert flb_strptime("32/Jan/2000", "%d/%b/%Y", Tm()) is None
+
+
+def test_strptime_ampm_and_epoch():
+    tm = Tm()
+    assert flb_strptime("01:30 PM", "%I:%M %p", tm) is not None
+    assert tm.to_epoch() % 86400 == 13 * 3600 + 30 * 60
+    tm2 = Tm()
+    assert flb_strptime("1700000000", "%s", tm2) is not None
+    assert tm2.to_epoch() == 1700000000.0
+
+
+def test_time_lookup_fractional():
+    # %L fractional seconds, ISO-ish
+    ts = time_lookup("2023-01-02T03:04:05.250Z", "%Y-%m-%dT%H:%M:%S.%L%z")
+    assert ts == calendar.timegm((2023, 1, 2, 3, 4, 5, 0, 1, 0)) + 0.25
+
+
+def test_time_lookup_no_year_uses_current():
+    import time as _t
+
+    now = _t.time()
+    ts = time_lookup("Oct 10 13:55:36", "%b %d %H:%M:%S", now=now)
+    assert ts is not None
+    year = _t.gmtime(now).tm_year
+    assert ts == calendar.timegm((year, 10, 10, 13, 55, 36, 0, 1, 0))
+
+
+def test_time_lookup_offset_applies_without_tz():
+    base = calendar.timegm((2023, 1, 1, 12, 0, 0, 0, 1, 0))
+    ts_utc = time_lookup("2023-01-01 12:00:00", "%Y-%m-%d %H:%M:%S")
+    ts_off = time_lookup("2023-01-01 12:00:00", "%Y-%m-%d %H:%M:%S",
+                         time_offset=2 * 3600)
+    assert ts_utc == base
+    assert ts_off == base - 2 * 3600
+
+
+def test_tzone_offset():
+    assert parse_tzone_offset("Z") == 0
+    assert parse_tzone_offset("+0200") == 7200
+    assert parse_tzone_offset("-05:30") == -(5 * 3600 + 30 * 60)
+    assert parse_tzone_offset("nope") is None
+
+
+# ---------------------------------------------------------------- parsers
+
+def apache2_parser():
+    return create_parser(
+        "apache2", Format="regex", Regex=APACHE2,
+        Time_Key="time", Time_Format="%d/%b/%Y:%H:%M:%S %z",
+    )
+
+
+def test_regex_parser_apache2():
+    p = apache2_parser()
+    got = p.do(APACHE_LINE)
+    assert got is not None
+    fields, ts = got
+    assert fields["host"] == "192.168.1.10"
+    assert fields["user"] == "frank"
+    assert fields["method"] == "GET"
+    assert fields["path"] == "/apache_pb.gif"
+    assert fields["code"] == "200"
+    assert fields["size"] == "2326"
+    assert fields["referer"] == "http://ref"
+    assert fields["agent"] == "Mozilla/4.08"
+    # time popped (time_keep default false) and parsed with offset
+    assert "time" not in fields
+    assert ts == calendar.timegm((2000, 10, 10, 20, 55, 36, 0, 1, 0))
+
+
+def test_regex_parser_no_match():
+    assert apache2_parser().do("not an apache line") is None
+
+
+def test_regex_parser_time_keep_and_bad_time():
+    p = create_parser("x", Format="regex",
+                      Regex=r"^(?<time>\S+) (?<msg>.*)$",
+                      Time_Format="%Y-%m-%d", Time_Keep="true")
+    fields, ts = p.do("2020-01-02 hello")
+    assert fields == {"time": "2020-01-02", "msg": "hello"}
+    assert ts == calendar.timegm((2020, 1, 2, 0, 0, 0, 0, 1, 0))
+    # bad time: field dropped, record still parses, no time override
+    fields2, ts2 = p.do("junktime hello")
+    assert fields2 == {"msg": "hello"}
+    assert ts2 is None
+
+
+def test_regex_parser_types_and_skip_empty():
+    p = create_parser("t", Format="regex",
+                      Regex=r"^(?<code>\d+) (?<size>\S*) (?<msg>.*)$",
+                      Types="code:integer size:integer")
+    fields, _ = p.do("404 - hi")
+    assert fields["code"] == 404
+    assert fields["size"] == "-"  # non-numeric stays string
+    fields2, _ = p.do("200 123 hi")
+    assert fields2["size"] == 123
+
+
+def test_json_parser():
+    p = create_parser("j", Format="json", Time_Key="ts",
+                      Time_Format="%Y-%m-%dT%H:%M:%S%z")
+    fields, ts = p.do('{"ts": "2021-06-01T00:00:00Z", "k": 1, "b": true}')
+    assert fields == {"k": 1, "b": True}
+    assert ts == calendar.timegm((2021, 6, 1, 0, 0, 0, 0, 1, 0))
+    assert p.do("[1,2,3]") is None
+    assert p.do("not json") is None
+
+
+def test_logfmt_parser():
+    p = create_parser("lf", Format="logfmt", Types="n:integer")
+    fields, _ = p.do('level=info msg="hello world" n=5 flag')
+    assert fields == {"level": "info", "msg": "hello world", "n": 5, "flag": ""}
+    assert p.do("") is None
+
+
+def test_logfmt_quoted_escapes():
+    p = create_parser("lf", Format="logfmt")
+    fields, _ = p.do(r'msg="a\"b\nc"')
+    assert fields["msg"] == 'a"b\nc'
+
+
+def test_ltsv_parser():
+    p = create_parser("lt", Format="ltsv", Types="status:integer")
+    fields, _ = p.do("host:1.2.3.4\tstatus:200\tmsg:ok")
+    assert fields == {"host": "1.2.3.4", "status": 200, "msg": "ok"}
+
+
+def test_unknown_format_raises():
+    with pytest.raises(ParserError):
+        create_parser("x", Format="xml")
