@@ -98,6 +98,8 @@ class Engine:
                                       "Records added by filter", ("name",))
         self.m_filter_drop = m.counter("fluentbit", "filter", "drop_records_total",
                                        "Records dropped by filter", ("name",))
+        self.m_filter_emit = m.counter("fluentbit", "filter", "emit_records_total",
+                                       "Records re-emitted by filter", ("name",))
         self.m_out_proc_records = m.counter("fluentbit", "output", "proc_records_total",
                                             "Records delivered", ("name",))
         self.m_out_proc_bytes = m.counter("fluentbit", "output", "proc_bytes_total",
@@ -158,6 +160,22 @@ class Engine:
         self.parsers[p.name] = p
         return p
 
+    def hidden_input(self, name: str, **props) -> InputInstance:
+        """Create + immediately initialize an internal input instance —
+        the hidden ``emitter`` pattern used by rewrite_tag /
+        log_to_metrics / chunk traces (reference
+        plugins/filter_rewrite_tag/rewrite_tag.c:245-260). Safe to call
+        from a plugin's init while the engine is starting."""
+        ins = self.registry.create_input(name)
+        self._number_instance(ins, self.inputs)
+        for k, v in props.items():
+            ins.set(k, v)
+        self.inputs.append(ins)
+        ins.configure()
+        ins.plugin.init(ins, self)
+        ins._initialized = True
+        return ins
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -167,6 +185,8 @@ class Engine:
         if self._thread is not None:
             raise RuntimeError("engine already started")
         for ins in self.inputs + self.filters + self.outputs:
+            if getattr(ins, "_initialized", False):
+                continue  # hidden inputs are initialized at creation
             ins.configure()
             ins.plugin.init(ins, self)
         self.started_at = time.time()

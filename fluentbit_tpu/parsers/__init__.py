@@ -193,6 +193,8 @@ class Parser:
             if v == "" and self.skip_empty_values:
                 continue
             fields[k] = v
+        if not fields:
+            return None  # zero extracted fields = parse failure
         return self._apply_types(fields)
 
     def _do_json(self, text: str) -> Optional[Dict[str, Any]]:

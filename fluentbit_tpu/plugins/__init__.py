@@ -3,7 +3,9 @@ built-in plugins (the cmake/plugins_options.cmake equivalent is: they are
 all on)."""
 
 from . import inputs_basic  # noqa: F401
+from . import in_emitter  # noqa: F401
 from . import outputs_basic  # noqa: F401
 from . import filter_grep  # noqa: F401
 from . import filter_parser  # noqa: F401
+from . import filter_rewrite_tag  # noqa: F401
 from . import filters_basic  # noqa: F401

@@ -32,14 +32,11 @@ LEGACY, AND, OR = "legacy", "AND", "OR"
 
 
 def _to_text(v) -> Optional[str]:
+    """Only string values are regex-matchable — the reference's
+    flb_ra_key_regex_match returns no-match for every non-STR msgpack
+    type (src/flb_ra_key.c:418)."""
     if isinstance(v, str):
         return v
-    if isinstance(v, bool):
-        return "true" if v else "false"
-    if isinstance(v, (int, float)):
-        return str(v)
-    if isinstance(v, bytes):
-        return v.decode("utf-8", "replace")
     return None
 
 

@@ -1,0 +1,197 @@
+"""filter_rewrite_tag — re-tag records by regex rule and re-emit.
+
+Reference: plugins/filter_rewrite_tag/rewrite_tag.c. Rules are
+``Rule <$key> <regex> <new_tag_template> <keep>``; the FIRST matching
+rule wins (process_record, :356-385); the new tag is composed by the
+record-accessor template with access to regex captures ($0..$9), $TAG,
+$TAG[n] and record fields (:393); the record is re-emitted under the new
+tag through a per-instance hidden ``emitter`` input (:407, created with
+alias ``emitter_for_<name>``, :245-260) and re-enters the full pipeline;
+the original is kept or dropped per the rule's keep flag (:375).
+
+Device path: when every rule regex compiles to a DFA and the append is
+large, the per-rule match matrix runs vectorized on device
+(fluentbit_tpu.ops.grep); capture extraction + tag composition run on
+the CPU only for the first matching rule of each matched record.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..codec.events import reencode_event
+from ..core.config import ConfigMapEntry, parse_bool
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..core.record_accessor import RecordAccessor, Template
+from ..regex import FlbRegex
+
+
+def _to_text(v) -> Optional[str]:
+    """String values only — flb_ra_key_regex_match returns no-match for
+    non-STR msgpack types (src/flb_ra_key.c:418)."""
+    if isinstance(v, str):
+        return v
+    return None
+
+
+class RewriteRule:
+    __slots__ = ("ra", "regex", "template", "keep")
+
+    def __init__(self, key: str, pattern: str, new_tag: str, keep):
+        self.ra = RecordAccessor(key)
+        self.regex = FlbRegex(pattern)
+        self.template = Template(new_tag)
+        self.keep = parse_bool(keep)
+
+
+@registry.register
+class RewriteTagFilter(FilterPlugin):
+    name = "rewrite_tag"
+    description = "re-tag records by regex and re-emit through the pipeline"
+    config_map = [
+        ConfigMapEntry("rule", "slist", multiple=True, slist_max_split=3,
+                       desc="<$key> <regex> <new_tag> <keep>"),
+        ConfigMapEntry("emitter_name", "str"),
+        ConfigMapEntry("emitter_storage.type", "str", default="memory"),
+        ConfigMapEntry("emitter_mem_buf_limit", "str", default="10M"),
+        ConfigMapEntry("tpu.enable", "bool", default=True),
+        ConfigMapEntry("tpu_batch_records", "int", default=64),
+        ConfigMapEntry("tpu_max_record_len", "int", default=512),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.rule:
+            raise ValueError("rewrite_tag requires at least one Rule")
+        self.rules: List[RewriteRule] = []
+        for parts in self.rule:
+            if len(parts) != 4:
+                raise ValueError(f"rewrite_tag: invalid rule {parts!r}")
+            self.rules.append(RewriteRule(*parts))
+        self._engine = engine
+        self.emitter = None
+        if engine is not None:
+            name = self.emitter_name or f"emitter_for_{instance.display_name}"
+            ins = engine.hidden_input(
+                "emitter",
+                alias=name,
+                mem_buf_limit=self.emitter_mem_buf_limit,
+                **{"storage.type": self.emitter_storage_type},
+            )
+            self.emitter = ins.plugin
+        self._program = None
+        if (
+            self.tpu_enable
+            and all(r.regex.dfa is not None for r in self.rules)
+        ):
+            try:
+                from ..ops.grep import program_for
+
+                self._program = program_for(
+                    tuple(r.regex.pattern for r in self.rules),
+                    self.tpu_max_record_len,
+                )
+            except Exception:
+                self._program = None
+
+    # -- matching --
+
+    def _values_matrix(self, events: list) -> List[List[Optional[str]]]:
+        vals: List[List[Optional[str]]] = []
+        for rule in self.rules:
+            ra = rule.ra
+            vals.append([
+                _to_text(ra.get(ev.body)) if isinstance(ev.body, dict) else None
+                for ev in events
+            ])
+        return vals
+
+    def _device_match_matrix(self, values) -> np.ndarray:
+        """mask[R, B]: rule r's regex matches record b's field value."""
+        from ..ops.batch import assemble, bucket_size
+
+        R = len(self.rules)
+        B = len(values[0])
+        Bp = bucket_size(B)
+        staged = [
+            assemble(
+                [v.encode("utf-8") if v is not None else None
+                 for v in values[r]],
+                self.tpu_max_record_len, Bp,
+            )
+            for r in range(R)
+        ]
+        mask = self._program.match(
+            np.stack([s.batch for s in staged]),
+            np.stack([s.lengths for s in staged]),
+        )
+        mask = np.array(mask[:, :B])
+        for r, s in enumerate(staged):
+            rx = self.rules[r].regex
+            for i in s.overflow:
+                mask[r, i] = rx.match(values[r][i])
+        return mask
+
+    def _first_match_cpu(self, body):
+        """Per-record rule scan, break on first match (process_record)."""
+        if not isinstance(body, dict):
+            return None, None
+        for rule in self.rules:
+            v = _to_text(rule.ra.get(body))
+            if v is None:
+                continue
+            caps = rule.regex.search_captures(v)
+            if caps is not None:
+                return rule, caps
+        return None, None
+
+    def _emit(self, ev, rule, captures, tag: str, engine) -> bool:
+        """Render the tag + re-emit; False when the record could not be
+        re-emitted (failed translation / backpressure) — the caller then
+        keeps the original, mirroring the reference's no-match return on
+        translation failure."""
+        new_tag = rule.template.render(record=ev.body, tag=tag,
+                                       captures=captures)
+        if not new_tag or self.emitter is None:
+            return False
+        data = ev.raw if ev.raw is not None else reencode_event(ev)
+        if self.emitter.add_record(new_tag, data, 1) < 0:
+            return False
+        if engine is not None:
+            engine.m_filter_emit.inc(1, (self.instance.display_name,))
+        return True
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        use_device = (
+            self._program is not None
+            and len(events) >= self.tpu_batch_records
+        )
+        if use_device:
+            values = self._values_matrix(events)
+            mask = self._device_match_matrix(values)
+        kept = []
+        modified = False
+        for b, ev in enumerate(events):
+            if use_device:
+                rule = captures = None
+                for r in range(len(self.rules)):
+                    if mask[r, b]:
+                        captures = self.rules[r].regex.search_captures(
+                            values[r][b]
+                        )
+                        if captures is not None:
+                            rule = self.rules[r]
+                            break
+            else:
+                rule, captures = self._first_match_cpu(ev.body)
+            if rule is None or not self._emit(ev, rule, captures, tag, engine):
+                kept.append(ev)
+                continue
+            if rule.keep:
+                kept.append(ev)
+            else:
+                modified = True
+        if not modified:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, kept)

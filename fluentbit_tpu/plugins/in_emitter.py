@@ -1,0 +1,42 @@
+"""in_emitter — internal record re-ingestion input.
+
+Reference: plugins/in_emitter/emitter.c. A passive input with no
+collector: other plugins (filter_rewrite_tag, filter_log_to_metrics,
+chunk traces) push records into it via ``add_record``, and the records
+re-enter the FULL pipeline (routing + filters) under their new tag via
+the engine's normal ingest path. Each consumer creates its own hidden
+instance (``emitter_for_<name>`` alias, rewrite_tag.c:245-260).
+"""
+
+from __future__ import annotations
+
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+
+@registry.register
+class EmitterInput(InputPlugin):
+    name = "emitter"
+    description = "internal re-ingestion channel"
+    config_map = [
+        ConfigMapEntry("ring_buffer_size", "int", default=0,
+                       desc="accepted for parity; ingest is direct"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._engine = engine
+
+    def add_record(self, tag: str, data: bytes, n_records: int = 1) -> int:
+        """in_emitter_add_record: append encoded log events under ``tag``.
+        Returns records written or -1 on backpressure."""
+        return self._engine.input_log_append(
+            self.instance, tag, data, n_records
+        )
+
+    def add_event(self, tag: str, data: bytes, event_type: str,
+                  n_records: int = 1) -> int:
+        """Typed (metrics/traces) re-ingestion — log_to_metrics' emitter
+        path (flb_input_metrics_append)."""
+        return self._engine.input_event_append(
+            self.instance, tag, data, event_type, n_records
+        )

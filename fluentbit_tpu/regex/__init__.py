@@ -184,6 +184,26 @@ class FlbRegex:
             return self.dfa.match_bytes(data)
         return self._py().search(data.decode("utf-8", "surrogateescape")) is not None
 
+    def search_captures(self, text):
+        """Search returning the capture tuple ``($0, $1, ...)`` — group 0
+        is the whole match (flb_ra_regex_match's flb_regex_search result,
+        consumed by rewrite_tag tag templates). None when no match.
+
+        Ruby capture numbering: when a pattern contains named groups,
+        unnamed groups do not capture — $1.. are the named groups in
+        order of appearance (ONIG_SYNTAX_RUBY behavior).
+        """
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", "surrogateescape")
+        py = self._py()
+        m = py.search(text)
+        if m is None:
+            return None
+        if py.groupindex:
+            ordered = sorted(py.groupindex.items(), key=lambda kv: kv[1])
+            return (m.group(0),) + tuple(m.group(i) for _, i in ordered)
+        return (m.group(0),) + m.groups()
+
     def parse_record(self, text) -> Optional[Dict[str, str]]:
         """Named-capture extraction (flb_regex_parse with callback per
         named group). Returns None when the pattern does not match."""

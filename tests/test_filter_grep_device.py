@@ -97,3 +97,13 @@ def test_program_built_only_when_capable():
     assert f._program is not None
     f2 = make_filter([("regex", r"log (?=G)GET")])
     assert f2._program is None
+
+
+def test_non_string_values_never_match():
+    """String-only matching (src/flb_ra_key.c:418): ints don't match."""
+    f = make_filter([("regex", r"n \d+")])
+    events = make_events(4, seed=3)
+    for ev in events:
+        ev.body["n"] = 123  # int field
+    _, kept = f.filter(list(events), "t", None)
+    assert kept == []  # Regex-miss ⇒ EXCLUDE in legacy mode

@@ -173,3 +173,8 @@ def test_ltsv_parser():
 def test_unknown_format_raises():
     with pytest.raises(ParserError):
         create_parser("x", Format="xml")
+
+
+def test_regex_zero_fields_is_failure():
+    p = create_parser("z", Format="regex", Regex=r"^(?<a>\d*)")
+    assert p.do("abc") is None  # group captured empty → skipped → no fields

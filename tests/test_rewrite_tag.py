@@ -1,0 +1,179 @@
+"""filter_rewrite_tag + in_emitter: first-match-wins, tag templates,
+keep/drop, full pipeline re-entry (reference
+plugins/filter_rewrite_tag/rewrite_tag.c:356-407), and the BASELINE
+config 3 shape (8 regex rules → out_null).
+"""
+
+import json
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+
+
+def run_pipeline(rules, records, extra=None, tag="orig", props=None):
+    """in_lib → rewrite_tag(rules) → collect everything per tag."""
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag=tag)
+    kw = {"match": tag}
+    if props:
+        kw.update(props)
+    f = ctx.filter("rewrite_tag", **kw)
+    for r in rules:
+        ctx.set(f, rule=r)
+    if extra:
+        extra(ctx)
+    got = {}
+    ctx.output(
+        "lib", match="*",
+        callback=lambda d, t: got.setdefault(t, []).extend(decode_events(d)),
+    )
+    ctx.start()
+    try:
+        for rec in records:
+            ctx.push(in_ffd, json.dumps(rec))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    return got
+
+
+def test_first_match_wins_and_drop():
+    got = run_pipeline(
+        ["$level error new.$level false", "$level .* catchall true"],
+        [{"level": "error", "m": 1}, {"level": "warn", "m": 2}],
+    )
+    # error → rule 1 only (first match wins), dropped from orig;
+    # warn → rule 2, kept in orig
+    assert [e.body["m"] for e in got.get("new.error", [])] == [1]
+    assert [e.body["m"] for e in got.get("catchall", [])] == [2]
+    assert [e.body["m"] for e in got.get("orig", [])] == [2]
+
+
+def test_tag_template_captures_tag_parts_fields():
+    # unnamed-group capture numbering: $1/$2
+    got = run_pipeline(
+        [r"$log ([a-z]+)-(\d+) $TAG[1].$1.$2.$kind true"],
+        [{"log": "api-42 hello", "kind": "k"}],
+        tag="a.b",
+    )
+    assert "b.api.42.k" in got, sorted(got)
+
+
+def test_tag_template_ruby_named_capture_numbering():
+    # Ruby semantics: with named groups present, unnamed groups do not
+    # capture — $1 is the first NAMED group
+    got = run_pipeline(
+        [r"$log (?<svc>[a-z]+)-(\d+) new.$1 true"],
+        [{"log": "api-42 hello"}],
+    )
+    assert "new.api" in got, sorted(got)
+
+
+def test_no_match_notouch():
+    got = run_pipeline(
+        ["$log ^ERROR et false"],
+        [{"log": "fine"}, {"log": "also fine"}],
+    )
+    assert len(got.get("orig", [])) == 2
+    assert "et" not in got
+
+
+def test_reemitted_records_pass_through_filters():
+    """Re-emitted records re-enter the FULL chain: a grep filter matching
+    the new tag must filter them."""
+    def add_grep(ctx):
+        ctx.filter("grep", match="rt.*", regex="log keepme")
+
+    got = run_pipeline(
+        ["$log .* rt.stream false"],
+        [{"log": "keepme 1"}, {"log": "dropme 2"}],
+        extra=add_grep,
+    )
+    logs = [e.body["log"] for e in got.get("rt.stream", [])]
+    assert logs == ["keepme 1"]
+    assert got.get("orig") is None
+
+
+def test_emitted_bytes_identical():
+    got = run_pipeline(
+        ["$log .* moved false"],
+        [{"log": "x", "n": 7}],
+    )
+    evs = got["moved"]
+    assert len(evs) == 1
+    assert evs[0].body == {"log": "x", "n": 7}
+
+
+def test_device_path_equivalence_config3():
+    """BASELINE config 3 shape: 8 regex rules, syslog-ish corpus; device
+    and CPU paths must produce identical routing."""
+    rules = [
+        r"$log sshd sec.ssh false",
+        r"$log kernel: sys.kernel false",
+        r"$log systemd\[1\] sys.init false",
+        r"$log ERROR app.error false",
+        r"$log WARN app.warn false",
+        r"$log nginx web.nginx false",
+        r"$log cron\[\d+\] sys.cron false",
+        r"$log .*OOM.* sys.oom false",
+    ]
+    corpus = []
+    for i in range(150):
+        which = i % 10
+        line = {
+            0: f"sshd[{i}]: Accepted publickey",
+            1: "kernel: eth0 up",
+            2: "systemd[1]: Started unit",
+            3: "app ERROR boom",
+            4: "app WARN slow",
+            5: "nginx 200 GET /",
+            6: f"cron[{i}]: job ran",
+            7: "invoked OOM killer",
+        }.get(which, f"plain message {i}")
+        corpus.append({"log": line, "i": i})
+
+    got_dev = run_pipeline(rules, corpus, props={"tpu_batch_records": "1"})
+    got_cpu = run_pipeline(rules, corpus, props={"tpu.enable": "off"})
+    assert set(got_dev) == set(got_cpu)
+    for t in got_cpu:
+        assert [e.body for e in got_dev[t]] == [e.body for e in got_cpu[t]], t
+    assert "sec.ssh" in got_cpu and "sys.oom" in got_cpu
+
+
+def test_emit_metric_counted():
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    f = ctx.filter("rewrite_tag", match="t", rule="$log .* moved true")
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"log": "a"}))
+        ctx.push(in_ffd, json.dumps({"log": "b"}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    fam = ctx.metrics.to_prometheus()
+    assert 'fluentbit_filter_emit_records_total{name="rewrite_tag.0"} 2' in fam
+
+
+def test_non_string_field_never_matches():
+    """flb_ra_key_regex_match: non-STR values are no-match
+    (src/flb_ra_key.c:418)."""
+    got = run_pipeline(
+        ["$n \\d+ moved false"],
+        [{"n": 42, "m": 1}, {"n": "42", "m": 2}],
+    )
+    assert [e.body["m"] for e in got.get("moved", [])] == [2]
+    assert [e.body["m"] for e in got.get("orig", [])] == [1]
+
+
+def test_failed_tag_translation_keeps_record():
+    """Rendered-empty tag = failed translation → record kept even with
+    keep=false (reference treats translation failure as no-match)."""
+    got = run_pipeline(
+        ["$log .* $missing false"],
+        [{"log": "hello"}],
+    )
+    assert len(got.get("orig", [])) == 1
