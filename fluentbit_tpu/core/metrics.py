@@ -157,29 +157,7 @@ class MetricsRegistry:
 
     def to_prometheus(self) -> str:
         """Prometheus text format (api/v1/metrics/prometheus equivalent)."""
-        out: List[str] = []
-        for m in self.metrics():
-            fq = m.fqname
-            if m.desc:
-                out.append(f"# HELP {fq} {m.desc}")
-            out.append(f"# TYPE {fq} {m.kind}")
-            if isinstance(m, Histogram):
-                for labels, (counts, total) in m.hist_samples().items():
-                    base = _fmt_labels(m.label_keys, labels)
-                    cum = 0
-                    for b, c in zip(m.buckets, counts):
-                        cum += c
-                        le = _fmt_labels(m.label_keys + ("le",), labels + (_fmt_float(b),))
-                        out.append(f"{fq}_bucket{le} {cum}")
-                    cum += counts[-1]
-                    le = _fmt_labels(m.label_keys + ("le",), labels + ("+Inf",))
-                    out.append(f"{fq}_bucket{le} {cum}")
-                    out.append(f"{fq}_sum{base} {_fmt_float(total)}")
-                    out.append(f"{fq}_count{base} {cum}")
-            else:
-                for labels, value in m.samples():
-                    out.append(f"{fq}{_fmt_labels(m.label_keys, labels)} {_fmt_float(value)}")
-        return "\n".join(out) + "\n"
+        return payload_to_prometheus(self.to_msgpack_obj())
 
     def to_msgpack_obj(self) -> dict:
         """Encode as a plain structure for the metrics pipeline."""
@@ -204,6 +182,47 @@ class MetricsRegistry:
                 ]
             metrics.append(entry)
         return {"meta": {"ts": ts}, "metrics": metrics}
+
+
+def payload_to_prometheus(obj: dict) -> str:
+    """Render a metrics-as-data payload (MetricsRegistry.to_msgpack_obj
+    shape) as Prometheus text — the out_prometheus_exporter / stdout
+    rendering of METRICS-type chunks."""
+    out: List[str] = []
+    for m in obj.get("metrics", []):
+        fq = m.get("name", "")
+        if m.get("desc"):
+            out.append(f"# HELP {fq} {m['desc']}")
+        out.append(f"# TYPE {fq} {m.get('type', 'untyped')}")
+        keys = tuple(m.get("labels", []))
+        if m.get("type") == "histogram":
+            buckets = m.get("buckets", [])
+            for h in m.get("hist", []):
+                labels = tuple(h.get("labels", []))
+                base = _fmt_labels(keys, labels)
+                cum = 0
+                counts = h.get("counts", [])
+                for b, c in zip(buckets, counts):
+                    cum += c
+                    le = _fmt_labels(keys + ("le",), labels + (_fmt_float(b),))
+                    out.append(f"{fq}_bucket{le} {cum}")
+                if len(counts) > len(buckets):
+                    cum += counts[-1]
+                le = _fmt_labels(keys + ("le",), labels + ("+Inf",))
+                out.append(f"{fq}_bucket{le} {cum}")
+                out.append(f"{fq}_sum{base} {_fmt_float(h.get('sum', 0.0))}")
+                out.append(f"{fq}_count{base} {cum}")
+        else:
+            for s in m.get("values", []):
+                out.append(
+                    f"{fq}{_fmt_labels(keys, tuple(s.get('labels', [])))} "
+                    f"{_fmt_float(s.get('value', 0.0))}"
+                )
+    return "\n".join(out) + "\n"
+
+
+def is_metrics_payload(obj) -> bool:
+    return isinstance(obj, dict) and "metrics" in obj and "meta" in obj
 
 
 def _fmt_labels(keys: Sequence[str], values: Sequence[str]) -> str:

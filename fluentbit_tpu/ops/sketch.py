@@ -1,0 +1,287 @@
+"""Device sketches — HyperLogLog + count-min, the psum/pmax showcase.
+
+The north-star additions over the reference's filter_log_to_metrics
+(BASELINE.md config 4: "count-min/HLL cardinality" — the reference
+supports only counter/gauge/histogram). Batches of field values are
+hashed ON DEVICE (FNV-1a over the padded ``[B, L] uint8`` staging
+layout, masked by lengths — one fused jit with the register updates),
+and sketch state lives as device arrays:
+
+- HLL: 2^p registers of max-rank; multi-device merge is ``lax.pmax``
+  over the mesh axis (register-wise max IS the union of sketches).
+- Count-min: ``[d, w]`` counters via Kirsch-Mitzenmacher double
+  hashing; multi-device merge is ``lax.psum`` (counter sum IS the
+  union).
+
+Both merges ride ICI on a real mesh — sketches are the rare aggregate
+whose distributed reduction is exact, which is why they are the chosen
+showcase for the metrics-reduction contract (SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    HAVE_JAX = True
+except Exception:  # pragma: no cover
+    HAVE_JAX = False
+
+FNV_OFFSET = np.uint32(0x811C9DC5)
+FNV_PRIME = np.uint32(0x01000193)
+
+
+def _fnv1a_scan(batch, lengths):
+    """FNV-1a 32-bit over valid bytes of each row: [B, L] u8 → [B] u32.
+
+    Pad positions multiply by 1 (identity) so fixed shapes stay exact.
+    """
+    B, L = batch.shape
+    pos = jnp.arange(L, dtype=jnp.int32)
+    valid = pos[None, :] < lengths[:, None]  # [B, L]
+    data = batch.astype(jnp.uint32)
+
+    def step(h, xs):
+        byte, ok = xs
+        nh = (h ^ byte) * FNV_PRIME
+        return jnp.where(ok, nh, h), None
+
+    # ^ 0*lengths: ties the carry to the (possibly mesh-sharded) batch so
+    # its varying-axes annotation matches the scan output under shard_map
+    h0 = jnp.full((B,), FNV_OFFSET, dtype=jnp.uint32) ^ (
+        lengths.astype(jnp.uint32) * 0
+    )
+    h, _ = lax.scan(step, h0, (data.T, valid.T))
+    # FNV's high bits avalanche poorly; finalize so index bits (taken
+    # from the top for HLL) are uniform
+    return _mix(h)
+
+
+def _mix(h):
+    """murmur3 fmix32 — independent second hash for double hashing."""
+    h = h ^ (h >> 16)
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+class HyperLogLog:
+    """HLL over 32-bit hashes; registers jnp int32 [2^p]."""
+
+    def __init__(self, p: int = 14):
+        if not HAVE_JAX:
+            raise RuntimeError("jax is unavailable")
+        self.p = p
+        self.m = 1 << p
+        self.registers = jnp.zeros((self.m,), dtype=jnp.int32)
+        self._update = jax.jit(self._update_impl)
+
+    def _update_impl(self, registers, batch, lengths):
+        h = _fnv1a_scan(batch, lengths)
+        idx = (h >> np.uint32(32 - self.p)).astype(jnp.int32)
+        rest = h << np.uint32(self.p)
+        # clz via bit-smear + popcount (integer-exact, TPU-friendly)
+        x = rest
+        for s in (1, 2, 4, 8, 16):
+            x = x | (x >> np.uint32(s))
+        nlz = 32 - lax.population_count(x).astype(jnp.int32)
+        # rank = leading zeros of the remaining (32-p) bits + 1; rest==0
+        # (nlz 32) saturates at the max rank for a (32-p)-bit suffix
+        rank = jnp.minimum(nlz + 1, 32 - self.p + 1)
+        valid = lengths >= 0
+        rank = jnp.where(valid, rank, 0)
+        return registers.at[idx].max(rank)
+
+    def update(self, batch: np.ndarray, lengths: np.ndarray) -> None:
+        """Absorb a staged [B, L] batch (rows with length<0 ignored)."""
+        self.registers = self._update(
+            self.registers, jnp.asarray(batch), jnp.asarray(lengths)
+        )
+
+    def add_cpu(self, value: bytes) -> None:
+        """Host-side single-value update (overflow-row fallback) — same
+        hash/rank math as the device kernel."""
+        h = int(_hash32_cpu(value))
+        idx = h >> (32 - self.p)
+        rest = (h << self.p) & 0xFFFFFFFF
+        nlz = 32 - rest.bit_length()
+        rank = min(nlz + 1, 32 - self.p + 1)
+        self.registers = self.registers.at[idx].max(rank)
+
+    def merge_registers(self, other: "jnp.ndarray") -> None:
+        self.registers = jnp.maximum(self.registers, other)
+
+    def estimate(self) -> float:
+        """Standard HLL estimator with small/large range corrections."""
+        regs = np.asarray(self.registers)
+        m = float(self.m)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        e = alpha * m * m / np.sum(np.exp2(-regs.astype(np.float64)))
+        if e <= 2.5 * m:
+            v = int(np.sum(regs == 0))
+            if v > 0:
+                e = m * np.log(m / v)
+        elif e > (1 << 32) / 30.0:
+            e = -(2.0 ** 32) * np.log(1.0 - e / 2.0 ** 32)
+        return float(e)
+
+
+class CountMin:
+    """Count-min sketch [d, w]; conservative point queries via row-min."""
+
+    def __init__(self, depth: int = 4, width: int = 16384):
+        if not HAVE_JAX:
+            raise RuntimeError("jax is unavailable")
+        self.depth = depth
+        self.width = width
+        self.table = jnp.zeros((depth, width), dtype=jnp.int64
+                               if jax.config.jax_enable_x64 else jnp.int32)
+        self._update = jax.jit(self._update_impl)
+        self._row_ids = np.arange(depth, dtype=np.uint32)
+
+    def _hashes(self, batch, lengths):
+        h1 = _fnv1a_scan(batch, lengths)
+        h2 = _mix(h1) | np.uint32(1)  # odd → full-period double hashing
+        rows = jnp.asarray(self._row_ids)[:, None]  # [d, 1]
+        cols = (h1[None, :] + rows * h2[None, :]) % np.uint32(self.width)
+        return cols.astype(jnp.int32)  # [d, B]
+
+    def _update_impl(self, table, batch, lengths, weights):
+        cols = self._hashes(batch, lengths)  # [d, B]
+        valid = (lengths >= 0).astype(table.dtype) * weights.astype(table.dtype)
+        d = self.depth
+
+        def body(r, tb):
+            return tb.at[r, cols[r]].add(valid)
+
+        return lax.fori_loop(0, d, body, table)
+
+    def update(self, batch: np.ndarray, lengths: np.ndarray,
+               weights: Optional[np.ndarray] = None) -> None:
+        B = batch.shape[0]
+        if weights is None:
+            weights = np.ones((B,), dtype=np.int32)
+        self.table = self._update(
+            self.table, jnp.asarray(batch), jnp.asarray(lengths),
+            jnp.asarray(weights),
+        )
+
+    def merge_table(self, other: "jnp.ndarray") -> None:
+        self.table = self.table + other
+
+    def _cols_cpu(self, value: bytes):
+        """Column per row for one value — bit-identical to the device
+        kernel (uint32 wrap BEFORE the modulo)."""
+        h1 = int(_hash32_cpu(value))
+        h2 = int(_mix_np(np.uint32(h1))) | 1
+        return [((h1 + r * h2) & 0xFFFFFFFF) % self.width
+                for r in range(self.depth)]
+
+    def add_cpu(self, value: bytes, weight: int = 1) -> None:
+        """Host-side single-value update (overflow-row fallback)."""
+        cols = self._cols_cpu(value)
+        rows = np.arange(self.depth)
+        self.table = self.table.at[rows, np.asarray(cols)].add(weight)
+
+    def query(self, value: bytes) -> int:
+        """Point estimate for one value (row-min)."""
+        table = np.asarray(self.table)
+        return int(min(
+            int(table[r, c]) for r, c in enumerate(self._cols_cpu(value))
+        ))
+
+
+def _hash32_cpu(value: bytes) -> np.uint32:
+    """Finalized FNV-1a — bit-identical to _fnv1a_scan on the device."""
+    h = int(FNV_OFFSET)
+    for b in value:
+        h = ((h ^ b) * int(FNV_PRIME)) & 0xFFFFFFFF
+    return _mix_np(np.uint32(h))
+
+
+def _mix_np(h: np.uint32) -> np.uint32:
+    h = np.uint32(h)
+    with np.errstate(over="ignore"):
+        h ^= h >> np.uint32(16)
+        h = np.uint32((int(h) * 0x85EBCA6B) & 0xFFFFFFFF)
+        h ^= h >> np.uint32(13)
+        h = np.uint32((int(h) * 0xC2B2AE35) & 0xFFFFFFFF)
+        h ^= h >> np.uint32(16)
+    return h
+
+
+# -- multi-device (SPMD) sketch update: batch sharded, state merged --
+
+def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
+                       lengths: np.ndarray) -> None:
+    """Update over a mesh: each device absorbs its batch shard into a
+    local register set, merged with lax.pmax (union of HLLs)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    B = batch.shape[0]
+    Bp = ((B + n_dev - 1) // n_dev) * n_dev
+    if Bp != B:
+        batch = np.concatenate(
+            [batch, np.zeros((Bp - B, batch.shape[1]), dtype=batch.dtype)]
+        )
+        lengths = np.concatenate(
+            [lengths, np.full((Bp - B,), -1, dtype=lengths.dtype)]
+        )
+
+    def step(regs, b, ln):
+        local = hll._update_impl(regs, b, ln)
+        return lax.pmax(local, axis_name=axis)
+
+    fn = jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(axis, None), P(axis)),
+        out_specs=P(),
+    ))
+    hll.registers = fn(hll.registers, jnp.asarray(batch), jnp.asarray(lengths))
+
+
+def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
+                       lengths: np.ndarray) -> None:
+    """Count-min over a mesh: local scatter-adds, psum merge."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    B = batch.shape[0]
+    Bp = ((B + n_dev - 1) // n_dev) * n_dev
+    if Bp != B:
+        batch = np.concatenate(
+            [batch, np.zeros((Bp - B, batch.shape[1]), dtype=batch.dtype)]
+        )
+        lengths = np.concatenate(
+            [lengths, np.full((Bp - B,), -1, dtype=lengths.dtype)]
+        )
+    weights = np.ones((Bp,), dtype=np.int32)
+
+    def step(table, b, ln, w):
+        # + 0*sum(w): ties the accumulator to the sharded batch so the
+        # fori_loop carry's varying-axes annotation stays consistent
+        zero = jnp.zeros_like(table) + (0 * w.sum()).astype(table.dtype)
+        local = cms._update_impl(zero, b, ln, w)
+        return table + lax.psum(local, axis_name=axis)
+
+    fn = jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(axis, None), P(axis), P(axis)),
+        out_specs=P(),
+    ))
+    cms.table = fn(cms.table, jnp.asarray(batch), jnp.asarray(lengths),
+                   jnp.asarray(weights))

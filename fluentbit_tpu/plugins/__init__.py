@@ -8,4 +8,5 @@ from . import outputs_basic  # noqa: F401
 from . import filter_grep  # noqa: F401
 from . import filter_parser  # noqa: F401
 from . import filter_rewrite_tag  # noqa: F401
+from . import filter_log_to_metrics  # noqa: F401
 from . import filters_basic  # noqa: F401

@@ -1,0 +1,331 @@
+"""filter_log_to_metrics — derive metrics (and sketches) from log records.
+
+Reference: plugins/filter_log_to_metrics/log_to_metrics.c. Modes
+counter/gauge/histogram (:566-612 bucket setup) with grep-style
+pre-filter rules in LEGACY first-rule-decides semantics
+(grep_filter_data, :345-372), labels from ``label_field`` record
+accessors + static ``add_label`` pairs, optional ``kubernetes_mode``
+auto-labels (namespace_name/pod_name/container_name/docker_id/pod_id,
+:45-49), required ``tag`` (:726), namespace default "log_metric"
+(log_to_metrics.h:54). Metrics are emitted as METRICS-type events
+through a hidden emitter input (flb_input_metrics_append, :633) so they
+flow the metrics pipeline to any metrics-capable output.
+
+North-star additions (BASELINE.md config 4 — no reference equivalent):
+``metric_mode cardinality`` maintains a device HyperLogLog over
+``value_field`` and emits the cardinality estimate as a gauge;
+``metric_mode frequency`` maintains a device count-min sketch and emits
+per-value estimated counts for the hottest observed values. Sketch
+updates run as fused jit kernels (hash + scatter) over staged batches
+(fluentbit_tpu.ops.sketch); on a device mesh the sketch merge is
+pmax/psum over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..codec.chunk import EVENT_TYPE_METRICS
+from ..codec.msgpack import packb
+from ..core.config import ConfigMapEntry
+from ..core.metrics import MetricsRegistry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..core.record_accessor import RecordAccessor
+from ..regex import FlbRegex
+
+K8S_LABELS = ("namespace_name", "pod_name", "container_name",
+              "docker_id", "pod_id")
+
+
+def _to_text(v) -> Optional[str]:
+    if isinstance(v, str):
+        return v
+    return None
+
+
+def _stringify(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+class _GrepRule:
+    __slots__ = ("is_exclude", "ra", "regex")
+
+    def __init__(self, is_exclude: bool, field: str, pattern: str):
+        self.is_exclude = is_exclude
+        self.ra = RecordAccessor(field)
+        self.regex = FlbRegex(pattern)
+
+
+@registry.register
+class LogToMetricsFilter(FilterPlugin):
+    name = "log_to_metrics"
+    description = "generate metrics from log records"
+    config_map = [
+        ConfigMapEntry("regex", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("exclude", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("metric_mode", "str", default="counter"),
+        ConfigMapEntry("value_field", "str"),
+        ConfigMapEntry("metric_name", "str"),
+        ConfigMapEntry("metric_namespace", "str", default="log_metric"),
+        ConfigMapEntry("metric_subsystem", "str", default=""),
+        ConfigMapEntry("metric_description", "str"),
+        ConfigMapEntry("kubernetes_mode", "bool", default=False),
+        ConfigMapEntry("add_label", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("label_field", "str", multiple=True),
+        ConfigMapEntry("bucket", "str", multiple=True),
+        ConfigMapEntry("tag", "str"),
+        ConfigMapEntry("emitter_name", "str"),
+        ConfigMapEntry("emitter_mem_buf_limit", "str", default="10M"),
+        ConfigMapEntry("discard_logs", "bool", default=False),
+        ConfigMapEntry("flush_interval_sec", "int", default=0),
+        ConfigMapEntry("flush_interval_nsec", "int", default=0),
+        # sketch modes (north-star additions)
+        ConfigMapEntry("sketch_precision", "int", default=14,
+                       desc="HLL precision p (2^p registers)"),
+        ConfigMapEntry("sketch_depth", "int", default=4),
+        ConfigMapEntry("sketch_width", "int", default=16384),
+        ConfigMapEntry("frequency_top_k", "int", default=10),
+        ConfigMapEntry("tpu_max_record_len", "int", default=256),
+    ]
+
+    MODES = ("counter", "gauge", "histogram", "cardinality", "frequency")
+
+    def init(self, instance, engine) -> None:
+        if not self.metric_name:
+            raise ValueError("log_to_metrics: metric_name is not set")
+        if not self.metric_description:
+            raise ValueError("log_to_metrics: metric_description is not set")
+        if not self.tag:
+            raise ValueError("log_to_metrics: Metric tag is not set")
+        self.mode = (self.metric_mode or "counter").lower()
+        if self.mode not in self.MODES:
+            raise ValueError(f"log_to_metrics: unknown mode {self.metric_mode!r}")
+        if self.mode in ("gauge", "histogram", "cardinality", "frequency") \
+                and not self.value_field:
+            raise ValueError(f"log_to_metrics: {self.mode} requires value_field")
+
+        # grep-style pre-filter, property order preserved (legacy logic)
+        self.rules: List[_GrepRule] = []
+        for key, value in instance.properties.items():
+            lk = key.lower()
+            if lk in ("regex", "exclude"):
+                parts = value.split(None, 1) if isinstance(value, str) else list(value)
+                if len(parts) != 2:
+                    raise ValueError(f"log_to_metrics: invalid rule {value!r}")
+                self.rules.append(_GrepRule(lk == "exclude", parts[0], parts[1]))
+
+        # labels: [k8s...] + label_field RAs + add_label statics
+        self.label_keys: List[str] = []
+        self._label_ras: List[RecordAccessor] = []
+        self._k8s_ra = RecordAccessor("$kubernetes") if self.kubernetes_mode else None
+        if self.kubernetes_mode:
+            self.label_keys.extend(K8S_LABELS)
+        for lf in self.label_field or []:
+            name = lf[1:] if lf.startswith("$") else lf
+            self.label_keys.append(name.replace("['", "_").replace("']", "")
+                                   .replace(".", "_"))
+            self._label_ras.append(
+                RecordAccessor(lf if lf.startswith("$") else "$" + lf)
+            )
+        self._static_labels: List[str] = []
+        for pair in self.add_label or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) != 2:
+                raise ValueError(f"log_to_metrics: invalid add_label {pair!r}")
+            self.label_keys.append(parts[0])
+            self._static_labels.append(parts[1])
+
+        self.value_ra = RecordAccessor(
+            self.value_field if str(self.value_field or "").startswith("$")
+            else "$" + (self.value_field or "value")
+        ) if self.value_field else None
+
+        # the cmt context emitted through the pipeline
+        self.cmt = MetricsRegistry()
+        ns, sub = self.metric_namespace, self.metric_subsystem or ""
+        keys = tuple(self.label_keys)
+        if self.mode == "counter":
+            self.metric = self.cmt.counter(ns, sub, self.metric_name,
+                                           self.metric_description, keys)
+        elif self.mode == "gauge":
+            self.metric = self.cmt.gauge(ns, sub, self.metric_name,
+                                         self.metric_description, keys)
+        elif self.mode == "histogram":
+            buckets = [float(b) for b in (self.bucket or [])] or None
+            from ..core.metrics import DEFAULT_BUCKETS
+
+            self.metric = self.cmt.histogram(
+                ns, sub, self.metric_name, self.metric_description, keys,
+                tuple(buckets) if buckets else DEFAULT_BUCKETS,
+            )
+        elif self.mode == "cardinality":
+            self.metric = self.cmt.gauge(ns, sub, self.metric_name,
+                                         self.metric_description, keys)
+            from ..ops.sketch import HyperLogLog
+
+            self.hll = HyperLogLog(p=self.sketch_precision)
+        else:  # frequency
+            self.metric = self.cmt.gauge(
+                ns, sub, self.metric_name, self.metric_description,
+                keys + ("value",),
+            )
+            from ..ops.sketch import CountMin
+
+            self.cms = CountMin(depth=self.sketch_depth,
+                                width=self.sketch_width)
+            self._freq_candidates: Dict[bytes, None] = {}
+
+        self.emitter = None
+        if engine is not None:
+            name = self.emitter_name or f"emitter_for_{instance.display_name}"
+            ins = engine.hidden_input(
+                "emitter", alias=name,
+                mem_buf_limit=self.emitter_mem_buf_limit,
+            )
+            self.emitter = ins.plugin
+
+    # -- per-record helpers --
+
+    def _emit_due(self) -> bool:
+        """flush_interval throttling: with an interval configured, emit a
+        snapshot at most once per interval (the reference's timer-driven
+        emission); interval 0 = emit on every append (default)."""
+        interval = self.flush_interval_sec + self.flush_interval_nsec / 1e9
+        if interval <= 0:
+            return True
+        import time as _time
+
+        now = _time.monotonic()
+        last = getattr(self, "_last_emit", 0.0)
+        if now - last >= interval:
+            self._last_emit = now
+            return True
+        return False
+
+    def _selected(self, body: dict) -> bool:
+        """LEGACY grep logic: first rule decides (grep_filter_data)."""
+        for rule in self.rules:
+            v = _to_text(rule.ra.get(body))
+            matched = rule.regex.match(v) if v is not None else False
+            if matched:
+                return not rule.is_exclude
+            if not rule.is_exclude:
+                return False
+        return True
+
+    def _labels(self, body: dict) -> tuple:
+        out: List[str] = []
+        if self._k8s_ra is not None:
+            k8s = self._k8s_ra.get(body) or {}
+            for key in K8S_LABELS:
+                v = k8s.get(key) if isinstance(k8s, dict) else None
+                out.append(_stringify(v) if v is not None else "")
+        for ra in self._label_ras:
+            v = ra.get(body)
+            out.append(_stringify(v) if v is not None else "")
+        out.extend(self._static_labels)
+        return tuple(out)
+
+    def _value(self, body: dict) -> Optional[float]:
+        v = self.value_ra.get(body) if self.value_ra else None
+        if isinstance(v, bool) or v is None:
+            return None
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return None
+
+    def _value_bytes(self, body: dict) -> Optional[bytes]:
+        v = self.value_ra.get(body) if self.value_ra else None
+        if v is None:
+            return None
+        return _stringify(v).encode("utf-8") if not isinstance(v, str) \
+            else v.encode("utf-8")
+
+    # -- the filter --
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        selected = [
+            ev for ev in events
+            if isinstance(ev.body, dict) and self._selected(ev.body)
+        ]
+        if self.mode == "counter":
+            for ev in selected:
+                self.metric.inc(1, self._labels(ev.body))
+        elif self.mode == "gauge":
+            for ev in selected:
+                v = self._value(ev.body)
+                if v is not None:
+                    self.metric.set(v, self._labels(ev.body))
+        elif self.mode == "histogram":
+            for ev in selected:
+                v = self._value(ev.body)
+                if v is not None:
+                    self.metric.observe(v, self._labels(ev.body))
+        elif self.mode == "cardinality":
+            self._update_hll(selected)
+        else:
+            self._update_cms(selected)
+
+        if selected and self.emitter is not None and self._emit_due():
+            payload = packb(self.cmt.to_msgpack_obj())
+            self.emitter.add_event(
+                self.tag, payload, EVENT_TYPE_METRICS,
+                n_records=len(list(self.cmt.metrics())),
+            )
+        if self.discard_logs:
+            return (FilterResult.MODIFIED, [])
+        return (FilterResult.NOTOUCH, events)
+
+    # -- sketch modes --
+
+    def _staged(self, values: List[Optional[bytes]]):
+        from ..ops.batch import assemble, bucket_size
+
+        return assemble(values, self.tpu_max_record_len,
+                        bucket_size(len(values)))
+
+    def _update_hll(self, selected: list) -> None:
+        vals = [self._value_bytes(ev.body) for ev in selected]
+        vals = [v for v in vals if v is not None]
+        if not vals:
+            return
+        b = self._staged(vals)
+        self.hll.update(b.batch, b.lengths)
+        for i in b.overflow:  # oversized values resolve on CPU
+            self.hll.add_cpu(vals[i])
+        labels = self._labels(selected[0].body) if self.label_keys else ()
+        self.metric.set(self.hll.estimate(), labels)
+
+    def _update_cms(self, selected: list) -> None:
+        vals = [self._value_bytes(ev.body) for ev in selected]
+        vals = [v for v in vals if v is not None]
+        if not vals:
+            return
+        b = self._staged(vals)
+        self.cms.update(b.batch, b.lengths)
+        for i in b.overflow:  # oversized values resolve on CPU
+            self.cms.add_cpu(vals[i])
+        for v in vals:
+            # delete-and-reinsert refreshes recency (dict preserves
+            # insertion order; plain reassignment would not move the key)
+            self._freq_candidates.pop(v, None)
+            self._freq_candidates[v] = None
+        # bound candidate memory: keep most recently seen 4096 values
+        if len(self._freq_candidates) > 4096:
+            drop = len(self._freq_candidates) - 4096
+            for k in list(self._freq_candidates)[:drop]:
+                del self._freq_candidates[k]
+        base = self._labels(selected[0].body) if self.label_keys else ()
+        top = sorted(
+            ((self.cms.query(v), v) for v in self._freq_candidates),
+            reverse=True,
+        )[: self.frequency_top_k]
+        for est, v in top:
+            self.metric.set(
+                est, base + (v.decode("utf-8", "replace"),)
+            )

@@ -24,6 +24,23 @@ from ..codec.chunk import (
 )
 
 
+def _metrics_payloads(data: bytes):
+    """Decode a METRICS-type chunk: a sequence of metrics snapshots
+    (one per emitter append). Empty list when it is log events."""
+    from ..codec.msgpack import Unpacker
+    from ..core.metrics import is_metrics_payload
+
+    out = []
+    try:
+        for obj in Unpacker(data):
+            if not is_metrics_payload(obj):
+                return []
+            out.append(obj)
+    except Exception:
+        return []
+    return out
+
+
 def _json_default(o):
     if isinstance(o, EventTime):
         return float(o)
@@ -60,6 +77,21 @@ class StdoutOutput(OutputPlugin):
     async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
         fmt = (self.format or "print").lower()
         out = sys.stdout
+        payloads = _metrics_payloads(data)
+        if payloads:
+            from ..core.metrics import payload_to_prometheus
+
+            # snapshots are cumulative per source registry: merge in
+            # order so each metric's latest snapshot wins
+            merged = {}
+            for p in payloads:
+                for m in p.get("metrics", []):
+                    merged[m.get("name", "")] = m
+            out.write(payload_to_prometheus(
+                {"meta": {}, "metrics": list(merged.values())}
+            ))
+            out.flush()
+            return FlushResult.OK
         if fmt == "msgpack":
             out.buffer.write(data)
         elif fmt in ("json", "json_lines", "json_stream"):
@@ -96,6 +128,7 @@ class LibOutput(OutputPlugin):
     """
 
     name = "lib"
+    event_types = (EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES)
     config_map = [ConfigMapEntry("callback", "raw")]
 
     def init(self, instance, engine) -> None:
@@ -190,6 +223,62 @@ class ExitOutput(OutputPlugin):
         if self._seen >= self.flush_count:
             engine.request_stop()
         return FlushResult.OK
+
+
+@registry.register
+class PrometheusExporterOutput(OutputPlugin):
+    """plugins/out_prometheus_exporter: aggregate metrics-type chunks and
+    expose them as Prometheus text. ``render()`` returns the current
+    exposition (served over HTTP by the admin server / a listener when
+    host/port configured; BASELINE config 4 sink)."""
+
+    name = "prometheus_exporter"
+    event_types = (EVENT_TYPE_METRICS,)
+    config_map = [
+        ConfigMapEntry("add_label", "slist", multiple=True, slist_max_split=1),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._payloads = {}  # metric fqname -> latest metric entry
+        self._extra = []
+        for pair in self.add_label or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) == 2:
+                self._extra.append((parts[0], parts[1]))
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        payloads = _metrics_payloads(data)
+        if not payloads:
+            return FlushResult.ERROR
+        # snapshots are cumulative PER SOURCE registry; a chunk may carry
+        # snapshots from several filters — merge every one in order so
+        # the last snapshot of EACH metric name wins
+        for payload in payloads:
+            for m in payload.get("metrics", []):
+                entry = dict(m)
+                if self._extra:
+                    extra_keys = [k for k, _ in self._extra]
+                    extra_vals = [v for _, v in self._extra]
+                    entry["labels"] = list(m.get("labels", [])) + extra_keys
+                    entry["values"] = [
+                        {"labels": list(s.get("labels", [])) + extra_vals,
+                         "value": s.get("value")}
+                        for s in m.get("values", [])
+                    ]
+                    entry["hist"] = [
+                        {**h,
+                         "labels": list(h.get("labels", [])) + extra_vals}
+                        for h in m.get("hist", [])
+                    ]
+                self._payloads[m.get("name", "")] = entry
+        return FlushResult.OK
+
+    def render(self) -> str:
+        from ..core.metrics import payload_to_prometheus
+
+        return payload_to_prometheus(
+            {"meta": {}, "metrics": list(self._payloads.values())}
+        )
 
 
 @registry.register
