@@ -72,6 +72,7 @@ class Engine:
         self.storage = None  # set by core.storage when storage_path configured
         self.parsers: Dict[str, Any] = {}  # named parsers (flb_parser registry)
 
+        self._backlog: List[Chunk] = []  # recovered chunks to re-dispatch
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -184,6 +185,15 @@ class Engine:
         """Spawn the engine thread (flb_start → flb_engine_start)."""
         if self._thread is not None:
             raise RuntimeError("engine already started")
+        # storage + backlog recovery (flb_storage_create at
+        # src/flb_engine.c:979; sb_segregate_chunks at :1129)
+        if self.service.storage_path and self.storage is None:
+            from .storage import Storage
+
+            self.storage = Storage(self.service.storage_path,
+                                   checksum=self.service.storage_checksum)
+        if self.storage is not None:
+            self._backlog = self.storage.scan_backlog()
         for ins in self.inputs + self.filters + self.outputs:
             if getattr(ins, "_initialized", False):
                 continue  # hidden inputs are initialized at creation
@@ -265,6 +275,8 @@ class Engine:
                 ins.plugin.exit()
             except Exception:
                 log.exception("%s exit failed", ins.display_name)
+        if self.storage is not None:
+            self.storage.close()
 
     @property
     def running(self) -> bool:
@@ -319,7 +331,9 @@ class Engine:
             out = bytearray()
             for ev in events:
                 out += ev.raw if ev.raw is not None else reencode_event(ev)
-            ins.pool.append(tag, bytes(out), len(events))
+            chunk = ins.pool.append(tag, bytes(out), len(events))
+            if self.storage is not None and ins.storage_type == "filesystem":
+                self.storage.write_through(chunk, bytes(out))
         return len(events)
 
     def input_event_append(self, ins: InputInstance, tag: Optional[str],
@@ -330,7 +344,9 @@ class Engine:
         self.m_in_records.inc(n_records, (ins.display_name,))
         self.m_in_bytes.inc(len(data), (ins.display_name,))
         with self._ingest_lock:
-            ins.pool.append(tag, data, n_records, event_type)
+            chunk = ins.pool.append(tag, data, n_records, event_type)
+            if self.storage is not None and ins.storage_type == "filesystem":
+                self.storage.write_through(chunk, data)
         return n_records
 
     def _run_filters(self, events: List[LogEvent], tag: str) -> List[LogEvent]:
@@ -366,8 +382,16 @@ class Engine:
             self.m_uptime.set(time.time() - self.started_at)
         with self._ingest_lock:
             chunks: List[tuple] = []
+            if self._backlog:  # recovered chunks re-dispatch first
+                chunks.extend((None, c) for c in self._backlog)
+                self._backlog = []
             for ins in self.inputs:
                 for chunk in ins.pool.drain():
+                    if (
+                        self.storage is not None
+                        and ins.storage_type == "filesystem"
+                    ):
+                        self.storage.finalize(chunk)
                     chunks.append((ins, chunk))
                 # resume paused inputs once the buffer drains
                 if ins.paused and (
@@ -384,6 +408,8 @@ class Engine:
                 if o.route.matches(chunk.tag) and chunk.event_type in o.plugin.event_types
             ]
             if not routes:
+                if self.storage is not None:
+                    self.storage.delete(chunk)
                 continue
             task = Task(chunk, routes)
             for out in routes:
@@ -459,6 +485,8 @@ class Engine:
             self.m_out_proc_bytes.inc(chunk.size, (name,))
             self.m_latency.observe(time.time() - chunk.created, (name,))
             task.users -= 1
+            if task.users == 0 and self.storage is not None:
+                self.storage.delete(chunk)  # every route delivered
             return None
         if result == FlushResult.RETRY:
             attempts = task.retries.get(out.name, 0) + 1
@@ -479,6 +507,8 @@ class Engine:
             except Exception:
                 log.exception("DLQ quarantine failed")
         task.users -= 1
+        if task.users == 0 and self.storage is not None:
+            self.storage.delete(chunk)  # dlq copy (if any) is separate
         return None
 
     # ------------------------------------------------------------------

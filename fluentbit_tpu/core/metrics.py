@@ -51,6 +51,11 @@ class _Metric:
         with self._lock:
             return list(self._values.items())
 
+    def clear(self) -> None:
+        """Drop every labeled series (frequency-mode top-k refresh)."""
+        with self._lock:
+            self._values.clear()
+
 
 class Counter(_Metric):
     kind = "counter"

@@ -1,0 +1,224 @@
+"""Filesystem chunk storage — persistence, backlog, DLQ.
+
+Reference: lib/chunkio (file chunks with CRC32 integrity,
+src/cio_file.c:49-104) wrapped by src/flb_storage.c (memory/filesystem
+mapping per input :530-556, quarantine
+flb_storage_quarantine_chunk), and plugins/in_storage_backlog (re-ingest
+of filesystem chunks found at startup after sb_segregate_chunks,
+src/flb_engine.c:1129).
+
+Design (TPU build, not a port of chunkio): a chunk file is
+``header + concatenated msgpack events``; appends are write-through
+(append + flush so a crash loses at most the last partial write), the
+CRC is stamped when the chunk is finalized at drain time. Layout::
+
+    <root>/streams/<input_name>/<chunk_id>.flb      in-flight chunks
+    <root>/dlq/<chunk_id>.flb                       quarantined chunks
+
+Header: ``FBTC | ver u8 | type u8 | state u8 | pad u8 | crc32 u32le |
+tag_len u16le | tag``. state 0 = open (crc not yet valid, a crash left
+it un-finalized — payload is still recovered), 1 = finalized (crc32 of
+the payload must match; mismatch → the file is renamed ``.corrupt`` and
+skipped, mirroring chunkio's checksum failure handling).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.chunk import (
+    Chunk,
+    EVENT_TYPE_BLOBS,
+    EVENT_TYPE_LOGS,
+    EVENT_TYPE_METRICS,
+    EVENT_TYPE_PROFILES,
+    EVENT_TYPE_TRACES,
+)
+
+log = logging.getLogger("flb.storage")
+
+MAGIC = b"FBTC"
+VERSION = 1
+STATE_OPEN = 0
+STATE_FINAL = 1
+
+_TYPE_CODES = {
+    EVENT_TYPE_LOGS: 0,
+    EVENT_TYPE_METRICS: 1,
+    EVENT_TYPE_TRACES: 2,
+    EVENT_TYPE_PROFILES: 3,
+    EVENT_TYPE_BLOBS: 4,
+}
+_TYPE_NAMES = {v: k for k, v in _TYPE_CODES.items()}
+
+_HEAD = struct.Struct("<4sBBBBIH")  # magic, ver, type, state, pad, crc, tag_len
+
+
+class Storage:
+    """Filesystem backend for chunk persistence + DLQ."""
+
+    def __init__(self, path: str, checksum: bool = True):
+        self.root = os.path.abspath(path)
+        self.checksum = checksum
+        self.streams_dir = os.path.join(self.root, "streams")
+        self.dlq_dir = os.path.join(self.root, "dlq")
+        os.makedirs(self.streams_dir, exist_ok=True)
+        os.makedirs(self.dlq_dir, exist_ok=True)
+        # chunk id → (open file handle or None, path)
+        self._files: Dict[int, Tuple[Optional[object], str]] = {}
+        self._quarantined: set = set()  # chunk ids already in the DLQ
+
+    # -- write path --
+
+    def _chunk_path(self, chunk: Chunk) -> str:
+        d = os.path.join(self.streams_dir, chunk.in_name or "default")
+        os.makedirs(d, exist_ok=True)
+        # the in-process chunk id counter resets on restart; a random
+        # suffix keeps new files from colliding with recovered ones
+        return os.path.join(d, f"{chunk.id}-{os.urandom(4).hex()}.flb")
+
+    def write_through(self, chunk: Chunk, data: bytes) -> None:
+        """Persist an append immediately (crash-safe up to this write)."""
+        entry = self._files.get(chunk.id)
+        if entry is None:
+            path = self._chunk_path(chunk)
+            f = open(path, "wb")
+            tag = chunk.tag.encode("utf-8")
+            f.write(_HEAD.pack(MAGIC, VERSION,
+                               _TYPE_CODES.get(chunk.event_type, 0),
+                               STATE_OPEN, 0, 0, len(tag)))
+            f.write(tag)
+            self._files[chunk.id] = (f, path)
+            entry = self._files[chunk.id]
+        f = entry[0]
+        f.write(data)
+        f.flush()
+
+    def finalize(self, chunk: Chunk) -> None:
+        """Stamp the CRC + finalized state (called at drain time)."""
+        entry = self._files.get(chunk.id)
+        if entry is None or entry[0] is None:
+            return
+        f, path = entry
+        crc = zlib.crc32(chunk.get_bytes()) & 0xFFFFFFFF if self.checksum else 0
+        f.flush()
+        f.seek(0)
+        tag = chunk.tag.encode("utf-8")
+        f.write(_HEAD.pack(MAGIC, VERSION,
+                           _TYPE_CODES.get(chunk.event_type, 0),
+                           STATE_FINAL, 0, crc, len(tag)))
+        f.close()
+        self._files[chunk.id] = (None, path)
+
+    def delete(self, chunk: Chunk) -> None:
+        """Drop the backing file once every route delivered the chunk."""
+        entry = self._files.pop(chunk.id, None)
+        if entry is None:
+            return
+        f, path = entry
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def quarantine(self, chunk: Chunk) -> str:
+        """DLQ: persist a rejected chunk (exhausted retries / hard error)
+        under dlq/ (flb_storage_quarantine_chunk equivalent)."""
+        if chunk.id in self._quarantined:  # one DLQ copy per chunk even
+            return ""                      # when several routes fail
+        self._quarantined.add(chunk.id)
+        path = os.path.join(self.dlq_dir,
+                            f"{chunk.id}-{os.urandom(4).hex()}.flb")
+        tag = chunk.tag.encode("utf-8")
+        payload = chunk.get_bytes()
+        crc = zlib.crc32(payload) & 0xFFFFFFFF if self.checksum else 0
+        with open(path, "wb") as f:
+            f.write(_HEAD.pack(MAGIC, VERSION,
+                               _TYPE_CODES.get(chunk.event_type, 0),
+                               STATE_FINAL, 0, crc, len(tag)))
+            f.write(tag)
+            f.write(payload)
+        return path
+
+    # -- read path (backlog) --
+
+    def _read_chunk_file(self, path: str) -> Optional[Chunk]:
+        with open(path, "rb") as f:
+            head = f.read(_HEAD.size)
+            if len(head) < _HEAD.size:
+                raise ValueError("truncated header")
+            magic, ver, tcode, state, _, crc, tag_len = _HEAD.unpack(head)
+            if magic != MAGIC or ver != VERSION:
+                raise ValueError("bad magic/version")
+            tag = f.read(tag_len).decode("utf-8")
+            payload = f.read()
+        if state == STATE_FINAL and self.checksum and crc:
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise ValueError("crc mismatch")
+        from ..codec.events import count_records
+
+        chunk = Chunk(tag, _TYPE_NAMES.get(tcode, EVENT_TYPE_LOGS),
+                      os.path.basename(os.path.dirname(path)))
+        chunk.buf = bytearray(payload)
+        chunk.records = count_records(payload)
+        chunk.locked = True
+        return chunk
+
+    def scan_backlog(self) -> List[Chunk]:
+        """Recover chunks left on disk by a previous run; corrupt files
+        are renamed ``.corrupt`` and skipped."""
+        out: List[Chunk] = []
+        for dirpath, _dirs, files in os.walk(self.streams_dir):
+            for name in sorted(files):
+                if not name.endswith(".flb"):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    chunk = self._read_chunk_file(path)
+                except Exception as e:
+                    log.warning("storage: corrupt chunk %s (%s)", path, e)
+                    try:
+                        os.rename(path, path + ".corrupt")
+                    except OSError:
+                        pass
+                    continue
+                if chunk.records == 0:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue
+                # track so delivery deletes the file
+                self._files[chunk.id] = (None, path)
+                out.append(chunk)
+        return out
+
+    def dlq_chunks(self) -> List[Chunk]:
+        """Read quarantined chunks (inspection / re-ingestion tooling)."""
+        out = []
+        for name in sorted(os.listdir(self.dlq_dir)):
+            if name.endswith(".flb"):
+                try:
+                    out.append(
+                        self._read_chunk_file(os.path.join(self.dlq_dir, name))
+                    )
+                except Exception:
+                    continue
+        return out
+
+    def close(self) -> None:
+        for f, _ in list(self._files.values()):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass

@@ -199,6 +199,17 @@ class CountMin:
             int(table[r, c]) for r, c in enumerate(self._cols_cpu(value))
         ))
 
+    def query_many(self, values) -> list:
+        """Point estimates for many values with ONE device→host table
+        copy (per-value query() would sync the device each time)."""
+        table = np.asarray(self.table)
+        out = []
+        for v in values:
+            out.append(int(min(
+                int(table[r, c]) for r, c in enumerate(self._cols_cpu(v))
+            )))
+        return out
+
 
 def _hash32_cpu(value: bytes) -> np.uint32:
     """Finalized FNV-1a — bit-identical to _fnv1a_scan on the device."""
@@ -221,14 +232,7 @@ def _mix_np(h: np.uint32) -> np.uint32:
 
 # -- multi-device (SPMD) sketch update: batch sharded, state merged --
 
-def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
-                       lengths: np.ndarray) -> None:
-    """Update over a mesh: each device absorbs its batch shard into a
-    local register set, merged with lax.pmax (union of HLLs)."""
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    axis = mesh.axis_names[0]
+def _pad_to_mesh(mesh, batch, lengths):
     n_dev = mesh.devices.size
     B = batch.shape[0]
     Bp = ((B + n_dev - 1) // n_dev) * n_dev
@@ -239,16 +243,35 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
         lengths = np.concatenate(
             [lengths, np.full((Bp - B,), -1, dtype=lengths.dtype)]
         )
+    return batch, lengths
 
-    def step(regs, b, ln):
-        local = hll._update_impl(regs, b, ln)
-        return lax.pmax(local, axis_name=axis)
 
-    fn = jax.jit(shard_map(
-        step, mesh=mesh,
-        in_specs=(P(), P(axis, None), P(axis)),
-        out_specs=P(),
-    ))
+def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
+                       lengths: np.ndarray) -> None:
+    """Update over a mesh: each device absorbs its batch shard into a
+    local register set, merged with lax.pmax (union of HLLs)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    batch, lengths = _pad_to_mesh(mesh, batch, lengths)
+    # cache the compiled step per mesh — a fresh jit(shard_map(...))
+    # closure would recompile on every call
+    cache = getattr(hll, "_sharded_cache", None)
+    if cache is None:
+        cache = hll._sharded_cache = {}
+    fn = cache.get(id(mesh))
+    if fn is None:
+        def step(regs, b, ln):
+            local = hll._update_impl(regs, b, ln)
+            return lax.pmax(local, axis_name=axis)
+
+        fn = jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(axis, None), P(axis)),
+            out_specs=P(),
+        ))
+        cache[id(mesh)] = fn
     hll.registers = fn(hll.registers, jnp.asarray(batch), jnp.asarray(lengths))
 
 
@@ -259,29 +282,25 @@ def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
     from jax.sharding import PartitionSpec as P
 
     axis = mesh.axis_names[0]
-    n_dev = mesh.devices.size
-    B = batch.shape[0]
-    Bp = ((B + n_dev - 1) // n_dev) * n_dev
-    if Bp != B:
-        batch = np.concatenate(
-            [batch, np.zeros((Bp - B, batch.shape[1]), dtype=batch.dtype)]
-        )
-        lengths = np.concatenate(
-            [lengths, np.full((Bp - B,), -1, dtype=lengths.dtype)]
-        )
-    weights = np.ones((Bp,), dtype=np.int32)
+    batch, lengths = _pad_to_mesh(mesh, batch, lengths)
+    weights = np.ones((batch.shape[0],), dtype=np.int32)
+    cache = getattr(cms, "_sharded_cache", None)
+    if cache is None:
+        cache = cms._sharded_cache = {}
+    fn = cache.get(id(mesh))
+    if fn is None:
+        def step(table, b, ln, w):
+            # + 0*sum(w): ties the accumulator to the sharded batch so
+            # the fori_loop carry's varying annotation stays consistent
+            zero = jnp.zeros_like(table) + (0 * w.sum()).astype(table.dtype)
+            local = cms._update_impl(zero, b, ln, w)
+            return table + lax.psum(local, axis_name=axis)
 
-    def step(table, b, ln, w):
-        # + 0*sum(w): ties the accumulator to the sharded batch so the
-        # fori_loop carry's varying-axes annotation stays consistent
-        zero = jnp.zeros_like(table) + (0 * w.sum()).astype(table.dtype)
-        local = cms._update_impl(zero, b, ln, w)
-        return table + lax.psum(local, axis_name=axis)
-
-    fn = jax.jit(shard_map(
-        step, mesh=mesh,
-        in_specs=(P(), P(axis, None), P(axis), P(axis)),
-        out_specs=P(),
-    ))
+        fn = jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(axis, None), P(axis), P(axis)),
+            out_specs=P(),
+        ))
+        cache[id(mesh)] = fn
     cms.table = fn(cms.table, jnp.asarray(batch), jnp.asarray(lengths),
                    jnp.asarray(weights))

@@ -62,6 +62,33 @@ class Rule:
         return self.regex.match(val)
 
 
+def legacy_keep(rules, body: dict) -> bool:
+    """First-rule-decides verdict (grep.c:167-194): Exclude-hit ⇒ drop,
+    Regex-miss ⇒ drop, Regex-hit ⇒ keep, fallthrough ⇒ keep. Shared by
+    filter_grep's legacy mode and filter_log_to_metrics' pre-filter
+    (log_to_metrics.c grep_filter_data uses the identical logic)."""
+    for rule in rules:
+        if rule.match(body):
+            return rule.is_exclude is False
+        if not rule.is_exclude:
+            return False
+    return True
+
+
+def parse_grep_rules(properties) -> List[Rule]:
+    """Build the ordered rule list from regex/exclude properties
+    (property order matters for legacy semantics)."""
+    rules: List[Rule] = []
+    for key, value in properties.items():
+        lk = key.lower()
+        if lk in ("regex", "exclude"):
+            parts = value.split(None, 1) if isinstance(value, str) else list(value)
+            if len(parts) != 2:
+                raise ValueError(f"grep: invalid rule {value!r}")
+            rules.append(Rule(lk == "exclude", parts[0], parts[1]))
+    return rules
+
+
 @registry.register
 class GrepFilter(FilterPlugin):
     name = "grep"
@@ -82,15 +109,7 @@ class GrepFilter(FilterPlugin):
     ]
 
     def init(self, instance, engine) -> None:
-        self.rules: List[Rule] = []
-        # property order matters for legacy mode; reconstruct it
-        for key, value in instance.properties.items():
-            lk = key.lower()
-            if lk in ("regex", "exclude"):
-                parts = value.split(None, 1) if isinstance(value, str) else list(value)
-                if len(parts) != 2:
-                    raise ValueError(f"grep: invalid rule {value!r}")
-                self.rules.append(Rule(lk == "exclude", parts[0], parts[1]))
+        self.rules = parse_grep_rules(instance.properties)
         op = (self.logical_op or "legacy").lower()
         if op == "and":
             self.op = AND
@@ -120,12 +139,7 @@ class GrepFilter(FilterPlugin):
         if not self.rules:
             return True
         if self.op == LEGACY:
-            for rule in self.rules:
-                if rule.match(body):
-                    return rule.is_exclude is False  # Exclude-hit→drop, Regex-hit→keep
-                if not rule.is_exclude:
-                    return False  # Regex-miss → exclude
-            return True
+            return legacy_keep(self.rules, body)
         # AND/OR: compute 'found' with short-circuit, verdict by last rule's type
         found = False
         rule = self.rules[0]

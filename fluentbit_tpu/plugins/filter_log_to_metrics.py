@@ -31,16 +31,10 @@ from ..core.config import ConfigMapEntry
 from ..core.metrics import MetricsRegistry
 from ..core.plugin import FilterPlugin, FilterResult, registry
 from ..core.record_accessor import RecordAccessor
-from ..regex import FlbRegex
+from .filter_grep import legacy_keep, parse_grep_rules
 
 K8S_LABELS = ("namespace_name", "pod_name", "container_name",
               "docker_id", "pod_id")
-
-
-def _to_text(v) -> Optional[str]:
-    if isinstance(v, str):
-        return v
-    return None
 
 
 def _stringify(v) -> str:
@@ -49,15 +43,6 @@ def _stringify(v) -> str:
     if isinstance(v, float) and v.is_integer():
         return str(int(v))
     return str(v)
-
-
-class _GrepRule:
-    __slots__ = ("is_exclude", "ra", "regex")
-
-    def __init__(self, is_exclude: bool, field: str, pattern: str):
-        self.is_exclude = is_exclude
-        self.ra = RecordAccessor(field)
-        self.regex = FlbRegex(pattern)
 
 
 @registry.register
@@ -108,15 +93,10 @@ class LogToMetricsFilter(FilterPlugin):
                 and not self.value_field:
             raise ValueError(f"log_to_metrics: {self.mode} requires value_field")
 
-        # grep-style pre-filter, property order preserved (legacy logic)
-        self.rules: List[_GrepRule] = []
-        for key, value in instance.properties.items():
-            lk = key.lower()
-            if lk in ("regex", "exclude"):
-                parts = value.split(None, 1) if isinstance(value, str) else list(value)
-                if len(parts) != 2:
-                    raise ValueError(f"log_to_metrics: invalid rule {value!r}")
-                self.rules.append(_GrepRule(lk == "exclude", parts[0], parts[1]))
+        # grep-style pre-filter, property order preserved — shares
+        # filter_grep's rule machinery (grep_filter_data is the same
+        # legacy logic)
+        self.rules = parse_grep_rules(instance.properties)
 
         # labels: [k8s...] + label_field RAs + add_label statics
         self.label_keys: List[str] = []
@@ -180,6 +160,8 @@ class LogToMetricsFilter(FilterPlugin):
             self._freq_candidates: Dict[bytes, None] = {}
 
         self.emitter = None
+        self._dirty = False
+        self._interval = 0.0
         if engine is not None:
             name = self.emitter_name or f"emitter_for_{instance.display_name}"
             ins = engine.hidden_input(
@@ -187,35 +169,24 @@ class LogToMetricsFilter(FilterPlugin):
                 mem_buf_limit=self.emitter_mem_buf_limit,
             )
             self.emitter = ins.plugin
+            interval = self.flush_interval_sec + self.flush_interval_nsec / 1e9
+            self._interval = interval
+            if interval > 0:
+                # timer-driven emission (the reference's flush timer):
+                # piggyback an interval collector on the hidden emitter
+                # so throttled updates are flushed even when no further
+                # records arrive
+                ins.plugin.collect_interval = interval
+                ins.plugin.collect = (
+                    lambda _engine: self._emit_snapshot() if self._dirty
+                    else None
+                )
 
     # -- per-record helpers --
 
-    def _emit_due(self) -> bool:
-        """flush_interval throttling: with an interval configured, emit a
-        snapshot at most once per interval (the reference's timer-driven
-        emission); interval 0 = emit on every append (default)."""
-        interval = self.flush_interval_sec + self.flush_interval_nsec / 1e9
-        if interval <= 0:
-            return True
-        import time as _time
-
-        now = _time.monotonic()
-        last = getattr(self, "_last_emit", 0.0)
-        if now - last >= interval:
-            self._last_emit = now
-            return True
-        return False
-
     def _selected(self, body: dict) -> bool:
         """LEGACY grep logic: first rule decides (grep_filter_data)."""
-        for rule in self.rules:
-            v = _to_text(rule.ra.get(body))
-            matched = rule.regex.match(v) if v is not None else False
-            if matched:
-                return not rule.is_exclude
-            if not rule.is_exclude:
-                return False
-        return True
+        return legacy_keep(self.rules, body)
 
     def _labels(self, body: dict) -> tuple:
         out: List[str] = []
@@ -271,15 +242,23 @@ class LogToMetricsFilter(FilterPlugin):
         else:
             self._update_cms(selected)
 
-        if selected and self.emitter is not None and self._emit_due():
-            payload = packb(self.cmt.to_msgpack_obj())
-            self.emitter.add_event(
-                self.tag, payload, EVENT_TYPE_METRICS,
-                n_records=len(list(self.cmt.metrics())),
-            )
+        if selected:
+            self._dirty = True
+            # interval 0 (default): emit on every append; with an
+            # interval configured, the emitter collector timer emits
+            if self.emitter is not None and self._interval <= 0:
+                self._emit_snapshot()
         if self.discard_logs:
             return (FilterResult.MODIFIED, [])
         return (FilterResult.NOTOUCH, events)
+
+    def _emit_snapshot(self) -> None:
+        payload = packb(self.cmt.to_msgpack_obj())
+        self.emitter.add_event(
+            self.tag, payload, EVENT_TYPE_METRICS,
+            n_records=len(list(self.cmt.metrics())),
+        )
+        self._dirty = False
 
     # -- sketch modes --
 
@@ -321,10 +300,14 @@ class LogToMetricsFilter(FilterPlugin):
             for k in list(self._freq_candidates)[:drop]:
                 del self._freq_candidates[k]
         base = self._labels(selected[0].body) if self.label_keys else ()
+        # one device→host table copy for the whole candidate set
+        ests = self.cms.query_many(list(self._freq_candidates))
         top = sorted(
-            ((self.cms.query(v), v) for v in self._freq_candidates),
-            reverse=True,
+            zip(ests, self._freq_candidates), reverse=True,
         )[: self.frequency_top_k]
+        # the gauge reports the CURRENT top-k only: stale series from
+        # values that dropped out must not linger in the exposition
+        self.metric.clear()
         for est, v in top:
             self.metric.set(
                 est, base + (v.decode("utf-8", "replace"),)

@@ -224,3 +224,31 @@ def test_sketches_sharded_equal_single_device(request):
     sharded_cms_update(c1, mesh, b.batch, b.lengths)
     c2.update(b.batch, b.lengths)
     assert np.array_equal(np.asarray(c1.table), np.asarray(c2.table))
+
+
+def test_flush_interval_timer_emits_pending():
+    """With flush_interval configured, updates arriving inside the
+    throttle window are emitted by the timer even when no further
+    records arrive."""
+    import time
+
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="logs")
+    ctx.filter("log_to_metrics", match="logs", metric_name="n",
+               metric_description="d", tag="metrics",
+               flush_interval_nsec=str(int(0.15e9)))
+    payloads = []
+    ctx.output("lib", match="metrics", callback=lambda d, t: payloads.append(d))
+    ctx.start()
+    try:
+        for _ in range(3):
+            ctx.push(in_ffd, json.dumps({"log": "x"}))
+        time.sleep(0.6)  # no filter() calls during this window
+    finally:
+        ctx.stop()
+    last = {}
+    for data in payloads:
+        for obj in Unpacker(data):
+            last = obj
+    m = find_metric(last, "log_metric_n")
+    assert m is not None and m["values"][0]["value"] == 3
