@@ -240,9 +240,13 @@ class Engine:
             if self._pending_flushes:
                 await asyncio.gather(*self._pending_flushes, return_exceptions=True)
         finally:
+            pending = []
             for ins in self.inputs:
                 if ins.collector_task is not None:
                     ins.collector_task.cancel()
+                    pending.append(ins.collector_task)
+            if pending:  # let cancellations run their cleanup (finally:)
+                await asyncio.gather(*pending, return_exceptions=True)
             self._started.clear()
 
     async def _collector(self, ins: InputInstance) -> None:

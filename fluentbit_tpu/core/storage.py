@@ -164,12 +164,20 @@ class Storage:
         if state == STATE_FINAL and self.checksum and crc:
             if zlib.crc32(payload) & 0xFFFFFFFF != crc:
                 raise ValueError("crc mismatch")
-        from ..codec.events import count_records
+        from ..codec.msgpack import Unpacker
 
+        # a crash mid-write can leave a partial trailing event in an
+        # un-finalized file: truncate at the last complete boundary so
+        # raw-passthrough outputs never transmit a corrupt fragment
+        u = Unpacker(payload)
+        records = 0
+        for _ in u:
+            records += 1
+        payload = payload[: u.tell()]
         chunk = Chunk(tag, _TYPE_NAMES.get(tcode, EVENT_TYPE_LOGS),
                       os.path.basename(os.path.dirname(path)))
         chunk.buf = bytearray(payload)
-        chunk.records = count_records(payload)
+        chunk.records = records
         chunk.locked = True
         return chunk
 

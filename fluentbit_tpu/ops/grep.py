@@ -192,10 +192,12 @@ class GrepProgram:
             lengths = np.concatenate(
                 [lengths, np.full((R, Bp - B), -1, dtype=lengths.dtype)], axis=1
             )
-        fn = self._sharded_cache.get(id(mesh))
+        key = (tuple(mesh.axis_names),
+               tuple(d.id for d in mesh.devices.flat))
+        fn = self._sharded_cache.get(key)
         if fn is None:
             fn = self.sharded_matcher(mesh, axis=mesh.axis_names[0])
-            self._sharded_cache[id(mesh)] = fn
+            self._sharded_cache[key] = fn
         mask, counts = fn(jnp.asarray(batch), jnp.asarray(lengths))
         return np.asarray(mask)[:, :B], np.asarray(counts), Bp
 

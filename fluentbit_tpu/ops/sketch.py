@@ -232,6 +232,14 @@ def _mix_np(h: np.uint32) -> np.uint32:
 
 # -- multi-device (SPMD) sketch update: batch sharded, state merged --
 
+def _mesh_key(mesh) -> tuple:
+    """Structural cache key: equal meshes (same axes + devices) share a
+    compiled step; keying by id(mesh) would miss every freshly
+    constructed-but-identical Mesh and pin dead meshes forever."""
+    return (tuple(mesh.axis_names),
+            tuple(d.id for d in mesh.devices.flat))
+
+
 def _pad_to_mesh(mesh, batch, lengths):
     n_dev = mesh.devices.size
     B = batch.shape[0]
@@ -260,7 +268,7 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
     cache = getattr(hll, "_sharded_cache", None)
     if cache is None:
         cache = hll._sharded_cache = {}
-    fn = cache.get(id(mesh))
+    fn = cache.get(_mesh_key(mesh))
     if fn is None:
         def step(regs, b, ln):
             local = hll._update_impl(regs, b, ln)
@@ -271,7 +279,7 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
             in_specs=(P(), P(axis, None), P(axis)),
             out_specs=P(),
         ))
-        cache[id(mesh)] = fn
+        cache[_mesh_key(mesh)] = fn
     hll.registers = fn(hll.registers, jnp.asarray(batch), jnp.asarray(lengths))
 
 
@@ -287,7 +295,7 @@ def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
     cache = getattr(cms, "_sharded_cache", None)
     if cache is None:
         cache = cms._sharded_cache = {}
-    fn = cache.get(id(mesh))
+    fn = cache.get(_mesh_key(mesh))
     if fn is None:
         def step(table, b, ln, w):
             # + 0*sum(w): ties the accumulator to the sharded batch so
@@ -301,6 +309,6 @@ def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
             in_specs=(P(), P(axis, None), P(axis), P(axis)),
             out_specs=P(),
         ))
-        cache[id(mesh)] = fn
+        cache[_mesh_key(mesh)] = fn
     cms.table = fn(cms.table, jnp.asarray(batch), jnp.asarray(lengths),
                    jnp.asarray(weights))

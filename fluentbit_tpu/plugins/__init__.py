@@ -4,6 +4,11 @@ all on)."""
 
 from . import inputs_basic  # noqa: F401
 from . import in_emitter  # noqa: F401
+from . import in_tail  # noqa: F401
+from . import in_syslog  # noqa: F401
+from . import net_tcp_udp  # noqa: F401
+from . import net_http  # noqa: F401
+from . import net_forward  # noqa: F401
 from . import outputs_basic  # noqa: F401
 from . import filter_grep  # noqa: F401
 from . import filter_parser  # noqa: F401

@@ -1,0 +1,168 @@
+"""in_syslog — syslog server (rfc3164 / rfc5424 over udp/tcp/unix).
+
+Reference: plugins/in_syslog (syslog.c, syslog_conn.c, syslog_server.c):
+modes udp/tcp/unix_udp/unix_tcp, messages parsed by a named parser
+(default the rfc3164 pattern from conf/parsers.conf). TCP messages are
+newline-framed (octet-counted framing is not implemented — documented
+gap, matching the reference's default behavior).
+
+The two standard syslog parsers are registered on demand as built-ins
+when the engine has no parser of that name (own regexes for the
+well-known RFC formats).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+log = logging.getLogger("flb.syslog")
+
+RFC3164_REGEX = (
+    r"^\<(?<pri>[0-9]+)\>(?<time>[A-Z][a-z][a-z] +\d+ \d+:\d+:\d+) "
+    r"(?<host>[^ ]*) (?<ident>[a-zA-Z0-9_/.\-]*)"
+    r"(?:\[(?<pid>[0-9]+)\])?[^:]*: *(?<message>.*)$"
+)
+RFC5424_REGEX = (
+    r"^\<(?<pri>[0-9]{1,5})\>1 (?<time>[^ ]+) (?<host>[^ ]+) "
+    r"(?<ident>[^ ]+) (?<pid>[-0-9]+) (?<msgid>[^ ]+) "
+    r"(?<extradata>\[.*\]|-) (?<message>.+)$"
+)
+
+
+def ensure_syslog_parsers(engine) -> None:
+    """Register the built-in rfc3164/rfc5424 parsers if absent."""
+    if "syslog-rfc3164" not in engine.parsers:
+        engine.parser("syslog-rfc3164", Format="regex", Regex=RFC3164_REGEX,
+                      Time_Key="time", Time_Format="%b %d %H:%M:%S",
+                      Time_Keep="true")
+    if "syslog-rfc5424" not in engine.parsers:
+        engine.parser("syslog-rfc5424", Format="regex", Regex=RFC5424_REGEX,
+                      Time_Key="time",
+                      Time_Format="%Y-%m-%dT%H:%M:%S.%L%z",
+                      Time_Keep="true")
+
+
+@registry.register
+class SyslogInput(InputPlugin):
+    name = "syslog"
+    description = "syslog server (rfc3164/rfc5424)"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("mode", "str", default="unix_udp"),
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=5140),
+        ConfigMapEntry("path", "str"),
+        ConfigMapEntry("parser", "str", default="syslog-rfc3164"),
+        ConfigMapEntry("unix_perm", "str"),
+        ConfigMapEntry("raw_message_key", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.bound_port: Optional[int] = None
+        if engine is not None:
+            ensure_syslog_parsers(engine)
+            self._parser = engine.parsers.get(self.parser)
+            if self._parser is None:
+                raise ValueError(f"syslog: unknown parser {self.parser!r}")
+
+    def _emit(self, engine, payload: bytes) -> None:
+        out = bytearray()
+        n = 0
+        for raw in payload.split(b"\n"):
+            line = raw.rstrip(b"\r").decode("utf-8", "replace")
+            if not line:
+                continue
+            got = self._parser.do(line)
+            if got is None:
+                log.debug("syslog: unparseable message dropped")
+                continue
+            body, ts = got
+            if self.raw_message_key:
+                body[self.raw_message_key] = line
+            out += encode_event(body, ts if ts not in (None, 0)
+                                else now_event_time())
+            n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+
+    async def start_server(self, engine) -> None:
+        mode = (self.mode or "unix_udp").lower()
+        plugin = self
+        if mode in ("udp", "unix_udp"):
+            class Proto(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    plugin._emit(engine, data)
+
+            loop = asyncio.get_running_loop()
+            if mode == "udp":
+                transport, _ = await loop.create_datagram_endpoint(
+                    Proto, local_addr=(self.listen, self.port)
+                )
+                self.bound_port = transport.get_extra_info("sockname")[1]
+            else:
+                import socket as _socket
+
+                self._unlink_stale()
+                sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
+                sock.bind(self.path)
+                sock.setblocking(False)
+                self._apply_perm()
+                transport, _ = await loop.create_datagram_endpoint(
+                    Proto, sock=sock
+                )
+            try:
+                await asyncio.Event().wait()
+            finally:
+                transport.close()
+            return
+
+        async def handle(reader, writer):
+            pending = b""
+            try:
+                while True:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    pending += data
+                    if b"\n" in pending:
+                        head, _, pending = pending.rpartition(b"\n")
+                        self._emit(engine, head)
+            finally:
+                if pending.strip():
+                    self._emit(engine, pending)
+                writer.close()
+
+        if mode == "tcp":
+            server = await asyncio.start_server(handle, self.listen, self.port)
+            self.bound_port = server.sockets[0].getsockname()[1]
+        else:  # unix_tcp
+            self._unlink_stale()
+            server = await asyncio.start_unix_server(handle, path=self.path)
+            self._apply_perm()
+        async with server:
+            await server.serve_forever()
+
+    def _unlink_stale(self) -> None:
+        """A previous run's socket file blocks bind (EADDRINUSE)."""
+        import os
+
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def _apply_perm(self) -> None:
+        if self.unix_perm:
+            import os
+
+            try:
+                os.chmod(self.path, int(str(self.unix_perm), 8))
+            except (OSError, ValueError):
+                log.warning("syslog: cannot apply unix_perm %r", self.unix_perm)

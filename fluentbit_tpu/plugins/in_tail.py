@@ -1,0 +1,250 @@
+"""in_tail — follow files, emit lines as log records.
+
+Reference: plugins/in_tail (tail.c, tail_file.c line processing,
+tail_scan_glob.c path scanning, tail_db.c sqlite offset persistence,
+rotation via inode tracking in tail_fs_inotify.c/tail_fs_stat.c). This
+implementation polls (stat-based; the reference also falls back to stat
+mode when inotify is unavailable):
+
+- ``path``: comma-separated globs, re-scanned every ``refresh_interval``
+- per-file offset + inode tracking; rotation = inode change under the
+  same name (old fd drained to EOF first), truncation = size < offset
+- ``db``: sqlite file persisting (path, inode, offset) across restarts
+  (tail_db.c semantics)
+- ``parser``: run each line through a named parser (structured fields +
+  time); otherwise records are ``{key: line}``
+- ``tag``: a ``*`` in the tag expands to the file path with separators
+  mapped to dots (the reference's tag expansion)
+- ``skip_long_lines``: lines above ``buffer_max_size`` are dropped with
+  a warning instead of blocking the file
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import logging
+import os
+import sqlite3
+from typing import Dict, List, Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry, parse_size
+from ..core.plugin import InputPlugin, registry
+
+log = logging.getLogger("flb.tail")
+
+
+class _TailFile:
+    __slots__ = ("path", "fd", "inode", "offset", "pending", "skipping")
+
+    def __init__(self, path: str, inode: int, offset: int = 0):
+        self.path = path
+        self.fd = None
+        self.inode = inode
+        self.offset = offset
+        self.pending = b""
+        self.skipping = False  # discarding an oversized line's remainder
+
+
+@registry.register
+class TailInput(InputPlugin):
+    name = "tail"
+    description = "follow files and emit appended lines"
+    collect_interval = 0.25
+    config_map = [
+        ConfigMapEntry("path", "clist"),
+        ConfigMapEntry("exclude_path", "clist"),
+        ConfigMapEntry("path_key", "str"),
+        ConfigMapEntry("key", "str", default="log"),
+        ConfigMapEntry("refresh_interval", "time", default="60"),
+        ConfigMapEntry("read_from_head", "bool", default=False),
+        ConfigMapEntry("parser", "str"),
+        ConfigMapEntry("db", "str"),
+        ConfigMapEntry("db.sync", "str", default="normal"),
+        ConfigMapEntry("buffer_max_size", "str", default="32k"),
+        ConfigMapEntry("skip_long_lines", "bool", default=False),
+        ConfigMapEntry("rotate_wait", "time", default="5"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.path:
+            raise ValueError("tail: path is required")
+        self._engine = engine
+        self._files: Dict[str, _TailFile] = {}
+        self._since_scan = float("inf")  # force a scan on first collect
+        self._max_line = parse_size(self.buffer_max_size)
+        self._parser = None
+        if self.parser:
+            self._parser = (engine.parsers if engine else {}).get(self.parser)
+            if self._parser is None:
+                raise ValueError(f"tail: unknown parser {self.parser!r}")
+        self._db = None
+        if self.db:
+            self._db = sqlite3.connect(self.db, check_same_thread=False)
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS in_tail_files ("
+                "path TEXT PRIMARY KEY, inode INTEGER, offset INTEGER)"
+            )
+            self._db.commit()
+
+    def exit(self) -> None:
+        for tf in self._files.values():
+            if tf.fd is not None:
+                try:
+                    tf.fd.close()
+                except OSError:
+                    pass
+        if self._db is not None:
+            self._db.close()
+
+    # -- scanning --
+
+    def _scan(self) -> None:
+        excluded = set()
+        for pat in self.exclude_path or []:
+            excluded.update(_glob.glob(pat))
+        for pat in self.path:
+            for path in sorted(_glob.glob(pat)):
+                if path in excluded or path in self._files:
+                    continue
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                offset = 0 if self.read_from_head else st.st_size
+                inode = st.st_ino
+                if self._db is not None:
+                    row = self._db.execute(
+                        "SELECT inode, offset FROM in_tail_files WHERE path=?",
+                        (path,),
+                    ).fetchone()
+                    if row is not None and row[0] == inode:
+                        offset = min(row[1], st.st_size)
+                    elif row is not None:
+                        offset = 0  # rotated while we were away
+                self._files[path] = _TailFile(path, inode, offset)
+
+    def _persist(self, tf: _TailFile) -> None:
+        if self._db is not None:
+            self._db.execute(
+                "INSERT INTO in_tail_files (path, inode, offset) "
+                "VALUES (?, ?, ?) ON CONFLICT(path) DO UPDATE SET "
+                "inode=excluded.inode, offset=excluded.offset",
+                (tf.path, tf.inode, tf.offset),
+            )
+            self._db.commit()
+
+    # -- reading --
+
+    def collect(self, engine) -> None:
+        self._since_scan += self.collect_interval
+        if self._since_scan >= self.refresh_interval:
+            self._scan()
+            self._since_scan = 0.0
+        for tf in list(self._files.values()):
+            self._read_file(tf, engine)
+
+    def _read_file(self, tf: _TailFile, engine) -> None:
+        try:
+            st = os.stat(tf.path)
+        except OSError:
+            st = None  # deleted; drain the open fd below then drop
+        if tf.fd is None:
+            try:
+                tf.fd = open(tf.path, "rb")
+                tf.fd.seek(tf.offset)
+            except OSError:
+                self._files.pop(tf.path, None)
+                return
+        # truncation: file shrank under the same inode
+        if st is not None and st.st_ino == tf.inode and st.st_size < tf.offset:
+            tf.fd.seek(0)
+            tf.offset = 0
+            tf.pending = b""
+        self._drain_fd(tf, engine)
+        # rotation: name now points at a different inode — finish the old
+        # file (drained above), then follow the new one from offset 0
+        if st is not None and st.st_ino != tf.inode:
+            try:
+                tf.fd.close()
+            except OSError:
+                pass
+            tf.fd = None
+            tf.inode = st.st_ino
+            tf.offset = 0
+            tf.pending = b""
+            self._drain_fd(tf, engine, reopen=True)
+        elif st is None:
+            try:
+                tf.fd.close()
+            except OSError:
+                pass
+            self._files.pop(tf.path, None)
+        self._persist(tf)
+
+    def _drain_fd(self, tf: _TailFile, engine, reopen: bool = False) -> None:
+        if reopen:
+            try:
+                tf.fd = open(tf.path, "rb")
+            except OSError:
+                return
+        while True:
+            chunk = tf.fd.read(65536)
+            if not chunk:
+                break
+            tf.offset += len(chunk)
+            if tf.skipping:
+                # discard up to (and including) the oversized line's
+                # terminating newline so its tail never becomes a record
+                nl = chunk.find(b"\n")
+                if nl < 0:
+                    continue
+                chunk = chunk[nl + 1 :]
+                tf.skipping = False
+            data = tf.pending + chunk
+            lines = data.split(b"\n")
+            tf.pending = lines.pop()
+            if len(tf.pending) > self._max_line:
+                if self.skip_long_lines:
+                    log.warning("tail: dropping long line in %s", tf.path)
+                    tf.pending = b""
+                    tf.skipping = True
+                # else: keep buffering (reference blocks the file; we
+                # keep growing the pending buffer)
+            if lines:
+                self._emit_lines(tf, lines, engine)
+
+    def _emit_lines(self, tf: _TailFile, lines: List[bytes], engine) -> None:
+        tag = self._tag_for(tf.path)
+        out = bytearray()
+        n = 0
+        for raw in lines:
+            line = raw.rstrip(b"\r").decode("utf-8", "replace")
+            if not line:
+                continue
+            if len(line) > self._max_line and self.skip_long_lines:
+                log.warning("tail: dropping long line in %s", tf.path)
+                continue
+            body = None
+            ts = None
+            if self._parser is not None:
+                got = self._parser.do(line)
+                if got is not None:
+                    body, ts = got
+            if body is None:
+                body = {self.key or "log": line}
+            if self.path_key:
+                body[self.path_key] = tf.path
+            out += encode_event(
+                body, ts if ts not in (None, 0) else now_event_time()
+            )
+            n += 1
+        if n:
+            engine.input_log_append(self.instance, tag, bytes(out), n)
+
+    def _tag_for(self, path: str) -> str:
+        tag = self.instance.tag or "tail.0"
+        if "*" in tag:
+            expanded = path.lstrip("/").replace("/", ".")
+            tag = tag.replace("*", expanded)
+        return tag

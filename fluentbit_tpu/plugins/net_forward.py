@@ -1,0 +1,274 @@
+"""Fluentd Forward protocol — in_forward server + out_forward client.
+
+Reference: plugins/in_forward (Fluentd protocol server, fw_prot.c) and
+plugins/out_forward (forward.c: msgpack over TCP, modes Message /
+Forward / PackedForward, ack via the ``chunk`` option, shared-key
+HELO/PING/PONG handshake :259-340). The protocol is the reference's
+"cluster fabric" (SURVEY §5): agent→aggregator fan-in/out over DCN.
+
+Wire formats accepted by the server:
+- Message:        ``[tag, time, record, option?]``
+- Forward:        ``[tag, [[time, record], ...], option?]``
+- PackedForward:  ``[tag, bin(msgpack stream of [time, record]), option?]``
+  (CompressedPackedForward when option.compressed == "gzip")
+When ``option.chunk`` is present the server replies ``{"ack": chunk}``
+(at-least-once). The client sends PackedForward, optionally gzip'd,
+with ``require_ack_response`` waiting for the matching ack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import hashlib
+import logging
+import os
+import socket
+from typing import Optional
+
+from ..codec.events import encode_event
+from ..codec.msgpack import EventTime, OutOfData, Unpacker, packb
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+
+log = logging.getLogger("flb.forward")
+
+
+def _entries_to_events(entries) -> tuple:
+    """Forward entries [[time, record], ...] → (encoded V2 buffer, n)."""
+    out = bytearray()
+    n = 0
+    for entry in entries:
+        if not isinstance(entry, (list, tuple)) or len(entry) < 2:
+            continue
+        ts, record = entry[0], entry[1]
+        if not isinstance(record, dict):
+            continue
+        out += encode_event(record, ts)
+        n += 1
+    return bytes(out), n
+
+
+@registry.register
+class ForwardInput(InputPlugin):
+    name = "forward"
+    description = "Fluentd Forward protocol server"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=24224),
+        ConfigMapEntry("shared_key", "str"),
+        ConfigMapEntry("self_hostname", "str", default="fluentbit-tpu"),
+        ConfigMapEntry("tag_prefix", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.bound_port: Optional[int] = None
+
+    async def start_server(self, engine) -> None:
+        async def handle(reader, writer):
+            try:
+                await self._handle_conn(reader, writer, engine)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            except Exception:
+                log.exception("in_forward connection failed")
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        server = await asyncio.start_server(handle, self.listen, self.port)
+        self.bound_port = server.sockets[0].getsockname()[1]
+        async with server:
+            await server.serve_forever()
+
+    async def _handle_conn(self, reader, writer, engine) -> None:
+        nonce = b""
+        if self.shared_key:
+            # HELO/PING/PONG handshake (forward.c:259-340 client side)
+            nonce = os.urandom(16)
+            writer.write(packb(["HELO", {"nonce": nonce, "auth": b"",
+                                         "keepalive": True}]))
+            await writer.drain()
+        u = Unpacker()
+        authed = not self.shared_key
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                return
+            u.feed(data)
+            for msg in u:
+                if not isinstance(msg, (list, tuple)) or not msg:
+                    continue
+                if not authed:
+                    authed = self._check_ping(msg, nonce, writer)
+                    if not authed:
+                        return
+                    await writer.drain()
+                    continue
+                await self._dispatch(msg, writer, engine)
+
+    def _check_ping(self, msg, nonce: bytes, writer) -> bool:
+        if msg[0] != "PING" or len(msg) < 6:
+            return False
+        _, hostname, salt, digest = msg[0], msg[1], msg[2], msg[3]
+        salt = salt if isinstance(salt, bytes) else str(salt).encode()
+        want = hashlib.sha512(
+            salt + str(hostname).encode() + nonce + self.shared_key.encode()
+        ).hexdigest()
+        ok = digest == want
+        shared_key_digest = hashlib.sha512(
+            salt + self.self_hostname.encode() + nonce
+            + self.shared_key.encode()
+        ).hexdigest()
+        writer.write(packb(["PONG", ok, "" if ok else "shared_key mismatch",
+                            self.self_hostname, shared_key_digest]))
+        return ok
+
+    async def _dispatch(self, msg, writer, engine) -> None:
+        tag = msg[0]
+        if not isinstance(tag, str):
+            return
+        if self.tag_prefix:
+            tag = f"{self.tag_prefix}.{tag}"
+        option = None
+        if isinstance(msg[1], (bytes, memoryview)):
+            # PackedForward / CompressedPackedForward
+            option = msg[2] if len(msg) > 2 and isinstance(msg[2], dict) else None
+            blob = bytes(msg[1])
+            if option and option.get("compressed") == "gzip":
+                blob = gzip.decompress(blob)
+            entries = list(Unpacker(blob))
+            buf, n = _entries_to_events(entries)
+        elif isinstance(msg[1], (list, tuple)):
+            # Forward mode
+            option = msg[2] if len(msg) > 2 and isinstance(msg[2], dict) else None
+            buf, n = _entries_to_events(msg[1])
+        else:
+            # Message mode [tag, time, record, option?]
+            if len(msg) < 3 or not isinstance(msg[2], dict):
+                return
+            option = msg[3] if len(msg) > 3 and isinstance(msg[3], dict) else None
+            buf, n = _entries_to_events([[msg[1], msg[2]]])
+        if n:
+            engine.input_log_append(self.instance, tag, buf, n)
+        chunk_id = option.get("chunk") if option else None
+        if chunk_id is not None:
+            writer.write(packb({"ack": chunk_id}))
+            await writer.drain()
+
+
+@registry.register
+class ForwardOutput(OutputPlugin):
+    name = "forward"
+    description = "Fluentd Forward protocol client"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=24224),
+        ConfigMapEntry("shared_key", "str"),
+        ConfigMapEntry("self_hostname", "str"),
+        ConfigMapEntry("require_ack_response", "bool", default=False),
+        ConfigMapEntry("compress", "str"),
+        ConfigMapEntry("time_as_integer", "bool", default=False),
+        ConfigMapEntry("ack_timeout", "time", default="10"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._reader = None
+        self._writer = None
+        # one connection per output instance: concurrent flush coroutines
+        # must not interleave writes or steal each other's acks
+        self._lock = asyncio.Lock()
+
+    async def _connect(self):
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        if self.shared_key:
+            await self._handshake()
+
+    async def _handshake(self) -> None:
+        u = Unpacker()
+        helo = await self._read_msg(u)
+        if not (isinstance(helo, list) and helo and helo[0] == "HELO"):
+            raise ConnectionError("forward: expected HELO")
+        nonce = helo[1].get("nonce", b"")
+        nonce = nonce if isinstance(nonce, bytes) else str(nonce).encode()
+        hostname = self.self_hostname or socket.gethostname()
+        salt = os.urandom(16)
+        digest = hashlib.sha512(
+            salt + hostname.encode() + nonce + self.shared_key.encode()
+        ).hexdigest()
+        self._writer.write(packb(["PING", hostname, salt, digest, "", ""]))
+        await self._writer.drain()
+        pong = await self._read_msg(u)
+        if not (isinstance(pong, list) and len(pong) >= 2 and pong[0] == "PONG"
+                and pong[1]):
+            raise ConnectionError("forward: handshake rejected")
+
+    async def _read_msg(self, u: Unpacker):
+        while True:
+            try:
+                return u.unpack()
+            except OutOfData:
+                data = await self._reader.read(65536)
+                if not data:
+                    raise ConnectionError("forward: peer closed")
+                u.feed(data)
+
+    def _packed_entries(self, data: bytes) -> tuple:
+        """V2 events buffer → forward-format entry stream + count."""
+        from ..codec.events import iter_events
+
+        out = bytearray()
+        n = 0
+        for ev in iter_events(data):
+            ts = ev.timestamp
+            if self.time_as_integer:
+                ts = int(ev.ts_float)
+            elif isinstance(ts, float):
+                ts = EventTime.from_float(ts)
+            out += packb([ts, ev.body])
+            n += 1
+        return bytes(out), n
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        async with self._lock:
+            return await self._flush_locked(data, tag)
+
+    async def _flush_locked(self, data: bytes, tag: str) -> FlushResult:
+        try:
+            await self._connect()
+            blob, n = self._packed_entries(data)
+            if n == 0:
+                return FlushResult.OK
+            option = {"size": n, "fluent_signal": 1}
+            if (self.compress or "").lower() == "gzip":
+                blob = gzip.compress(blob)
+                option["compressed"] = "gzip"
+            chunk_id = None
+            if self.require_ack_response:
+                chunk_id = os.urandom(16).hex()
+                option["chunk"] = chunk_id
+            self._writer.write(packb([tag, blob, option]))
+            await self._writer.drain()
+            if chunk_id is not None:
+                u = Unpacker()
+                try:
+                    ack = await asyncio.wait_for(
+                        self._read_msg(u), timeout=self.ack_timeout
+                    )
+                except asyncio.TimeoutError:
+                    self._writer = None
+                    return FlushResult.RETRY
+                if not (isinstance(ack, dict) and ack.get("ack") == chunk_id):
+                    self._writer = None
+                    return FlushResult.RETRY
+        except (ConnectionError, OSError):
+            self._writer = None
+            return FlushResult.RETRY
+        return FlushResult.OK

@@ -1,0 +1,226 @@
+"""HTTP input (server) + HTTP output (client).
+
+Reference: plugins/in_http (HTTP/1.1 JSON server: POST bodies as a map,
+an array of maps, or NDJSON; the URI path becomes the tag) and
+plugins/out_http (POST formatted records with configurable format and
+headers; 2xx = OK, retryable errors = FLB_RETRY). Minimal HTTP/1.1
+framing over asyncio streams — enough for loopback pipelines and tests;
+no TLS/HTTP2 (the reference uses openssl/nghttp2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from .outputs_basic import format_json_lines
+
+log = logging.getLogger("flb.http")
+
+
+async def read_http_request(reader) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+    """Parse one HTTP/1.1 request; returns (method, uri, headers, body)."""
+    line = await reader.readline()
+    if not line:
+        return None
+    try:
+        method, uri, _version = line.decode("latin-1").split(None, 2)
+    except ValueError:
+        return None
+    headers: Dict[str, str] = {}
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        if b":" in h:
+            k, v = h.decode("latin-1").split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    body = b""
+    n = int(headers.get("content-length", 0) or 0)
+    if n > 0:
+        body = await reader.readexactly(n)
+    elif headers.get("transfer-encoding", "").lower() == "chunked":
+        while True:
+            size_line = await reader.readline()
+            size = int(size_line.strip() or b"0", 16)
+            if size == 0:
+                await reader.readline()
+                break
+            body += await reader.readexactly(size)
+            await reader.readline()
+    return method, uri, headers, body
+
+
+def http_response(status: int, body: bytes = b"",
+                  content_type: str = "text/plain",
+                  extra_headers: Optional[Dict[str, str]] = None) -> bytes:
+    reason = {200: "OK", 201: "Created", 204: "No Content",
+              400: "Bad Request", 404: "Not Found",
+              500: "Internal Server Error"}.get(status, "OK")
+    head = [f"HTTP/1.1 {status} {reason}",
+            f"Content-Length: {len(body)}",
+            f"Content-Type: {content_type}"]
+    for k, v in (extra_headers or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
+
+
+def _parse_json_bodies(body: bytes) -> Optional[List[dict]]:
+    """in_http body handling: map | array of maps | NDJSON."""
+    text = body.decode("utf-8", "replace").strip()
+    if not text:
+        return []
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            return [obj]
+        if isinstance(obj, list) and all(isinstance(o, dict) for o in obj):
+            return obj
+        return None
+    except ValueError:
+        pass
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            o = json.loads(line)
+        except ValueError:
+            return None
+        if not isinstance(o, dict):
+            return None
+        out.append(o)
+    return out
+
+
+@registry.register
+class HttpInput(InputPlugin):
+    name = "http"
+    description = "HTTP server input (JSON/NDJSON bodies)"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=9880),
+        ConfigMapEntry("tag_key", "str"),
+        ConfigMapEntry("successful_response_code", "int", default=201),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.bound_port: Optional[int] = None
+
+    async def start_server(self, engine) -> None:
+        async def handle(reader, writer):
+            try:
+                while True:
+                    req = await read_http_request(reader)
+                    if req is None:
+                        break
+                    method, uri, headers, body = req
+                    if method != "POST":
+                        writer.write(http_response(400, b"POST only\n"))
+                        await writer.drain()
+                        continue
+                    bodies = _parse_json_bodies(body)
+                    if bodies is None:
+                        writer.write(http_response(400, b"bad body\n"))
+                        await writer.drain()
+                        continue
+                    uri_tag = uri.lstrip("/").split("?")[0].replace("/", ".") \
+                        or self.instance.tag
+                    # tag_key resolves PER RECORD: group by tag, one
+                    # append per group so mixed-tag bodies route right
+                    groups: Dict[str, bytearray] = {}
+                    counts: Dict[str, int] = {}
+                    for b in bodies:
+                        tag = uri_tag
+                        if self.tag_key and isinstance(b.get(self.tag_key), str):
+                            tag = b[self.tag_key]
+                        groups.setdefault(tag, bytearray())
+                        groups[tag] += encode_event(b, now_event_time())
+                        counts[tag] = counts.get(tag, 0) + 1
+                    for tag, buf in groups.items():
+                        engine.input_log_append(
+                            self.instance, tag, bytes(buf), counts[tag]
+                        )
+                    writer.write(http_response(
+                        self.successful_response_code or 201))
+                    await writer.drain()
+                    if headers.get("connection", "").lower() == "close":
+                        break
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        server = await asyncio.start_server(handle, self.listen, self.port)
+        self.bound_port = server.sockets[0].getsockname()[1]
+        async with server:
+            await server.serve_forever()
+
+
+@registry.register
+class HttpOutput(OutputPlugin):
+    name = "http"
+    description = "HTTP client output"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=80),
+        ConfigMapEntry("uri", "str", default="/"),
+        ConfigMapEntry("format", "str", default="json"),
+        ConfigMapEntry("json_date_key", "str", default="date"),
+        ConfigMapEntry("header", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("compress", "str"),
+    ]
+
+    def _payload(self, data: bytes) -> Tuple[bytes, str]:
+        fmt = (self.format or "json").lower()
+        if fmt == "msgpack":
+            return data, "application/msgpack"
+        text = format_json_lines(data, date_key=self.json_date_key or "date")
+        if fmt == "json":
+            return ("[" + text.replace("\n", ",") + "]").encode(), \
+                "application/json"
+        return (text + "\n").encode(), "application/x-ndjson"
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        body, ctype = self._payload(data)
+        headers = [f"POST {self.uri or '/'} HTTP/1.1",
+                   f"Host: {self.host}:{self.port}",
+                   f"Content-Length: {len(body)}",
+                   f"Content-Type: {ctype}"]
+        if (self.compress or "").lower() == "gzip":
+            import gzip as _gzip
+
+            body = _gzip.compress(body)
+            headers[2] = f"Content-Length: {len(body)}"
+            headers.append("Content-Encoding: gzip")
+        for pair in self.header or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) == 2:
+                headers.append(f"{parts[0]}: {parts[1]}")
+        try:
+            reader, writer = await asyncio.open_connection(self.host, self.port)
+            writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
+            await writer.drain()
+            status_line = await reader.readline()
+            writer.close()
+        except OSError:
+            return FlushResult.RETRY
+        try:
+            status = int(status_line.split()[1])
+        except (IndexError, ValueError):
+            return FlushResult.RETRY
+        if 200 <= status < 300:
+            return FlushResult.OK
+        if status >= 500 or status == 408 or status == 429:
+            return FlushResult.RETRY
+        return FlushResult.ERROR

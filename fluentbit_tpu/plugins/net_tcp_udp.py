@@ -1,0 +1,217 @@
+"""TCP/UDP inputs + outputs.
+
+Reference: plugins/in_tcp (newline-framed JSON or raw lines over a TCP
+listener), plugins/in_udp (same per datagram), plugins/out_tcp and
+plugins/out_udp (deliver formatted records to a remote socket). The
+reference's event-loop + coroutine I/O (src/flb_io.c) maps onto asyncio
+streams running on the engine loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from .outputs_basic import format_json_lines
+
+log = logging.getLogger("flb.net")
+
+
+def _json_body_records(line: str, key: str):
+    """A line → list of record bodies (format json: must be a map or an
+    array of maps; format none handled by caller)."""
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        return None
+    if isinstance(obj, dict):
+        return [obj]
+    if isinstance(obj, list) and all(isinstance(o, dict) for o in obj):
+        return obj
+    return None
+
+
+class _LineServerInput(InputPlugin):
+    """Shared line-framing logic for in_tcp / in_udp payloads."""
+
+    server_task_needed = True
+
+    def _emit_payload(self, engine, data: bytes) -> None:
+        fmt = (self.format or "json").lower()
+        out = bytearray()
+        n = 0
+        for raw in data.split(self.separator.encode() if self.separator else b"\n"):
+            if not raw.strip():
+                continue
+            line = raw.decode("utf-8", "replace")
+            if fmt == "none":
+                bodies = [{self.source_key or "log": line}]
+            else:
+                bodies = _json_body_records(line, self.source_key or "log")
+                if bodies is None:
+                    log.debug("%s: malformed JSON line dropped", self.name)
+                    continue
+            for body in bodies:
+                out += encode_event(body, now_event_time())
+                n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+
+
+@registry.register
+class TcpInput(_LineServerInput):
+    name = "tcp"
+    description = "TCP listener for JSON / raw lines"
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=5170),
+        ConfigMapEntry("format", "str", default="json"),
+        ConfigMapEntry("separator", "str"),
+        ConfigMapEntry("source_key", "str", default="log"),
+        ConfigMapEntry("chunk_size", "size", default="32k"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._server = None
+        self.bound_port: Optional[int] = None
+
+    async def start_server(self, engine) -> None:
+        async def handle(reader, writer):
+            pending = b""
+            try:
+                while True:
+                    data = await reader.read(int(self.chunk_size or 32768))
+                    if not data:
+                        break
+                    pending += data
+                    sep = (self.separator or "\n").encode()
+                    if sep in pending:
+                        head, _, pending = pending.rpartition(sep)
+                        self._emit_payload(engine, head)
+            finally:
+                if pending.strip():
+                    self._emit_payload(engine, pending)
+                writer.close()
+
+        self._server = await asyncio.start_server(
+            handle, self.listen, self.port
+        )
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+        async with self._server:
+            await self._server.serve_forever()
+
+
+@registry.register
+class UdpInput(_LineServerInput):
+    name = "udp"
+    description = "UDP listener for JSON / raw lines"
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=5170),
+        ConfigMapEntry("format", "str", default="json"),
+        ConfigMapEntry("separator", "str"),
+        ConfigMapEntry("source_key", "str", default="log"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.bound_port: Optional[int] = None
+
+    async def start_server(self, engine) -> None:
+        plugin = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                plugin._emit_payload(engine, data)
+
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(self.listen, self.port)
+        )
+        self.bound_port = transport.get_extra_info("sockname")[1]
+        try:
+            await asyncio.Event().wait()  # run until cancelled
+        finally:
+            transport.close()
+
+
+class _SocketOutput(OutputPlugin):
+    """Connection-reusing TCP client base (upstream pool of size 1 —
+    src/flb_upstream.c keepalive semantics)."""
+
+    def init(self, instance, engine) -> None:
+        self._writer = None
+
+    async def _connect(self):
+        if self._writer is not None and not self._writer.is_closing():
+            return self._writer
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self._reader = reader
+        self._writer = writer
+        return writer
+
+    def _format(self, data: bytes) -> bytes:
+        fmt = (self.format or "msgpack").lower()
+        if fmt == "msgpack":
+            return data
+        text = format_json_lines(data, date_key=self.json_date_key or "date")
+        if fmt == "json":
+            return ("[" + text.replace("\n", ",") + "]").encode()
+        return (text + "\n").encode()
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        try:
+            writer = await self._connect()
+            writer.write(self._format(data))
+            await writer.drain()
+        except OSError:
+            self._writer = None
+            return FlushResult.RETRY
+        return FlushResult.OK
+
+
+@registry.register
+class TcpOutput(_SocketOutput):
+    name = "tcp"
+    description = "deliver records over a TCP socket"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=5170),
+        ConfigMapEntry("format", "str", default="msgpack"),
+        ConfigMapEntry("json_date_key", "str", default="date"),
+    ]
+
+
+@registry.register
+class UdpOutput(OutputPlugin):
+    name = "udp"
+    description = "deliver records over UDP datagrams"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=5170),
+        ConfigMapEntry("format", "str", default="json_lines"),
+        ConfigMapEntry("json_date_key", "str", default="date"),
+    ]
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        import socket
+
+        fmt = (self.format or "json_lines").lower()
+        if fmt == "msgpack":
+            payloads = [data]
+        else:
+            text = format_json_lines(data, date_key=self.json_date_key or "date")
+            payloads = [(l + "\n").encode() for l in text.splitlines()]
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            for p in payloads:
+                s.sendto(p, (self.host, self.port))
+            s.close()
+        except OSError:
+            return FlushResult.RETRY
+        return FlushResult.OK

@@ -1,0 +1,339 @@
+"""Networking plugins: loopback runtime tests (the reference's
+tests/runtime/in_forward.c pattern — real sockets on localhost) plus
+in_tail file-following tests.
+"""
+
+import json
+import socket
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+
+
+def wait_for(cond, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError("condition not met")
+
+
+def collect_ctx(input_name, tag="t", **props):
+    """Start a ctx with one server input and a lib collector."""
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input(input_name, tag=tag, port="0", **props)
+    ins = ctx.engine.inputs[0]
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append((t, d)))
+    ctx.start()
+    port = wait_for(lambda: getattr(ins.plugin, "bound_port", None))
+    return ctx, port, got
+
+
+def events_of(got):
+    return [(t, e) for t, d in got for e in decode_events(d)]
+
+
+# ------------------------------------------------------------------ tcp/udp
+
+def test_in_tcp_json_lines():
+    ctx, port, got = collect_ctx("tcp")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(b'{"a": 1}\n{"a": 2}\n')
+        s.close()
+        wait_for(lambda: len(events_of(got)) >= 2)
+    finally:
+        ctx.stop()
+    evs = events_of(got)
+    assert [e.body for _, e in evs] == [{"a": 1}, {"a": 2}]
+
+
+def test_in_tcp_format_none():
+    ctx, port, got = collect_ctx("tcp", format="none")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(b"raw line one\nraw line two\n")
+        s.close()
+        wait_for(lambda: len(events_of(got)) >= 2)
+    finally:
+        ctx.stop()
+    assert events_of(got)[0][1].body == {"log": "raw line one"}
+
+
+def test_out_tcp_to_in_tcp_roundtrip():
+    ctx_srv, port, got = collect_ctx("tcp")
+    ctx_cli = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx_cli.input("lib", tag="cli")
+    ctx_cli.output("tcp", match="cli", host="127.0.0.1", port=str(port),
+                   format="json_lines")
+    ctx_cli.start()
+    try:
+        ctx_cli.push(in_ffd, json.dumps({"msg": "over tcp"}))
+        ctx_cli.flush_now()
+        wait_for(lambda: len(events_of(got)) >= 1)
+    finally:
+        ctx_cli.stop()
+        ctx_srv.stop()
+    (tag, ev), = events_of(got)
+    assert ev.body["msg"] == "over tcp"
+    assert "date" in ev.body  # json_lines carries the timestamp key
+
+
+def test_in_udp_datagram():
+    ctx, port, got = collect_ctx("udp")
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(b'{"u": 7}\n', ("127.0.0.1", port))
+        s.close()
+        wait_for(lambda: len(events_of(got)) >= 1)
+    finally:
+        ctx.stop()
+    assert events_of(got)[0][1].body == {"u": 7}
+
+
+# ------------------------------------------------------------------ forward
+
+def forward_pair(server_props=None, client_props=None):
+    ctx_srv, port, got = collect_ctx("forward", **(server_props or {}))
+    ctx_cli = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx_cli.input("lib", tag="fwd.test")
+    ctx_cli.output("forward", match="*", host="127.0.0.1", port=str(port),
+                   **(client_props or {}))
+    ctx_cli.start()
+    return ctx_srv, ctx_cli, in_ffd, got
+
+
+def test_forward_loopback_packedforward():
+    ctx_srv, ctx_cli, in_ffd, got = forward_pair()
+    try:
+        ctx_cli.push(in_ffd, json.dumps({"n": 1}))
+        ctx_cli.push(in_ffd, json.dumps({"n": 2}))
+        ctx_cli.flush_now()
+        wait_for(lambda: len(events_of(got)) >= 2)
+    finally:
+        ctx_cli.stop()
+        ctx_srv.stop()
+    evs = events_of(got)
+    assert [t for t, _ in evs] == ["fwd.test", "fwd.test"]  # tag preserved
+    assert [e.body["n"] for _, e in evs] == [1, 2]
+
+
+def test_forward_ack_and_gzip():
+    ctx_srv, ctx_cli, in_ffd, got = forward_pair(
+        client_props={"require_ack_response": "true", "compress": "gzip"})
+    try:
+        ctx_cli.push(in_ffd, json.dumps({"z": "ok"}))
+        ctx_cli.flush_now()
+        wait_for(lambda: len(events_of(got)) >= 1)
+    finally:
+        met = ctx_cli.metrics.to_prometheus()
+        ctx_cli.stop()
+        ctx_srv.stop()
+    assert events_of(got)[0][1].body == {"z": "ok"}
+    assert 'fluentbit_output_proc_records_total{name="forward.0"} 1' in met
+
+
+def test_forward_shared_key_handshake():
+    ctx_srv, ctx_cli, in_ffd, got = forward_pair(
+        server_props={"shared_key": "s3cret"},
+        client_props={"shared_key": "s3cret",
+                      "require_ack_response": "true"})
+    try:
+        ctx_cli.push(in_ffd, json.dumps({"auth": True}))
+        ctx_cli.flush_now()
+        wait_for(lambda: len(events_of(got)) >= 1)
+    finally:
+        ctx_cli.stop()
+        ctx_srv.stop()
+    assert events_of(got)[0][1].body == {"auth": True}
+
+
+def test_forward_wrong_shared_key_rejected():
+    ctx_srv, ctx_cli, in_ffd, got = forward_pair(
+        server_props={"shared_key": "right"},
+        client_props={"shared_key": "wrong"})
+    try:
+        ctx_cli.push(in_ffd, json.dumps({"x": 1}))
+        ctx_cli.flush_now()
+        time.sleep(0.5)
+        assert events_of(got) == []
+        met = ctx_cli.metrics.to_prometheus()
+        assert 'fluentbit_output_retries_total{name="forward.0"} 1' in met
+    finally:
+        ctx_cli.stop()
+        ctx_srv.stop()
+
+
+def test_forward_raw_message_and_forward_modes():
+    """Hand-built Message + Forward mode frames."""
+    from fluentbit_tpu.codec.msgpack import packb
+
+    ctx, port, got = collect_ctx("forward")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(packb(["app.a", 1000, {"mode": "message"}]))
+        s.sendall(packb(["app.b", [[1001, {"mode": "fwd1"}],
+                                   [1002, {"mode": "fwd2"}]]]))
+        s.close()
+        wait_for(lambda: len(events_of(got)) >= 3)
+    finally:
+        ctx.stop()
+    by_tag = {}
+    for t, e in events_of(got):
+        by_tag.setdefault(t, []).append(e)
+    assert by_tag["app.a"][0].body == {"mode": "message"}
+    assert [e.body["mode"] for e in by_tag["app.b"]] == ["fwd1", "fwd2"]
+
+
+# -------------------------------------------------------------------- http
+
+def test_in_http_post_and_out_http_roundtrip():
+    ctx, port, got = collect_ctx("http")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        body = b'{"h": 1}\n{"h": 2}\n'
+        s.sendall(b"POST /logs/app HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        resp = s.recv(4096)
+        s.close()
+        assert b"201" in resp.split(b"\r\n")[0]
+        wait_for(lambda: len(events_of(got)) >= 2)
+        evs = events_of(got)
+        assert evs[0][0] == "logs.app"  # uri path → tag
+        assert [e.body["h"] for _, e in evs] == [1, 2]
+
+        # out_http → in_http loopback
+        ctx_cli = flb.create(flush="50ms", grace="1")
+        in_ffd = ctx_cli.input("lib", tag="cli")
+        ctx_cli.output("http", match="cli", host="127.0.0.1",
+                       port=str(port), uri="/from/client", format="json")
+        ctx_cli.start()
+        try:
+            ctx_cli.push(in_ffd, json.dumps({"via": "http"}))
+            ctx_cli.flush_now()
+            wait_for(lambda: any(t == "from.client"
+                                 for t, _ in events_of(got)))
+        finally:
+            ctx_cli.stop()
+    finally:
+        ctx.stop()
+
+
+# ------------------------------------------------------------------ syslog
+
+def test_syslog_udp_rfc3164():
+    ctx, port, got = collect_ctx("syslog", mode="udp")
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(b"<34>Oct 11 22:14:15 myhost su[230]: failed for lonvick",
+                 ("127.0.0.1", port))
+        s.close()
+        wait_for(lambda: len(events_of(got)) >= 1)
+    finally:
+        ctx.stop()
+    body = events_of(got)[0][1].body
+    assert body["pri"] == "34"
+    assert body["host"] == "myhost"
+    assert body["ident"] == "su"
+    assert body["pid"] == "230"
+    assert body["message"] == "failed for lonvick"
+
+
+def test_syslog_tcp_rfc5424():
+    ctx, port, got = collect_ctx("syslog", mode="tcp",
+                                 parser="syslog-rfc5424")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(b"<165>1 2003-10-11T22:14:15.003Z host app 1234 ID47 - "
+                  b"an event\n")
+        s.close()
+        wait_for(lambda: len(events_of(got)) >= 1)
+    finally:
+        ctx.stop()
+    body = events_of(got)[0][1].body
+    assert body["ident"] == "app"
+    assert body["message"] == "an event"
+
+
+# -------------------------------------------------------------------- tail
+
+def test_tail_follows_and_rotates(tmp_path):
+    f = tmp_path / "app.log"
+    f.write_text("old line\n")  # present before start: skipped by default
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("tail", tag="t", path=str(tmp_path / "*.log"),
+              refresh_interval="0.1")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        wait_for(lambda: ctx.engine.inputs[0].plugin._files)
+        with open(f, "a") as fh:
+            fh.write("line 1\nline 2\n")
+        wait_for(lambda: sum(len(decode_events(d)) for d in got) >= 2)
+        # rotation: rename + recreate
+        f.rename(tmp_path / "app.log.1")
+        f.write_text("after rotate\n")
+        wait_for(lambda: sum(len(decode_events(d)) for d in got) >= 3)
+    finally:
+        ctx.stop()
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert logs == ["line 1", "line 2", "after rotate"]
+
+
+def test_tail_db_offsets_survive_restart(tmp_path):
+    f = tmp_path / "x.log"
+    db = str(tmp_path / "tail.db")
+    f.write_text("a\nb\n")
+
+    def run(expect):
+        ctx = flb.create(flush="50ms", grace="1")
+        ctx.input("tail", tag="t", path=str(f), db=db,
+                  read_from_head="true", refresh_interval="0.1")
+        got = []
+        ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+        ctx.start()
+        try:
+            wait_for(
+                lambda: sum(len(decode_events(d)) for d in got) >= expect,
+                timeout=3,
+            )
+        finally:
+            ctx.stop()
+        return [e.body["log"] for d in got for e in decode_events(d)]
+
+    assert run(2) == ["a", "b"]
+    with open(f, "a") as fh:
+        fh.write("c\n")
+    # restart: only the NEW line (offsets persisted in the db)
+    assert run(1) == ["c"]
+
+
+def test_tail_parser_and_tag_expansion(tmp_path):
+    f = tmp_path / "svc.log"
+    f.write_text("")
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.parser("kv", Format="logfmt")
+    ctx.input("tail", tag="app.*", path=str(f), parser="kv",
+              path_key="filepath", refresh_interval="0.1")
+    got = []
+    ctx.output("lib", match="app.*", callback=lambda d, t: got.append((t, d)))
+    ctx.start()
+    try:
+        wait_for(lambda: ctx.engine.inputs[0].plugin._files)
+        with open(f, "a") as fh:
+            fh.write("level=info msg=hello\n")
+        wait_for(lambda: got)
+    finally:
+        ctx.stop()
+    tag, data = got[0]
+    ev = decode_events(data)[0]
+    assert ev.body["level"] == "info"
+    assert ev.body["filepath"] == str(f)
+    assert tag.startswith("app.") and tag.endswith("svc.log")
