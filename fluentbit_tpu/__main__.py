@@ -1,0 +1,222 @@
+"""CLI — ``python -m fluentbit_tpu``.
+
+Reference: src/fluent-bit.c (long-option parsing :1038, signal handlers
+:704-716: SIGINT/SIGTERM graceful stop, SIGHUP hot reload). Argument
+order matters the same way: ``-p`` properties apply to the most recent
+``-i``/``-F``/``-o`` instance.
+
+Usage examples::
+
+    python -m fluentbit_tpu -i dummy -o stdout -f 1
+    python -m fluentbit_tpu -i tail -p path=/var/log/syslog -o null
+    python -m fluentbit_tpu -c pipeline.conf
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+__version__ = "0.2.0"
+
+USAGE = """\
+fluentbit_tpu — TPU-native telemetry pipeline
+
+Options:
+  -c, --config FILE     load a configuration file (classic INI or YAML)
+  -R, --parser FILE     load a parsers file
+  -i, --input NAME      add an input plugin instance
+  -F, --filter NAME     add a filter plugin instance
+  -o, --output NAME     add an output plugin instance
+  -p, --prop K=V        set a property on the last added instance
+  -t, --tag TAG         set the tag on the last added input
+  -m, --match PATTERN   set the match rule on the last filter/output
+  -f, --flush SECONDS   flush interval
+  -g, --grace SECONDS   shutdown grace period
+  -H, --http            enable the HTTP admin server
+  -P, --port PORT       HTTP admin server port (default 2020)
+  -D, --define K=V      set a config variable for ${K} interpolation
+  -v, --verbose         increase log verbosity (repeatable)
+  -q, --quiet           decrease log verbosity
+  --dry-run             validate configuration and exit
+  -V, --version         print version and exit
+  -h, --help            this message
+"""
+
+
+def build_context(argv):
+    import fluentbit_tpu as flb
+    from fluentbit_tpu.config_format import apply_to_context, load_config_file
+
+    ctx = flb.create()
+    env = {}
+    last = None  # (kind, ffd)
+    verbosity = 0
+    dry_run = False
+    config_path = None
+    i = 0
+
+    def need_arg(flag):
+        nonlocal i
+        i += 1
+        if i >= len(argv):
+            raise SystemExit(f"option {flag} requires an argument")
+        return argv[i]
+
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print(USAGE)
+            raise SystemExit(0)
+        elif a in ("-V", "--version"):
+            print(f"fluentbit_tpu v{__version__}")
+            raise SystemExit(0)
+        elif a in ("-c", "--config"):
+            config_path = need_arg(a)
+            cf = load_config_file(config_path, env=env)
+            apply_to_context(
+                ctx, cf, os.path.dirname(os.path.abspath(config_path))
+            )
+        elif a in ("-R", "--parser"):
+            path = need_arg(a)
+            from fluentbit_tpu.config_format import _apply_parsers
+
+            _apply_parsers(ctx, load_config_file(path, env=env))
+        elif a in ("-i", "--input"):
+            last = ("input", ctx.input(need_arg(a)))
+        elif a in ("-F", "--filter"):
+            last = ("filter", ctx.filter(need_arg(a)))
+        elif a in ("-o", "--output"):
+            last = ("output", ctx.output(need_arg(a)))
+        elif a in ("-p", "--prop"):
+            kv = need_arg(a)
+            if "=" not in kv or last is None:
+                raise SystemExit(f"bad -p usage: {kv!r}")
+            k, v = kv.split("=", 1)
+            ctx.set(last[1], **{k: v})
+        elif a in ("-t", "--tag"):
+            if last is None or last[0] != "input":
+                raise SystemExit("-t requires a preceding -i")
+            ctx.set(last[1], tag=need_arg(a))
+        elif a in ("-m", "--match"):
+            if last is None or last[0] == "input":
+                raise SystemExit("-m requires a preceding -F/-o")
+            ctx.set(last[1], match=need_arg(a))
+        elif a in ("-f", "--flush"):
+            ctx.service_set(flush=need_arg(a))
+        elif a in ("-g", "--grace"):
+            ctx.service_set(grace=need_arg(a))
+        elif a in ("-H", "--http"):
+            ctx.service_set(http_server="on")
+        elif a in ("-P", "--port"):
+            ctx.service_set(http_port=need_arg(a))
+        elif a in ("-D", "--define"):
+            kv = need_arg(a)
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+        elif a in ("-v", "--verbose"):
+            verbosity += 1
+        elif a in ("-q", "--quiet"):
+            verbosity -= 1
+        elif a == "--dry-run":
+            dry_run = True
+        else:
+            raise SystemExit(f"unknown option {a!r} (see --help)")
+        i += 1
+
+    return ctx, verbosity, dry_run, config_path, env
+
+
+def main(argv=None) -> int:
+    import logging
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(USAGE)
+        return 1
+    ctx, verbosity, dry_run, config_path, env = build_context(argv)
+    level = {-1: logging.ERROR, 0: logging.INFO, 1: logging.DEBUG}.get(
+        max(-1, min(1, verbosity)), logging.INFO
+    )
+    logging.basicConfig(
+        level=level, format="[%(asctime)s] [%(levelname)5s] %(message)s"
+    )
+    log = logging.getLogger("flb.cli")
+
+    if not ctx.engine.inputs or not ctx.engine.outputs:
+        log.error("configuration needs at least one input and one output")
+        return 1
+    if dry_run:
+        print("configuration test is successful")
+        return 0
+
+    stop_evt = threading.Event()
+    reload_req = threading.Event()
+
+    def reload_enabled() -> bool:
+        # reload is gated (reference: -Y / [SERVICE] Hot_Reload On)
+        return bool(config_path) and ctx.engine.service.hot_reload
+
+    def on_stop(signum, frame):
+        stop_evt.set()
+
+    def on_hup(signum, frame):
+        if reload_enabled():
+            reload_req.set()
+            stop_evt.set()
+        else:
+            log.warning("SIGHUP ignored (hot_reload off or no config file)")
+
+    signal.signal(signal.SIGINT, on_stop)
+    signal.signal(signal.SIGTERM, on_stop)
+    signal.signal(signal.SIGHUP, on_hup)
+
+    reloads = 0
+    while True:
+        if reload_enabled():
+            # POST /api/v2/reload triggers the same path as SIGHUP
+            def _http_reload():
+                reload_req.set()
+                stop_evt.set()
+
+            ctx.engine.reload_callback = _http_reload
+        ctx.engine.reload_count = reloads
+        ctx.start()
+        log.info("fluentbit_tpu v%s started (pid %d)", __version__, os.getpid())
+        while True:
+            while not stop_evt.is_set() and ctx.engine.running:
+                stop_evt.wait(0.2)
+            if not reload_req.is_set():
+                log.info("stopping (grace %ss)...", ctx.engine.service.grace)
+                ctx.stop()
+                return 0
+            # hot reload (flb_reload, src/flb_reload.c:461): validate the
+            # NEW configuration with the full original argv BEFORE the
+            # old pipeline is torn down — a broken edit must not kill a
+            # working service
+            log.info("reloading configuration %s", config_path)
+            reload_req.clear()
+            stop_evt.clear()
+            try:
+                new_ctx, *_ = build_context(argv)
+                ok = bool(new_ctx.engine.inputs and new_ctx.engine.outputs)
+            except (SystemExit, Exception) as e:  # noqa: BLE001
+                log.error("reload failed, keeping current pipeline: %s", e)
+                continue  # old engine still running
+            if not ok:
+                log.error("reload failed, keeping current pipeline: "
+                          "needs at least one input and one output")
+                continue
+            log.info("stopping old pipeline (grace %ss)...",
+                     ctx.engine.service.grace)
+            ctx.stop()
+            ctx = new_ctx
+            reloads += 1
+            break  # outer loop starts the new context
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,250 @@
+"""Config formats — classic INI + YAML → a unified section AST.
+
+Reference: src/config_format/flb_config_format.c (the unified flb_cf
+AST), flb_cf_fluentbit.c (classic mode: ``[SECTION]`` + ``Key Value``
+lines, ``@INCLUDE``/``@SET`` commands) and flb_cf_yaml.c (YAML with
+``service:``/``pipeline:`` trees, per-instance ``processors:``,
+includes). Environment interpolation (``${VAR}``, src/flb_env.c)
+applies to both.
+
+``load_config_file`` dispatches by extension (.yaml/.yml → YAML, else
+classic), returning a ``ConfigFile`` of ordered sections that
+``apply_to_context`` materializes onto an FLBContext.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+_ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+@dataclass
+class Section:
+    """One config section: name + ordered key/value properties."""
+
+    name: str  # lowercased: service|input|filter|output|parser|custom...
+    properties: List[Tuple[str, Any]] = field(default_factory=list)
+    # per-instance processor pipelines (YAML only)
+    processors: Dict[str, list] = field(default_factory=dict)
+
+    def get(self, key: str, default=None):
+        k = key.lower()
+        for pk, v in self.properties:
+            if pk.lower() == k:
+                return v
+        return default
+
+
+@dataclass
+class ConfigFile:
+    sections: List[Section] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)  # @SET variables
+
+
+def _interp(value: str, extra_env: Dict[str, str]) -> str:
+    """${VAR} interpolation (flb_env semantics: environment wins over
+    @SET definitions; unknown vars expand empty)."""
+
+    def sub(m):
+        name = m.group(1)
+        return os.environ.get(name, extra_env.get(name, ""))
+
+    return _ENV_RE.sub(sub, value)
+
+
+# ---------------------------------------------------------------- classic
+
+def parse_classic(text: str, base_dir: str = ".",
+                  env: Optional[Dict[str, str]] = None) -> ConfigFile:
+    """Classic fluent-bit INI mode (flb_cf_fluentbit.c)."""
+    cf = ConfigFile(env=dict(env or {}))
+    current: Optional[Section] = None
+    for raw_line in text.splitlines():
+        line = raw_line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("@"):
+            parts = line.split(None, 1)
+            cmd = parts[0].upper()
+            arg = parts[1].strip() if len(parts) > 1 else ""
+            if cmd == "@SET" and "=" in arg:
+                k, v = arg.split("=", 1)
+                cf.env[k.strip()] = v.strip()
+            elif cmd == "@INCLUDE":
+                pattern = arg if os.path.isabs(arg) else os.path.join(base_dir, arg)
+                for path in sorted(_glob.glob(pattern)):
+                    inc = load_config_file(path, env=cf.env)
+                    cf.sections.extend(inc.sections)
+                    cf.env.update(inc.env)
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            current = Section(line[1:-1].strip().lower())
+            cf.sections.append(current)
+            continue
+        if current is None:
+            raise ValueError(f"property outside any section: {line!r}")
+        parts = line.split(None, 1)
+        key = parts[0]
+        value = _interp(parts[1].strip() if len(parts) > 1 else "", cf.env)
+        current.properties.append((key, value))
+    return cf
+
+
+# ------------------------------------------------------------------- yaml
+
+def parse_yaml(text: str, base_dir: str = ".",
+               env: Optional[Dict[str, str]] = None) -> ConfigFile:
+    """YAML mode (flb_cf_yaml.c): ``service:``, ``pipeline: {inputs,
+    filters, outputs}``, ``parsers:``, ``includes:``, ``env:``,
+    per-instance ``processors:``."""
+    import yaml as _yaml
+
+    cf = ConfigFile(env=dict(env or {}))
+    doc = _yaml.safe_load(text) or {}
+    if not isinstance(doc, dict):
+        raise ValueError("YAML config root must be a mapping")
+
+    for k, v in (doc.get("env") or {}).items():
+        cf.env[str(k)] = str(v)
+
+    def interp_val(v):
+        return _interp(v, cf.env) if isinstance(v, str) else v
+
+    def section_from(name: str, body: dict) -> Section:
+        sec = Section(name)
+        for k, v in body.items():
+            if k == "processors" and isinstance(v, dict):
+                sec.processors = v
+                continue
+            if isinstance(v, list):
+                for item in v:
+                    sec.properties.append((str(k), interp_val(item)))
+            else:
+                sec.properties.append((str(k), interp_val(v)))
+        return sec
+
+    for inc in doc.get("includes") or []:
+        path = inc if os.path.isabs(inc) else os.path.join(base_dir, inc)
+        for p in sorted(_glob.glob(path)):
+            sub = load_config_file(p, env=cf.env)
+            cf.sections.extend(sub.sections)
+            cf.env.update(sub.env)
+
+    if isinstance(doc.get("service"), dict):
+        cf.sections.append(section_from("service", doc["service"]))
+
+    for psec in doc.get("parsers") or []:
+        cf.sections.append(section_from("parser", psec))
+
+    pipeline = doc.get("pipeline") or {}
+    for kind, sec_name in (("inputs", "input"), ("filters", "filter"),
+                           ("outputs", "output")):
+        for body in pipeline.get(kind) or []:
+            if isinstance(body, dict):
+                cf.sections.append(section_from(sec_name, body))
+    for body in doc.get("customs") or []:
+        cf.sections.append(section_from("custom", body))
+    return cf
+
+
+def load_config_file(path: str, env: Optional[Dict[str, str]] = None) -> ConfigFile:
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    base_dir = os.path.dirname(os.path.abspath(path))
+    if path.endswith((".yaml", ".yml")):
+        return parse_yaml(text, base_dir, env)
+    return parse_classic(text, base_dir, env)
+
+
+# -------------------------------------------------------------- apply
+
+#: SERVICE keys that name parser definition files
+_PARSER_FILE_KEYS = ("parsers_file", "parsers_files")
+
+
+def apply_to_context(ctx, cf: ConfigFile, base_dir: str = ".") -> None:
+    """Materialize a parsed config onto an FLBContext (the flb_cf →
+    flb_config translation the CLI performs)."""
+    # service first (flush/grace/storage affect everything else)
+    for sec in cf.sections:
+        if sec.name != "service":
+            continue
+        for key, value in sec.properties:
+            lk = key.lower()
+            if lk in _PARSER_FILE_KEYS:
+                path = value if os.path.isabs(value) \
+                    else os.path.join(base_dir, value)
+                pcf = load_config_file(path, env=cf.env)
+                _apply_parsers(ctx, pcf)
+            else:
+                ctx.service_set(**{lk: value})
+    _apply_parsers(ctx, cf)
+    for sec in cf.sections:
+        if sec.name in ("service", "parser", "multiline_parser"):
+            continue
+        if sec.name not in ("input", "filter", "output", "custom"):
+            raise ValueError(f"unknown config section [{sec.name}]")
+        props = list(sec.properties)
+        name = None
+        rest = []
+        for k, v in props:
+            if k.lower() == "name":
+                name = v
+            else:
+                rest.append((k, v))
+        if name is None:
+            raise ValueError(f"[{sec.name}] section without Name")
+        if sec.name == "input":
+            ffd = ctx.input(name)
+        elif sec.name == "filter":
+            ffd = ctx.filter(name)
+        elif sec.name == "output":
+            ffd = ctx.output(name)
+        else:
+            continue  # customs: accepted, none implemented yet
+        for k, v in rest:
+            ctx.set(ffd, **{k: v})
+        if sec.processors:
+            _apply_processors(ctx, ffd, sec.processors)
+
+
+def _apply_processors(ctx, ffd, processors: Dict[str, list]) -> None:
+    """YAML per-instance ``processors:`` → processor instances on the
+    input/output (flb_cf_yaml.c is the only format exposing these)."""
+    ins = ctx.engine.registry  # registry for creation
+    target = ctx._handles[ffd]
+    if not hasattr(target, "processors"):
+        raise ValueError(
+            f"processors are not supported on {target.kind} instances"
+        )
+    for signal_type, units in processors.items():
+        if signal_type not in ("logs", "metrics", "traces"):
+            raise ValueError(f"unknown processor signal {signal_type!r}")
+        for unit in units or []:
+            if not isinstance(unit, dict) or "name" not in unit:
+                raise ValueError(f"processor unit needs a name: {unit!r}")
+            proc = ins.create_processor(unit["name"])
+            for k, v in unit.items():
+                if k != "name":
+                    proc.set(k, v)
+            proc.configure()
+            proc.plugin.init(proc, ctx.engine)
+            target.processors.append(proc)
+
+
+def _apply_parsers(ctx, cf: ConfigFile) -> None:
+    for sec in cf.sections:
+        if sec.name != "parser":
+            continue
+        props = {k: v for k, v in sec.properties}
+        low = {k.lower(): v for k, v in props.items()}
+        name = low.pop("name", None)
+        if not name:
+            raise ValueError("[PARSER] section without Name")
+        props = {k: v for k, v in props.items() if k.lower() != "name"}
+        ctx.parser(name, **props)

@@ -82,6 +82,8 @@ class Engine:
         self._notification_subs: List = []
         self.started_at: float = 0.0
         self.reload_count = 0
+        self.admin_server = None
+        self.reload_callback = None  # wired by the CLI for /api/v2/reload
 
         self._init_metrics()
 
@@ -222,6 +224,15 @@ class Engine:
                 ins.collector_task = asyncio.ensure_future(self._collector(ins))
             elif getattr(plugin, "server_task_needed", False):
                 ins.collector_task = asyncio.ensure_future(plugin.start_server(self))
+        # admin HTTP server (flb_hs_create/start, src/flb_engine.c:1074)
+        admin_task = None
+        if self.service.http_server:
+            from .http_server import AdminServer
+
+            self.admin_server = AdminServer(
+                self, self.service.http_listen, self.service.http_port
+            )
+            admin_task = asyncio.ensure_future(self.admin_server.serve())
         self._started.set()
         flush_interval = max(0.02, self.service.flush)
         try:
@@ -245,6 +256,9 @@ class Engine:
                 if ins.collector_task is not None:
                     ins.collector_task.cancel()
                     pending.append(ins.collector_task)
+            if admin_task is not None:
+                admin_task.cancel()
+                pending.append(admin_task)
             if pending:  # let cancellations run their cleanup (finally:)
                 await asyncio.gather(*pending, return_exceptions=True)
             self._started.clear()

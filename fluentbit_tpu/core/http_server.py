@@ -1,0 +1,129 @@
+"""Admin HTTP server — health, metrics, uptime, reload.
+
+Reference: src/http_server (flb_hs.c + api/v1: health.c, metrics.c,
+uptime.c, plugins, storage; api/v2: reload.c). Runs on the engine's
+asyncio loop when ``[SERVICE] http_server on`` (started from
+flb_engine_start in the reference, src/flb_engine.c:1074-1080).
+
+Endpoints:
+  GET  /                       banner (name/version)
+  GET  /api/v1/health          liveness ("ok")
+  GET  /api/v1/metrics         internal metrics as JSON
+  GET  /api/v1/metrics/prometheus   Prometheus text exposition
+  GET  /api/v1/uptime          uptime seconds
+  GET  /api/v1/plugins         configured plugin instances
+  GET  /api/v1/storage         chunk storage overview
+  GET  /api/v2/reload          {"hot_reload_count": N}
+  POST /api/v2/reload          trigger hot reload (requires the host
+                               process to wire engine.reload_callback,
+                               e.g. the CLI's SIGHUP path)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from ..plugins.net_http import http_response, read_http_request
+
+log = logging.getLogger("flb.http_server")
+
+
+class AdminServer:
+    def __init__(self, engine, listen: str = "0.0.0.0", port: int = 2020):
+        self.engine = engine
+        self.listen = listen
+        self.port = port
+        self.bound_port: Optional[int] = None
+
+    async def serve(self) -> None:
+        try:
+            server = await asyncio.start_server(self._handle, self.listen,
+                                                self.port)
+        except OSError as e:
+            # surface bind failures immediately — a silent task death
+            # leaves health checks failing while the engine looks fine
+            log.error("admin server cannot listen on %s:%s: %s",
+                      self.listen, self.port, e)
+            return
+        self.bound_port = server.sockets[0].getsockname()[1]
+        async with server:
+            await server.serve_forever()
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            while True:
+                req = await read_http_request(reader)
+                if req is None:
+                    break
+                method, uri, headers, _body = req
+                status, body, ctype = self._route(method, uri.split("?")[0])
+                writer.write(http_response(status, body, ctype))
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _route(self, method: str, path: str):
+        e = self.engine
+        if path == "/":
+            return 200, json.dumps(
+                {"fluentbit_tpu": {"version": "0.2.0",
+                                   "edition": "tpu-native"}}
+            ).encode(), "application/json"
+        if path == "/api/v1/health":
+            return 200, b"ok\n", "text/plain"
+        if path == "/api/v1/metrics/prometheus":
+            return 200, e.metrics.to_prometheus().encode(), \
+                "text/plain; version=0.0.4"
+        if path == "/api/v1/metrics":
+            return 200, json.dumps(e.metrics.to_msgpack_obj(),
+                                   default=str).encode(), "application/json"
+        if path == "/api/v1/uptime":
+            up = time.time() - e.started_at if e.started_at else 0.0
+            return 200, json.dumps(
+                {"uptime_sec": int(up),
+                 "uptime_hr": f"up {int(up) // 86400}d {int(up) % 86400 // 3600}h"
+                              f" {int(up) % 3600 // 60}m {int(up) % 60}s"}
+            ).encode(), "application/json"
+        if path == "/api/v1/plugins":
+            return 200, json.dumps({
+                "inputs": [i.display_name for i in e.inputs],
+                "filters": [f.display_name for f in e.filters],
+                "outputs": [o.display_name for o in e.outputs],
+            }).encode(), "application/json"
+        if path == "/api/v1/storage":
+            layer = {"chunks": {
+                "total_chunks": sum(i.pool.pending_chunks for i in e.inputs),
+                "mem_chunks": sum(i.pool.pending_chunks for i in e.inputs
+                                  if i.storage_type == "memory"),
+                "fs_chunks": sum(i.pool.pending_chunks for i in e.inputs
+                                 if i.storage_type == "filesystem"),
+            }}
+            return 200, json.dumps({"storage_layer": layer}).encode(), \
+                "application/json"
+        if path == "/api/v2/reload":
+            if method == "POST":
+                cb = getattr(e, "reload_callback", None)
+                if cb is None:
+                    return 400, b'{"error": "hot reload not enabled"}\n', \
+                        "application/json"
+                try:
+                    cb()
+                except Exception:
+                    log.exception("reload callback failed")
+                    return 500, b"", "application/json"
+                return 200, b'{"reload": "in progress"}\n', "application/json"
+            return 200, json.dumps(
+                {"hot_reload_count": e.reload_count}
+            ).encode(), "application/json"
+        return 404, b"not found\n", "text/plain"
