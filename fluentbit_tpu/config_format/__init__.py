@@ -239,12 +239,39 @@ def _apply_processors(ctx, ffd, processors: Dict[str, list]) -> None:
 
 def _apply_parsers(ctx, cf: ConfigFile) -> None:
     for sec in cf.sections:
-        if sec.name != "parser":
+        if sec.name == "parser":
+            props = {k: v for k, v in sec.properties}
+            low = {k.lower(): v for k, v in props.items()}
+            name = low.pop("name", None)
+            if not name:
+                raise ValueError("[PARSER] section without Name")
+            props = {k: v for k, v in props.items() if k.lower() != "name"}
+            ctx.parser(name, **props)
+        elif sec.name == "multiline_parser":
+            _apply_ml_parser(ctx, sec)
+
+
+def _apply_ml_parser(ctx, sec: Section) -> None:
+    """[MULTILINE_PARSER] → engine.ml_parser. Rule lines are
+    '"state" "/regex/" "next_state"' (flb_ml_rule syntax)."""
+    name = sec.get("name")
+    if not name:
+        raise ValueError("[MULTILINE_PARSER] section without Name")
+    if (sec.get("type") or "regex").lower() != "regex":
+        raise ValueError("multiline parser type must be 'regex'")
+    rules = []
+    for key, value in sec.properties:
+        if key.lower() != "rule":
             continue
-        props = {k: v for k, v in sec.properties}
-        low = {k.lower(): v for k, v in props.items()}
-        name = low.pop("name", None)
-        if not name:
-            raise ValueError("[PARSER] section without Name")
-        props = {k: v for k, v in props.items() if k.lower() != "name"}
-        ctx.parser(name, **props)
+        parts = re.findall(r'"((?:[^"\\]|\\.)*)"', str(value))
+        if len(parts) != 3:
+            raise ValueError(f"invalid multiline rule {value!r}")
+        state, pattern, nxt = parts
+        if pattern.startswith("/") and pattern.endswith("/"):
+            pattern = pattern[1:-1]
+        rules.append((state, pattern, nxt))
+    ctx.ml_parser(
+        name, rules,
+        flush_ms=int(sec.get("flush_timeout", 2000)),
+        key_content=sec.get("key_content", "log"),
+    )

@@ -71,6 +71,8 @@ class Engine:
         self.metrics = MetricsRegistry()
         self.storage = None  # set by core.storage when storage_path configured
         self.parsers: Dict[str, Any] = {}  # named parsers (flb_parser registry)
+        self.ml_parsers: Dict[str, Any] = {}  # multiline parsers (flb_ml)
+        self._ingest_src = None  # input currently appending (under lock)
 
         self._backlog: List[Chunk] = []  # recovered chunks to re-dispatch
         self.loop: Optional[asyncio.AbstractEventLoop] = None
@@ -161,6 +163,18 @@ class Engine:
 
         p = create_parser(name, **props)
         self.parsers[p.name] = p
+        return p
+
+    def ml_parser(self, name: str, rules=None, flush_ms: int = 2000,
+                  key_content: str = "log"):
+        """Create + register a named multiline parser
+        ([MULTILINE_PARSER] section / flb_ml_parser_create)."""
+        from ..multiline import MLParser, MLRule
+
+        mlr = [MLRule([r[0]], r[1], r[2]) if not isinstance(r, MLRule) else r
+               for r in (rules or [])]
+        p = MLParser(name, mlr, flush_ms=flush_ms, key_content=key_content)
+        self.ml_parsers[name] = p
         return p
 
     def hidden_input(self, name: str, **props) -> InputInstance:
@@ -329,6 +343,10 @@ class Engine:
             return -1
 
         with self._ingest_lock:
+            # expose the appending input to filters that must recognise
+            # their own emitter's records (filter_multiline's
+            # i_ins == ctx->ins_emitter check in the reference)
+            self._ingest_src = ins
             events = decode_events(data)
             if n_records is None:
                 n_records = len(events)

@@ -62,6 +62,10 @@ class FLBContext:
         parsers_file [PARSER] section equivalent)."""
         return self.engine.parser(name, **props)
 
+    def ml_parser(self, name: str, rules=None, **kw):
+        """Create + register a multiline parser ([MULTILINE_PARSER])."""
+        return self.engine.ml_parser(name, rules, **kw)
+
     def set(self, ffd: int, **props) -> None:
         """flb_input_set / flb_output_set / flb_filter_set."""
         ins = self._handles[ffd]

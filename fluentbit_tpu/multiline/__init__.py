@@ -1,0 +1,286 @@
+"""Multiline engine — state-machine line concatenation.
+
+Reference: src/multiline/ (flb_ml.c rule types :88-94, flb_ml_rule.c
+state machine, flb_ml_stream.c per-stream buffering, and the built-in
+language parsers flb_ml_parser_docker/cri/go/java/python/ruby). Used by
+in_tail (``multiline.parser``) and filter_multiline.
+
+Model: a parser is a set of rules ``(from_states, regex, to_state)``.
+A stream feeds lines; a group opens when a rule from ``start_state``
+matches, continues while a rule from the current state matches, and
+closes (concatenated emit) on the first non-matching line — which is
+then re-fed as a fresh line. ``flush_ms`` bounds how long a pending
+group may wait for its continuation.
+
+Built-ins are re-specified from the well-known public formats (docker
+JSON logs, CRI-O, Go panics, Java stack traces, Python tracebacks) —
+not copies of the reference's tables.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..regex import FlbRegex
+
+DEFAULT_FLUSH_MS = 2000
+
+
+class MLRule:
+    __slots__ = ("from_states", "regex", "to_state")
+
+    def __init__(self, from_states: Sequence[str], pattern: str,
+                 to_state: str):
+        self.from_states = tuple(from_states)
+        self.regex = FlbRegex(pattern)
+        self.to_state = to_state
+
+
+class MLParser:
+    """A named multiline parser (flb_ml_parser)."""
+
+    def __init__(self, name: str, rules: Sequence[MLRule],
+                 flush_ms: int = DEFAULT_FLUSH_MS, sep: str = "\n",
+                 key_content: str = "log"):
+        self.name = name
+        self.rules = list(rules)
+        self.flush_ms = flush_ms
+        self.sep = sep
+        self.key_content = key_content
+
+    def rules_from(self, state: str) -> List[MLRule]:
+        return [r for r in self.rules if state in r.from_states]
+
+    def matches_start(self, line: str) -> Optional[str]:
+        for r in self.rules_from("start_state"):
+            if r.regex.match(line):
+                return r.to_state
+        return None
+
+
+class MLStream:
+    """Per-source concatenation state (flb_ml_stream).
+
+    Accepts several parsers: when no group is open each parser's start
+    rules are tried IN ORDER (the reference tries the configured parser
+    list per stream); the parser that opened the group owns it until it
+    closes.
+    """
+
+    __slots__ = ("parsers", "active", "emit", "state", "lines",
+                 "opened_at", "meta", "flush_ms")
+
+    def __init__(self, parsers, emit: Callable[[str, object], None],
+                 flush_ms: Optional[int] = None):
+        if isinstance(parsers, MLParser):
+            parsers = [parsers]
+        self.parsers = list(parsers)
+        self.active: Optional[MLParser] = None
+        self.emit = emit  # emit(concatenated_text, context)
+        self.state: Optional[str] = None
+        self.lines: List[str] = []
+        self.opened_at = 0.0
+        self.meta = None  # caller context of the group's FIRST line
+        self.flush_ms = (flush_ms if flush_ms is not None
+                         else self.parsers[0].flush_ms)
+
+    def feed(self, line: str, ctx=None) -> None:
+        if self.state is not None:
+            for r in self.active.rules_from(self.state):
+                if r.regex.match(line):
+                    self.lines.append(line)
+                    self.state = r.to_state
+                    return
+            self._close()
+        for parser in self.parsers:
+            to = parser.matches_start(line)
+            if to is not None:
+                self.active = parser
+                self.state = to
+                self.lines = [line]
+                self.opened_at = time.monotonic()
+                self.meta = ctx
+                return
+        self.emit(line, ctx)
+
+    def _close(self) -> None:
+        if self.lines:
+            self.emit(self.active.sep.join(self.lines), self.meta)
+        self.state = None
+        self.active = None
+        self.lines = []
+        self.meta = None
+
+    def flush(self) -> None:
+        """Force out any pending group (shutdown / timeout)."""
+        self._close()
+
+    def timed_out(self) -> bool:
+        return (
+            self.state is not None
+            and (time.monotonic() - self.opened_at) * 1000 >= self.flush_ms
+        )
+
+
+# ------------------------------------------------------------- built-ins
+
+def _builtin_go() -> MLParser:
+    return MLParser("go", [
+        MLRule(["start_state"], r"^(panic:|fatal error:)", "after_panic"),
+        MLRule(["after_panic", "trace"],
+               r"^(goroutine \d+|\s|\[signal|created by |exit status "
+               r"|runtime\.|.*\.go:\d+|[A-Za-z0-9_.\-/*()]+\()", "trace"),
+    ])
+
+
+def _builtin_java() -> MLParser:
+    return MLParser("java", [
+        MLRule(["start_state"],
+               r"^.+(Exception|Error)(: .*)?$", "after_exc"),
+        MLRule(["after_exc", "frames"],
+               r"^([\t ]+(at |\.\.\. |Suppressed: )|Caused by: )", "frames"),
+    ])
+
+
+def _builtin_python() -> MLParser:
+    return MLParser("python", [
+        MLRule(["start_state"],
+               r"^Traceback \(most recent call last\):", "frames"),
+        MLRule(["frames"], r"^[\t ]+", "frames"),
+        # the final "SomeError: message" line completes the group; the
+        # closing state has no outgoing rules so the next line closes it
+        MLRule(["frames"], r"^\S+(Error|Exception|Interrupt|Exit)", "done"),
+    ])
+
+
+def _builtin_ruby() -> MLParser:
+    return MLParser("ruby", [
+        MLRule(["start_state"], r"^.+Error \(.+\):", "frames"),
+        MLRule(["frames"], r"^[\t ]+(from )?", "frames"),
+    ])
+
+
+#: cri lines: "<time> <stream> <P|F> <content>" — P keeps the group open
+CRI_REGEX = (
+    r"^(?<time>[^ ]+) (?<stream>stdout|stderr) (?<flag>[FP]) (?<log>.*)$"
+)
+
+
+BUILTINS: Dict[str, Callable[[], MLParser]] = {
+    "go": _builtin_go,
+    "java": _builtin_java,
+    "python": _builtin_python,
+    "ruby": _builtin_ruby,
+}
+
+
+def get_builtin(name: str) -> Optional[MLParser]:
+    fn = BUILTINS.get(name.lower())
+    return fn() if fn else None
+
+
+class DockerStream:
+    """Built-in 'docker' mode: JSON-log fragments concat until the
+    content ends with a newline (daemon 16K splits)."""
+
+    __slots__ = ("emit", "parts", "meta", "opened_at", "flush_ms")
+
+    def __init__(self, emit, flush_ms: int = DEFAULT_FLUSH_MS):
+        self.emit = emit
+        self.parts: List[str] = []
+        self.meta = None
+        self.opened_at = 0.0
+        self.flush_ms = flush_ms
+
+    def feed(self, content: str, ctx=None) -> None:
+        if not self.parts:
+            self.meta = ctx
+            self.opened_at = time.monotonic()
+        self.parts.append(content)
+        if content.endswith("\n"):
+            self.emit("".join(self.parts).rstrip("\n"), self.meta)
+            self.parts = []
+            self.meta = None
+
+    def flush(self) -> None:
+        if self.parts:
+            self.emit("".join(self.parts).rstrip("\n"), self.meta)
+            self.parts = []
+            self.meta = None
+
+    def timed_out(self) -> bool:
+        return bool(self.parts) and (
+            (time.monotonic() - self.opened_at) * 1000 >= self.flush_ms
+        )
+
+
+class CriStream:
+    """Built-in 'cri' mode: the P/F flag drives grouping; the emitted
+    context is the parsed (time, stream, log) of the FIRST fragment."""
+
+    __slots__ = ("emit", "parts", "meta", "opened_at", "flush_ms", "_rx")
+
+    def __init__(self, emit, flush_ms: int = DEFAULT_FLUSH_MS):
+        self.emit = emit
+        self.parts: List[str] = []
+        self.meta = None
+        self.opened_at = 0.0
+        self.flush_ms = flush_ms
+        self._rx = FlbRegex(CRI_REGEX)
+
+    def feed(self, line: str, ctx=None) -> None:
+        got = self._rx.parse_record(line)
+        if got is None:
+            self.flush()
+            self.emit(line, ctx)
+            return
+        if not self.parts:
+            self.meta = ctx
+            self.opened_at = time.monotonic()
+        self.parts.append(got.get("log", ""))
+        if got.get("flag") == "F":
+            self.emit("".join(self.parts), self.meta)
+            self.parts = []
+            self.meta = None
+
+    def flush(self) -> None:
+        if self.parts:
+            self.emit("".join(self.parts), self.meta)
+            self.parts = []
+            self.meta = None
+
+    def timed_out(self) -> bool:
+        return bool(self.parts) and (
+            (time.monotonic() - self.opened_at) * 1000 >= self.flush_ms
+        )
+
+
+def create_stream(parser_names, resolver, emit,
+                  flush_ms: int = DEFAULT_FLUSH_MS):
+    """Stream factory. ``parser_names`` is a name or list of names tried
+    in order per stream; ``resolver`` maps a name to a user-defined
+    MLParser (or None → built-ins). 'docker'/'cri' have dedicated
+    stream types and cannot be combined with rule parsers."""
+    if isinstance(parser_names, str):
+        parser_names = [parser_names]
+    if resolver is None:
+        resolver = lambda name: None  # noqa: E731
+    elif isinstance(resolver, dict):
+        table = resolver
+        resolver = table.get
+    lows = [n.lower() for n in parser_names]
+    if "docker" in lows or "cri" in lows:
+        if len(lows) > 1:
+            raise ValueError(
+                "multiline: docker/cri cannot combine with other parsers"
+            )
+        return (DockerStream(emit, flush_ms) if lows[0] == "docker"
+                else CriStream(emit, flush_ms))
+    parsers = []
+    for name in parser_names:
+        parser = resolver(name) or get_builtin(name.lower())
+        if parser is None:
+            raise ValueError(f"unknown multiline parser {name!r}")
+        parsers.append(parser)
+    return MLStream(parsers, emit, flush_ms)

@@ -1,0 +1,127 @@
+"""filter_multiline — concatenate split log records.
+
+Reference: plugins/filter_multiline (ml.c): a list of multiline parsers
+(``multiline.parser``, tried per stream), ``key_content`` selecting the
+concatenated field, buffered mode holding partial groups and flushing
+them after ``flush_ms`` through a hidden emitter; the filter recognises
+its own emitter's records and passes them through untouched (the
+i_ins == ctx->ins_emitter check) to avoid re-buffering.
+
+Per-tag streams: records from different tags never concatenate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..codec.events import LogEvent, reencode_event
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..multiline import create_stream
+
+
+@registry.register
+class MultilineFilter(FilterPlugin):
+    name = "multiline"
+    description = "concatenate multiline/split records"
+    config_map = [
+        ConfigMapEntry("multiline.parser", "clist"),
+        ConfigMapEntry("multiline.key_content", "str", default="log"),
+        ConfigMapEntry("flush_ms", "int", default=2000),
+        ConfigMapEntry("mode", "str", default="parser"),
+        ConfigMapEntry("emitter_name", "str"),
+        ConfigMapEntry("emitter_mem_buf_limit", "str", default="10M"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.multiline_parser:
+            raise ValueError("multiline: multiline.parser is required")
+        self._engine = engine
+        self.key = self.multiline_key_content or "log"
+        self._streams: Dict[str, object] = {}  # tag → stream
+        self._sink: List[LogEvent] = []
+        self.emitter = None
+        self.emitter_instance = None
+        if engine is not None:
+            # validate the whole parser list up front
+            create_stream(self.multiline_parser, engine.ml_parsers,
+                          lambda *_: None, self.flush_ms)
+            name = self.emitter_name or f"emitter_for_{instance.display_name}"
+            ins = engine.hidden_input(
+                "emitter", alias=name,
+                mem_buf_limit=self.emitter_mem_buf_limit,
+            )
+            self.emitter = ins.plugin
+            self.emitter_instance = ins
+            # timeout flush rides the emitter's collector (the
+            # reference's flush_ms timer)
+            ins.plugin.collect_interval = max(0.25, self.flush_ms / 1000.0)
+            ins.plugin.collect = lambda _engine: self.flush_timed_out()
+
+    # -- stream plumbing --
+
+    def _stream_for(self, tag: str):
+        st = self._streams.get(tag)
+        if st is None:
+            st = create_stream(
+                self.multiline_parser,
+                self._engine.ml_parsers if self._engine else None,
+                lambda text, ctx: self._sink.append(
+                    self._build_event(text, ctx)
+                ),
+                self.flush_ms,
+            )
+            self._streams[tag] = st
+        return st
+
+    def _build_event(self, text: str, ctx) -> LogEvent:
+        if ctx is None:
+            return LogEvent(timestamp=0, body={self.key: text})
+        body = dict(ctx.body)
+        body[self.key] = text
+        return LogEvent(timestamp=ctx.timestamp, body=body,
+                        metadata=ctx.metadata, raw=None)
+
+    # -- the filter --
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        if (
+            engine is not None
+            and self.emitter_instance is not None
+            and engine._ingest_src is self.emitter_instance
+        ):
+            # our own emitter's timeout flush: pass through untouched
+            return (FilterResult.NOTOUCH, events)
+        stream = self._stream_for(tag)
+        out: List[LogEvent] = []
+        self._sink = out  # stream emits synchronously → order preserved
+        for ev in events:
+            content = ev.body.get(self.key) if isinstance(ev.body, dict) else None
+            if not isinstance(content, str):
+                stream.flush()
+                out.append(ev)
+                continue
+            stream.feed(content, ev)
+        self._sink = []
+        return (FilterResult.MODIFIED, out)
+
+    def flush_timed_out(self) -> None:
+        """Emit groups that waited past flush_ms (timer-driven; the
+        records re-enter the pipeline via the emitter and are passed
+        through untouched above). Serialized against filter() by the
+        engine's ingest lock."""
+        if self._engine is None:
+            return
+        with self._engine._ingest_lock:
+            for tag, stream in list(self._streams.items()):
+                if not stream.timed_out():
+                    continue
+                done: List[LogEvent] = []
+                self._sink = done
+                stream.flush()
+                self._sink = []
+                for ev in done:
+                    if self.emitter is not None:
+                        self.emitter.add_record(
+                            tag, reencode_event(ev), 1
+                        )

@@ -64,6 +64,8 @@ class TailInput(InputPlugin):
         ConfigMapEntry("buffer_max_size", "str", default="32k"),
         ConfigMapEntry("skip_long_lines", "bool", default=False),
         ConfigMapEntry("rotate_wait", "time", default="5"),
+        ConfigMapEntry("multiline.parser", "clist",
+                       desc="concatenate lines with a multiline parser"),
     ]
 
     def init(self, instance, engine) -> None:
@@ -78,6 +80,14 @@ class TailInput(InputPlugin):
             self._parser = (engine.parsers if engine else {}).get(self.parser)
             if self._parser is None:
                 raise ValueError(f"tail: unknown parser {self.parser!r}")
+        self._ml_streams: Dict[str, object] = {}  # path → multiline stream
+        if self.multiline_parser and engine is not None:
+            from ..multiline import create_stream
+
+            pname = self.multiline_parser[0]
+            # fail fast on unknown parser names
+            create_stream(pname, engine.ml_parsers.get(pname),
+                          lambda *_: None)
         self._db = None
         if self.db:
             self._db = sqlite3.connect(self.db, check_same_thread=False)
@@ -143,6 +153,14 @@ class TailInput(InputPlugin):
             self._since_scan = 0.0
         for tf in list(self._files.values()):
             self._read_file(tf, engine)
+        # flush multiline groups that waited past their flush window
+        for path, (st, groups) in list(self._ml_streams.items()):
+            if st.timed_out():
+                st.flush()
+                if groups:
+                    done = list(groups)
+                    groups.clear()
+                    self._emit_texts(path, self._tag_for(path), done, engine)
 
     def _read_file(self, tf: _TailFile, engine) -> None:
         try:
@@ -155,6 +173,7 @@ class TailInput(InputPlugin):
                 tf.fd.seek(tf.offset)
             except OSError:
                 self._files.pop(tf.path, None)
+                self._drop_ml_stream(tf.path, engine)
                 return
         # truncation: file shrank under the same inode
         if st is not None and st.st_ino == tf.inode and st.st_size < tf.offset:
@@ -180,6 +199,7 @@ class TailInput(InputPlugin):
             except OSError:
                 pass
             self._files.pop(tf.path, None)
+            self._drop_ml_stream(tf.path, engine)
         self._persist(tf)
 
     def _drain_fd(self, tf: _TailFile, engine, reopen: bool = False) -> None:
@@ -214,16 +234,73 @@ class TailInput(InputPlugin):
             if lines:
                 self._emit_lines(tf, lines, engine)
 
+    def _ml_stream(self, path: str):
+        from ..multiline import create_stream
+
+        entry = self._ml_streams.get(path)
+        if entry is None:
+            groups: List[str] = []
+            st = create_stream(
+                self.multiline_parser,
+                self._engine.ml_parsers if self._engine else None,
+                lambda text, ctx: groups.append(text),
+            )
+            entry = (st, groups)
+            self._ml_streams[path] = entry
+        return entry
+
+    def _drop_ml_stream(self, path: str, engine) -> None:
+        """Flush + forget the multiline stream of a dropped file."""
+        entry = self._ml_streams.pop(path, None)
+        if entry is None:
+            return
+        st, groups = entry
+        st.flush()
+        if groups:
+            self._emit_texts(path, self._tag_for(path), list(groups), engine)
+
     def _emit_lines(self, tf: _TailFile, lines: List[bytes], engine) -> None:
         tag = self._tag_for(tf.path)
+        decoded = [raw.rstrip(b"\r").decode("utf-8", "replace")
+                   for raw in lines]
+        if self.multiline_parser:
+            # concatenate through the per-file multiline stream first
+            st, groups = self._ml_stream(tf.path)
+            docker = self.multiline_parser[0].lower() == "docker"
+            for line in decoded:
+                if docker:
+                    # docker mode consumes the JSON 'log' content: the
+                    # 16K-split fragments are closed by a trailing \n IN
+                    # THE CONTENT, which line splitting cannot see
+                    import json as _json
+
+                    try:
+                        obj = _json.loads(line) if line else None
+                    except ValueError:
+                        obj = None
+                    content = obj.get("log") if isinstance(obj, dict) else None
+                    if isinstance(content, str):
+                        st.feed(content)
+                    elif line:
+                        st.flush()
+                        groups.append(line)
+                else:
+                    # blank lines must reach the state machine — they
+                    # close groups whose rules do not match empty
+                    st.feed(line)
+            decoded = list(groups)
+            groups.clear()
+        self._emit_texts(tf.path, tag, decoded, engine)
+
+    def _emit_texts(self, path: str, tag: str, texts: List[str],
+                    engine) -> None:
         out = bytearray()
         n = 0
-        for raw in lines:
-            line = raw.rstrip(b"\r").decode("utf-8", "replace")
+        for line in texts:
             if not line:
                 continue
             if len(line) > self._max_line and self.skip_long_lines:
-                log.warning("tail: dropping long line in %s", tf.path)
+                log.warning("tail: dropping long line in %s", path)
                 continue
             body = None
             ts = None
@@ -234,7 +311,7 @@ class TailInput(InputPlugin):
             if body is None:
                 body = {self.key or "log": line}
             if self.path_key:
-                body[self.path_key] = tf.path
+                body[self.path_key] = path
             out += encode_event(
                 body, ts if ts not in (None, 0) else now_event_time()
             )

@@ -1,0 +1,328 @@
+"""Multiline engine: built-in parsers (go/java/python/docker/cri),
+custom rule state machines, filter_multiline buffering + timeout flush,
+in_tail multiline.parser integration.
+
+Reference: src/multiline/flb_ml*.c, plugins/filter_multiline.
+"""
+
+import json
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.multiline import (
+    CriStream,
+    DockerStream,
+    MLParser,
+    MLRule,
+    MLStream,
+    create_stream,
+    get_builtin,
+)
+
+
+def run_stream(parser_name, lines, parser=None):
+    out = []
+    resolver = {parser_name: parser} if parser is not None else None
+    st = create_stream(parser_name, resolver,
+                       lambda text, ctx: out.append(text))
+    for line in lines:
+        st.feed(line)
+    st.flush()
+    return out
+
+
+# ------------------------------------------------------------- built-ins
+
+def test_python_traceback():
+    lines = [
+        "before",
+        "Traceback (most recent call last):",
+        '  File "x.py", line 1, in <module>',
+        "    boom()",
+        "ValueError: boom",
+        "after",
+    ]
+    got = run_stream("python", lines)
+    assert got == [
+        "before",
+        "Traceback (most recent call last):\n"
+        '  File "x.py", line 1, in <module>\n'
+        "    boom()\n"
+        "ValueError: boom",
+        "after",
+    ]
+
+
+def test_go_panic():
+    lines = [
+        "panic: runtime error: index out of range",
+        "goroutine 1 [running]:",
+        "main.main()",
+        "\t/app/main.go:5 +0x1d",
+        "regular log",
+    ]
+    got = run_stream("go", lines)
+    assert len(got) == 2
+    assert got[0].startswith("panic:") and "/app/main.go:5" in got[0]
+    assert got[1] == "regular log"
+
+
+def test_java_stacktrace():
+    lines = [
+        "java.lang.NullPointerException: oops",
+        "\tat com.example.App.run(App.java:12)",
+        "\tat com.example.App.main(App.java:5)",
+        "Caused by: java.lang.IllegalStateException",
+        "\tat com.example.Deep.call(Deep.java:1)",
+        "done",
+    ]
+    got = run_stream("java", lines)
+    assert len(got) == 2
+    assert got[0].count("\n") == 4
+    assert got[1] == "done"
+
+
+def test_docker_partial_lines():
+    out = []
+    st = DockerStream(lambda text, ctx: out.append(text))
+    st.feed("part one ")
+    st.feed("part two\n")
+    st.feed("single\n")
+    assert out == ["part one part two", "single"]
+
+
+def test_cri_partial_flags():
+    out = []
+    st = CriStream(lambda text, ctx: out.append(text))
+    st.feed("2024-01-01T00:00:00.0Z stdout P first ")
+    st.feed("2024-01-01T00:00:01.0Z stdout P second ")
+    st.feed("2024-01-01T00:00:02.0Z stdout F third")
+    st.feed("2024-01-01T00:00:03.0Z stderr F alone")
+    assert out == ["first second third", "alone"]
+
+
+def test_custom_rule_parser():
+    parser = MLParser("cont", [
+        MLRule(["start_state"], r"^start", "cont"),
+        MLRule(["cont"], r"^\+", "cont"),
+    ])
+    got = run_stream("cont", ["start a", "+b", "+c", "other", "start d"],
+                     parser)
+    assert got == ["start a\n+b\n+c", "other", "start d"]
+
+
+def test_unknown_parser_raises():
+    with pytest.raises(ValueError):
+        create_stream("nope", None, lambda *_: None)
+
+
+# -------------------------------------------------------- filter runtime
+
+def test_filter_multiline_concatenates():
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("multiline", match="t", **{"multiline.parser": "python"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for line in [
+            "ok 1",
+            "Traceback (most recent call last):",
+            "  File \"a.py\", line 2",
+            "KeyError: 'x'",
+            "ok 2",
+        ]:
+            ctx.push(in_ffd, json.dumps({"log": line, "svc": "s"}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert logs[0] == "ok 1"
+    assert any(l.startswith("Traceback") and "KeyError" in l for l in logs)
+    assert logs[-1] == "ok 2"
+    # other body fields of the group's first record are preserved
+    evs = [e for d in got for e in decode_events(d)]
+    tb = [e for e in evs if e.body["log"].startswith("Traceback")][0]
+    assert tb.body["svc"] == "s"
+
+
+def test_filter_multiline_timeout_flush():
+    """A pending group with no closing line is flushed via the emitter
+    after flush_ms and passes through untouched."""
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("multiline", match="t", flush_ms="200",
+               **{"multiline.parser": "python"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"log": "Traceback (most recent call last):"}))
+        ctx.push(in_ffd, json.dumps({"log": "  File \"p.py\", line 9"}))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if any(decode_events(d) for d in got):
+                break
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert len(logs) == 1
+    assert logs[0] == "Traceback (most recent call last):\n  File \"p.py\", line 9"
+
+
+def test_tail_with_multiline(tmp_path):
+    f = tmp_path / "app.log"
+    f.write_text("")
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("tail", tag="t", path=str(f), refresh_interval="0.1",
+              **{"multiline.parser": "go"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not ctx.engine.inputs[0].plugin._files:
+            time.sleep(0.05)
+        with open(f, "a") as fh:
+            fh.write("panic: boom\ngoroutine 7 [running]:\n\tmain.go:3\n"
+                     "normal line\n")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if sum(len(decode_events(d)) for d in got) >= 2:
+                break
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert logs[0] == "panic: boom\ngoroutine 7 [running]:\n\tmain.go:3"
+    assert logs[1] == "normal line"
+
+
+def test_multiline_parser_config_section(tmp_path):
+    conf = tmp_path / "ml.conf"
+    conf.write_text("""
+[MULTILINE_PARSER]
+    Name          myml
+    Type          regex
+    Flush_Timeout 1000
+    Rule          "start_state"  "/^BEGIN/"  "body"
+    Rule          "body"         "/^  /"     "body"
+
+[INPUT]
+    Name lib
+    Tag  t
+
+[FILTER]
+    Name             multiline
+    Match            t
+    multiline.parser myml
+
+[OUTPUT]
+    Name  lib
+    Match t
+""")
+    from fluentbit_tpu.config_format import apply_to_context, load_config_file
+
+    ctx = flb.create(flush="50ms", grace="1")
+    apply_to_context(ctx, load_config_file(str(conf)), str(tmp_path))
+    assert "myml" in ctx.engine.ml_parsers
+    got = []
+    ctx.engine.outputs[0].set("callback", lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for line in ["BEGIN txn", "  step 1", "  step 2", "END"]:
+            ctx.push(0, json.dumps({"log": line}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert logs == ["BEGIN txn\n  step 1\n  step 2", "END"]
+
+
+def test_multi_parser_list_tried_in_order():
+    from fluentbit_tpu.multiline import create_stream
+
+    out = []
+    st = create_stream(["go", "java"], None, lambda t, c: out.append(t))
+    for line in [
+        "panic: go boom",
+        "goroutine 1 [running]:",
+        "java.lang.NullPointerException: j",
+        "\tat a.b.C.d(C.java:1)",
+        "plain",
+    ]:
+        st.feed(line)
+    st.flush()
+    assert out == [
+        "panic: go boom\ngoroutine 1 [running]:",
+        "java.lang.NullPointerException: j\n\tat a.b.C.d(C.java:1)",
+        "plain",
+    ]
+
+
+def test_stream_flush_ms_override():
+    from fluentbit_tpu.multiline import create_stream
+
+    st = create_stream("java", None, lambda *_: None, flush_ms=500)
+    assert st.flush_ms == 500
+
+
+def test_blank_line_closes_group_in_tail(tmp_path):
+    f = tmp_path / "t.log"
+    f.write_text("")
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("tail", tag="t", path=str(f), refresh_interval="0.1",
+              **{"multiline.parser": "python"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not ctx.engine.inputs[0].plugin._files:
+            time.sleep(0.05)
+        with open(f, "a") as fh:
+            fh.write("Traceback (most recent call last):\n  frame\n\n"
+                     "  indented but unrelated\n")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if sum(len(decode_events(d)) for d in got) >= 2:
+                break
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert logs[0] == "Traceback (most recent call last):\n  frame"
+    assert logs[1] == "  indented but unrelated"
+
+
+def test_tail_docker_mode(tmp_path):
+    f = tmp_path / "docker.log"
+    f.write_text("")
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("tail", tag="t", path=str(f), refresh_interval="0.1",
+              **{"multiline.parser": "docker"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not ctx.engine.inputs[0].plugin._files:
+            time.sleep(0.05)
+        with open(f, "a") as fh:
+            fh.write(json.dumps({"log": "split one ", "stream": "stdout"}) + "\n")
+            fh.write(json.dumps({"log": "split two\n", "stream": "stdout"}) + "\n")
+            fh.write(json.dumps({"log": "whole\n", "stream": "stdout"}) + "\n")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if sum(len(decode_events(d)) for d in got) >= 2:
+                break
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert logs == ["split one split two", "whole"]
