@@ -141,6 +141,22 @@ def parse_yaml(text: str, base_dir: str = ".",
     for psec in doc.get("parsers") or []:
         cf.sections.append(section_from("parser", psec))
 
+    for msec in doc.get("multiline_parsers") or []:
+        sec = Section("multiline_parser")
+        for k, v in msec.items():
+            if k == "rules" and isinstance(v, list):
+                # YAML rule form: {state: s, regex: r, next_state: n}
+                for rule in v:
+                    sec.properties.append((
+                        "rule",
+                        f'"{rule.get("state", "start_state")}" '
+                        f'"{rule.get("regex", "")}" '
+                        f'"{rule.get("next_state", "")}"',
+                    ))
+            else:
+                sec.properties.append((str(k), interp_val(v)))
+        cf.sections.append(sec)
+
     pipeline = doc.get("pipeline") or {}
     for kind, sec_name in (("inputs", "input"), ("filters", "filter"),
                            ("outputs", "output")):

@@ -171,8 +171,13 @@ class Engine:
         ([MULTILINE_PARSER] section / flb_ml_parser_create)."""
         from ..multiline import MLParser, MLRule
 
-        mlr = [MLRule([r[0]], r[1], r[2]) if not isinstance(r, MLRule) else r
-               for r in (rules or [])]
+        # from_states may be comma-separated ("start_state,cont" —
+        # flb_ml_rule_create splits on comma)
+        mlr = [
+            MLRule([s.strip() for s in str(r[0]).split(",")], r[1], r[2])
+            if not isinstance(r, MLRule) else r
+            for r in (rules or [])
+        ]
         p = MLParser(name, mlr, flush_ms=flush_ms, key_content=key_content)
         self.ml_parsers[name] = p
         return p
@@ -253,7 +258,16 @@ class Engine:
             while not self._stopping:
                 await asyncio.sleep(flush_interval)
                 self.flush_all()
-            # graceful drain (grace period, src/flb_engine.c:1137-1160)
+            # graceful drain (grace period, src/flb_engine.c:1137-1160):
+            # let plugins flush held state (pending multiline groups)
+            # BEFORE the final chunk drain so nothing is lost at stop
+            for ins in self.inputs + self.filters:
+                drain = getattr(ins.plugin, "drain", None)
+                if drain is not None:
+                    try:
+                        drain(self)
+                    except Exception:
+                        log.exception("%s drain failed", ins.display_name)
             self.flush_all()
             await asyncio.sleep(0.05)  # let queued _create callbacks run
             deadline = time.time() + self.service.grace
@@ -380,10 +394,27 @@ class Engine:
         self.m_in_records.inc(n_records, (ins.display_name,))
         self.m_in_bytes.inc(len(data), (ins.display_name,))
         with self._ingest_lock:
+            # input-side metrics processors (flb_processor_run on the
+            # typed append path)
+            if ins.processors and event_type == EVENT_TYPE_METRICS:
+                data = self._run_metrics_processors(ins.processors, data, tag)
             chunk = ins.pool.append(tag, data, n_records, event_type)
             if self.storage is not None and ins.storage_type == "filesystem":
                 self.storage.write_through(chunk, data)
         return n_records
+
+    def _run_metrics_processors(self, procs, data: bytes, tag: str) -> bytes:
+        """Run a metrics processor pipeline over encoded payloads."""
+        from ..codec.msgpack import Unpacker, packb
+
+        try:
+            payloads = list(Unpacker(data))
+            for proc in procs:
+                payloads = proc.plugin.process_metrics(payloads, tag, self)
+            return b"".join(packb(p) for p in payloads)
+        except Exception:
+            log.exception("metrics processor pipeline failed")
+            return data
 
     def _run_filters(self, events: List[LogEvent], tag: str) -> List[LogEvent]:
         """flb_filter_do equivalent (src/flb_filter.c:119-330)."""
@@ -476,20 +507,24 @@ class Engine:
         (reference flb_output_flush_create/output_pre_cb_flush; backoff stays
         inside the coroutine rather than re-dispatching through the
         scheduler)."""
+        chunk = task.chunk
+        data = chunk.get_bytes()
+        # output-side processors (flb_processor_run at flush-create,
+        # include/fluent-bit/flb_output.h:794) — once per chunk, not per
+        # retry attempt
+        if out.processors and chunk.event_type == EVENT_TYPE_LOGS:
+            events = decode_events(data)
+            for proc in out.processors:
+                events = proc.plugin.process_logs(events, chunk.tag, self)
+            data = b"".join(
+                ev.raw if ev.raw is not None else reencode_event(ev)
+                for ev in events
+            )
+        elif out.processors and chunk.event_type == EVENT_TYPE_METRICS:
+            data = self._run_metrics_processors(out.processors, data, chunk.tag)
         while True:
             if delay > 0:
                 await asyncio.sleep(delay)
-            chunk = task.chunk
-            data = chunk.get_bytes()
-            # output-side processors (flb_processor_run at flush-create,
-            # include/fluent-bit/flb_output.h:794)
-            if out.processors and chunk.event_type == EVENT_TYPE_LOGS:
-                events = decode_events(data)
-                for proc in out.processors:
-                    events = proc.plugin.process_logs(events, chunk.tag, self)
-                data = b"".join(
-                    ev.raw if ev.raw is not None else reencode_event(ev) for ev in events
-                )
             # test formatter hook (src/flb_engine_dispatch.c:101-137)
             if out.test_formatter is not None:
                 try:

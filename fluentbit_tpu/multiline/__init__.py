@@ -257,11 +257,15 @@ class CriStream:
 
 
 def create_stream(parser_names, resolver, emit,
-                  flush_ms: int = DEFAULT_FLUSH_MS):
+                  flush_ms: Optional[int] = None):
     """Stream factory. ``parser_names`` is a name or list of names tried
     in order per stream; ``resolver`` maps a name to a user-defined
     MLParser (or None → built-ins). 'docker'/'cri' have dedicated
-    stream types and cannot be combined with rule parsers."""
+    stream types and cannot be combined with rule parsers.
+
+    ``flush_ms=None`` defers to the (first) parser's configured
+    Flush_Timeout; an explicit value (filter_multiline's flush_ms)
+    overrides it."""
     if isinstance(parser_names, str):
         parser_names = [parser_names]
     if resolver is None:
@@ -275,8 +279,9 @@ def create_stream(parser_names, resolver, emit,
             raise ValueError(
                 "multiline: docker/cri cannot combine with other parsers"
             )
-        return (DockerStream(emit, flush_ms) if lows[0] == "docker"
-                else CriStream(emit, flush_ms))
+        ms = flush_ms if flush_ms is not None else DEFAULT_FLUSH_MS
+        return (DockerStream(emit, ms) if lows[0] == "docker"
+                else CriStream(emit, ms))
     parsers = []
     for name in parser_names:
         parser = resolver(name) or get_builtin(name.lower())

@@ -15,4 +15,7 @@ from . import filter_parser  # noqa: F401
 from . import filter_rewrite_tag  # noqa: F401
 from . import filter_log_to_metrics  # noqa: F401
 from . import filter_multiline  # noqa: F401
+from . import filter_kubernetes  # noqa: F401
 from . import filters_basic  # noqa: F401
+from . import filters_extra  # noqa: F401
+from . import processors  # noqa: F401

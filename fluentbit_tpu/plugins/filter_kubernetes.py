@@ -1,0 +1,241 @@
+"""filter_kubernetes — pod metadata enrichment.
+
+Reference: plugins/filter_kubernetes (kubernetes.c, kube_meta.c,
+kube_property.c, kube_regex.h). Tag → pod identity (the in_tail
+``kube.var.log.containers.<pod>_<namespace>_<container>-<id>.log``
+convention), metadata from a TTL cache fed by (a) a pre-warmed cache
+directory of ``<namespace>_<pod>.meta`` JSON files (kube_meta.c:331-360
+— the offline/test path), or (b) an HTTP GET against ``kube_url``
+(API-server/kubelet style; plain HTTP here — the reference's TLS
+upstream has no equivalent in this build yet). ``merge_log`` parses the
+``log`` field (JSON or a named parser) into structured fields
+(kubernetes.c:295-330); pod annotations ``fluentbit.io/parser`` and
+``fluentbit.io/exclude`` override per-pod behavior when enabled by
+``k8s-logging.parser`` / ``k8s-logging.exclude`` (kube_property.c).
+
+Mostly host-side work (SURVEY §2.5: network + cache); the merge_log
+JSON parse is the device-batch candidate once the JSON field-extraction
+kernel lands.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..codec.events import LogEvent
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..regex import FlbRegex
+
+log = logging.getLogger("flb.kube")
+
+DEFAULT_TAG_PREFIX = "kube.var.log.containers."
+
+#: `<pod>_<namespace>_<container>-<docker_id>.log` (kube_regex.h tag regex
+#: shape, re-specified)
+TAG_REGEX = (
+    r"(?<pod_name>[a-z0-9](?:[-a-z0-9.]*[a-z0-9])?)_"
+    r"(?<namespace_name>[^_]+)_"
+    r"(?<container_name>.+)-(?<docker_id>[a-f0-9]{12,64})\.log$"
+)
+
+
+@registry.register
+class KubernetesFilter(FilterPlugin):
+    name = "kubernetes"
+    description = "enrich records with Kubernetes pod metadata"
+    config_map = [
+        ConfigMapEntry("kube_tag_prefix", "str", default=DEFAULT_TAG_PREFIX),
+        ConfigMapEntry("kube_url", "str"),
+        ConfigMapEntry("kube_meta_preload_cache_dir", "str"),
+        ConfigMapEntry("kube_meta_cache_ttl", "time", default="0"),
+        ConfigMapEntry("regex_parser", "str"),
+        ConfigMapEntry("merge_log", "bool", default=False),
+        ConfigMapEntry("merge_log_key", "str"),
+        ConfigMapEntry("merge_log_trim", "bool", default=True),
+        ConfigMapEntry("merge_parser", "str"),
+        ConfigMapEntry("keep_log", "bool", default=True),
+        ConfigMapEntry("labels", "bool", default=True),
+        ConfigMapEntry("annotations", "bool", default=True),
+        ConfigMapEntry("k8s-logging.parser", "bool", default=False),
+        ConfigMapEntry("k8s-logging.exclude", "bool", default=False),
+        ConfigMapEntry("buffer_size", "str", default="32k"),
+        ConfigMapEntry("tls.verify", "bool", default=True),
+        ConfigMapEntry("use_kubelet", "bool", default=False),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._engine = engine
+        self._tag_rx = FlbRegex(TAG_REGEX)
+        self._cache: Dict[Tuple[str, str], Tuple[float, dict]] = {}
+        self._merge_parser = None
+        if self.merge_parser:
+            self._merge_parser = (engine.parsers if engine else {}).get(
+                self.merge_parser
+            )
+            if self._merge_parser is None:
+                raise ValueError(
+                    f"kubernetes: unknown merge_parser {self.merge_parser!r}"
+                )
+
+    # -- identity + metadata --
+
+    def tag_to_identity(self, tag: str) -> Optional[Dict[str, str]]:
+        """kube.var.log.containers.<pod>_<ns>_<ctr>-<id>.log → fields."""
+        rest = tag
+        prefix = self.kube_tag_prefix or ""
+        if prefix and rest.startswith(prefix):
+            rest = rest[len(prefix):]
+        return self._tag_rx.parse_record(rest)
+
+    def _load_meta(self, namespace: str, pod: str) -> dict:
+        key = (namespace, pod)
+        hit = self._cache.get(key)
+        now = time.monotonic()
+        ttl = self.kube_meta_cache_ttl or 0
+        if hit is not None and (ttl <= 0 or now - hit[0] < ttl):
+            return hit[1]
+        meta = {}
+        if self.kube_meta_preload_cache_dir:
+            path = os.path.join(self.kube_meta_preload_cache_dir,
+                                f"{namespace}_{pod}.meta")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                meta = {}
+        if not meta and self.kube_url:
+            meta = self._fetch_meta(namespace, pod)
+        self._cache[key] = (now, meta)
+        return meta
+
+    def _fetch_meta(self, namespace: str, pod: str) -> dict:
+        """Blocking HTTP GET of the pod object (API-server path shape:
+        /api/v1/namespaces/<ns>/pods/<pod>)."""
+        url = self.kube_url.rstrip("/")
+        if not url.startswith("http://"):
+            log.warning("kubernetes: only plain http kube_url supported")
+            return {}
+        hostport = url[len("http://"):].split("/")[0]
+        host, _, port = hostport.partition(":")
+        try:
+            s = socket.create_connection((host, int(port or 80)), timeout=3)
+            path = f"/api/v1/namespaces/{namespace}/pods/{pod}"
+            s.sendall(f"GET {path} HTTP/1.1\r\nHost: {hostport}\r\n"
+                      f"Connection: close\r\n\r\n".encode())
+            data = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            s.close()
+            head, _, body = data.partition(b"\r\n\r\n")
+            if b" 200 " not in head.split(b"\r\n")[0]:
+                return {}
+            return json.loads(body)
+        except (OSError, ValueError):
+            return {}
+
+    def _kubernetes_map(self, identity: dict, meta: dict) -> dict:
+        k8s: Dict[str, Any] = {
+            "pod_name": identity["pod_name"],
+            "namespace_name": identity["namespace_name"],
+            "container_name": identity["container_name"],
+            "docker_id": identity["docker_id"],
+        }
+        md = meta.get("metadata", {}) if isinstance(meta, dict) else {}
+        spec = meta.get("spec", {}) if isinstance(meta, dict) else {}
+        if md.get("uid"):
+            k8s["pod_id"] = md["uid"]
+        if spec.get("nodeName"):
+            k8s["host"] = spec["nodeName"]
+        if self.labels and md.get("labels"):
+            k8s["labels"] = md["labels"]
+        if self.annotations and md.get("annotations"):
+            k8s["annotations"] = md["annotations"]
+        return k8s
+
+    def _pod_properties(self, meta: dict) -> dict:
+        """fluentbit.io/* annotations gated by k8s-logging.* options."""
+        out = {}
+        anns = (meta.get("metadata", {}) or {}).get("annotations", {}) \
+            if isinstance(meta, dict) else {}
+        if not isinstance(anns, dict):
+            return out
+        if getattr(self, "k8s_logging_parser", False):
+            p = anns.get("fluentbit.io/parser")
+            if p:
+                out["parser"] = p
+        if getattr(self, "k8s_logging_exclude", False):
+            ex = str(anns.get("fluentbit.io/exclude", "")).lower()
+            if ex in ("true", "on", "1", "yes"):
+                out["exclude"] = True
+        return out
+
+    # -- merge_log --
+
+    def _merge(self, ev: LogEvent, props: dict) -> Optional[dict]:
+        """Parse the log field into structured fields; returns the new
+        body or None when nothing merged."""
+        content = ev.body.get("log")
+        if not isinstance(content, str):
+            return None
+        if self.merge_log_trim:
+            content = content.rstrip()
+        parsed = None
+        parser = self._merge_parser
+        pname = props.get("parser")
+        if pname and self._engine is not None:
+            parser = self._engine.parsers.get(pname, parser)
+        if parser is not None:
+            got = parser.do(content)
+            if got is not None:
+                parsed = got[0]
+        elif content[:1] == "{":
+            try:
+                obj = json.loads(content)
+                if isinstance(obj, dict):
+                    parsed = obj
+            except ValueError:
+                parsed = None
+        if parsed is None:
+            return None
+        body = dict(ev.body)
+        if not self.keep_log:
+            body.pop("log", None)
+        if self.merge_log_key:
+            body[self.merge_log_key] = parsed
+        else:
+            for k, v in parsed.items():
+                body.setdefault(k, v)
+        return body
+
+    # -- the filter --
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        identity = self.tag_to_identity(tag)
+        if identity is None:
+            return (FilterResult.NOTOUCH, events)
+        meta = self._load_meta(identity["namespace_name"],
+                               identity["pod_name"])
+        k8s = self._kubernetes_map(identity, meta)
+        props = self._pod_properties(meta)
+        if props.get("exclude"):
+            return (FilterResult.MODIFIED, [])
+        out = []
+        for ev in events:
+            if not isinstance(ev.body, dict):
+                out.append(ev)
+                continue
+            body = (self._merge(ev, props) if self.merge_log else None) \
+                or dict(ev.body)
+            body["kubernetes"] = k8s
+            out.append(LogEvent(timestamp=ev.timestamp, body=body,
+                                metadata=ev.metadata, raw=None))
+        return (FilterResult.MODIFIED, out)

@@ -105,6 +105,21 @@ class MultilineFilter(FilterPlugin):
         self._sink = []
         return (FilterResult.MODIFIED, out)
 
+    def drain(self, engine) -> None:
+        """Engine shutdown: flush every pending group through the
+        emitter so buffered records are not lost."""
+        if self._engine is None:
+            return
+        with self._engine._ingest_lock:
+            for tag, stream in list(self._streams.items()):
+                done: List[LogEvent] = []
+                self._sink = done
+                stream.flush()
+                self._sink = []
+                for ev in done:
+                    if self.emitter is not None:
+                        self.emitter.add_record(tag, reencode_event(ev), 1)
+
     def flush_timed_out(self) -> None:
         """Emit groups that waited past flush_ms (timer-driven; the
         records re-enter the pipeline via the emitter and are passed

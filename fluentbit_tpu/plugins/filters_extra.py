@@ -1,0 +1,240 @@
+"""Additional filters: type_converter, checklist, alter_size,
+throttle_size, sysinfo.
+
+Reference: plugins/filter_type_converter (int/uint/float/string casts
+between keys), plugins/filter_checklist (lookup-list match → set
+records/labels, CIDR/exact/partial modes — exact + file list here),
+plugins/filter_alter_size (add N dummy records / remove N records),
+plugins/filter_throttle_size (per-window byte budget; simplified
+sliding window like filter_throttle), plugins/filter_sysinfo (append
+host/os/version fields).
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ..codec.events import LogEvent, encode_event, now_event_time
+from ..core.config import ConfigMapEntry, parse_size
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..core.record_accessor import RecordAccessor
+
+
+@registry.register
+class TypeConverterFilter(FilterPlugin):
+    name = "type_converter"
+    description = "convert field types into new keys"
+    config_map = [
+        # <from_key> <to_key> <type>  (type: int|uint|float|string)
+        ConfigMapEntry("int_key", "slist", multiple=True),
+        ConfigMapEntry("uint_key", "slist", multiple=True),
+        ConfigMapEntry("float_key", "slist", multiple=True),
+        ConfigMapEntry("str_key", "slist", multiple=True),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.rules = []  # (from, to, caster)
+
+        def add(entries, caster):
+            for e in entries or []:
+                parts = e if isinstance(e, list) else str(e).split()
+                if len(parts) < 2:
+                    raise ValueError(f"type_converter: bad rule {e!r}")
+                self.rules.append((parts[0], parts[1], caster))
+
+        def to_int(v):
+            return int(float(v))
+
+        def to_uint(v):
+            return abs(int(float(v)))
+
+        add(self.int_key, to_int)
+        add(self.uint_key, to_uint)
+        add(self.float_key, float)
+        add(self.str_key, str)
+        if not self.rules:
+            raise ValueError("type_converter: no conversion rules")
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        out = []
+        modified = False
+        for ev in events:
+            if not isinstance(ev.body, dict):
+                out.append(ev)
+                continue
+            body = None
+            for src, dst, caster in self.rules:
+                if src in ev.body:
+                    try:
+                        value = caster(ev.body[src])
+                    except (TypeError, ValueError):
+                        continue
+                    if body is None:
+                        body = dict(ev.body)
+                    body[dst] = value
+            if body is None:
+                out.append(ev)
+            else:
+                modified = True
+                out.append(LogEvent(ev.timestamp, body, ev.metadata, raw=None))
+        if not modified:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, out)
+
+
+@registry.register
+class ChecklistFilter(FilterPlugin):
+    name = "checklist"
+    description = "look up a field value in a list file and mark records"
+    config_map = [
+        ConfigMapEntry("file", "str"),
+        ConfigMapEntry("lookup_key", "str"),
+        ConfigMapEntry("record", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("mode", "str", default="exact"),
+        ConfigMapEntry("ignore_case", "bool", default=False),
+        ConfigMapEntry("print_query_time", "bool", default=False),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.file or not self.lookup_key:
+            raise ValueError("checklist: file and lookup_key are required")
+        self.ra = RecordAccessor(
+            self.lookup_key if self.lookup_key.startswith("$")
+            else "$" + self.lookup_key
+        )
+        self._set = set()
+        with open(self.file, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    self._set.add(line.lower() if self.ignore_case else line)
+        self._records = []
+        for pair in self.record or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) == 2:
+                self._records.append((parts[0], parts[1]))
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        out = []
+        modified = False
+        for ev in events:
+            v = self.ra.get(ev.body) if isinstance(ev.body, dict) else None
+            hit = isinstance(v, str) and (
+                (v.lower() if self.ignore_case else v) in self._set
+            )
+            if hit and self._records:
+                body = dict(ev.body)
+                for k, val in self._records:
+                    body[k] = val
+                out.append(LogEvent(ev.timestamp, body, ev.metadata, raw=None))
+                modified = True
+            else:
+                out.append(ev)
+        if not modified:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, out)
+
+
+@registry.register
+class AlterSizeFilter(FilterPlugin):
+    name = "alter_size"
+    description = "add or remove records (test/tuning plugin)"
+    config_map = [
+        ConfigMapEntry("add", "int", default=0),
+        ConfigMapEntry("remove", "int", default=0),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if self.add and self.remove:
+            raise ValueError("alter_size: add and remove are exclusive")
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        if self.remove:
+            return (FilterResult.MODIFIED, events[self.remove:])
+        if self.add:
+            from ..codec.events import decode_events
+
+            extra = b"".join(
+                encode_event({"alter_size": "added"}, now_event_time())
+                for _ in range(self.add)
+            )
+            return (FilterResult.MODIFIED, events + decode_events(extra))
+        return (FilterResult.NOTOUCH, events)
+
+
+@registry.register
+class ThrottleSizeFilter(FilterPlugin):
+    name = "throttle_size"
+    description = "rate-limit by bytes per window"
+    config_map = [
+        ConfigMapEntry("rate", "str", default="1M"),
+        ConfigMapEntry("window", "time", default="5"),
+        ConfigMapEntry("log_field", "str", default="log"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._budget = parse_size(self.rate)
+        self._window_start = time.monotonic()
+        self._used = 0
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        now = time.monotonic()
+        if now - self._window_start >= self.window:
+            self._window_start = now
+            self._used = 0
+        kept = []
+        for ev in events:
+            v = ev.body.get(self.log_field) if isinstance(ev.body, dict) else None
+            size = len(v.encode("utf-8", "replace")) if isinstance(v, str) \
+                else len(ev.raw or b"")
+            if self._used + size <= self._budget:
+                self._used += size
+                kept.append(ev)
+        if len(kept) == len(events):
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, kept)
+
+
+@registry.register
+class SysinfoFilter(FilterPlugin):
+    name = "sysinfo"
+    description = "append host/os information"
+    config_map = [
+        ConfigMapEntry("fluentbit_version_key", "str"),
+        ConfigMapEntry("os_name_key", "str"),
+        ConfigMapEntry("hostname_key", "str"),
+        ConfigMapEntry("os_version_key", "str"),
+        ConfigMapEntry("kernel_version_key", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        import socket as _socket
+
+        self._fields: Dict[str, str] = {}
+        if self.fluentbit_version_key:
+            self._fields[self.fluentbit_version_key] = "0.2.0"
+        if self.os_name_key:
+            self._fields[self.os_name_key] = sys.platform
+        if self.hostname_key:
+            self._fields[self.hostname_key] = _socket.gethostname()
+        if self.os_version_key:
+            self._fields[self.os_version_key] = platform.version()
+        if self.kernel_version_key:
+            self._fields[self.kernel_version_key] = platform.release()
+        if not self._fields:
+            raise ValueError("sysinfo: no *_key options configured")
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        out = []
+        for ev in events:
+            body = dict(ev.body) if isinstance(ev.body, dict) else ev.body
+            if isinstance(body, dict):
+                body.update(self._fields)
+                out.append(LogEvent(ev.timestamp, body, ev.metadata, raw=None))
+            else:
+                out.append(ev)
+        return (FilterResult.MODIFIED, out)

@@ -84,9 +84,8 @@ class TailInput(InputPlugin):
         if self.multiline_parser and engine is not None:
             from ..multiline import create_stream
 
-            pname = self.multiline_parser[0]
-            # fail fast on unknown parser names
-            create_stream(pname, engine.ml_parsers.get(pname),
+            # fail fast on unknown parser names (whole list)
+            create_stream(self.multiline_parser, engine.ml_parsers,
                           lambda *_: None)
         self._db = None
         if self.db:
@@ -96,6 +95,12 @@ class TailInput(InputPlugin):
                 "path TEXT PRIMARY KEY, inode INTEGER, offset INTEGER)"
             )
             self._db.commit()
+
+    def drain(self, engine) -> None:
+        """Engine shutdown: emit any pending multiline groups so the
+        final record of each stream survives a restart."""
+        for path in list(self._ml_streams):
+            self._drop_ml_stream(path, engine)
 
     def exit(self) -> None:
         for tf in self._files.values():

@@ -1,0 +1,195 @@
+"""Processors — per-instance pipelines attached to inputs/outputs.
+
+Reference: plugins/processor_content_modifier (3546 LoC: actions
+insert/upsert/delete/rename/hash/extract/convert over body or
+metadata), plugins/processor_labels (metric label edits),
+plugins/processor_metrics_selector (include/exclude by name). Processor
+units run at input ingest (flb_processor_run, src/flb_input_log.c:1562)
+and at output flush — the engine invokes ``process_logs`` /
+``process_metrics`` accordingly (YAML ``processors:`` blocks wire them,
+src/config_format/flb_cf_yaml.c being the only format exposing them).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, Dict, List, Optional
+
+from ..codec.events import LogEvent
+from ..core.config import ConfigMapEntry
+from ..core.plugin import ProcessorPlugin, registry
+from ..regex import FlbRegex
+
+
+@registry.register
+class ContentModifierProcessor(ProcessorPlugin):
+    name = "content_modifier"
+    description = ("modify record content: insert/upsert/delete/rename/"
+                   "hash/extract/convert")
+    config_map = [
+        ConfigMapEntry("action", "str"),
+        ConfigMapEntry("context", "str", default="body"),
+        ConfigMapEntry("key", "str"),
+        ConfigMapEntry("value", "str"),
+        ConfigMapEntry("pattern", "str"),
+        ConfigMapEntry("converted_type", "str"),
+    ]
+
+    ACTIONS = ("insert", "upsert", "delete", "rename", "hash", "extract",
+               "convert")
+
+    def init(self, instance, engine) -> None:
+        if self.action not in self.ACTIONS:
+            raise ValueError(f"content_modifier: unknown action {self.action!r}")
+        if not self.key:
+            raise ValueError("content_modifier: key is required")
+        if self.action == "extract" and not self.pattern:
+            raise ValueError("content_modifier: extract requires pattern")
+        if self.action in ("insert", "upsert", "rename") and self.value is None:
+            raise ValueError(
+                f"content_modifier: {self.action} requires a value"
+            )
+        self._rx = FlbRegex(self.pattern) if self.pattern else None
+        ctx = (self.context or "body").lower()
+        if ctx in ("body", "attributes"):
+            self._meta = False
+        elif ctx in ("metadata", "otel_resource_attributes"):
+            self._meta = True
+        else:
+            raise ValueError(f"content_modifier: unknown context {ctx!r}")
+
+    def _convert(self, v):
+        t = (self.converted_type or "string").lower()
+        try:
+            if t == "int":
+                return int(float(v))
+            if t == "double":
+                return float(v)
+            if t == "boolean":
+                s = str(v).lower()
+                return s in ("true", "on", "1", "yes")
+        except (TypeError, ValueError):
+            return v
+        return str(v)
+
+    def process_logs(self, events: list, tag: str, engine) -> list:
+        out = []
+        for ev in events:
+            target = ev.metadata if self._meta else ev.body
+            if not isinstance(target, dict):
+                out.append(ev)
+                continue
+            target = dict(target)
+            a = self.action
+            if a == "insert":
+                target.setdefault(self.key, self.value)
+            elif a == "upsert":
+                target[self.key] = self.value
+            elif a == "delete":
+                target.pop(self.key, None)
+            elif a == "rename":
+                if self.key in target:
+                    target[self.value] = target.pop(self.key)
+            elif a == "hash":
+                if self.key in target:
+                    target[self.key] = hashlib.sha256(
+                        str(target[self.key]).encode()
+                    ).hexdigest()
+            elif a == "extract":
+                v = target.get(self.key)
+                if isinstance(v, str):
+                    got = self._rx.parse_record(v)
+                    if got:
+                        target.update(got)
+            elif a == "convert":
+                if self.key in target:
+                    target[self.key] = self._convert(target[self.key])
+            if self._meta:
+                out.append(LogEvent(ev.timestamp, ev.body, target, raw=None))
+            else:
+                out.append(LogEvent(ev.timestamp, target, ev.metadata,
+                                    raw=None))
+        return out
+
+
+@registry.register
+class LabelsProcessor(ProcessorPlugin):
+    name = "labels"
+    description = "edit labels on metrics"
+    config_map = [
+        ConfigMapEntry("insert", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("upsert", "slist", multiple=True, slist_max_split=1),
+        ConfigMapEntry("delete", "str", multiple=True),
+    ]
+
+    @staticmethod
+    def _pairs(entries):
+        out = []
+        for e in entries or []:
+            parts = e if isinstance(e, list) else str(e).split(None, 1)
+            if len(parts) == 2:
+                out.append((parts[0], parts[1]))
+        return out
+
+    def process_metrics(self, payloads: list, tag: str, engine) -> list:
+        inserts = self._pairs(self.insert)
+        upserts = self._pairs(self.upsert)
+        deletes = list(self.delete or [])
+        for payload in payloads:
+            for m in payload.get("metrics", []):
+                keys: List[str] = list(m.get("labels", []))
+                # deletes first: drop the key + its per-sample values
+                drop_idx = [i for i, k in enumerate(keys) if k in deletes]
+                if drop_idx:
+                    m["labels"] = [k for i, k in enumerate(keys)
+                                   if i not in drop_idx]
+                    for s in m.get("values", []):
+                        s["labels"] = [v for i, v in
+                                       enumerate(s.get("labels", []))
+                                       if i not in drop_idx]
+                    keys = m["labels"]
+                for key, value in upserts + inserts:
+                    if key in keys:
+                        if (key, value) in inserts:
+                            continue  # insert never overwrites
+                        i = keys.index(key)
+                        for s in m.get("values", []):
+                            s["labels"][i] = value
+                    else:
+                        m["labels"] = keys = list(keys) + [key]
+                        for s in m.get("values", []):
+                            s["labels"] = list(s.get("labels", [])) + [value]
+        return payloads
+
+
+@registry.register
+class MetricsSelectorProcessor(ProcessorPlugin):
+    name = "metrics_selector"
+    description = "include/exclude metrics by name"
+    config_map = [
+        ConfigMapEntry("metric_name", "str"),
+        ConfigMapEntry("action", "str", default="include"),
+        ConfigMapEntry("operation_type", "str", default="substring"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.metric_name:
+            raise ValueError("metrics_selector: metric_name is required")
+        name = self.metric_name
+        if name.startswith("/") and name.endswith("/"):
+            self._rx = re.compile(name[1:-1])
+            self._match = lambda n: bool(self._rx.search(n))
+        elif (self.operation_type or "substring").lower() == "prefix":
+            self._match = lambda n: n.startswith(name)
+        else:
+            self._match = lambda n: name in n
+
+    def process_metrics(self, payloads: list, tag: str, engine) -> list:
+        include = (self.action or "include").lower() == "include"
+        for payload in payloads:
+            payload["metrics"] = [
+                m for m in payload.get("metrics", [])
+                if self._match(m.get("name", "")) == include
+            ]
+        return payloads

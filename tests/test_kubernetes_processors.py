@@ -1,0 +1,265 @@
+"""filter_kubernetes (BASELINE config 5), processors
+(content_modifier / labels / metrics_selector), and the extra filters
+(type_converter / checklist / alter_size / throttle_size / sysinfo).
+"""
+
+import json
+import os
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.codec.msgpack import Unpacker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+K8S_TAG = ("kube.var.log.containers."
+           "web-5c7f9_prod_nginx-0123456789abcdef0123456789abcdef"
+           "0123456789abcdef0123456789abcdef.log")
+
+
+def write_meta(tmp_path, namespace="prod", pod="web-5c7f9", **kw):
+    meta = {
+        "metadata": {
+            "uid": "pod-uid-1",
+            "labels": {"app": "web"},
+            "annotations": kw.get("annotations", {"team": "core"}),
+        },
+        "spec": {"nodeName": "node-7"},
+    }
+    d = tmp_path / "cache"
+    d.mkdir(exist_ok=True)
+    (d / f"{namespace}_{pod}.meta").write_text(json.dumps(meta))
+    return str(d)
+
+
+def run_k8s(tmp_path, records, tag=K8S_TAG, **props):
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag=tag)
+    ctx.filter("kubernetes", match="kube.*",
+               kube_meta_preload_cache_dir=write_meta(tmp_path), **props)
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for r in records:
+            ctx.push(in_ffd, json.dumps(r))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    return [e for d in got for e in decode_events(d)]
+
+
+def test_k8s_enrichment_from_cache(tmp_path):
+    evs = run_k8s(tmp_path, [{"log": "hello"}])
+    k8s = evs[0].body["kubernetes"]
+    assert k8s["pod_name"] == "web-5c7f9"
+    assert k8s["namespace_name"] == "prod"
+    assert k8s["container_name"] == "nginx"
+    assert k8s["pod_id"] == "pod-uid-1"
+    assert k8s["host"] == "node-7"
+    assert k8s["labels"] == {"app": "web"}
+
+
+def test_k8s_merge_log_json(tmp_path):
+    evs = run_k8s(tmp_path, [{"log": '{"level": "info", "msg": "m"}'}],
+                  merge_log="on")
+    body = evs[0].body
+    assert body["level"] == "info" and body["msg"] == "m"
+    assert "log" in body  # keep_log default on
+    evs2 = run_k8s(tmp_path, [{"log": '{"a": 1}'}], merge_log="on",
+                   keep_log="off")
+    assert "log" not in evs2[0].body and evs2[0].body["a"] == 1
+
+
+def test_k8s_non_matching_tag_untouched(tmp_path):
+    evs = run_k8s(tmp_path, [{"log": "x"}], tag="other.tag")
+    assert "kubernetes" not in evs[0].body
+
+
+def test_k8s_exclude_annotation(tmp_path):
+    cache = write_meta(
+        tmp_path, annotations={"fluentbit.io/exclude": "true"})
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag=K8S_TAG)
+    ctx.filter("kubernetes", match="kube.*",
+               kube_meta_preload_cache_dir=cache,
+               **{"k8s-logging.exclude": "on"})
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"log": "x"}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    assert got == []
+
+
+def test_baseline5_constructible():
+    from fluentbit_tpu.config_format import apply_to_context, load_config_file
+
+    ctx = flb.create()
+    apply_to_context(
+        ctx,
+        load_config_file(os.path.join(REPO, "conf", "baseline5-k8s.conf")),
+        os.path.join(REPO, "conf"),
+    )
+    assert [i.plugin.name for i in ctx.engine.inputs] == ["forward"]
+    assert [f.plugin.name for f in ctx.engine.filters] == ["kubernetes", "grep"]
+
+
+# ------------------------------------------------------------- processors
+
+def make_processor(name, **props):
+    from fluentbit_tpu.core.plugin import registry
+
+    proc = registry.create_processor(name)
+    for k, v in props.items():
+        proc.set(k, v)
+    proc.configure()
+    proc.plugin.init(proc, None)
+    return proc.plugin
+
+
+def ev_of(body, ts=1.0):
+    from fluentbit_tpu.codec.events import encode_event
+
+    return decode_events(encode_event(body, ts))[0]
+
+
+def test_content_modifier_actions():
+    p = make_processor("content_modifier", action="upsert", key="env",
+                       value="prod")
+    out = p.process_logs([ev_of({"a": 1})], "t", None)
+    assert out[0].body == {"a": 1, "env": "prod"}
+
+    p2 = make_processor("content_modifier", action="rename", key="old",
+                        value="new")
+    assert p2.process_logs([ev_of({"old": 5})], "t", None)[0].body == {"new": 5}
+
+    p3 = make_processor("content_modifier", action="hash", key="secret")
+    hashed = p3.process_logs([ev_of({"secret": "x"})], "t", None)[0].body
+    assert len(hashed["secret"]) == 64
+
+    p4 = make_processor("content_modifier", action="extract", key="log",
+                        pattern=r"(?<verb>\w+) (?<path>/\S*)")
+    out4 = p4.process_logs([ev_of({"log": "GET /x HTTP"})], "t", None)
+    assert out4[0].body["verb"] == "GET" and out4[0].body["path"] == "/x"
+
+    p5 = make_processor("content_modifier", action="convert", key="n",
+                        converted_type="int")
+    assert p5.process_logs([ev_of({"n": "42"})], "t", None)[0].body["n"] == 42
+
+
+def test_yaml_processors_wired(tmp_path):
+    conf = tmp_path / "p.yaml"
+    conf.write_text("""
+service:
+  flush: 0.05
+  grace: 1
+pipeline:
+  inputs:
+    - name: lib
+      tag: t
+      processors:
+        logs:
+          - name: content_modifier
+            action: upsert
+            key: stamped
+            value: "yes"
+  outputs:
+    - name: lib
+      match: "*"
+""")
+    from fluentbit_tpu.config_format import apply_to_context, load_config_file
+
+    ctx = flb.create()
+    apply_to_context(ctx, load_config_file(str(conf)), str(tmp_path))
+    got = []
+    ctx.engine.outputs[0].set("callback", lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(0, json.dumps({"m": 1}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    evs = [e for d in got for e in decode_events(d)]
+    assert evs[0].body == {"m": 1, "stamped": "yes"}
+
+
+def test_labels_and_selector_processors():
+    payload = {"meta": {}, "metrics": [
+        {"name": "a_hits", "labels": ["svc"],
+         "values": [{"labels": ["api"], "value": 2}]},
+        {"name": "b_errs", "labels": [],
+         "values": [{"labels": [], "value": 1}]},
+    ]}
+    lp = make_processor("labels", insert="env prod")
+    (out,) = lp.process_metrics([payload], "t", None)
+    m = out["metrics"][0]
+    assert m["labels"] == ["svc", "env"]
+    assert m["values"][0]["labels"] == ["api", "prod"]
+
+    sel = make_processor("metrics_selector", metric_name="hits")
+    (out2,) = sel.process_metrics([out], "t", None)
+    assert [m["name"] for m in out2["metrics"]] == ["a_hits"]
+
+
+# ------------------------------------------------------------ extra filters
+
+def run_filter(name, records, **props):
+    from fluentbit_tpu.core.plugin import registry
+
+    ins = registry.create_filter(name)
+    for k, v in props.items():
+        if isinstance(v, list):
+            for item in v:
+                ins.set(k, item)
+        else:
+            ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    events = [ev_of(r) for r in records]
+    _, out = ins.plugin.filter(events, "t", None)
+    return out
+
+
+def test_type_converter():
+    out = run_filter("type_converter", [{"code": "200", "f": "1.5"}],
+                     int_key="code code_n", float_key="f f_n")
+    assert out[0].body["code_n"] == 200
+    assert out[0].body["f_n"] == 1.5
+
+
+def test_checklist(tmp_path):
+    lst = tmp_path / "bad.txt"
+    lst.write_text("10.0.0.9\n# comment\n10.0.0.1\n")
+    out = run_filter("checklist",
+                     [{"ip": "10.0.0.1"}, {"ip": "8.8.8.8"}],
+                     file=str(lst), lookup_key="ip",
+                     record=["flagged true"])
+    assert out[0].body["flagged"] == "true"
+    assert "flagged" not in out[1].body
+
+
+def test_alter_size():
+    out = run_filter("alter_size", [{"i": i} for i in range(5)], remove="2")
+    assert [e.body["i"] for e in out] == [2, 3, 4]
+    out2 = run_filter("alter_size", [{"i": 0}], add="2")
+    assert len(out2) == 3
+
+
+def test_throttle_size():
+    out = run_filter("throttle_size",
+                     [{"log": "x" * 100} for _ in range(10)],
+                     rate="350", window="60")
+    assert len(out) == 3  # 3 × 100 bytes fit the 350-byte budget
+
+
+def test_sysinfo():
+    out = run_filter("sysinfo", [{"m": 1}], hostname_key="host",
+                     os_name_key="os")
+    assert out[0].body["os"] == "linux"
+    assert out[0].body["host"]

@@ -326,3 +326,31 @@ def test_tail_docker_mode(tmp_path):
         ctx.stop()
     logs = [e.body["log"] for d in got for e in decode_events(d)]
     assert logs == ["split one split two", "whole"]
+
+
+def test_custom_ml_parser_via_tail(tmp_path):
+    """Custom [MULTILINE_PARSER] with comma from_states + its
+    Flush_Timeout honored by in_tail; pending group flushed at stop."""
+    f = tmp_path / "x.log"
+    f.write_text("")
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.ml_parser("myml", [("start_state,cont", r"^>>", "cont")],
+                  flush_ms=600)
+    ctx.input("tail", tag="t", path=str(f), refresh_interval="0.1",
+              **{"multiline.parser": "myml"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not ctx.engine.inputs[0].plugin._files:
+            time.sleep(0.05)
+        st, _ = ctx.engine.inputs[0].plugin._ml_stream(str(f))
+        assert st.flush_ms == 600  # parser Flush_Timeout honored
+        with open(f, "a") as fh:
+            fh.write(">>a\n>>b\n")
+        time.sleep(0.4)
+    finally:
+        ctx.stop()  # drain hook flushes the pending group
+    logs = [e.body["log"] for d in got for e in decode_events(d)]
+    assert logs == [">>a\n>>b"]
