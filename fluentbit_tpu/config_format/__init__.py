@@ -197,11 +197,17 @@ def apply_to_context(ctx, cf: ConfigFile, base_dir: str = ".") -> None:
                     else os.path.join(base_dir, value)
                 pcf = load_config_file(path, env=cf.env)
                 _apply_parsers(ctx, pcf)
+            elif lk == "streams_file":
+                path = value if os.path.isabs(value) \
+                    else os.path.join(base_dir, value)
+                _apply_streams(ctx, load_config_file(path, env=cf.env))
             else:
                 ctx.service_set(**{lk: value})
     _apply_parsers(ctx, cf)
+    _apply_streams(ctx, cf)
     for sec in cf.sections:
-        if sec.name in ("service", "parser", "multiline_parser"):
+        if sec.name in ("service", "parser", "multiline_parser",
+                        "stream_task"):
             continue
         if sec.name not in ("input", "filter", "output", "custom"):
             raise ValueError(f"unknown config section [{sec.name}]")
@@ -265,6 +271,18 @@ def _apply_parsers(ctx, cf: ConfigFile) -> None:
             ctx.parser(name, **props)
         elif sec.name == "multiline_parser":
             _apply_ml_parser(ctx, sec)
+
+
+def _apply_streams(ctx, cf: ConfigFile) -> None:
+    """[STREAM_TASK] sections (the reference's streams_file format:
+    Name + Exec SQL)."""
+    for sec in cf.sections:
+        if sec.name != "stream_task":
+            continue
+        sql = sec.get("exec")
+        if not sql:
+            raise ValueError("[STREAM_TASK] section without Exec")
+        ctx.sp_task(sql)
 
 
 def _apply_ml_parser(ctx, sec: Section) -> None:

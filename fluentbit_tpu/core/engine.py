@@ -72,6 +72,7 @@ class Engine:
         self.storage = None  # set by core.storage when storage_path configured
         self.parsers: Dict[str, Any] = {}  # named parsers (flb_parser registry)
         self.ml_parsers: Dict[str, Any] = {}  # multiline parsers (flb_ml)
+        self.sp = None  # stream processor (flb_sp), created on first task
         self._ingest_src = None  # input currently appending (under lock)
 
         self._backlog: List[Chunk] = []  # recovered chunks to re-dispatch
@@ -182,6 +183,55 @@ class Engine:
         self.ml_parsers[name] = p
         return p
 
+    def sp_task(self, sql: str):
+        """Register a stream-processor query (flb_sp_create task;
+        [STREAM_TASK] Exec). The SP runs synchronously post-filter at
+        ingest (src/flb_input_chunk.c:3155) and its window timer rides a
+        collector on the SP emitter."""
+        from ..stream_processor import StreamProcessor
+
+        if self.sp is None:
+            self.sp = StreamProcessor(self)
+        task = self.sp.create_task(sql)
+        # window timer: piggyback a collector on the SP emitter input
+        if self.sp._emitter is None:
+            ins = self.hidden_input(
+                "emitter", alias="emitter_for_stream_processor"
+            )
+            self.sp._emitter = ins.plugin
+            self.sp.emitter_instance = ins
+            sp = self.sp
+
+            def _tick(_engine):
+                with self._ingest_lock:
+                    sp.tick()
+
+            ins.plugin.collect_interval = 0.5
+            ins.plugin.collect = _tick
+            # tasks may be registered AFTER engine start: _main's
+            # startup pass has already run, so schedule the collector
+            # ourselves
+            self.ensure_collector(ins)
+        return task
+
+    def ensure_collector(self, ins: InputInstance) -> None:
+        """Schedule a collector for an input created after start()
+        (startup normally does this in _main)."""
+        if not self.running or self.loop is None:
+            return
+
+        def _create():
+            if ins.collector_task is None and \
+                    ins.plugin.collect_interval is not None:
+                ins.collector_task = asyncio.ensure_future(
+                    self._collector(ins)
+                )
+
+        try:
+            self.loop.call_soon_threadsafe(_create)
+        except RuntimeError:
+            pass
+
     def hidden_input(self, name: str, **props) -> InputInstance:
         """Create + immediately initialize an internal input instance —
         the hidden ``emitter`` pattern used by rewrite_tag /
@@ -268,6 +318,12 @@ class Engine:
                         drain(self)
                     except Exception:
                         log.exception("%s drain failed", ins.display_name)
+            if self.sp is not None:  # flush open SQL windows
+                with self._ingest_lock:
+                    try:
+                        self.sp.drain()
+                    except Exception:
+                        log.exception("stream processor drain failed")
             self.flush_all()
             await asyncio.sleep(0.05)  # let queued _create callbacks run
             deadline = time.time() + self.service.grace
@@ -377,6 +433,20 @@ class Engine:
             events = self._run_filters(events, tag)
             if not events:
                 return 0
+
+            # stream processor on the filtered records (flb_sp_do,
+            # src/flb_input_chunk.c:3155); never on its OWN emitter's
+            # records — a task whose TAG pattern matches its output tag
+            # must not feed back into itself
+            if (
+                self.sp is not None
+                and self.sp.tasks
+                and ins is not self.sp.emitter_instance
+            ):
+                try:
+                    self.sp.do(events, tag)
+                except Exception:
+                    log.exception("stream processor failed")
 
             out = bytearray()
             for ev in events:

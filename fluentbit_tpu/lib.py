@@ -66,6 +66,10 @@ class FLBContext:
         """Create + register a multiline parser ([MULTILINE_PARSER])."""
         return self.engine.ml_parser(name, rules, **kw)
 
+    def sp_task(self, sql: str):
+        """Register a stream-processor SQL query ([STREAM_TASK] Exec)."""
+        return self.engine.sp_task(sql)
+
     def set(self, ffd: int, **props) -> None:
         """flb_input_set / flb_output_set / flb_filter_set."""
         ins = self._handles[ffd]

@@ -164,6 +164,46 @@ class LabelsProcessor(ProcessorPlugin):
 
 
 @registry.register
+class SqlProcessor(ProcessorPlugin):
+    """plugins/processor_sql — lighter per-instance SELECT projection +
+    WHERE over records, distinct from the engine-level stream processor
+    (SURVEY §2.5 contrast) but sharing its expression engine."""
+
+    name = "sql"
+    description = "SELECT projection/WHERE over records"
+    config_map = [ConfigMapEntry("query", "str")]
+
+    def init(self, instance, engine) -> None:
+        from ..stream_processor import parse_sql
+
+        if not self.query:
+            raise ValueError("sql processor requires a query")
+        q = parse_sql(self.query)
+        if q.has_aggregates or q.window or q.group_by:
+            raise ValueError(
+                "sql processor supports projection/WHERE only — use a "
+                "stream-processor task for aggregates/windows"
+            )
+        self._q = q
+
+    def process_logs(self, events: list, tag: str, engine) -> list:
+        from ..stream_processor import eval_cond, project
+
+        q = self._q
+        out = []
+        for ev in events:
+            if not isinstance(ev.body, dict):
+                out.append(ev)
+                continue
+            if q.where is not None and not eval_cond(q.where, ev.body,
+                                                     ev.ts_float):
+                continue
+            out.append(LogEvent(ev.timestamp, project(ev.body, q.keys),
+                                ev.metadata, raw=None))
+        return out
+
+
+@registry.register
 class MetricsSelectorProcessor(ProcessorPlugin):
     name = "metrics_selector"
     description = "include/exclude metrics by name"

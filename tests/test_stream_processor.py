@@ -1,0 +1,343 @@
+"""Stream processor SQL: grammar, projection, WHERE, aggregates,
+windows, GROUP BY, stream chaining, engine integration.
+
+Reference: src/stream_processor/ (sql.y grammar, flb_sp.c,
+flb_sp_window.c, flb_sp_aggregate_func.c).
+"""
+
+import json
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.stream_processor import SQLError, SPTask, parse_sql
+
+
+def ev(body, ts=1.0):
+    return decode_events(encode_event(body, ts))[0]
+
+
+# ------------------------------------------------------------------ parse
+
+def test_parse_create_stream():
+    q = parse_sql(
+        "CREATE STREAM errors WITH (tag='app.errors') AS "
+        "SELECT code, msg AS message FROM TAG:'app.*' "
+        "WHERE level = 'error' AND code >= 500;"
+    )
+    assert q.stream_name == "errors"
+    assert q.props == {"tag": "app.errors"}
+    assert [k.out_name for k in q.keys] == ["code", "message"]
+    assert q.source_type == "tag" and q.source == "app.*"
+    assert q.window is None and q.group_by == []
+
+
+def test_parse_window_group_by():
+    q = parse_sql(
+        "CREATE STREAM s AS SELECT COUNT(*), AVG(size) AS a "
+        "FROM STREAM:base WINDOW TUMBLING (5 SECOND) GROUP BY host;"
+    )
+    assert q.source_type == "stream" and q.source == "base"
+    assert q.window == ("tumbling", 5.0, 5.0)
+    assert q.group_by == ["host"]
+    assert q.keys[0].func == "count"
+    assert q.keys[1].alias == "a"
+
+
+def test_parse_hopping_window():
+    q = parse_sql("SELECT COUNT(*) FROM TAG:'x' "
+                  "WINDOW HOPPING (10 SECOND, ADVANCE BY 2 SECOND);")
+    assert q.window == ("hopping", 10.0, 2.0)
+
+
+def test_parse_errors():
+    with pytest.raises(SQLError):
+        parse_sql("SELECT FROM TAG:'x';")
+    with pytest.raises(SQLError):
+        parse_sql("SELECT * FROM NOWHERE:'x';")
+
+
+# -------------------------------------------------------------- semantics
+
+def run_task(sql, events, ticks=0, now=None):
+    got = []
+    task = SPTask(sql, lambda tag, bodies: got.append((tag, bodies)),
+                  now=now)
+    task.process(events, "app.log")
+    for _ in range(ticks):
+        task.tick()
+    return got
+
+
+def test_projection_and_where():
+    events = [
+        ev({"level": "error", "code": 500, "msg": "boom"}),
+        ev({"level": "info", "code": 200, "msg": "fine"}),
+        ev({"level": "error", "code": 404, "msg": "gone"}),
+    ]
+    got = run_task(
+        "SELECT code, msg FROM TAG:'app.*' WHERE level = 'error';", events
+    )
+    assert got == [("sp.results", [{"code": 500, "msg": "boom"},
+                                   {"code": 404, "msg": "gone"}])]
+
+
+def test_select_star_and_record_functions():
+    events = [ev({"a": 1, "b": 2}), ev({"a": 3})]
+    got = run_task(
+        "SELECT * FROM TAG:'app.*' WHERE @record.contains(b);", events
+    )
+    assert got[0][1] == [{"a": 1, "b": 2}]
+
+
+def test_aggregates_per_chunk():
+    events = [ev({"size": 10, "host": "a"}), ev({"size": 20, "host": "a"}),
+              ev({"size": 60, "host": "b"})]
+    got = run_task(
+        "CREATE STREAM s WITH (tag='agg') AS SELECT COUNT(*) AS n, "
+        "AVG(size) AS avg, MIN(size) AS lo, MAX(size) AS hi, "
+        "SUM(size) AS total FROM TAG:'app.*';",
+        events,
+    )
+    (tag, rows), = got
+    assert tag == "agg"
+    assert rows == [{"n": 3, "avg": 30.0, "lo": 10, "hi": 60, "total": 90.0}]
+
+
+def test_group_by():
+    events = [ev({"size": 10, "host": "a"}), ev({"size": 20, "host": "a"}),
+              ev({"size": 60, "host": "b"})]
+    got = run_task(
+        "SELECT COUNT(*) AS n, SUM(size) AS s FROM TAG:'app.*' "
+        "GROUP BY host;",
+        events,
+    )
+    rows = {r["host"]: r for r in got[0][1]}
+    assert rows["a"] == {"host": "a", "n": 2, "s": 30.0}
+    assert rows["b"] == {"host": "b", "n": 1, "s": 60.0}
+
+
+def test_tumbling_window_emits_on_tick():
+    clock = [100.0]
+    got = []
+    task = SPTask(
+        "SELECT COUNT(*) AS n FROM TAG:'app.*' WINDOW TUMBLING (5 SECOND);",
+        lambda tag, bodies: got.append(bodies), now=lambda: clock[0],
+    )
+    task.process([ev({"x": 1}), ev({"x": 2})], "app.log")
+    task.tick()
+    assert got == []  # window still open
+    clock[0] = 105.5
+    task.tick()
+    assert got == [[{"n": 2}]]
+    # next window accumulates fresh
+    task.process([ev({"x": 3})], "app.log")
+    clock[0] = 111.0
+    task.tick()
+    assert got[-1] == [{"n": 1}]
+
+
+def test_timeseries_forecast():
+    events = [ev({"v": float(i)}, ts=float(i)) for i in range(10)]
+    got = run_task(
+        "SELECT TIMESERIES_FORECAST(v, 5) AS f FROM TAG:'app.*';", events
+    )
+    # linear series v=t → forecast at t=9+5 is 14
+    assert got[0][1][0]["f"] == pytest.approx(14.0, abs=1e-6)
+
+
+def test_is_null_and_not():
+    events = [ev({"a": 1}), ev({"a": 1, "b": 2})]
+    got = run_task(
+        "SELECT a FROM TAG:'app.*' WHERE b IS NULL;", events
+    )
+    assert len(got[0][1]) == 1
+
+
+# ------------------------------------------------------------ integration
+
+def test_engine_integration_and_reingest():
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="sales")
+    ctx.sp_task(
+        "CREATE STREAM bigsales WITH (tag='sales.big') AS "
+        "SELECT * FROM TAG:'sales' WHERE amount >= 100;"
+    )
+    got = {}
+    ctx.output("lib", match="*",
+               callback=lambda d, t: got.setdefault(t, []).extend(
+                   decode_events(d)))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"amount": 250, "sku": "x"}))
+        ctx.push(in_ffd, json.dumps({"amount": 5, "sku": "y"}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    assert [e.body["sku"] for e in got["sales"]] == ["x", "y"]
+    assert [e.body["sku"] for e in got["sales.big"]] == ["x"]
+
+
+def test_stream_chaining():
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.sp_task("CREATE STREAM s1 WITH (tag='s1.out') AS "
+                "SELECT code FROM TAG:'t' WHERE code >= 400;")
+    ctx.sp_task("CREATE STREAM s2 WITH (tag='s2.out') AS "
+                "SELECT COUNT(*) AS n FROM STREAM:s1;")
+    got = {}
+    ctx.output("lib", match="s*",
+               callback=lambda d, t: got.setdefault(t, []).extend(
+                   decode_events(d)))
+    ctx.start()
+    try:
+        for code in [200, 404, 500, 301]:
+            ctx.push(in_ffd, json.dumps({"code": code}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    assert [e.body["code"] for e in got["s1.out"]] == [404, 500]
+    # each chunk of s1 results aggregates per chunk
+    assert sum(e.body["n"] for e in got["s2.out"]) == 2
+
+
+def test_streams_file_config(tmp_path):
+    streams = tmp_path / "streams.conf"
+    streams.write_text("""
+[STREAM_TASK]
+    Name  t1
+    Exec  CREATE STREAM s WITH (tag='out') AS SELECT * FROM TAG:'in';
+""")
+    conf = tmp_path / "main.conf"
+    conf.write_text(f"""
+[SERVICE]
+    Flush        0.05
+    Streams_File {streams}
+
+[INPUT]
+    Name lib
+    Tag  in
+
+[OUTPUT]
+    Name  lib
+    Match *
+""")
+    from fluentbit_tpu.config_format import apply_to_context, load_config_file
+
+    ctx = flb.create(grace="1")
+    apply_to_context(ctx, load_config_file(str(conf)), str(tmp_path))
+    assert ctx.engine.sp is not None and len(ctx.engine.sp.tasks) == 1
+
+
+def test_sql_processor_projection():
+    """processor_sql: per-instance projection/WHERE (distinct from the
+    engine-level SP)."""
+    from fluentbit_tpu.core.plugin import registry
+
+    proc = registry.create_processor("sql")
+    proc.set("query", "SELECT code, path FROM TAG:'x' WHERE code >= 400;")
+    proc.configure()
+    proc.plugin.init(proc, None)
+    events = [ev({"code": 200, "path": "/a", "junk": 1}),
+              ev({"code": 404, "path": "/b", "junk": 2})]
+    out = proc.plugin.process_logs(events, "t", None)
+    assert len(out) == 1
+    assert out[0].body == {"code": 404, "path": "/b"}
+
+
+def test_sql_processor_rejects_aggregates():
+    from fluentbit_tpu.core.plugin import registry
+
+    proc = registry.create_processor("sql")
+    proc.set("query", "SELECT COUNT(*) FROM TAG:'x';")
+    proc.configure()
+    with pytest.raises(ValueError):
+        proc.plugin.init(proc, None)
+
+
+def test_hopping_window_slides_over_panes():
+    """HOPPING (4s, ADVANCE 2s): each emission aggregates the union of
+    the last size/advance panes, not just the newest advance."""
+    clock = [100.0]
+    got = []
+    task = SPTask(
+        "SELECT COUNT(*) AS n FROM TAG:'t' "
+        "WINDOW HOPPING (4 SECOND, ADVANCE BY 2 SECOND);",
+        lambda tag, bodies: got.append(bodies[0]["n"]), now=lambda: clock[0],
+    )
+    task.process([ev({"x": 1}), ev({"x": 2})], "t")  # pane 1: 2 events
+    clock[0] = 102.1
+    task.tick()
+    assert got[-1] == 2
+    task.process([ev({"x": 3})], "t")  # pane 2: 1 event
+    clock[0] = 104.2
+    task.tick()
+    assert got[-1] == 3  # union of last two panes
+    clock[0] = 106.3
+    task.tick()  # pane 1 slid out; only pane 2 remains
+    assert got[-1] == 1
+
+
+def test_windowed_task_registered_after_start_ticks():
+    """sp_task after ctx.start(): the window collector must still be
+    scheduled and the window close must emit."""
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    got = {}
+    ctx.output("lib", match="*",
+               callback=lambda d, t: got.setdefault(t, []).extend(
+                   decode_events(d)))
+    ctx.start()
+    try:
+        ctx.sp_task("CREATE STREAM w WITH (tag='w.out') AS "
+                    "SELECT COUNT(*) AS n FROM TAG:'t' "
+                    "WINDOW TUMBLING (1 SECOND);")
+        ctx.push(in_ffd, json.dumps({"a": 1}))
+        ctx.push(in_ffd, json.dumps({"a": 2}))
+        deadline = time.time() + 6
+        while time.time() < deadline and "w.out" not in got:
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    assert sum(e.body["n"] for e in got.get("w.out", [])) == 2
+
+
+def test_window_drained_at_shutdown():
+    """An open 60s window is flushed at engine stop, not dropped."""
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.sp_task("CREATE STREAM w WITH (tag='w.out') AS "
+                "SELECT COUNT(*) AS n FROM TAG:'t' "
+                "WINDOW TUMBLING (60 SECOND);")
+    got = {}
+    ctx.output("lib", match="*",
+               callback=lambda d, t: got.setdefault(t, []).extend(
+                   decode_events(d)))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"a": 1}))
+        ctx.push(in_ffd, json.dumps({"a": 2}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    assert [e.body["n"] for e in got.get("w.out", [])] == [2]
+
+
+def test_no_self_feedback_loop():
+    """A task whose pattern matches its own output tag must not recurse."""
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="anything")
+    ctx.sp_task("SELECT * FROM TAG:'*';")  # out_tag sp.results matches '*'
+    got = {}
+    ctx.output("lib", match="*",
+               callback=lambda d, t: got.setdefault(t, []).extend(
+                   decode_events(d)))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"a": 1}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    assert len(got.get("sp.results", [])) == 1  # exactly one, no loop
