@@ -137,3 +137,18 @@ def count_records(buf: bytes) -> int:
     for _ in Unpacker(buf):
         n += 1
     return n
+
+
+def fast_count_records(buf: bytes):
+    """Native msgpack scanner when available (no Python-object decode);
+    None on malformed input or when the native library is absent AND the
+    Python fallback fails."""
+    from .. import native
+
+    n = native.count_records(buf)
+    if n is not None:
+        return n
+    try:
+        return count_records(buf)
+    except Exception:
+        return None

@@ -417,6 +417,32 @@ class Engine:
             # their own emitter's records (filter_multiline's
             # i_ins == ctx->ins_emitter check in the reference)
             self._ingest_src = ins
+
+            # ---- raw fast path (VERDICT: no decode-per-append) ----
+            # When nothing on the chain needs decoded events — no
+            # processors, no stream-processor task, and every matching
+            # filter can operate on raw chunk bytes (grep's native
+            # staging) — records are counted by the native msgpack
+            # scanner and appended as raw spans.
+            matching = [f for f in self.filters if f.route.matches(tag)]
+            sp_active = (
+                self.sp is not None
+                and self.sp.tasks
+                and ins is not self.sp.emitter_instance
+                and any(t.matches(tag) for t in self.sp.tasks)
+            )
+            if (
+                not ins.processors
+                and not sp_active
+                and all(
+                    getattr(f.plugin, "can_filter_raw", lambda: False)()
+                    for f in matching
+                )
+            ):
+                got = self._ingest_raw(ins, tag, data, matching, n_records)
+                if got is not None:
+                    return got
+
             events = decode_events(data)
             if n_records is None:
                 n_records = len(events)
@@ -472,6 +498,46 @@ class Engine:
             if self.storage is not None and ins.storage_type == "filesystem":
                 self.storage.write_through(chunk, data)
         return n_records
+
+    def _ingest_raw(self, ins, tag: str, data: bytes, matching,
+                    n_records: Optional[int]) -> Optional[int]:
+        """Append without Python decode; None → caller falls back to the
+        decode path (native unavailable / a filter declined)."""
+        from ..codec import events as _events
+
+        if n_records is None:
+            n_records = _events.fast_count_records(data)
+            if n_records is None:
+                return None
+        in_bytes = len(data)
+        n = n_records
+        deltas = []  # metric updates deferred until the chain commits:
+        for f in matching:  # a later decline re-runs the decode path,
+            try:            # which must not double-count earlier drops
+                got = f.plugin.filter_raw(data, tag, self, n_records=n)
+            except Exception:
+                log.exception("filter %s raw path failed", f.display_name)
+                return None
+            if got is None:
+                return None  # filter declined: decode path handles it
+            n2, data = got
+            deltas.append((f.display_name, n, n2))
+            n = n2
+            if n == 0:
+                break
+        for name, before, after in deltas:
+            if after < before:
+                self.m_filter_drop.inc(before - after, (name,))
+            elif after > before:
+                self.m_filter_add.inc(after - before, (name,))
+        self.m_in_records.inc(n_records, (ins.display_name,))
+        self.m_in_bytes.inc(in_bytes, (ins.display_name,))
+        if n == 0:
+            return 0
+        chunk = ins.pool.append(tag, data, n)
+        if self.storage is not None and ins.storage_type == "filesystem":
+            self.storage.write_through(chunk, data)
+        return n
 
     def _run_metrics_processors(self, procs, data: bytes, tag: str) -> bytes:
         """Run a metrics processor pipeline over encoded payloads."""

@@ -1,0 +1,150 @@
+"""Native data-plane shim — ctypes bindings for fbtpu_native.
+
+Builds native/fbtpu_native.cpp with g++ on first use (cached as
+``native/build/fbtpu_native.so``; pybind11 is not available in this
+image so the ABI is plain C via ctypes). Every entry point degrades
+gracefully: if the toolchain or the .so is unavailable, callers fall
+back to the pure-Python codec (``available()`` reports which path is
+active).
+
+API:
+  count_records(buf)                       → int | None
+  scan_offsets(buf)                        → numpy int64 offsets | None
+  stage_field(buf, key, max_len, pad_to)   → (batch, lengths, offsets,
+                                              n) | None
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("flb.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
+                    "native", "fbtpu_native.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
+                          "native", "build")
+_SO = os.path.join(_BUILD_DIR, "fbtpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+           _SRC, "-o", _SO]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable: %s", e)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed: %s", proc.stderr[-2000:])
+        return False
+    return True
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("FBTPU_NO_NATIVE"):
+            return None
+        src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.warning("native load failed: %s", e)
+            return None
+        lib.fbtpu_count_records.restype = ctypes.c_longlong
+        lib.fbtpu_count_records.argtypes = [ctypes.c_char_p,
+                                            ctypes.c_longlong]
+        lib.fbtpu_scan_offsets.restype = ctypes.c_longlong
+        lib.fbtpu_scan_offsets.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+        ]
+        lib.fbtpu_stage_field.restype = ctypes.c_longlong
+        lib.fbtpu_stage_field.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def count_records(buf: bytes) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.fbtpu_count_records(buf, len(buf))
+    return None if n < 0 else int(n)
+
+
+def scan_offsets(buf: bytes) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    # worst case: 1-byte records
+    cap = len(buf) + 1
+    offsets = np.empty(cap + 1, dtype=np.int64)
+    n = lib.fbtpu_scan_offsets(
+        buf, len(buf),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
+    )
+    if n < 0:
+        return None
+    return offsets[: n + 1]
+
+
+def stage_field(
+    buf: bytes, key: bytes, max_len: int, pad_to: Optional[int] = None,
+    n_hint: Optional[int] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]:
+    """Fill the staging matrix for one top-level string field straight
+    from chunk bytes: (batch[B, L] u8, lengths[B] i32, offsets[n+1] i64,
+    n_records). ``pad_to`` rounds B for jit shape stability; ``n_hint``
+    (a caller-known record count) skips the counting pre-pass."""
+    lib = _load()
+    if lib is None:
+        return None
+    est = n_hint if n_hint is not None else count_records(buf)
+    if est is None:
+        return None
+    B = pad_to if pad_to and pad_to >= est else est
+    batch = np.zeros((B, max_len), dtype=np.uint8)
+    lengths = np.full((B,), -1, dtype=np.int32)
+    offsets = np.empty(est + 1, dtype=np.int64)
+    n = lib.fbtpu_stage_field(
+        buf, len(buf), key, len(key),
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        est, max_len,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+    )
+    if n < 0:
+        return None
+    return batch, lengths, offsets, int(n)

@@ -30,6 +30,17 @@ from ..regex import FlbRegex
 
 LEGACY, AND, OR = "legacy", "AND", "OR"
 
+_LEN_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def _len_bucket(n: int, cap: int) -> int:
+    """Round a max value length up to a small bucket set (jit-stable
+    shapes) without exceeding the configured cap."""
+    for b in _LEN_BUCKETS:
+        if n <= b:
+            return min(b, cap) if b <= cap else cap
+    return cap
+
 
 def _to_text(v) -> Optional[str]:
     """Only string values are regex-matchable — the reference's
@@ -224,3 +235,89 @@ class GrepFilter(FilterPlugin):
         if len(kept) == len(events):
             return (FilterResult.NOTOUCH, events)
         return (FilterResult.MODIFIED, kept)
+
+    # -- raw chunk-bytes path (no Python decode) --
+
+    def can_filter_raw(self) -> bool:
+        """True when matching can run straight off chunk bytes: native
+        scanner present, device program compiled, AND every rule
+        addresses a simple top-level key (the field scanner's
+        contract)."""
+        from .. import native
+
+        return (
+            self._program is not None
+            and bool(self.rules)
+            and all(not r.ra.parts for r in self.rules)
+            and native.available()
+        )
+
+    def filter_raw(self, data: bytes, tag: str, engine, n_records=None):
+        """Native staging → DFA kernel → verdict → raw-span compaction.
+        Returns (n_records, new_data) or None to decline (the engine
+        then falls back to the decode path). Byte-identical surviving
+        records — the grep contract (grep.c:286-392)."""
+        from .. import native
+        from ..ops.batch import bucket_size
+
+        if not native.available():
+            return None
+        if n_records is not None and n_records < self.tpu_batch_records:
+            return None  # small batches: decode path is cheaper
+        by_key: dict = {}
+        for r, rule in enumerate(self.rules):
+            by_key.setdefault(rule.ra.head.encode("utf-8"), []).append(r)
+        staged = {}
+        offsets = None
+        n = None
+        for key, idxs in by_key.items():
+            got = native.stage_field(
+                data, key, self.tpu_max_record_len, None, n_hint=n_records
+            )
+            if got is None:
+                return None
+            batch, lengths, offs, count = got
+            if n is None:
+                n, offsets = count, offs
+            staged[key] = (batch, lengths)
+        if n is None or n < self.tpu_batch_records:
+            return None  # small batches: decode path is cheaper
+        Bp = bucket_size(n)
+        R = len(self.rules)
+        # scan-length bucketing: the DFA scan is sequential in L, so
+        # clamp to the longest staged value (rounded to a small bucket
+        # set for jit shape stability) instead of always tpu_max_record_len
+        max_staged = max(
+            (int(ln.max()) if ln.size else 0) for _, ln in staged.values()
+        )
+        L = _len_bucket(max(max_staged, 1), self.tpu_max_record_len)
+        batch = np.zeros((R, Bp, L), dtype=np.uint8)
+        lengths = np.full((R, Bp), -1, dtype=np.int32)
+        for key, idxs in by_key.items():
+            b, ln = staged[key]
+            for r in idxs:
+                batch[r, :n] = b[:, :L]
+                lengths[r, :n] = ln
+        mask = np.array(self._program.match(batch, lengths)[:, :n])
+        # overflow rows (-2): decode just those records on the CPU
+        overflow_rows = np.unique(np.nonzero(lengths[:, :n] == -2)[1])
+        if overflow_rows.size:
+            from ..codec.events import decode_events
+
+            for b_idx in overflow_rows:
+                span = bytes(data[offsets[b_idx]: offsets[b_idx + 1]])
+                ev = decode_events(span)[0]
+                for r, rule in enumerate(self.rules):
+                    if lengths[r, b_idx] == -2:
+                        mask[r, b_idx] = rule.match(ev.body)
+        keep = self.keep_mask(mask)
+        n_keep = int(keep.sum())
+        if n_keep == n:
+            return (n, data)
+        if n_keep == 0:
+            return (0, b"")
+        parts = [
+            data[offsets[i]: offsets[i + 1]]
+            for i in np.nonzero(keep)[0]
+        ]
+        return (n_keep, b"".join(parts))

@@ -243,7 +243,11 @@ class _Parser:
 
     def _unit(self) -> float:
         k, v = self.next()
-        return {"second": 1.0, "minute": 60.0, "hour": 3600.0}.get(v, 1.0)
+        unit = {"second": 1.0, "minute": 60.0, "hour": 3600.0}.get(v)
+        if unit is None:
+            raise SQLError(f"unknown time unit {v!r} "
+                           f"(SECOND/MINUTE/HOUR)")
+        return unit
 
     # -- conditions --
 
@@ -556,12 +560,16 @@ class SPTask:
         now = self._now()
         if kind == "tumbling":
             if now - self._window_start >= size:
-                self._window_start = now
+                # advance by whole periods so tick latency never drifts
+                # the window boundaries
+                self._window_start += size * ((now - self._window_start)
+                                              // size)
                 self._emit_aggregates()
             return
         if now - self._window_start < advance:
             return
-        self._window_start = now
+        self._window_start += advance * ((now - self._window_start)
+                                         // advance)
         self._panes.append(self._groups)
         self._groups = {}
         n_panes = max(1, int(round(size / advance)))
@@ -622,13 +630,26 @@ class StreamProcessor:
             )
         self._emitter.add_record(tag, data, len(bodies))
         # stream-to-stream chaining: FROM STREAM:<name> consumes the
-        # named stream's RESULTS (flb_sp_stream.c)
+        # named stream's RESULTS (flb_sp_stream.c). Depth-bounded so a
+        # cycle of streams (a←b, b←a) terminates instead of recursing
         name = src_task.query.stream_name
         if name:
-            chained = decode_events(data)
-            for t2 in self.tasks:
-                if t2 is not src_task and t2.matches(tag, name):
-                    t2.process(chained, tag)
+            self._chain_depth = getattr(self, "_chain_depth", 0) + 1
+            try:
+                if self._chain_depth > 16:
+                    import logging
+
+                    logging.getLogger("flb.sp").warning(
+                        "stream chain depth exceeded — cycle between "
+                        "CREATE STREAM tasks? dropping further chaining"
+                    )
+                    return
+                chained = decode_events(data)
+                for t2 in self.tasks:
+                    if t2 is not src_task and t2.matches(tag, name):
+                        t2.process(chained, tag)
+            finally:
+                self._chain_depth -= 1
 
     def do(self, events: list, tag: str,
            stream_name: Optional[str] = None) -> None:

@@ -1,0 +1,304 @@
+// fbtpu_native — msgpack hot-path scanner + batch staging.
+//
+// The C++ data-plane shim promised by SURVEY §7 ("msgpack chunk codec +
+// staging buffers"): the reference keeps its hot loops in C
+// (lib/msgpack-c, src/flb_mp.c record counting at
+// src/flb_input_chunk.c:3041); this is the TPU build's equivalent. The
+// Python codec (fluentbit_tpu/codec/msgpack.py) remains the semantic
+// reference; this library accelerates three operations on the ingest
+// path:
+//
+//   fbtpu_count_records  — count top-level msgpack objects (no decode)
+//   fbtpu_scan_offsets   — per-record byte offsets (raw span slicing)
+//   fbtpu_stage_field    — fill the [B, L] u8 staging matrix + lengths
+//                          with each record's top-level string field
+//                          (feeds the DFA/sketch kernels directly, no
+//                          Python-object round trip)
+//
+// Exposed via ctypes (no pybind11 in this image). All functions return
+// -1 on malformed input; the caller falls back to the Python codec.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// msgpack skip: advance over one object, headers only
+// ---------------------------------------------------------------------
+
+static const uint8_t *skip_obj(const uint8_t *p, const uint8_t *end,
+                               int depth) {
+    if (p >= end || depth > 64) return nullptr;
+    uint8_t b = *p++;
+    uint32_t n;
+    if (b <= 0x7f || b >= 0xe0) return p;                 // fixint
+    if ((b & 0xe0) == 0xa0) {                             // fixstr
+        n = b & 0x1f;
+        return p + n <= end ? p + n : nullptr;
+    }
+    if ((b & 0xf0) == 0x90) {                             // fixarray
+        n = b & 0x0f;
+        for (uint32_t i = 0; i < n; i++) {
+            p = skip_obj(p, end, depth + 1);
+            if (!p) return nullptr;
+        }
+        return p;
+    }
+    if ((b & 0xf0) == 0x80) {                             // fixmap
+        n = b & 0x0f;
+        for (uint32_t i = 0; i < 2 * n; i++) {
+            p = skip_obj(p, end, depth + 1);
+            if (!p) return nullptr;
+        }
+        return p;
+    }
+    switch (b) {
+    case 0xc0: case 0xc2: case 0xc3: return p;            // nil/bool
+    case 0xcc: case 0xd0: return p + 1 <= end ? p + 1 : nullptr;
+    case 0xcd: case 0xd1: return p + 2 <= end ? p + 2 : nullptr;
+    case 0xce: case 0xd2: case 0xca: return p + 4 <= end ? p + 4 : nullptr;
+    case 0xcf: case 0xd3: case 0xcb: return p + 8 <= end ? p + 8 : nullptr;
+    case 0xd9: case 0xc4:                                 // str8/bin8
+        if (p + 1 > end) return nullptr;
+        n = p[0]; p += 1;
+        return p + n <= end ? p + n : nullptr;
+    case 0xda: case 0xc5:                                 // str16/bin16
+        if (p + 2 > end) return nullptr;
+        n = ((uint32_t)p[0] << 8) | p[1]; p += 2;
+        return p + n <= end ? p + n : nullptr;
+    case 0xdb: case 0xc6:                                 // str32/bin32
+        if (p + 4 > end) return nullptr;
+        n = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+          | ((uint32_t)p[2] << 8) | p[3]; p += 4;
+        return p + n <= end ? p + n : nullptr;
+    case 0xdc:                                            // array16
+        if (p + 2 > end) return nullptr;
+        n = ((uint32_t)p[0] << 8) | p[1]; p += 2;
+        for (uint32_t i = 0; i < n; i++) {
+            p = skip_obj(p, end, depth + 1);
+            if (!p) return nullptr;
+        }
+        return p;
+    case 0xdd:                                            // array32
+        if (p + 4 > end) return nullptr;
+        n = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+          | ((uint32_t)p[2] << 8) | p[3]; p += 4;
+        for (uint32_t i = 0; i < n; i++) {
+            p = skip_obj(p, end, depth + 1);
+            if (!p) return nullptr;
+        }
+        return p;
+    case 0xde:                                            // map16
+        if (p + 2 > end) return nullptr;
+        n = ((uint32_t)p[0] << 8) | p[1]; p += 2;
+        for (uint32_t i = 0; i < 2 * n; i++) {
+            p = skip_obj(p, end, depth + 1);
+            if (!p) return nullptr;
+        }
+        return p;
+    case 0xdf:                                            // map32
+        if (p + 4 > end) return nullptr;
+        n = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+          | ((uint32_t)p[2] << 8) | p[3]; p += 4;
+        for (uint32_t i = 0; i < 2 * n; i++) {
+            p = skip_obj(p, end, depth + 1);
+            if (!p) return nullptr;
+        }
+        return p;
+    case 0xd4: return p + 2 <= end ? p + 2 : nullptr;     // fixext1
+    case 0xd5: return p + 3 <= end ? p + 3 : nullptr;     // fixext2
+    case 0xd6: return p + 5 <= end ? p + 5 : nullptr;     // fixext4
+    case 0xd7: return p + 9 <= end ? p + 9 : nullptr;     // fixext8
+    case 0xd8: return p + 17 <= end ? p + 17 : nullptr;   // fixext16
+    case 0xc7:                                            // ext8
+        if (p + 2 > end) return nullptr;
+        n = p[0]; p += 2;
+        return p + n <= end ? p + n : nullptr;
+    case 0xc8:                                            // ext16
+        if (p + 3 > end) return nullptr;
+        n = ((uint32_t)p[0] << 8) | p[1]; p += 3;
+        return p + n <= end ? p + n : nullptr;
+    case 0xc9:                                            // ext32
+        if (p + 5 > end) return nullptr;
+        n = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+          | ((uint32_t)p[2] << 8) | p[3]; p += 5;
+        return p + n <= end ? p + n : nullptr;
+    }
+    return nullptr;
+}
+
+// helpers: read container headers at p (returns elem count, advances)
+static const uint8_t *read_array_hdr(const uint8_t *p, const uint8_t *end,
+                                     uint32_t *n) {
+    if (p >= end) return nullptr;
+    uint8_t b = *p++;
+    if ((b & 0xf0) == 0x90) { *n = b & 0x0f; return p; }
+    if (b == 0xdc) {
+        if (p + 2 > end) return nullptr;
+        *n = ((uint32_t)p[0] << 8) | p[1];
+        return p + 2;
+    }
+    if (b == 0xdd) {
+        if (p + 4 > end) return nullptr;
+        *n = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+           | ((uint32_t)p[2] << 8) | p[3];
+        return p + 4;
+    }
+    return nullptr;
+}
+
+static const uint8_t *read_map_hdr(const uint8_t *p, const uint8_t *end,
+                                   uint32_t *n) {
+    if (p >= end) return nullptr;
+    uint8_t b = *p++;
+    if ((b & 0xf0) == 0x80) { *n = b & 0x0f; return p; }
+    if (b == 0xde) {
+        if (p + 2 > end) return nullptr;
+        *n = ((uint32_t)p[0] << 8) | p[1];
+        return p + 2;
+    }
+    if (b == 0xdf) {
+        if (p + 4 > end) return nullptr;
+        *n = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+           | ((uint32_t)p[2] << 8) | p[3];
+        return p + 4;
+    }
+    return nullptr;
+}
+
+static const uint8_t *read_str_hdr(const uint8_t *p, const uint8_t *end,
+                                   uint32_t *n) {
+    if (p >= end) return nullptr;
+    uint8_t b = *p++;
+    if ((b & 0xe0) == 0xa0) { *n = b & 0x1f; return p; }
+    if (b == 0xd9) {
+        if (p + 1 > end) return nullptr;
+        *n = p[0];
+        return p + 1;
+    }
+    if (b == 0xda) {
+        if (p + 2 > end) return nullptr;
+        *n = ((uint32_t)p[0] << 8) | p[1];
+        return p + 2;
+    }
+    if (b == 0xdb) {
+        if (p + 4 > end) return nullptr;
+        *n = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+           | ((uint32_t)p[2] << 8) | p[3];
+        return p + 4;
+    }
+    return nullptr;
+}
+
+// ---------------------------------------------------------------------
+// public API
+// ---------------------------------------------------------------------
+
+long long fbtpu_count_records(const uint8_t *buf, long long len) {
+    const uint8_t *p = buf, *end = buf + len;
+    long long count = 0;
+    while (p < end) {
+        p = skip_obj(p, end, 0);
+        if (!p) return -1;
+        count++;
+    }
+    return count;
+}
+
+// offsets[count+1]: record i spans [offsets[i], offsets[i+1])
+long long fbtpu_scan_offsets(const uint8_t *buf, long long len,
+                             long long *offsets, long long max_records) {
+    const uint8_t *p = buf, *end = buf + len;
+    long long count = 0;
+    while (p < end) {
+        if (count >= max_records) return -2;  // caller buffer too small
+        offsets[count] = p - buf;
+        p = skip_obj(p, end, 0);
+        if (!p) return -1;
+        count++;
+    }
+    offsets[count] = len;
+    return count;
+}
+
+// Stage each record's top-level string field `key` into out[B][max_len].
+// Records are [[ts, meta], body] (V2) or [ts, body] (legacy); non-map
+// bodies and missing/non-string values get length -1; oversize -2.
+// offsets: optional record offsets out (B+1) or NULL.
+long long fbtpu_stage_field(const uint8_t *buf, long long buflen,
+                            const uint8_t *key, long long keylen,
+                            uint8_t *out, int32_t *lengths,
+                            long long max_records, long long max_len,
+                            long long *offsets) {
+    const uint8_t *p = buf, *end = buf + buflen;
+    long long rec = 0;
+    while (p < end) {
+        if (rec >= max_records) return -2;
+        if (offsets) offsets[rec] = p - buf;
+        const uint8_t *rec_start = p;
+        uint32_t outer;
+        const uint8_t *q = read_array_hdr(p, end, &outer);
+        int32_t flen = -1;
+        if (q && outer >= 2) {
+            // skip the header element (array [ts, meta] or scalar ts)
+            const uint8_t *body = skip_obj(q, end, 0);
+            if (body) {
+                uint32_t pairs;
+                const uint8_t *kv = read_map_hdr(body, end, &pairs);
+                if (kv) {
+                    // scan ALL pairs: duplicate map keys are legal
+                    // msgpack, and the Python dict decode keeps the
+                    // LAST occurrence — so must we
+                    const uint8_t *hit = nullptr;
+                    uint32_t hit_len = 0;
+                    int hit_kind = 0;  // 0 none, 1 string, 2 non-string
+                    for (uint32_t i = 0; i < pairs && kv; i++) {
+                        uint32_t klen;
+                        const uint8_t *kstr = read_str_hdr(kv, end, &klen);
+                        const uint8_t *val;
+                        bool match = false;
+                        if (kstr) {
+                            val = kstr + klen;
+                            if (val > end) { kv = nullptr; break; }
+                            match = ((long long)klen == keylen &&
+                                     memcmp(kstr, key, klen) == 0);
+                        } else {
+                            val = skip_obj(kv, end, 0);  // non-str key
+                            if (!val) { kv = nullptr; break; }
+                        }
+                        if (match) {
+                            uint32_t vlen;
+                            const uint8_t *vstr =
+                                read_str_hdr(val, end, &vlen);
+                            if (vstr && vstr + vlen <= end) {
+                                hit = vstr;
+                                hit_len = vlen;
+                                hit_kind = 1;
+                            } else {
+                                hit_kind = 2;  // non-string value
+                            }
+                        }
+                        kv = skip_obj(val, end, 0);
+                    }
+                    if (hit_kind == 1) {
+                        if ((long long)hit_len > max_len) {
+                            flen = -2;  // overflow row
+                        } else {
+                            memcpy(out + rec * max_len, hit, hit_len);
+                            flen = (int32_t)hit_len;
+                        }
+                    }
+                }
+            }
+        }
+        lengths[rec] = flen;
+        p = skip_obj(rec_start, end, 0);
+        if (!p) return -1;
+        rec++;
+    }
+    if (offsets) offsets[rec] = buflen;
+    return rec;
+}
+
+}  // extern "C"

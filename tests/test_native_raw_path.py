@@ -1,0 +1,141 @@
+"""Native msgpack scanner + raw ingest path.
+
+Differential contract: the native staging/compaction path must be
+byte-identical to the Python decode path across record shapes (missing
+fields, non-string values, overflow rows, nested maps, legacy events,
+EventTime timestamps).
+"""
+
+import json
+import random
+
+import pytest
+
+from fluentbit_tpu import native
+from fluentbit_tpu.codec.events import count_records, decode_events, encode_event
+from fluentbit_tpu.codec.msgpack import EventTime, packb
+from fluentbit_tpu.core.engine import Engine
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def corpus(seed=0, n=400):
+    rng = random.Random(seed)
+    buf = bytearray()
+    for i in range(n):
+        body = {"log": f"{rng.choice(['GET', 'POST', 'PUT'])} /r/{i} "
+                       f"{rng.choice(['200', '404', '500'])}"}
+        roll = rng.random()
+        if roll < 0.08:
+            body.pop("log")                      # missing field
+        elif roll < 0.14:
+            body["log"] = rng.randrange(1000)    # non-string value
+        elif roll < 0.2:
+            body["log"] = "y" * 900 + " GET tail 200"  # overflow row
+        if rng.random() < 0.3:
+            body["nested"] = {"a": [1, 2, {"b": "c"}]}
+        if rng.random() < 0.2:
+            body["v"] = rng.random()
+        ts = EventTime(1700000000 + i, 500) if i % 2 else float(i)
+        buf += encode_event(body, ts)
+    # legacy form records too
+    buf += packb([1234, {"log": "GET legacy 200"}])
+    return bytes(buf)
+
+
+def test_native_count_matches_python():
+    buf = corpus()
+    assert native.count_records(buf) == count_records(buf)
+
+
+def test_native_offsets_match_raw_spans():
+    buf = corpus(seed=1)
+    offs = native.scan_offsets(buf)
+    evs = decode_events(buf)
+    assert len(offs) == len(evs) + 1
+    pos = 0
+    for i, ev in enumerate(evs):
+        assert offs[i] == pos
+        pos += len(ev.raw)
+    assert offs[-1] == len(buf)
+
+
+def test_native_stage_field_matches_python_extraction():
+    buf = corpus(seed=2)
+    batch, lengths, offs, n = native.stage_field(buf, b"log", 256)
+    evs = decode_events(buf)
+    assert n == len(evs)
+    for i, ev in enumerate(evs):
+        v = ev.body.get("log")
+        if isinstance(v, str):
+            enc = v.encode("utf-8")
+            if len(enc) > 256:
+                assert lengths[i] == -2
+            else:
+                assert lengths[i] == len(enc)
+                assert bytes(batch[i][: lengths[i]]) == enc
+        else:
+            assert lengths[i] == -1
+
+
+def test_malformed_buffer_rejected():
+    assert native.count_records(b"\xd9") is None  # truncated str8
+    assert native.count_records(b"\x91") is None  # fixarray missing elem
+
+
+@pytest.mark.parametrize("seed", [3, 4, 5])
+def test_raw_ingest_path_byte_identical(seed):
+    """engine raw path (native staging + kernel + raw compaction) ==
+    decode path, including overflow/missing/non-string rows."""
+    buf = corpus(seed=seed)
+
+    def build(tpu_on):
+        e = Engine()
+        f = e.filter("grep")
+        f.set("regex", "log GET")
+        f.set("exclude", "log 500$")
+        f.set("tpu_batch_records", "1")
+        if not tpu_on:
+            f.set("tpu.enable", "off")
+        ins = e.input("dummy")
+        for x in e.inputs + e.filters:
+            x.configure()
+            x.plugin.init(x, e)
+        return e, ins
+
+    e1, i1 = build(True)
+    e2, i2 = build(False)
+    n1 = e1.input_log_append(i1, "t", buf)
+    n2 = e2.input_log_append(i2, "t", buf)
+    out1 = b"".join(bytes(c.buf) for c in i1.pool.drain())
+    out2 = b"".join(bytes(c.buf) for c in i2.pool.drain())
+    assert n1 == n2
+    assert out1 == out2
+
+
+def test_raw_path_declines_for_nested_accessor():
+    """Rules with nested RA paths must use the decode path."""
+    e = Engine()
+    f = e.filter("grep")
+    f.set("regex", "$k['a'] x")
+    ins = e.input("dummy")
+    for x in e.inputs + e.filters:
+        x.configure()
+        x.plugin.init(x, e)
+    assert not e.filters[0].plugin.can_filter_raw()
+    buf = encode_event({"k": {"a": "x"}}, 1.0)
+    assert e.input_log_append(ins, "t", buf) == 1
+
+
+def test_unfiltered_fast_append_counts():
+    e = Engine()
+    ins = e.input("dummy")
+    ins.configure()
+    ins.plugin.init(ins, e)
+    buf = corpus(seed=6, n=50)
+    n = e.input_log_append(ins, "t", buf)
+    assert n == count_records(buf)
+    chunks = ins.pool.drain()
+    assert b"".join(bytes(c.buf) for c in chunks) == buf
