@@ -112,8 +112,6 @@ def build_engine(device: bool):
 
 def main():
     t_setup = time.time()
-    from fluentbit_tpu.codec.events import decode_events, encode_event
-
     chunks = make_corpus(N_CHUNKS, CHUNK_RECORDS)
     raw_chunks = [
         b"".join(ev.raw for ev in ch) for ch in chunks

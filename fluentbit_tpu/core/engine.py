@@ -642,7 +642,14 @@ class Engine:
         """One (task × output) flush coroutine, including its retries
         (reference flb_output_flush_create/output_pre_cb_flush; backoff stays
         inside the coroutine rather than re-dispatching through the
-        scheduler)."""
+        scheduler). Concurrency honors the reference's dispatch flags
+        (src/flb_engine_dispatch.c:193-207 + flb_output_thread.c):
+        FLB_OUTPUT_SYNCHRONOUS / no_multiplex serialize to one in-flight
+        flush per output; ``workers N`` bounds concurrency to N."""
+        await self._flush_body(task, out, delay)
+
+    async def _flush_body(self, task: Task, out: OutputInstance,
+                          delay: float) -> None:
         chunk = task.chunk
         data = chunk.get_bytes()
         # output-side processors (flb_processor_run at flush-create,
@@ -658,25 +665,37 @@ class Engine:
             )
         elif out.processors and chunk.event_type == EVENT_TYPE_METRICS:
             data = self._run_metrics_processors(out.processors, data, chunk.tag)
+        sem = out.flush_semaphore
         while True:
             if delay > 0:
                 await asyncio.sleep(delay)
-            # test formatter hook (src/flb_engine_dispatch.c:101-137)
-            if out.test_formatter is not None:
-                try:
-                    out.test_formatter(data, chunk.tag)
-                    result = FlushResult.OK
-                except Exception:
-                    log.exception("test formatter failed")
-                    result = FlushResult.ERROR
-            else:
-                try:
-                    result = await out.plugin.flush(data, chunk.tag, self)
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    log.exception("output %s flush raised", out.display_name)
-                    result = FlushResult.ERROR
+            # concurrency bound covers ONE attempt, never the backoff
+            # sleeps — a retrying chunk must not head-of-line block the
+            # output's other flushes (reference: retries are
+            # re-scheduled, freeing the dispatch slot)
+            if sem is not None:
+                await sem.acquire()
+            try:
+                # test formatter hook (src/flb_engine_dispatch.c:101-137)
+                if out.test_formatter is not None:
+                    try:
+                        out.test_formatter(data, chunk.tag)
+                        result = FlushResult.OK
+                    except Exception:
+                        log.exception("test formatter failed")
+                        result = FlushResult.ERROR
+                else:
+                    try:
+                        result = await out.plugin.flush(data, chunk.tag, self)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        log.exception("output %s flush raised",
+                                      out.display_name)
+                        result = FlushResult.ERROR
+            finally:
+                if sem is not None:
+                    sem.release()
             delay = self._handle_flush_result(task, out, result)
             if delay is None:
                 return

@@ -191,6 +191,9 @@ class OutputInstance(Instance):
         self.retry_limit: Optional[int] = None  # None → service default
         self.workers: int = 0
         self.processors: List = []
+        # flush-concurrency bound, built at configure():
+        # synchronous/no_multiplex → 1; workers N → N; else unbounded
+        self.flush_semaphore = None
         # test hooks (reference: flb_output_set_test / test_formatter mode,
         # src/flb_engine_dispatch.c:101-137)
         self.test_formatter: Optional[Callable] = None
@@ -206,6 +209,14 @@ class OutputInstance(Instance):
         w = self.properties.get("workers")
         if w is not None:
             self.workers = int(w)
+        import asyncio as _asyncio
+        from .config import parse_bool as _pb
+
+        if self.plugin.synchronous or self.plugin.no_multiplex or \
+                _pb(self.properties.get("no_multiplex", False)):
+            self.flush_semaphore = _asyncio.Semaphore(1)
+        elif self.workers > 0:
+            self.flush_semaphore = _asyncio.Semaphore(self.workers)
 
 
 class Registry:

@@ -9,7 +9,9 @@ from . import in_syslog  # noqa: F401
 from . import net_tcp_udp  # noqa: F401
 from . import net_http  # noqa: F401
 from . import net_forward  # noqa: F401
+from . import inputs_system  # noqa: F401
 from . import outputs_basic  # noqa: F401
+from . import outputs_http_based  # noqa: F401
 from . import filter_grep  # noqa: F401
 from . import filter_parser  # noqa: F401
 from . import filter_rewrite_tag  # noqa: F401

@@ -38,7 +38,7 @@ def _len_bucket(n: int, cap: int) -> int:
     shapes) without exceeding the configured cap."""
     for b in _LEN_BUCKETS:
         if n <= b:
-            return min(b, cap) if b <= cap else cap
+            return min(b, cap)
     return cap
 
 
@@ -132,6 +132,12 @@ class GrepFilter(FilterPlugin):
             kinds = {r.is_exclude for r in self.rules}
             if len(kinds) > 1:
                 raise ValueError("grep: AND/OR mode cannot mix Regex and Exclude rules")
+        # probe (and first-build) the native scanner here, NOT on the
+        # hot append path under the ingest lock — the one-time g++
+        # compile must never stall ingest
+        from .. import native as _native
+
+        _native.available()
         # device program: all rules DFA-expressible + jax importable
         self._program = None
         if self.tpu_enable and self.rules and all(r.dfa is not None for r in self.rules):

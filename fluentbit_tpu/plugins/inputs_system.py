@@ -1,0 +1,292 @@
+"""System-telemetry inputs: cpu, mem, disk, netif, proc, thermal, health.
+
+Reference: plugins/in_cpu (per-core /proc/stat deltas), plugins/in_mem
+(/proc/meminfo), plugins/in_disk (/proc/diskstats deltas),
+plugins/in_netif (/proc/net/dev deltas), plugins/in_proc (pid
+liveness + /proc/<pid> stats), plugins/in_thermal
+(/sys/class/thermal), plugins/in_health (TCP connect probe). All are
+interval collectors emitting one record per tick.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Dict, Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+
+class _IntervalInput(InputPlugin):
+    config_map = [
+        ConfigMapEntry("interval_sec", "time", default="1"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.interval_sec or 1)
+
+    def _emit(self, engine, body: dict) -> None:
+        engine.input_log_append(
+            self.instance, self.instance.tag,
+            encode_event(body, now_event_time()), 1,
+        )
+
+
+@registry.register
+class CpuInput(_IntervalInput):
+    name = "cpu"
+    description = "CPU utilization from /proc/stat deltas"
+    collect_interval = 1.0
+
+    def init(self, instance, engine) -> None:
+        super().init(instance, engine)
+        self._prev: Optional[Dict[str, tuple]] = None
+
+    @staticmethod
+    def _read() -> Dict[str, tuple]:
+        out = {}
+        with open("/proc/stat") as f:
+            for line in f:
+                if not line.startswith("cpu"):
+                    break
+                parts = line.split()
+                vals = tuple(int(x) for x in parts[1:9])
+                out[parts[0]] = vals
+        return out
+
+    def collect(self, engine) -> None:
+        cur = self._read()
+        prev, self._prev = self._prev, cur
+        if prev is None:
+            return
+        body: Dict[str, float] = {}
+        for name, vals in cur.items():
+            pv = prev.get(name)
+            if pv is None:
+                continue
+            deltas = [c - p for c, p in zip(vals, pv)]
+            total = sum(deltas) or 1
+            user, nice, system, idle = deltas[0], deltas[1], deltas[2], deltas[3]
+            key = "cpu" if name == "cpu" else name
+            body[f"{key}_p"] = round(100.0 * (total - idle) / total, 2)
+            body[f"{key}.user_p" if key != "cpu" else "user_p"] = round(
+                100.0 * (user + nice) / total, 2)
+            body[f"{key}.system_p" if key != "cpu" else "system_p"] = round(
+                100.0 * system / total, 2)
+        self._emit(engine, body)
+
+
+@registry.register
+class MemInput(_IntervalInput):
+    name = "mem"
+    description = "memory usage from /proc/meminfo"
+    collect_interval = 1.0
+
+    def collect(self, engine) -> None:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                info[k] = int(rest.split()[0])
+        total = info.get("MemTotal", 0)
+        free = info.get("MemAvailable", info.get("MemFree", 0))
+        st = info.get("SwapTotal", 0)
+        sf = info.get("SwapFree", 0)
+        self._emit(engine, {
+            "Mem.total": total, "Mem.used": total - free, "Mem.free": free,
+            "Swap.total": st, "Swap.used": st - sf, "Swap.free": sf,
+        })
+
+
+@registry.register
+class DiskInput(_IntervalInput):
+    name = "disk"
+    description = "disk throughput from /proc/diskstats deltas"
+    collect_interval = 1.0
+    config_map = _IntervalInput.config_map + [
+        ConfigMapEntry("dev_name", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        super().init(instance, engine)
+        self._prev = None
+
+    def _read(self):
+        rd = wr = 0
+        with open("/proc/diskstats") as f:
+            for line in f:
+                parts = line.split()
+                name = parts[2]
+                if self.dev_name and name != self.dev_name:
+                    continue
+                if not self.dev_name and not name.startswith(
+                        ("sd", "nvme", "vd", "xvd")):
+                    continue
+                rd += int(parts[5]) * 512
+                wr += int(parts[9]) * 512
+        return rd, wr
+
+    def collect(self, engine) -> None:
+        cur = self._read()
+        prev, self._prev = self._prev, cur
+        if prev is None:
+            return
+        self._emit(engine, {"read_size": cur[0] - prev[0],
+                            "write_size": cur[1] - prev[1]})
+
+
+@registry.register
+class NetifInput(_IntervalInput):
+    name = "netif"
+    description = "interface throughput from /proc/net/dev deltas"
+    collect_interval = 1.0
+    config_map = _IntervalInput.config_map + [
+        ConfigMapEntry("interface", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        super().init(instance, engine)
+        self._prev = None
+
+    def _read(self):
+        out = {}
+        with open("/proc/net/dev") as f:
+            for line in f.readlines()[2:]:
+                name, _, rest = line.partition(":")
+                name = name.strip()
+                if self.interface and name != self.interface:
+                    continue
+                parts = rest.split()
+                out[name] = (int(parts[0]), int(parts[8]))
+        return out
+
+    def collect(self, engine) -> None:
+        cur = self._read()
+        prev, self._prev = self._prev, cur
+        if prev is None:
+            return
+        body = {}
+        for name, (rx, tx) in cur.items():
+            pv = prev.get(name)
+            if pv is None:
+                continue
+            body[f"{name}.rx.bytes"] = rx - pv[0]
+            body[f"{name}.tx.bytes"] = tx - pv[1]
+        if body:
+            self._emit(engine, body)
+
+
+@registry.register
+class ProcInput(_IntervalInput):
+    name = "proc"
+    description = "process liveness + /proc/<pid> stats"
+    collect_interval = 1.0
+    config_map = _IntervalInput.config_map + [
+        ConfigMapEntry("proc_name", "str"),
+        ConfigMapEntry("alert", "bool", default=False),
+        ConfigMapEntry("mem", "bool", default=True),
+        ConfigMapEntry("fd", "bool", default=True),
+    ]
+
+    def init(self, instance, engine) -> None:
+        super().init(instance, engine)
+        if not self.proc_name:
+            raise ValueError("proc: proc_name is required")
+
+    def _find_pid(self) -> Optional[int]:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/comm") as f:
+                    if f.read().strip() == self.proc_name:
+                        return int(pid)
+            except OSError:
+                continue
+        return None
+
+    def collect(self, engine) -> None:
+        pid = self._find_pid()
+        alive = pid is not None
+        if self.alert and alive:
+            return  # alert mode: only emit when the process is gone
+        body: Dict[str, object] = {"proc_name": self.proc_name,
+                                   "alive": alive}
+        if alive:
+            body["pid"] = pid
+            if self.mem:
+                try:
+                    with open(f"/proc/{pid}/status") as f:
+                        for line in f:
+                            if line.startswith(("VmRSS", "VmSize")):
+                                k, _, rest = line.partition(":")
+                                body[f"mem.{k}"] = int(rest.split()[0])
+                except OSError:
+                    pass
+            if self.fd:
+                try:
+                    body["fd"] = len(os.listdir(f"/proc/{pid}/fd"))
+                except OSError:
+                    pass
+        self._emit(engine, body)
+
+
+@registry.register
+class ThermalInput(_IntervalInput):
+    name = "thermal"
+    description = "temperatures from /sys/class/thermal"
+    collect_interval = 1.0
+
+    def collect(self, engine) -> None:
+        base = "/sys/class/thermal"
+        try:
+            zones = sorted(z for z in os.listdir(base)
+                           if z.startswith("thermal_zone"))
+        except OSError:
+            return
+        for z in zones:
+            try:
+                with open(f"{base}/{z}/temp") as f:
+                    temp = int(f.read().strip()) / 1000.0
+                with open(f"{base}/{z}/type") as f:
+                    ztype = f.read().strip()
+            except OSError:
+                continue
+            self._emit(engine, {"name": z, "type": ztype, "temp": temp})
+
+
+@registry.register
+class HealthInput(_IntervalInput):
+    name = "health"
+    description = "TCP connect health probe"
+    collect_interval = 1.0
+    config_map = _IntervalInput.config_map + [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=80),
+        ConfigMapEntry("alert", "bool", default=False),
+        ConfigMapEntry("add_host", "bool", default=False),
+        ConfigMapEntry("add_port", "bool", default=False),
+    ]
+
+    def collect(self, engine) -> None:
+        t0 = time.perf_counter()
+        try:
+            s = socket.create_connection((self.host, self.port), timeout=2)
+            s.close()
+            alive = True
+        except OSError:
+            alive = False
+        if self.alert and alive:
+            return
+        body: Dict[str, object] = {"alive": alive}
+        if alive:
+            body["check_time_ms"] = round(
+                (time.perf_counter() - t0) * 1000, 3)
+        if self.add_host:
+            body["hostname"] = self.host
+        if self.add_port:
+            body["port"] = self.port
+        self._emit(engine, body)
