@@ -1,0 +1,141 @@
+"""Device UTF-8 validation — the simdutf-connector equivalent.
+
+Reference: src/simdutf/flb_simdutf_connector.cpp + src/flb_utf8.c (SIMD
+Unicode validation; directly relevant to the
+benchmarks/utf8_surrogate_bench_10k.ndjson corpus). The TPU re-design
+runs a byte-class DFA over ``[B, L] uint8`` staged batches as a
+``lax.scan`` of table gathers — the same execution model as the grep
+kernel — validating a whole batch of records per dispatch.
+
+The automaton is built from the RFC 3629 well-formedness table
+(overlongs, UTF-16 surrogates ED A0..BF, and > U+10FFFF all rejected):
+
+  classes: ASCII, 80-8F, 90-9F, A0-BF, C2-DF, E0, E1-EC|EE-EF, ED,
+           F0, F1-F3, F4, invalid (C0-C1, F5-FF)
+  states:  OK, C1 (one continuation), C2, C3, E0' (A0-BF then C1),
+           ED' (80-9F then C1), F0' (90-BF then C2), F4' (80-8F then
+           C2), DEAD
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    HAVE_JAX = True
+except Exception:  # pragma: no cover
+    HAVE_JAX = False
+
+# byte classes
+_ASCII, _80_8F, _90_9F, _A0_BF, _C2_DF, _E0, _E1_EC_EE_EF, _ED, _F0, \
+    _F1_F3, _F4, _BAD = range(12)
+N_CLASSES = 12
+
+# states
+OK, C1, C2, C3, E0S, EDS, F0S, F4S, DEAD = range(9)
+N_STATES = 9
+
+
+def _byte_classes() -> np.ndarray:
+    cls = np.full(256, _BAD, dtype=np.int32)
+    cls[0x00:0x80] = _ASCII
+    cls[0x80:0x90] = _80_8F
+    cls[0x90:0xA0] = _90_9F
+    cls[0xA0:0xC0] = _A0_BF
+    cls[0xC2:0xE0] = _C2_DF
+    cls[0xE0] = _E0
+    cls[0xE1:0xED] = _E1_EC_EE_EF
+    cls[0xED] = _ED
+    cls[0xEE:0xF0] = _E1_EC_EE_EF
+    cls[0xF0] = _F0
+    cls[0xF1:0xF4] = _F1_F3
+    cls[0xF4] = _F4
+    return cls
+
+
+def _transitions() -> np.ndarray:
+    t = np.full((N_STATES, N_CLASSES), DEAD, dtype=np.int32)
+    cont = (_80_8F, _90_9F, _A0_BF)
+    t[OK, _ASCII] = OK
+    t[OK, _C2_DF] = C1
+    t[OK, _E0] = E0S
+    t[OK, _E1_EC_EE_EF] = C2
+    t[OK, _ED] = EDS
+    t[OK, _F0] = F0S
+    t[OK, _F1_F3] = C3
+    t[OK, _F4] = F4S
+    for c in cont:
+        t[C1, c] = OK
+        t[C2, c] = C1
+        t[C3, c] = C2
+    t[E0S, _A0_BF] = C1            # E0: A0-BF only (no overlongs)
+    t[EDS, _80_8F] = C1            # ED: 80-9F only (no surrogates)
+    t[EDS, _90_9F] = C1
+    t[F0S, _90_9F] = C2            # F0: 90-BF only (no overlongs)
+    t[F0S, _A0_BF] = C2
+    t[F4S, _80_8F] = C2            # F4: 80-8F only (<= U+10FFFF)
+    return t
+
+
+_CLS = _byte_classes()
+_TRANS = _transitions()
+
+
+def validate_bytes(data: bytes) -> bool:
+    """CPU reference validator (the oracle the kernel must match)."""
+    state = OK
+    for b in data:
+        state = _TRANS[state, _CLS[b]]
+        if state == DEAD:
+            return False
+    return state == OK
+
+
+class Utf8Validator:
+    """Batched device validation: valid[b] per staged row."""
+
+    def __init__(self):
+        if not HAVE_JAX:
+            raise RuntimeError("jax is unavailable")
+        self._cls = jnp.asarray(_CLS)
+        self._trans = jnp.asarray(_TRANS)
+        self._jit = jax.jit(self._impl)
+
+    def _impl(self, batch, lengths):
+        B, L = batch.shape
+        cls = self._cls[batch]  # [B, L]
+        pos = jnp.arange(L, dtype=jnp.int32)
+        pad = pos[None, :] >= lengths[:, None]
+        # pad positions map to ASCII (identity for states OK/DEAD; a
+        # sequence cut by the pad boundary stays in C*/E*/F* and fails
+        # the final state == OK check exactly like a truncated string)
+        cls = jnp.where(pad, _ASCII, cls)
+        state0 = jnp.zeros((B,), dtype=jnp.int32) + 0 * lengths
+
+        def step(state, c_t):
+            return self._trans[state, c_t], None
+
+        final, _ = lax.scan(step, state0, cls.T)
+        return (final == OK) & (lengths >= 0)
+
+    def validate(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """bool [B] — row i's first lengths[i] bytes are well-formed
+        UTF-8 (rows with negative length report False)."""
+        return np.asarray(self._jit(jnp.asarray(batch),
+                                    jnp.asarray(lengths)))
+
+
+_validator: Optional[Utf8Validator] = None
+
+
+def validator() -> Utf8Validator:
+    global _validator
+    if _validator is None:
+        _validator = Utf8Validator()
+    return _validator

@@ -20,4 +20,5 @@ from . import filter_multiline  # noqa: F401
 from . import filter_kubernetes  # noqa: F401
 from . import filters_basic  # noqa: F401
 from . import filters_extra  # noqa: F401
+from . import filter_script  # noqa: F401
 from . import processors  # noqa: F401

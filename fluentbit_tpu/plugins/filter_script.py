@@ -1,0 +1,125 @@
+"""Extension-runtime filters: script (Python), lua + wasm gates.
+
+Reference layer 9 (SURVEY §1): the reference embeds out-of-language
+filter runtimes — LuaJIT (plugins/filter_lua, src/flb_lua.c) and WAMR
+(plugins/filter_wasm, src/wasm/flb_wasm.c). In this build Python IS the
+embedding language, so the idiomatic equivalent is a user-supplied
+Python callback with the filter_lua contract:
+
+    def cb_filter(tag, timestamp, record):
+        return code, timestamp, record
+
+    code -1 → drop the record
+          0 → keep unmodified
+          1 → record was modified
+          2 → record AND timestamp were modified
+
+``lua`` and ``wasm`` are registered as explicit gates (LuaJIT/WAMR are
+not vendored in this image) whose error points at ``script``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from ..codec.events import LogEvent
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+
+log = logging.getLogger("flb.script")
+
+
+@registry.register
+class ScriptFilter(FilterPlugin):
+    name = "script"
+    description = "user Python callback filter (filter_lua contract)"
+    config_map = [
+        ConfigMapEntry("script", "str", desc="path to the Python file"),
+        ConfigMapEntry("call", "str", default="cb_filter",
+                       desc="function name inside the script"),
+        ConfigMapEntry("code", "str",
+                       desc="inline script body (alternative to script)"),
+        ConfigMapEntry("protected_mode", "bool", default=True,
+                       desc="exceptions keep the record instead of "
+                            "failing the chain"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.script and not self.code:
+            raise ValueError("script filter requires 'script' or 'code'")
+        source = self.code
+        filename = "<inline>"
+        if self.script:
+            filename = self.script
+            with open(self.script, "r", encoding="utf-8") as f:
+                source = f.read()
+        namespace: dict = {}
+        exec(compile(source, filename, "exec"), namespace)  # noqa: S102
+        fn = namespace.get(self.call or "cb_filter")
+        if not callable(fn):
+            raise ValueError(
+                f"script filter: function {self.call!r} not found in "
+                f"{filename}"
+            )
+        self._fn: Callable = fn
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        out: List[LogEvent] = []
+        modified = False
+        for ev in events:
+            try:
+                code, ts, record = self._fn(tag, ev.ts_float, ev.body)
+                if code == -1:
+                    modified = True
+                    continue
+                if code == 0:
+                    out.append(ev)
+                    continue
+                new_ts = ts if code == 2 else ev.timestamp
+                if isinstance(record, list):
+                    # split: one input record → several outputs (the
+                    # filter_lua array return form)
+                    new_evs = [LogEvent(new_ts, dict(r), ev.metadata,
+                                        raw=None) for r in record]
+                else:
+                    new_evs = [LogEvent(new_ts, dict(record), ev.metadata,
+                                        raw=None)]
+            except Exception:
+                # protected mode covers the whole per-record handling —
+                # a bad return shape must not revert the batch
+                if not self.protected_mode:
+                    raise
+                log.exception("script filter callback failed")
+                out.append(ev)
+                continue
+            modified = True
+            out.extend(new_evs)
+        if not modified:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, out)
+
+
+class _GatedFilter(FilterPlugin):
+    runtime = ""
+
+    def init(self, instance, engine) -> None:
+        raise RuntimeError(
+            f"filter_{self.name}: the {self.runtime} runtime is not "
+            f"vendored in this build — the 'script' filter provides the "
+            f"same cb_filter contract in Python"
+        )
+
+
+@registry.register
+class LuaFilter(_GatedFilter):
+    name = "lua"
+    description = "gated: LuaJIT runtime not vendored (use 'script')"
+    runtime = "LuaJIT"
+
+
+@registry.register
+class WasmFilter(_GatedFilter):
+    name = "wasm"
+    description = "gated: WAMR runtime not vendored (use 'script')"
+    runtime = "WAMR"

@@ -114,16 +114,27 @@ class DiskInput(_IntervalInput):
         super().init(instance, engine)
         self._prev = None
 
+    _WHOLE_DISK = None  # compiled lazily
+
     def _read(self):
+        import re as _re
+
+        if DiskInput._WHOLE_DISK is None:
+            # whole disks only: sda yes, sda1 no; nvme0n1 yes,
+            # nvme0n1p1 no — the kernel double-accounts sectors in the
+            # partition AND parent rows
+            DiskInput._WHOLE_DISK = _re.compile(
+                r"^(?:sd[a-z]+|vd[a-z]+|xvd[a-z]+|nvme\d+n\d+)$"
+            )
         rd = wr = 0
         with open("/proc/diskstats") as f:
             for line in f:
                 parts = line.split()
                 name = parts[2]
-                if self.dev_name and name != self.dev_name:
-                    continue
-                if not self.dev_name and not name.startswith(
-                        ("sd", "nvme", "vd", "xvd")):
+                if self.dev_name:
+                    if name != self.dev_name:
+                        continue
+                elif not DiskInput._WHOLE_DISK.match(name):
                     continue
                 rd += int(parts[5]) * 512
                 wr += int(parts[9]) * 512
@@ -272,6 +283,33 @@ class HealthInput(_IntervalInput):
     ]
 
     def collect(self, engine) -> None:
+        """Collectors run ON the engine loop — the probe must not block
+        it, so schedule an async connect when a loop is running (tests
+        may call collect() synchronously, where blocking is fine)."""
+        import asyncio
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            self._probe_blocking(engine)
+            return
+        asyncio.ensure_future(self._probe_async(engine))
+
+    async def _probe_async(self, engine) -> None:
+        t0 = time.perf_counter()
+        try:
+            import asyncio
+
+            _r, w = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), 2.0
+            )
+            w.close()
+            alive = True
+        except Exception:
+            alive = False
+        self._emit_probe(engine, alive, t0)
+
+    def _probe_blocking(self, engine) -> None:
         t0 = time.perf_counter()
         try:
             s = socket.create_connection((self.host, self.port), timeout=2)
@@ -279,6 +317,9 @@ class HealthInput(_IntervalInput):
             alive = True
         except OSError:
             alive = False
+        self._emit_probe(engine, alive, t0)
+
+    def _emit_probe(self, engine, alive: bool, t0: float) -> None:
         if self.alert and alive:
             return
         body: Dict[str, object] = {"alive": alive}

@@ -53,8 +53,10 @@ class _HttpDeliveryOutput(OutputPlugin):
     def _headers(self) -> List[str]:
         return []
 
-    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
-        body = self.format(data, tag)
+    CONNECT_TIMEOUT = 10.0  # net.connect_timeout default (flb_upstream)
+    IO_TIMEOUT = 30.0
+
+    async def _post(self, body: bytes) -> FlushResult:
         headers = [
             f"POST {self._uri()} HTTP/1.1",
             f"Host: {self.host}:{self.port}",
@@ -63,20 +65,26 @@ class _HttpDeliveryOutput(OutputPlugin):
             "Connection: close",
         ] + self._headers()
         try:
-            reader, writer = await asyncio.open_connection(self.host,
-                                                           self.port)
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                self.CONNECT_TIMEOUT,
+            )
             writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
-            await writer.drain()
-            status_line = await reader.readline()
+            await asyncio.wait_for(writer.drain(), self.IO_TIMEOUT)
+            status_line = await asyncio.wait_for(reader.readline(),
+                                                 self.IO_TIMEOUT)
             writer.close()
             status = int(status_line.split()[1])
-        except (OSError, IndexError, ValueError):
+        except (OSError, IndexError, ValueError, asyncio.TimeoutError):
             return FlushResult.RETRY
         if 200 <= status < 300:
             return FlushResult.OK
         if status >= 500 or status in (408, 429):
             return FlushResult.RETRY
         return FlushResult.ERROR
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        return await self._post(self.format(data, tag))
 
 
 @registry.register
@@ -226,9 +234,14 @@ class SplunkOutput(_HttpDeliveryOutput):
         return ([f"Authorization: Splunk {self.splunk_token}"]
                 if self.splunk_token else [])
 
+    def init(self, instance, engine) -> None:
+        # static-config accessor: build once, not per flush
+        self._event_ra = (RecordAccessor(self.event_key)
+                          if self.event_key else None)
+
     def format(self, data: bytes, tag: str) -> bytes:
         out: List[str] = []
-        ekey = RecordAccessor(self.event_key) if self.event_key else None
+        ekey = self._event_ra
         for ev in decode_events(data):
             if self.splunk_send_raw:
                 out.append(_dumps(ev.body))
@@ -301,6 +314,11 @@ class GelfOutput(_HttpDeliveryOutput):
     ]
 
     def format(self, data: bytes, tag: str) -> bytes:
+        return "\n".join(
+            m.decode() for m in self.format_messages(data, tag)
+        ).encode()
+
+    def format_messages(self, data: bytes, tag: str) -> List[bytes]:
         out = []
         for ev in decode_events(data):
             body = dict(ev.body)
@@ -314,8 +332,16 @@ class GelfOutput(_HttpDeliveryOutput):
             }
             for k, v in body.items():
                 msg[f"_{k}"] = v  # GELF additional fields
-            out.append(_dumps(msg))
-        return "\n".join(out).encode()
+            out.append(_dumps(msg).encode())
+        return out
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        # GELF HTTP inputs accept exactly ONE JSON message per request
+        for msg in self.format_messages(data, tag):
+            res = await self._post(msg)
+            if res != FlushResult.OK:
+                return res
+        return FlushResult.OK
 
 
 @registry.register

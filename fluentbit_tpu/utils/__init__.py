@@ -1,0 +1,102 @@
+"""Foundation utilities — compression, crypto, encoding.
+
+Reference: src/flb_gzip.c, src/flb_snappy.c, src/flb_zstd.c,
+src/flb_compression.c (payload compression for outputs/forward);
+src/flb_crypto.c, src/flb_hmac.c, src/flb_base64.c, src/flb_uri.c,
+src/flb_utf8.c (hashing, signing, encoding). Python's stdlib provides
+gzip/zlib/base64/hmac/hashlib; snappy and zstd have no vendored
+equivalents in this image and are gated — ``compress('snappy', ...)``
+raises a clear error instead of silently passing data through.
+"""
+
+from __future__ import annotations
+
+import base64 as _b64
+import gzip as _gzip
+import hashlib
+import hmac as _hmac
+import urllib.parse as _url
+import zlib
+from typing import Optional
+
+
+class CompressionError(ValueError):
+    pass
+
+
+_GATED = {"snappy", "zstd", "lz4"}
+
+
+def compress(algo: str, data: bytes, level: int = 6) -> bytes:
+    """flb_compression_compress equivalent."""
+    a = (algo or "gzip").lower()
+    if a == "gzip":
+        return _gzip.compress(data, compresslevel=level)
+    if a in ("zlib", "deflate"):
+        return zlib.compress(data, level)
+    if a in _GATED:
+        raise CompressionError(
+            f"{a} is not available in this build (no vendored codec); "
+            f"use gzip or zlib"
+        )
+    raise CompressionError(f"unknown compression algorithm {algo!r}")
+
+
+def decompress(algo: str, data: bytes) -> bytes:
+    a = (algo or "gzip").lower()
+    if a == "gzip":
+        return _gzip.decompress(data)
+    if a in ("zlib", "deflate"):
+        return zlib.decompress(data)
+    if a in _GATED:
+        raise CompressionError(
+            f"{a} is not available in this build (no vendored codec)"
+        )
+    raise CompressionError(f"unknown compression algorithm {algo!r}")
+
+
+def crc32(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+# -- crypto (flb_crypto/flb_hmac: SHA-family digests + HMAC signing) --
+
+_DIGESTS = {"sha256", "sha512", "sha1", "md5", "sha384", "sha224"}
+
+
+def digest(algo: str, data: bytes) -> bytes:
+    a = algo.lower().replace("-", "")
+    if a not in _DIGESTS:
+        raise ValueError(f"unsupported digest {algo!r}")
+    return hashlib.new(a, data).digest()
+
+
+def hmac_sign(algo: str, key: bytes, data: bytes) -> bytes:
+    a = algo.lower().replace("-", "")
+    if a not in _DIGESTS:
+        raise ValueError(f"unsupported digest {algo!r}")
+    return _hmac.new(key, data, a).digest()
+
+
+# -- encoding (flb_base64 / flb_uri) --
+
+def base64_encode(data: bytes) -> bytes:
+    return _b64.b64encode(data)
+
+
+def base64_decode(data: bytes) -> bytes:
+    return _b64.b64decode(data)
+
+
+def uri_encode(text: str, safe: str = "/") -> str:
+    return _url.quote(text, safe=safe)
+
+
+def uri_decode(text: str) -> str:
+    return _url.unquote(text)
+
+
+def uri_field(uri: str, index: int) -> Optional[str]:
+    """flb_uri_get: the Nth path segment of a URI (1-based)."""
+    parts = [p for p in uri.split("?")[0].split("/") if p]
+    return parts[index - 1] if 1 <= index <= len(parts) else None

@@ -1,0 +1,160 @@
+"""Device UTF-8 validation kernel, compression/crypto utils, and the
+script extension-runtime filter.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.ops.batch import assemble
+from fluentbit_tpu.ops.utf8 import Utf8Validator, validate_bytes
+from fluentbit_tpu import utils
+
+
+# ------------------------------------------------------------------ utf8
+
+GOOD = [
+    b"plain ascii",
+    "héllo wörld".encode(),
+    "日本語テキスト".encode(),
+    "🎉🚀 emoji".encode(),
+    "\U0010FFFF".encode(),  # max code point
+    b"",
+]
+BAD = [
+    b"\x80midstream",            # lone continuation
+    b"\xc0\xaf",                 # overlong '/'
+    b"\xc1\xbf",                 # C1 always invalid
+    b"\xe0\x80\x80",             # overlong 3-byte
+    b"\xed\xa0\x80",             # UTF-16 surrogate D800
+    b"\xf0\x80\x80\x80",         # overlong 4-byte
+    b"\xf4\x90\x80\x80",         # > U+10FFFF
+    b"\xf5\x80\x80\x80",         # F5 lead invalid
+    b"truncated \xe6\x97",       # cut sequence
+    b"\xff",
+]
+
+
+def test_cpu_oracle():
+    for g in GOOD:
+        assert validate_bytes(g), g
+    for b in BAD:
+        assert not validate_bytes(b), b
+
+
+def test_device_kernel_matches_oracle():
+    vals = GOOD + BAD
+    staged = assemble(vals, 64)
+    got = Utf8Validator().validate(staged.batch, staged.lengths)
+    want = [validate_bytes(v) for v in vals]
+    assert got.tolist() == want
+
+
+def test_device_kernel_python_stdlib_differential():
+    import random
+
+    rng = random.Random(1)
+    vals = []
+    for _ in range(300):
+        raw = bytes(rng.randrange(256) for _ in range(rng.randrange(24)))
+        vals.append(raw)
+    staged = assemble(vals, 32)
+    got = Utf8Validator().validate(staged.batch, staged.lengths)
+    for v, g in zip(vals, got):
+        try:
+            v.decode("utf-8")
+            ok = True
+        except UnicodeDecodeError:
+            ok = False
+        assert bool(g) == ok, v
+
+
+# ----------------------------------------------------------------- utils
+
+def test_compression_roundtrip_and_gates():
+    data = b"payload " * 100
+    for algo in ("gzip", "zlib"):
+        assert utils.decompress(algo, utils.compress(algo, data)) == data
+    with pytest.raises(utils.CompressionError):
+        utils.compress("snappy", data)
+    with pytest.raises(utils.CompressionError):
+        utils.compress("nope", data)
+
+
+def test_crypto_encoding():
+    assert utils.digest("sha256", b"x").hex().startswith("2d711642")
+    assert utils.hmac_sign("sha256", b"k", b"m")
+    assert utils.base64_decode(utils.base64_encode(b"abc")) == b"abc"
+    assert utils.uri_decode(utils.uri_encode("a b/c")) == "a b/c"
+    assert utils.uri_field("/api/v1/metrics", 2) == "v1"
+    assert utils.uri_field("/api", 9) is None
+    assert utils.crc32(b"123456789") == 0xCBF43926  # CRC-32 check value
+
+
+# ---------------------------------------------------------------- script
+
+SCRIPT = """
+def cb_filter(tag, ts, record):
+    if record.get("drop"):
+        return -1, ts, record
+    if record.get("split"):
+        return 1, ts, [{"part": 1}, {"part": 2}]
+    if "n" in record:
+        record["n2"] = record["n"] * 2
+        return 1, ts, record
+    return 0, ts, record
+"""
+
+
+def test_script_filter_contract(tmp_path):
+    path = tmp_path / "cb.py"
+    path.write_text(SCRIPT)
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("script", match="t", script=str(path))
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"n": 21}))
+        ctx.push(in_ffd, json.dumps({"drop": True}))
+        ctx.push(in_ffd, json.dumps({"keep": "as-is"}))
+        ctx.push(in_ffd, json.dumps({"split": True}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    bodies = [e.body for d in got for e in decode_events(d)]
+    assert {"n": 21, "n2": 42} in bodies
+    assert {"keep": "as-is"} in bodies
+    assert {"part": 1} in bodies and {"part": 2} in bodies
+    assert not any(b.get("drop") for b in bodies)
+
+
+def test_script_inline_code_and_protected_mode():
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("script", match="t",
+               code="def cb_filter(tag, ts, r):\n    raise RuntimeError('x')")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"survives": 1}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    bodies = [e.body for d in got for e in decode_events(d)]
+    assert bodies == [{"survives": 1}]  # protected mode keeps the record
+
+
+def test_lua_wasm_gated():
+    from fluentbit_tpu.core.plugin import registry
+
+    for name in ("lua", "wasm"):
+        ins = registry.create_filter(name)
+        ins.configure()
+        with pytest.raises(RuntimeError, match="script"):
+            ins.plugin.init(ins, None)
