@@ -40,6 +40,8 @@ Options:
   -D, --define K=V      set a config variable for ${K} interpolation
   -v, --verbose         increase log verbosity (repeatable)
   -q, --quiet           decrease log verbosity
+  --supervisor          run under a supervising parent that restarts
+                        the worker on crash
   --dry-run             validate configuration and exit
   -V, --version         print version and exit
   -h, --help            this message
@@ -137,6 +139,14 @@ def main(argv=None) -> int:
     if not argv:
         print(USAGE)
         return 1
+    if "--supervisor" in argv:
+        # flb_supervisor_run: parent forks + restarts the worker
+        from .supervisor import run_supervised
+
+        worker_argv = [a for a in argv if a != "--supervisor"]
+        logging.basicConfig(level=logging.INFO,
+                            format="[%(asctime)s] [%(levelname)5s] %(message)s")
+        return run_supervised(lambda: main(worker_argv))
     ctx, verbosity, dry_run, config_path, env = build_context(argv)
     level = {-1: logging.ERROR, 0: logging.INFO, 1: logging.DEBUG}.get(
         max(-1, min(1, verbosity)), logging.INFO

@@ -73,6 +73,7 @@ class Engine:
         self.parsers: Dict[str, Any] = {}  # named parsers (flb_parser registry)
         self.ml_parsers: Dict[str, Any] = {}  # multiline parsers (flb_ml)
         self.sp = None  # stream processor (flb_sp), created on first task
+        self.traces: Dict[str, dict] = {}  # chunk-trace "tap" contexts
         self._ingest_src = None  # input currently appending (under lock)
 
         self._backlog: List[Chunk] = []  # recovered chunks to re-dispatch
@@ -213,6 +214,70 @@ class Engine:
             # ourselves
             self.ensure_collector(ins)
         return task
+
+    def enable_trace(self, input_name: str, output_tag: str = "trace") -> bool:
+        """Chunk trace "tap" (src/flb_chunk_trace.c:184-203): stamp each
+        append's journey — input + per-filter before/after with timing —
+        and re-emit the stamps through a hidden emitter under
+        ``output_tag`` so they flow the normal pipeline. Enabled per
+        input (CLI -Z / HTTP api/v1/trace equivalent)."""
+        target = None
+        for ins in self.inputs:
+            if input_name in (ins.name, ins.display_name):
+                target = ins
+                break
+        if target is None:
+            return False
+        if target.name in self.traces:  # canonical key: dedup aliases
+            return True
+        emitter = self.hidden_input(
+            "emitter", alias=f"trace_emitter_{target.name}"
+        )
+        self.traces[target.name] = {
+            "input": target,
+            "output_tag": output_tag,
+            "emitter": emitter.plugin,
+            "emitter_instance": emitter,
+            "count": 0,
+        }
+        return True
+
+    def disable_trace(self, input_name: str) -> bool:
+        key = input_name
+        if key not in self.traces:
+            for name, ctx in self.traces.items():
+                if ctx["input"].display_name == input_name:
+                    key = name
+                    break
+        ctx = self.traces.pop(key, None)
+        if ctx is None:
+            return False
+        # drop the hidden emitter too — repeated enable/disable cycles
+        # must not accumulate dead inputs
+        try:
+            self.inputs.remove(ctx["emitter_instance"])
+        except ValueError:
+            pass
+        return True
+
+    def _trace_ctx(self, ins) -> Optional[dict]:
+        if not self.traces:
+            return None
+        for key in (ins.name, ins.display_name):
+            ctx = self.traces.get(key)
+            if ctx is not None and ctx["input"] is ins:
+                return ctx
+        return None
+
+    def _trace_emit(self, ctx: dict, body: dict) -> None:
+        from ..codec.events import encode_event, now_event_time
+
+        try:
+            ctx["emitter"].add_record(
+                ctx["output_tag"], encode_event(body, now_event_time()), 1
+            )
+        except Exception:
+            log.exception("chunk trace emit failed")
 
     def ensure_collector(self, ins: InputInstance) -> None:
         """Schedule a collector for an input created after start()
@@ -434,6 +499,7 @@ class Engine:
             if (
                 not ins.processors
                 and not sp_active
+                and self._trace_ctx(ins) is None
                 and all(
                     getattr(f.plugin, "can_filter_raw", lambda: False)()
                     for f in matching
@@ -455,8 +521,21 @@ class Engine:
                 if not events:
                     return 0
 
+            # chunk trace: input stamp (flb_chunk_trace_do_input,
+            # src/flb_input_chunk.c:3049)
+            trace_ctx = self._trace_ctx(ins)
+            if trace_ctx is not None:
+                trace_ctx["count"] += 1
+                trace_ctx["trace_id"] = trace_id = \
+                    f"{ins.name}.{trace_ctx['count']}"
+                self._trace_emit(trace_ctx, {
+                    "type": "input", "trace_id": trace_id,
+                    "input_instance": ins.display_name, "tag": tag,
+                    "records": n_records,
+                })
+
             # filter chain — synchronous, pre-storage
-            events = self._run_filters(events, tag)
+            events = self._run_filters(events, tag, trace_ctx)
             if not events:
                 return 0
 
@@ -552,19 +631,34 @@ class Engine:
             log.exception("metrics processor pipeline failed")
             return data
 
-    def _run_filters(self, events: List[LogEvent], tag: str) -> List[LogEvent]:
-        """flb_filter_do equivalent (src/flb_filter.c:119-330)."""
+    def _run_filters(self, events: List[LogEvent], tag: str,
+                     trace_ctx: Optional[dict] = None) -> List[LogEvent]:
+        """flb_filter_do equivalent (src/flb_filter.c:119-330), with the
+        chunk-trace per-filter stamps (flb_chunk_trace_filter hooks,
+        src/flb_filter.c:248,312) when a tap is active."""
         for f in self.filters:
             if not events:
                 break
             if not f.route.matches(tag):
                 continue
             before = len(events)
+            t0 = time.perf_counter_ns() if trace_ctx is not None else 0
             try:
                 result, new_events = f.plugin.filter(events, tag, self)
             except Exception:
                 log.exception("filter %s failed", f.display_name)
                 continue
+            if trace_ctx is not None:
+                after = (len(new_events) if new_events is not None else 0) \
+                    if result == FilterResult.MODIFIED else before
+                self._trace_emit(trace_ctx, {
+                    "type": "filter",
+                    "trace_id": trace_ctx.get("trace_id", ""),
+                    "filter_instance": f.display_name,
+                    "records_in": before,
+                    "records_out": after,
+                    "elapsed_ns": time.perf_counter_ns() - t0,
+                })
             if result == FilterResult.MODIFIED:
                 events = new_events if new_events is not None else []
                 # modified events lose raw identity unless the filter kept it

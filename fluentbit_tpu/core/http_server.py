@@ -59,8 +59,10 @@ class AdminServer:
                 req = await read_http_request(reader)
                 if req is None:
                     break
-                method, uri, headers, _body = req
-                status, body, ctype = self._route(method, uri.split("?")[0])
+                method, uri, headers, req_body = req
+                status, body, ctype = self._route(
+                    method, uri.split("?")[0], req_body
+                )
                 writer.write(http_response(status, body, ctype))
                 await writer.drain()
                 if headers.get("connection", "").lower() == "close":
@@ -73,8 +75,10 @@ class AdminServer:
             except Exception:
                 pass
 
-    def _route(self, method: str, path: str):
+    def _route(self, method: str, path: str, req_body: bytes = b""):
         e = self.engine
+        if path.startswith("/api/v1/trace"):
+            return self._route_trace(method, path, req_body)
         if path == "/":
             return 200, json.dumps(
                 {"fluentbit_tpu": {"version": "0.2.0",
@@ -127,3 +131,39 @@ class AdminServer:
                 {"hot_reload_count": e.reload_count}
             ).encode(), "application/json"
         return 404, b"not found\n", "text/plain"
+
+    def _route_trace(self, method: str, path: str, req_body: bytes):
+        """Chunk-trace control (src/http_server/api/v1/trace.c):
+        GET /api/v1/trace — active taps; POST/DELETE
+        /api/v1/trace/<input> — enable/disable."""
+        e = self.engine
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 3:  # /api/v1/trace
+            if method == "GET":
+                return 200, json.dumps({
+                    "inputs": {
+                        name: {"output_tag": ctx["output_tag"],
+                               "chunks": ctx["count"]}
+                        for name, ctx in e.traces.items()
+                    }
+                }).encode(), "application/json"
+            return 400, b'{"error": "input name required"}\n', \
+                "application/json"
+        input_name = parts[3]
+        if method == "POST":
+            output_tag = "trace"
+            if req_body:
+                try:
+                    obj = json.loads(req_body)
+                    if isinstance(obj, dict):
+                        output_tag = obj.get("output_tag", "trace")
+                except ValueError:
+                    pass
+            if e.enable_trace(input_name, output_tag):
+                return 200, b'{"status": "ok"}\n', "application/json"
+            return 404, b'{"error": "unknown input"}\n', "application/json"
+        if method == "DELETE":
+            if e.disable_trace(input_name):
+                return 200, b'{"status": "ok"}\n', "application/json"
+            return 404, b'{"error": "no trace active"}\n', "application/json"
+        return 400, b"", "application/json"

@@ -11,6 +11,7 @@ interval collectors emitting one record per tick.
 from __future__ import annotations
 
 import os
+import re
 import socket
 import time
 from typing import Dict, Optional
@@ -114,18 +115,13 @@ class DiskInput(_IntervalInput):
         super().init(instance, engine)
         self._prev = None
 
-    _WHOLE_DISK = None  # compiled lazily
+    # whole disks only: sda yes, sda1 no; nvme0n1 yes, nvme0n1p1 no —
+    # the kernel double-accounts sectors in partition AND parent rows
+    _WHOLE_DISK = re.compile(
+        r"^(?:sd[a-z]+|vd[a-z]+|xvd[a-z]+|nvme\d+n\d+)$"
+    )
 
     def _read(self):
-        import re as _re
-
-        if DiskInput._WHOLE_DISK is None:
-            # whole disks only: sda yes, sda1 no; nvme0n1 yes,
-            # nvme0n1p1 no — the kernel double-accounts sectors in the
-            # partition AND parent rows
-            DiskInput._WHOLE_DISK = _re.compile(
-                r"^(?:sd[a-z]+|vd[a-z]+|xvd[a-z]+|nvme\d+n\d+)$"
-            )
         rd = wr = 0
         with open("/proc/diskstats") as f:
             for line in f:
@@ -293,7 +289,14 @@ class HealthInput(_IntervalInput):
         except RuntimeError:
             self._probe_blocking(engine)
             return
-        asyncio.ensure_future(self._probe_async(engine))
+        # hold a strong reference: the loop keeps only weak refs and a
+        # GC pass could collect an in-flight probe
+        tasks = getattr(self, "_probe_tasks", None)
+        if tasks is None:
+            tasks = self._probe_tasks = set()
+        t = asyncio.ensure_future(self._probe_async(engine))
+        tasks.add(t)
+        t.add_done_callback(tasks.discard)
 
     async def _probe_async(self, engine) -> None:
         t0 = time.perf_counter()

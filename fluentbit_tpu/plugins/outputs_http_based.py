@@ -64,6 +64,7 @@ class _HttpDeliveryOutput(OutputPlugin):
             f"Content-Type: {self._content_type()}",
             "Connection: close",
         ] + self._headers()
+        writer = None
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(self.host, self.port),
@@ -73,10 +74,15 @@ class _HttpDeliveryOutput(OutputPlugin):
             await asyncio.wait_for(writer.drain(), self.IO_TIMEOUT)
             status_line = await asyncio.wait_for(reader.readline(),
                                                  self.IO_TIMEOUT)
-            writer.close()
             status = int(status_line.split()[1])
         except (OSError, IndexError, ValueError, asyncio.TimeoutError):
             return FlushResult.RETRY
+        finally:
+            if writer is not None:  # never leak the socket on timeout
+                try:
+                    writer.close()
+                except Exception:
+                    pass
         if 200 <= status < 300:
             return FlushResult.OK
         if status >= 500 or status in (408, 429):

@@ -1,0 +1,316 @@
+"""Self-telemetry + protocol extras: in_fluentbit_metrics,
+in_fluentbit_logs, in_statsd, out_syslog, processor_template,
+processor cumulative_to_delta.
+
+Reference: plugins/in_fluentbit_metrics (internal cmetrics → the
+metrics pipeline), plugins/in_fluentbit_logs (the agent's own logs
+self-ingested, flb_log_pipeline_enable src/flb_engine.c:922-924),
+plugins/in_statsd (UDP statsd datagrams), plugins/out_syslog (rfc5424
+framing over tcp/udp), plugins/processor_template,
+plugins/processor_cumulative_to_delta (counter → delta conversion).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.chunk import EVENT_TYPE_METRICS
+from ..codec.events import encode_event, iter_events, now_event_time
+from ..codec.msgpack import packb
+from ..core.config import ConfigMapEntry
+from ..core.plugin import (
+    FlushResult,
+    InputPlugin,
+    OutputPlugin,
+    ProcessorPlugin,
+    registry,
+)
+from ..core.record_accessor import Template
+
+log = logging.getLogger("flb")
+
+
+@registry.register
+class FluentbitMetricsInput(InputPlugin):
+    """Internal metrics flow AS DATA through the pipeline."""
+
+    name = "fluentbit_metrics"
+    description = "scrape the engine's internal metrics into the pipeline"
+    config_map = [
+        ConfigMapEntry("scrape_interval", "time", default="2"),
+        ConfigMapEntry("scrape_on_start", "bool", default=False),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.scrape_interval or 2)
+        if self.scrape_on_start and engine is not None:
+            self.collect(engine)
+
+    def collect(self, engine) -> None:
+        payload = packb(engine.metrics.to_msgpack_obj())
+        engine.input_event_append(
+            self.instance, self.instance.tag, payload, EVENT_TYPE_METRICS,
+            n_records=len(list(engine.metrics.metrics())),
+        )
+
+
+class _PipelineLogHandler(logging.Handler):
+    def __init__(self, plugin):
+        super().__init__()
+        self.plugin = plugin
+        self.buffer: List[dict] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if self.plugin._emitting:
+            return  # the ingest path itself may log: no recursion
+        try:
+            self.buffer.append({
+                "message": record.getMessage(),
+                "level": record.levelname.lower(),
+                "logger": record.name,
+            })
+        except Exception:  # pragma: no cover
+            pass
+
+
+@registry.register
+class FluentbitLogsInput(InputPlugin):
+    """The agent's own log stream, self-ingested."""
+
+    name = "fluentbit_logs"
+    description = "self-ingest the engine's own logs"
+    collect_interval = 0.5
+
+    def init(self, instance, engine) -> None:
+        self._emitting = False
+        self._handler = _PipelineLogHandler(self)
+        logging.getLogger("flb").addHandler(self._handler)
+
+    def exit(self) -> None:
+        logging.getLogger("flb").removeHandler(self._handler)
+
+    def collect(self, engine) -> None:
+        buffered, self._handler.buffer = self._handler.buffer, []
+        if not buffered:
+            return
+        self._emitting = True
+        try:
+            out = bytearray()
+            for body in buffered:
+                out += encode_event(body, now_event_time())
+            engine.input_log_append(
+                self.instance, self.instance.tag, bytes(out), len(buffered)
+            )
+        finally:
+            self._emitting = False
+
+
+@registry.register
+class StatsdInput(InputPlugin):
+    """UDP statsd datagrams → records."""
+
+    name = "statsd"
+    description = "statsd UDP server"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=8125),
+        ConfigMapEntry("metrics", "bool", default=False),
+    ]
+
+    TYPES = {"c": "counter", "g": "gauge", "ms": "timer", "s": "set",
+             "h": "histogram"}
+
+    def init(self, instance, engine) -> None:
+        self.bound_port: Optional[int] = None
+
+    def _parse(self, line: str) -> Optional[dict]:
+        # name:value|type[|@rate]
+        if ":" not in line or "|" not in line:
+            return None
+        name, _, rest = line.partition(":")
+        parts = rest.split("|")
+        if len(parts) < 2:
+            return None
+        tname = self.TYPES.get(parts[1].strip())
+        if tname is None:
+            return None
+        body: Dict[str, object] = {"name": name.strip(), "type": tname}
+        try:
+            v = parts[0].strip()
+            body["value"] = float(v) if tname != "set" else v
+        except ValueError:
+            return None
+        for extra in parts[2:]:
+            if extra.startswith("@"):
+                try:
+                    body["sample_rate"] = float(extra[1:])
+                except ValueError:
+                    pass
+        return body
+
+    def _emit_payload(self, engine, data: bytes) -> None:
+        out = bytearray()
+        n = 0
+        for raw in data.split(b"\n"):
+            line = raw.strip().decode("utf-8", "replace")
+            if not line:
+                continue
+            body = self._parse(line)
+            if body is None:
+                log.debug("statsd: malformed metric %r", line)
+                continue
+            out += encode_event(body, now_event_time())
+            n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+
+    async def start_server(self, engine) -> None:
+        plugin = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                plugin._emit_payload(engine, data)
+
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(self.listen, self.port)
+        )
+        self.bound_port = transport.get_extra_info("sockname")[1]
+        try:
+            await asyncio.Event().wait()
+        finally:
+            transport.close()
+
+
+@registry.register
+class SyslogOutput(OutputPlugin):
+    """rfc5424 framing to a remote syslog endpoint (tcp/udp)."""
+
+    name = "syslog"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=514),
+        ConfigMapEntry("mode", "str", default="udp"),
+        ConfigMapEntry("syslog_format", "str", default="rfc5424"),
+        ConfigMapEntry("syslog_severity_key", "str"),
+        ConfigMapEntry("syslog_hostname_key", "str"),
+        ConfigMapEntry("syslog_appname_key", "str"),
+        ConfigMapEntry("syslog_message_key", "str", default="log"),
+    ]
+
+    SEVERITIES = {"emerg": 0, "alert": 1, "crit": 2, "error": 3, "err": 3,
+                  "warning": 4, "warn": 4, "notice": 5, "info": 6,
+                  "debug": 7}
+
+    def init(self, instance, engine) -> None:
+        self._writer = None
+
+    def format_message(self, ev, tag: str) -> bytes:
+        body = ev.body if isinstance(ev.body, dict) else {}
+        sev = 6
+        if self.syslog_severity_key:
+            sev = self.SEVERITIES.get(
+                str(body.get(self.syslog_severity_key, "info")).lower(), 6)
+        pri = 1 * 8 + sev  # facility user-level
+        host = str(body.get(self.syslog_hostname_key or "", "") or "-")
+        app = str(body.get(self.syslog_appname_key or "", "") or tag)
+        msg = str(body.get(self.syslog_message_key or "log", ""))
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ev.ts_float))
+        frac = int((ev.ts_float % 1) * 1e6)
+        return (f"<{pri}>1 {ts}.{frac:06d}Z {host} {app} - - - "
+                f"{msg}").encode()
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        msgs = [self.format_message(ev, tag) for ev in iter_events(data)]
+        mode = (self.mode or "udp").lower()
+        try:
+            if mode == "udp":
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                for m in msgs:
+                    s.sendto(m, (self.host, self.port))
+                s.close()
+            else:
+                if self._writer is None or self._writer.is_closing():
+                    _r, self._writer = await asyncio.wait_for(
+                        asyncio.open_connection(self.host, self.port), 10
+                    )
+                for m in msgs:
+                    # octet-counted framing (rfc6587)
+                    self._writer.write(str(len(m)).encode() + b" " + m)
+                await asyncio.wait_for(self._writer.drain(), 30)
+        except (OSError, asyncio.TimeoutError):
+            if self._writer is not None:
+                try:
+                    self._writer.close()  # never leak the broken socket
+                except Exception:
+                    pass
+            self._writer = None
+            return FlushResult.RETRY
+        return FlushResult.OK
+
+
+@registry.register
+class TemplateProcessor(ProcessorPlugin):
+    """plugins/processor_template: render a new field from a template
+    with record-accessor variables."""
+
+    name = "template"
+    description = "add a field rendered from a template"
+    config_map = [
+        ConfigMapEntry("key", "str"),
+        ConfigMapEntry("template", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.key or self.template is None:
+            raise ValueError("template processor requires key + template")
+        self._tpl = Template(self.template)
+
+    def process_logs(self, events: list, tag: str, engine) -> list:
+        from ..codec.events import LogEvent
+
+        out = []
+        for ev in events:
+            if not isinstance(ev.body, dict):
+                out.append(ev)
+                continue
+            body = dict(ev.body)
+            body[self.key] = self._tpl.render(record=ev.body, tag=tag)
+            out.append(LogEvent(ev.timestamp, body, ev.metadata, raw=None))
+        return out
+
+
+@registry.register
+class CumulativeToDeltaProcessor(ProcessorPlugin):
+    """plugins/processor_cumulative_to_delta: convert counter samples
+    from cumulative totals to per-snapshot deltas (monotonic resets
+    pass the new value through, the standard delta convention)."""
+
+    name = "cumulative_to_delta"
+    description = "convert cumulative counters to deltas"
+    config_map = []
+
+    def init(self, instance, engine) -> None:
+        self._prev: Dict[Tuple[str, tuple], float] = {}
+
+    def process_metrics(self, payloads: list, tag: str, engine) -> list:
+        for payload in payloads:
+            for m in payload.get("metrics", []):
+                if m.get("type") != "counter":
+                    continue
+                for s in m.get("values", []):
+                    key = (m.get("name", ""), tuple(s.get("labels", [])))
+                    cur = float(s.get("value", 0.0))
+                    prev = self._prev.get(key)
+                    self._prev[key] = cur
+                    if prev is None or cur < prev:  # first sample / reset
+                        s["value"] = cur
+                    else:
+                        s["value"] = cur - prev
+        return payloads

@@ -1,0 +1,93 @@
+"""Supervisor — parent process that restarts crashed workers.
+
+Reference: src/flb_supervisor.c (supervisor_spawn fork :384-415,
+waitpid monitor :314-375, restart-on-request/crash, grace
+propagation :268-285). The CLI's ``--supervisor`` flag wraps the run
+in this loop: fork a worker running the pipeline; on abnormal exit
+(signal/crash) restart it with exponential backoff; SIGTERM/SIGINT
+forward to the worker and stop; SIGHUP forwards (hot reload happens
+inside the worker).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger("flb.supervisor")
+
+RESTART_BACKOFF_BASE = 1.0
+RESTART_BACKOFF_CAP = 30.0
+#: a nonzero exit faster than this is a startup error (bad config), not
+#: a crash — restarting would loop forever on a fatal condition
+MIN_UPTIME_FOR_RESTART = 2.0
+
+
+def run_supervised(worker_main: Callable[[], int],
+                   max_restarts: Optional[int] = None) -> int:
+    """Fork/monitor loop. Returns the final worker exit code."""
+    restarts = 0
+    stopping = {"flag": False}
+    child = {"pid": 0}
+
+    def forward(signum, frame):
+        if signum in (signal.SIGTERM, signal.SIGINT):
+            stopping["flag"] = True
+        if child["pid"]:
+            try:
+                os.kill(child["pid"], signum)
+            except ProcessLookupError:
+                pass
+
+    for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        signal.signal(sig, forward)
+
+    while True:
+        started = time.time()
+        pid = os.fork()
+        if pid == 0:
+            # worker: default signal dispositions; run the pipeline
+            for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+                signal.signal(sig, signal.SIG_DFL)
+            os._exit(worker_main())
+        child["pid"] = pid
+        log.info("supervisor: worker started (pid %d)", pid)
+        while True:
+            try:
+                _, status = os.waitpid(pid, 0)
+                break
+            except InterruptedError:
+                continue
+        child["pid"] = 0
+        if os.WIFEXITED(status):
+            code = os.WEXITSTATUS(status)
+            if stopping["flag"] or code == 0:
+                log.info("supervisor: worker exited (%d)", code)
+                return code
+            if time.time() - started < MIN_UPTIME_FOR_RESTART:
+                # fast nonzero exit = fatal startup error, not a crash
+                log.error("supervisor: worker failed at startup "
+                          "(exit %d) — not restarting", code)
+                return code
+            reason = f"exit code {code}"
+        else:
+            if stopping["flag"]:
+                return 0
+            reason = f"signal {os.WTERMSIG(status)}"
+        restarts += 1
+        if max_restarts is not None and restarts > max_restarts:
+            log.error("supervisor: giving up after %d restarts", restarts - 1)
+            return 1
+        delay = min(RESTART_BACKOFF_CAP,
+                    RESTART_BACKOFF_BASE * (2 ** min(restarts - 1, 6)))
+        log.warning("supervisor: worker died (%s); restart #%d in %.1fs",
+                    reason, restarts, delay)
+        deadline = time.time() + delay
+        while time.time() < deadline and not stopping["flag"]:
+            time.sleep(0.1)
+        if stopping["flag"]:
+            return 1
