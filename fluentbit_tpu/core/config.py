@@ -194,7 +194,7 @@ CORE_INSTANCE_KEYS = {
     "tag", "match", "match_regex", "alias", "log_level",
     "mem_buf_limit", "storage.type", "storage.pause_on_chunks_overlimit",
     "threaded", "workers", "retry_limit", "no_multiplex", "host", "port", "tls",
-    "tls.verify", "tls.ca_file", "tls.crt_file", "tls.key_file",
+    "tls.verify", "tls.ca_file", "tls.crt_file", "tls.key_file", "tls.vhost",
 }
 
 

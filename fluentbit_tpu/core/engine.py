@@ -740,7 +740,20 @@ class Engine:
         (src/flb_engine_dispatch.c:193-207 + flb_output_thread.c):
         FLB_OUTPUT_SYNCHRONOUS / no_multiplex serialize to one in-flight
         flush per output; ``workers N`` bounds concurrency to N."""
-        await self._flush_body(task, out, delay)
+        try:
+            await self._flush_body(task, out, delay)
+        except asyncio.CancelledError:
+            # engine stopping with this route undelivered (parked on the
+            # semaphore, mid-flush, or in backoff): a memory chunk would
+            # be silently lost — quarantine when storage is on.
+            # Filesystem chunks are on disk and recover as backlog.
+            if self.storage is not None and \
+                    not self.storage.is_tracked(task.chunk):
+                try:
+                    self.storage.quarantine(task.chunk)
+                except Exception:
+                    log.exception("shutdown quarantine failed")
+            raise
 
     async def _flush_body(self, task: Task, out: OutputInstance,
                           delay: float) -> None:

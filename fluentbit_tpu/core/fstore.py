@@ -1,0 +1,95 @@
+"""fstore — file-backed object store on top of the chunk file format.
+
+Reference: src/flb_fstore.c (chunkio-backed KV staging used by out_s3
+multipart uploads and blob delivery). Streams are directories; files
+are named objects with byte content + a small JSON metadata sidecar.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+
+class FStoreFile:
+    __slots__ = ("name", "path", "meta_path")
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self.meta_path = path + ".meta"
+
+    def append(self, data: bytes) -> None:
+        with open(self.path, "ab") as f:
+            f.write(data)
+
+    def content(self) -> bytes:
+        with open(self.path, "rb") as f:
+            return f.read()
+
+    def set_meta(self, meta: dict) -> None:
+        with open(self.meta_path, "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+
+    def meta(self) -> dict:
+        try:
+            with open(self.meta_path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    @property
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def delete(self) -> None:
+        for p in (self.path, self.meta_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+class FStoreStream:
+    def __init__(self, root: str, name: str):
+        self.name = name
+        self.dir = os.path.join(root, name)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def create(self, name: str) -> FStoreFile:
+        f = FStoreFile(name, os.path.join(self.dir, name))
+        open(f.path, "ab").close()  # meta-only files must still exist
+        return f
+
+    def get(self, name: str) -> Optional[FStoreFile]:
+        path = os.path.join(self.dir, name)
+        return FStoreFile(name, path) if os.path.exists(path) else None
+
+    def files(self) -> List[FStoreFile]:
+        out = []
+        for name in sorted(os.listdir(self.dir)):
+            if name.endswith(".meta"):
+                continue
+            out.append(FStoreFile(name, os.path.join(self.dir, name)))
+        return out
+
+
+class FStore:
+    """flb_fstore_create: a root of named streams."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def stream(self, name: str) -> FStoreStream:
+        return FStoreStream(self.root, name)
+
+    def streams(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+        )

@@ -1,0 +1,59 @@
+"""SQLite wrapper — shared state databases.
+
+Reference: src/flb_sqldb.c (the sqlite-amalgamation wrapper behind
+in_tail offsets, tail_db.c, and the blob db). One shared connection per
+path (the reference shares handles via flb_sqldb_open's db list), with
+thread-safe access and a tiny exec/query API.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_lock = threading.Lock()
+_open_dbs: Dict[str, "SqlDB"] = {}
+
+
+class SqlDB:
+    """flb_sqldb equivalent: one connection, serialized statements."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self.users = 1
+
+    def execute(self, sql: str, params: Iterable[Any] = ()) -> None:
+        with self._lock:
+            self._conn.execute(sql, tuple(params))
+            self._conn.commit()
+
+    def query(self, sql: str, params: Iterable[Any] = ()) -> List[Tuple]:
+        with self._lock:
+            return self._conn.execute(sql, tuple(params)).fetchall()
+
+    def close(self) -> None:
+        with _lock:
+            self.users -= 1
+            if self.users <= 0:
+                _open_dbs.pop(self.path, None)
+                with self._lock:
+                    self._conn.close()
+
+
+def open_db(path: str) -> SqlDB:
+    """Shared-handle open (flb_sqldb_open): same FILE → same DB —
+    normalized so spelling variants cannot bypass the shared lock."""
+    import os
+
+    path = os.path.abspath(path)
+    with _lock:
+        db = _open_dbs.get(path)
+        if db is not None:
+            db.users += 1
+            return db
+        db = SqlDB(path)
+        _open_dbs[path] = db
+        return db

@@ -114,6 +114,11 @@ class Storage:
         f.close()
         self._files[chunk.id] = (None, path)
 
+    def is_tracked(self, chunk: Chunk) -> bool:
+        """True when the chunk has a backing stream file (it will be
+        recovered as backlog after a crash/stop)."""
+        return chunk.id in self._files
+
     def delete(self, chunk: Chunk) -> None:
         """Drop the backing file once every route delivered the chunk."""
         entry = self._files.pop(chunk.id, None)

@@ -1,0 +1,83 @@
+"""TLS layer — client + server SSL contexts from instance properties.
+
+Reference: src/tls/flb_tls.c + src/tls/openssl.c (OpenSSL-backed TLS
+for upstreams/downstreams: ``tls``, ``tls.verify``, ``tls.ca_file``,
+``tls.crt_file``, ``tls.key_file``, ``tls.vhost``). Python's ``ssl``
+module is the OpenSSL binding here; asyncio integrates the handshake
+with the event loop exactly like the reference's coroutine I/O.
+
+``client_context(ins)`` / ``server_context(ins)`` read the shared core
+properties off any plugin instance (CORE_INSTANCE_KEYS) and return an
+``ssl.SSLContext`` or None when ``tls`` is off.
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import Optional
+
+from .config import parse_bool
+
+
+def _props(ins):
+    get = ins.properties.get
+    return {
+        "on": parse_bool(get("tls", False)),
+        "verify": parse_bool(get("tls.verify", True)),
+        "ca_file": get("tls.ca_file"),
+        "crt_file": get("tls.crt_file"),
+        "key_file": get("tls.key_file"),
+        "vhost": get("tls.vhost"),
+    }
+
+
+def client_context(ins) -> Optional[ssl.SSLContext]:
+    """Upstream TLS (flb_tls_create for outputs)."""
+    p = _props(ins)
+    if not p["on"]:
+        return None
+    ctx = ssl.create_default_context(ssl.Purpose.SERVER_AUTH,
+                                     cafile=p["ca_file"])
+    if not p["verify"]:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    if p["crt_file"]:
+        ctx.load_cert_chain(p["crt_file"], p["key_file"])
+    return ctx
+
+
+def client_server_hostname(ins) -> Optional[str]:
+    """SNI override (tls.vhost)."""
+    return _props(ins)["vhost"]
+
+
+async def open_connection(ins, host: str, port: int, timeout=None):
+    """Client connect honoring the instance's TLS properties — the one
+    place the ssl/server_hostname dance lives (every TCP client plugin
+    uses this instead of repeating it)."""
+    import asyncio
+
+    ctx = client_context(ins)
+    coro = asyncio.open_connection(
+        host, port, ssl=ctx,
+        server_hostname=(client_server_hostname(ins) or None) if ctx
+        else None,
+    )
+    if timeout is not None:
+        return await asyncio.wait_for(coro, timeout)
+    return await coro
+
+
+def server_context(ins) -> Optional[ssl.SSLContext]:
+    """Downstream TLS (server-type inputs)."""
+    p = _props(ins)
+    if not p["on"]:
+        return None
+    if not p["crt_file"]:
+        raise ValueError(f"{ins.display_name}: tls on requires tls.crt_file")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(p["crt_file"], p["key_file"])
+    if p["ca_file"]:
+        ctx.load_verify_locations(p["ca_file"])
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx

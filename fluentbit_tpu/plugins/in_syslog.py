@@ -93,9 +93,17 @@ class SyslogInput(InputPlugin):
                                     bytes(out), n)
 
     async def start_server(self, engine) -> None:
+        from ..core.tls import server_context
+
         mode = (self.mode or "unix_udp").lower()
         plugin = self
+        tls_ctx = server_context(self.instance)
         if mode in ("udp", "unix_udp"):
+            if tls_ctx is not None:
+                # never downgrade silently: TLS has no datagram mode here
+                raise ValueError(
+                    f"syslog: tls is not supported in {mode} mode"
+                )
             class Proto(asyncio.DatagramProtocol):
                 def datagram_received(self, data, addr):
                     plugin._emit(engine, data)
@@ -140,11 +148,13 @@ class SyslogInput(InputPlugin):
                 writer.close()
 
         if mode == "tcp":
-            server = await asyncio.start_server(handle, self.listen, self.port)
+            server = await asyncio.start_server(handle, self.listen,
+                                                self.port, ssl=tls_ctx)
             self.bound_port = server.sockets[0].getsockname()[1]
         else:  # unix_tcp
             self._unlink_stale()
-            server = await asyncio.start_unix_server(handle, path=self.path)
+            server = await asyncio.start_unix_server(handle, path=self.path,
+                                                     ssl=tls_ctx)
             self._apply_perm()
         async with server:
             await server.serve_forever()

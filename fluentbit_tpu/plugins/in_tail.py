@@ -24,7 +24,6 @@ from __future__ import annotations
 import glob as _glob
 import logging
 import os
-import sqlite3
 from typing import Dict, List, Optional
 
 from ..codec.events import encode_event, now_event_time
@@ -89,12 +88,15 @@ class TailInput(InputPlugin):
                           lambda *_: None)
         self._db = None
         if self.db:
-            self._db = sqlite3.connect(self.db, check_same_thread=False)
+            from ..core.sqldb import open_db
+
+            # shared-handle wrapper (flb_sqldb): two tail inputs on the
+            # same db path share one serialized connection
+            self._db = open_db(self.db)
             self._db.execute(
                 "CREATE TABLE IF NOT EXISTS in_tail_files ("
                 "path TEXT PRIMARY KEY, inode INTEGER, offset INTEGER)"
             )
-            self._db.commit()
 
     def drain(self, engine) -> None:
         """Engine shutdown: emit any pending multiline groups so the
@@ -129,10 +131,11 @@ class TailInput(InputPlugin):
                 offset = 0 if self.read_from_head else st.st_size
                 inode = st.st_ino
                 if self._db is not None:
-                    row = self._db.execute(
+                    rows = self._db.query(
                         "SELECT inode, offset FROM in_tail_files WHERE path=?",
                         (path,),
-                    ).fetchone()
+                    )
+                    row = rows[0] if rows else None
                     if row is not None and row[0] == inode:
                         offset = min(row[1], st.st_size)
                     elif row is not None:
@@ -147,7 +150,6 @@ class TailInput(InputPlugin):
                 "inode=excluded.inode, offset=excluded.offset",
                 (tf.path, tf.inode, tf.offset),
             )
-            self._db.commit()
 
     # -- reading --
 

@@ -79,7 +79,12 @@ class ForwardInput(InputPlugin):
                 except Exception:
                     pass
 
-        server = await asyncio.start_server(handle, self.listen, self.port)
+        from ..core.tls import server_context
+
+        server = await asyncio.start_server(
+            handle, self.listen, self.port,
+            ssl=server_context(self.instance),
+        )
         self.bound_port = server.sockets[0].getsockname()[1]
         async with server:
             await server.serve_forever()
@@ -185,8 +190,10 @@ class ForwardOutput(OutputPlugin):
     async def _connect(self):
         if self._writer is not None and not self._writer.is_closing():
             return
-        self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+        from ..core.tls import open_connection
+
+        self._reader, self._writer = await open_connection(
+            self.instance, self.host, self.port, timeout=10
         )
         if self.shared_key:
             await self._handshake()

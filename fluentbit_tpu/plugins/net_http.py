@@ -161,7 +161,12 @@ class HttpInput(InputPlugin):
                 except Exception:
                     pass
 
-        server = await asyncio.start_server(handle, self.listen, self.port)
+        from ..core.tls import server_context
+
+        server = await asyncio.start_server(
+            handle, self.listen, self.port,
+            ssl=server_context(self.instance),
+        )
         self.bound_port = server.sockets[0].getsockname()[1]
         async with server:
             await server.serve_forever()
@@ -208,7 +213,11 @@ class HttpOutput(OutputPlugin):
             if len(parts) == 2:
                 headers.append(f"{parts[0]}: {parts[1]}")
         try:
-            reader, writer = await asyncio.open_connection(self.host, self.port)
+            from ..core.tls import open_connection
+
+            reader, writer = await open_connection(
+                self.instance, self.host, self.port, timeout=10
+            )
             writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
             await writer.drain()
             status_line = await reader.readline()

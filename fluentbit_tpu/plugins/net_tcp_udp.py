@@ -99,8 +99,11 @@ class TcpInput(_LineServerInput):
                     self._emit_payload(engine, pending)
                 writer.close()
 
+        from ..core.tls import server_context
+
         self._server = await asyncio.start_server(
-            handle, self.listen, self.port
+            handle, self.listen, self.port,
+            ssl=server_context(self.instance),
         )
         self.bound_port = self._server.sockets[0].getsockname()[1]
         async with self._server:
@@ -150,7 +153,11 @@ class _SocketOutput(OutputPlugin):
     async def _connect(self):
         if self._writer is not None and not self._writer.is_closing():
             return self._writer
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        from ..core.tls import open_connection
+
+        reader, writer = await open_connection(
+            self.instance, self.host, self.port
+        )
         self._reader = reader
         self._writer = writer
         return writer

@@ -8,8 +8,8 @@ plugins/out_loki (loki.c push API with label sets), plugins/out_splunk
 ``format(data, tag)`` builds the exact wire payload (the unit the
 reference exercises through its test-formatter harness,
 src/flb_engine_dispatch.c:101-137); delivery rides a shared minimal
-HTTP/1.1 client (no TLS — the reference's openssl upstream is a later
-layer).
+HTTP/1.1 client with optional TLS (core.tls — ``tls on`` +
+``tls.verify/ca_file/crt_file/key_file`` instance properties).
 """
 
 from __future__ import annotations
@@ -66,9 +66,11 @@ class _HttpDeliveryOutput(OutputPlugin):
         ] + self._headers()
         writer = None
         try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(self.host, self.port),
-                self.CONNECT_TIMEOUT,
+            from ..core.tls import open_connection
+
+            reader, writer = await open_connection(
+                self.instance, self.host, self.port,
+                timeout=self.CONNECT_TIMEOUT,
             )
             writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
             await asyncio.wait_for(writer.drain(), self.IO_TIMEOUT)

@@ -1,0 +1,157 @@
+"""AWS common — credentials providers + Signature Version 4.
+
+Reference: src/aws/ (flb_aws_credentials.c: env → profile → STS/IMDS
+chain; src/flb_signv4.c request signing shared by all AWS outputs +
+filter_aws). Implemented from the public SigV4 specification; the
+network-dependent providers (IMDS/STS/HTTP) are gated — env and
+profile-file credentials cover the offline build.
+"""
+
+from __future__ import annotations
+
+import configparser
+import datetime
+import hashlib
+import hmac
+import os
+import urllib.parse
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass
+class Credentials:
+    access_key: str
+    secret_key: str
+    session_token: Optional[str] = None
+
+
+def env_provider() -> Optional[Credentials]:
+    ak = os.environ.get("AWS_ACCESS_KEY_ID")
+    sk = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    if not ak or not sk:
+        return None
+    return Credentials(ak, sk, os.environ.get("AWS_SESSION_TOKEN"))
+
+
+def profile_provider(profile: Optional[str] = None,
+                     path: Optional[str] = None) -> Optional[Credentials]:
+    path = path or os.environ.get(
+        "AWS_SHARED_CREDENTIALS_FILE",
+        os.path.expanduser("~/.aws/credentials"),
+    )
+    profile = profile or os.environ.get("AWS_PROFILE", "default")
+    cp = configparser.ConfigParser()
+    try:
+        cp.read(path)
+    except (OSError, configparser.Error):
+        return None
+    if profile not in cp:
+        return None
+    sec = cp[profile]
+    ak = sec.get("aws_access_key_id")
+    sk = sec.get("aws_secret_access_key")
+    if not ak or not sk:
+        return None
+    return Credentials(ak, sk, sec.get("aws_session_token"))
+
+
+def get_credentials() -> Optional[Credentials]:
+    """The provider chain (env → profile; IMDS/STS are gated offline)."""
+    return env_provider() or profile_provider()
+
+
+# ------------------------------------------------------------------ sigv4
+
+def _canonical_query(qs: str) -> str:
+    """Spec-exact canonical query: percent-decode WITHOUT '+'-to-space
+    (a literal '+' is data), re-encode with the unreserved-safe set,
+    sort by ENCODED key then encoded value."""
+    if not qs:
+        return ""
+    pairs = []
+    for part in qs.split("&"):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        pairs.append((
+            urllib.parse.quote(urllib.parse.unquote(k), safe="-_.~"),
+            urllib.parse.quote(urllib.parse.unquote(v), safe="-_.~"),
+        ))
+    return "&".join(f"{k}={v}" for k, v in sorted(pairs))
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret: str, date: str, region: str, service: str) -> bytes:
+    k = _sign(("AWS4" + secret).encode(), date)
+    k = _sign(k, region)
+    k = _sign(k, service)
+    return _sign(k, "aws4_request")
+
+
+def sigv4_headers(
+    method: str,
+    url: str,
+    region: str,
+    service: str,
+    payload: bytes,
+    credentials: Credentials,
+    headers: Optional[Dict[str, str]] = None,
+    now: Optional[datetime.datetime] = None,
+) -> Dict[str, str]:
+    """Sign a request; returns the headers to attach (Authorization,
+    X-Amz-Date, X-Amz-Content-Sha256 [, X-Amz-Security-Token])."""
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+    path = parsed.path or "/"
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest()
+
+    all_headers = {"host": host, "x-amz-date": amz_date,
+                   "x-amz-content-sha256": payload_hash}
+    if credentials.session_token:
+        all_headers["x-amz-security-token"] = credentials.session_token
+    for k, v in (headers or {}).items():
+        # sequential-whitespace collapse per the canonicalization spec
+        all_headers[k.lower()] = " ".join(str(v).split())
+
+    canonical_query = _canonical_query(parsed.query)
+    signed_names = sorted(all_headers)
+    canonical_headers = "".join(
+        f"{k}:{all_headers[k]}\n" for k in signed_names
+    )
+    signed_headers = ";".join(signed_names)
+    canonical_request = "\n".join([
+        method.upper(),
+        urllib.parse.quote(path, safe="/-_.~"),
+        canonical_query,
+        canonical_headers,
+        signed_headers,
+        payload_hash,
+    ])
+    scope = f"{date}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256",
+        amz_date,
+        scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest(),
+    ])
+    signature = hmac.new(
+        signing_key(credentials.secret_key, date, region, service),
+        string_to_sign.encode(), hashlib.sha256,
+    ).hexdigest()
+    out = {
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={credentials.access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        ),
+        "X-Amz-Date": amz_date,
+        "X-Amz-Content-Sha256": payload_hash,
+    }
+    if credentials.session_token:
+        out["X-Amz-Security-Token"] = credentials.session_token
+    return out

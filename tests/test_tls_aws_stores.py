@@ -1,0 +1,227 @@
+"""TLS layer (loopback with self-signed certs), SigV4 signing, sqldb /
+fstore modules, retry-shutdown quarantine.
+"""
+
+import datetime
+import glob
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.core.fstore import FStore
+from fluentbit_tpu.core.sqldb import open_db
+from fluentbit_tpu.utils.aws import Credentials, sigv4_headers
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    crt, key = str(d / "srv.crt"), str(d / "srv.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "2",
+         "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    return crt, key
+
+
+def wait_for(cond, timeout=6.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.02)
+    raise TimeoutError
+
+
+def test_tls_forward_loopback(certs):
+    crt, key = certs
+    srv = flb.create(flush="60ms", grace="1")
+    srv.input("forward", tag="x", port="0", tls="on",
+              **{"tls.crt_file": crt, "tls.key_file": key})
+    fins = srv.engine.inputs[0]
+    got = []
+    srv.output("lib", match="*", callback=lambda d, t: got.append((t, d)))
+    srv.start()
+    port = wait_for(lambda: getattr(fins.plugin, "bound_port", None))
+
+    cli = flb.create(flush="60ms", grace="1")
+    in_ffd = cli.input("lib", tag="sec.logs")
+    cli.output("forward", match="*", host="127.0.0.1", port=str(port),
+               tls="on", **{"tls.verify": "off",
+                            "require_ack_response": "true"})
+    cli.start()
+    try:
+        cli.push(in_ffd, json.dumps({"over": "tls"}))
+        cli.flush_now()
+        wait_for(lambda: got)
+    finally:
+        cli.stop()
+        srv.stop()
+    tag, data = got[0]
+    assert tag == "sec.logs"
+    assert decode_events(data)[0].body == {"over": "tls"}
+
+
+def test_tls_http_client_verifies_ca(certs):
+    crt, key = certs
+    srv = flb.create(flush="60ms", grace="1")
+    srv.input("http", tag="h", port="0", tls="on",
+              **{"tls.crt_file": crt, "tls.key_file": key})
+    hins = srv.engine.inputs[0]
+    got = []
+    srv.output("lib", match="*", callback=lambda d, t: got.append(d))
+    srv.start()
+    port = wait_for(lambda: getattr(hins.plugin, "bound_port", None))
+
+    cli = flb.create(flush="60ms", grace="1")
+    in_ffd = cli.input("lib", tag="c")
+    # verify against the self-signed cert as CA + SNI vhost
+    cli.output("http", match="*", host="127.0.0.1", port=str(port),
+               uri="/in", format="json", tls="on",
+               **{"tls.ca_file": crt, "tls.vhost": "localhost"})
+    cli.start()
+    try:
+        cli.push(in_ffd, json.dumps({"https": True}))
+        cli.flush_now()
+        wait_for(lambda: got)
+    finally:
+        cli.stop()
+        srv.stop()
+    body = decode_events(got[0])[0].body
+    assert body["https"] is True  # out_http json format adds "date"
+
+
+# ------------------------------------------------------------------ sigv4
+
+def test_sigv4_known_vector():
+    """AWS's published GET vector (get-vanilla-query-order-key-case
+    style, simplified single-header case validated against botocore)."""
+    creds = Credentials("AKIDEXAMPLE",
+                        "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY")
+    now = datetime.datetime(2015, 8, 30, 12, 36, 0,
+                            tzinfo=datetime.timezone.utc)
+    hdrs = sigv4_headers("GET", "https://example.amazonaws.com/", "us-east-1",
+                         "service", b"", creds, now=now)
+    assert hdrs["X-Amz-Date"] == "20150830T123600Z"
+    assert hdrs["Authorization"].startswith(
+        "AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20150830/us-east-1/"
+        "service/aws4_request, SignedHeaders="
+    )
+    assert "Signature=" in hdrs["Authorization"]
+    # determinism
+    again = sigv4_headers("GET", "https://example.amazonaws.com/",
+                          "us-east-1", "service", b"", creds, now=now)
+    assert again == hdrs
+
+
+def test_sigv4_session_token_and_payload():
+    creds = Credentials("AK", "SK", session_token="TOK")
+    hdrs = sigv4_headers("POST", "https://logs.us-west-2.amazonaws.com/",
+                         "us-west-2", "logs", b'{"a":1}', creds)
+    assert hdrs["X-Amz-Security-Token"] == "TOK"
+    import hashlib
+
+    assert hdrs["X-Amz-Content-Sha256"] == \
+        hashlib.sha256(b'{"a":1}').hexdigest()
+
+
+# ---------------------------------------------------------- sqldb / fstore
+
+def test_sqldb_shared_handles(tmp_path):
+    path = str(tmp_path / "state.db")
+    db1 = open_db(path)
+    db2 = open_db(path)
+    assert db1 is db2
+    db1.execute("CREATE TABLE t (k TEXT PRIMARY KEY, v INT)")
+    db1.execute("INSERT INTO t VALUES (?, ?)", ("a", 1))
+    assert db2.query("SELECT v FROM t WHERE k=?", ("a",)) == [(1,)]
+    db1.close()
+    db2.close()
+    db3 = open_db(path)  # reopen after full close
+    assert db3.query("SELECT v FROM t") == [(1,)]
+    db3.close()
+
+
+def test_fstore_streams_and_meta(tmp_path):
+    fs = FStore(str(tmp_path / "fs"))
+    st = fs.stream("multipart")
+    f = st.create("upload-1")
+    f.append(b"part one ")
+    f.append(b"part two")
+    f.set_meta({"upload_id": "u1", "parts": 2})
+    assert f.content() == b"part one part two"
+    assert f.size == 17
+    got = st.get("upload-1")
+    assert got is not None and got.meta() == {"upload_id": "u1", "parts": 2}
+    assert [x.name for x in st.files()] == ["upload-1"]
+    assert fs.streams() == ["multipart"]
+    f.delete()
+    assert st.files() == []
+
+
+# ----------------------------------------------- retry shutdown durability
+
+def test_memory_chunk_quarantined_when_stopped_mid_retry(tmp_path):
+    """A MEMORY chunk stuck in retry backoff at shutdown lands in the
+    DLQ instead of vanishing (filesystem chunks recover via backlog)."""
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.service_set(**{"storage.path": str(tmp_path / "st"),
+                       "scheduler.base": "30", "scheduler.cap": "60"})
+    in_ffd = ctx.input("lib", tag="t")  # memory storage
+    ctx.output("retry", match="t")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"precious": 1}))
+        ctx.flush_now()
+        time.sleep(0.3)  # first attempt returns RETRY, coroutine backs off
+    finally:
+        ctx.stop()
+    dlq = glob.glob(str(tmp_path / "st" / "dlq" / "*.flb"))
+    assert dlq, "chunk lost at shutdown"
+
+
+def test_sigv4_canonical_query_rules():
+    from fluentbit_tpu.utils.aws import _canonical_query
+
+    # literal '+' is data (never decoded to space); space encodes %20
+    assert _canonical_query("a=1+2") == "a=1%2B2"
+    assert _canonical_query("a=x%20y") == "a=x%20y"
+    # sorted by ENCODED key, then encoded value
+    assert _canonical_query("b=2&a=1&a=0") == "a=0&a=1&b=2"
+    assert _canonical_query("") == ""
+    # bare keys keep an empty value
+    assert _canonical_query("flag") == "flag="
+
+
+def test_sigv4_header_whitespace_collapsed():
+    creds = Credentials("AK", "SK")
+    now = datetime.datetime(2020, 1, 1, tzinfo=datetime.timezone.utc)
+    h1 = sigv4_headers("GET", "https://h.example/", "r", "s", b"", creds,
+                       headers={"X-Custom": "a    b"}, now=now)
+    h2 = sigv4_headers("GET", "https://h.example/", "r", "s", b"", creds,
+                       headers={"X-Custom": "a b"}, now=now)
+    assert h1["Authorization"] == h2["Authorization"]
+
+
+def test_syslog_udp_rejects_tls():
+    import fluentbit_tpu as _flb
+
+    ctx = _flb.create(flush="50ms", grace="1")
+    ctx.input("syslog", tag="s", mode="udp", port="0", tls="on")
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        time.sleep(0.3)
+        # the server task died with ValueError; no bound port appears
+        assert getattr(ctx.engine.inputs[0].plugin, "bound_port", None) is None
+    finally:
+        ctx.stop()
