@@ -252,8 +252,21 @@ def _apply_processors(ctx, ffd, processors: Dict[str, list]) -> None:
                 raise ValueError(f"processor unit needs a name: {unit!r}")
             proc = ins.create_processor(unit["name"])
             for k, v in unit.items():
-                if k != "name":
-                    proc.set(k, v)
+                if k in ("name", "condition"):
+                    continue
+                proc.set(k, v)
+            if "condition" in unit:
+                if signal_type != "logs":
+                    # only the log pipeline evaluates per-record
+                    # conditions; accepting one here would silently
+                    # apply the processor unconditionally
+                    raise ValueError(
+                        "processor conditions are supported on logs "
+                        "units only"
+                    )
+                from ..core.conditions import Condition
+
+                proc.condition = Condition.from_config(unit["condition"])
             proc.configure()
             proc.plugin.init(proc, ctx.engine)
             target.processors.append(proc)

@@ -1,0 +1,106 @@
+"""Conditions — field/op/value rules for processors and routing.
+
+Reference: src/flb_conditionals.c (struct flb_condition: a rule list
+with AND/OR combination; ops eq/neq/gt/lt/gte/lte/regex/not_regex/
+in/not_in, record-accessor fields) consumed by processor units
+(include/fluent-bit/flb_processor.h:69-90 ``condition``) and the
+condition-based router (src/flb_router_condition.c).
+
+YAML shape (the reference's processor condition form)::
+
+    condition:
+      op: and                 # or
+      rules:
+        - field: "$status"
+          op: gte
+          value: 500
+        - field: "$level"
+          op: in
+          value: ["error", "fatal"]
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .record_accessor import RecordAccessor
+from ..regex import FlbRegex
+
+OPS = ("eq", "neq", "gt", "lt", "gte", "lte", "regex", "not_regex",
+       "in", "not_in", "exists", "not_exists")
+
+
+class Rule:
+    __slots__ = ("ra", "op", "value", "_rx")
+
+    def __init__(self, field: str, op: str, value: Any = None):
+        op = op.lower()
+        if op not in OPS:
+            raise ValueError(f"condition: unknown op {op!r}")
+        self.ra = RecordAccessor(field if field.startswith("$")
+                                 else "$" + field)
+        self.op = op
+        self.value = value
+        self._rx = FlbRegex(str(value)) if op in ("regex", "not_regex") \
+            else None
+
+    def eval(self, body: dict) -> bool:
+        sentinel = object()
+        v = self.ra.get(body, sentinel)
+        if self.op == "exists":
+            return v is not sentinel
+        if self.op == "not_exists":
+            return v is sentinel
+        if v is sentinel:
+            return False
+        if self.op == "eq":
+            return v == self.value
+        if self.op == "neq":
+            return v != self.value
+        if self.op in ("gt", "lt", "gte", "lte"):
+            try:
+                if self.op == "gt":
+                    return v > self.value
+                if self.op == "lt":
+                    return v < self.value
+                if self.op == "gte":
+                    return v >= self.value
+                return v <= self.value
+            except TypeError:
+                return False
+        if self.op in ("regex", "not_regex"):
+            ok = isinstance(v, str) and self._rx.match(v)
+            return ok if self.op == "regex" else not ok
+        if self.op in ("in", "not_in"):
+            members = self.value if isinstance(self.value, (list, tuple)) \
+                else [self.value]
+            return (v in members) if self.op == "in" else (v not in members)
+        return False
+
+
+class Condition:
+    """flb_condition: AND/OR over a rule list."""
+
+    def __init__(self, rules: List[Rule], op: str = "and"):
+        op = (op or "and").lower()
+        if op not in ("and", "or"):
+            raise ValueError(f"condition: unknown combinator {op!r}")
+        self.rules = rules
+        self.op = op
+
+    def eval(self, body: dict) -> bool:
+        if not isinstance(body, dict):
+            return False
+        if self.op == "and":
+            return all(r.eval(body) for r in self.rules)
+        return any(r.eval(body) for r in self.rules)
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any]) -> "Condition":
+        if not isinstance(cfg, dict) or "rules" not in cfg:
+            raise ValueError("condition needs a 'rules' list")
+        rules = []
+        for r in cfg["rules"]:
+            rules.append(Rule(r["field"], r.get("op", "eq"),
+                              r.get("value")))
+        return cls(rules, cfg.get("op", "and"))

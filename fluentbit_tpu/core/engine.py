@@ -376,7 +376,7 @@ class Engine:
             # graceful drain (grace period, src/flb_engine.c:1137-1160):
             # let plugins flush held state (pending multiline groups)
             # BEFORE the final chunk drain so nothing is lost at stop
-            for ins in self.inputs + self.filters:
+            for ins in self.inputs + self.filters + self.outputs:
                 drain = getattr(ins.plugin, "drain", None)
                 if drain is not None:
                     try:
@@ -516,10 +516,9 @@ class Engine:
             self.m_in_bytes.inc(len(data), (ins.display_name,))
 
             # input-side processors (flb_processor_run, src/flb_input_log.c:1562)
-            for proc in ins.processors:
-                events = proc.plugin.process_logs(events, tag, self)
-                if not events:
-                    return 0
+            events = self._run_log_processors(ins.processors, events, tag)
+            if not events:
+                return 0
 
             # chunk trace: input stamp (flb_chunk_trace_do_input,
             # src/flb_input_chunk.c:3049)
@@ -617,6 +616,26 @@ class Engine:
         if self.storage is not None and ins.storage_type == "filesystem":
             self.storage.write_through(chunk, data)
         return n
+
+    def _run_log_processors(self, procs, events, tag: str):
+        """Processor pipeline with per-unit conditions
+        (flb_processor.h:69-90: a unit may carry a condition; events
+        that fail it pass through the unit untouched)."""
+        for proc in procs:
+            if not events:
+                break
+            cond = getattr(proc, "condition", None)
+            if cond is None:
+                events = proc.plugin.process_logs(events, tag, self)
+                continue
+            out = []
+            for ev in events:
+                if cond.eval(ev.body):
+                    out.extend(proc.plugin.process_logs([ev], tag, self))
+                else:
+                    out.append(ev)
+            events = out
+        return events
 
     def _run_metrics_processors(self, procs, data: bytes, tag: str) -> bytes:
         """Run a metrics processor pipeline over encoded payloads."""
@@ -763,9 +782,9 @@ class Engine:
         # include/fluent-bit/flb_output.h:794) — once per chunk, not per
         # retry attempt
         if out.processors and chunk.event_type == EVENT_TYPE_LOGS:
-            events = decode_events(data)
-            for proc in out.processors:
-                events = proc.plugin.process_logs(events, chunk.tag, self)
+            events = self._run_log_processors(
+                out.processors, decode_events(data), chunk.tag
+            )
             data = b"".join(
                 ev.raw if ev.raw is not None else reencode_event(ev)
                 for ev in events

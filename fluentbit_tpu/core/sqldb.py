@@ -30,6 +30,13 @@ class SqlDB:
             self._conn.execute(sql, tuple(params))
             self._conn.commit()
 
+    def executemany(self, sql: str, rows: Iterable[Iterable[Any]]) -> None:
+        """One transaction + one commit for a whole batch (the per-file
+        checkpoint pattern must not fsync per row)."""
+        with self._lock:
+            self._conn.executemany(sql, [tuple(r) for r in rows])
+            self._conn.commit()
+
     def query(self, sql: str, params: Iterable[Any] = ()) -> List[Tuple]:
         with self._lock:
             return self._conn.execute(sql, tuple(params)).fetchall()

@@ -23,3 +23,5 @@ from . import filters_extra  # noqa: F401
 from . import filter_script  # noqa: F401
 from . import processors  # noqa: F401
 from . import telemetry_extra  # noqa: F401
+from . import outputs_aws  # noqa: F401
+from . import gated  # noqa: F401

@@ -1,0 +1,47 @@
+"""Gated plugins — components whose vendored runtime is absent.
+
+Reference plugins that embed a library this image does not provide
+(librdkafka, WAMR, libmaxminddb, TF-Lite, libbpf). They register under
+their reference names and fail AT INIT with a clear message naming the
+missing runtime — configs referencing them error loudly instead of
+silently dropping data (the same stance as the snappy/zstd compression
+gates in utils).
+"""
+
+from __future__ import annotations
+
+from ..core.plugin import (
+    FilterPlugin,
+    InputPlugin,
+    OutputPlugin,
+    registry,
+)
+
+
+def _gate(kind, plugin_name: str, runtime: str, hint: str = ""):
+    class Gated(kind):
+        name = plugin_name
+        description = f"gated: {runtime} not vendored in this build"
+
+        def init(self, instance, engine) -> None:
+            msg = (f"{plugin_name}: the {runtime} runtime is not vendored "
+                   f"in this build")
+            if hint:
+                msg += f" — {hint}"
+            raise RuntimeError(msg)
+
+    Gated.__name__ = f"Gated_{plugin_name}"
+    return registry.register(Gated)
+
+
+_gate(InputPlugin, "kafka", "librdkafka")
+_gate(OutputPlugin, "kafka", "librdkafka")
+_gate(InputPlugin, "exec_wasi", "WAMR",
+      "the 'exec' input runs native commands")
+_gate(FilterPlugin, "geoip2", "libmaxminddb")
+_gate(FilterPlugin, "tensorflow", "TensorFlow Lite")
+_gate(FilterPlugin, "nightfall", "the Nightfall DLP API (network)")
+_gate(InputPlugin, "ebpf", "libbpf CO-RE")
+_gate(InputPlugin, "systemd", "libsystemd (journald)")
+_gate(InputPlugin, "winlog", "the Windows Event Log API")
+_gate(InputPlugin, "winevtlog", "the Windows Event Log API")

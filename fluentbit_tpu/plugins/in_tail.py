@@ -87,6 +87,7 @@ class TailInput(InputPlugin):
             create_stream(self.multiline_parser, engine.ml_parsers,
                           lambda *_: None)
         self._db = None
+        self._dirty: Dict[str, tuple] = {}
         if self.db:
             from ..core.sqldb import open_db
 
@@ -112,6 +113,7 @@ class TailInput(InputPlugin):
                 except OSError:
                     pass
         if self._db is not None:
+            self._checkpoint()  # final offsets before close
             self._db.close()
 
     # -- scanning --
@@ -143,13 +145,23 @@ class TailInput(InputPlugin):
                 self._files[path] = _TailFile(path, inode, offset)
 
     def _persist(self, tf: _TailFile) -> None:
+        """Mark the offset dirty; the batch at the end of each collect
+        pass commits once (not one fsync per tailed file)."""
         if self._db is not None:
-            self._db.execute(
-                "INSERT INTO in_tail_files (path, inode, offset) "
-                "VALUES (?, ?, ?) ON CONFLICT(path) DO UPDATE SET "
-                "inode=excluded.inode, offset=excluded.offset",
-                (tf.path, tf.inode, tf.offset),
-            )
+            self._dirty[tf.path] = (tf.inode, tf.offset)
+
+    def _checkpoint(self) -> None:
+        if self._db is None or not self._dirty:
+            return
+        rows = [(path, ino, off)
+                for path, (ino, off) in self._dirty.items()]
+        self._dirty.clear()
+        self._db.executemany(
+            "INSERT INTO in_tail_files (path, inode, offset) "
+            "VALUES (?, ?, ?) ON CONFLICT(path) DO UPDATE SET "
+            "inode=excluded.inode, offset=excluded.offset",
+            rows,
+        )
 
     # -- reading --
 
@@ -160,6 +172,7 @@ class TailInput(InputPlugin):
             self._since_scan = 0.0
         for tf in list(self._files.values()):
             self._read_file(tf, engine)
+        self._checkpoint()
         # flush multiline groups that waited past their flush window
         for path, (st, groups) in list(self._ml_streams.items()):
             if st.timed_out():

@@ -53,7 +53,16 @@ class DummyInput(InputPlugin):
         except json.JSONDecodeError:
             self._meta = {}
         self._emitted = 0
-        self.collect_interval = 1.0 / max(1, self.rate)
+        # high rates cannot ride the timer (asyncio resolution ~ms, the
+        # round-1 load-generation ceiling): cap the tick frequency at
+        # 100 Hz and emit rate×interval records per tick in ONE batched
+        # append (they share the tick's timestamp, like `copies`)
+        if self.rate > 100:
+            self.collect_interval = 0.01
+            self._per_tick = max(1, int(round(self.rate * 0.01)))
+        else:
+            self.collect_interval = 1.0 / max(1, self.rate)
+            self._per_tick = 1
         if self.start_time_sec >= 0:
             self._fixed_ts = EventTime(self.start_time_sec,
                                        max(0, self.start_time_nsec))
@@ -68,7 +77,7 @@ class DummyInput(InputPlugin):
         if self.samples and self._emitted >= self.samples:
             return
         ts = self._fixed_ts or now_event_time()
-        n = self.copies
+        n = self.copies * self._per_tick
         if self.samples:
             n = min(n, self.samples - self._emitted)
         buf = b"".join(
