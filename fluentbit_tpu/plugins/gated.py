@@ -45,3 +45,8 @@ _gate(InputPlugin, "ebpf", "libbpf CO-RE")
 _gate(InputPlugin, "systemd", "libsystemd (journald)")
 _gate(InputPlugin, "winlog", "the Windows Event Log API")
 _gate(InputPlugin, "winevtlog", "the Windows Event Log API")
+_gate(OutputPlugin, "prometheus_remote_write",
+      "snappy (the remote-write protobuf frame is snappy-compressed)")
+_gate(InputPlugin, "prometheus_remote_write", "snappy")
+_gate(InputPlugin, "mqtt", "an MQTT broker protocol stack")
+_gate(OutputPlugin, "websocket", "an RFC6455 websocket stack")

@@ -1,0 +1,231 @@
+"""Remaining breadth: processor_sampling, out_nats, in_kmsg,
+in_docker_events.
+
+Reference: plugins/processor_sampling (probabilistic + tail trace
+sampling — probabilistic mode applied per record here; tail mode needs
+trace grouping and is gated), plugins/out_nats (NATS text protocol
+CONNECT/PUB), plugins/in_kmsg (/dev/kmsg kernel log), plugins/
+in_docker_events (docker daemon /events over the unix socket).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import time
+from typing import Dict, List, Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import (
+    FlushResult,
+    InputPlugin,
+    OutputPlugin,
+    ProcessorPlugin,
+    registry,
+)
+from .outputs_basic import format_json_lines
+
+log = logging.getLogger("flb.misc")
+
+
+@registry.register
+class SamplingProcessor(ProcessorPlugin):
+    name = "sampling"
+    description = "probabilistic record sampling"
+    config_map = [
+        ConfigMapEntry("type", "str", default="probabilistic"),
+        ConfigMapEntry("sampling_settings_sampling_percentage", "double",
+                       default=10.0),
+        ConfigMapEntry("percentage", "double"),
+        ConfigMapEntry("seed", "int"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if (self.type or "probabilistic").lower() != "probabilistic":
+            raise ValueError(
+                "sampling: only probabilistic mode is implemented "
+                "(tail sampling needs trace grouping)"
+            )
+        pct = self.percentage
+        if pct is None:
+            pct = self.sampling_settings_sampling_percentage
+        self._p = max(0.0, min(100.0, float(pct))) / 100.0
+        self._rng = random.Random(self.seed)
+
+    def process_logs(self, events: list, tag: str, engine) -> list:
+        return [ev for ev in events if self._rng.random() < self._p]
+
+
+@registry.register
+class NatsOutput(OutputPlugin):
+    """plugins/out_nats: publish each record as JSON on subject=tag
+    (the NATS text protocol: INFO/CONNECT/PUB/+OK)."""
+
+    name = "nats"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=4222),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._reader = None
+        self._writer = None
+        # one shared connection: concurrent flushes must not race the
+        # INFO/CONNECT handshake or interleave writes
+        self._lock = asyncio.Lock()
+
+    async def _connect(self):
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        from ..core.tls import open_connection
+
+        self._reader, self._writer = await open_connection(
+            self.instance, self.host, self.port, timeout=10
+        )
+        info = await asyncio.wait_for(self._reader.readline(), 10)
+        if not info.startswith(b"INFO"):
+            raise ConnectionError("nats: expected INFO")
+        self._writer.write(b'CONNECT {"verbose":false}\r\n')
+        await self._writer.drain()
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        async with self._lock:
+            return await self._flush_locked(data, tag)
+
+    async def _flush_locked(self, data: bytes, tag: str) -> FlushResult:
+        try:
+            await self._connect()
+            for line in format_json_lines(data).splitlines():
+                payload = line.encode()
+                self._writer.write(
+                    f"PUB {tag} {len(payload)}\r\n".encode()
+                    + payload + b"\r\n"
+                )
+            await asyncio.wait_for(self._writer.drain(), 30)
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+            self._writer = None
+            return FlushResult.RETRY
+        return FlushResult.OK
+
+
+@registry.register
+class KmsgInput(InputPlugin):
+    """plugins/in_kmsg: the kernel ring buffer via /dev/kmsg
+    ('<pri>,<seq>,<usec_since_boot>,<flags>;message')."""
+
+    name = "kmsg"
+    collect_interval = 0.25
+    config_map = [
+        ConfigMapEntry("file", "str", default="/dev/kmsg"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._fd = None
+        try:
+            self._fd = os.open(self.file, os.O_RDONLY | os.O_NONBLOCK)
+            # boot epoch so usec-since-boot maps to wall time
+            with open("/proc/uptime") as f:
+                uptime = float(f.read().split()[0])
+            self._boot = time.time() - uptime
+        except OSError as e:
+            raise RuntimeError(f"kmsg: cannot open {self.file}: {e}")
+
+    def exit(self) -> None:
+        if self._fd is not None:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+
+    def collect(self, engine) -> None:
+        out = bytearray()
+        n = 0
+        while True:
+            try:
+                raw = os.read(self._fd, 8192)
+            except BlockingIOError:
+                break
+            except OSError:
+                break
+            if not raw:
+                break
+            line = raw.decode("utf-8", "replace").rstrip("\n")
+            head, _, msg = line.partition(";")
+            parts = head.split(",")
+            body: Dict[str, object] = {"msg": msg}
+            try:
+                prival = int(parts[0])
+                body["priority"] = prival & 7
+                body["facility"] = prival >> 3
+                body["sequence"] = int(parts[1])
+                ts = self._boot + int(parts[2]) / 1e6
+            except (ValueError, IndexError):
+                ts = None
+            out += encode_event(
+                body, ts if ts else now_event_time()
+            )
+            n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+
+
+@registry.register
+class DockerEventsInput(InputPlugin):
+    """plugins/in_docker_events: stream the daemon's /events JSON feed
+    over the unix socket."""
+
+    name = "docker_events"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("unix_path", "str", default="/var/run/docker.sock"),
+        ConfigMapEntry("reconnect.retry_interval", "time", default="1"),
+    ]
+
+    async def start_server(self, engine) -> None:
+        while True:
+            try:
+                await self._stream(engine)
+            except (OSError, asyncio.IncompleteReadError) as e:
+                log.debug("docker_events: %s; reconnecting", e)
+            await asyncio.sleep(self.reconnect_retry_interval or 1)
+
+    async def _stream(self, engine) -> None:
+        reader, writer = await asyncio.open_unix_connection(self.unix_path)
+        try:
+            writer.write(b"GET /events HTTP/1.1\r\nHost: docker\r\n\r\n")
+            await writer.drain()
+            # skip response headers
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+            while True:
+                line = (await reader.readline()).strip()
+                if not line:
+                    continue
+                try:
+                    int(line, 16)  # chunked-encoding size lines
+                    continue
+                except ValueError:
+                    pass
+                try:
+                    body = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(body, dict):
+                    engine.input_log_append(
+                        self.instance, self.instance.tag,
+                        encode_event(body, now_event_time()), 1,
+                    )
+        finally:
+            writer.close()

@@ -1,0 +1,273 @@
+"""OpenTelemetry logs — OTLP/HTTP (JSON encoding) input + output.
+
+Reference: plugins/in_opentelemetry (OTLP server for
+logs/metrics/traces, opentelemetry.c) and plugins/out_opentelemetry
+(4640 LoC OTLP export). This build speaks the OTLP/HTTP **JSON**
+encoding for the logs signal on ``/v1/logs`` (the protobuf binary
+encoding and the metrics/traces signals are gated — no protoc-generated
+schemas are vendored; OTLP/JSON is a standard encoding per the
+OpenTelemetry protocol spec).
+
+Mapping: each logRecord → one pipeline record; resource + scope
+attributes land in the event metadata under ``otlp`` so group identity
+survives round trips; ``timeUnixNano`` ↔ the event timestamp;
+``body.stringValue`` → ``{"message": ...}``, kvlist bodies merge as
+fields.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..codec.events import LogEvent, encode_event, iter_events
+from ..codec.msgpack import EventTime
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+
+log = logging.getLogger("flb.otlp")
+
+
+# ---------------------------------------------------------- value mapping
+
+def any_value_to_py(v: dict) -> Any:
+    if not isinstance(v, dict):
+        return v
+    if "stringValue" in v:
+        return v["stringValue"]
+    if "intValue" in v:
+        return int(v["intValue"])
+    if "doubleValue" in v:
+        return float(v["doubleValue"])
+    if "boolValue" in v:
+        return bool(v["boolValue"])
+    if "arrayValue" in v:
+        return [any_value_to_py(x)
+                for x in v["arrayValue"].get("values", [])]
+    if "kvlistValue" in v:
+        return kvlist_to_dict(v["kvlistValue"].get("values", []))
+    if "bytesValue" in v:
+        import base64
+
+        try:
+            return base64.b64decode(v["bytesValue"])
+        except (ValueError, TypeError):
+            return v["bytesValue"]
+    return None
+
+
+def kvlist_to_dict(kvs: List[dict]) -> Dict[str, Any]:
+    return {kv.get("key", ""): any_value_to_py(kv.get("value", {}))
+            for kv in kvs}
+
+
+def py_to_any_value(v: Any) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    if isinstance(v, (list, tuple)):
+        return {"arrayValue": {"values": [py_to_any_value(x) for x in v]}}
+    if isinstance(v, dict):
+        return {"kvlistValue": {"values": dict_to_kvlist(v)}}
+    if isinstance(v, bytes):
+        import base64
+
+        # proto3 JSON mapping: bytes fields are base64 text
+        return {"bytesValue": base64.b64encode(v).decode("ascii")}
+    return {"stringValue": str(v)}
+
+
+def dict_to_kvlist(d: Dict[str, Any]) -> List[dict]:
+    return [{"key": k, "value": py_to_any_value(v)} for k, v in d.items()]
+
+
+SEVERITIES = {1: "trace", 5: "debug", 9: "info", 13: "warn", 17: "error",
+              21: "fatal"}
+
+
+def decode_otlp_logs(payload: dict):
+    """OTLP ExportLogsServiceRequest JSON → [(ts_ns, body, metadata)]."""
+    out = []
+    for rl in payload.get("resourceLogs", []):
+        resource_attrs = kvlist_to_dict(
+            (rl.get("resource") or {}).get("attributes", []))
+        for sl in rl.get("scopeLogs", []):
+            scope = sl.get("scope") or {}
+            for rec in sl.get("logRecords", []):
+                ts = int(rec.get("timeUnixNano")
+                         or rec.get("observedTimeUnixNano") or 0)
+                body: Dict[str, Any] = {}
+                b = any_value_to_py(rec.get("body", {}))
+                if isinstance(b, dict):
+                    body.update(b)
+                elif b is not None:
+                    body["message"] = b
+                attrs = kvlist_to_dict(rec.get("attributes", []))
+                body.update(attrs)
+                sev_num = rec.get("severityNumber")
+                sev_text = rec.get("severityText")
+                if (sev_text or sev_num) and "severity" not in body:
+                    body["severity"] = sev_text or SEVERITIES.get(
+                        int(sev_num), str(sev_num))
+                meta = {"otlp": {"resource": resource_attrs,
+                                 "scope": {"name": scope.get("name", ""),
+                                           "version": scope.get("version",
+                                                                "")}}}
+                out.append((ts, body, meta))
+    return out
+
+
+def encode_otlp_logs(events, tag: str) -> dict:
+    """Pipeline events → ExportLogsServiceRequest JSON (one resource per
+    distinct otlp.resource metadata, default tagged resource)."""
+    groups: Dict[str, dict] = {}
+    for ev in events:
+        meta = ev.metadata or {}
+        otlp = meta.get("otlp", {}) if isinstance(meta, dict) else {}
+        resource = otlp.get("resource") or {"service.name": tag}
+        key = json.dumps(resource, sort_keys=True, default=str)
+        g = groups.setdefault(key, {"resource": resource, "records": []})
+        body = dict(ev.body) if isinstance(ev.body, dict) else {}
+        sev_text = str(body.pop("severity", ""))
+        ts = ev.timestamp
+        if isinstance(ts, EventTime):
+            # exact: float64 loses ~100ns at current epochs
+            ns = ts.sec * 10**9 + ts.nsec
+        else:
+            ns = int(ev.ts_float * 1e9)
+        rec = {
+            "timeUnixNano": str(ns),
+            "body": {"kvlistValue": {"values": dict_to_kvlist(body)}}
+            if len(body) != 1 or "message" not in body
+            else {"stringValue": str(body["message"])},
+            "attributes": [],
+        }
+        if sev_text:
+            rec["severityText"] = sev_text
+        g["records"].append(rec)
+    return {"resourceLogs": [
+        {"resource": {"attributes": dict_to_kvlist(g["resource"])},
+         "scopeLogs": [{"scope": {"name": "fluentbit_tpu"},
+                        "logRecords": g["records"]}]}
+        for g in groups.values()
+    ]}
+
+
+@registry.register
+class OpentelemetryInput(InputPlugin):
+    name = "opentelemetry"
+    description = "OTLP/HTTP server (logs signal, JSON encoding)"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=4318),
+        ConfigMapEntry("tag_from_uri", "bool", default=True),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.bound_port: Optional[int] = None
+
+    async def start_server(self, engine) -> None:
+        import asyncio
+
+        from ..core.tls import server_context
+        from .net_http import http_response, read_http_request
+
+        async def handle(reader, writer):
+            try:
+                while True:
+                    req = await read_http_request(reader)
+                    if req is None:
+                        break
+                    method, uri, headers, body = req
+                    path = uri.split("?")[0]
+                    if method != "POST" or path not in ("/v1/logs",):
+                        code = 404 if method == "POST" else 400
+                        writer.write(http_response(code, b"{}",
+                                                   "application/json"))
+                        await writer.drain()
+                        continue
+                    try:
+                        payload = json.loads(body)
+                        records = decode_otlp_logs(payload)
+                    except Exception:
+                        # any structurally invalid payload is the
+                        # client's error: answer 400, keep the conn
+                        writer.write(http_response(400, b"{}",
+                                                   "application/json"))
+                        await writer.drain()
+                        continue
+                    tag = "v1.logs" if self.tag_from_uri else \
+                        self.instance.tag
+                    from ..codec.events import now_event_time
+
+                    buf = bytearray()
+                    for ts_ns, rec_body, meta in records:
+                        # no timestamp on the record → receive time
+                        # (the reference server's fallback)
+                        ts = (EventTime(ts_ns // 10**9, ts_ns % 10**9)
+                              if ts_ns else now_event_time())
+                        buf += encode_event(rec_body, ts, meta)
+                    if records:
+                        engine.input_log_append(
+                            self.instance, tag, bytes(buf), len(records)
+                        )
+                    writer.write(http_response(
+                        200, b'{"partialSuccess":{}}', "application/json"))
+                    await writer.drain()
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        import asyncio
+
+        server = await asyncio.start_server(
+            handle, self.listen, self.port,
+            ssl=server_context(self.instance),
+        )
+        self.bound_port = server.sockets[0].getsockname()[1]
+        async with server:
+            await server.serve_forever()
+
+
+from .outputs_http_based import _HttpDeliveryOutput
+
+
+@registry.register
+class OpentelemetryOutput(_HttpDeliveryOutput):
+    """Shares the HTTP delivery base (TLS, timeouts, 408/429 retry
+    classification — OTLP backpressure must RETRY, not drop)."""
+
+    name = "opentelemetry"
+    description = "OTLP/HTTP exporter (logs signal, JSON encoding)"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=4318),
+        ConfigMapEntry("logs_uri", "str", default="/v1/logs"),
+        ConfigMapEntry("header", "slist", multiple=True, slist_max_split=1),
+    ]
+
+    def _uri(self) -> str:
+        return self.logs_uri or "/v1/logs"
+
+    def _headers(self) -> List[str]:
+        out = []
+        for pair in self.header or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) == 2:
+                out.append(f"{parts[0]}: {parts[1]}")
+        return out
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        return json.dumps(
+            encode_otlp_logs(list(iter_events(data)), tag),
+            separators=(",", ":"), default=str,
+        ).encode()

@@ -1,0 +1,170 @@
+"""OTLP logs in/out (round trip over loopback), sampling processor,
+out_nats against a stub server, kmsg parser bits.
+"""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.plugins.opentelemetry import (
+    decode_otlp_logs,
+    encode_otlp_logs,
+)
+
+
+def wait_for(cond, timeout=6.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.02)
+    raise TimeoutError
+
+
+OTLP_PAYLOAD = {
+    "resourceLogs": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "checkout"}},
+        ]},
+        "scopeLogs": [{
+            "scope": {"name": "app"},
+            "logRecords": [
+                {"timeUnixNano": "1700000000123456789",
+                 "severityNumber": 17, "severityText": "ERROR",
+                 "body": {"stringValue": "payment failed"},
+                 "attributes": [
+                     {"key": "order_id", "value": {"intValue": "42"}},
+                 ]},
+                {"timeUnixNano": "1700000001000000000",
+                 "body": {"kvlistValue": {"values": [
+                     {"key": "k", "value": {"stringValue": "v"}},
+                     {"key": "n", "value": {"doubleValue": 1.5}},
+                 ]}}},
+            ],
+        }],
+    }],
+}
+
+
+def test_decode_otlp_logs():
+    records = decode_otlp_logs(OTLP_PAYLOAD)
+    assert len(records) == 2
+    ts, body, meta = records[0]
+    assert ts == 1700000000123456789
+    assert body["message"] == "payment failed"
+    assert body["order_id"] == 42
+    assert body["severity"] == "ERROR"
+    assert meta["otlp"]["resource"]["service.name"] == "checkout"
+    _, body2, _ = records[1]
+    assert body2 == {"k": "v", "n": 1.5}
+
+
+def test_encode_otlp_logs_roundtrip():
+    events = decode_events(
+        encode_event({"message": "hi", "severity": "warn"}, 1700000000.5)
+    )
+    payload = encode_otlp_logs(events, "my.tag")
+    back = decode_otlp_logs(payload)
+    assert len(back) == 1
+    ts, body, meta = back[0]
+    assert ts == 1700000000500000000
+    assert body["message"] == "hi"
+    assert body["severity"] == "warn"
+    assert meta["otlp"]["resource"]["service.name"] == "my.tag"
+
+
+def test_otlp_loopback_pipeline():
+    """out_opentelemetry → in_opentelemetry over real HTTP."""
+    srv = flb.create(flush="60ms", grace="1")
+    srv.input("opentelemetry", tag="otlp", port="0")
+    oins = srv.engine.inputs[0]
+    got = []
+    srv.output("lib", match="*", callback=lambda d, t: got.append((t, d)))
+    srv.start()
+    port = wait_for(lambda: getattr(oins.plugin, "bound_port", None))
+
+    cli = flb.create(flush="60ms", grace="1")
+    in_ffd = cli.input("lib", tag="apps")
+    cli.output("opentelemetry", match="*", host="127.0.0.1",
+               port=str(port))
+    cli.start()
+    try:
+        cli.push(in_ffd, json.dumps({"message": "otlp hop", "n": 3}))
+        cli.flush_now()
+        wait_for(lambda: got)
+    finally:
+        cli.stop()
+        srv.stop()
+    tag, data = got[0]
+    assert tag == "v1.logs"
+    body = decode_events(data)[0].body
+    assert body["message"] == "otlp hop" and body["n"] == 3
+
+
+def test_sampling_processor():
+    from fluentbit_tpu.core.plugin import registry
+
+    proc = registry.create_processor("sampling")
+    proc.set("percentage", "25")
+    proc.set("seed", "7")
+    proc.configure()
+    proc.plugin.init(proc, None)
+    events = decode_events(b"".join(
+        encode_event({"i": i}, float(i)) for i in range(2000)
+    ))
+    kept = proc.plugin.process_logs(events, "t", None)
+    assert 350 < len(kept) < 650  # ~25% of 2000
+
+
+def test_out_nats_stub():
+    received = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    port = srv.getsockname()[1]
+
+    def serve():
+        c, _ = srv.accept()
+        c.sendall(b'INFO {"server_id":"stub"}\r\n')
+        c.settimeout(5)
+        data = b""
+        try:
+            while b"PUB " not in data or not data.endswith(b"\r\n"):
+                data += c.recv(65536)
+        except OSError:
+            pass
+        received.append(data)
+        c.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    ctx = flb.create(flush="60ms", grace="1")
+    in_ffd = ctx.input("lib", tag="subject.a")
+    ctx.output("nats", match="*", host="127.0.0.1", port=str(port))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"m": 1}))
+        ctx.flush_now()
+        wait_for(lambda: received)
+    finally:
+        ctx.stop()
+        srv.close()
+    data = received[0].decode()
+    assert "CONNECT" in data
+    assert "PUB subject.a " in data
+    assert '"m":1' in data.replace(" ", "")
+
+
+def test_gated_prometheus_remote_write():
+    from fluentbit_tpu.core.plugin import registry
+
+    ins = registry.create_output("prometheus_remote_write")
+    ins.configure()
+    with pytest.raises(RuntimeError, match="snappy"):
+        ins.plugin.init(ins, None)
