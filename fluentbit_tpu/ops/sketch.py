@@ -20,7 +20,6 @@ showcase for the metrics-reduction contract (SURVEY §2.4).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import numpy as np

@@ -12,7 +12,6 @@ host/os/version fields).
 
 from __future__ import annotations
 
-import os
 import platform
 import sys
 import time

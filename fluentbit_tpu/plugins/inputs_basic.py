@@ -12,7 +12,6 @@ import os
 import random as _random
 import subprocess
 import sys
-import time
 
 from ..codec.events import encode_event, now_event_time
 from ..codec.msgpack import EventTime

@@ -96,9 +96,29 @@ class NatsOutput(OutputPlugin):
         async with self._lock:
             return await self._flush_locked(data, tag)
 
+    async def _service_incoming(self) -> None:
+        """Answer server PINGs and surface -ERR (a real broker drops
+        the connection after unanswered pings)."""
+        while True:
+            try:
+                line = await asyncio.wait_for(self._reader.readline(),
+                                              0.005)
+            except asyncio.TimeoutError:
+                return
+            if not line:
+                raise ConnectionError("nats: peer closed")
+            if line.startswith(b"PING"):
+                self._writer.write(b"PONG\r\n")
+                await self._writer.drain()
+            elif line.startswith(b"-ERR"):
+                raise ConnectionError(
+                    f"nats: {line.decode(errors='replace').strip()}"
+                )
+
     async def _flush_locked(self, data: bytes, tag: str) -> FlushResult:
         try:
             await self._connect()
+            await self._service_incoming()
             for line in format_json_lines(data).splitlines():
                 payload = line.encode()
                 self._writer.write(
@@ -106,6 +126,7 @@ class NatsOutput(OutputPlugin):
                     + payload + b"\r\n"
                 )
             await asyncio.wait_for(self._writer.drain(), 30)
+            await self._service_incoming()  # catch -ERR for this publish
         except (OSError, ConnectionError, asyncio.TimeoutError):
             if self._writer is not None:
                 try:
@@ -204,28 +225,49 @@ class DockerEventsInput(InputPlugin):
         try:
             writer.write(b"GET /events HTTP/1.1\r\nHost: docker\r\n\r\n")
             await writer.drain()
-            # skip response headers
+            # response headers
+            chunked = False
             while True:
                 line = await reader.readline()
                 if line in (b"\r\n", b""):
                     break
+                if line.lower().startswith(b"transfer-encoding:") and \
+                        b"chunked" in line.lower():
+                    chunked = True
+            # de-chunk EXACTLY (an event JSON may span chunk
+            # boundaries), then split records on newlines
+            pending = b""
             while True:
-                line = (await reader.readline()).strip()
-                if not line:
-                    continue
-                try:
-                    int(line, 16)  # chunked-encoding size lines
-                    continue
-                except ValueError:
-                    pass
-                try:
-                    body = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(body, dict):
-                    engine.input_log_append(
-                        self.instance, self.instance.tag,
-                        encode_event(body, now_event_time()), 1,
-                    )
+                if chunked:
+                    size_line = await reader.readline()
+                    if not size_line:
+                        break
+                    try:
+                        size = int(size_line.strip() or b"0", 16)
+                    except ValueError:
+                        break
+                    if size == 0:
+                        break
+                    data = await reader.readexactly(size)
+                    await reader.readline()  # trailing CRLF
+                else:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                pending += data
+                *lines, pending = pending.split(b"\n")
+                for raw in lines:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        body = json.loads(raw)
+                    except ValueError:
+                        continue
+                    if isinstance(body, dict):
+                        engine.input_log_append(
+                            self.instance, self.instance.tag,
+                            encode_event(body, now_event_time()), 1,
+                        )
         finally:
             writer.close()

@@ -21,10 +21,10 @@ import json
 import logging
 from typing import Any, Dict, List, Optional
 
-from ..codec.events import LogEvent, encode_event, iter_events
+from ..codec.events import encode_event, iter_events
 from ..codec.msgpack import EventTime
 from ..core.config import ConfigMapEntry
-from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from ..core.plugin import InputPlugin, registry
 
 log = logging.getLogger("flb.otlp")
 
@@ -129,8 +129,10 @@ def encode_otlp_logs(events, tag: str) -> dict:
         meta = ev.metadata or {}
         otlp = meta.get("otlp", {}) if isinstance(meta, dict) else {}
         resource = otlp.get("resource") or {"service.name": tag}
-        key = json.dumps(resource, sort_keys=True, default=str)
-        g = groups.setdefault(key, {"resource": resource, "records": []})
+        scope = otlp.get("scope") or {"name": "fluentbit_tpu"}
+        key = json.dumps([resource, scope], sort_keys=True, default=str)
+        g = groups.setdefault(key, {"resource": resource, "scope": scope,
+                                    "records": []})
         body = dict(ev.body) if isinstance(ev.body, dict) else {}
         sev_text = str(body.pop("severity", ""))
         ts = ev.timestamp
@@ -151,7 +153,7 @@ def encode_otlp_logs(events, tag: str) -> dict:
         g["records"].append(rec)
     return {"resourceLogs": [
         {"resource": {"attributes": dict_to_kvlist(g["resource"])},
-         "scopeLogs": [{"scope": {"name": "fluentbit_tpu"},
+         "scopeLogs": [{"scope": g["scope"],
                         "logRecords": g["records"]}]}
         for g in groups.values()
     ]}

@@ -18,7 +18,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..codec.events import decode_events
-from ..core.config import ConfigMapEntry, parse_size, parse_time
+from ..core.config import ConfigMapEntry
 from ..core.fstore import FStore
 from ..core.plugin import FlushResult, OutputPlugin, registry
 from ..utils import aws as _aws
@@ -28,16 +28,29 @@ from .outputs_http_based import _dumps
 
 async def _http_request(ins, host: str, port: int, method: str, path: str,
                         headers: Dict[str, str], body: bytes,
-                        timeout: float = 30.0) -> Tuple[int, bytes]:
+                        timeout: float = 30.0, quote_path: bool = True,
+                        use_tls: Optional[bool] = None) -> Tuple[int, bytes]:
     from urllib.parse import quote
 
     from ..core.tls import open_connection
 
     # honor the instance's tls.* properties (never plaintext when
-    # `tls on`); the request line carries the SAME encoding the
-    # signature was computed over (identical quote + safe set)
-    path = quote(path, safe="/-_.~")
-    reader, writer = await open_connection(ins, host, port, timeout=10.0)
+    # `tls on`). SigV4 callers keep quote_path=True: the request line
+    # must carry the SAME encoding the signature was computed over
+    # (identical quote + safe set); Google-style method paths
+    # (…/entries:write) pass quote_path=False and pre-safe paths.
+    if quote_path:
+        path = quote(path, safe="/-_.~")
+    if use_tls:
+        import asyncio as _aio
+        import ssl as _ssl
+
+        ctx = _ssl.create_default_context()
+        reader, writer = await _aio.wait_for(
+            _aio.open_connection(host, port, ssl=ctx), 10.0
+        )
+    else:
+        reader, writer = await open_connection(ins, host, port, timeout=10.0)
     try:
         lines = [f"{method} {path} HTTP/1.1", f"Host: {host}:{port}",
                  f"Content-Length: {len(body)}", "Connection: close"]

@@ -56,14 +56,17 @@ class _HttpDeliveryOutput(OutputPlugin):
     CONNECT_TIMEOUT = 10.0  # net.connect_timeout default (flb_upstream)
     IO_TIMEOUT = 30.0
 
-    async def _post(self, body: bytes) -> FlushResult:
+    async def _post(self, body: bytes,
+                    extra_headers: Optional[List[str]] = None) -> FlushResult:
+        # per-request headers are passed in, never stashed on the
+        # instance: concurrent flushes must not see each other's auth
         headers = [
             f"POST {self._uri()} HTTP/1.1",
             f"Host: {self.host}:{self.port}",
             f"Content-Length: {len(body)}",
             f"Content-Type: {self._content_type()}",
             "Connection: close",
-        ] + self._headers()
+        ] + self._headers() + (extra_headers or [])
         writer = None
         try:
             from ..core.tls import open_connection

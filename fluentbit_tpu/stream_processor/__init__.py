@@ -28,7 +28,6 @@ reference semantics those kernels must reproduce.
 
 from __future__ import annotations
 
-import math
 import re
 import time
 from dataclasses import dataclass, field
