@@ -27,4 +27,5 @@ from . import outputs_aws  # noqa: F401
 from . import outputs_cloud  # noqa: F401
 from . import opentelemetry  # noqa: F401
 from . import misc_plugins  # noqa: F401
+from . import in_servers_extra  # noqa: F401
 from . import gated  # noqa: F401

@@ -1,0 +1,171 @@
+"""Server-style inputs: splunk (HEC) + elasticsearch (bulk API).
+
+Reference: plugins/in_splunk (Splunk HTTP Event Collector server:
+/services/collector[/event] JSON events, /services/collector/raw raw
+lines, token auth, store_token_in_metadata) and plugins/
+in_elasticsearch (Elasticsearch bulk-API server: POST /_bulk NDJSON
+action/document pairs, answering the bulk response shape so
+beats/agents accept the sink). Both ride the shared HTTP server base
+(net_http.HttpServerInputBase).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..codec.msgpack import EventTime
+from ..core.config import ConfigMapEntry
+from ..core.plugin import registry
+from .net_http import HttpServerInputBase
+
+log = logging.getLogger("flb.servers")
+
+
+@registry.register
+class SplunkInput(HttpServerInputBase):
+    name = "splunk"
+    description = "Splunk HEC server"
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=8088),
+        ConfigMapEntry("splunk_token", "str"),
+        ConfigMapEntry("store_token_in_metadata", "bool", default=False),
+    ]
+
+    def _authorized(self, headers) -> Optional[str]:
+        auth = headers.get("authorization", "")
+        token = auth[len("Splunk "):] if auth.startswith("Splunk ") else None
+        if not self.splunk_token:
+            return token or ""
+        return token if token == self.splunk_token else None
+
+    def handle_request(self, engine, method, path, headers, body):
+        if method != "POST":
+            return 400, b'{"text":"Bad Request","code":6}'
+        token = self._authorized(headers)
+        if token is None:
+            return 401, b'{"text":"Invalid token","code":4}'
+        if path not in ("/services/collector", "/services/collector/event",
+                        "/services/collector/raw"):
+            return 404, b'{"text":"Not Found","code":404}'
+        # out_splunk passthrough: keep the presented token in metadata
+        meta = {"hec_token": token} \
+            if self.store_token_in_metadata and token else None
+        out = bytearray()
+        n = 0
+        if path.endswith("/raw"):
+            for raw in body.splitlines():
+                line = raw.decode("utf-8", "replace").strip()
+                if line:
+                    out += encode_event({"log": line}, now_event_time(),
+                                        meta)
+                    n += 1
+        else:
+            # concatenated JSON objects (HEC allows back-to-back docs)
+            dec = json.JSONDecoder()
+            text = body.decode("utf-8", "replace").strip()
+            pos = 0
+            while pos < len(text):
+                try:
+                    obj, end = dec.raw_decode(text, pos)
+                except ValueError:
+                    return 400, b'{"text":"Invalid data format","code":6}'
+                pos = end
+                while pos < len(text) and text[pos] in " \r\n\t":
+                    pos += 1
+                if not isinstance(obj, dict):
+                    # real HEC rejects non-object events (code 6)
+                    return 400, b'{"text":"Invalid data format","code":6}'
+                event = obj.get("event", obj)
+                rec = event if isinstance(event, dict) else {"event": event}
+                rec = dict(rec)
+                for k in ("source", "sourcetype", "index", "host"):
+                    if k in obj:
+                        rec.setdefault(k, obj[k])
+                if isinstance(obj.get("fields"), dict):
+                    for k, v in obj["fields"].items():
+                        rec.setdefault(k, v)
+                ts = obj.get("time")
+                try:
+                    ts = EventTime.from_float(float(ts)) if ts is not None \
+                        else now_event_time()
+                except (TypeError, ValueError):
+                    ts = now_event_time()
+                out += encode_event(rec, ts, meta)
+                n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+        return 200, b'{"text":"Success","code":0}'
+
+
+@registry.register
+class ElasticsearchInput(HttpServerInputBase):
+    name = "elasticsearch"
+    description = "Elasticsearch bulk-API server"
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=9200),
+        ConfigMapEntry("meta_key", "str", default="@es_meta",
+                       desc="store the bulk action metadata under this key"),
+        ConfigMapEntry("hostname", "str", default="fluentbit-tpu"),
+        ConfigMapEntry("version", "str", default="8.0.0"),
+    ]
+
+    def handle_request(self, engine, method, path, headers, body):
+        if method in ("GET", "HEAD"):
+            # beats probe the root + license endpoints before bulking
+            info = {"name": self.hostname,
+                    "version": {"number": self.version},
+                    "tagline": "You Know, for Search"}
+            return 200, json.dumps(info).encode()
+        if method != "POST" or not path.endswith("_bulk"):
+            return 400, b'{"error":"unsupported"}'
+        out = bytearray()
+        n = 0
+        items = []
+        action_meta = None
+        for raw in body.splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                return 400, b'{"error":"malformed bulk body"}'
+            if action_meta is None:
+                # action line: {"index": {...}} / {"create": {...}} —
+                # delete has no document line
+                if not isinstance(obj, dict) or not obj:
+                    return 400, b'{"error":"bad action"}'
+                op = next(iter(obj))
+                meta = obj.get(op)
+                if meta is not None and not isinstance(meta, dict):
+                    return 400, b'{"error":"bad action metadata"}'
+                if op == "delete":
+                    items.append({op: {"status": 200}})
+                    continue
+                action_meta = (op, meta or {})
+                continue
+            op, meta = action_meta
+            action_meta = None
+            if not isinstance(obj, dict):
+                # clients correlate items positionally: a bad document
+                # must fail the request, never silently desync
+                return 400, b'{"error":"bulk document must be an object"}'
+            rec = dict(obj)
+            if self.meta_key:
+                rec[self.meta_key] = {"op": op, **meta}
+            out += encode_event(rec, now_event_time())
+            n += 1
+            items.append({op: {"status": 201, "result": "created"}})
+        if action_meta is not None:
+            return 400, b'{"error":"action without document"}'
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+        return 200, json.dumps({"took": 0, "errors": False,
+                                "items": items}).encode()

@@ -99,22 +99,28 @@ def _parse_json_bodies(body: bytes) -> Optional[List[dict]]:
     return out
 
 
-@registry.register
-class HttpInput(InputPlugin):
-    name = "http"
-    description = "HTTP server input (JSON/NDJSON bodies)"
+class HttpServerInputBase(InputPlugin):
+    """Shared HTTP server skeleton for server-type inputs (http, splunk
+    HEC, elasticsearch bulk, opentelemetry). Subclasses implement
+    ``handle_request(engine, method, path, headers, body) → (status,
+    resp_bytes)``; the base runs the accept loop, TLS, keep-alive
+    (Connection: close honored), HEAD body suppression, and error
+    isolation (a raising handler answers 500 instead of dropping the
+    connection)."""
+
     server_task_needed = True
-    config_map = [
-        ConfigMapEntry("listen", "str", default="0.0.0.0"),
-        ConfigMapEntry("port", "int", default=9880),
-        ConfigMapEntry("tag_key", "str"),
-        ConfigMapEntry("successful_response_code", "int", default=201),
-    ]
+    content_type = "application/json"
 
     def init(self, instance, engine) -> None:
         self.bound_port: Optional[int] = None
 
+    def handle_request(self, engine, method, path, headers,
+                       body):  # pragma: no cover
+        raise NotImplementedError
+
     async def start_server(self, engine) -> None:
+        from ..core.tls import server_context
+
         async def handle(reader, writer):
             try:
                 while True:
@@ -122,34 +128,19 @@ class HttpInput(InputPlugin):
                     if req is None:
                         break
                     method, uri, headers, body = req
-                    if method != "POST":
-                        writer.write(http_response(400, b"POST only\n"))
-                        await writer.drain()
-                        continue
-                    bodies = _parse_json_bodies(body)
-                    if bodies is None:
-                        writer.write(http_response(400, b"bad body\n"))
-                        await writer.drain()
-                        continue
-                    uri_tag = uri.lstrip("/").split("?")[0].replace("/", ".") \
-                        or self.instance.tag
-                    # tag_key resolves PER RECORD: group by tag, one
-                    # append per group so mixed-tag bodies route right
-                    groups: Dict[str, bytearray] = {}
-                    counts: Dict[str, int] = {}
-                    for b in bodies:
-                        tag = uri_tag
-                        if self.tag_key and isinstance(b.get(self.tag_key), str):
-                            tag = b[self.tag_key]
-                        groups.setdefault(tag, bytearray())
-                        groups[tag] += encode_event(b, now_event_time())
-                        counts[tag] = counts.get(tag, 0) + 1
-                    for tag, buf in groups.items():
-                        engine.input_log_append(
-                            self.instance, tag, bytes(buf), counts[tag]
+                    try:
+                        status, resp = self.handle_request(
+                            engine, method, uri.split("?")[0], headers,
+                            body,
                         )
-                    writer.write(http_response(
-                        self.successful_response_code or 201))
+                    except Exception:
+                        log.exception("%s request handler failed",
+                                      self.name)
+                        status, resp = 500, b"{}"
+                    if method == "HEAD":
+                        resp = b""  # RFC 9110: HEAD carries no body
+                    writer.write(http_response(status, resp,
+                                               self.content_type))
                     await writer.drain()
                     if headers.get("connection", "").lower() == "close":
                         break
@@ -161,8 +152,6 @@ class HttpInput(InputPlugin):
                 except Exception:
                     pass
 
-        from ..core.tls import server_context
-
         server = await asyncio.start_server(
             handle, self.listen, self.port,
             ssl=server_context(self.instance),
@@ -170,6 +159,42 @@ class HttpInput(InputPlugin):
         self.bound_port = server.sockets[0].getsockname()[1]
         async with server:
             await server.serve_forever()
+
+
+@registry.register
+class HttpInput(HttpServerInputBase):
+    name = "http"
+    description = "HTTP server input (JSON/NDJSON bodies)"
+    content_type = "text/plain"
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=9880),
+        ConfigMapEntry("tag_key", "str"),
+        ConfigMapEntry("successful_response_code", "int", default=201),
+    ]
+
+    def handle_request(self, engine, method, path, headers, body):
+        if method != "POST":
+            return 400, b"POST only\n"
+        bodies = _parse_json_bodies(body)
+        if bodies is None:
+            return 400, b"bad body\n"
+        uri_tag = path.lstrip("/").replace("/", ".") or self.instance.tag
+        # tag_key resolves PER RECORD: group by tag, one append per
+        # group so mixed-tag bodies route right
+        groups: Dict[str, bytearray] = {}
+        counts: Dict[str, int] = {}
+        for b in bodies:
+            tag = uri_tag
+            if self.tag_key and isinstance(b.get(self.tag_key), str):
+                tag = b[self.tag_key]
+            groups.setdefault(tag, bytearray())
+            groups[tag] += encode_event(b, now_event_time())
+            counts[tag] = counts.get(tag, 0) + 1
+        for tag, buf in groups.items():
+            engine.input_log_append(self.instance, tag, bytes(buf),
+                                    counts[tag])
+        return (self.successful_response_code or 201), b""
 
 
 @registry.register

@@ -337,3 +337,64 @@ def test_tail_parser_and_tag_expansion(tmp_path):
     assert ev.body["level"] == "info"
     assert ev.body["filepath"] == str(f)
     assert tag.startswith("app.") and tag.endswith("svc.log")
+
+
+def test_in_splunk_hec():
+    ctx, port, got = collect_ctx("splunk", splunk_token="tok123")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        body = (b'{"time": 1700000000.5, "event": {"msg": "one"}, '
+                b'"sourcetype": "st"}{"event": "bare string"}')
+        s.sendall(b"POST /services/collector/event HTTP/1.1\r\nHost: x\r\n"
+                  b"Authorization: Splunk tok123\r\n"
+                  b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        resp = s.recv(4096)
+        assert b'"code":0' in resp
+        s.close()
+        wait_for(lambda: len(events_of(got)) >= 2)
+    finally:
+        ctx.stop()
+    evs = [e for _, e in events_of(got)]
+    assert evs[0].body["msg"] == "one"
+    assert evs[0].body["sourcetype"] == "st"
+    assert abs(evs[0].ts_float - 1700000000.5) < 1e-6
+    assert evs[1].body == {"event": "bare string"}
+
+
+def test_in_splunk_rejects_bad_token():
+    ctx, port, got = collect_ctx("splunk", splunk_token="right")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(b"POST /services/collector HTTP/1.1\r\nHost: x\r\n"
+                  b"Authorization: Splunk wrong\r\n"
+                  b"Content-Length: 2\r\n\r\n{}")
+        resp = s.recv(4096)
+        s.close()
+        assert b"401" in resp.split(b"\r\n")[0]
+        time.sleep(0.2)
+        assert events_of(got) == []
+    finally:
+        ctx.stop()
+
+
+def test_in_elasticsearch_bulk():
+    ctx, port, got = collect_ctx("elasticsearch")
+    try:
+        s = socket.create_connection(("127.0.0.1", port))
+        body = (b'{"create": {"_index": "logs"}}\n'
+                b'{"msg": "doc one"}\n'
+                b'{"index": {"_index": "logs"}}\n'
+                b'{"msg": "doc two"}\n')
+        s.sendall(b"POST /_bulk HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        resp = s.recv(65536)
+        s.close()
+        assert b'"errors": false' in resp.replace(b'"errors":false',
+                                                  b'"errors": false')
+        wait_for(lambda: len(events_of(got)) >= 2)
+    finally:
+        ctx.stop()
+    evs = [e for _, e in events_of(got)]
+    assert evs[0].body["msg"] == "doc one"
+    assert evs[0].body["@es_meta"] == {"op": "create", "_index": "logs"}
+    assert evs[1].body["msg"] == "doc two"
