@@ -9,7 +9,7 @@ Public embedding API mirrors the reference's library mode
 (include/fluent-bit/flb_lib.h): create/input/filter/output/start/push/stop.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from .lib import FLBContext, create  # noqa: F401
 from .core.plugin import (  # noqa: F401

@@ -19,7 +19,7 @@ import signal
 import sys
 import threading
 
-__version__ = "0.2.0"
+from fluentbit_tpu import __version__
 
 USAGE = """\
 fluentbit_tpu — TPU-native telemetry pipeline

@@ -32,6 +32,12 @@ from ..plugins.net_http import http_response, read_http_request
 log = logging.getLogger("flb.http_server")
 
 
+def _version() -> str:
+    from .. import __version__
+
+    return __version__
+
+
 class AdminServer:
     def __init__(self, engine, listen: str = "0.0.0.0", port: int = 2020):
         self.engine = engine
@@ -81,7 +87,7 @@ class AdminServer:
             return self._route_trace(method, path, req_body)
         if path == "/":
             return 200, json.dumps(
-                {"fluentbit_tpu": {"version": "0.2.0",
+                {"fluentbit_tpu": {"version": _version(),
                                    "edition": "tpu-native"}}
             ).encode(), "application/json"
         if path == "/api/v1/health":

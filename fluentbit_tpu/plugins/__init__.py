@@ -28,4 +28,5 @@ from . import outputs_cloud  # noqa: F401
 from . import opentelemetry  # noqa: F401
 from . import misc_plugins  # noqa: F401
 from . import in_servers_extra  # noqa: F401
+from . import enrichment_extra  # noqa: F401
 from . import gated  # noqa: F401

@@ -121,25 +121,18 @@ class KubernetesFilter(FilterPlugin):
         if not url.startswith("http://"):
             log.warning("kubernetes: only plain http kube_url supported")
             return {}
+        from ..utils import plain_http_request
+
         hostport = url[len("http://"):].split("/")[0]
         host, _, port = hostport.partition(":")
+        path = f"/api/v1/namespaces/{namespace}/pods/{pod}"
+        got = plain_http_request(host, int(port or 80), "GET", path,
+                                 timeout=3)
+        if got is None or got[0] != 200:
+            return {}
         try:
-            s = socket.create_connection((host, int(port or 80)), timeout=3)
-            path = f"/api/v1/namespaces/{namespace}/pods/{pod}"
-            s.sendall(f"GET {path} HTTP/1.1\r\nHost: {hostport}\r\n"
-                      f"Connection: close\r\n\r\n".encode())
-            data = b""
-            while True:
-                chunk = s.recv(65536)
-                if not chunk:
-                    break
-                data += chunk
-            s.close()
-            head, _, body = data.partition(b"\r\n\r\n")
-            if b" 200 " not in head.split(b"\r\n")[0]:
-                return {}
-            return json.loads(body)
-        except (OSError, ValueError):
+            return json.loads(got[1])
+        except ValueError:
             return {}
 
     def _kubernetes_map(self, identity: dict, meta: dict) -> dict:

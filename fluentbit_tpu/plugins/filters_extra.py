@@ -215,7 +215,9 @@ class SysinfoFilter(FilterPlugin):
 
         self._fields: Dict[str, str] = {}
         if self.fluentbit_version_key:
-            self._fields[self.fluentbit_version_key] = "0.2.0"
+            from .. import __version__
+
+            self._fields[self.fluentbit_version_key] = __version__
         if self.os_name_key:
             self._fields[self.os_name_key] = sys.platform
         if self.hostname_key:

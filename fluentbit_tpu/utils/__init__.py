@@ -59,6 +59,36 @@ def crc32(data: bytes) -> int:
     return zlib.crc32(data) & 0xFFFFFFFF
 
 
+def plain_http_request(host: str, port: int, method: str, path: str,
+                       headers=None, body: bytes = b"",
+                       timeout: float = 2.0):
+    """Minimal blocking HTTP/1.1 request → (status, body) or None on
+    socket failure. The one shared helper for metadata-style fetches
+    (filter_kubernetes kube_url, filter_aws IMDS, filter_ecs) — the
+    reference funnels these through its flb_http_client."""
+    import socket as _socket
+
+    try:
+        s = _socket.create_connection((host, port), timeout=timeout)
+        req = [f"{method} {path} HTTP/1.1", f"Host: {host}",
+               "Connection: close", f"Content-Length: {len(body)}"]
+        for k, v in (headers or {}).items():
+            req.append(f"{k}: {v}")
+        s.sendall(("\r\n".join(req) + "\r\n\r\n").encode() + body)
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        s.close()
+        head, _, resp = data.partition(b"\r\n\r\n")
+        status = int(head.split(b" ", 2)[1])
+        return status, resp
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 # -- crypto (flb_crypto/flb_hmac: SHA-family digests + HMAC signing) --
 
 _DIGESTS = {"sha256", "sha512", "sha1", "md5", "sha384", "sha224"}

@@ -1,0 +1,134 @@
+"""filter_aws (stub IMDS), filter_ecs, opentelemetry_envelope, tda."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.core.plugin import registry
+
+
+class StubMeta:
+    """Answers fixed paths with text bodies."""
+
+    def __init__(self, routes):
+        self.routes = routes
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                c, _ = self.srv.accept()
+            except OSError:
+                return
+            try:
+                c.settimeout(2)
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += c.recv(65536)
+                path = data.split(b" ")[1].decode()
+                body = self.routes.get(path)
+                if body is None:
+                    c.sendall(b"HTTP/1.1 404 NF\r\nContent-Length: 0\r\n\r\n")
+                else:
+                    payload = body.encode()
+                    c.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                              + str(len(payload)).encode()
+                              + b"\r\n\r\n" + payload)
+            except OSError:
+                pass
+            c.close()
+
+    def close(self):
+        self.srv.close()
+
+
+def ev(body, ts=1.0):
+    return decode_events(encode_event(body, ts))[0]
+
+
+def test_filter_aws_enriches_from_stub_imds():
+    stub = StubMeta({
+        "/latest/meta-data/placement/availability-zone": "us-east-1a",
+        "/latest/meta-data/instance-id": "i-0abc",
+    })
+    ins = registry.create_filter("aws")
+    ins.set("imds_host", "127.0.0.1")
+    ins.set("imds_port", str(stub.port))
+    ins.configure()
+    ins.plugin.init(ins, None)
+    _, out = ins.plugin.filter([ev({"log": "x"})], "t", None)
+    stub.close()
+    assert out[0].body["az"] == "us-east-1a"
+    assert out[0].body["ec2_instance_id"] == "i-0abc"
+
+
+def test_filter_aws_degrades_without_imds():
+    ins = registry.create_filter("aws")
+    ins.set("imds_host", "127.0.0.1")
+    ins.set("imds_port", "1")  # nothing listens
+    ins.configure()
+    ins.plugin.init(ins, None)
+    events = [ev({"log": "x"})]
+    res, out = ins.plugin.filter(events, "t", None)
+    assert out[0].body == {"log": "x"}  # pass-through
+
+
+def test_filter_ecs_from_stub():
+    stub = StubMeta({
+        "/task": json.dumps({"Cluster": "prod", "TaskARN": "arn:x",
+                             "Family": "web"}),
+    })
+    ins = registry.create_filter("ecs")
+    ins.set("metadata_host", "127.0.0.1")
+    ins.set("metadata_port", str(stub.port))
+    ins.set("add", "ecs_cluster cluster")
+    ins.set("add", "task task_arn")
+    ins.configure()
+    ins.plugin.init(ins, None)
+    _, out = ins.plugin.filter([ev({"m": 1})], "t", None)
+    stub.close()
+    assert out[0].body["ecs_cluster"] == "prod"
+    assert out[0].body["task"] == "arn:x"
+
+
+def test_otel_envelope_feeds_exporter_grouping():
+    proc = registry.create_processor("opentelemetry_envelope")
+    proc.configure()
+    proc.plugin.init(proc, None)
+    out = proc.plugin.process_logs([ev({"m": 1})], "svc.a", None)
+    assert out[0].metadata["otlp"]["resource"] == {"service.name": "svc.a"}
+    # exporter groups by that envelope
+    from fluentbit_tpu.plugins.opentelemetry import encode_otlp_logs
+
+    payload = encode_otlp_logs(out, "svc.a")
+    res = payload["resourceLogs"][0]["resource"]["attributes"]
+    assert {"key": "service.name",
+            "value": {"stringValue": "svc.a"}} in res
+
+
+def test_tda_betti0_tracks_cluster_count():
+    proc = registry.create_processor("tda")
+    proc.set("fields", "x,y")
+    proc.set("window_size", "8")
+    proc.set("epsilon", "1.5")
+    proc.configure()
+    proc.plugin.init(proc, None)
+    # one tight cluster → betti_0 settles at 1
+    events = [ev({"x": 0.0 + i * 0.1, "y": 0.0}) for i in range(4)]
+    out = proc.plugin.process_logs(events, "t", None)
+    assert out[-1].body["betti_0"] == 1
+    # a far-away point splits the cloud into 2 components
+    out2 = proc.plugin.process_logs([ev({"x": 100.0, "y": 100.0})], "t", None)
+    assert out2[0].body["betti_0"] == 2
+    # non-numeric rows pass through untouched
+    out3 = proc.plugin.process_logs([ev({"x": "nan?"})], "t", None)
+    assert "betti_0" not in out3[0].body
