@@ -217,6 +217,7 @@ class ServiceConfig:
     storage_sync: str = "normal"
     storage_checksum: bool = False
     storage_backlog_mem_limit: int = 5 * 1024 * 1024
+    storage_max_chunks_up: int = 128  # pause threshold (flb_storage)
     # TPU execution options (new — no reference equivalent)
     tpu_enable: bool = True
     tpu_batch_records: int = 8192
@@ -240,6 +241,7 @@ class ServiceConfig:
         "storage.sync": ("storage_sync", str),
         "storage.checksum": ("storage_checksum", parse_bool),
         "storage.backlog.mem_limit": ("storage_backlog_mem_limit", parse_size),
+        "storage.max_chunks_up": ("storage_max_chunks_up", int),
         "tpu.enable": ("tpu_enable", parse_bool),
         "tpu.batch_records": ("tpu_batch_records", int),
         "tpu.max_record_len": ("tpu_max_record_len", int),

@@ -467,8 +467,17 @@ class Engine:
         """
         tag = tag or ins.tag or ins.plugin.name
 
-        # backpressure (mem_buf_limit, src/flb_input.c:157,740-746)
-        if ins.mem_buf_limit and ins.pool.pending_bytes >= ins.mem_buf_limit:
+        # backpressure (mem_buf_limit, src/flb_input.c:157,740-746;
+        # storage.pause_on_chunks_overlimit, :169)
+        over = (
+            ins.mem_buf_limit
+            and ins.pool.pending_bytes >= ins.mem_buf_limit
+        ) or (
+            getattr(ins, "pause_on_chunks_overlimit", False)
+            and ins.pool.pending_chunks
+            >= self.service.storage_max_chunks_up
+        )
+        if over:
             if not ins.paused:
                 ins.paused = True
                 try:
@@ -711,7 +720,12 @@ class Engine:
                     chunks.append((ins, chunk))
                 # resume paused inputs once the buffer drains
                 if ins.paused and (
-                    not ins.mem_buf_limit or ins.pool.pending_bytes < ins.mem_buf_limit
+                    not ins.mem_buf_limit
+                    or ins.pool.pending_bytes < ins.mem_buf_limit
+                ) and (
+                    not getattr(ins, "pause_on_chunks_overlimit", False)
+                    or ins.pool.pending_chunks
+                    < self.service.storage_max_chunks_up
                 ):
                     ins.paused = False
                     try:

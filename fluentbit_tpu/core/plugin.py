@@ -174,10 +174,15 @@ class InputInstance(Instance):
         # instances of the same plugin never merge streams (reference:
         # instance tag defaults to the instance name)
         self.tag = self.properties.get("tag") or self.plugin.default_tag or self.name
-        from .config import parse_size
+        from .config import parse_bool, parse_size
         mbl = self.properties.get("mem_buf_limit")
         self.mem_buf_limit = parse_size(mbl) if mbl else 0
         self.storage_type = self.properties.get("storage.type", "memory")
+        # storage.pause_on_chunks_overlimit (src/flb_input.c:169):
+        # filesystem-backed inputs pause at storage.max_chunks_up
+        self.pause_on_chunks_overlimit = parse_bool(
+            self.properties.get("storage.pause_on_chunks_overlimit", False)
+        )
 
 
 class FilterInstance(Instance):

@@ -29,4 +29,5 @@ from . import opentelemetry  # noqa: F401
 from . import misc_plugins  # noqa: F401
 from . import in_servers_extra  # noqa: F401
 from . import enrichment_extra  # noqa: F401
+from . import inputs_net_extra  # noqa: F401
 from . import gated  # noqa: F401

@@ -144,13 +144,20 @@ class EcsFilter(_MetadataHttpFilter):
         if not host:
             uri = os.environ.get("ECS_CONTAINER_METADATA_URI_V4", "")
             if uri.startswith("http://"):
-                rest = uri[len("http://"):]
-                hostport, _, base_path = rest.partition("/")
-                host, _, p = hostport.partition(":")
-                self.metadata_port = int(p or 80)
-                # the per-container base path (…/v4/<id>) prefixes the
-                # /task endpoint — dropping it 404s on real ECS
-                base = "/" + base_path.rstrip("/") if base_path else ""
+                try:
+                    rest = uri[len("http://"):]
+                    hostport, _, base_path = rest.partition("/")
+                    host, _, p = hostport.partition(":")
+                    self.metadata_port = int(p or 80)
+                    # the per-container base path (…/v4/<id>) prefixes
+                    # the /task endpoint — dropping it 404s on real ECS
+                    base = "/" + base_path.rstrip("/") if base_path else ""
+                except ValueError:
+                    # degrade-to-passthrough contract: a malformed URI
+                    # (IPv6 literal etc.) must not fail startup
+                    log.warning("filter_ecs: cannot parse metadata URI %r",
+                                uri)
+                    host = None
         if not host:
             log.warning("filter_ecs: no metadata endpoint (records pass "
                         "through unenriched)")

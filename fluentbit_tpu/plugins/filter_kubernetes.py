@@ -23,7 +23,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import socket
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -125,9 +124,13 @@ class KubernetesFilter(FilterPlugin):
 
         hostport = url[len("http://"):].split("/")[0]
         host, _, port = hostport.partition(":")
+        try:
+            port_n = int(port or 80)
+        except ValueError:
+            log.warning("kubernetes: malformed kube_url port %r", port)
+            return {}
         path = f"/api/v1/namespaces/{namespace}/pods/{pod}"
-        got = plain_http_request(host, int(port or 80), "GET", path,
-                                 timeout=3)
+        got = plain_http_request(host, port_n, "GET", path, timeout=3)
         if got is None or got[0] != 200:
             return {}
         try:

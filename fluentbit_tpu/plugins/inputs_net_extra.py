@@ -1,0 +1,307 @@
+"""More network inputs: unix_socket, prometheus_scrape,
+nginx_exporter_metrics.
+
+Reference: plugins/in_unix_socket (stream/dgram unix server, same
+framing as in_tcp), plugins/in_prometheus_scrape (pull a /metrics
+endpoint on an interval and re-emit the samples as metrics),
+plugins/in_nginx_exporter_metrics (nginx stub_status → metrics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.chunk import EVENT_TYPE_METRICS
+from ..codec.msgpack import packb
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+from .net_tcp_udp import _LineServerInput
+
+log = logging.getLogger("flb.net_extra")
+
+
+@registry.register
+class UnixSocketInput(_LineServerInput):
+    name = "unix_socket"
+    description = "unix-domain socket listener (JSON / raw lines)"
+    config_map = [
+        ConfigMapEntry("path", "str"),
+        ConfigMapEntry("mode", "str", default="stream"),
+        ConfigMapEntry("format", "str", default="json"),
+        ConfigMapEntry("separator", "str"),
+        ConfigMapEntry("source_key", "str", default="log"),
+        ConfigMapEntry("unix_perm", "str"),
+        ConfigMapEntry("chunk_size", "size", default="32k"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.path:
+            raise ValueError("unix_socket: path is required")
+        self.ready = False
+
+    def _prepare_path(self) -> None:
+        import os
+
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def _apply_perm(self) -> None:
+        if self.unix_perm:
+            import os
+
+            try:
+                os.chmod(self.path, int(str(self.unix_perm), 8))
+            except (OSError, ValueError):
+                log.warning("unix_socket: cannot apply unix_perm %r",
+                            self.unix_perm)
+
+    async def start_server(self, engine) -> None:
+        mode = (self.mode or "stream").lower()
+        self._prepare_path()
+        if mode == "dgram":
+            import socket as _socket
+
+            plugin = self
+
+            class Proto(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    plugin._emit_payload(engine, data)
+
+            sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
+            sock.bind(self.path)
+            sock.setblocking(False)
+            self._apply_perm()
+            loop = asyncio.get_running_loop()
+            transport, _ = await loop.create_datagram_endpoint(Proto,
+                                                               sock=sock)
+            self.ready = True
+            try:
+                await asyncio.Event().wait()
+            finally:
+                transport.close()
+            return
+
+        async def handle(reader, writer):
+            pending = b""
+            try:
+                while True:
+                    data = await reader.read(int(self.chunk_size or 32768))
+                    if not data:
+                        break
+                    pending += data
+                    sep = (self.separator or "\n").encode()
+                    if sep in pending:
+                        head, _, pending = pending.rpartition(sep)
+                        self._emit_payload(engine, head)
+            finally:
+                if pending.strip():
+                    self._emit_payload(engine, pending)
+                writer.close()
+
+        server = await asyncio.start_unix_server(handle, path=self.path)
+        self._apply_perm()
+        self.ready = True
+        async with server:
+            await server.serve_forever()
+
+
+# ------------------------------------------------- prometheus text parser
+
+_SAMPLE_RE = re.compile(
+    r"""^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)
+        (?:\{(?P<labels>[^}]*)\})?
+        \s+(?P<value>[^\s]+)(?:\s+(?P<ts>-?\d+))?$""",
+    re.VERBOSE,
+)
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
+)
+
+
+def parse_prometheus_text(text: str) -> List[dict]:
+    """Prometheus exposition text → metrics payload entries (the
+    reverse of core.metrics.payload_to_prometheus; the reference uses
+    the cmt_decode_prometheus flex/bison grammar)."""
+    metrics: Dict[str, dict] = {}
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 3:
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name = m.group("name")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = []
+        if m.group("labels"):
+            labels = [(k, re.sub(r"\\(.)", r"\1", v))
+                      for k, v in _LABEL_RE.findall(m.group("labels"))]
+        # histogram/summary series fold back into their base family name
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+                break
+        # entries key on (name, sorted label names): Prometheus label
+        # ORDER is unspecified, so samples must be realigned to the
+        # entry's key order, and differing label SETS get own entries
+        lmap = dict(labels)
+        key = (name, tuple(sorted(lmap)))
+        entry = metrics.setdefault(key, {
+            "name": name,
+            "type": types.get(base, types.get(name, "untyped")),
+            "desc": helps.get(base, helps.get(name, "")),
+            "labels": [k for k, _ in labels],
+            "values": [],
+        })
+        entry["values"].append({
+            "labels": [lmap[k] for k in entry["labels"]],
+            "value": value,
+        })
+    return list(metrics.values())
+
+
+class _AsyncScrapeInput(InputPlugin):
+    """Interval scrapers run ON the engine loop: the fetch must be
+    async (a blocking 3s connect would stall every collector, flush
+    timer, and server). collect() dispatches an async task; a strong
+    reference keeps it from being GC'd mid-flight."""
+
+    def collect(self, engine) -> None:
+        import asyncio
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # unit tests drive collect() synchronously
+            asyncio.run(self._scrape(engine))
+            return
+        tasks = getattr(self, "_scrape_tasks", None)
+        if tasks is None:
+            tasks = self._scrape_tasks = set()
+        t = asyncio.ensure_future(self._scrape(engine))
+        tasks.add(t)
+        t.add_done_callback(tasks.discard)
+
+    async def _scrape(self, engine) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+@registry.register
+class PrometheusScrapeInput(_AsyncScrapeInput):
+    name = "prometheus_scrape"
+    description = "scrape a Prometheus /metrics endpoint"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=9100),
+        ConfigMapEntry("metrics_path", "str", default="/metrics"),
+        ConfigMapEntry("scrape_interval", "time", default="10"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.scrape_interval or 10)
+
+    async def _scrape(self, engine) -> None:
+        from ..utils import async_plain_http_request
+
+        got = await async_plain_http_request(
+            self.host, self.port, "GET", self.metrics_path or "/metrics"
+        )
+        if got is None or got[0] != 200:
+            log.debug("prometheus_scrape: scrape failed")
+            return
+        entries = parse_prometheus_text(got[1].decode("utf-8", "replace"))
+        if not entries:
+            return
+        payload = {"meta": {"ts": time.time()}, "metrics": entries}
+        engine.input_event_append(
+            self.instance, self.instance.tag, packb(payload),
+            EVENT_TYPE_METRICS, n_records=len(entries),
+        )
+
+
+@registry.register
+class NginxExporterMetricsInput(_AsyncScrapeInput):
+    name = "nginx_exporter_metrics"
+    description = "nginx stub_status → metrics"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=80),
+        ConfigMapEntry("status_url", "str", default="/status"),
+        ConfigMapEntry("scrape_interval", "time", default="5"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.scrape_interval or 5)
+
+    async def _scrape(self, engine) -> None:
+        from ..utils import async_plain_http_request
+
+        got = await async_plain_http_request(
+            self.host, self.port, "GET", self.status_url or "/status"
+        )
+        up = 1.0 if got is not None and got[0] == 200 else 0.0
+        entries = [{"name": "nginx_up", "type": "gauge",
+                    "desc": "nginx reachable", "labels": [],
+                    "values": [{"labels": [], "value": up}]}]
+        if up:
+            text = got[1].decode("utf-8", "replace")
+            m = re.search(r"Active connections:\s*(\d+)", text)
+            counters = re.search(
+                r"^\s*(\d+)\s+(\d+)\s+(\d+)\s*$", text, re.MULTILINE)
+            rw = re.search(
+                r"Reading:\s*(\d+)\s+Writing:\s*(\d+)\s+Waiting:\s*(\d+)",
+                text)
+            def gauge(name, desc, v):
+                return {"name": f"nginx_{name}", "type": "gauge",
+                        "desc": desc, "labels": [],
+                        "values": [{"labels": [], "value": float(v)}]}
+            if m:
+                entries.append(gauge("connections_active",
+                                     "active connections", m.group(1)))
+            if counters:
+                entries.append({
+                    "name": "nginx_connections_accepted", "type": "counter",
+                    "desc": "accepted connections", "labels": [],
+                    "values": [{"labels": [],
+                                "value": float(counters.group(1))}]})
+                entries.append({
+                    "name": "nginx_http_requests_total", "type": "counter",
+                    "desc": "handled requests", "labels": [],
+                    "values": [{"labels": [],
+                                "value": float(counters.group(3))}]})
+            if rw:
+                entries.append(gauge("connections_reading", "reading",
+                                     rw.group(1)))
+                entries.append(gauge("connections_writing", "writing",
+                                     rw.group(2)))
+                entries.append(gauge("connections_waiting", "waiting",
+                                     rw.group(3)))
+        payload = {"meta": {"ts": time.time()}, "metrics": entries}
+        engine.input_event_append(
+            self.instance, self.instance.tag, packb(payload),
+            EVENT_TYPE_METRICS, n_records=len(entries),
+        )

@@ -68,9 +68,10 @@ def plain_http_request(host: str, port: int, method: str, path: str,
     reference funnels these through its flb_http_client."""
     import socket as _socket
 
+    host_hdr = host if port in (80, None) else f"{host}:{port}"
     try:
         s = _socket.create_connection((host, port), timeout=timeout)
-        req = [f"{method} {path} HTTP/1.1", f"Host: {host}",
+        req = [f"{method} {path} HTTP/1.1", f"Host: {host_hdr}",
                "Connection: close", f"Content-Length: {len(body)}"]
         for k, v in (headers or {}).items():
             req.append(f"{k}: {v}")
@@ -124,6 +125,38 @@ def uri_encode(text: str, safe: str = "/") -> str:
 
 def uri_decode(text: str) -> str:
     return _url.unquote(text)
+
+
+async def async_plain_http_request(host: str, port: int, method: str,
+                                   path: str, headers=None,
+                                   body: bytes = b"",
+                                   timeout: float = 3.0):
+    """Async twin of plain_http_request — for interval collectors that
+    run ON the engine loop and must never block it."""
+    import asyncio
+
+    host_hdr = host if port in (80, None) else f"{host}:{port}"
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+        req = [f"{method} {path} HTTP/1.1", f"Host: {host_hdr}",
+               "Connection: close", f"Content-Length: {len(body)}"]
+        for k, v in (headers or {}).items():
+            req.append(f"{k}: {v}")
+        writer.write(("\r\n".join(req) + "\r\n\r\n").encode() + body)
+        await asyncio.wait_for(writer.drain(), timeout)
+        data = b""
+        while True:
+            chunk = await asyncio.wait_for(reader.read(65536), timeout)
+            if not chunk:
+                break
+            data += chunk
+        writer.close()
+        head, _, resp = data.partition(b"\r\n\r\n")
+        return int(head.split(b" ", 2)[1]), resp
+    except (OSError, ValueError, IndexError, asyncio.TimeoutError):
+        return None
 
 
 def uri_field(uri: str, index: int) -> Optional[str]:

@@ -1,0 +1,138 @@
+"""unix_socket input, prometheus text parser + scrape input,
+nginx_exporter_metrics, storage.pause_on_chunks_overlimit.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.codec.msgpack import Unpacker
+from fluentbit_tpu.plugins.inputs_net_extra import parse_prometheus_text
+
+
+def wait_for(cond, timeout=6.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.02)
+    raise TimeoutError
+
+
+def test_unix_socket_stream(tmp_path):
+    path = str(tmp_path / "flb.sock")
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("unix_socket", tag="t", path=path)
+    ins = ctx.engine.inputs[0]
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        wait_for(lambda: ins.plugin.ready)
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(b'{"via": "unix"}\n')
+        s.close()
+        wait_for(lambda: got)
+    finally:
+        ctx.stop()
+    assert decode_events(got[0])[0].body == {"via": "unix"}
+
+
+PROM_TEXT = """\
+# HELP http_requests_total Total requests.
+# TYPE http_requests_total counter
+http_requests_total{method="get",code="200"} 1027 1700000000000
+http_requests_total{method="post",code="200"} 3
+# TYPE temp_celsius gauge
+temp_celsius 36.6
+# TYPE rpc_seconds histogram
+rpc_seconds_bucket{le="0.1"} 2
+rpc_seconds_bucket{le="+Inf"} 5
+rpc_seconds_sum 1.5
+rpc_seconds_count 5
+# a comment
+malformed line without value
+"""
+
+
+def test_parse_prometheus_text():
+    entries = {e["name"]: e for e in parse_prometheus_text(PROM_TEXT)}
+    reqs = entries["http_requests_total"]
+    assert reqs["type"] == "counter"
+    assert reqs["desc"] == "Total requests."
+    assert reqs["labels"] == ["method", "code"]
+    vals = {tuple(s["labels"]): s["value"] for s in reqs["values"]}
+    assert vals == {("get", "200"): 1027.0, ("post", "200"): 3.0}
+    assert entries["temp_celsius"]["values"][0]["value"] == 36.6
+    # histogram series inherit the family type
+    assert entries["rpc_seconds_bucket"]["type"] == "histogram"
+    assert entries["rpc_seconds_count"]["values"][0]["value"] == 5.0
+
+
+def test_prometheus_scrape_pipeline():
+    # stub /metrics endpoint
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def serve():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                c.settimeout(2)
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += c.recv(65536)
+                body = PROM_TEXT.encode()
+                c.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                          + str(len(body)).encode() + b"\r\n\r\n" + body)
+            except OSError:
+                pass
+            c.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("prometheus_scrape", tag="prom", host="127.0.0.1",
+              port=str(srv.getsockname()[1]), scrape_interval="0.2")
+    payloads = []
+    ctx.output("lib", match="prom", callback=lambda d, t: payloads.append(d))
+    ctx.start()
+    try:
+        wait_for(lambda: payloads)
+    finally:
+        ctx.stop()
+        srv.close()
+    obj = next(iter(Unpacker(payloads[0])))
+    names = {m["name"] for m in obj["metrics"]}
+    assert "http_requests_total" in names and "temp_celsius" in names
+
+
+def test_pause_on_chunks_overlimit():
+    ctx = flb.create(flush="10", grace="1")  # slow flush: chunks pile up
+    ctx.service_set(**{"storage.max_chunks_up": "2"})
+    in_ffd = ctx.input("lib", tag="t",
+                       **{"storage.pause_on_chunks_overlimit": "on"})
+    ctx.output("null", match="t")
+    ctx.start()
+    try:
+        accepted = 0
+        for i in range(10):
+            # big appends: each locks a fresh chunk (2MB target)
+            big = json.dumps({"pad": "x" * (2 * 1024 * 1024)})
+            if ctx.push(in_ffd, big) > 0:
+                accepted += 1
+        ins = ctx.engine.inputs[0]
+        assert ins.paused
+        assert accepted <= 3  # limit 2 chunks (+1 in-flight append)
+    finally:
+        ctx.stop()
