@@ -88,6 +88,13 @@ def _load():
             ctypes.c_longlong, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_longlong),
         ]
+        lib.fbtpu_compact.restype = ctypes.c_longlong
+        lib.fbtpu_compact.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_uint8),
+        ]
         _lib = lib
         return _lib
 
@@ -118,6 +125,29 @@ def scan_offsets(buf: bytes) -> Optional[np.ndarray]:
     if n < 0:
         return None
     return offsets[: n + 1]
+
+
+def compact(buf: bytes, offsets: np.ndarray,
+            keep: np.ndarray) -> Optional[bytes]:
+    """Order-preserving copy of the records with keep[i] True straight
+    from the source buffer (the raw grep path's survivor re-emit)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(keep)
+    out = np.empty(len(buf), dtype=np.uint8)
+    keep_u8 = np.ascontiguousarray(keep, dtype=np.uint8)
+    offs = np.ascontiguousarray(offsets, dtype=np.int64)
+    w = lib.fbtpu_compact(
+        buf, len(buf),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        keep_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if w < 0:
+        return None
+    return out[:w].tobytes()
 
 
 def stage_field(

@@ -322,6 +322,9 @@ class GrepFilter(FilterPlugin):
             return (n, data)
         if n_keep == 0:
             return (0, b"")
+        compacted = native.compact(data, offsets[: n + 1], keep)
+        if compacted is not None:
+            return (n_keep, compacted)
         parts = [
             data[offsets[i]: offsets[i + 1]]
             for i in np.nonzero(keep)[0]

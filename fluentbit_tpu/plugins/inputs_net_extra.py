@@ -67,19 +67,14 @@ class UnixSocketInput(_LineServerInput):
         if mode == "dgram":
             import socket as _socket
 
-            plugin = self
-
-            class Proto(asyncio.DatagramProtocol):
-                def datagram_received(self, data, addr):
-                    plugin._emit_payload(engine, data)
-
             sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
             sock.bind(self.path)
             sock.setblocking(False)
             self._apply_perm()
             loop = asyncio.get_running_loop()
-            transport, _ = await loop.create_datagram_endpoint(Proto,
-                                                               sock=sock)
+            transport, _ = await loop.create_datagram_endpoint(
+                self._datagram_protocol(engine), sock=sock
+            )
             self.ready = True
             try:
                 await asyncio.Event().wait()
@@ -88,21 +83,7 @@ class UnixSocketInput(_LineServerInput):
             return
 
         async def handle(reader, writer):
-            pending = b""
-            try:
-                while True:
-                    data = await reader.read(int(self.chunk_size or 32768))
-                    if not data:
-                        break
-                    pending += data
-                    sep = (self.separator or "\n").encode()
-                    if sep in pending:
-                        head, _, pending = pending.rpartition(sep)
-                        self._emit_payload(engine, head)
-            finally:
-                if pending.strip():
-                    self._emit_payload(engine, pending)
-                writer.close()
+            await self._handle_stream(reader, writer, engine)
 
         server = await asyncio.start_unix_server(handle, path=self.path)
         self._apply_perm()
@@ -122,6 +103,13 @@ _SAMPLE_RE = re.compile(
 _LABEL_RE = re.compile(
     r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
 )
+
+
+def _unescape_label(v: str) -> str:
+    r"""Exposition-format label escapes: \\, \" and \n (a real
+    newline) — never strip the backslash generically."""
+    return re.sub(r"\\(.)",
+                  lambda m: {"n": "\n"}.get(m.group(1), m.group(1)), v)
 
 
 def parse_prometheus_text(text: str) -> List[dict]:
@@ -157,7 +145,7 @@ def parse_prometheus_text(text: str) -> List[dict]:
             continue
         labels = []
         if m.group("labels"):
-            labels = [(k, re.sub(r"\\(.)", r"\1", v))
+            labels = [(k, _unescape_label(v))
                       for k, v in _LABEL_RE.findall(m.group("labels"))]
         # histogram/summary series fold back into their base family name
         base = name
@@ -190,6 +178,11 @@ class _AsyncScrapeInput(InputPlugin):
     timer, and server). collect() dispatches an async task; a strong
     reference keeps it from being GC'd mid-flight."""
 
+    #: overall per-scrape deadline (the per-read timeout inside the
+    #: fetch resets each chunk; a drip-feeding endpoint must not keep a
+    #: scrape alive forever)
+    SCRAPE_DEADLINE = 15.0
+
     def collect(self, engine) -> None:
         import asyncio
 
@@ -199,12 +192,19 @@ class _AsyncScrapeInput(InputPlugin):
             # unit tests drive collect() synchronously
             asyncio.run(self._scrape(engine))
             return
-        tasks = getattr(self, "_scrape_tasks", None)
-        if tasks is None:
-            tasks = self._scrape_tasks = set()
-        t = asyncio.ensure_future(self._scrape(engine))
-        tasks.add(t)
-        t.add_done_callback(tasks.discard)
+        inflight = getattr(self, "_inflight", None)
+        if inflight is not None and not inflight.done():
+            return  # previous scrape still running: skip this tick
+
+        async def bounded():
+            try:
+                await asyncio.wait_for(self._scrape(engine),
+                                       self.SCRAPE_DEADLINE)
+            except asyncio.TimeoutError:
+                log.warning("%s: scrape exceeded %.0fs deadline",
+                            self.name, self.SCRAPE_DEADLINE)
+
+        self._inflight = asyncio.ensure_future(bounded())
 
     async def _scrape(self, engine) -> None:  # pragma: no cover
         raise NotImplementedError

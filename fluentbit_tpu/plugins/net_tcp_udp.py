@@ -37,9 +37,42 @@ def _json_body_records(line: str, key: str):
 
 
 class _LineServerInput(InputPlugin):
-    """Shared line-framing logic for in_tcp / in_udp payloads."""
+    """Shared line-framing logic for in_tcp / in_udp / in_unix_socket:
+    payload split + the stream/datagram handlers themselves (one copy
+    of the framing loop for every transport)."""
 
     server_task_needed = True
+
+    async def _handle_stream(self, reader, writer, engine) -> None:
+        """Connection loop: buffer, emit complete lines at each
+        separator, flush the trailing partial on close."""
+        pending = b""
+        read_size = int(getattr(self, "chunk_size", None) or 32768)
+        try:
+            while True:
+                data = await reader.read(read_size)
+                if not data:
+                    break
+                pending += data
+                sep = (self.separator or "\n").encode()
+                if sep in pending:
+                    head, _, pending = pending.rpartition(sep)
+                    self._emit_payload(engine, head)
+        finally:
+            if pending.strip():
+                self._emit_payload(engine, pending)
+            writer.close()
+
+    def _datagram_protocol(self, engine):
+        import asyncio
+
+        plugin = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                plugin._emit_payload(engine, data)
+
+        return Proto
 
     def _emit_payload(self, engine, data: bytes) -> None:
         fmt = (self.format or "json").lower()
@@ -82,24 +115,10 @@ class TcpInput(_LineServerInput):
         self.bound_port: Optional[int] = None
 
     async def start_server(self, engine) -> None:
-        async def handle(reader, writer):
-            pending = b""
-            try:
-                while True:
-                    data = await reader.read(int(self.chunk_size or 32768))
-                    if not data:
-                        break
-                    pending += data
-                    sep = (self.separator or "\n").encode()
-                    if sep in pending:
-                        head, _, pending = pending.rpartition(sep)
-                        self._emit_payload(engine, head)
-            finally:
-                if pending.strip():
-                    self._emit_payload(engine, pending)
-                writer.close()
-
         from ..core.tls import server_context
+
+        async def handle(reader, writer):
+            await self._handle_stream(reader, writer, engine)
 
         self._server = await asyncio.start_server(
             handle, self.listen, self.port,
@@ -126,15 +145,10 @@ class UdpInput(_LineServerInput):
         self.bound_port: Optional[int] = None
 
     async def start_server(self, engine) -> None:
-        plugin = self
-
-        class Proto(asyncio.DatagramProtocol):
-            def datagram_received(self, data, addr):
-                plugin._emit_payload(engine, data)
-
         loop = asyncio.get_running_loop()
         transport, _ = await loop.create_datagram_endpoint(
-            Proto, local_addr=(self.listen, self.port)
+            self._datagram_protocol(engine),
+            local_addr=(self.listen, self.port),
         )
         self.bound_port = transport.get_extra_info("sockname")[1]
         try:

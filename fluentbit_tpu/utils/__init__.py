@@ -136,6 +136,7 @@ async def async_plain_http_request(host: str, port: int, method: str,
     import asyncio
 
     host_hdr = host if port in (80, None) else f"{host}:{port}"
+    writer = None
     try:
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, port), timeout
@@ -152,11 +153,16 @@ async def async_plain_http_request(host: str, port: int, method: str,
             if not chunk:
                 break
             data += chunk
-        writer.close()
         head, _, resp = data.partition(b"\r\n\r\n")
         return int(head.split(b" ", 2)[1]), resp
     except (OSError, ValueError, IndexError, asyncio.TimeoutError):
         return None
+    finally:
+        if writer is not None:  # never leak the transport on timeout
+            try:
+                writer.close()
+            except Exception:
+                pass
 
 
 def uri_field(uri: str, index: int) -> Optional[str]:

@@ -301,4 +301,21 @@ long long fbtpu_stage_field(const uint8_t *buf, long long buflen,
     return rec;
 }
 
+// Copy the records whose keep[i] != 0 into out, preserving order.
+// offsets has n+1 entries (from fbtpu_stage_field / fbtpu_scan_offsets).
+// Returns bytes written; out must hold buflen bytes (worst case).
+long long fbtpu_compact(const uint8_t *buf, long long buflen,
+                        const long long *offsets, const uint8_t *keep,
+                        long long n, uint8_t *out) {
+    long long w = 0;
+    for (long long i = 0; i < n; i++) {
+        if (!keep[i]) continue;
+        long long a = offsets[i], b = offsets[i + 1];
+        if (a < 0 || b > buflen || b < a) return -1;
+        memcpy(out + w, buf + a, (size_t)(b - a));
+        w += b - a;
+    }
+    return w;
+}
+
 }  // extern "C"
