@@ -1,0 +1,144 @@
+"""Webhook-style outputs: slack, logdna, td.
+
+Reference: plugins/out_slack (incoming-webhook POST of record text),
+plugins/out_logdna (LogDNA ingest API), plugins/out_td (Treasure Data
+import API). All ride the shared HTTP delivery base.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from ..codec.events import decode_events
+from ..core.config import ConfigMapEntry
+from ..core.plugin import registry
+from ..utils import base64_encode, compress
+from .outputs_http_based import _HttpDeliveryOutput, _dumps
+
+
+@registry.register
+class SlackOutput(_HttpDeliveryOutput):
+    """plugins/out_slack: records rendered into a webhook text block."""
+
+    name = "slack"
+    config_map = [
+        ConfigMapEntry("webhook", "str",
+                       desc="full webhook URL or path (host/port split "
+                            "for plain-http test endpoints)"),
+        ConfigMapEntry("host", "str", default="hooks.slack.com"),
+        ConfigMapEntry("port", "int", default=443),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.webhook:
+            raise ValueError("slack: webhook is required")
+        if self.webhook.startswith(("http://", "https://")):
+            from urllib.parse import urlsplit
+
+            u = urlsplit(self.webhook)  # handles IPv6 + schemes
+            self.host = u.hostname or self.host
+            self.port = u.port or (80 if u.scheme == "http" else 443)
+            self._path = u.path or "/"
+        else:
+            self._path = self.webhook if self.webhook.startswith("/") \
+                else "/" + self.webhook
+
+    def _uri(self) -> str:
+        return self._path
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        lines = [
+            f"[{ev.ts_float:.6f}] {tag}: {_dumps(ev.body)}"
+            for ev in decode_events(data)
+        ]
+        return _dumps({"text": "```" + "\n".join(lines) + "```"}).encode()
+
+
+@registry.register
+class LogdnaOutput(_HttpDeliveryOutput):
+    """plugins/out_logdna: ingest API (lines array + basic-auth key)."""
+
+    name = "logdna"
+    config_map = [
+        ConfigMapEntry("api_key", "str"),
+        ConfigMapEntry("logdna_host", "str", default="logs.logdna.com"),
+        ConfigMapEntry("logdna_port", "int", default=443),
+        ConfigMapEntry("hostname", "str", default="fluentbit-tpu"),
+        ConfigMapEntry("app", "str"),
+        ConfigMapEntry("host", "str"),
+        ConfigMapEntry("port", "int", default=0),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.api_key:
+            raise ValueError("logdna: api_key is required")
+        # host/port fall back to the logdna_* pair (test endpoints
+        # override host/port directly)
+        if not self.host:
+            self.host = self.logdna_host
+        if not self.port:
+            self.port = self.logdna_port
+
+    def _uri(self) -> str:
+        from ..utils import uri_encode
+
+        host = uri_encode(self.hostname or "", safe="")
+        return f"/logs/ingest?hostname={host}&now={int(time.time())}"
+
+    def _headers(self) -> List[str]:
+        cred = base64_encode(f"{self.api_key}:".encode()).decode()
+        return [f"Authorization: Basic {cred}"]
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        lines = []
+        for ev in decode_events(data):
+            body = ev.body if isinstance(ev.body, dict) else {}
+            line = body.get("log") or body.get("message") or _dumps(body)
+            entry = {
+                "timestamp": int(ev.ts_float * 1000),
+                "line": str(line),
+                "app": self.app or tag,
+                "meta": body,
+            }
+            lines.append(entry)
+        return _dumps({"lines": lines}).encode()
+
+
+@registry.register
+class TdOutput(_HttpDeliveryOutput):
+    """plugins/out_td: Treasure Data import — msgpack.gz payloads with
+    the TD1 apikey header."""
+
+    name = "td"
+    config_map = [
+        ConfigMapEntry("api", "str", desc="TD API key"),
+        ConfigMapEntry("database", "str"),
+        ConfigMapEntry("table", "str"),
+        ConfigMapEntry("host", "str", default="api.treasuredata.com"),
+        ConfigMapEntry("port", "int", default=443),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not (self.api and self.database and self.table):
+            raise ValueError("td: api + database + table are required")
+
+    def _uri(self) -> str:
+        return (f"/v3/table/import/{self.database}/{self.table}"
+                f"/msgpack.gz")
+
+    def _content_type(self) -> str:
+        return "application/gzip"
+
+    def _headers(self) -> List[str]:
+        return [f"Authorization: TD1 {self.api}"]
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        from ..codec.msgpack import packb
+
+        out = bytearray()
+        for ev in decode_events(data):
+            body = dict(ev.body) if isinstance(ev.body, dict) else {}
+            body["time"] = int(ev.ts_float)
+            out += packb(body)
+        return compress("gzip", bytes(out))

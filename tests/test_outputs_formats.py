@@ -196,3 +196,60 @@ def test_flush_concurrency_flags(props, expect_max):
     finally:
         ctx.stop()
     assert tracker.max_active <= expect_max
+
+
+def test_slack_format_and_webhook_parse():
+    p = make_output("slack", webhook="http://127.0.0.1:9/services/T/B/x")
+    assert p.host == "127.0.0.1" and p.port == 9
+    assert p._uri() == "/services/T/B/x"
+    payload = json.loads(p.format(chunk_of([{"alert": "disk"}]), "ops"))
+    assert payload["text"].startswith("```")
+    assert '"alert":"disk"' in payload["text"].replace(" ", "")
+
+
+def test_logdna_format():
+    p = make_output("logdna", api_key="k", app="svc")
+    body = json.loads(p.format(chunk_of([{"log": "hello", "x": 1}]), "t"))
+    line = body["lines"][0]
+    assert line["line"] == "hello"
+    assert line["app"] == "svc"
+    assert line["timestamp"] == 1700000000500
+    assert line["meta"]["x"] == 1
+    assert p._headers()[0].startswith("Authorization: Basic ")
+
+
+def test_td_format_roundtrip():
+    import gzip as _gz
+
+    from fluentbit_tpu.codec.msgpack import Unpacker
+
+    p = make_output("td", api="key", database="db", table="tbl")
+    assert p._uri() == "/v3/table/import/db/tbl/msgpack.gz"
+    payload = p.format(chunk_of([{"a": 1}]), "t")
+    rows = list(Unpacker(_gz.decompress(payload)))
+    assert rows[0]["a"] == 1 and rows[0]["time"] == 1700000000
+
+
+def test_native_scanner_fuzz_robustness():
+    """Random byte soup must never crash or hang the native scanner;
+    valid buffers must count identically to the Python codec."""
+    import random
+
+    from fluentbit_tpu import native
+    from fluentbit_tpu.codec.events import count_records, encode_event
+
+    if not native.available():
+        pytest.skip("native unavailable")
+    rng = random.Random(99)
+    for _ in range(300):
+        junk = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+        native.count_records(junk)        # may be None; must not crash
+        native.scan_offsets(junk)
+        native.stage_field(junk, b"log", 32)
+    for _ in range(50):
+        buf = b"".join(
+            encode_event({"log": "x" * rng.randrange(20),
+                          "n": rng.randrange(1000)}, float(i))
+            for i in range(rng.randrange(1, 30))
+        )
+        assert native.count_records(buf) == count_records(buf)
