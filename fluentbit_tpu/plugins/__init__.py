@@ -31,4 +31,5 @@ from . import misc_plugins  # noqa: F401
 from . import in_servers_extra  # noqa: F401
 from . import enrichment_extra  # noqa: F401
 from . import inputs_net_extra  # noqa: F401
+from . import inputs_exporters  # noqa: F401
 from . import gated  # noqa: F401

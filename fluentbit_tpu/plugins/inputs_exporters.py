@@ -1,0 +1,289 @@
+"""node_exporter_metrics + collectd inputs.
+
+Reference: plugins/in_node_exporter_metrics (10160 LoC of /proc &
+/sys scrapers emitting prometheus-convention node_* metrics) — this
+build covers the core collector set (cpu, meminfo, loadavg,
+filesystem, netdev, uname/boot_time); plugins/in_collectd (the
+collectd binary "parts" protocol over UDP: typed parts HOST/TIME/
+PLUGIN/TYPE/VALUES per the public protocol spec).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import struct
+import time
+from typing import Dict, List, Optional
+
+from ..codec.chunk import EVENT_TYPE_METRICS
+from ..codec.events import encode_event, now_event_time
+from ..codec.msgpack import packb
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+log = logging.getLogger("flb.exporters")
+
+
+def _gauge(name, desc, samples, label_keys=()):
+    return {"name": name, "type": "gauge", "desc": desc,
+            "labels": list(label_keys),
+            "values": [{"labels": list(l), "value": float(v)}
+                       for l, v in samples]}
+
+
+def _counter(name, desc, samples, label_keys=()):
+    e = _gauge(name, desc, samples, label_keys)
+    e["type"] = "counter"
+    return e
+
+
+@registry.register
+class NodeExporterMetricsInput(InputPlugin):
+    name = "node_exporter_metrics"
+    description = "host metrics in node_exporter conventions"
+    config_map = [
+        ConfigMapEntry("scrape_interval", "time", default="5"),
+        ConfigMapEntry("path.procfs", "str", default="/proc"),
+        ConfigMapEntry("path.sysfs", "str", default="/sys"),
+        ConfigMapEntry("collectors", "clist",
+                       default="cpu,meminfo,loadavg,filesystem,netdev,uname"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.scrape_interval or 5)
+        self._enabled = {c.strip().lower() for c in (self.collectors or [])}
+
+    # -- collectors --
+
+    def _cpu(self) -> List[dict]:
+        modes = ("user", "nice", "system", "idle", "iowait", "irq",
+                 "softirq", "steal")
+        samples = []
+        with open(os.path.join(self.path_procfs, "stat")) as f:
+            for line in f:
+                if not line.startswith("cpu") or line.startswith("cpu "):
+                    continue
+                parts = line.split()
+                cpu = parts[0][3:]
+                for mode, v in zip(modes, parts[1:9]):
+                    samples.append(((cpu, mode), int(v) / 100.0))
+        return [_counter("node_cpu_seconds_total",
+                         "Seconds the CPUs spent in each mode.",
+                         samples, ("cpu", "mode"))]
+
+    def _meminfo(self) -> List[dict]:
+        out = []
+        with open(os.path.join(self.path_procfs, "meminfo")) as f:
+            for line in f:
+                key, _, rest = line.partition(":")
+                fields = rest.split()
+                if not fields:
+                    continue
+                base = "node_memory_" + key.replace("(", "_").replace(")", "")
+                if "kB" in rest:  # unit-less counts (HugePages_*) keep
+                    v = int(fields[0]) * 1024  # node_exporter's bare name
+                    name = base + "_bytes"
+                else:
+                    v = int(fields[0])
+                    name = base
+                out.append(_gauge(name, f"Memory information field {key}.",
+                                  [((), v)]))
+        return out
+
+    def _loadavg(self) -> List[dict]:
+        with open(os.path.join(self.path_procfs, "loadavg")) as f:
+            l1, l5, l15 = f.read().split()[:3]
+        return [_gauge("node_load1", "1m load average.", [((), float(l1))]),
+                _gauge("node_load5", "5m load average.", [((), float(l5))]),
+                _gauge("node_load15", "15m load average.",
+                       [((), float(l15))])]
+
+    def _filesystem(self) -> List[dict]:
+        size, avail = [], []
+        seen = set()
+        with open(os.path.join(self.path_procfs, "mounts")) as f:
+            for line in f:
+                dev, mnt, fstype = line.split()[:3]
+                if not dev.startswith("/") or mnt in seen:
+                    continue
+                seen.add(mnt)
+                try:
+                    st = os.statvfs(mnt)
+                except OSError:
+                    continue
+                labels = (dev, mnt, fstype)
+                size.append((labels, st.f_blocks * st.f_frsize))
+                avail.append((labels, st.f_bavail * st.f_frsize))
+        keys = ("device", "mountpoint", "fstype")
+        return [_gauge("node_filesystem_size_bytes",
+                       "Filesystem size in bytes.", size, keys),
+                _gauge("node_filesystem_avail_bytes",
+                       "Filesystem space available to unprivileged users.",
+                       avail, keys)]
+
+    def _netdev(self) -> List[dict]:
+        rx, tx = [], []
+        with open(os.path.join(self.path_procfs, "net/dev")) as f:
+            for line in f.readlines()[2:]:
+                name, _, rest = line.partition(":")
+                parts = rest.split()
+                rx.append(((name.strip(),), int(parts[0])))
+                tx.append(((name.strip(),), int(parts[8])))
+        return [_counter("node_network_receive_bytes_total",
+                         "Network device statistic receive_bytes.",
+                         rx, ("device",)),
+                _counter("node_network_transmit_bytes_total",
+                         "Network device statistic transmit_bytes.",
+                         tx, ("device",))]
+
+    def _uname(self) -> List[dict]:
+        u = os.uname()
+        labels = (u.sysname, u.release, u.version, u.machine, u.nodename)
+        keys = ("sysname", "release", "version", "machine", "nodename")
+        out = [_gauge("node_uname_info", "Labeled system information.",
+                      [(labels, 1.0)], keys)]
+        try:
+            with open(os.path.join(self.path_procfs, "stat")) as f:
+                for line in f:
+                    if line.startswith("btime "):
+                        out.append(_gauge("node_boot_time_seconds",
+                                          "Node boot time.",
+                                          [((), int(line.split()[1]))]))
+        except OSError:
+            pass
+        return out
+
+    def collect(self, engine) -> None:
+        entries: List[dict] = []
+        for name, fn in (("cpu", self._cpu), ("meminfo", self._meminfo),
+                         ("loadavg", self._loadavg),
+                         ("filesystem", self._filesystem),
+                         ("netdev", self._netdev), ("uname", self._uname)):
+            if name not in self._enabled:
+                continue
+            try:
+                entries.extend(fn())
+            except OSError as e:
+                log.debug("node_exporter: collector %s failed: %s", name, e)
+        if not entries:
+            return
+        payload = {"meta": {"ts": time.time()}, "metrics": entries}
+        engine.input_event_append(
+            self.instance, self.instance.tag, packb(payload),
+            EVENT_TYPE_METRICS, n_records=len(entries),
+        )
+
+
+# ----------------------------------------------------------------- collectd
+
+# part type ids (public collectd binary protocol)
+_HOST, _TIME, _PLUGIN, _PLUGIN_INSTANCE, _TYPE, _TYPE_INSTANCE = (
+    0x0000, 0x0001, 0x0002, 0x0003, 0x0004, 0x0005)
+_VALUES, _INTERVAL, _TIME_HR, _INTERVAL_HR = 0x0006, 0x0007, 0x0008, 0x0009
+_DS_COUNTER, _DS_GAUGE, _DS_DERIVE, _DS_ABSOLUTE = 0, 1, 2, 3
+
+
+def parse_collectd_packet(data: bytes) -> List[dict]:
+    """Binary parts → records (one per VALUES part, carrying the
+    stateful host/plugin/type context accumulated so far)."""
+    out: List[dict] = []
+    ctx: Dict[str, object] = {}
+    pos = 0
+    n = len(data)
+    while pos + 4 <= n:
+        ptype, plen = struct.unpack_from(">HH", data, pos)
+        if plen < 4 or pos + plen > n:
+            break
+        body = data[pos + 4 : pos + plen]
+        pos += plen
+        if ptype in (_HOST, _PLUGIN, _PLUGIN_INSTANCE, _TYPE,
+                     _TYPE_INSTANCE):
+            key = {_HOST: "host", _PLUGIN: "plugin",
+                   _PLUGIN_INSTANCE: "plugin_instance", _TYPE: "type",
+                   _TYPE_INSTANCE: "type_instance"}[ptype]
+            ctx[key] = body.rstrip(b"\x00").decode("utf-8", "replace")
+        elif ptype in (_TIME, _TIME_HR, _INTERVAL, _INTERVAL_HR):
+            if len(body) != 8:  # malformed part from an untrusted peer:
+                continue        # skip it, keep the rest of the packet
+            v = struct.unpack(">Q", body)[0]
+            if ptype == _TIME:
+                ctx["time"] = float(v)
+            elif ptype == _TIME_HR:
+                ctx["time"] = v / (2 ** 30)
+            elif ptype == _INTERVAL:
+                ctx["interval"] = float(v)
+            else:
+                ctx["interval"] = v / (2 ** 30)
+        elif ptype == _VALUES:
+            if len(body) < 2:
+                continue
+            count = struct.unpack_from(">H", body, 0)[0]
+            if len(body) < 2 + count * 9:
+                continue
+            kinds = body[2 : 2 + count]
+            values = []
+            vpos = 2 + count
+            for k in kinds:
+                raw = body[vpos : vpos + 8]
+                vpos += 8
+                if k == _DS_GAUGE:
+                    values.append(struct.unpack("<d", raw)[0])  # LE!
+                elif k == _DS_DERIVE:
+                    values.append(struct.unpack(">q", raw)[0])
+                else:  # counter/absolute: u64 BE
+                    values.append(struct.unpack(">Q", raw)[0])
+            rec = dict(ctx)
+            rec.pop("interval", None)
+            rec["values"] = values
+            out.append(rec)
+    return out
+
+
+@registry.register
+class CollectdInput(InputPlugin):
+    name = "collectd"
+    description = "collectd binary protocol over UDP"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=25826),
+        ConfigMapEntry("typesdb", "str",
+                       desc="accepted for parity; value names default "
+                            "to positional 'values'"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.bound_port: Optional[int] = None
+
+    def _emit(self, engine, data: bytes) -> None:
+        records = parse_collectd_packet(data)
+        if not records:
+            return
+        out = bytearray()
+        for rec in records:
+            ts = rec.pop("time", None)
+            out += encode_event(rec, ts if ts else now_event_time())
+        engine.input_log_append(self.instance, self.instance.tag,
+                                bytes(out), len(records))
+
+    async def start_server(self, engine) -> None:
+        plugin = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                try:
+                    plugin._emit(engine, data)
+                except Exception:
+                    log.exception("collectd: packet parse failed")
+
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(self.listen, self.port)
+        )
+        self.bound_port = transport.get_extra_info("sockname")[1]
+        try:
+            await asyncio.Event().wait()
+        finally:
+            transport.close()

@@ -40,6 +40,10 @@ class SlackOutput(_HttpDeliveryOutput):
             self.host = u.hostname or self.host
             self.port = u.port or (80 if u.scheme == "http" else 443)
             self._path = u.path or "/"
+            if u.scheme == "https" and "tls" not in instance.properties:
+                # https implies TLS: never post the secret webhook path
+                # in cleartext (core.tls reads the instance property)
+                instance.set("tls", "on")
         else:
             self._path = self.webhook if self.webhook.startswith("/") \
                 else "/" + self.webhook

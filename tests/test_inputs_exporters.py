@@ -1,0 +1,98 @@
+"""node_exporter_metrics collectors + collectd binary protocol."""
+
+import socket
+import struct
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.codec.msgpack import Unpacker
+from fluentbit_tpu.plugins.inputs_exporters import parse_collectd_packet
+
+
+def test_node_exporter_collectors():
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("node_exporter_metrics", tag="node", scrape_interval="0.2")
+    payloads = []
+    ctx.output("lib", match="node", callback=lambda d, t: payloads.append(d))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not payloads:
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    assert payloads
+    obj = next(iter(Unpacker(payloads[0])))
+    by_name = {m["name"]: m for m in obj["metrics"]}
+    cpu = by_name["node_cpu_seconds_total"]
+    assert cpu["type"] == "counter"
+    assert cpu["labels"] == ["cpu", "mode"]
+    modes = {s["labels"][1] for s in cpu["values"]}
+    assert {"user", "system", "idle"} <= modes
+    assert by_name["node_memory_MemTotal_bytes"]["values"][0]["value"] > 0
+    assert "node_load1" in by_name
+    assert by_name["node_uname_info"]["values"][0]["value"] == 1.0
+    fs = by_name["node_filesystem_size_bytes"]
+    assert fs["labels"] == ["device", "mountpoint", "fstype"]
+
+
+def collectd_packet():
+    def part_str(ptype, s):
+        b = s.encode() + b"\x00"
+        return struct.pack(">HH", ptype, 4 + len(b)) + b
+
+    def part_u64(ptype, v):
+        return struct.pack(">HHQ", ptype, 12, v)
+
+    values = struct.pack(">HH", 0x0006, 4 + 2 + 2 * 9)  # 2 values
+    values += struct.pack(">H", 2)
+    values += bytes([1, 0])                  # gauge, counter
+    values += struct.pack("<d", 36.5)        # gauge is little-endian
+    values += struct.pack(">Q", 12345)       # counter is u64 BE
+    return (part_str(0x0000, "web01")
+            + part_u64(0x0008, int(1700000000 * (2 ** 30)))  # time_hr
+            + part_str(0x0002, "cpu")
+            + part_str(0x0003, "0")
+            + part_str(0x0004, "cpu")
+            + part_str(0x0005, "user")
+            + values)
+
+
+def test_parse_collectd_packet():
+    records = parse_collectd_packet(collectd_packet())
+    assert len(records) == 1
+    r = records[0]
+    assert r["host"] == "web01"
+    assert r["plugin"] == "cpu" and r["plugin_instance"] == "0"
+    assert r["type"] == "cpu" and r["type_instance"] == "user"
+    assert r["values"] == [36.5, 12345]
+    assert abs(r["time"] - 1700000000) < 1
+
+
+def test_collectd_udp_pipeline():
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("collectd", tag="cd", port="0")
+    ins = ctx.engine.inputs[0]
+    got = []
+    ctx.output("lib", match="cd", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not getattr(ins.plugin,
+                                                     "bound_port", None):
+            time.sleep(0.02)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(collectd_packet(), ("127.0.0.1", ins.plugin.bound_port))
+        s.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    ev = decode_events(got[0])[0]
+    assert ev.body["host"] == "web01"
+    assert ev.body["values"] == [36.5, 12345]
+    assert abs(ev.ts_float - 1700000000) < 1

@@ -228,28 +228,3 @@ def test_td_format_roundtrip():
     payload = p.format(chunk_of([{"a": 1}]), "t")
     rows = list(Unpacker(_gz.decompress(payload)))
     assert rows[0]["a"] == 1 and rows[0]["time"] == 1700000000
-
-
-def test_native_scanner_fuzz_robustness():
-    """Random byte soup must never crash or hang the native scanner;
-    valid buffers must count identically to the Python codec."""
-    import random
-
-    from fluentbit_tpu import native
-    from fluentbit_tpu.codec.events import count_records, encode_event
-
-    if not native.available():
-        pytest.skip("native unavailable")
-    rng = random.Random(99)
-    for _ in range(300):
-        junk = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
-        native.count_records(junk)        # may be None; must not crash
-        native.scan_offsets(junk)
-        native.stage_field(junk, b"log", 32)
-    for _ in range(50):
-        buf = b"".join(
-            encode_event({"log": "x" * rng.randrange(20),
-                          "n": rng.randrange(1000)}, float(i))
-            for i in range(rng.randrange(1, 30))
-        )
-        assert native.count_records(buf) == count_records(buf)
