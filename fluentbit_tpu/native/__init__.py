@@ -17,6 +17,7 @@ API:
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -39,8 +40,19 @@ _lib = None
 _tried = False
 
 
-def _build() -> bool:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
+def _src_hash() -> Optional[str]:
+    try:
+        with open(_SRC, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _build(src_hash: Optional[str]) -> bool:
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+    except OSError:
+        return False
     cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
            _SRC, "-o", _SO]
     try:
@@ -52,6 +64,12 @@ def _build() -> bool:
     if proc.returncode != 0:
         log.warning("native build failed: %s", proc.stderr[-2000:])
         return False
+    if src_hash:
+        try:
+            with open(_SO + ".hash", "w") as f:
+                f.write(src_hash)
+        except OSError:
+            pass  # staleness check degrades; the .so itself is fine
     return True
 
 
@@ -63,10 +81,27 @@ def _load():
         _tried = True
         if os.environ.get("FBTPU_NO_NATIVE"):
             return None
-        src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
-            if not os.path.exists(_SRC) or not _build():
+        # rebuild on source-hash mismatch (mtime is unreliable: git
+        # stamps source and artifacts with the same checkout time)
+        have_so = os.path.exists(_SO)
+        if not os.path.exists(_SRC):
+            if not have_so:
                 return None
+        else:
+            built_hash = None
+            try:
+                with open(_SO + ".hash") as f:
+                    built_hash = f.read().strip()
+            except OSError:
+                pass
+            src_hash = _src_hash()
+            if not have_so or (src_hash is not None
+                               and built_hash != src_hash):
+                # a failed rebuild falls back to an existing (possibly
+                # prebuilt, hash-less) .so rather than losing the
+                # native path on toolchain-less hosts
+                if not _build(src_hash) and not have_so:
+                    return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError as e:

@@ -34,7 +34,8 @@ log = logging.getLogger("flb.tail")
 
 
 class _TailFile:
-    __slots__ = ("path", "fd", "inode", "offset", "pending", "skipping")
+    __slots__ = ("path", "fd", "inode", "offset", "pending", "skipping",
+                 "skip_anchor")
 
     def __init__(self, path: str, inode: int, offset: int = 0):
         self.path = path
@@ -43,6 +44,7 @@ class _TailFile:
         self.offset = offset
         self.pending = b""
         self.skipping = False  # discarding an oversized line's remainder
+        self.skip_anchor = 0   # the discarded line's start offset
 
 
 @registry.register
@@ -146,9 +148,20 @@ class TailInput(InputPlugin):
 
     def _persist(self, tf: _TailFile) -> None:
         """Mark the offset dirty; the batch at the end of each collect
-        pass commits once (not one fsync per tailed file)."""
+        pass commits once (not one fsync per tailed file).
+
+        The persisted offset excludes the buffered partial-line fragment
+        (tf.pending) so a crash+resume re-reads the fragment whole
+        instead of emitting its tail as a corrupt record — the
+        reference's resumable-offset semantics (in_tail/tail_db.c,
+        flb_tail_file_db_offset subtracts the unconsumed buffer). While
+        an oversized line is being discarded the resumable point is that
+        line's start — a restart re-reads and re-skips it whole rather
+        than emitting its tail as a corrupt record."""
         if self._db is not None:
-            self._dirty[tf.path] = (tf.inode, tf.offset)
+            off = tf.skip_anchor if tf.skipping \
+                else tf.offset - len(tf.pending)
+            self._dirty[tf.path] = (tf.inode, off)
 
     def _checkpoint(self) -> None:
         if self._db is None or not self._dirty:
@@ -247,6 +260,7 @@ class TailInput(InputPlugin):
             if len(tf.pending) > self._max_line:
                 if self.skip_long_lines:
                     log.warning("tail: dropping long line in %s", tf.path)
+                    tf.skip_anchor = tf.offset - len(tf.pending)
                     tf.pending = b""
                     tf.skipping = True
                 # else: keep buffering (reference blocks the file; we

@@ -83,6 +83,11 @@ class LogdnaOutput(_HttpDeliveryOutput):
             self.host = self.logdna_host
         if not self.port:
             self.port = self.logdna_port
+        # TLS on by default: the reference hardcodes FLB_IO_TLS for
+        # out_logdna (never send the api_key in cleartext); explicit
+        # `tls off` remains available for local stub endpoints
+        if "tls" not in instance.properties:
+            instance.set("tls", "on")
 
     def _uri(self) -> str:
         from ..utils import uri_encode
@@ -126,6 +131,9 @@ class TdOutput(_HttpDeliveryOutput):
     def init(self, instance, engine) -> None:
         if not (self.api and self.database and self.table):
             raise ValueError("td: api + database + table are required")
+        # reference out_td hardcodes FLB_IO_TLS; same default here
+        if "tls" not in instance.properties:
+            instance.set("tls", "on")
 
     def _uri(self) -> str:
         return (f"/v3/table/import/{self.database}/{self.table}"
