@@ -95,6 +95,17 @@ def _load():
             except OSError:
                 pass
             src_hash = _src_hash()
+            if have_so and built_hash is None and src_hash is not None:
+                # prebuilt .so with no hash sidecar: assume it matches
+                # the current source and record that assumption, so a
+                # LATER source edit triggers exactly one rebuild instead
+                # of a failing g++ attempt on every process start
+                try:
+                    with open(_SO + ".hash", "w") as f:
+                        f.write(src_hash)
+                    built_hash = src_hash
+                except OSError:
+                    pass
             if not have_so or (src_hash is not None
                                and built_hash != src_hash):
                 # a failed rebuild falls back to an existing (possibly

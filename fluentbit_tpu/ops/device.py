@@ -1,0 +1,110 @@
+"""Device attach controller — jax backend init must never block the pipeline.
+
+On some platforms (the axon TPU tunnel in particular) the first backend
+touch — ``jax.devices()`` / the first ``jnp.asarray`` — can block in C
+for minutes, during which Python signal handlers cannot run. The
+reference never has this problem because its regex engine is host-side C
+(Onigmo); our device kernels do, so every plugin that compiles a device
+program routes its first backend touch through here:
+
+- ``attach_async()`` starts backend init once, in a daemon thread.
+- ``wait(timeout)`` joins it with a bounded, signal-interruptible wait.
+- ``ready()`` is a cheap non-blocking probe.
+
+Until ``ready()``, callers serve records on their (bit-exact) CPU
+fallback path; when attach completes, compiled device programs
+materialize lazily and the device path swaps in live. A failed attach
+(no jax, broken platform) pins the CPU path permanently.
+
+``FBTPU_ATTACH_WAIT_S`` tunes how long plugin init waits synchronously
+for the device before proceeding on CPU (default 2 s — tests force the
+CPU platform where attach is near-instant; the bench sets its own longer
+deadline).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("flb.device")
+
+_lock = threading.Lock()
+_state = "unattached"  # unattached | attaching | ready | failed
+_error: Optional[str] = None
+_thread: Optional[threading.Thread] = None
+_attach_seconds: Optional[float] = None
+
+
+def default_wait() -> float:
+    try:
+        return float(os.environ.get("FBTPU_ATTACH_WAIT_S", "2"))
+    except ValueError:
+        return 2.0
+
+
+def _attach_worker() -> None:
+    global _state, _error, _attach_seconds
+    t0 = time.time()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n = len(jax.devices())  # the (possibly minutes-long) backend init
+        # one trivial dispatch so the runtime is fully warm before the
+        # first real kernel
+        jnp.zeros((8,), dtype=jnp.int32).block_until_ready()
+        with _lock:
+            _attach_seconds = time.time() - t0
+            _state = "ready"
+        log.info("device backend attached: %d device(s) in %.1fs",
+                 n, _attach_seconds)
+    except Exception as e:  # pragma: no cover - platform-dependent
+        with _lock:
+            _error = repr(e)
+            _state = "failed"
+        log.warning("device attach failed (CPU path pinned): %r", e)
+
+
+def attach_async() -> None:
+    """Start backend init in the background (idempotent)."""
+    global _state, _thread
+    with _lock:
+        if _state != "unattached":
+            return
+        _state = "attaching"
+        _thread = threading.Thread(
+            target=_attach_worker, daemon=True, name="flb-device-attach"
+        )
+        # start under the lock: wait() must never observe a created-but-
+        # unstarted thread (is_alive False) and skip its join
+        _thread.start()
+
+
+def ready() -> bool:
+    return _state == "ready"
+
+
+def failed() -> bool:
+    return _state == "failed"
+
+
+def wait(timeout: Optional[float] = None) -> bool:
+    """Ensure attach is running and wait up to ``timeout`` seconds for
+    it (None = the FBTPU_ATTACH_WAIT_S default). Returns ready()."""
+    attach_async()
+    t = _thread
+    if t is not None and t.is_alive():
+        t.join(default_wait() if timeout is None else timeout)
+    return ready()
+
+
+def status() -> dict:
+    return {
+        "state": _state,
+        "error": _error,
+        "attach_seconds": _attach_seconds,
+    }

@@ -25,6 +25,7 @@ gathers vectorize across the batch dimension.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -79,31 +80,63 @@ class GrepProgram:
         self.max_len = max_len
         R = len(self.dfas)
 
-        # shared k so the combined-index math is uniform
+        # Table prep is pure numpy — cheap and safe at plugin init. The
+        # jnp transfers + jit happen in _materialize(), gated on the
+        # device-attach controller, so constructing a GrepProgram never
+        # blocks on (possibly minutes-long) backend init.
         self.k = min(choose_k(d.n_states, d.n_classes) for d in self.dfas)
         tables = [compose_table(d.trans, self.k) for d in self.dfas]
         max_flat = max(t.shape[0] * t.shape[1] for t in tables)
         flat = np.zeros((R, max_flat), dtype=np.int32)
         for r, t in enumerate(tables):
             flat[r, : t.size] = t.reshape(-1)
-        self.trans_flat = jnp.asarray(flat)
-        self.n_cols = jnp.asarray(
-            [t.shape[1] for t in tables], dtype=np.int32
-        )  # C^k per rule (unused in math; cols folded in flat index)
-        self.C = jnp.asarray([d.n_classes for d in self.dfas], dtype=np.int32)
-        self.Ck = jnp.asarray(
-            [d.n_classes ** self.k for d in self.dfas], dtype=np.int32
-        )
         cmaps = np.zeros((R, 257), dtype=np.int32)
         for r, d in enumerate(self.dfas):
             cmaps[r] = d.class_map.astype(np.int32)
-        self.class_maps = jnp.asarray(cmaps)
-        self.eol_cls = jnp.asarray(
-            [d.eol_class for d in self.dfas], dtype=np.int32
-        )
-        self.starts = jnp.asarray([d.start for d in self.dfas], dtype=np.int32)
-        self._jit = jax.jit(self._match_impl)
+        self._np = {
+            "trans_flat": flat,
+            "C": np.asarray([d.n_classes for d in self.dfas],
+                            dtype=np.int32),
+            "Ck": np.asarray([d.n_classes ** self.k for d in self.dfas],
+                             dtype=np.int32),
+            "class_maps": cmaps,
+            "eol_cls": np.asarray([d.eol_class for d in self.dfas],
+                                  dtype=np.int32),
+            "starts": np.asarray([d.start for d in self.dfas],
+                                 dtype=np.int32),
+        }
+        self._jit = None
+        self._mat_lock = threading.Lock()
         self._sharded_cache: dict = {}
+
+    def _materialize(self) -> None:
+        """Transfer tables to the attached backend + build the jit."""
+        with self._mat_lock:
+            if self._jit is not None:
+                return
+            t = self._np
+            self.trans_flat = jnp.asarray(t["trans_flat"])
+            self.C = jnp.asarray(t["C"])
+            self.Ck = jnp.asarray(t["Ck"])
+            self.class_maps = jnp.asarray(t["class_maps"])
+            self.eol_cls = jnp.asarray(t["eol_cls"])
+            self.starts = jnp.asarray(t["starts"])
+            self._jit = jax.jit(self._match_impl)
+            self._np = None  # tables now live on device; free host copy
+
+    def try_ready(self) -> bool:
+        """Non-blocking: True iff the device path is usable now. Kicks
+        background attach on first call; until ready, callers run their
+        bit-exact CPU fallback."""
+        if self._jit is not None:
+            return True
+        from . import device
+
+        if not device.ready():
+            device.attach_async()
+            return False
+        self._materialize()
+        return True
 
     # -- the kernel --
 
@@ -143,7 +176,16 @@ class GrepProgram:
         return (final == ACC) & (lengths >= 0)
 
     def match(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-        """Run the kernel; returns bool [R, B] (numpy)."""
+        """Run the kernel; returns bool [R, B] (numpy). Blocks up to the
+        attach-wait deadline if the backend isn't up yet."""
+        if self._jit is None:
+            from . import device
+
+            if not device.wait(60.0):
+                raise RuntimeError(
+                    f"device backend not attached: {device.status()}"
+                )
+            self._materialize()
         out = self._jit(jnp.asarray(batch), jnp.asarray(lengths))
         return np.asarray(out)
 
@@ -162,6 +204,15 @@ class GrepProgram:
         """
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
+
+        if self._jit is None:
+            from . import device
+
+            if not device.wait(60.0):
+                raise RuntimeError(
+                    f"device backend not attached: {device.status()}"
+                )
+            self._materialize()
 
         def step(batch, lengths):
             mask = self._match_impl(batch, lengths)

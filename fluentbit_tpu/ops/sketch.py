@@ -81,8 +81,27 @@ class HyperLogLog:
             raise RuntimeError("jax is unavailable")
         self.p = p
         self.m = 1 << p
-        self.registers = jnp.zeros((self.m,), dtype=jnp.int32)
+        # registers start host-side (numpy) and move to the device when
+        # the backend attaches — constructing a sketch must never block
+        # on backend init (see ops.device); add_cpu is bit-identical to
+        # the device kernel, so pre-attach updates stay exact
+        self.registers = np.zeros((self.m,), dtype=np.int32)
+        self._update = None
+
+    def _ensure_device(self, wait: bool = False) -> bool:
+        if self._update is not None:
+            return True
+        from . import device
+
+        ok = device.wait(max(60.0, device.default_wait())) if wait \
+            else device.ready()
+        if not ok:
+            if not wait:
+                device.attach_async()
+            return False
+        self.registers = jnp.asarray(self.registers)
         self._update = jax.jit(self._update_impl)
+        return True
 
     def _update_impl(self, registers, batch, lengths):
         h = _fnv1a_scan(batch, lengths)
@@ -101,10 +120,18 @@ class HyperLogLog:
         return registers.at[idx].max(rank)
 
     def update(self, batch: np.ndarray, lengths: np.ndarray) -> None:
-        """Absorb a staged [B, L] batch (rows with length<0 ignored)."""
-        self.registers = self._update(
-            self.registers, jnp.asarray(batch), jnp.asarray(lengths)
-        )
+        """Absorb a staged [B, L] batch (rows with length<0 ignored).
+        Falls back to the bit-identical host loop while the device
+        backend is still attaching."""
+        if self._ensure_device():
+            self.registers = self._update(
+                self.registers, jnp.asarray(batch), jnp.asarray(lengths)
+            )
+            return
+        for i in range(batch.shape[0]):
+            ln = int(lengths[i])
+            if ln >= 0:
+                self.add_cpu(batch[i, :ln].tobytes())
 
     def add_cpu(self, value: bytes) -> None:
         """Host-side single-value update (overflow-row fallback) — same
@@ -114,10 +141,16 @@ class HyperLogLog:
         rest = (h << self.p) & 0xFFFFFFFF
         nlz = 32 - rest.bit_length()
         rank = min(nlz + 1, 32 - self.p + 1)
-        self.registers = self.registers.at[idx].max(rank)
+        if isinstance(self.registers, np.ndarray):
+            self.registers[idx] = max(int(self.registers[idx]), rank)
+        else:
+            self.registers = self.registers.at[idx].max(rank)
 
-    def merge_registers(self, other: "jnp.ndarray") -> None:
-        self.registers = jnp.maximum(self.registers, other)
+    def merge_registers(self, other) -> None:
+        if isinstance(self.registers, np.ndarray):
+            self.registers = np.maximum(self.registers, np.asarray(other))
+        else:
+            self.registers = jnp.maximum(self.registers, other)
 
     def estimate(self) -> float:
         """Standard HLL estimator with small/large range corrections."""
@@ -142,10 +175,29 @@ class CountMin:
             raise RuntimeError("jax is unavailable")
         self.depth = depth
         self.width = width
-        self.table = jnp.zeros((depth, width), dtype=jnp.int64
-                               if jax.config.jax_enable_x64 else jnp.int32)
-        self._update = jax.jit(self._update_impl)
+        # host-side until the backend attaches (see HyperLogLog); the
+        # dtype matches what the device table will use so the CPU-pinned
+        # path keeps the same overflow envelope
+        self._dtype = (np.int64 if jax.config.jax_enable_x64
+                       else np.int32)
+        self.table = np.zeros((depth, width), dtype=self._dtype)
+        self._update = None
         self._row_ids = np.arange(depth, dtype=np.uint32)
+
+    def _ensure_device(self, wait: bool = False) -> bool:
+        if self._update is not None:
+            return True
+        from . import device
+
+        ok = device.wait(max(60.0, device.default_wait())) if wait \
+            else device.ready()
+        if not ok:
+            if not wait:
+                device.attach_async()
+            return False
+        self.table = jnp.asarray(self.table, dtype=self._dtype)
+        self._update = jax.jit(self._update_impl)
+        return True
 
     def _hashes(self, batch, lengths):
         h1 = _fnv1a_scan(batch, lengths)
@@ -169,13 +221,22 @@ class CountMin:
         B = batch.shape[0]
         if weights is None:
             weights = np.ones((B,), dtype=np.int32)
-        self.table = self._update(
-            self.table, jnp.asarray(batch), jnp.asarray(lengths),
-            jnp.asarray(weights),
-        )
+        if self._ensure_device():
+            self.table = self._update(
+                self.table, jnp.asarray(batch), jnp.asarray(lengths),
+                jnp.asarray(weights),
+            )
+            return
+        for i in range(B):
+            ln = int(lengths[i])
+            if ln >= 0:
+                self.add_cpu(batch[i, :ln].tobytes(), int(weights[i]))
 
-    def merge_table(self, other: "jnp.ndarray") -> None:
-        self.table = self.table + other
+    def merge_table(self, other) -> None:
+        if isinstance(self.table, np.ndarray):
+            self.table = self.table + np.asarray(other)
+        else:
+            self.table = self.table + other
 
     def _cols_cpu(self, value: bytes):
         """Column per row for one value — bit-identical to the device
@@ -189,7 +250,10 @@ class CountMin:
         """Host-side single-value update (overflow-row fallback)."""
         cols = self._cols_cpu(value)
         rows = np.arange(self.depth)
-        self.table = self.table.at[rows, np.asarray(cols)].add(weight)
+        if isinstance(self.table, np.ndarray):
+            self.table[rows, np.asarray(cols)] += weight
+        else:
+            self.table = self.table.at[rows, np.asarray(cols)].add(weight)
 
     def query(self, value: bytes) -> int:
         """Point estimate for one value (row-min)."""
@@ -261,6 +325,12 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
     from jax.sharding import PartitionSpec as P
 
     axis = mesh.axis_names[0]
+    if not hll._ensure_device(wait=True):
+        from . import device
+
+        raise RuntimeError(
+            f"device backend not attached: {device.status()}"
+        )
     batch, lengths = _pad_to_mesh(mesh, batch, lengths)
     # cache the compiled step per mesh — a fresh jit(shard_map(...))
     # closure would recompile on every call
@@ -289,6 +359,12 @@ def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
     from jax.sharding import PartitionSpec as P
 
     axis = mesh.axis_names[0]
+    if not cms._ensure_device(wait=True):
+        from . import device
+
+        raise RuntimeError(
+            f"device backend not attached: {device.status()}"
+        )
     batch, lengths = _pad_to_mesh(mesh, batch, lengths)
     weights = np.ones((batch.shape[0],), dtype=np.int32)
     cache = getattr(cms, "_sharded_cache", None)

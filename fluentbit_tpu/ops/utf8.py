@@ -103,9 +103,20 @@ class Utf8Validator:
     def __init__(self):
         if not HAVE_JAX:
             raise RuntimeError("jax is unavailable")
+        self._jit = None  # materialized when the backend attaches
+
+    def _ensure_device(self) -> bool:
+        if self._jit is not None:
+            return True
+        from . import device
+
+        if not device.ready():
+            device.attach_async()
+            return False
         self._cls = jnp.asarray(_CLS)
         self._trans = jnp.asarray(_TRANS)
         self._jit = jax.jit(self._impl)
+        return True
 
     def _impl(self, batch, lengths):
         B, L = batch.shape
@@ -126,9 +137,17 @@ class Utf8Validator:
 
     def validate(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         """bool [B] — row i's first lengths[i] bytes are well-formed
-        UTF-8 (rows with negative length report False)."""
-        return np.asarray(self._jit(jnp.asarray(batch),
-                                    jnp.asarray(lengths)))
+        UTF-8 (rows with negative length report False). Falls back to
+        the host DFA while the backend is attaching."""
+        if self._ensure_device():
+            return np.asarray(self._jit(jnp.asarray(batch),
+                                        jnp.asarray(lengths)))
+        out = np.zeros((batch.shape[0],), dtype=bool)
+        for i in range(batch.shape[0]):
+            ln = int(lengths[i])
+            if ln >= 0:
+                out[i] = validate_bytes(bytes(batch[i, :ln]))
+        return out
 
 
 _validator: Optional[Utf8Validator] = None

@@ -82,11 +82,14 @@ class ParserFilter(FilterPlugin):
             and self.parsers[0].regex.dfa is not None
         ):
             try:
+                from ..ops import device
                 from ..ops.grep import program_for
 
                 self._prefilter = program_for(
                     (self.parsers[0].regex.pattern,), self.tpu_max_record_len
                 )
+                device.wait()  # bounded; CPU path serves until attached
+                self._prefilter.try_ready()
             except Exception:
                 self._prefilter = None
 
@@ -147,7 +150,9 @@ class ParserFilter(FilterPlugin):
             for ev in events
         ]
         mask = None
-        if self._prefilter is not None and len(events) >= self.tpu_batch_records:
+        if (self._prefilter is not None
+                and len(events) >= self.tpu_batch_records
+                and self._prefilter.try_ready()):
             mask = self._device_match_mask(values)
         out: List[LogEvent] = []
         modified = False

@@ -86,12 +86,15 @@ class RewriteTagFilter(FilterPlugin):
             and all(r.regex.dfa is not None for r in self.rules)
         ):
             try:
+                from ..ops import device
                 from ..ops.grep import program_for
 
                 self._program = program_for(
                     tuple(r.regex.pattern for r in self.rules),
                     self.tpu_max_record_len,
                 )
+                device.wait()  # bounded; CPU path serves until attached
+                self._program.try_ready()
             except Exception:
                 self._program = None
 
@@ -166,6 +169,7 @@ class RewriteTagFilter(FilterPlugin):
         use_device = (
             self._program is not None
             and len(events) >= self.tpu_batch_records
+            and self._program.try_ready()
         )
         if use_device:
             values = self._values_matrix(events)

@@ -213,6 +213,8 @@ class TailInput(InputPlugin):
             tf.fd.seek(0)
             tf.offset = 0
             tf.pending = b""
+            tf.skipping = False
+            tf.skip_anchor = 0
         self._drain_fd(tf, engine)
         # rotation: name now points at a different inode — finish the old
         # file (drained above), then follow the new one from offset 0
@@ -225,6 +227,8 @@ class TailInput(InputPlugin):
             tf.inode = st.st_ino
             tf.offset = 0
             tf.pending = b""
+            tf.skipping = False
+            tf.skip_anchor = 0
             self._drain_fd(tf, engine, reopen=True)
         elif st is None:
             try:

@@ -15,6 +15,9 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CPU attach is near-instant; a generous deadline keeps the device path
+# deterministic in tests (plugins would otherwise race the attach thread)
+os.environ.setdefault("FBTPU_ATTACH_WAIT_S", "120")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
