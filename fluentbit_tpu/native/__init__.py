@@ -53,7 +53,7 @@ def _build(src_hash: Optional[str]) -> bool:
         os.makedirs(_BUILD_DIR, exist_ok=True)
     except OSError:
         return False
-    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
            _SRC, "-o", _SO]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
@@ -99,19 +99,24 @@ def _load():
                 # prebuilt .so with no hash sidecar: assume it matches
                 # the current source and record that assumption, so a
                 # LATER source edit triggers exactly one rebuild instead
-                # of a failing g++ attempt on every process start
+                # of a failing g++ attempt on every process start. The
+                # assumption holds even when the sidecar write fails
+                # (read-only filesystem) — the .so must still load.
+                built_hash = src_hash
                 try:
                     with open(_SO + ".hash", "w") as f:
                         f.write(src_hash)
-                    built_hash = src_hash
                 except OSError:
                     pass
             if not have_so or (src_hash is not None
                                and built_hash != src_hash):
-                # a failed rebuild falls back to an existing (possibly
-                # prebuilt, hash-less) .so rather than losing the
-                # native path on toolchain-less hosts
-                if not _build(src_hash) and not have_so:
+                if not _build(src_hash):
+                    # a KNOWN-stale .so (recorded hash differs from the
+                    # current source) must never load — its ABI may not
+                    # match the Python callers, and a silent mismatch
+                    # corrupts memory. Only a hash-less prebuilt .so
+                    # (provenance unknown, assumed current above) is a
+                    # safe fallback, and that case never reaches here.
                     return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -141,8 +146,36 @@ def _load():
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_longlong, ctypes.POINTER(ctypes.c_uint8),
         ]
+        try:
+            grep_fn = lib.fbtpu_grep_match
+        except AttributeError:
+            # prebuilt .so from an older source (hash-less trust path):
+            # the scanner entry points still work; grep_match() reports
+            # unavailable and callers use their staged/Python paths
+            grep_fn = None
+            log.warning("fbtpu_grep_match absent in %s (stale prebuilt?)",
+                        _SO)
+        if grep_fn is not None:
+            grep_fn.restype = ctypes.c_longlong
+            grep_fn.argtypes = _grep_match_argtypes()
         _lib = lib
         return _lib
+
+
+def _grep_match_argtypes():
+    return [
+            ctypes.c_char_p, ctypes.c_longlong,          # buf
+            ctypes.c_char_p,                             # keys_cat
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_int32),              # trans_cat
+            ctypes.POINTER(ctypes.c_longlong),           # troffs
+            ctypes.POINTER(ctypes.c_int32),              # cmaps
+            ctypes.POINTER(ctypes.c_int32),              # starts
+            ctypes.POINTER(ctypes.c_int32),              # ncls
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong),           # offsets
+        ]
 
 
 def available() -> bool:
@@ -194,6 +227,98 @@ def compact(buf: bytes, offsets: np.ndarray,
     if w < 0:
         return None
     return out[:w].tobytes()
+
+
+class GrepTables:
+    """Packed DFA tables for the one-pass native grep matcher — the
+    host-side twin of ops.grep.GrepProgram (same tables, k=1). Verdicts
+    are bit-exact with the device kernel and the Python regex engine."""
+
+    __slots__ = ("n_rules", "keys_cat", "key_offs", "key_of_rule",
+                 "trans_cat", "troffs", "cmaps", "starts", "ncls")
+
+    def __init__(self, rules):
+        """rules: iterable of (field_key: bytes, dfa) pairs."""
+        keys: list = []
+        key_idx = {}
+        key_of_rule = []
+        trans_parts = []
+        troffs = [0]
+        cmaps = []
+        starts = []
+        ncls = []
+        for key, dfa in rules:
+            if key not in key_idx:
+                key_idx[key] = len(keys)
+                keys.append(key)
+            key_of_rule.append(key_idx[key])
+            from ..regex.dfa import compose_supersteps
+
+            t = np.ascontiguousarray(dfa.trans, dtype=np.int32)
+            S, C = t.shape
+            # pre-compose to k-byte super-steps (cuts the dependent-load
+            # chain k-fold) while [S, C^k] stays cache-friendly; the
+            # packed class count encodes C + 1000*(k-1) for the C side
+            k = 1
+            while k < 4 and S * (C ** (k + 1)) * 4 <= 2 * 1024 * 1024:
+                k += 1
+            tk = compose_supersteps(t, k)
+            trans_parts.append(np.ascontiguousarray(
+                tk, dtype=np.int32).reshape(-1))
+            troffs.append(troffs[-1] + tk.size)
+            ncls.append(C + 1000 * (k - 1))
+            cmaps.append(np.ascontiguousarray(
+                dfa.class_map, dtype=np.int32))
+            starts.append(dfa.start)
+        self.n_rules = len(key_of_rule)
+        self.keys_cat = b"".join(keys)
+        offs = [0]
+        for k in keys:
+            offs.append(offs[-1] + len(k))
+        self.key_offs = np.asarray(offs, dtype=np.int64)
+        self.key_of_rule = np.asarray(key_of_rule, dtype=np.int32)
+        self.trans_cat = np.concatenate(trans_parts)
+        self.troffs = np.asarray(troffs[:-1], dtype=np.int64)
+        self.cmaps = np.concatenate(cmaps)
+        self.starts = np.asarray(starts, dtype=np.int32)
+        self.ncls = np.asarray(ncls, dtype=np.int32)
+
+
+def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
+               ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+    """One-pass field-extract + DFA match over chunk bytes. Returns
+    (mask[R, n] bool, offsets[n+1] i64, n) or None (native unavailable /
+    malformed buffer)."""
+    lib = _load()
+    if lib is None or getattr(lib, "fbtpu_grep_match", None) is None:
+        return None
+    est = n_hint if n_hint is not None else count_records(buf)
+    if est is None:
+        return None
+    R = tables.n_rules
+    cap = max(est, 1)  # match/offsets sized to the capacity granted to C
+    match = np.empty((R, cap), dtype=np.uint8)
+    offsets = np.empty(cap + 1, dtype=np.int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_longlong)
+    n = getattr(lib, "fbtpu_grep_match")(
+        buf, len(buf),
+        tables.keys_cat,
+        tables.key_offs.ctypes.data_as(i64p),
+        len(tables.key_offs) - 1,
+        tables.key_of_rule.ctypes.data_as(i32p), R,
+        tables.trans_cat.ctypes.data_as(i32p),
+        tables.troffs.ctypes.data_as(i64p),
+        tables.cmaps.ctypes.data_as(i32p),
+        tables.starts.ctypes.data_as(i32p),
+        tables.ncls.ctypes.data_as(i32p),
+        match.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+        offsets.ctypes.data_as(i64p),
+    )
+    if n < 0:
+        return None
+    return match[:, :n].astype(bool), offsets[: n + 1], int(n)
 
 
 def stage_field(

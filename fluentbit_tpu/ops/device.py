@@ -37,6 +37,7 @@ _state = "unattached"  # unattached | attaching | ready | failed
 _error: Optional[str] = None
 _thread: Optional[threading.Thread] = None
 _attach_seconds: Optional[float] = None
+_platform: Optional[str] = None
 
 
 def default_wait() -> float:
@@ -47,7 +48,7 @@ def default_wait() -> float:
 
 
 def _attach_worker() -> None:
-    global _state, _error, _attach_seconds
+    global _state, _error, _attach_seconds, _platform
     t0 = time.time()
     try:
         import jax
@@ -59,6 +60,7 @@ def _attach_worker() -> None:
         jnp.zeros((8,), dtype=jnp.int32).block_until_ready()
         with _lock:
             _attach_seconds = time.time() - t0
+            _platform = jax.default_backend()
             _state = "ready"
         log.info("device backend attached: %d device(s) in %.1fs",
                  n, _attach_seconds)
@@ -102,9 +104,15 @@ def wait(timeout: Optional[float] = None) -> bool:
     return ready()
 
 
+def platform() -> Optional[str]:
+    """Attached backend name ('tpu', 'cpu', ...); None until ready."""
+    return _platform
+
+
 def status() -> dict:
     return {
         "state": _state,
         "error": _error,
+        "platform": _platform,
         "attach_seconds": _attach_seconds,
     }

@@ -56,15 +56,12 @@ def choose_k(n_states: int, n_classes: int, budget: int = _TABLE_BUDGET) -> int:
 
 
 def compose_table(trans: np.ndarray, k: int) -> np.ndarray:
-    """Pre-compose a [S, C] table to k-byte super-steps: [S, C^k]."""
-    S, C = trans.shape
-    out = trans
-    for _ in range(k - 1):
-        # out[s, w] = state after word w; extend by one byte:
-        # new[s, w*C + c] = trans[out[s, w], c]
-        out = trans[out.reshape(-1)].reshape(S, -1)
-        # careful: trans[out[s,w]] gives [S*W, C]; reshape to [S, W*C]
-    return out
+    """Pre-compose a [S, C] table to k-byte super-steps: [S, C^k]
+    (delegates to the shared composition in regex.dfa so the device and
+    native tables stay bit-identical)."""
+    from ..regex.dfa import compose_supersteps
+
+    return compose_supersteps(trans, k)
 
 
 class GrepProgram:

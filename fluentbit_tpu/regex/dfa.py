@@ -194,6 +194,23 @@ class DFA:
         return (state == ACC) & (lengths >= 0)
 
 
+def compose_supersteps(trans: np.ndarray, k: int) -> np.ndarray:
+    """Pre-compose a [S, C] table to k-byte super-steps: [S, C^k] with
+    T_k[s, c1*C^(k-1) + ... + ck] = T[...T[T[s, c1], c2]..., ck].
+
+    The single source of the super-step index order — both the device
+    kernel (ops/grep.py GrepProgram) and the native C++ twin
+    (native/__init__.py GrepTables) build their tables here, keeping the
+    bit-exact contract between them in one place."""
+    S, C = trans.shape
+    out = trans
+    for _ in range(k - 1):
+        # out[s, w] = state after word w; extend by one byte:
+        # new[s, w*C + c] = trans[out[s, w], c]
+        out = trans[out.reshape(-1)].reshape(S, -1)
+    return out
+
+
 def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,
                 max_states: int = 4096) -> DFA:
     """Compile a pattern (str or ParsedRegex) to a scan DFA.

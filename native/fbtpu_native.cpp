@@ -19,7 +19,110 @@
 // -1 on malformed input; the caller falls back to the Python codec.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
+
+// W-way interleaved DFA over extracted values: W independent
+// state-transition chains hide the dependent-load latency that caps a
+// scalar table walk. DEAD(0)/ACC(1) rows and the EOL class are all
+// absorbing in these tables (regex/dfa.py construction), so rows
+// shorter than the block's max length just spin on EOL — branch-free.
+#define FBTPU_DFA_LANES 8
+
+static void dfa_run_block(const int32_t *trans, const int32_t *cmap,
+                          int32_t C, int32_t start,
+                          const uint8_t *const *vals,
+                          const uint32_t *lens, int nrows,
+                          uint8_t *out) {
+    const int W = FBTPU_DFA_LANES;
+    int32_t eol = cmap[256];
+    int32_t s[W];
+    const uint8_t *v[W];
+    uint32_t l[W], maxlen = 0;
+    for (int j = 0; j < W; j++) {
+        if (j < nrows && vals[j] != nullptr) {
+            v[j] = vals[j];
+            l[j] = lens[j];
+            s[j] = start;
+            if (l[j] > maxlen) maxlen = l[j];
+        } else {
+            v[j] = nullptr;
+            l[j] = 0;
+            s[j] = 0;  // DEAD: missing/non-string value never matches
+        }
+    }
+    for (uint32_t pos = 0; pos <= maxlen; pos++) {
+        int32_t c[W], acc = 0;
+        for (int j = 0; j < W; j++)
+            c[j] = pos < l[j] ? cmap[v[j][pos]] : eol;
+        for (int j = 0; j < W; j++) {
+            s[j] = trans[s[j] * C + c[j]];
+            acc |= s[j];
+        }
+        // states are non-negative, so OR <= 1 iff every chain is in
+        // {DEAD, ACC} — all absorbed, result final
+        if (acc <= 1) break;
+    }
+    // every live row consumed >= 1 EOL symbol inside the loop (pos runs
+    // to maxlen inclusive), and an early break means all chains were
+    // already absorbed — the final states are final
+    for (int j = 0; j < W && j < nrows; j++)
+        out[j] = (uint8_t)(s[j] == 1);
+}
+
+// k>=2 variant: trans_k[s, c1*C^(k-1) + ... + ck] tables pre-composed
+// host-side (GrepTables packs them while S*C^k fits the budget) cut the
+// dependent-load chain k-fold — k bytes per step, EOL^k absorbing.
+template <int K>
+static void dfa_run_block_k(const int32_t *transk, const int32_t *cmap,
+                            int32_t C, int32_t start,
+                            const uint8_t *const *vals,
+                            const uint32_t *lens, int nrows,
+                            uint8_t *out) {
+    const int W = FBTPU_DFA_LANES;
+    int32_t eol = cmap[256];
+    int32_t Ck = 1;
+    for (int b = 0; b < K; b++) Ck *= C;
+    int32_t s[W];
+    const uint8_t *v[W];
+    uint32_t l[W], maxlen = 0;
+    for (int j = 0; j < W; j++) {
+        if (j < nrows && vals[j] != nullptr) {
+            v[j] = vals[j];
+            l[j] = lens[j];
+            s[j] = start;
+            if (l[j] > maxlen) maxlen = l[j];
+        } else {
+            v[j] = nullptr;
+            l[j] = 0;
+            s[j] = 0;
+        }
+    }
+    // pos <= maxlen guarantees every row sees >= 1 EOL symbol: the
+    // step group containing index l always runs (l <= maxlen), and pad
+    // positions inside a group read as EOL
+    for (uint32_t pos = 0; pos <= maxlen; pos += K) {
+        int32_t c[W], acc = 0;
+        for (int j = 0; j < W; j++) {
+            int32_t cc = 0;
+            for (int b = 0; b < K; b++) {
+                int32_t cb = pos + b < l[j] ? cmap[v[j][pos + b]] : eol;
+                cc = cc * C + cb;
+            }
+            c[j] = cc;
+        }
+        for (int j = 0; j < W; j++) {
+            s[j] = transk[s[j] * Ck + c[j]];
+            acc |= s[j];
+        }
+        if (acc <= 1) break;
+    }
+    for (int j = 0; j < W && j < nrows; j++)
+        out[j] = (uint8_t)(s[j] == 1);
+}
+
+
 
 extern "C" {
 
@@ -298,6 +401,181 @@ long long fbtpu_stage_field(const uint8_t *buf, long long buflen,
         rec++;
     }
     if (offsets) offsets[rec] = buflen;
+    return rec;
+}
+
+// ---------------------------------------------------------------------
+// One-pass grep: field extraction + DFA execution straight off chunk
+// bytes. The host-side twin of the device kernel (fluentbit_tpu/ops/
+// grep.py): identical table semantics (DEAD=0 / ACC=1 absorbing, bytes
+// then one EOL step), so verdicts are bit-exact with both the jax
+// kernel and the Python regex engine. Used when the device backend is
+// not attached (or is the jax CPU backend, which a table-driven C loop
+// beats by orders of magnitude) — reference precedent: the hot filter
+// loop is host-native C in fluent-bit (plugins/filter_grep/grep.c:286).
+//
+//   keys_cat/key_offs : n_keys concatenated field names
+//   key_of_rule       : rule r matches field keys[key_of_rule[r]]
+//   trans_cat/troffs  : per-rule [S*C] int32 transition tables
+//   cmaps             : [R][257] byte->class maps (entry 256 = EOL)
+//   starts, ncls      : per-rule start state / class count
+//   match_out         : [R][max_records] u8 verdict matrix
+//   offsets           : record byte offsets (max_records+1)
+// Returns record count, -1 malformed, -2 capacity exceeded.
+// ---------------------------------------------------------------------
+
+#define FBTPU_MAX_KEYS 64
+
+long long fbtpu_grep_match(const uint8_t *buf, long long buflen,
+                           const uint8_t *keys_cat,
+                           const long long *key_offs, long long n_keys,
+                           const int32_t *key_of_rule, long long n_rules,
+                           const int32_t *trans_cat,
+                           const long long *troffs,
+                           const int32_t *cmaps, const int32_t *starts,
+                           const int32_t *ncls,
+                           uint8_t *match_out, long long max_records,
+                           long long *offsets) {
+    if (n_keys > FBTPU_MAX_KEYS) return -1;
+    const uint8_t *p = buf, *end = buf + buflen;
+    long long rec = 0;
+    // phase 1: one msgpack walk extracts every key's (ptr, len) per
+    // record into scratch, so phase 2 can run each rule's DFA over
+    // contiguous rows with FBTPU_DFA_LANES-way interleaving
+    const uint8_t **vals = new const uint8_t *[n_keys * max_records];
+    uint32_t *vlens = new uint32_t[n_keys * max_records];
+    while (p < end) {
+        if (rec >= max_records) {
+            delete[] vals;
+            delete[] vlens;
+            return -2;
+        }
+        if (offsets) offsets[rec] = p - buf;
+        const uint8_t *rec_start = p;
+        for (long long kx = 0; kx < n_keys; kx++)
+            vals[kx * max_records + rec] = nullptr;
+        uint32_t outer;
+        const uint8_t *q = read_array_hdr(p, end, &outer);
+        if (q && outer >= 2) {
+            const uint8_t *body = skip_obj(q, end, 0);
+            if (body) {
+                uint32_t pairs;
+                const uint8_t *kv = read_map_hdr(body, end, &pairs);
+                if (kv) {
+                    // one map walk resolves every rule's field; LAST
+                    // duplicate occurrence wins (dict-decode parity)
+                    for (uint32_t i = 0; i < pairs && kv; i++) {
+                        uint32_t klen;
+                        const uint8_t *kstr = read_str_hdr(kv, end, &klen);
+                        const uint8_t *val;
+                        long long match_kx = -1;
+                        if (kstr) {
+                            val = kstr + klen;
+                            if (val > end) { kv = nullptr; break; }
+                            for (long long kx = 0; kx < n_keys; kx++) {
+                                long long kl =
+                                    key_offs[kx + 1] - key_offs[kx];
+                                if (kl == (long long)klen &&
+                                    memcmp(kstr, keys_cat + key_offs[kx],
+                                           klen) == 0) {
+                                    match_kx = kx;
+                                    break;
+                                }
+                            }
+                        } else {
+                            val = skip_obj(kv, end, 0);  // non-str key
+                            if (!val) { kv = nullptr; break; }
+                        }
+                        if (match_kx >= 0) {
+                            uint32_t vlen;
+                            const uint8_t *vstr =
+                                read_str_hdr(val, end, &vlen);
+                            long long slot =
+                                match_kx * max_records + rec;
+                            if (vstr && vstr + vlen <= end) {
+                                vals[slot] = vstr;
+                                vlens[slot] = vlen;
+                            } else {
+                                vals[slot] = nullptr;  // non-string
+                            }
+                        }
+                        kv = skip_obj(val, end, 0);
+                    }
+                }
+            }
+        }
+        p = skip_obj(rec_start, end, 0);
+        if (!p) {
+            delete[] vals;
+            delete[] vlens;
+            return -1;
+        }
+        rec++;
+    }
+    if (offsets) offsets[rec] = buflen;
+    // phase 2: per-rule interleaved DFA sweep. Rows are independent, so
+    // large batches fan out across host threads (the ctypes caller has
+    // already released the GIL). FBTPU_DFA_THREADS caps the fan-out.
+    auto sweep = [&](long long r, long long lo, long long hi) {
+        const int32_t *trans = trans_cat + troffs[r];
+        const int32_t *cmap = cmaps + r * 257;
+        const uint8_t *const *kv = vals + key_of_rule[r] * max_records;
+        const uint32_t *kl = vlens + key_of_rule[r] * max_records;
+        uint8_t *out = match_out + r * max_records;
+        // ncls encodes C and the super-step k: C + 1000*(k-1)
+        int32_t enc = ncls[r];
+        int k = enc / 1000 + 1;
+        int32_t C = enc % 1000;
+        for (long long i = lo; i < hi; i += FBTPU_DFA_LANES) {
+            int nrows = (int)(hi - i < FBTPU_DFA_LANES
+                              ? hi - i : FBTPU_DFA_LANES);
+            if (k == 4)
+                dfa_run_block_k<4>(trans, cmap, C, starts[r],
+                                   kv + i, kl + i, nrows, out + i);
+            else if (k == 3)
+                dfa_run_block_k<3>(trans, cmap, C, starts[r],
+                                   kv + i, kl + i, nrows, out + i);
+            else if (k == 2)
+                dfa_run_block_k<2>(trans, cmap, C, starts[r],
+                                   kv + i, kl + i, nrows, out + i);
+            else
+                dfa_run_block(trans, cmap, C, starts[r],
+                              kv + i, kl + i, nrows, out + i);
+        }
+    };
+    int nthreads = 1;
+    if (rec >= 4096) {
+        const char *env = getenv("FBTPU_DFA_THREADS");
+        long want = env ? strtol(env, nullptr, 10) : 4;
+        unsigned hw = std::thread::hardware_concurrency();
+        if (want < 1) want = 1;
+        if (hw && want > (long)hw) want = hw;
+        if (want > 16) want = 16;
+        nthreads = (int)want;
+    }
+    if (nthreads <= 1) {
+        for (long long r = 0; r < n_rules; r++) sweep(r, 0, rec);
+    } else {
+        // split rows into nthreads slices (lane-aligned), all rules in
+        // each slice — one spawn wave regardless of rule count
+        std::thread workers[16];
+        long long step = (rec + nthreads - 1) / nthreads;
+        step = ((step + FBTPU_DFA_LANES - 1) / FBTPU_DFA_LANES)
+               * FBTPU_DFA_LANES;
+        int spawned = 0;
+        for (int t = 0; t < nthreads; t++) {
+            long long lo = (long long)t * step;
+            if (lo >= rec) break;
+            long long hi = lo + step < rec ? lo + step : rec;
+            workers[spawned++] = std::thread([&sweep, n_rules, lo, hi] {
+                for (long long r = 0; r < n_rules; r++)
+                    sweep(r, lo, hi);
+            });
+        }
+        for (int t = 0; t < spawned; t++) workers[t].join();
+    }
+    delete[] vals;
+    delete[] vlens;
     return rec;
 }
 

@@ -164,3 +164,59 @@ def test_native_scanner_fuzz_robustness():
             for i in range(rng.randrange(1, 30))
         )
         assert native.count_records(buf) == count_records(buf)
+
+
+def test_native_grep_match_differential():
+    """One-pass C++ DFA matcher vs the Python regex engine over mixed
+    corpora: apache2, alternation, anchors, bounded reps; missing /
+    empty / non-string values; odd+even lengths (exercises every k
+    super-step variant)."""
+    import random
+
+    from fluentbit_tpu import native
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.regex import FlbRegex
+    from fluentbit_tpu.regex.dfa import compile_dfa
+
+    if not native.available():
+        pytest.skip("native unavailable")
+    apache2 = (
+        r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
+        r'"(?<method>\S+)(?: +(?<path>[^ ]*) +\S*)?" (?<code>[^ ]*) '
+        r'(?<size>[^ ]*)(?: "(?<referer>[^\"]*)" "(?<agent>.*)")?$'
+    )
+    patterns = [("log", apache2), ("log", "ERROR|WARN"),
+                ("msg", "^kernel:"), ("log", "a{2,5}b?$")]
+    tables = native.GrepTables(
+        [(k.encode(), compile_dfa(p)) for k, p in patterns]
+    )
+    regexes = [(k, FlbRegex(p)) for k, p in patterns]
+    rng = random.Random(11)
+    buf = bytearray()
+    records = []
+    for i in range(3000):
+        kind = rng.random()
+        if kind < 0.3:
+            line = (f'10.0.0.{rng.randrange(256)} - frank '
+                    f'[10/Oct/2000:13:55:36 -0700] "GET /p{i} HTTP/1.1" '
+                    f'200 {i} "r" "a"')
+            body = {"log": line[: rng.randrange(0, 120)]}
+        elif kind < 0.5:
+            body = {"log": "a" * rng.randrange(8) + "b" * rng.randrange(3),
+                    "msg": f"kernel: oops {i}"}
+        elif kind < 0.7:
+            body = {"msg": rng.choice(["kernel: x", "user: y"]), "n": i}
+        elif kind < 0.85:
+            body = {"log": ""}
+        else:
+            body = {"other": "zz", "log": 123}
+        buf += encode_event(body, float(i))
+        records.append(body)
+    mask, offsets, n = native.grep_match(bytes(buf), tables)
+    assert n == len(records)
+    assert offsets[-1] == len(buf)
+    for r, (k, rx) in enumerate(regexes):
+        for i, body in enumerate(records):
+            v = body.get(k)
+            exp = rx.match(v) if isinstance(v, str) else False
+            assert bool(mask[r, i]) == bool(exp), (r, i, body)
