@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import threading
 from typing import Any, Callable, Dict, List, Optional, Type
 
 from .config import ConfigMapEntry, Properties, apply_config_map
@@ -167,6 +168,14 @@ class InputInstance(Instance):
         self.storage_type = "memory"
         self.processors: List = []  # input-side processor pipeline
         self.collector_task = None
+        self.threaded = False  # run the collector on its own OS thread
+        self.collector_thread = None
+        # serializes this input's pool: every append/drain of this
+        # input's chunks holds it, so raw-path ingest can run WITHOUT
+        # the engine-global lock when the filter chain allows (reference:
+        # per-input chunk maps, src/flb_input_log.c:1524). RLock — the
+        # global-lock paths nest it around their pool touches.
+        self.ingest_lock = threading.RLock()
 
     def configure(self) -> None:
         super().configure()
@@ -183,6 +192,11 @@ class InputInstance(Instance):
         self.pause_on_chunks_overlimit = parse_bool(
             self.properties.get("storage.pause_on_chunks_overlimit", False)
         )
+        # threaded collector (reference FLB_INPUT_THREADED /
+        # `threaded on`, src/flb_input_thread.c:225): collection work
+        # runs on a dedicated OS thread; the append path stays
+        # thread-safe via the engine's ingest locking
+        self.threaded = parse_bool(self.properties.get("threaded", False))
 
 
 class FilterInstance(Instance):
