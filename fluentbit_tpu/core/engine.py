@@ -48,7 +48,8 @@ class Task:
     """One flushable chunk + its routes + retry state
     (reference struct flb_task, include/fluent-bit/flb_task.h:82-98)."""
 
-    __slots__ = ("id", "chunk", "routes", "retries", "users", "engine")
+    __slots__ = ("id", "chunk", "routes", "retries", "users", "engine",
+                 "processed")
 
     def __init__(self, chunk: Chunk, routes: List[OutputInstance]):
         self.id = next(_task_ids)
@@ -56,6 +57,9 @@ class Task:
         self.routes = routes
         self.retries: Dict[str, int] = {}  # output name → attempts
         self.users = 0
+        # output name → processed payload (output-side processors run
+        # once per route; retries reuse the cached bytes)
+        self.processed: Dict[str, bytes] = {}
 
 
 class Engine:
@@ -84,6 +88,10 @@ class Engine:
         self._stop_event = threading.Event()  # wakes threaded collectors
         self._ingest_lock = threading.RLock()
         self._pending_flushes: set = set()
+        # scheduler-owned retries (flb_engine_dispatch_retry,
+        # src/flb_engine_dispatch.c:36-99): a retry is a loop timer +
+        # this record, NOT a sleeping coroutine — key (chunk id, output)
+        self._pending_retries: Dict[tuple, tuple] = {}
         self._notification_subs: List = []
         self.started_at: float = 0.0
         self.reload_count = 0
@@ -418,11 +426,19 @@ class Engine:
             deadline = time.time() + self.service.grace
             while self._pending_flushes and time.time() < deadline:
                 await asyncio.sleep(0.02)
-            # cancel stragglers (e.g. retries sleeping out their backoff)
+            # cancel stragglers (in-flight flush attempts)
             for fut in list(self._pending_flushes):
                 fut.cancel()
             if self._pending_flushes:
                 await asyncio.gather(*self._pending_flushes, return_exceptions=True)
+            # pending scheduler retries: cancel their timers and
+            # quarantine undelivered memory chunks (same semantics as a
+            # cancelled in-flight flush)
+            for key, (task, out, handle) in list(
+                    self._pending_retries.items()):
+                handle.cancel()
+                self._drop_retry(task, out)
+            self._pending_retries.clear()
         finally:
             # an abnormal loop exit (exception above) must still stop
             # collector threads — they check _stopping/_stop_event
@@ -810,8 +826,8 @@ class Engine:
                 task.users += 1
                 self._spawn_flush(task, out)
 
-    def _spawn_flush(self, task: Task, out: OutputInstance, delay: float = 0.0) -> None:
-        coro = self._flush_one(task, out, delay)
+    def _spawn_flush(self, task: Task, out: OutputInstance) -> None:
+        coro = self._flush_one(task, out)
         if self.loop is None or not self.running:
             # synchronous fallback (engine not started: unit tests)
             asyncio.run(coro)
@@ -829,16 +845,19 @@ class Engine:
             self.m_out_dropped.inc(task.chunk.records, (out.display_name,))
             task.users -= 1
 
-    async def _flush_one(self, task: Task, out: OutputInstance, delay: float) -> None:
-        """One (task × output) flush coroutine, including its retries
-        (reference flb_output_flush_create/output_pre_cb_flush; backoff stays
-        inside the coroutine rather than re-dispatching through the
-        scheduler). Concurrency honors the reference's dispatch flags
+    async def _flush_one(self, task: Task, out: OutputInstance) -> None:
+        """One (task × output) flush ATTEMPT
+        (flb_output_flush_create/output_pre_cb_flush). A RETRY result
+        does not sleep here: it registers a scheduler timer that
+        re-spawns a fresh attempt (flb_engine_dispatch_retry,
+        src/flb_engine_dispatch.c:36-99), so a chunk backing off for
+        minutes holds no coroutine and no concurrency slot. Concurrency
+        honors the reference's dispatch flags
         (src/flb_engine_dispatch.c:193-207 + flb_output_thread.c):
         FLB_OUTPUT_SYNCHRONOUS / no_multiplex serialize to one in-flight
         flush per output; ``workers N`` bounds concurrency to N."""
         try:
-            await self._flush_body(task, out, delay)
+            await self._flush_body(task, out)
         except asyncio.CancelledError:
             # engine stopping with this route undelivered (parked on the
             # semaphore, mid-flush, or in backoff): a memory chunk would
@@ -852,13 +871,17 @@ class Engine:
                     log.exception("shutdown quarantine failed")
             raise
 
-    async def _flush_body(self, task: Task, out: OutputInstance,
-                          delay: float) -> None:
+    def _flush_payload(self, task: Task, out: OutputInstance) -> bytes:
+        """The bytes this output delivers for the chunk — output-side
+        processors (flb_processor_run at flush-create,
+        include/fluent-bit/flb_output.h:794) run ONCE per (chunk,
+        output); retries reuse the cached result so non-idempotent
+        processors never repeat side effects."""
         chunk = task.chunk
+        cached = task.processed.get(out.name)
+        if cached is not None:
+            return cached
         data = chunk.get_bytes()
-        # output-side processors (flb_processor_run at flush-create,
-        # include/fluent-bit/flb_output.h:794) — once per chunk, not per
-        # retry attempt
         if out.processors and chunk.event_type == EVENT_TYPE_LOGS:
             events = self._run_log_processors(
                 out.processors, decode_events(data), chunk.tag
@@ -868,15 +891,18 @@ class Engine:
                 for ev in events
             )
         elif out.processors and chunk.event_type == EVENT_TYPE_METRICS:
-            data = self._run_metrics_processors(out.processors, data, chunk.tag)
-        sem = out.flush_semaphore
-        while True:
-            if delay > 0:
-                await asyncio.sleep(delay)
-            # concurrency bound covers ONE attempt, never the backoff
-            # sleeps — a retrying chunk must not head-of-line block the
-            # output's other flushes (reference: retries are
-            # re-scheduled, freeing the dispatch slot)
+            data = self._run_metrics_processors(out.processors, data,
+                                                chunk.tag)
+        if out.processors:
+            task.processed[out.name] = data
+        return data
+
+    async def _flush_body(self, task: Task, out: OutputInstance) -> None:
+        chunk = task.chunk
+        data = self._flush_payload(task, out)
+
+        async def attempt() -> Optional[float]:
+            sem = out.flush_semaphore
             if sem is not None:
                 await sem.acquire()
             try:
@@ -890,7 +916,8 @@ class Engine:
                         result = FlushResult.ERROR
                 else:
                     try:
-                        result = await out.plugin.flush(data, chunk.tag, self)
+                        result = await out.plugin.flush(data, chunk.tag,
+                                                        self)
                     except asyncio.CancelledError:
                         raise
                     except Exception:
@@ -900,9 +927,62 @@ class Engine:
             finally:
                 if sem is not None:
                     sem.release()
-            delay = self._handle_flush_result(task, out, result)
-            if delay is None:
+            return self._handle_flush_result(task, out, result)
+
+        delay = await attempt()
+        if delay is None:
+            return
+        if self.loop is not None and self.running:
+            self._schedule_retry(task, out, delay)
+            return
+        # synchronous fallback (engine not started: unit tests/lib mode
+        # without a loop): retry inside this coroutine like the
+        # pre-scheduler design — asyncio.run() can't be nested
+        while delay is not None:
+            await asyncio.sleep(delay)
+            delay = await attempt()
+
+    def _schedule_retry(self, task: Task, out: OutputInstance,
+                        delay: float) -> None:
+        """Timer-driven retry re-dispatch: the backoff lives in the
+        event loop's timer wheel (flb_sched_request_create →
+        flb_engine_dispatch_retry), not in a parked coroutine. At stop,
+        pending retry records are quarantined like any undelivered
+        route."""
+        key = (task.chunk.id, out.name)
+
+        def _fire():
+            self._pending_retries.pop(key, None)
+            if self._stopping:
+                self._drop_retry(task, out)
                 return
+            self._spawn_flush(task, out)
+
+        def _register():
+            if self._stopping:
+                self._drop_retry(task, out)
+                return
+            handle = self.loop.call_later(delay, _fire)
+            self._pending_retries[key] = (task, out, handle)
+
+        try:
+            self.loop.call_soon_threadsafe(_register)
+        except RuntimeError:
+            self._drop_retry(task, out)
+
+    def _drop_retry(self, task: Task, out: OutputInstance) -> None:
+        """Account a retry dropped at shutdown: quarantine the chunk
+        unless its bytes are already on disk, and count the drop like
+        every other drop path."""
+        self.m_out_errors.inc(1, (out.display_name,))
+        self.m_out_dropped.inc(task.chunk.records, (out.display_name,))
+        if self.storage is not None and \
+                not self.storage.is_tracked(task.chunk):
+            try:
+                self.storage.quarantine(task.chunk)
+            except Exception:
+                log.exception("retry quarantine failed")
+        task.users -= 1
 
     def _handle_flush_result(self, task: Task, out: OutputInstance,
                              result: FlushResult) -> Optional[float]:
@@ -970,5 +1050,8 @@ class Engine:
             return
         settled.wait(timeout=2)
         deadline = time.time() + 5
-        while self._pending_flushes and time.time() < deadline:
+        # retried chunks park as scheduler timers, not coroutines —
+        # settle on both so callers still observe final delivery
+        while (self._pending_flushes or self._pending_retries) \
+                and time.time() < deadline:
             time.sleep(0.01)

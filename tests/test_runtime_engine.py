@@ -248,3 +248,50 @@ def test_engine_metrics_families(ctx):
     eng = ctx.engine
     assert eng.m_in_records.get(("lib.0",)) == 2
     assert eng.m_filter_drop.get((eng.filters[0].display_name,)) == 1
+
+
+def test_retry_is_scheduler_owned_not_coroutine():
+    """A retry backing off for ~60s must hold NO pending flush
+    coroutine and no concurrency slot — it lives as a loop timer in
+    _pending_retries (flb_engine_dispatch_retry semantics,
+    src/flb_engine_dispatch.c:36-99) — and a short-backoff retry must
+    fire on schedule from that timer."""
+    # long backoff: record exists, coroutine doesn't
+    ctx = flb.create(flush="30ms", grace="1")
+    ctx.service_set(**{"scheduler.base": "60", "scheduler.cap": "60"})
+    in_ffd = ctx.input("lib")
+    ctx.output("retry", match="*", retry_limit="5")
+    retry_plugin = ctx.engine.outputs[0].plugin
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"x": 1}')
+        deadline = time.time() + 5
+        while retry_plugin.attempts < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert retry_plugin.attempts == 1
+        time.sleep(0.2)  # let the attempt coroutine finish + register
+        assert len(ctx.engine._pending_retries) == 1
+        assert len(ctx.engine._pending_flushes) == 0
+        # the output's semaphore slot is free during backoff
+        sem = ctx.engine.outputs[0].flush_semaphore
+        assert sem is None or not sem.locked()
+    finally:
+        ctx.stop()
+    # stop with a pending retry leaves no timer behind
+    assert len(ctx.engine._pending_retries) == 0
+
+    # short backoff: the timer fires and re-dispatches
+    ctx2 = flb.create(flush="30ms", grace="1")
+    ctx2.service_set(**{"scheduler.base": "0.05", "scheduler.cap": "0.05"})
+    in2 = ctx2.input("lib")
+    ctx2.output("retry", match="*", retry_limit="2")
+    p2 = ctx2.engine.outputs[0].plugin
+    ctx2.start()
+    try:
+        ctx2.push(in2, '{"x": 1}')
+        deadline = time.time() + 8
+        while p2.attempts < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        assert p2.attempts == 3  # initial + 2 scheduler-fired retries
+    finally:
+        ctx2.stop()
