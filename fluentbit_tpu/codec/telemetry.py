@@ -1,0 +1,464 @@
+"""Typed traces / metrics / profiles payloads + OTLP JSON codecs.
+
+The ctraces / cprofiles equivalents (reference: lib/ctraces/ ~24k LoC,
+lib/cprofiles/ ~44k LoC — both mirror the OTLP data model in C structs;
+OTLP server plugins/in_opentelemetry/, exporter
+plugins/out_opentelemetry/ 4640 LoC). The TPU build's typed model is a
+normalized Python/msgpack structure that flows through chunks with
+event_type "traces"/"profiles" exactly like metrics-as-data payloads:
+
+- **Traces** — ``{"resourceSpans": [{"resource": {attrs}, "scopeSpans":
+  [{"scope": {...}, "spans": [span...]}]}]}`` where span ids are raw
+  bytes, timestamps are int nanoseconds, and attributes are plain dicts
+  (the OTLP kvlist form exists only at the wire boundary).
+- **Metrics** — OTLP metrics decode INTO the internal cmetrics-like
+  snapshot (``core/metrics.py to_msgpack_obj`` shape: ``{"meta": ...,
+  "metrics": [{name/type/labels/values}]}``) so every metrics-capable
+  output (prometheus_exporter, stdout, forward) consumes them
+  unchanged; the exporter re-encodes that shape as OTLP.
+- **Profiles** — resource/scope attributes normalize to dicts; the
+  pprof-style profile tables (sampleType/sample/locationTable/
+  functionTable/stringTable...) pass through structurally with
+  nanosecond fields coerced to ints.
+
+Every decode_* returns ``(payload_dict, record_count)``; every
+encode_* is its inverse, and round trips preserve span/resource/sample
+fidelity (tests/test_otlp_signals.py).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------- AnyValue
+
+def any_value_to_py(v: dict) -> Any:
+    if not isinstance(v, dict):
+        return v
+    if "stringValue" in v:
+        return v["stringValue"]
+    if "intValue" in v:
+        return int(v["intValue"])
+    if "doubleValue" in v:
+        return float(v["doubleValue"])
+    if "boolValue" in v:
+        return bool(v["boolValue"])
+    if "arrayValue" in v:
+        return [any_value_to_py(x)
+                for x in v["arrayValue"].get("values", [])]
+    if "kvlistValue" in v:
+        return kvlist_to_dict(v["kvlistValue"].get("values", []))
+    if "bytesValue" in v:
+        try:
+            return base64.b64decode(v["bytesValue"])
+        except (ValueError, TypeError):
+            return v["bytesValue"]
+    return None
+
+
+def kvlist_to_dict(kvs: List[dict]) -> Dict[str, Any]:
+    return {kv.get("key", ""): any_value_to_py(kv.get("value", {}))
+            for kv in kvs}
+
+
+def py_to_any_value(v: Any) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    if isinstance(v, (list, tuple)):
+        return {"arrayValue": {"values": [py_to_any_value(x) for x in v]}}
+    if isinstance(v, dict):
+        return {"kvlistValue": {"values": dict_to_kvlist(v)}}
+    if isinstance(v, bytes):
+        # proto3 JSON mapping: bytes fields are base64 text
+        return {"bytesValue": base64.b64encode(v).decode("ascii")}
+    return {"stringValue": str(v)}
+
+
+def dict_to_kvlist(d: Dict[str, Any]) -> List[dict]:
+    return [{"key": k, "value": py_to_any_value(v)} for k, v in d.items()]
+
+
+def _id_bytes(hex_or_b64: Optional[str]) -> bytes:
+    """OTLP/JSON trace & span ids are hex per the protocol JSON mapping;
+    tolerate base64 (some SDKs emit proto3-default encoding)."""
+    if not hex_or_b64:
+        return b""
+    try:
+        return bytes.fromhex(hex_or_b64)
+    except ValueError:
+        try:
+            return base64.b64decode(hex_or_b64)
+        except (ValueError, TypeError):
+            return b""
+
+
+def _id_hex(b) -> str:
+    if isinstance(b, bytes):
+        return b.hex()
+    return str(b or "")
+
+
+def _ns(v) -> int:
+    try:
+        return int(v or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+# ---------------------------------------------------------- traces
+
+def _decode_span(s: dict) -> dict:
+    out = {
+        "traceId": _id_bytes(s.get("traceId")),
+        "spanId": _id_bytes(s.get("spanId")),
+        "parentSpanId": _id_bytes(s.get("parentSpanId")),
+        "name": s.get("name", ""),
+        "kind": int(s.get("kind", 0) or 0),
+        "startTimeUnixNano": _ns(s.get("startTimeUnixNano")),
+        "endTimeUnixNano": _ns(s.get("endTimeUnixNano")),
+        "attributes": kvlist_to_dict(s.get("attributes", [])),
+    }
+    if s.get("traceState"):
+        out["traceState"] = s["traceState"]
+    if s.get("droppedAttributesCount"):
+        out["droppedAttributesCount"] = int(s["droppedAttributesCount"])
+    evs = [{
+        "timeUnixNano": _ns(e.get("timeUnixNano")),
+        "name": e.get("name", ""),
+        "attributes": kvlist_to_dict(e.get("attributes", [])),
+    } for e in s.get("events", [])]
+    if evs:
+        out["events"] = evs
+    links = [{
+        "traceId": _id_bytes(ln.get("traceId")),
+        "spanId": _id_bytes(ln.get("spanId")),
+        "attributes": kvlist_to_dict(ln.get("attributes", [])),
+    } for ln in s.get("links", [])]
+    if links:
+        out["links"] = links
+    st = s.get("status")
+    if st:
+        out["status"] = {"code": int(st.get("code", 0) or 0),
+                         "message": st.get("message", "")}
+    return out
+
+
+def _encode_span(s: dict) -> dict:
+    out = {
+        "traceId": _id_hex(s.get("traceId")),
+        "spanId": _id_hex(s.get("spanId")),
+        "name": s.get("name", ""),
+        "kind": int(s.get("kind", 0)),
+        "startTimeUnixNano": str(s.get("startTimeUnixNano", 0)),
+        "endTimeUnixNano": str(s.get("endTimeUnixNano", 0)),
+        "attributes": dict_to_kvlist(s.get("attributes", {})),
+    }
+    if s.get("parentSpanId"):
+        out["parentSpanId"] = _id_hex(s["parentSpanId"])
+    if s.get("traceState"):
+        out["traceState"] = s["traceState"]
+    if s.get("droppedAttributesCount"):
+        out["droppedAttributesCount"] = s["droppedAttributesCount"]
+    if s.get("events"):
+        out["events"] = [{
+            "timeUnixNano": str(e.get("timeUnixNano", 0)),
+            "name": e.get("name", ""),
+            "attributes": dict_to_kvlist(e.get("attributes", {})),
+        } for e in s["events"]]
+    if s.get("links"):
+        out["links"] = [{
+            "traceId": _id_hex(ln.get("traceId")),
+            "spanId": _id_hex(ln.get("spanId")),
+            "attributes": dict_to_kvlist(ln.get("attributes", {})),
+        } for ln in s["links"]]
+    if s.get("status"):
+        st = {}
+        if s["status"].get("code"):
+            st["code"] = s["status"]["code"]
+        if s["status"].get("message"):
+            st["message"] = s["status"]["message"]
+        out["status"] = st
+    return out
+
+
+def _scope_to_py(scope: dict) -> dict:
+    out = {"name": (scope or {}).get("name", ""),
+           "version": (scope or {}).get("version", "")}
+    attrs = kvlist_to_dict((scope or {}).get("attributes", []))
+    if attrs:
+        out["attributes"] = attrs
+    return out
+
+
+def _scope_to_otlp(scope: dict) -> dict:
+    out = {"name": scope.get("name", ""),
+           "version": scope.get("version", "")}
+    if scope.get("attributes"):
+        out["attributes"] = dict_to_kvlist(scope["attributes"])
+    return out
+
+
+def decode_otlp_traces(payload: dict) -> Tuple[dict, int]:
+    """ExportTraceServiceRequest JSON → typed payload + span count."""
+    rs_out = []
+    n = 0
+    for rs in payload.get("resourceSpans", []):
+        resource = kvlist_to_dict(
+            (rs.get("resource") or {}).get("attributes", []))
+        scopes = []
+        for ss in rs.get("scopeSpans", []):
+            spans = [_decode_span(s) for s in ss.get("spans", [])]
+            n += len(spans)
+            scopes.append({"scope": _scope_to_py(ss.get("scope")),
+                           "spans": spans})
+        rs_out.append({"resource": resource, "scopeSpans": scopes})
+    return {"resourceSpans": rs_out}, n
+
+
+def encode_otlp_traces(payloads: List[dict]) -> dict:
+    """Typed payload(s) → ExportTraceServiceRequest JSON."""
+    rs_out = []
+    for payload in payloads:
+        for rs in payload.get("resourceSpans", []):
+            rs_out.append({
+                "resource": {
+                    "attributes": dict_to_kvlist(rs.get("resource", {}))},
+                "scopeSpans": [{
+                    "scope": _scope_to_otlp(ss.get("scope", {})),
+                    "spans": [_encode_span(s)
+                              for s in ss.get("spans", [])],
+                } for ss in rs.get("scopeSpans", [])],
+            })
+    return {"resourceSpans": rs_out}
+
+
+def count_spans(payload: dict) -> int:
+    return sum(len(ss.get("spans", []))
+               for rs in payload.get("resourceSpans", [])
+               for ss in rs.get("scopeSpans", []))
+
+
+def is_traces_payload(obj) -> bool:
+    return isinstance(obj, dict) and "resourceSpans" in obj
+
+
+# ---------------------------------------------------------- metrics
+
+def decode_otlp_metrics(payload: dict) -> Tuple[List[dict], int]:
+    """ExportMetricsServiceRequest JSON → internal cmetrics-like
+    snapshots (core/metrics.py to_msgpack_obj shape), ONE PER RESOURCE
+    so multi-resource requests keep their attribution (each snapshot's
+    ``meta.resource`` travels with its metrics; metric chunks already
+    hold sequences of snapshots). Gauge, sum (→ counter), and histogram
+    instruments map; attributes become the label set."""
+    payloads: List[dict] = []
+    total = 0
+    for rm in payload.get("resourceMetrics", []):
+        resource = kvlist_to_dict(
+            (rm.get("resource") or {}).get("attributes", []))
+        metrics: List[dict] = []
+        meta: Dict[str, Any] = (
+            {"resource": resource} if resource else {})
+        for sm in rm.get("scopeMetrics", []):
+            for m in sm.get("metrics", []):
+                name = m.get("name", "")
+                desc = m.get("description", "")
+                if "gauge" in m or "sum" in m:
+                    kind = "gauge" if "gauge" in m else "counter"
+                    dps = (m.get("gauge") or m.get("sum") or {}).get(
+                        "dataPoints", [])
+                    label_keys: List[str] = []
+                    values = []
+                    for dp in dps:
+                        attrs = kvlist_to_dict(dp.get("attributes", []))
+                        for k in attrs:
+                            if k not in label_keys:
+                                label_keys.append(k)
+                        v = dp.get("asDouble")
+                        if v is None:
+                            v = int(dp.get("asInt", 0) or 0)
+                        values.append({
+                            "labels": [str(attrs.get(k, ""))
+                                       for k in label_keys],
+                            "value": v,
+                            "ts": _ns(dp.get("timeUnixNano")),
+                        })
+                    # re-pad label vectors (a later point may introduce
+                    # new keys)
+                    for val in values:
+                        val["labels"] += [""] * (len(label_keys)
+                                                 - len(val["labels"]))
+                    metrics.append({"name": name, "type": kind,
+                                    "desc": desc, "labels": label_keys,
+                                    "values": values})
+                elif "histogram" in m:
+                    dps = m["histogram"].get("dataPoints", [])
+                    label_keys = []
+                    hist = []
+                    buckets: List[float] = []
+                    for dp in dps:
+                        attrs = kvlist_to_dict(dp.get("attributes", []))
+                        for k in attrs:
+                            if k not in label_keys:
+                                label_keys.append(k)
+                        bounds = [float(b) for b in
+                                  dp.get("explicitBounds", [])]
+                        if bounds and not buckets:
+                            buckets = bounds
+                        counts = [int(c) for c in
+                                  dp.get("bucketCounts", [])]
+                        hist.append({
+                            "labels": [str(attrs.get(k, ""))
+                                       for k in label_keys],
+                            "counts": counts,
+                            "sum": float(dp.get("sum", 0.0) or 0.0),
+                        })
+                    for h in hist:
+                        h["labels"] += [""] * (len(label_keys)
+                                               - len(h["labels"]))
+                    metrics.append({"name": name, "type": "histogram",
+                                    "desc": desc, "labels": label_keys,
+                                    "buckets": buckets, "values": [],
+                                    "hist": hist})
+        if metrics:
+            total += sum(len(m.get("values", [])) + len(m.get("hist", []))
+                         for m in metrics)
+            payloads.append({"meta": meta, "metrics": metrics})
+    return payloads, total
+
+
+def encode_otlp_metrics(payloads: List[dict]) -> dict:
+    """Internal snapshot(s) → ExportMetricsServiceRequest JSON — one
+    resourceMetrics entry per snapshot, so each keeps its own resource
+    attribution."""
+    rm_out = []
+    for payload in payloads:
+        otlp_metrics: List[dict] = []
+        meta = payload.get("meta") or {}
+        resource = meta.get("resource", {}) if isinstance(meta, dict) \
+            else {}
+        for m in payload.get("metrics", []):
+            name = m.get("name", "")
+            kind = m.get("type", "counter")
+            keys = m.get("labels", [])
+            entry: Dict[str, Any] = {"name": name,
+                                     "description": m.get("desc", "")}
+            if kind == "histogram":
+                dps = []
+                for h in m.get("hist", []):
+                    dps.append({
+                        "attributes": dict_to_kvlist(
+                            dict(zip(keys, h.get("labels", [])))),
+                        "bucketCounts": [str(c) for c in
+                                         h.get("counts", [])],
+                        "explicitBounds": list(m.get("buckets", [])),
+                        "sum": h.get("sum", 0.0),
+                        "count": str(sum(h.get("counts", []))),
+                    })
+                entry["histogram"] = {
+                    "dataPoints": dps, "aggregationTemporality": 2}
+            else:
+                dps = []
+                for val in m.get("values", []):
+                    dp: Dict[str, Any] = {
+                        "attributes": dict_to_kvlist(
+                            dict(zip(keys, val.get("labels", [])))),
+                    }
+                    v = val.get("value", 0)
+                    if isinstance(v, float) and not v.is_integer():
+                        dp["asDouble"] = v
+                    else:
+                        dp["asInt"] = str(int(v))
+                    if val.get("ts"):
+                        dp["timeUnixNano"] = str(int(val["ts"]))
+                    dps.append(dp)
+                if kind == "counter":
+                    entry["sum"] = {"dataPoints": dps,
+                                    "aggregationTemporality": 2,
+                                    "isMonotonic": True}
+                else:
+                    entry["gauge"] = {"dataPoints": dps}
+            otlp_metrics.append(entry)
+        rm_out.append({
+            "resource": {"attributes": dict_to_kvlist(resource)},
+            "scopeMetrics": [{"scope": {"name": "fluentbit_tpu"},
+                              "metrics": otlp_metrics}],
+        })
+    return {"resourceMetrics": rm_out}
+
+
+# ---------------------------------------------------------- profiles
+
+_PROFILE_NS_FIELDS = ("timeNanos", "startTimeUnixNano",
+                      "endTimeUnixNano", "durationNanos", "timeUnixNano")
+
+
+def _normalize_profile(p: dict) -> dict:
+    out = dict(p)
+    for f in _PROFILE_NS_FIELDS:
+        if f in out:
+            out[f] = _ns(out[f])
+    if out.get("profileId"):
+        out["profileId"] = _id_bytes(out["profileId"]) or out["profileId"]
+    if isinstance(out.get("attributes"), list):
+        out["attributes"] = kvlist_to_dict(out["attributes"])
+    return out
+
+
+def _profile_to_otlp(p: dict) -> dict:
+    out = dict(p)
+    for f in _PROFILE_NS_FIELDS:
+        if f in out:
+            out[f] = str(out[f])
+    if isinstance(out.get("profileId"), bytes):
+        out["profileId"] = base64.b64encode(
+            out["profileId"]).decode("ascii")
+    if isinstance(out.get("attributes"), dict):
+        out["attributes"] = dict_to_kvlist(out["attributes"])
+    return out
+
+
+def decode_otlp_profiles(payload: dict) -> Tuple[dict, int]:
+    """ExportProfilesServiceRequest JSON (development/profiles signal)
+    → typed payload + profile count. Resource/scope attributes become
+    dicts; the pprof-style tables inside each profile pass through
+    structurally (the cprofiles approach: same model, C structs)."""
+    rp_out = []
+    n = 0
+    for rp in payload.get("resourceProfiles", []):
+        resource = kvlist_to_dict(
+            (rp.get("resource") or {}).get("attributes", []))
+        scopes = []
+        for sp in rp.get("scopeProfiles", []):
+            profiles = [_normalize_profile(p)
+                        for p in sp.get("profiles", [])]
+            n += len(profiles)
+            scopes.append({"scope": _scope_to_py(sp.get("scope")),
+                           "profiles": profiles})
+        rp_out.append({"resource": resource, "scopeProfiles": scopes})
+    return {"resourceProfiles": rp_out}, n
+
+
+def encode_otlp_profiles(payloads: List[dict]) -> dict:
+    rp_out = []
+    for payload in payloads:
+        for rp in payload.get("resourceProfiles", []):
+            rp_out.append({
+                "resource": {
+                    "attributes": dict_to_kvlist(rp.get("resource", {}))},
+                "scopeProfiles": [{
+                    "scope": _scope_to_otlp(sp.get("scope", {})),
+                    "profiles": [_profile_to_otlp(p)
+                                 for p in sp.get("profiles", [])],
+                } for sp in rp.get("scopeProfiles", [])],
+            })
+    return {"resourceProfiles": rp_out}
+
+
+def is_profiles_payload(obj) -> bool:
+    return isinstance(obj, dict) and "resourceProfiles" in obj

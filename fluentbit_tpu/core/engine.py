@@ -953,9 +953,11 @@ class Engine:
 
         def _fire():
             self._pending_retries.pop(key, None)
-            if self._stopping:
-                self._drop_retry(task, out)
-                return
+            # fire even while stopping: a retry coming due inside the
+            # grace window gets its attempt (the reference services
+            # retries until grace expires); if it RETRYs again,
+            # _register drops it, and the stop-sequence cleanup handles
+            # whatever is still pending when grace runs out
             self._spawn_flush(task, out)
 
         def _register():

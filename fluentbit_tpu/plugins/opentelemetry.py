@@ -1,16 +1,23 @@
-"""OpenTelemetry logs — OTLP/HTTP (JSON encoding) input + output.
+"""OpenTelemetry — OTLP/HTTP (JSON encoding) input + output, 4 signals.
 
 Reference: plugins/in_opentelemetry (OTLP server for
-logs/metrics/traces, opentelemetry.c) and plugins/out_opentelemetry
-(4640 LoC OTLP export). This build speaks the OTLP/HTTP **JSON**
-encoding for the logs signal on ``/v1/logs`` (the protobuf binary
-encoding and the metrics/traces signals are gated — no protoc-generated
-schemas are vendored; OTLP/JSON is a standard encoding per the
-OpenTelemetry protocol spec).
+logs/metrics/traces/profiles, opentelemetry.c) and
+plugins/out_opentelemetry (4640 LoC OTLP export for all four signals).
+This build speaks the OTLP/HTTP **JSON** encoding (a standard encoding
+per the OpenTelemetry protocol spec; the protobuf binary encoding is
+gated — no protoc-generated schemas are vendored) on:
 
-Mapping: each logRecord → one pipeline record; resource + scope
-attributes land in the event metadata under ``otlp`` so group identity
-survives round trips; ``timeUnixNano`` ↔ the event timestamp;
+- ``/v1/logs``    → log events (V2 records with otlp metadata)
+- ``/v1/traces``  → typed traces payloads (codec.telemetry, the
+  ctraces-equivalent model), event_type "traces" chunks
+- ``/v1/metrics`` → the internal cmetrics-like snapshot, event_type
+  "metrics" chunks (every metrics-capable output consumes them)
+- ``/v1/development/profiles`` (and ``/v1/profiles``) → typed profiles
+  payloads (cprofiles equivalent), event_type "profiles" chunks
+
+Mapping for logs: each logRecord → one pipeline record; resource +
+scope attributes land in the event metadata under ``otlp`` so group
+identity survives round trips; ``timeUnixNano`` ↔ the event timestamp;
 ``body.stringValue`` → ``{"message": ...}``, kvlist bodies merge as
 fields.
 """
@@ -21,68 +28,20 @@ import json
 import logging
 from typing import Any, Dict, List, Optional
 
+from ..codec.chunk import (EVENT_TYPE_LOGS, EVENT_TYPE_METRICS,
+                           EVENT_TYPE_PROFILES, EVENT_TYPE_TRACES)
 from ..codec.events import encode_event, iter_events
-from ..codec.msgpack import EventTime
+from ..codec.msgpack import EventTime, packb
+from ..codec.telemetry import (any_value_to_py, decode_otlp_metrics,
+                               decode_otlp_profiles, decode_otlp_traces,
+                               dict_to_kvlist, encode_otlp_metrics,
+                               encode_otlp_profiles, encode_otlp_traces,
+                               is_profiles_payload, is_traces_payload,
+                               kvlist_to_dict, py_to_any_value)
 from ..core.config import ConfigMapEntry
 from ..core.plugin import InputPlugin, registry
 
 log = logging.getLogger("flb.otlp")
-
-
-# ---------------------------------------------------------- value mapping
-
-def any_value_to_py(v: dict) -> Any:
-    if not isinstance(v, dict):
-        return v
-    if "stringValue" in v:
-        return v["stringValue"]
-    if "intValue" in v:
-        return int(v["intValue"])
-    if "doubleValue" in v:
-        return float(v["doubleValue"])
-    if "boolValue" in v:
-        return bool(v["boolValue"])
-    if "arrayValue" in v:
-        return [any_value_to_py(x)
-                for x in v["arrayValue"].get("values", [])]
-    if "kvlistValue" in v:
-        return kvlist_to_dict(v["kvlistValue"].get("values", []))
-    if "bytesValue" in v:
-        import base64
-
-        try:
-            return base64.b64decode(v["bytesValue"])
-        except (ValueError, TypeError):
-            return v["bytesValue"]
-    return None
-
-
-def kvlist_to_dict(kvs: List[dict]) -> Dict[str, Any]:
-    return {kv.get("key", ""): any_value_to_py(kv.get("value", {}))
-            for kv in kvs}
-
-
-def py_to_any_value(v: Any) -> dict:
-    if isinstance(v, bool):
-        return {"boolValue": v}
-    if isinstance(v, int):
-        return {"intValue": str(v)}
-    if isinstance(v, float):
-        return {"doubleValue": v}
-    if isinstance(v, (list, tuple)):
-        return {"arrayValue": {"values": [py_to_any_value(x) for x in v]}}
-    if isinstance(v, dict):
-        return {"kvlistValue": {"values": dict_to_kvlist(v)}}
-    if isinstance(v, bytes):
-        import base64
-
-        # proto3 JSON mapping: bytes fields are base64 text
-        return {"bytesValue": base64.b64encode(v).decode("ascii")}
-    return {"stringValue": str(v)}
-
-
-def dict_to_kvlist(d: Dict[str, Any]) -> List[dict]:
-    return [{"key": k, "value": py_to_any_value(v)} for k, v in d.items()]
 
 
 SEVERITIES = {1: "trace", 5: "debug", 9: "info", 13: "warn", 17: "error",
@@ -159,10 +118,19 @@ def encode_otlp_logs(events, tag: str) -> dict:
     ]}
 
 
+_SIGNAL_PATHS = {
+    "/v1/logs": "logs",
+    "/v1/traces": "traces",
+    "/v1/metrics": "metrics",
+    "/v1/profiles": "profiles",
+    "/v1/development/profiles": "profiles",
+}
+
+
 @registry.register
 class OpentelemetryInput(InputPlugin):
     name = "opentelemetry"
-    description = "OTLP/HTTP server (logs signal, JSON encoding)"
+    description = "OTLP/HTTP server (logs/traces/metrics/profiles, JSON)"
     server_task_needed = True
     config_map = [
         ConfigMapEntry("listen", "str", default="0.0.0.0"),
@@ -172,6 +140,43 @@ class OpentelemetryInput(InputPlugin):
 
     def init(self, instance, engine) -> None:
         self.bound_port: Optional[int] = None
+
+    def _ingest(self, engine, signal: str, payload: dict, tag: str) -> None:
+        if signal == "logs":
+            records = decode_otlp_logs(payload)
+            from ..codec.events import now_event_time
+
+            buf = bytearray()
+            for ts_ns, rec_body, meta in records:
+                # no timestamp on the record → receive time
+                # (the reference server's fallback)
+                ts = (EventTime(ts_ns // 10**9, ts_ns % 10**9)
+                      if ts_ns else now_event_time())
+                buf += encode_event(rec_body, ts, meta)
+            if records:
+                engine.input_log_append(
+                    self.instance, tag, bytes(buf), len(records)
+                )
+            return
+        if signal == "metrics":
+            snaps, n = decode_otlp_metrics(payload)
+            if n:
+                engine.input_event_append(
+                    self.instance, tag,
+                    b"".join(packb(s) for s in snaps),
+                    EVENT_TYPE_METRICS, n_records=n,
+                )
+            return
+        if signal == "traces":
+            typed, n = decode_otlp_traces(payload)
+            etype = EVENT_TYPE_TRACES
+        else:
+            typed, n = decode_otlp_profiles(payload)
+            etype = EVENT_TYPE_PROFILES
+        if n:
+            engine.input_event_append(
+                self.instance, tag, packb(typed), etype, n_records=n
+            )
 
     async def start_server(self, engine) -> None:
         import asyncio
@@ -187,7 +192,8 @@ class OpentelemetryInput(InputPlugin):
                         break
                     method, uri, headers, body = req
                     path = uri.split("?")[0]
-                    if method != "POST" or path not in ("/v1/logs",):
+                    signal = _SIGNAL_PATHS.get(path)
+                    if method != "POST" or signal is None:
                         code = 404 if method == "POST" else 400
                         writer.write(http_response(code, b"{}",
                                                    "application/json"))
@@ -195,29 +201,18 @@ class OpentelemetryInput(InputPlugin):
                         continue
                     try:
                         payload = json.loads(body)
-                        records = decode_otlp_logs(payload)
+                        tag = path.strip("/").replace("/", ".") \
+                            if self.tag_from_uri else self.instance.tag
+                        self._ingest(engine, signal, payload, tag)
                     except Exception:
                         # any structurally invalid payload is the
                         # client's error: answer 400, keep the conn
+                        log.debug("otlp %s decode failed", signal,
+                                  exc_info=True)
                         writer.write(http_response(400, b"{}",
                                                    "application/json"))
                         await writer.drain()
                         continue
-                    tag = "v1.logs" if self.tag_from_uri else \
-                        self.instance.tag
-                    from ..codec.events import now_event_time
-
-                    buf = bytearray()
-                    for ts_ns, rec_body, meta in records:
-                        # no timestamp on the record → receive time
-                        # (the reference server's fallback)
-                        ts = (EventTime(ts_ns // 10**9, ts_ns % 10**9)
-                              if ts_ns else now_event_time())
-                        buf += encode_event(rec_body, ts, meta)
-                    if records:
-                        engine.input_log_append(
-                            self.instance, tag, bytes(buf), len(records)
-                        )
                     writer.write(http_response(
                         200, b'{"partialSuccess":{}}', "application/json"))
                     await writer.drain()
@@ -228,8 +223,6 @@ class OpentelemetryInput(InputPlugin):
                     writer.close()
                 except Exception:
                     pass
-
-        import asyncio
 
         server = await asyncio.start_server(
             handle, self.listen, self.port,
@@ -245,15 +238,27 @@ from .outputs_http_based import _HttpDeliveryOutput
 
 @registry.register
 class OpentelemetryOutput(_HttpDeliveryOutput):
-    """Shares the HTTP delivery base (TLS, timeouts, 408/429 retry
-    classification — OTLP backpressure must RETRY, not drop)."""
+    """OTLP/HTTP exporter for all four signals. Shares the HTTP
+    delivery base (TLS, timeouts, 408/429 retry classification — OTLP
+    backpressure must RETRY, not drop). Each chunk carries one
+    event_type, and the payload shape is self-describing (typed traces
+    payloads hold resourceSpans, metrics snapshots hold a metrics list,
+    profiles hold resourceProfiles), so the flush routes to the
+    matching signal URI — the reference's per-signal endpoints
+    (out_opentelemetry logs/metrics/traces/profiles_uri options)."""
 
     name = "opentelemetry"
-    description = "OTLP/HTTP exporter (logs signal, JSON encoding)"
+    description = "OTLP/HTTP exporter (logs/traces/metrics/profiles)"
+    event_types = (EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES,
+                   EVENT_TYPE_PROFILES)
     config_map = [
         ConfigMapEntry("host", "str", default="127.0.0.1"),
         ConfigMapEntry("port", "int", default=4318),
         ConfigMapEntry("logs_uri", "str", default="/v1/logs"),
+        ConfigMapEntry("traces_uri", "str", default="/v1/traces"),
+        ConfigMapEntry("metrics_uri", "str", default="/v1/metrics"),
+        ConfigMapEntry("profiles_uri", "str",
+                       default="/v1/development/profiles"),
         ConfigMapEntry("header", "slist", multiple=True, slist_max_split=1),
     ]
 
@@ -268,8 +273,47 @@ class OpentelemetryOutput(_HttpDeliveryOutput):
                 out.append(f"{parts[0]}: {parts[1]}")
         return out
 
+    def _classify(self, data: bytes):
+        """(signal, payload list) from a chunk's self-describing bytes."""
+        from ..codec.msgpack import Unpacker
+        from ..core.metrics import is_metrics_payload
+
+        try:
+            objs = list(Unpacker(data))
+        except Exception:
+            return "logs", None
+        if objs and all(is_traces_payload(o) for o in objs):
+            return "traces", objs
+        if objs and all(is_profiles_payload(o) for o in objs):
+            return "profiles", objs
+        if objs and all(is_metrics_payload(o) for o in objs):
+            return "metrics", objs
+        return "logs", None
+
+    def _encode(self, signal: str, objs, data: bytes, tag: str) -> bytes:
+        if signal == "traces":
+            body = encode_otlp_traces(objs)
+        elif signal == "profiles":
+            body = encode_otlp_profiles(objs)
+        elif signal == "metrics":
+            body = encode_otlp_metrics(objs)
+        else:
+            body = encode_otlp_logs(list(iter_events(data)), tag)
+        return json.dumps(body, separators=(",", ":"),
+                          default=str).encode()
+
     def format(self, data: bytes, tag: str) -> bytes:
-        return json.dumps(
-            encode_otlp_logs(list(iter_events(data)), tag),
-            separators=(",", ":"), default=str,
-        ).encode()
+        """Wire payload for the chunk (test-formatter unit)."""
+        signal, objs = self._classify(data)
+        return self._encode(signal, objs, data, tag)
+
+    async def flush(self, data: bytes, tag: str, engine):
+        # classify ONCE; the unpacked objects feed the encoder directly
+        signal, objs = self._classify(data)
+        uri = {
+            "traces": self.traces_uri,
+            "metrics": self.metrics_uri,
+            "profiles": self.profiles_uri,
+        }.get(signal, self.logs_uri)
+        return await self._post(self._encode(signal, objs, data, tag),
+                                uri=uri)

@@ -57,11 +57,12 @@ class _HttpDeliveryOutput(OutputPlugin):
     IO_TIMEOUT = 30.0
 
     async def _post(self, body: bytes,
-                    extra_headers: Optional[List[str]] = None) -> FlushResult:
+                    extra_headers: Optional[List[str]] = None,
+                    uri: Optional[str] = None) -> FlushResult:
         # per-request headers are passed in, never stashed on the
         # instance: concurrent flushes must not see each other's auth
         headers = [
-            f"POST {self._uri()} HTTP/1.1",
+            f"POST {uri or self._uri()} HTTP/1.1",
             f"Host: {self.host}:{self.port}",
             f"Content-Length: {len(body)}",
             f"Content-Type: {self._content_type()}",
