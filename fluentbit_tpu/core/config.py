@@ -195,6 +195,7 @@ CORE_INSTANCE_KEYS = {
     "mem_buf_limit", "storage.type", "storage.pause_on_chunks_overlimit",
     "threaded", "workers", "retry_limit", "no_multiplex", "host", "port", "tls",
     "tls.verify", "tls.ca_file", "tls.crt_file", "tls.key_file", "tls.vhost",
+    "http2",  # HTTP-based outputs: prior-knowledge h2c delivery
 }
 
 

@@ -1,0 +1,572 @@
+"""HTTP/2 (h2c, prior knowledge) — frames + HPACK + client/server.
+
+Reference: src/flb_http_client_http2.c (nghttp2-based client used by
+~30 outputs) and the HTTP/2 side of plugins/in_http. This build
+implements the protocol directly (no nghttp2 to vendor): RFC 7540
+framing (SETTINGS/HEADERS/CONTINUATION/DATA/WINDOW_UPDATE/PING/
+RST_STREAM/GOAWAY) and RFC 7541 HPACK — full static table, dynamic
+table with eviction, integer/string primitives, and Huffman DECODING
+(clients like curl Huffman-encode header values; our encoder emits
+plain literals, which is always spec-valid).
+
+Scope: cleartext prior-knowledge h2c as the reference uses it for
+backend links — one request per stream, client streams odd-numbered,
+flow-control windows kept open with generous WINDOW_UPDATEs. TLS ALPN
+h2 works with the same engine when the caller supplies a TLS transport.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Dict, List, Optional, Tuple
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+# frame types (RFC 7540 §6)
+DATA, HEADERS, PRIORITY, RST_STREAM, SETTINGS, PUSH_PROMISE, PING, \
+    GOAWAY, WINDOW_UPDATE, CONTINUATION = range(10)
+
+FLAG_END_STREAM = 0x1
+FLAG_END_HEADERS = 0x4
+FLAG_PADDED = 0x8
+FLAG_PRIORITY = 0x20
+FLAG_ACK = 0x1
+
+# ---------------------------------------------------------------- HPACK
+
+STATIC_TABLE: List[Tuple[str, str]] = [
+    (":authority", ""), (":method", "GET"), (":method", "POST"),
+    (":path", "/"), (":path", "/index.html"), (":scheme", "http"),
+    (":scheme", "https"), (":status", "200"), (":status", "204"),
+    (":status", "206"), (":status", "304"), (":status", "400"),
+    (":status", "404"), (":status", "500"), ("accept-charset", ""),
+    ("accept-encoding", "gzip, deflate"), ("accept-language", ""),
+    ("accept-ranges", ""), ("accept", ""), ("access-control-allow-origin",
+    ""), ("age", ""), ("allow", ""), ("authorization", ""),
+    ("cache-control", ""), ("content-disposition", ""),
+    ("content-encoding", ""), ("content-language", ""),
+    ("content-length", ""), ("content-location", ""),
+    ("content-range", ""), ("content-type", ""), ("cookie", ""),
+    ("date", ""), ("etag", ""), ("expect", ""), ("expires", ""),
+    ("from", ""), ("host", ""), ("if-match", ""),
+    ("if-modified-since", ""), ("if-none-match", ""), ("if-range", ""),
+    ("if-unmodified-since", ""), ("last-modified", ""), ("link", ""),
+    ("location", ""), ("max-forwards", ""), ("proxy-authenticate", ""),
+    ("proxy-authorization", ""), ("range", ""), ("referer", ""),
+    ("refresh", ""), ("retry-after", ""), ("server", ""),
+    ("set-cookie", ""), ("strict-transport-security", ""),
+    ("transfer-encoding", ""), ("user-agent", ""), ("vary", ""),
+    ("via", ""), ("www-authenticate", ""),
+]
+
+# RFC 7541 appendix B: (code, bit length) for symbols 0..256 (256 = EOS)
+_HUFF = [
+    (0x1ff8, 13), (0x7fffd8, 23), (0xfffffe2, 28), (0xfffffe3, 28),
+    (0xfffffe4, 28), (0xfffffe5, 28), (0xfffffe6, 28), (0xfffffe7, 28),
+    (0xfffffe8, 28), (0xffffea, 24), (0x3ffffffc, 30), (0xfffffe9, 28),
+    (0xfffffea, 28), (0x3ffffffd, 30), (0xfffffeb, 28), (0xfffffec, 28),
+    (0xfffffed, 28), (0xfffffee, 28), (0xfffffef, 28), (0xffffff0, 28),
+    (0xffffff1, 28), (0xffffff2, 28), (0x3ffffffe, 30), (0xffffff3, 28),
+    (0xffffff4, 28), (0xffffff5, 28), (0xffffff6, 28), (0xffffff7, 28),
+    (0xffffff8, 28), (0xffffff9, 28), (0xffffffa, 28), (0xffffffb, 28),
+    (0x14, 6), (0x3f8, 10), (0x3f9, 10), (0xffa, 12), (0x1ff9, 13),
+    (0x15, 6), (0xf8, 8), (0x7fa, 11), (0x3fa, 10), (0x3fb, 10),
+    (0xf9, 8), (0x7fb, 11), (0xfa, 8), (0x16, 6), (0x17, 6), (0x18, 6),
+    (0x0, 5), (0x1, 5), (0x2, 5), (0x19, 6), (0x1a, 6), (0x1b, 6),
+    (0x1c, 6), (0x1d, 6), (0x1e, 6), (0x1f, 6), (0x5c, 7), (0xfb, 8),
+    (0x7ffc, 15), (0x20, 6), (0xffb, 12), (0x3fc, 10), (0x1ffa, 13),
+    (0x21, 6), (0x5d, 7), (0x5e, 7), (0x5f, 7), (0x60, 7), (0x61, 7),
+    (0x62, 7), (0x63, 7), (0x64, 7), (0x65, 7), (0x66, 7), (0x67, 7),
+    (0x68, 7), (0x69, 7), (0x6a, 7), (0x6b, 7), (0x6c, 7), (0x6d, 7),
+    (0x6e, 7), (0x6f, 7), (0x70, 7), (0x71, 7), (0x72, 7), (0xfc, 8),
+    (0x73, 7), (0xfd, 8), (0x1ffb, 13), (0x7fff0, 19), (0x1ffc, 13),
+    (0x3ffc, 14), (0x22, 6), (0x7ffd, 15), (0x3, 5), (0x23, 6),
+    (0x4, 5), (0x24, 6), (0x5, 5), (0x25, 6), (0x26, 6), (0x27, 6),
+    (0x6, 5), (0x74, 7), (0x75, 7), (0x28, 6), (0x29, 6), (0x2a, 6),
+    (0x7, 5), (0x2b, 6), (0x76, 7), (0x2c, 6), (0x8, 5), (0x9, 5),
+    (0x2d, 6), (0x77, 7), (0x78, 7), (0x79, 7), (0x7a, 7), (0x7b, 7),
+    (0x7ffe, 15), (0x7fc, 11), (0x3ffd, 14), (0x1ffd, 13),
+    (0xffffffc, 28), (0xfffe6, 20), (0x3fffd2, 22), (0xfffe7, 20),
+    (0xfffe8, 20), (0x3fffd3, 22), (0x3fffd4, 22), (0x3fffd5, 22),
+    (0x7fffd9, 23), (0x3fffd6, 22), (0x7fffda, 23), (0x7fffdb, 23),
+    (0x7fffdc, 23), (0x7fffdd, 23), (0x7fffde, 23), (0xffffeb, 24),
+    (0x7fffdf, 23), (0xffffec, 24), (0xffffed, 24), (0x3fffd7, 22),
+    (0x7fffe0, 23), (0xffffee, 24), (0x7fffe1, 23), (0x7fffe2, 23),
+    (0x7fffe3, 23), (0x7fffe4, 23), (0x1fffdc, 21), (0x3fffd8, 22),
+    (0x7fffe5, 23), (0x3fffd9, 22), (0x7fffe6, 23), (0x7fffe7, 23),
+    (0xffffef, 24), (0x3fffda, 22), (0x1fffdd, 21), (0xfffe9, 20),
+    (0x3fffdb, 22), (0x3fffdc, 22), (0x7fffe8, 23), (0x7fffe9, 23),
+    (0x1fffde, 21), (0x7fffea, 23), (0x3fffdd, 22), (0x3fffde, 22),
+    (0xfffff0, 24), (0x1fffdf, 21), (0x3fffdf, 22), (0x7fffeb, 23),
+    (0x7fffec, 23), (0x1fffe0, 21), (0x1fffe1, 21), (0x3fffe0, 22),
+    (0x1fffe2, 21), (0x7fffed, 23), (0x3fffe1, 22), (0x7fffee, 23),
+    (0x7fffef, 23), (0xfffea, 20), (0x3fffe2, 22), (0x3fffe3, 22),
+    (0x3fffe4, 22), (0x7ffff0, 23), (0x3fffe5, 22), (0x3fffe6, 22),
+    (0x7ffff1, 23), (0x3ffffe0, 26), (0x3ffffe1, 26), (0xfffeb, 20),
+    (0x7fff1, 19), (0x3fffe7, 22), (0x7ffff2, 23), (0x3fffe8, 22),
+    (0x1ffffec, 25), (0x3ffffe2, 26), (0x3ffffe3, 26), (0x3ffffe4, 26),
+    (0x7ffffde, 27), (0x7ffffdf, 27), (0x3ffffe5, 26), (0xfffff1, 24),
+    (0x1ffffed, 25), (0x7fff2, 19), (0x1fffe3, 21), (0x3ffffe6, 26),
+    (0x7ffffe0, 27), (0x7ffffe1, 27), (0x3ffffe7, 26), (0x7ffffe2, 27),
+    (0xfffff2, 24), (0x1fffe4, 21), (0x1fffe5, 21), (0x3ffffe8, 26),
+    (0x3ffffe9, 26), (0xffffffd, 28), (0x7ffffe3, 27), (0x7ffffe4, 27),
+    (0x7ffffe5, 27), (0xfffec, 20), (0xfffff3, 24), (0xfffed, 20),
+    (0x1fffe6, 21), (0x3fffe9, 22), (0x1fffe7, 21), (0x1fffe8, 21),
+    (0x7ffff3, 23), (0x3fffea, 22), (0x3fffeb, 22), (0x1ffffee, 25),
+    (0x1ffffef, 25), (0xfffff4, 24), (0xfffff5, 24), (0x3ffffea, 26),
+    (0x7ffff4, 23), (0x3ffffeb, 26), (0x7ffffe6, 27), (0x3ffffec, 26),
+    (0x3ffffed, 26), (0x7ffffe7, 27), (0x7ffffe8, 27), (0x7ffffe9, 27),
+    (0x7ffffea, 27), (0x7ffffeb, 27), (0xffffffe, 28), (0x7ffffec, 27),
+    (0x7ffffed, 27), (0x7ffffee, 27), (0x7ffffef, 27), (0x7fffff0, 27),
+    (0x3ffffee, 26), (0x3fffffff, 30),
+]
+
+_huff_decode_map: Dict[Tuple[int, int], int] = {
+    (code, bits): sym for sym, (code, bits) in enumerate(_HUFF)
+}
+
+
+def huffman_decode(data: bytes) -> bytes:
+    out = bytearray()
+    code = 0
+    bits = 0
+    for byte in data:
+        for i in range(7, -1, -1):
+            code = (code << 1) | ((byte >> i) & 1)
+            bits += 1
+            sym = _huff_decode_map.get((code, bits))
+            if sym is not None:
+                if sym == 256:
+                    raise ValueError("EOS in huffman stream")
+                out.append(sym)
+                code = 0
+                bits = 0
+    # trailing bits must be a prefix of EOS (all ones), <= 7 bits
+    if bits > 7 or code != (1 << bits) - 1:
+        raise ValueError("bad huffman padding")
+    return bytes(out)
+
+
+def _int_encode(value: int, prefix_bits: int, first_byte: int = 0) -> bytes:
+    limit = (1 << prefix_bits) - 1
+    if value < limit:
+        return bytes([first_byte | value])
+    out = bytearray([first_byte | limit])
+    value -= limit
+    while value >= 128:
+        out.append((value % 128) + 128)
+        value //= 128
+    out.append(value)
+    return bytes(out)
+
+
+def _int_decode(data: bytes, pos: int, prefix_bits: int) -> Tuple[int, int]:
+    limit = (1 << prefix_bits) - 1
+    value = data[pos] & limit
+    pos += 1
+    if value < limit:
+        return value, pos
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated hpack integer")
+        b = data[pos]
+        pos += 1
+        value += (b & 0x7F) << shift
+        shift += 7
+        if not (b & 0x80):
+            return value, pos
+        if shift > 63:
+            raise ValueError("hpack integer overflow")
+
+
+def _str_decode(data: bytes, pos: int) -> Tuple[str, int]:
+    huff = bool(data[pos] & 0x80)
+    length, pos = _int_decode(data, pos, 7)
+    raw = data[pos:pos + length]
+    if len(raw) != length:
+        raise ValueError("truncated hpack string")
+    pos += length
+    if huff:
+        raw = huffman_decode(raw)
+    return raw.decode("utf-8", "replace"), pos
+
+
+def _str_encode(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    return _int_encode(len(raw), 7) + raw
+
+
+class HpackCodec:
+    """One direction's HPACK context (decoder or encoder dynamic table)."""
+
+    def __init__(self, max_size: int = 4096):
+        self.max_size = max_size
+        self.dynamic: List[Tuple[str, str]] = []
+        self.size = 0
+
+    def _entry_size(self, name: str, value: str) -> int:
+        return len(name.encode()) + len(value.encode()) + 32
+
+    def _add(self, name: str, value: str) -> None:
+        self.dynamic.insert(0, (name, value))
+        self.size += self._entry_size(name, value)
+        while self.size > self.max_size and self.dynamic:
+            n, v = self.dynamic.pop()
+            self.size -= self._entry_size(n, v)
+
+    def _lookup(self, index: int) -> Tuple[str, str]:
+        if index <= 0:
+            raise ValueError("hpack index 0")
+        if index <= len(STATIC_TABLE):
+            return STATIC_TABLE[index - 1]
+        d = index - len(STATIC_TABLE) - 1
+        if d >= len(self.dynamic):
+            raise ValueError("hpack index out of range")
+        return self.dynamic[d]
+
+    def decode(self, data: bytes) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        pos = 0
+        while pos < len(data):
+            b = data[pos]
+            if b & 0x80:  # indexed
+                index, pos = _int_decode(data, pos, 7)
+                out.append(self._lookup(index))
+            elif b & 0x40:  # literal with incremental indexing
+                index, pos = _int_decode(data, pos, 6)
+                name = self._lookup(index)[0] if index else None
+                if name is None:
+                    name, pos = _str_decode(data, pos)
+                value, pos = _str_decode(data, pos)
+                self._add(name, value)
+                out.append((name, value))
+            elif b & 0x20:  # dynamic table size update
+                size, pos = _int_decode(data, pos, 5)
+                self.max_size = size
+                while self.size > self.max_size and self.dynamic:
+                    n, v = self.dynamic.pop()
+                    self.size -= self._entry_size(n, v)
+            else:  # literal without indexing / never indexed (4-bit)
+                index, pos = _int_decode(data, pos, 4)
+                name = self._lookup(index)[0] if index else None
+                if name is None:
+                    name, pos = _str_decode(data, pos)
+                value, pos = _str_decode(data, pos)
+                out.append((name, value))
+        return out
+
+    def encode(self, headers: List[Tuple[str, str]]) -> bytes:
+        out = bytearray()
+        for name, value in headers:
+            name = name.lower()
+            idx = None
+            name_idx = None
+            for i, (n, v) in enumerate(STATIC_TABLE, 1):
+                if n == name:
+                    if v == value:
+                        idx = i
+                        break
+                    if name_idx is None:
+                        name_idx = i
+            if idx is not None:
+                out += _int_encode(idx, 7, 0x80)
+            elif name_idx is not None:
+                # literal without indexing, indexed name
+                out += _int_encode(name_idx, 4, 0x00)
+                out += _str_encode(value)
+            else:
+                out += _int_encode(0, 4, 0x00)
+                out += _str_encode(name)
+                out += _str_encode(value)
+        return bytes(out)
+
+
+# ---------------------------------------------------------------- frames
+
+def frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
+    return struct.pack("!I", len(payload))[1:] + bytes(
+        [ftype, flags]) + struct.pack("!I", stream_id & 0x7FFFFFFF) + payload
+
+
+async def read_frame(reader) -> Tuple[int, int, int, bytes]:
+    head = await reader.readexactly(9)
+    length = (head[0] << 16) | (head[1] << 8) | head[2]
+    ftype, flags = head[3], head[4]
+    stream_id = struct.unpack("!I", head[5:9])[0] & 0x7FFFFFFF
+    payload = await reader.readexactly(length) if length else b""
+    return ftype, flags, stream_id, payload
+
+
+def settings_frame(ack: bool = False, initial_window: int = 1 << 24,
+                   max_frame: int = 16384) -> bytes:
+    if ack:
+        return frame(SETTINGS, FLAG_ACK, 0, b"")
+    payload = struct.pack("!HI", 0x4, initial_window)  # INITIAL_WINDOW_SIZE
+    payload += struct.pack("!HI", 0x5, max_frame)      # MAX_FRAME_SIZE
+    return frame(SETTINGS, 0, 0, payload)
+
+
+def strip_padding(flags: int, payload: bytes) -> bytes:
+    if flags & FLAG_PADDED:
+        if not payload:
+            raise ValueError("padded frame with empty payload")
+        pad = payload[0]
+        payload = payload[1:]
+        if pad:
+            if pad > len(payload):
+                raise ValueError("padding exceeds payload")
+            payload = payload[:-pad]
+    return payload
+
+
+def parse_settings(payload: bytes) -> Dict[int, int]:
+    out = {}
+    for off in range(0, len(payload) - 5, 6):
+        ident, value = struct.unpack("!HI", payload[off:off + 6])
+        out[ident] = value
+    return out
+
+
+# ---------------------------------------------------------------- client
+
+class Http2Client:
+    """Prior-knowledge h2c client over an asyncio transport; one
+    request at a time (streams 1, 3, 5, ... on one connection).
+    Respects the peer's send windows (RFC 7540 §5.2): DATA waits for
+    WINDOW_UPDATE when the 65535-byte default (or whatever the server's
+    SETTINGS granted) is exhausted — compliant servers GOAWAY on
+    overflow."""
+
+    def __init__(self, reader, writer, scheme: str = "http"):
+        self.reader = reader
+        self.writer = writer
+        self.scheme = scheme
+        self.encoder = HpackCodec()
+        self.decoder = HpackCodec()
+        self.next_stream = 1
+        self._started = False
+        self.conn_window = 65535
+        self.peer_initial_window = 65535
+        self.peer_max_frame = 16384
+
+    async def _start(self) -> None:
+        self.writer.write(PREFACE + settings_frame())
+        await self.writer.drain()
+        self._started = True
+
+    async def request(self, method: str, authority: str, path: str,
+                      headers: List[Tuple[str, str]],
+                      body: bytes = b"",
+                      timeout: float = 30.0) -> Tuple[int, bytes]:
+        """Send one request, wait for the full response:
+        (status, body)."""
+        if not self._started:
+            await self._start()
+        sid = self.next_stream
+        self.next_stream += 2
+        hdrs = [(":method", method), (":scheme", self.scheme),
+                (":authority", authority), (":path", path)] + \
+            [(k.lower(), v) for k, v in headers]
+        block = self.encoder.encode(hdrs)
+        flags = FLAG_END_HEADERS | (0 if body else FLAG_END_STREAM)
+        self.writer.write(frame(HEADERS, flags, sid, block))
+        await self.writer.drain()
+
+        state = {
+            "status": 0, "resp": bytearray(), "hdr": bytearray(),
+            "got_headers": False, "done": False,
+            "stream_window": self.peer_initial_window,
+            "off": 0,
+        }
+
+        async def _pump():
+            # interleave window-bounded sends with frame processing
+            # until the response completes
+            while not state["done"]:
+                while (state["off"] < len(body)
+                       and min(state["stream_window"],
+                               self.conn_window) > 0):
+                    n = min(self.peer_max_frame,
+                            len(body) - state["off"],
+                            state["stream_window"], self.conn_window)
+                    chunk = body[state["off"]:state["off"] + n]
+                    state["off"] += n
+                    state["stream_window"] -= n
+                    self.conn_window -= n
+                    end = state["off"] >= len(body)
+                    self.writer.write(frame(
+                        DATA, FLAG_END_STREAM if end else 0, sid, chunk))
+                    await self.writer.drain()
+                await self._process_one(sid, state)
+
+        await asyncio.wait_for(_pump(), timeout)
+        if not state["got_headers"]:
+            raise ConnectionError("no response headers")
+        return state["status"], bytes(state["resp"])
+
+    async def _process_one(self, sid: int, state: dict) -> None:
+        ftype, fl, rsid, payload = await read_frame(self.reader)
+        if ftype == SETTINGS:
+            if not (fl & FLAG_ACK):
+                settings = parse_settings(payload)
+                if 0x4 in settings:  # INITIAL_WINDOW_SIZE
+                    delta = settings[0x4] - self.peer_initial_window
+                    self.peer_initial_window = settings[0x4]
+                    state["stream_window"] += delta
+                if 0x5 in settings:  # MAX_FRAME_SIZE
+                    self.peer_max_frame = max(16384, settings[0x5])
+                self.writer.write(settings_frame(ack=True))
+                await self.writer.drain()
+        elif ftype == PING and not (fl & FLAG_ACK):
+            self.writer.write(frame(PING, FLAG_ACK, 0, payload))
+            await self.writer.drain()
+        elif ftype == WINDOW_UPDATE:
+            incr = struct.unpack("!I", payload[:4])[0] & 0x7FFFFFFF
+            if rsid == 0:
+                self.conn_window += incr
+            elif rsid == sid:
+                state["stream_window"] += incr
+        elif ftype in (HEADERS, CONTINUATION) and rsid == sid:
+            state["hdr"].extend(strip_padding(fl, payload)
+                                if ftype == HEADERS else payload)
+            if fl & FLAG_END_HEADERS:
+                for k, v in self.decoder.decode(bytes(state["hdr"])):
+                    if k == ":status":
+                        try:
+                            state["status"] = int(v)
+                        except ValueError:
+                            raise ConnectionError(
+                                f"bad :status {v!r}")
+                state["got_headers"] = True
+            if fl & FLAG_END_STREAM:
+                state["done"] = True
+        elif ftype == DATA and rsid == sid:
+            state["resp"].extend(strip_padding(fl, payload))
+            # keep receive windows open
+            upd = struct.pack("!I", 1 << 20)
+            self.writer.write(frame(WINDOW_UPDATE, 0, 0, upd)
+                              + frame(WINDOW_UPDATE, 0, sid, upd))
+            await self.writer.drain()
+            if fl & FLAG_END_STREAM:
+                state["done"] = True
+        elif ftype == RST_STREAM and rsid == sid:
+            raise ConnectionError("stream reset")
+        elif ftype == GOAWAY:
+            raise ConnectionError("goaway")
+
+    def close(self) -> None:
+        try:
+            self.writer.write(frame(GOAWAY, 0, 0, struct.pack("!II", 0, 0)))
+            self.writer.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------- server
+
+async def serve_h2c(reader, writer, handler, preface_consumed=False):
+    """Serve one h2c connection: for each request stream, call
+    ``await handler(method, path, headers_dict, body) -> (status,
+    body_bytes, content_type)``. The caller detects the connection
+    preface (``PREFACE``) and hands the socket over."""
+    if not preface_consumed:
+        got = await reader.readexactly(len(PREFACE))
+        if got != PREFACE:
+            raise ConnectionError("bad h2c preface")
+    decoder = HpackCodec()
+    encoder = HpackCodec()
+    writer.write(settings_frame())
+    await writer.drain()
+    streams: Dict[int, dict] = {}
+
+    async def finish(sid: int) -> None:
+        st = streams.pop(sid, None)
+        if st is None:
+            return
+        headers = dict(st["headers"])
+        method = headers.get(":method", "GET")
+        path = headers.get(":path", "/")
+        try:
+            status, body, ctype = await handler(
+                method, path, headers, bytes(st["body"]))
+        except Exception:
+            status, body, ctype = 500, b"", "text/plain"
+        hdrs = [(":status", str(status)),
+                ("content-type", ctype),
+                ("content-length", str(len(body)))]
+        block = encoder.encode(hdrs)
+        writer.write(frame(HEADERS, FLAG_END_HEADERS
+                           | (0 if body else FLAG_END_STREAM), sid, block))
+        if body:
+            writer.write(frame(DATA, FLAG_END_STREAM, sid, body))
+        await writer.drain()
+
+    while True:
+        try:
+            ftype, flags, sid, payload = await read_frame(reader)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+        if ftype == SETTINGS:
+            if not (flags & FLAG_ACK):
+                writer.write(settings_frame(ack=True))
+                await writer.drain()
+        elif ftype == PING:
+            if not (flags & FLAG_ACK):
+                writer.write(frame(PING, FLAG_ACK, 0, payload))
+                await writer.drain()
+        elif ftype == HEADERS:
+            data = strip_padding(flags, payload)
+            if flags & FLAG_PRIORITY:
+                data = data[5:]
+            st = streams.setdefault(sid, {"headers": [], "body":
+                                          bytearray(), "hdr": bytearray()})
+            st["hdr"].extend(data)
+            if flags & FLAG_END_HEADERS:
+                st["headers"] = decoder.decode(bytes(st["hdr"]))
+                st["hdr"].clear()
+            if flags & FLAG_END_STREAM:
+                await finish(sid)
+        elif ftype == CONTINUATION:
+            st = streams.get(sid)
+            if st is not None:
+                st["hdr"].extend(payload)
+                if flags & FLAG_END_HEADERS:
+                    st["headers"] = decoder.decode(bytes(st["hdr"]))
+                    st["hdr"].clear()
+                if flags & FLAG_END_STREAM:
+                    await finish(sid)
+        elif ftype == DATA:
+            st = streams.get(sid)
+            if st is not None:
+                st["body"].extend(strip_padding(flags, payload))
+                upd = struct.pack("!I", 1 << 20)
+                writer.write(frame(WINDOW_UPDATE, 0, 0, upd)
+                             + frame(WINDOW_UPDATE, 0, sid, upd))
+                await writer.drain()
+                if flags & FLAG_END_STREAM:
+                    await finish(sid)
+        elif ftype == RST_STREAM:
+            streams.pop(sid, None)
+        elif ftype == GOAWAY:
+            return
+        # PRIORITY / PUSH_PROMISE / unknown types: ignored (spec allows)
+
+
+def grpc_wrap(message: bytes, compressed: bool = False) -> bytes:
+    """gRPC length-prefixed message framing (the transport layer of
+    OTLP/gRPC; the protobuf message encoding itself is gated — no
+    protoc schemas are vendored, see plugins/gated.py rationale)."""
+    return bytes([1 if compressed else 0]) + struct.pack(
+        "!I", len(message)) + message
+
+
+def grpc_unwrap(data: bytes) -> List[bytes]:
+    out = []
+    pos = 0
+    while pos + 5 <= len(data):
+        length = struct.unpack("!I", data[pos + 1:pos + 5])[0]
+        out.append(data[pos + 5:pos + 5 + length])
+        pos += 5 + length
+    return out

@@ -216,9 +216,15 @@ class OutputInstance(Instance):
         # test hooks (reference: flb_output_set_test / test_formatter mode,
         # src/flb_engine_dispatch.c:101-137)
         self.test_formatter: Optional[Callable] = None
+        self.http2 = False  # prior-knowledge h2c delivery
 
     def configure(self) -> None:
         super().configure()
+        from .config import parse_bool
+
+        # fail fast on a bad value (config_map-typed options do the
+        # same); an invalid bool must not surface per-flush
+        self.http2 = parse_bool(self.properties.get("http2", False))
         rl = self.properties.get("retry_limit")
         if rl is not None:
             if str(rl).lower() in ("no_limits", "false", "no_retries_forever", "unlimited"):

@@ -31,6 +31,10 @@ def _props(ins):
     }
 
 
+def tls_enabled(ins) -> bool:
+    return bool(_props(ins)["on"])
+
+
 def client_context(ins) -> Optional[ssl.SSLContext]:
     """Upstream TLS (flb_tls_create for outputs)."""
     p = _props(ins)
@@ -43,6 +47,10 @@ def client_context(ins) -> Optional[ssl.SSLContext]:
         ctx.verify_mode = ssl.CERT_NONE
     if p["crt_file"]:
         ctx.load_cert_chain(p["crt_file"], p["key_file"])
+    # an h2 output must negotiate the protocol via ALPN — without it a
+    # TLS server assumes HTTP/1.1 and rejects the binary h2 preamble
+    if getattr(ins, "http2", False):
+        ctx.set_alpn_protocols(["h2"])
     return ctx
 
 

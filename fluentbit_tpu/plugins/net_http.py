@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+from struct import error as struct_error
 from typing import Dict, List, Optional, Tuple
 
 from ..codec.events import encode_event, now_event_time
@@ -121,6 +122,17 @@ class HttpServerInputBase(InputPlugin):
     async def start_server(self, engine) -> None:
         from ..core.tls import server_context
 
+        async def h2_handler(method, path, headers, body):
+            try:
+                status, resp = self.handle_request(
+                    engine, method, path.split("?")[0], headers, body)
+            except Exception:
+                log.exception("%s h2 request handler failed", self.name)
+                status, resp = 500, b"{}"
+            if method == "HEAD":
+                resp = b""  # RFC 9110: HEAD carries no body
+            return status, resp, self.content_type
+
         async def handle(reader, writer):
             try:
                 while True:
@@ -128,6 +140,27 @@ class HttpServerInputBase(InputPlugin):
                     if req is None:
                         break
                     method, uri, headers, body = req
+                    if method == "PRI" and uri == "*":
+                        # h2c prior-knowledge preface: its first line
+                        # parses as a request; consume the trailing
+                        # "SM\r\n\r\n" and switch the connection to the
+                        # HTTP/2 engine (reference in_http speaks both
+                        # via nghttp2 upgrade detection)
+                        rest = await reader.readexactly(6)
+                        if rest != b"SM\r\n\r\n":
+                            break
+                        from ..core.http2 import serve_h2c
+
+                        try:
+                            await serve_h2c(reader, writer, h2_handler,
+                                            preface_consumed=True)
+                        except (ValueError, IndexError, struct_error):
+                            # malformed frames/HPACK from the client:
+                            # drop the connection like a bad HTTP/1
+                            # request, never an unhandled task error
+                            log.debug("h2c connection error",
+                                      exc_info=True)
+                        break
                     try:
                         status, resp = self.handle_request(
                             engine, method, uri.split("?")[0], headers,

@@ -56,9 +56,59 @@ class _HttpDeliveryOutput(OutputPlugin):
     CONNECT_TIMEOUT = 10.0  # net.connect_timeout default (flb_upstream)
     IO_TIMEOUT = 30.0
 
+    def _use_http2(self) -> bool:
+        """`http2 on` switches delivery to prior-knowledge h2c
+        (reference: flb_http_client_http2.c is selected the same way
+        via the client's protocol flags); parsed once at configure."""
+        return bool(getattr(self.instance, "http2", False))
+
+    async def _post_h2(self, body: bytes,
+                       extra_headers: Optional[List[str]],
+                       uri: Optional[str]) -> FlushResult:
+        from ..core.http2 import Http2Client
+        from ..core.tls import open_connection, tls_enabled
+
+        writer = None
+        try:
+            reader, writer = await open_connection(
+                self.instance, self.host, self.port,
+                timeout=self.CONNECT_TIMEOUT,
+            )
+            scheme = "https" if tls_enabled(self.instance) else "http"
+            client = Http2Client(reader, writer, scheme=scheme)
+            headers = [("content-type", self._content_type())]
+            for h in self._headers() + (extra_headers or []):
+                if ":" in h:
+                    k, v = h.split(":", 1)
+                    headers.append((k.strip().lower(), v.strip()))
+            status, _resp = await client.request(
+                "POST", f"{self.host}:{self.port}",
+                uri or self._uri(), headers, body,
+                timeout=self.IO_TIMEOUT,
+            )
+        except (OSError, ConnectionError, ValueError, IndexError,
+                asyncio.TimeoutError, asyncio.IncompleteReadError):
+            # ValueError/IndexError: malformed HPACK/frames from the
+            # peer — transient server misbehavior, retryable like the
+            # HTTP/1 path's parse failures
+            return FlushResult.RETRY
+        finally:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+        if 200 <= status < 300:
+            return FlushResult.OK
+        if status >= 500 or status in (408, 429):
+            return FlushResult.RETRY
+        return FlushResult.ERROR
+
     async def _post(self, body: bytes,
                     extra_headers: Optional[List[str]] = None,
                     uri: Optional[str] = None) -> FlushResult:
+        if self._use_http2():
+            return await self._post_h2(body, extra_headers, uri)
         # per-request headers are passed in, never stashed on the
         # instance: concurrent flushes must not see each other's auth
         headers = [

@@ -1,0 +1,225 @@
+"""HTTP/2 (h2c) — HPACK, framing, in_http server, OTLP h2 export.
+
+Reference: src/flb_http_client_http2.c (nghttp2 client) and in_http's
+HTTP/2 support. Done-criteria: in_http accepts an HTTP/2 POST;
+out_opentelemetry speaks h2c to a test server.
+"""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.core.http2 import (PREFACE, Http2Client, HpackCodec,
+                                      _HUFF, grpc_unwrap, grpc_wrap,
+                                      huffman_decode, serve_h2c)
+
+
+def _huffman_encode(data: bytes) -> bytes:
+    """Test-side encoder (the codec itself only decodes)."""
+    bits = 0
+    nbits = 0
+    out = bytearray()
+    for b in data:
+        code, length = _HUFF[b]
+        bits = (bits << length) | code
+        nbits += length
+        while nbits >= 8:
+            out.append((bits >> (nbits - 8)) & 0xFF)
+            nbits -= 8
+    if nbits:
+        out.append(((bits << (8 - nbits)) | ((1 << (8 - nbits)) - 1))
+                   & 0xFF)
+    return bytes(out)
+
+
+def test_hpack_round_trip_and_dynamic_table():
+    enc = HpackCodec()
+    dec = HpackCodec()
+    headers = [(":method", "POST"), (":path", "/v1/logs"),
+               ("content-type", "application/json"),
+               ("x-custom", "abc123"), ("authorization", "Bearer tok")]
+    block = enc.encode(headers)
+    assert dec.decode(block) == [(k.lower(), v) for k, v in headers]
+    # second block reuses the decoder state without corruption
+    block2 = enc.encode(headers)
+    assert dec.decode(block2) == [(k.lower(), v) for k, v in headers]
+
+
+def test_hpack_huffman_decode():
+    for s in (b"www.example.com", b"/custom/path?q=1",
+              b"no-cache", bytes(range(32, 127))):
+        assert huffman_decode(_huffman_encode(s)) == s
+    # huffman-coded literal header (as curl sends): flag bit 0x80 set
+    val = _huffman_encode(b"hello-world")
+    block = bytes([0x00]) + bytes([0x01]) + b"x" \
+        + bytes([0x80 | len(val)]) + val
+    assert HpackCodec().decode(block) == [("x", "hello-world")]
+
+
+def test_grpc_framing():
+    msgs = [b"abc", b"", b"x" * 1000]
+    data = b"".join(grpc_wrap(m) for m in msgs)
+    assert grpc_unwrap(data) == msgs
+
+
+def _h2_post(port, path, body, content_type="application/json"):
+    """Blocking helper: one h2c POST from a worker thread."""
+    result = {}
+
+    async def run():
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        client = Http2Client(reader, writer)
+        status, resp = await client.request(
+            "POST", f"127.0.0.1:{port}", path,
+            [("content-type", content_type)], body, timeout=10)
+        result["status"] = status
+        result["resp"] = resp
+        client.close()
+
+    asyncio.run(run())
+    return result
+
+
+def test_in_http_accepts_http2_post():
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("http", listen="127.0.0.1", port="0")
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        plugin = ctx.engine.inputs[0].plugin
+        deadline = time.time() + 5
+        while plugin.bound_port is None and time.time() < deadline:
+            time.sleep(0.02)
+        res = _h2_post(plugin.bound_port, "/app.log",
+                       json.dumps({"k": "v", "n": 7}).encode())
+        assert res["status"] == 201
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    assert got and got[0].body == {"k": "v", "n": 7}
+    # HTTP/1.1 on the same listener still works after the h2 upgrade path
+    ctx2 = flb.create(flush="50ms", grace="1")
+    ctx2.input("http", listen="127.0.0.1", port="0")
+    got2 = []
+    ctx2.output("lib", match="*",
+                callback=lambda d, tag: got2.extend(decode_events(d)))
+    ctx2.start()
+    try:
+        plugin = ctx2.engine.inputs[0].plugin
+        deadline = time.time() + 5
+        while plugin.bound_port is None and time.time() < deadline:
+            time.sleep(0.02)
+        body = b'{"a": 1}'
+        with socket.create_connection(("127.0.0.1", plugin.bound_port),
+                                      timeout=5) as s:
+            s.sendall(b"POST /t HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+            assert b" 201 " in s.recv(1024)
+        deadline = time.time() + 5
+        while not got2 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx2.stop()
+    assert got2 and got2[0].body == {"a": 1}
+
+
+class _H2TestServer:
+    """Minimal h2c collector server running on its own thread."""
+
+    def __init__(self):
+        self.requests = []
+        self.port = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._loop = None
+
+    def start(self):
+        self._thread.start()
+        deadline = time.time() + 5
+        while self.port is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert self.port is not None
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    def _run(self):
+        async def handler(method, path, headers, body):
+            self.requests.append((method, path, body))
+            return 200, b"{}", "application/json"
+
+        async def on_conn(reader, writer):
+            try:
+                await serve_h2c(reader, writer, handler)
+            except Exception:
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        async def main():
+            server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+            self.port = server.sockets[0].getsockname()[1]
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(main())
+        self._loop.run_forever()
+
+
+def test_out_opentelemetry_speaks_h2c():
+    srv = _H2TestServer()
+    srv.start()
+    try:
+        ctx = flb.create(flush="50ms", grace="1")
+        in_ffd = ctx.input("lib")
+        ctx.output("opentelemetry", match="*", host="127.0.0.1",
+                   port=str(srv.port), http2="on")
+        ctx.start()
+        try:
+            ctx.push(in_ffd, '{"message": "over h2"}')
+            deadline = time.time() + 8
+            while not srv.requests and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            ctx.stop()
+    finally:
+        srv.stop()
+    assert srv.requests, "h2c server never saw the OTLP export"
+    method, path, body = srv.requests[0]
+    assert method == "POST" and path == "/v1/logs"
+    wire = json.loads(body)
+    rec = wire["resourceLogs"][0]["scopeLogs"][0]["logRecords"][0]
+    assert rec["body"]["stringValue"] == "over h2"
+
+
+def test_h2_large_body_flow_control():
+    """A body well past the 65535-byte default send window must deliver
+    intact — the client waits for WINDOW_UPDATEs instead of blasting
+    past the peer's window (RFC 7540 §5.2)."""
+    srv = _H2TestServer()
+    srv.start()
+    try:
+        big = json.dumps({"data": "x" * 300_000}).encode()
+        res = _h2_post(srv.port, "/big", big)
+        assert res["status"] == 200
+        deadline = time.time() + 5
+        while not srv.requests and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        srv.stop()
+    method, path, body = srv.requests[0]
+    assert path == "/big" and body == big
