@@ -32,4 +32,7 @@ from . import in_servers_extra  # noqa: F401
 from . import enrichment_extra  # noqa: F401
 from . import inputs_net_extra  # noqa: F401
 from . import inputs_exporters  # noqa: F401
+from . import in_kubernetes_events  # noqa: F401
+from . import out_websocket  # noqa: F401
+from . import out_pgsql  # noqa: F401
 from . import gated  # noqa: F401

@@ -210,23 +210,46 @@ class OtelEnvelopeProcessor(ProcessorPlugin):
         return out
 
 
+def _gf2_rank(rows: List[int]) -> int:
+    """Rank over GF(2) of a bit-matrix (rows as Python ints)."""
+    rank = 0
+    pivots: List[int] = []
+    for row in rows:
+        for p in pivots:
+            low = p & -p
+            if row & low:
+                row ^= p
+        if row:
+            pivots.append(row)
+            rank += 1
+    return rank
+
+
 @registry.register
 class TdaProcessor(ProcessorPlugin):
     """plugins/processor_tda: sliding-window topological signal. The
     reference computes Betti 0/1/2 with the vendored C++ ripser
-    (src/ripser/flb_ripser_wrapper.cpp); here Betti-0 at ``epsilon`` is
-    computed EXACTLY (union-find over the Vietoris–Rips 1-skeleton);
-    higher Betti numbers are not emitted (no persistent-homology
-    engine is vendored — gated, not approximated)."""
+    (src/ripser/flb_ripser_wrapper.cpp:39-45; tda.c:735-757). Here the
+    Vietoris–Rips complex at ``epsilon`` is built exactly up to its
+    2-skeleton: Betti-0 by union-find over the edge set, Betti-1 by the
+    Euler/boundary identity β1 = E − V + β0 − rank(∂2) with the
+    triangle boundary rank computed over GF(2) — exact, because H1
+    depends only on the 2-skeleton. Betti-2 would need the 3-skeleton
+    (documented divergence: not emitted; the reference's ripser does
+    compute it). A triangle-count guard keeps pathological windows from
+    stalling ingest — when it trips, only Betti-0 is stamped."""
 
     name = "tda"
-    description = "sliding-window Betti-0 anomaly signal"
+    description = "sliding-window Betti-0/1 anomaly signal"
     config_map = [
         ConfigMapEntry("fields", "clist",
                        desc="numeric fields forming the point cloud"),
         ConfigMapEntry("window_size", "int", default=32),
         ConfigMapEntry("epsilon", "double", default=1.0),
         ConfigMapEntry("output_key", "str", default="betti_0"),
+        ConfigMapEntry("output_key_b1", "str", default="betti_1"),
+        ConfigMapEntry("max_triangles", "int", default=20000,
+                       desc="β1 guard: beyond this, only β0 is emitted"),
     ]
 
     def init(self, instance, engine) -> None:
@@ -236,9 +259,11 @@ class TdaProcessor(ProcessorPlugin):
                      for f in self.fields]
         self._window: List[tuple] = []
 
-    def _betti0(self) -> int:
+    def _betti(self) -> tuple:
+        """(β0, β1 | None) of the VR complex at epsilon."""
         pts = self._window
         n = len(pts)
+        eps2 = float(self.epsilon) ** 2
         parent = list(range(n))
 
         def find(a):
@@ -247,13 +272,32 @@ class TdaProcessor(ProcessorPlugin):
                 a = parent[a]
             return a
 
-        eps2 = float(self.epsilon) ** 2
+        adj = [[False] * n for _ in range(n)]
+        edge_idx: dict = {}
         for i in range(n):
             for j in range(i + 1, n):
                 d2 = sum((x - y) ** 2 for x, y in zip(pts[i], pts[j]))
                 if d2 <= eps2:
+                    adj[i][j] = adj[j][i] = True
+                    edge_idx[(i, j)] = len(edge_idx)
                     parent[find(i)] = find(j)
-        return len({find(i) for i in range(n)})
+        b0 = len({find(i) for i in range(n)})
+        E = len(edge_idx)
+        # triangle boundary rows: each triangle flips its 3 edge bits
+        rows: List[int] = []
+        for i in range(n):
+            for j in range(i + 1, n):
+                if not adj[i][j]:
+                    continue
+                for k in range(j + 1, n):
+                    if adj[i][k] and adj[j][k]:
+                        rows.append((1 << edge_idx[(i, j)])
+                                    | (1 << edge_idx[(i, k)])
+                                    | (1 << edge_idx[(j, k)]))
+                        if len(rows) > self.max_triangles:
+                            return b0, None  # guard tripped
+        b1 = E - n + b0 - _gf2_rank(rows)
+        return b0, b1
 
     def process_logs(self, events: list, tag: str, engine) -> list:
         out = []
@@ -276,6 +320,9 @@ class TdaProcessor(ProcessorPlugin):
             if len(self._window) > self.window_size:
                 self._window.pop(0)
             body = dict(ev.body)
-            body[self.output_key] = self._betti0()
+            b0, b1 = self._betti()
+            body[self.output_key] = b0
+            if b1 is not None:
+                body[self.output_key_b1] = b1
             out.append(LogEvent(ev.timestamp, body, ev.metadata, raw=None))
         return out

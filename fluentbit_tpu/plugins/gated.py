@@ -49,4 +49,3 @@ _gate(OutputPlugin, "prometheus_remote_write",
       "snappy (the remote-write protobuf frame is snappy-compressed)")
 _gate(InputPlugin, "prometheus_remote_write", "snappy")
 _gate(InputPlugin, "mqtt", "an MQTT broker protocol stack")
-_gate(OutputPlugin, "websocket", "an RFC6455 websocket stack")

@@ -1,0 +1,209 @@
+"""in_kubernetes_events — ingest Kubernetes cluster Events.
+
+Reference: plugins/in_kubernetes_events (polls/watches the
+/api/v1/events endpoint with the pod service-account token, dedups by
+uid + resourceVersion, one record per Event object). This build polls
+the list endpoint on an interval over the shared HTTP client path
+(TLS + bearer token), tracks the highest resourceVersion, and emits
+each new Event as a structured record timestamped from
+lastTimestamp/eventTime.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+log = logging.getLogger("flb.k8s_events")
+
+
+def _event_ts(ev: dict):
+    """Best event timestamp: lastTimestamp | eventTime | firstTimestamp
+    (RFC3339) → EventTime; fall back to receive time."""
+    import calendar
+    import re
+
+    for key in ("lastTimestamp", "eventTime", "firstTimestamp"):
+        v = ev.get(key)
+        if not isinstance(v, str) or not v:
+            continue
+        m = re.match(
+            r"(\d{4})-(\d{2})-(\d{2})[Tt](\d{2}):(\d{2}):(\d{2})"
+            r"(?:\.(\d+))?(?:[Zz]|([+-]\d{2}):?(\d{2}))?", v)
+        if not m:
+            continue
+        y, mo, d, h, mi, s = (int(m.group(i)) for i in range(1, 7))
+        frac = m.group(7) or ""
+        nsec = int((frac + "000000000")[:9]) if frac else 0
+        epoch = calendar.timegm((y, mo, d, h, mi, s, 0, 0, 0))
+        if m.group(8) is not None:
+            # sign from the STRING: int("-00") == 0 would mis-sign a
+            # negative-zero-hour offset like -00:30
+            sign = -1 if m.group(8).startswith("-") else 1
+            offs = sign * (abs(int(m.group(8))) * 3600
+                           + int(m.group(9)) * 60)
+            epoch -= offs
+        from ..codec.msgpack import EventTime
+
+        return EventTime(epoch, nsec)
+    return now_event_time()
+
+
+@registry.register
+class KubernetesEventsInput(InputPlugin):
+    name = "kubernetes_events"
+    description = "Kubernetes cluster Events (API poll)"
+    config_map = [
+        ConfigMapEntry("kube_url", "str",
+                       default="https://kubernetes.default.svc"),
+        ConfigMapEntry("kube_token_file", "str",
+                       default="/var/run/secrets/kubernetes.io/"
+                               "serviceaccount/token"),
+        ConfigMapEntry("kube_namespace", "str", default="",
+                       desc="restrict to one namespace (default: all)"),
+        ConfigMapEntry("interval_sec", "time", default="5"),
+        ConfigMapEntry("kube_request_limit", "int", default=500),
+    ]
+
+    def init(self, instance, engine) -> None:
+        from urllib.parse import urlsplit
+
+        self.collect_interval = float(self.interval_sec or 5)
+        u = urlsplit(self.kube_url)
+        self._host = u.hostname or "kubernetes.default.svc"
+        self._port = u.port or (443 if u.scheme == "https" else 80)
+        if u.scheme == "https" and "tls" not in instance.properties:
+            instance.set("tls", "on")
+        self._token: Optional[str] = None
+        try:
+            with open(self.kube_token_file) as f:
+                self._token = f.read().strip()
+        except OSError:
+            pass  # token is optional against unauthenticated test APIs
+        # dedup state: uid → last seen resourceVersion
+        self._seen: Dict[str, str] = {}
+
+    def _path(self, continue_token: str = "") -> str:
+        base = (f"/api/v1/namespaces/{self.kube_namespace}/events"
+                if self.kube_namespace else "/api/v1/events")
+        path = f"{base}?limit={self.kube_request_limit}"
+        if continue_token:
+            from urllib.parse import quote
+
+            path += f"&continue={quote(continue_token)}"
+        return path
+
+    async def _fetch(self, continue_token: str = "") -> Optional[dict]:
+        from ..core.tls import open_connection
+
+        writer = None
+        try:
+            reader, writer = await open_connection(
+                self.instance, self._host, self._port, timeout=10.0)
+            headers = [f"GET {self._path(continue_token)} HTTP/1.1",
+                       f"Host: {self._host}",
+                       "Accept: application/json",
+                       "Connection: close"]
+            if self._token:
+                headers.append(f"Authorization: Bearer {self._token}")
+            writer.write(("\r\n".join(headers) + "\r\n\r\n").encode())
+            await writer.drain()
+            status_line = await asyncio.wait_for(reader.readline(), 15.0)
+            parts = status_line.split()
+            if len(parts) < 2 or parts[1] != b"200":
+                log.debug("kubernetes_events: status %r", status_line)
+                return None
+            length = None
+            chunked = False
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 15.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                low = line.lower()
+                if low.startswith(b"content-length:"):
+                    length = int(line.split(b":", 1)[1])
+                elif low.startswith(b"transfer-encoding:") and \
+                        b"chunked" in low:
+                    chunked = True
+            if chunked:
+                body = bytearray()
+                while True:
+                    size_line = await asyncio.wait_for(
+                        reader.readline(), 15.0)
+                    size = int(size_line.strip() or b"0", 16)
+                    if size == 0:
+                        break
+                    body += await asyncio.wait_for(
+                        reader.readexactly(size + 2), 15.0)
+                    del body[-2:]
+                body = bytes(body)
+            elif length is not None:
+                body = await asyncio.wait_for(
+                    reader.readexactly(length), 15.0)
+            else:
+                body = await asyncio.wait_for(reader.read(), 15.0)
+            return json.loads(body)
+        except (OSError, ConnectionError, ValueError,
+                asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+            log.debug("kubernetes_events: fetch failed: %r", e)
+            return None
+        finally:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+    def _emit(self, engine, events: List[dict]) -> None:
+        buf = bytearray()
+        n = 0
+        for ev in events:
+            meta = ev.get("metadata") or {}
+            uid = meta.get("uid") or meta.get("name") or ""
+            rv = str(meta.get("resourceVersion") or "")
+            if self._seen.get(uid) == rv:
+                continue
+            self._seen[uid] = rv
+            if len(self._seen) > 8192:  # bound the dedup table
+                for k in list(self._seen)[:4096]:
+                    del self._seen[k]
+            buf += encode_event(ev, _event_ts(ev))
+            n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(buf), n)
+
+    def collect(self, engine) -> None:
+        """Driven by the engine's collector; the fetch runs on the
+        engine loop when available, inline otherwise."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            task = asyncio.ensure_future(self._collect_async(engine))
+            # errors surface via the collector's exception logging
+            task.add_done_callback(lambda t: t.exception())
+        else:
+            asyncio.run(self._collect_async(engine))
+
+    async def _collect_async(self, engine) -> None:
+        """Fetch every page (the API caps a list at `limit` items and
+        hands back metadata.continue for the rest)."""
+        token = ""
+        for _page in range(64):  # hard bound against a looping server
+            payload = await self._fetch(token)
+            if not payload:
+                return
+            items = payload.get("items") or []
+            if items:
+                self._emit(engine, items)
+            token = (payload.get("metadata") or {}).get("continue") or ""
+            if not token:
+                return

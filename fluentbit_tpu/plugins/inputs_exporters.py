@@ -287,3 +287,103 @@ class CollectdInput(InputPlugin):
             await asyncio.Event().wait()
         finally:
             transport.close()
+
+
+@registry.register
+class ProcessExporterMetricsInput(InputPlugin):
+    """Reference: plugins/in_process_exporter_metrics (procfs scraper in
+    process_exporter conventions, grouped by comm name)."""
+
+    name = "process_exporter_metrics"
+    description = "per-process metrics from procfs (process_exporter)"
+    config_map = [
+        ConfigMapEntry("scrape_interval", "time", default="5"),
+        ConfigMapEntry("path.procfs", "str", default="/proc"),
+        ConfigMapEntry("process_include_pattern", "str", default=".*"),
+        ConfigMapEntry("process_exclude_pattern", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        import re
+
+        self.collect_interval = float(self.scrape_interval or 5)
+        self._inc = re.compile(self.process_include_pattern or ".*")
+        self._exc = (re.compile(self.process_exclude_pattern)
+                     if self.process_exclude_pattern else None)
+        self._clk = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") \
+            else 100
+        self._page = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") \
+            else 4096
+
+    def _scan(self):
+        """Aggregate per-comm: cpu seconds, rss/vsize, threads, fds,
+        process count."""
+        agg: dict = {}
+        for pid in os.listdir(self.path_procfs):
+            if not pid.isdigit():
+                continue
+            base = os.path.join(self.path_procfs, pid)
+            try:
+                with open(os.path.join(base, "stat")) as f:
+                    stat = f.read()
+                # comm may contain spaces/parens: fields after rparen
+                lp, rp = stat.index("("), stat.rindex(")")
+                comm = stat[lp + 1:rp]
+                fields = stat[rp + 2:].split()
+            except (OSError, ValueError):
+                continue  # process exited mid-scan
+            if not self._inc.search(comm) or (
+                    self._exc is not None and self._exc.search(comm)):
+                continue
+            utime, stime = int(fields[11]), int(fields[12])
+            threads = int(fields[17])
+            vsize = int(fields[20])
+            rss = int(fields[21]) * self._page
+            try:
+                fds = len(os.listdir(os.path.join(base, "fd")))
+            except OSError:
+                fds = 0
+            a = agg.setdefault(comm, [0.0, 0, 0, 0, 0, 0])
+            a[0] += (utime + stime) / self._clk
+            a[1] += rss
+            a[2] += vsize
+            a[3] += threads
+            a[4] += fds
+            a[5] += 1
+        return agg
+
+    def collect(self, engine) -> None:
+        try:
+            agg = self._scan()
+        except OSError as e:
+            log.debug("process_exporter: scan failed: %s", e)
+            return
+        if not agg:
+            return
+        keys = ("name",)
+        rows = sorted(agg.items())
+        entries = [
+            _counter("process_cpu_seconds_total",
+                     "CPU time per process name.",
+                     [((c,), a[0]) for c, a in rows], keys),
+            _gauge("process_resident_memory_bytes",
+                   "Resident memory per process name.",
+                   [((c,), a[1]) for c, a in rows], keys),
+            _gauge("process_virtual_memory_bytes",
+                   "Virtual memory per process name.",
+                   [((c,), a[2]) for c, a in rows], keys),
+            _gauge("process_num_threads",
+                   "Thread count per process name.",
+                   [((c,), a[3]) for c, a in rows], keys),
+            _gauge("process_open_fds",
+                   "Open file descriptors per process name.",
+                   [((c,), a[4]) for c, a in rows], keys),
+            _gauge("process_count",
+                   "Processes per name.",
+                   [((c,), a[5]) for c, a in rows], keys),
+        ]
+        payload = {"meta": {"ts": time.time()}, "metrics": entries}
+        engine.input_event_append(
+            self.instance, self.instance.tag, packb(payload),
+            EVENT_TYPE_METRICS, n_records=len(entries),
+        )

@@ -1,0 +1,181 @@
+"""out_pgsql — insert records into PostgreSQL.
+
+Reference: plugins/out_pgsql (libpq-based; inserts (tag, time, data
+jsonb) rows). No libpq in this image, so this speaks the PostgreSQL
+frontend/backend protocol v3 directly over asyncio: StartupMessage,
+AuthenticationOk / cleartext / MD5 password, then simple-protocol
+Query with escaped literals — the same row shape the reference
+produces (timestamp, tag varchar, data jsonb).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import struct
+from typing import List, Optional, Tuple
+
+from ..codec.events import decode_events
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, OutputPlugin, registry
+from .outputs_http_based import _json_default
+
+log = logging.getLogger("flb.pgsql")
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+async def _read_msg(reader) -> Tuple[bytes, bytes]:
+    tag = await reader.readexactly(1)
+    (length,) = struct.unpack("!I", await reader.readexactly(4))
+    payload = await reader.readexactly(length - 4)
+    return tag, payload
+
+
+def _quote_literal(s: str) -> str:
+    """Single-quoted SQL literal (standard_conforming_strings on)."""
+    return "'" + s.replace("'", "''") + "'"
+
+
+class SqlError(Exception):
+    """Backend rejected the statement — the data is the problem, not
+    the connection; the chunk must ERROR (drop/DLQ), never retry."""
+
+
+@registry.register
+class PgsqlOutput(OutputPlugin):
+    name = "pgsql"
+    description = "PostgreSQL insert output (wire protocol v3)"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=5432),
+        ConfigMapEntry("user", "str", default="fluentbit"),
+        ConfigMapEntry("password", "str"),
+        ConfigMapEntry("database", "str", default="fluentbit"),
+        ConfigMapEntry("table", "str", default="fluentbit"),
+        ConfigMapEntry("timestamp_key", "str", default="date"),
+        ConfigMapEntry("create_table", "bool", default=True),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._reader = None
+        self._writer = None
+        self._created = False
+
+    async def _connect(self) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), 10.0)
+        params = _cstr("user") + _cstr(self.user) + \
+            _cstr("database") + _cstr(self.database) + b"\x00"
+        payload = struct.pack("!I", 196608) + params  # protocol 3.0
+        self._writer.write(struct.pack("!I", len(payload) + 4) + payload)
+        await self._writer.drain()
+        while True:
+            tag, body = await asyncio.wait_for(
+                _read_msg(self._reader), 10.0)
+            if tag == b"R":
+                (code,) = struct.unpack("!I", body[:4])
+                if code == 0:  # AuthenticationOk
+                    continue
+                if code == 3:  # cleartext password
+                    self._writer.write(_msg(
+                        b"p", _cstr(self.password or "")))
+                    await self._writer.drain()
+                    continue
+                if code == 5:  # MD5: md5(md5(pw + user) + salt)
+                    salt = body[4:8]
+                    inner = hashlib.md5(
+                        (self.password or "").encode()
+                        + self.user.encode()).hexdigest()
+                    outer = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._writer.write(_msg(b"p", _cstr("md5" + outer)))
+                    await self._writer.drain()
+                    continue
+                raise ConnectionError(f"unsupported auth method {code}")
+            if tag == b"E":
+                raise ConnectionError(
+                    f"pgsql error during startup: {body!r}")
+            if tag == b"Z":  # ReadyForQuery
+                return
+            # ParameterStatus / BackendKeyData / NoticeResponse: skip
+
+    async def _query(self, sql: str) -> None:
+        self._writer.write(_msg(b"Q", _cstr(sql)))
+        await self._writer.drain()
+        error = None
+        while True:
+            tag, body = await asyncio.wait_for(
+                _read_msg(self._reader), 30.0)
+            if tag == b"E":
+                error = body
+            elif tag == b"Z":
+                if error is not None:
+                    # the backend answered ReadyForQuery: the
+                    # connection is healthy, the STATEMENT failed
+                    raise SqlError(f"pgsql error: {error!r}")
+                return
+
+    def _rows_sql(self, data: bytes, tag: str) -> Optional[str]:
+        values = []
+        for ev in decode_events(data):
+            doc = json.dumps(ev.body, default=_json_default,
+                             separators=(",", ":"))
+            # PostgreSQL jsonb cannot store NUL code points
+            doc = doc.replace("\\u0000", "")
+            values.append(
+                f"(to_timestamp({ev.ts_float!r}), "
+                f"{_quote_literal(tag)}, "
+                f"{_quote_literal(doc)}::jsonb)")
+        if not values:
+            return None
+        table = self.table
+        return (f"INSERT INTO {table} (time, tag, data) VALUES "
+                + ", ".join(values) + ";")
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        sql = self._rows_sql(data, tag)
+        if sql is None:
+            return FlushResult.OK  # nothing decodable to insert
+        for attempt in (0, 1):  # one reconnect per flush
+            try:
+                if self._writer is None:
+                    await self._connect()
+                    if self.create_table and not self._created:
+                        await self._query(
+                            f"CREATE TABLE IF NOT EXISTS {self.table} "
+                            "(time timestamptz, tag varchar, "
+                            "data jsonb);")
+                        self._created = True
+                await self._query(sql)
+                return FlushResult.OK
+            except SqlError as e:
+                # poison data: drop/DLQ the chunk, keep the connection
+                log.error("pgsql: statement rejected: %s", e)
+                return FlushResult.ERROR
+            except (OSError, ConnectionError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, struct.error):
+                if self._writer is not None:
+                    try:
+                        self._writer.close()
+                    except Exception:
+                        pass
+                self._reader = self._writer = None
+        return FlushResult.RETRY
+
+    def exit(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.write(_msg(b"X", b""))  # Terminate
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None

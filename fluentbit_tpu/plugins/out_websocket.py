@@ -1,0 +1,175 @@
+"""out_websocket — deliver records over an RFC 6455 websocket.
+
+Reference: plugins/out_websocket (websocket.c): HTTP/1.1 upgrade
+handshake once per connection, then each flush's formatted payload goes
+out as one websocket message (text frames for json/json_lines formats,
+binary for msgpack), client-masked as the RFC requires. A failed
+send reconnects and retries the chunk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import logging
+import os
+import struct
+from typing import List, Optional
+
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, OutputPlugin, registry
+from .outputs_basic import format_json_lines
+
+log = logging.getLogger("flb.websocket")
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def ws_frame(opcode: int, payload: bytes, mask: bool = True) -> bytes:
+    """One FIN frame, client-masked (RFC 6455 §5.2-5.3)."""
+    head = bytearray([0x80 | opcode])
+    n = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if n < 126:
+        head.append(mask_bit | n)
+    elif n < (1 << 16):
+        head.append(mask_bit | 126)
+        head += struct.pack("!H", n)
+    else:
+        head.append(mask_bit | 127)
+        head += struct.pack("!Q", n)
+    if not mask:
+        return bytes(head) + payload
+    key = os.urandom(4)
+    head += key
+    masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return bytes(head) + masked
+
+
+def ws_accept_key(client_key: str) -> str:
+    return base64.b64encode(hashlib.sha1(
+        (client_key + _WS_GUID).encode()).digest()).decode()
+
+
+@registry.register
+class WebsocketOutput(OutputPlugin):
+    name = "websocket"
+    description = "websocket (RFC 6455) client output"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=80),
+        ConfigMapEntry("uri", "str", default="/"),
+        ConfigMapEntry("format", "str", default="msgpack",
+                       desc="msgpack | json | json_lines"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._reader = None
+        self._writer = None
+
+    async def _connect(self) -> None:
+        from ..core.tls import open_connection
+
+        reader, writer = await open_connection(
+            self.instance, self.host, self.port, timeout=10.0)
+        key = base64.b64encode(os.urandom(16)).decode()
+        writer.write((
+            f"GET {self.uri or '/'} HTTP/1.1\r\n"
+            f"Host: {self.host}:{self.port}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n"
+        ).encode())
+        await writer.drain()
+        status = await asyncio.wait_for(reader.readline(), 10.0)
+        if b" 101 " not in status:
+            writer.close()
+            raise ConnectionError(f"upgrade refused: {status!r}")
+        accept = None
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"sec-websocket-accept:"):
+                accept = line.split(b":", 1)[1].strip().decode()
+        if accept != ws_accept_key(key):
+            writer.close()
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+        self._reader, self._writer = reader, writer
+
+    def _payload(self, data: bytes, tag: str):
+        fmt = (self.format or "msgpack").lower()
+        if fmt == "json_lines":
+            return OP_TEXT, format_json_lines(data).encode()
+        if fmt == "json":
+            import json
+
+            from ..codec.events import decode_events
+            from .outputs_basic import _json_default
+
+            arr = [{"date": ev.ts_float, **ev.body}
+                   for ev in decode_events(data)]
+            return OP_TEXT, json.dumps(
+                arr, default=_json_default).encode()
+        return OP_BINARY, data  # msgpack passthrough
+
+    async def _service_incoming(self) -> None:
+        """Drain any server frames queued since the last flush: answer
+        Ping with Pong, honor Close (raises so the caller reconnects) —
+        a half-closed socket must not swallow the next chunk as 'OK'."""
+        while True:
+            try:
+                head = await asyncio.wait_for(
+                    self._reader.readexactly(2), 0.01)
+            except asyncio.TimeoutError:
+                return  # nothing pending
+            opcode = head[0] & 0x0F
+            n = head[1] & 0x7F
+            if n == 126:
+                n = struct.unpack(
+                    "!H", await self._reader.readexactly(2))[0]
+            elif n == 127:
+                n = struct.unpack(
+                    "!Q", await self._reader.readexactly(8))[0]
+            payload = await self._reader.readexactly(n) if n else b""
+            if opcode == OP_PING:
+                self._writer.write(ws_frame(OP_PONG, payload))
+                await self._writer.drain()
+            elif opcode == OP_CLOSE:
+                raise ConnectionError("server sent Close")
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        opcode, payload = self._payload(data, tag)
+        for attempt in (0, 1):  # one reconnect per flush
+            try:
+                if self._writer is None:
+                    await self._connect()
+                await self._service_incoming()
+                self._writer.write(ws_frame(opcode, payload))
+                await asyncio.wait_for(self._writer.drain(), 30.0)
+                return FlushResult.OK
+            except (OSError, ConnectionError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError):
+                if self._writer is not None:
+                    try:
+                        self._writer.close()
+                    except Exception:
+                        pass
+                self._reader = self._writer = None
+        return FlushResult.RETRY
+
+    def exit(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.write(ws_frame(OP_CLOSE, b""))
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None

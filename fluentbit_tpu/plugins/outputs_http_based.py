@@ -106,13 +106,14 @@ class _HttpDeliveryOutput(OutputPlugin):
 
     async def _post(self, body: bytes,
                     extra_headers: Optional[List[str]] = None,
-                    uri: Optional[str] = None) -> FlushResult:
+                    uri: Optional[str] = None, verb: str = "POST",
+                    ok_statuses: tuple = ()) -> FlushResult:
         if self._use_http2():
             return await self._post_h2(body, extra_headers, uri)
         # per-request headers are passed in, never stashed on the
         # instance: concurrent flushes must not see each other's auth
         headers = [
-            f"POST {uri or self._uri()} HTTP/1.1",
+            f"{verb} {uri or self._uri()} HTTP/1.1",
             f"Host: {self.host}:{self.port}",
             f"Content-Length: {len(body)}",
             f"Content-Type: {self._content_type()}",
@@ -139,7 +140,7 @@ class _HttpDeliveryOutput(OutputPlugin):
                     writer.close()
                 except Exception:
                     pass
-        if 200 <= status < 300:
+        if 200 <= status < 300 or status in ok_statuses:
             return FlushResult.OK
         if status >= 500 or status in (408, 429):
             return FlushResult.RETRY

@@ -8,6 +8,7 @@ import time
 import pytest
 
 import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import LogEvent
 from fluentbit_tpu.codec.events import decode_events, encode_event
 from fluentbit_tpu.core.plugin import registry
 
@@ -132,3 +133,58 @@ def test_tda_betti0_tracks_cluster_count():
     # non-numeric rows pass through untouched
     out3 = proc.plugin.process_logs([ev({"x": "nan?"})], "t", None)
     assert "betti_0" not in out3[0].body
+
+
+def test_tda_betti1_detects_a_loop():
+    """β1 = 1 for a 4-cycle with no chords (square of side 1, eps 1.2:
+    edges yes, diagonals no, no triangles); filling in a 5th center
+    point creates triangles that fill the loop → β1 = 0."""
+    from fluentbit_tpu.core.plugin import registry as reg
+
+    proc = reg.create_processor("tda")
+    proc.set("fields", "x,y")
+    proc.set("epsilon", "1.2")
+    proc.set("window_size", "4")
+    proc.configure()
+    proc.plugin.init(proc, None)
+
+    square = [(0, 0), (1, 0), (1, 1), (0, 1)]
+    evs = [LogEvent(float(i), {"x": float(x), "y": float(y)}, None, None)
+           for i, (x, y) in enumerate(square)]
+    out = proc.plugin.process_logs(evs, "t", None)
+    # after all 4 points: one component, one loop
+    assert out[-1].body["betti_0"] == 1
+    assert out[-1].body["betti_1"] == 1
+
+    # center point within eps of all corners fills the square
+    proc2 = reg.create_processor("tda")
+    proc2.set("fields", "x,y")
+    proc2.set("epsilon", "1.2")
+    proc2.set("window_size", "5")
+    proc2.configure()
+    proc2.plugin.init(proc2, None)
+    pts = square + [(0.5, 0.5)]
+    evs2 = [LogEvent(float(i), {"x": float(x), "y": float(y)}, None, None)
+            for i, (x, y) in enumerate(pts)]
+    out2 = proc2.plugin.process_logs(evs2, "t", None)
+    assert out2[-1].body["betti_0"] == 1
+    assert out2[-1].body["betti_1"] == 0
+
+
+def test_tda_betti1_two_disjoint_loops():
+    """Two far-apart 4-cycles: β0 = 2, β1 = 2."""
+    from fluentbit_tpu.core.plugin import registry as reg
+
+    proc = reg.create_processor("tda")
+    proc.set("fields", "x,y")
+    proc.set("epsilon", "1.2")
+    proc.set("window_size", "8")
+    proc.configure()
+    proc.plugin.init(proc, None)
+    pts = [(0, 0), (1, 0), (1, 1), (0, 1),
+           (10, 0), (11, 0), (11, 1), (10, 1)]
+    evs = [LogEvent(float(i), {"x": float(x), "y": float(y)}, None, None)
+           for i, (x, y) in enumerate(pts)]
+    out = proc.plugin.process_logs(evs, "t", None)
+    assert out[-1].body["betti_0"] == 2
+    assert out[-1].body["betti_1"] == 2
