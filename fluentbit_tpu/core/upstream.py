@@ -1,0 +1,163 @@
+"""Upstream connection pools + HA node sets.
+
+Reference: src/flb_upstream.c (per-destination pools with keepalive —
+`net.keepalive`, `net.keepalive_idle_timeout`, `net.keepalive_max_recycle`
+config map at flb_upstream.c:63-90) and src/flb_upstream_ha.c +
+flb_upstream_node.c (named upstream files with weighted [NODE] sections
+used by out_forward). The TPU build's clients are asyncio streams; a
+pooled connection is an (reader, writer) pair parked until the idle
+timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional, Tuple
+
+from .config import parse_bool
+
+
+class Upstream:
+    """Keepalive pool for one destination (flb_upstream equivalent).
+
+    ``get()`` pops a live idle connection or dials a new one;
+    ``release(reusable=True)`` parks it for reuse. Dead idles (peer
+    closed, idle timeout, recycle count exceeded) are dropped on pop.
+    """
+
+    def __init__(self, instance, host: str, port: int,
+                 connect_timeout: float = 10.0):
+        self.instance = instance
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        props = getattr(instance, "properties", None)
+        get = props.get if props is not None else (lambda *a: None)
+        self.keepalive = parse_bool(get("net.keepalive", True))
+        self.idle_timeout = float(
+            get("net.keepalive_idle_timeout", 30) or 30)
+        self.max_recycle = int(get("net.keepalive_max_recycle", 0) or 0)
+        self.max_idle = int(get("net.max_worker_connections", 4) or 4)
+        self._idle: List[tuple] = []  # (reader, writer, parked_at, uses)
+
+    async def get(self) -> Tuple[object, object, bool, int]:
+        """(reader, writer, reused, use_count)."""
+        now = time.time()
+        while self._idle:
+            reader, writer, parked, uses = self._idle.pop()
+            if now - parked > self.idle_timeout:
+                self._close(writer)
+                continue
+            if reader.at_eof() or writer.is_closing():
+                self._close(writer)
+                continue
+            return reader, writer, True, uses
+        from .tls import open_connection
+
+        reader, writer = await open_connection(
+            self.instance, self.host, self.port,
+            timeout=self.connect_timeout)
+        return reader, writer, False, 0
+
+    def release(self, reader, writer, reusable: bool,
+                use_count: int = 0) -> None:
+        if (not reusable or not self.keepalive
+                or writer.is_closing()
+                or len(self._idle) >= self.max_idle
+                or (self.max_recycle and use_count + 1
+                    >= self.max_recycle)):
+            self._close(writer)
+            return
+        self._idle.append((reader, writer, time.time(), use_count + 1))
+
+    def _close(self, writer) -> None:
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        while self._idle:
+            _, writer, _, _ = self._idle.pop()
+            self._close(writer)
+
+
+class UpstreamNode:
+    __slots__ = ("name", "host", "port", "weight", "properties",
+                 "down_until")
+
+    def __init__(self, name: str, host: str, port: int,
+                 weight: int = 1, properties=None):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.weight = max(1, int(weight))
+        self.properties = properties or {}
+        self.down_until = 0.0
+
+
+class UpstreamHA:
+    """Weighted node set with failover (flb_upstream_ha.c).
+
+    ``pick()`` is smooth weighted round-robin over healthy nodes;
+    ``mark_down(node)`` cools a failing node off for ``retry_window``
+    seconds. When every node is down, picks proceed anyway (the caller
+    surfaces the delivery error — parity with the reference, which
+    never blackholes silently)."""
+
+    def __init__(self, name: str, nodes: List[UpstreamNode],
+                 retry_window: float = 10.0):
+        self.name = name
+        self.nodes = nodes
+        self.retry_window = retry_window
+        self._current = {n.name: 0 for n in nodes}
+
+    def pick(self) -> Optional[UpstreamNode]:
+        if not self.nodes:
+            return None
+        now = time.time()
+        candidates = [n for n in self.nodes if n.down_until <= now]
+        if not candidates:
+            candidates = self.nodes  # all down: let the caller fail
+        total = sum(n.weight for n in candidates)
+        best = None
+        for n in candidates:
+            self._current[n.name] += n.weight
+            if best is None or self._current[n.name] > \
+                    self._current[best.name]:
+                best = n
+        self._current[best.name] -= total
+        return best
+
+    def mark_down(self, node: UpstreamNode) -> None:
+        node.down_until = time.time() + self.retry_window
+
+    def mark_up(self, node: UpstreamNode) -> None:
+        node.down_until = 0.0
+
+
+def parse_upstream_file(path: str) -> UpstreamHA:
+    """Load an upstream definition file — classic-INI [UPSTREAM] with
+    `name`, followed by [NODE] sections carrying name/host/port and
+    optional per-node properties (flb_upstream_node.c)."""
+    from ..config_format import parse_classic
+
+    cf = parse_classic(open(path).read())
+    name = "upstream"
+    nodes: List[UpstreamNode] = []
+    for sec in cf.sections:
+        if sec.name.lower() == "upstream":
+            name = sec.get("name", name)
+        elif sec.name.lower() == "node":
+            props = {k.lower(): v for k, v in sec.properties}
+            nodes.append(UpstreamNode(
+                props.get("name", f"node{len(nodes)}"),
+                props.get("host", "127.0.0.1"),
+                int(props.get("port", 24224)),
+                int(props.get("weight", 1)),
+                props,
+            ))
+    if not nodes:
+        raise ValueError(f"upstream file {path!r} defines no nodes")
+    return UpstreamHA(name, nodes)

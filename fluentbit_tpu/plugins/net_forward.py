@@ -178,6 +178,9 @@ class ForwardOutput(OutputPlugin):
         ConfigMapEntry("compress", "str"),
         ConfigMapEntry("time_as_integer", "bool", default=False),
         ConfigMapEntry("ack_timeout", "time", default="10"),
+        ConfigMapEntry("upstream", "str",
+                       desc="upstream HA definition file: weighted "
+                            "[NODE] sections with failover"),
     ]
 
     def init(self, instance, engine) -> None:
@@ -186,15 +189,33 @@ class ForwardOutput(OutputPlugin):
         # one connection per output instance: concurrent flush coroutines
         # must not interleave writes or steal each other's acks
         self._lock = asyncio.Lock()
+        # upstream HA (flb_upstream_ha.c): weighted nodes + failover
+        self._ha = None
+        self._node = None
+        if self.upstream:
+            from ..core.upstream import parse_upstream_file
+
+            self._ha = parse_upstream_file(self.upstream)
 
     async def _connect(self):
         if self._writer is not None and not self._writer.is_closing():
             return
         from ..core.tls import open_connection
 
-        self._reader, self._writer = await open_connection(
-            self.instance, self.host, self.port, timeout=10
-        )
+        host, port = self.host, self.port
+        if self._ha is not None:
+            self._node = self._ha.pick()
+            host, port = self._node.host, self._node.port
+        try:
+            self._reader, self._writer = await open_connection(
+                self.instance, host, port, timeout=10
+            )
+        except (OSError, asyncio.TimeoutError):
+            if self._ha is not None and self._node is not None:
+                self._ha.mark_down(self._node)
+            raise
+        if self._ha is not None and self._node is not None:
+            self._ha.mark_up(self._node)
         if self.shared_key:
             await self._handshake()
 
@@ -271,11 +292,19 @@ class ForwardOutput(OutputPlugin):
                     )
                 except asyncio.TimeoutError:
                     self._writer = None
+                    if self._ha is not None and self._node is not None:
+                        # TCP-alive-but-hung node: failover like a
+                        # connect error, or weight keeps re-picking it
+                        self._ha.mark_down(self._node)
                     return FlushResult.RETRY
                 if not (isinstance(ack, dict) and ack.get("ack") == chunk_id):
                     self._writer = None
+                    if self._ha is not None and self._node is not None:
+                        self._ha.mark_down(self._node)
                     return FlushResult.RETRY
         except (ConnectionError, OSError):
             self._writer = None
+            if self._ha is not None and self._node is not None:
+                self._ha.mark_down(self._node)  # fail over next flush
             return FlushResult.RETRY
         return FlushResult.OK

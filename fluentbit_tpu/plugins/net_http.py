@@ -20,6 +20,7 @@ from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
 from .outputs_basic import format_json_lines
+from .outputs_http_based import _HttpDeliveryOutput
 
 log = logging.getLogger("flb.http")
 
@@ -231,7 +232,10 @@ class HttpInput(HttpServerInputBase):
 
 
 @registry.register
-class HttpOutput(OutputPlugin):
+class HttpOutput(_HttpDeliveryOutput):
+    """Rides the shared delivery base: keepalive pools (core.upstream),
+    retry classification, TLS, and `http2 on`."""
+
     name = "http"
     description = "HTTP client output"
     config_map = [
@@ -244,50 +248,46 @@ class HttpOutput(OutputPlugin):
         ConfigMapEntry("compress", "str"),
     ]
 
-    def _payload(self, data: bytes) -> Tuple[bytes, str]:
-        fmt = (self.format or "json").lower()
-        if fmt == "msgpack":
-            return data, "application/msgpack"
-        text = format_json_lines(data, date_key=self.json_date_key or "date")
-        if fmt == "json":
-            return ("[" + text.replace("\n", ",") + "]").encode(), \
-                "application/json"
-        return (text + "\n").encode(), "application/x-ndjson"
+    def _fmt(self) -> str:
+        # the `format` OPTION collides with the wire-builder method
+        # required by the delivery base, so it reads from properties
+        return str(self.instance.properties.get("format")
+                   or "json").lower()
+
+    def _content_type(self) -> str:
+        return {"msgpack": "application/msgpack",
+                "json": "application/json"}.get(
+                    self._fmt(), "application/x-ndjson")
+
+    def _headers(self) -> list:
+        out = []
+        if (self.compress or "").lower() == "gzip":
+            out.append("Content-Encoding: gzip")
+        for pair in self.header or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) == 2:
+                out.append(f"{parts[0]}: {parts[1]}")
+        return out
 
     async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
-        body, ctype = self._payload(data)
-        headers = [f"POST {self.uri or '/'} HTTP/1.1",
-                   f"Host: {self.host}:{self.port}",
-                   f"Content-Length: {len(body)}",
-                   f"Content-Type: {ctype}"]
+        # the `format` config option always shadows any method of that
+        # name on the instance (config defaults are setattr'd), so the
+        # wire builder lives under _build
+        return await self._post(self._build(data, tag))
+
+    def _build(self, data: bytes, tag: str) -> bytes:
+        fmt = self._fmt()
+        if fmt == "msgpack":
+            body = data
+        else:
+            text = format_json_lines(
+                data, date_key=self.json_date_key or "date")
+            if fmt == "json":
+                body = ("[" + text.replace("\n", ",") + "]").encode()
+            else:
+                body = (text + "\n").encode()
         if (self.compress or "").lower() == "gzip":
             import gzip as _gzip
 
             body = _gzip.compress(body)
-            headers[2] = f"Content-Length: {len(body)}"
-            headers.append("Content-Encoding: gzip")
-        for pair in self.header or []:
-            parts = pair if isinstance(pair, list) else pair.split(None, 1)
-            if len(parts) == 2:
-                headers.append(f"{parts[0]}: {parts[1]}")
-        try:
-            from ..core.tls import open_connection
-
-            reader, writer = await open_connection(
-                self.instance, self.host, self.port, timeout=10
-            )
-            writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
-            await writer.drain()
-            status_line = await reader.readline()
-            writer.close()
-        except OSError:
-            return FlushResult.RETRY
-        try:
-            status = int(status_line.split()[1])
-        except (IndexError, ValueError):
-            return FlushResult.RETRY
-        if 200 <= status < 300:
-            return FlushResult.OK
-        if status >= 500 or status == 408 or status == 429:
-            return FlushResult.RETRY
-        return FlushResult.ERROR
+        return body

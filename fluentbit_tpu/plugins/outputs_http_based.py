@@ -104,12 +104,27 @@ class _HttpDeliveryOutput(OutputPlugin):
             return FlushResult.RETRY
         return FlushResult.ERROR
 
+    def _upstream(self):
+        """Lazy per-plugin keepalive pool (flb_upstream equivalent;
+        net.keepalive* instance properties tune it)."""
+        from ..core.upstream import Upstream
+
+        up = getattr(self, "_pool", None)
+        if up is None or (up.host, up.port) != (self.host, self.port):
+            if up is not None:
+                up.close()
+            self._pool = up = Upstream(
+                self.instance, self.host, self.port,
+                connect_timeout=self.CONNECT_TIMEOUT)
+        return up
+
     async def _post(self, body: bytes,
                     extra_headers: Optional[List[str]] = None,
                     uri: Optional[str] = None, verb: str = "POST",
                     ok_statuses: tuple = ()) -> FlushResult:
         if self._use_http2():
             return await self._post_h2(body, extra_headers, uri)
+        pool = self._upstream()
         # per-request headers are passed in, never stashed on the
         # instance: concurrent flushes must not see each other's auth
         headers = [
@@ -117,37 +132,102 @@ class _HttpDeliveryOutput(OutputPlugin):
             f"Host: {self.host}:{self.port}",
             f"Content-Length: {len(body)}",
             f"Content-Type: {self._content_type()}",
-            "Connection: close",
+            "Connection: " + ("keep-alive" if pool.keepalive
+                              else "close"),
         ] + self._headers() + (extra_headers or [])
-        writer = None
-        try:
-            from ..core.tls import open_connection
+        wire = ("\r\n".join(headers) + "\r\n\r\n").encode() + body
+        # one transparent redo when a REUSED keepalive connection turns
+        # out dead mid-request (the normal keepalive race; reference
+        # upstream does the same by dropping the stale conn)
+        for _ in (0, 1):
+            try:
+                reader, writer, reused, uses = await pool.get()
+            except (OSError, asyncio.TimeoutError):
+                return FlushResult.RETRY
+            responded = [False]  # any response byte seen?
+            try:
+                writer.write(wire)
+                await asyncio.wait_for(writer.drain(), self.IO_TIMEOUT)
+                status, conn_close, drained = await self._read_response(
+                    reader, responded)
+            except (OSError, IndexError, ValueError,
+                    asyncio.TimeoutError, asyncio.IncompleteReadError):
+                pool.release(reader, writer, reusable=False)
+                if reused and not responded[0]:
+                    # stale idle connection died BEFORE any response:
+                    # safe to redo on a fresh dial. Once the server has
+                    # started answering, the request may have been
+                    # processed — no silent immediate re-send (the
+                    # scheduler's RETRY owns at-least-once from here)
+                    continue
+                return FlushResult.RETRY
+            pool.release(reader, writer,
+                         reusable=drained and not conn_close,
+                         use_count=uses)
+            if 200 <= status < 300 or status in ok_statuses:
+                return FlushResult.OK
+            if status >= 500 or status in (408, 429):
+                return FlushResult.RETRY
+            return FlushResult.ERROR
+        return FlushResult.RETRY
 
-            reader, writer = await open_connection(
-                self.instance, self.host, self.port,
-                timeout=self.CONNECT_TIMEOUT,
-            )
-            writer.write(("\r\n".join(headers) + "\r\n\r\n").encode() + body)
-            await asyncio.wait_for(writer.drain(), self.IO_TIMEOUT)
-            status_line = await asyncio.wait_for(reader.readline(),
-                                                 self.IO_TIMEOUT)
-            status = int(status_line.split()[1])
-        except (OSError, IndexError, ValueError, asyncio.TimeoutError):
-            return FlushResult.RETRY
-        finally:
-            if writer is not None:  # never leak the socket on timeout
-                try:
-                    writer.close()
-                except Exception:
-                    pass
-        if 200 <= status < 300 or status in ok_statuses:
-            return FlushResult.OK
-        if status >= 500 or status in (408, 429):
-            return FlushResult.RETRY
-        return FlushResult.ERROR
+    async def _read_response(self, reader, responded=None):
+        """(status, connection_close, fully_drained) — the body must be
+        consumed for the connection to be reusable."""
+        status_line = await asyncio.wait_for(reader.readline(),
+                                             self.IO_TIMEOUT)
+        if responded is not None and status_line:
+            responded[0] = True
+        status = int(status_line.split()[1])
+        length = None
+        chunked = False
+        conn_close = False
+        while True:
+            line = await asyncio.wait_for(reader.readline(),
+                                          self.IO_TIMEOUT)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            low = line.lower()
+            if low.startswith(b"content-length:"):
+                length = int(line.split(b":", 1)[1])
+            elif low.startswith(b"transfer-encoding:") and \
+                    b"chunked" in low:
+                chunked = True
+            elif low.startswith(b"connection:") and b"close" in low:
+                conn_close = True
+        drained = False
+        if chunked:
+            while True:
+                size_line = await asyncio.wait_for(
+                    reader.readline(), self.IO_TIMEOUT)
+                # chunk extensions ("c;name=val") are legal — size is
+                # everything before the first ';'
+                size = int(size_line.split(b";")[0].strip() or b"0", 16)
+                if size == 0:
+                    # consume optional trailers through the blank line
+                    while True:
+                        line = await asyncio.wait_for(
+                            reader.readline(), self.IO_TIMEOUT)
+                        if line in (b"\r\n", b"\n", b""):
+                            break
+                    break
+                await asyncio.wait_for(
+                    reader.readexactly(size + 2), self.IO_TIMEOUT)
+            drained = True
+        elif length is not None:
+            await asyncio.wait_for(reader.readexactly(length),
+                                   self.IO_TIMEOUT)
+            drained = True
+        # no length + not chunked: body runs to EOF — not reusable
+        return status, conn_close, drained
 
     async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
         return await self._post(self.format(data, tag))
+
+    def exit(self) -> None:
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.close()  # parked keepalive sockets must not leak
 
 
 @registry.register

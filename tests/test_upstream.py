@@ -1,0 +1,223 @@
+"""Upstream keepalive pools + HA weighted failover.
+
+Reference: src/flb_upstream.c (net.keepalive* pools),
+src/flb_upstream_ha.c + flb_upstream_node.c (weighted [NODE] files
+consumed by out_forward).
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.core.upstream import (UpstreamHA, UpstreamNode,
+                                         parse_upstream_file)
+
+
+def test_ha_weighted_round_robin():
+    a = UpstreamNode("a", "h1", 1, weight=3)
+    b = UpstreamNode("b", "h2", 2, weight=1)
+    ha = UpstreamHA("up", [a, b])
+    picks = [ha.pick().name for _ in range(8)]
+    assert picks.count("a") == 6 and picks.count("b") == 2
+
+
+def test_ha_failover_and_recovery():
+    a = UpstreamNode("a", "h1", 1)
+    b = UpstreamNode("b", "h2", 2)
+    ha = UpstreamHA("up", [a, b], retry_window=0.2)
+    ha.mark_down(a)
+    assert {ha.pick().name for _ in range(4)} == {"b"}
+    time.sleep(0.25)
+    assert "a" in {ha.pick().name for _ in range(4)}
+    # all down: picks still return (caller surfaces the error)
+    ha.mark_down(a)
+    ha.mark_down(b)
+    assert ha.pick() is not None
+
+
+def test_parse_upstream_file(tmp_path):
+    p = tmp_path / "up.conf"
+    p.write_text(
+        "[UPSTREAM]\n    name forward-balancing\n"
+        "[NODE]\n    name n1\n    host 127.0.0.1\n    port 10001\n"
+        "    weight 2\n"
+        "[NODE]\n    name n2\n    host 127.0.0.1\n    port 10002\n"
+    )
+    ha = parse_upstream_file(str(p))
+    assert ha.name == "forward-balancing"
+    assert [(n.name, n.port, n.weight) for n in ha.nodes] == [
+        ("n1", 10001, 2), ("n2", 10002, 1)]
+
+
+class _CountingHttpServer:
+    """HTTP/1.1 keep-alive server counting connections + requests."""
+
+    def __init__(self):
+        self.connections = 0
+        self.requests = 0
+        self.port = None
+        self._loop = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        deadline = time.time() + 5
+        while self.port is None and time.time() < deadline:
+            time.sleep(0.02)
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    def _run(self):
+        async def on_conn(reader, writer):
+            self.connections += 1
+            try:
+                while True:
+                    head = bytearray()
+                    while not head.endswith(b"\r\n\r\n"):
+                        b = await reader.readexactly(1)
+                        head += b
+                    length = 0
+                    for line in head.decode("latin-1").split("\r\n"):
+                        if line.lower().startswith("content-length:"):
+                            length = int(line.split(":", 1)[1])
+                    if length:
+                        await reader.readexactly(length)
+                    self.requests += 1
+                    writer.write(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Length: 2\r\n\r\nok")
+                    await writer.drain()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        async def main():
+            server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+            self.port = server.sockets[0].getsockname()[1]
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(main())
+        self._loop.run_forever()
+
+
+def test_http_output_reuses_keepalive_connection():
+    srv = _CountingHttpServer().start()
+    try:
+        ctx = flb.create(flush="40ms", grace="1")
+        in_ffd = ctx.input("lib")
+        ctx.output("http", match="*", host="127.0.0.1",
+                   port=str(srv.port))
+        ctx.start()
+        try:
+            for i in range(5):
+                ctx.push(in_ffd, '{"n": %d}' % i)
+                time.sleep(0.15)  # separate chunks → separate flushes
+            deadline = time.time() + 5
+            while srv.requests < 5 and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            ctx.stop()
+    finally:
+        srv.stop()
+    assert srv.requests >= 5
+    # the pool reuses connections: far fewer dials than requests
+    assert srv.connections < srv.requests, (
+        srv.connections, srv.requests)
+
+
+def test_forward_output_ha_failover():
+    """Two forward endpoints; only one is alive — records must land
+    there via HA failover."""
+    from fluentbit_tpu.codec.msgpack import Unpacker
+
+    received = []
+    alive_port = {}
+    loop_holder = {}
+
+    def run_server():
+        async def on_conn(reader, writer):
+            u = Unpacker()
+            try:
+                while True:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    u.feed(data)
+                    while True:
+                        try:
+                            received.append(u.unpack())
+                        except Exception:
+                            break
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        async def main():
+            server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+            alive_port["port"] = server.sockets[0].getsockname()[1]
+
+        loop = asyncio.new_event_loop()
+        loop_holder["loop"] = loop
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(main())
+        loop.run_forever()
+
+    t = threading.Thread(target=run_server, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while "port" not in alive_port and time.time() < deadline:
+        time.sleep(0.02)
+
+    # a dead port: bind+close to get a port nothing listens on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".conf",
+                                     delete=False) as f:
+        f.write(
+            "[UPSTREAM]\n    name ha\n"
+            f"[NODE]\n    name dead\n    host 127.0.0.1\n"
+            f"    port {dead_port}\n    weight 10\n"
+            f"[NODE]\n    name live\n    host 127.0.0.1\n"
+            f"    port {alive_port['port']}\n"
+        )
+        up_file = f.name
+
+    ctx = flb.create(flush="40ms", grace="1")
+    ctx.service_set(**{"scheduler.base": "0.05", "scheduler.cap": "0.1"})
+    in_ffd = ctx.input("lib")
+    ctx.output("forward", match="*", upstream=up_file)
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"via": "ha"}')
+        deadline = time.time() + 10
+        while not received and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+        loop_holder["loop"].call_soon_threadsafe(
+            loop_holder["loop"].stop)
+    assert received, "no forward message reached the live node"
+    tag, blob, option = received[0]
+    assert tag == "lib.0"
+    evs = list(Unpacker(blob))
+    assert evs[0][1] == {"via": "ha"}
