@@ -98,13 +98,19 @@ class ChunkPool:
         self.total_bytes = 0
 
     def append(self, tag: str, data: bytes, n_records: int,
-               event_type: str = EVENT_TYPE_LOGS) -> Chunk:
-        key = (event_type, tag)
+               event_type: str = EVENT_TYPE_LOGS,
+               routes_mask: int = 0) -> Chunk:
+        # routes_mask joins the chunk key: conditionally-routed record
+        # groups must never merge into a chunk with different routes
+        # (reference split_and_append_route_payloads,
+        # src/flb_input_log.c:1495)
+        key = (event_type, tag, routes_mask)
         chunk = self._active.get(key)
         if chunk is None or chunk.locked:
             if chunk is not None and chunk.locked:
                 self._ready.append(chunk)
             chunk = Chunk(tag, event_type, self.in_name)
+            chunk.routes_mask = routes_mask
             self._active[key] = chunk
         chunk.append(data, n_records)
         self.total_bytes += len(data)
@@ -112,6 +118,28 @@ class ChunkPool:
             self._ready.append(chunk)
             del self._active[key]
         return chunk
+
+    def evict_oldest(self, bytes_needed: int):
+        """memrb eviction (src/flb_input_chunk.c:2936-2966): drop the
+        OLDEST buffered chunks until ``bytes_needed`` is freed; returns
+        the dropped chunks so the caller can count them in metrics."""
+        dropped = []
+        freed = 0
+        while freed < bytes_needed and self._ready:
+            c = self._ready.pop(0)
+            freed += c.size
+            self.total_bytes -= c.size
+            dropped.append(c)
+        if freed < bytes_needed:
+            for key in sorted(self._active,
+                              key=lambda k: self._active[k].created):
+                if freed >= bytes_needed:
+                    break
+                c = self._active.pop(key)
+                freed += c.size
+                self.total_bytes -= c.size
+                dropped.append(c)
+        return dropped
 
     def drain(self) -> List[Chunk]:
         """Take all flushable chunks (locked + currently active non-empty)."""

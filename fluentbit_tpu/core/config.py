@@ -196,6 +196,9 @@ CORE_INSTANCE_KEYS = {
     "threaded", "workers", "retry_limit", "no_multiplex", "host", "port", "tls",
     "tls.verify", "tls.ca_file", "tls.crt_file", "tls.key_file", "tls.vhost",
     "http2",  # HTTP-based outputs: prior-knowledge h2c delivery
+    "route_condition",  # ingest-time conditional routing (outputs)
+    "net.keepalive", "net.keepalive_idle_timeout",
+    "net.keepalive_max_recycle", "net.max_worker_connections",
 }
 
 

@@ -132,6 +132,13 @@ class Engine:
         # end-to-end latency histogram (reference src/flb_engine.c:400-405)
         self.m_latency = m.histogram("fluentbit", "output", "latency_seconds",
                                      "chunk create → delivered latency", ("name",))
+        # memrb ring-buffer eviction (src/flb_input_chunk.c:2936-2966)
+        self.m_memrb_dropped_chunks = m.counter(
+            "fluentbit", "input", "memrb_dropped_chunks_total",
+            "Chunks evicted by memrb ring buffer", ("name",))
+        self.m_memrb_dropped_bytes = m.counter(
+            "fluentbit", "input", "memrb_dropped_bytes_total",
+            "Bytes evicted by memrb ring buffer", ("name",))
 
     # ------------------------------------------------------------------
     # configuration
@@ -530,16 +537,31 @@ class Engine:
         """
         tag = tag or ins.tag or ins.plugin.name
 
+        # memrb storage: a ring buffer — over the limit, the OLDEST
+        # buffered chunks are evicted with drop metrics instead of
+        # pausing the input (src/flb_input_chunk.c:2936-2966)
+        if ins.storage_type == "memrb":
+            limit = ins.mem_buf_limit or 10 * 1024 * 1024
+            need = ins.pool.pending_bytes + len(data) - limit
+            if need > 0:
+                with ins.ingest_lock:
+                    evicted = ins.pool.evict_oldest(need)
+                for c in evicted:
+                    self.m_memrb_dropped_chunks.inc(
+                        1, (ins.display_name,))
+                    self.m_memrb_dropped_bytes.inc(
+                        c.size, (ins.display_name,))
+
         # backpressure (mem_buf_limit, src/flb_input.c:157,740-746;
         # storage.pause_on_chunks_overlimit, :169)
-        over = (
+        over = ins.storage_type != "memrb" and ((
             ins.mem_buf_limit
             and ins.pool.pending_bytes >= ins.mem_buf_limit
         ) or (
             getattr(ins, "pause_on_chunks_overlimit", False)
             and ins.pool.pending_chunks
             >= self.service.storage_max_chunks_up
-        )
+        ))
         if over:
             if not ins.paused:
                 ins.paused = True
@@ -567,9 +589,14 @@ class Engine:
             and ins is not self.sp.emitter_instance
             and any(t.matches(tag) for t in self.sp.tasks)
         )
+        cond_routing = any(
+            o.route_condition is not None and o.route.matches(tag)
+            for o in self.outputs
+        )
         raw_ok = (
             not ins.processors
             and not sp_active
+            and not cond_routing  # per-record splits need decoded events
             and self._trace_ctx(ins) is None
             and all(
                 getattr(f.plugin, "can_filter_raw", lambda: False)()
@@ -635,6 +662,44 @@ class Engine:
                     self.sp.do(events, tag)
                 except Exception:
                     log.exception("stream processor failed")
+
+            if cond_routing:
+                # split_and_append_route_payloads
+                # (src/flb_input_log.c:1495): group records by the set
+                # of outputs whose condition admits them; each group
+                # lands in its own chunk carrying that route bitmask
+                groups: Dict[int, bytearray] = {}
+                counts: Dict[int, int] = {}
+                # tag is constant for the append: resolve the matching
+                # candidates once, per-record work is condition eval only
+                candidates = [
+                    (1 << i, o.route_condition)
+                    for i, o in enumerate(self.outputs)
+                    if o.route.matches(tag)
+                ]
+                for ev in events:
+                    mask = 0
+                    for bit, cond in candidates:
+                        if cond is None or cond.eval(ev.body):
+                            mask |= bit
+                    if mask == 0:
+                        # no output admits this record (every matching
+                        # route's condition failed): nothing to deliver
+                        # — parity with dispatch finding zero routes
+                        continue
+                    raw = ev.raw if ev.raw is not None \
+                        else reencode_event(ev)
+                    groups.setdefault(mask, bytearray()).extend(raw)
+                    counts[mask] = counts.get(mask, 0) + 1
+                with ins.ingest_lock:
+                    for mask, buf in groups.items():
+                        chunk = ins.pool.append(
+                            tag, bytes(buf), counts[mask],
+                            routes_mask=mask)
+                        if self.storage is not None and \
+                                ins.storage_type == "filesystem":
+                            self.storage.write_through(chunk, bytes(buf))
+                return len(events)
 
             out = bytearray()
             for ev in events:
@@ -813,10 +878,20 @@ class Engine:
                     except Exception:
                         pass
         for ins, chunk in chunks:
-            routes = [
-                o for o in self.outputs
-                if o.route.matches(chunk.tag) and chunk.event_type in o.plugin.event_types
-            ]
+            if chunk.routes_mask:
+                # conditionally-split chunk: the ingest-time bitmask IS
+                # the route set (tag matching already folded in)
+                routes = [
+                    o for i, o in enumerate(self.outputs)
+                    if (chunk.routes_mask >> i) & 1
+                    and chunk.event_type in o.plugin.event_types
+                ]
+            else:
+                routes = [
+                    o for o in self.outputs
+                    if o.route.matches(chunk.tag)
+                    and chunk.event_type in o.plugin.event_types
+                ]
             if not routes:
                 if self.storage is not None:
                     self.storage.delete(chunk)

@@ -217,10 +217,36 @@ class OutputInstance(Instance):
         # src/flb_engine_dispatch.c:101-137)
         self.test_formatter: Optional[Callable] = None
         self.http2 = False  # prior-knowledge h2c delivery
+        # ingest-time conditional route (flb_router_condition.c):
+        # records failing the condition never enter this output's chunks
+        self.route_condition = None
 
     def configure(self) -> None:
         super().configure()
         from .config import parse_bool
+
+        conds = self.properties.get_all("route_condition")
+        if conds:
+            from .conditions import Condition, Rule
+
+            rules = []
+            for c in conds:
+                parts = c.split(None, 2) if isinstance(c, str) else list(c)
+                if len(parts) < 2:
+                    raise ValueError(
+                        f"route_condition needs 'field op [value]': {c!r}")
+                field, op = parts[0], parts[1]
+                value: object = parts[2] if len(parts) > 2 else None
+                if isinstance(value, str):
+                    try:
+                        value = int(value)
+                    except ValueError:
+                        try:
+                            value = float(value)
+                        except ValueError:
+                            pass
+                rules.append(Rule(field, op, value))
+            self.route_condition = Condition(rules, "and")
 
         # fail fast on a bad value (config_map-typed options do the
         # same); an invalid bool must not surface per-flush

@@ -15,8 +15,9 @@ CRC is stamped when the chunk is finalized at drain time. Layout::
     <root>/streams/<input_name>/<chunk_id>.flb      in-flight chunks
     <root>/dlq/<chunk_id>.flb                       quarantined chunks
 
-Header: ``FBTC | ver u8 | type u8 | state u8 | pad u8 | crc32 u32le |
-tag_len u16le | tag``. state 0 = open (crc not yet valid, a crash left
+Header (v2): ``FBTC | ver u8 | type u8 | state u8 | pad u8 | crc32 u32le |
+tag_len u16le | routes_mask u64le | tag`` (v1 files — no mask field —
+still load, with mask 0). state 0 = open (crc not yet valid, a crash left
 it un-finalized — payload is still recovered), 1 = finalized (crc32 of
 the payload must match; mismatch → the file is renamed ``.corrupt`` and
 skipped, mirroring chunkio's checksum failure handling).
@@ -42,7 +43,7 @@ from ..codec.chunk import (
 log = logging.getLogger("flb.storage")
 
 MAGIC = b"FBTC"
-VERSION = 1
+VERSION = 2
 STATE_OPEN = 0
 STATE_FINAL = 1
 
@@ -56,6 +57,14 @@ _TYPE_CODES = {
 _TYPE_NAMES = {v: k for k, v in _TYPE_CODES.items()}
 
 _HEAD = struct.Struct("<4sBBBBIH")  # magic, ver, type, state, pad, crc, tag_len
+_MASK = struct.Struct("<Q")  # v2: routes_mask (conditional routing survives restart)
+
+
+def _mask_bytes(chunk) -> bytes:
+    m = getattr(chunk, "routes_mask", 0) or 0
+    if m >= 1 << 64:  # >64 outputs: fall back to tag routing on recovery
+        m = 0
+    return _MASK.pack(m)
 
 
 class Storage:
@@ -91,6 +100,7 @@ class Storage:
             f.write(_HEAD.pack(MAGIC, VERSION,
                                _TYPE_CODES.get(chunk.event_type, 0),
                                STATE_OPEN, 0, 0, len(tag)))
+            f.write(_mask_bytes(chunk))
             f.write(tag)
             self._files[chunk.id] = (f, path)
             entry = self._files[chunk.id]
@@ -111,6 +121,7 @@ class Storage:
         f.write(_HEAD.pack(MAGIC, VERSION,
                            _TYPE_CODES.get(chunk.event_type, 0),
                            STATE_FINAL, 0, crc, len(tag)))
+        f.write(_mask_bytes(chunk))
         f.close()
         self._files[chunk.id] = (None, path)
 
@@ -150,6 +161,7 @@ class Storage:
             f.write(_HEAD.pack(MAGIC, VERSION,
                                _TYPE_CODES.get(chunk.event_type, 0),
                                STATE_FINAL, 0, crc, len(tag)))
+            f.write(_mask_bytes(chunk))
             f.write(tag)
             f.write(payload)
         return path
@@ -162,8 +174,11 @@ class Storage:
             if len(head) < _HEAD.size:
                 raise ValueError("truncated header")
             magic, ver, tcode, state, _, crc, tag_len = _HEAD.unpack(head)
-            if magic != MAGIC or ver != VERSION:
+            if magic != MAGIC or ver not in (1, VERSION):
                 raise ValueError("bad magic/version")
+            routes_mask = 0
+            if ver >= 2:
+                routes_mask = _MASK.unpack(f.read(_MASK.size))[0]
             tag = f.read(tag_len).decode("utf-8")
             payload = f.read()
         if state == STATE_FINAL and self.checksum and crc:
@@ -184,6 +199,7 @@ class Storage:
         chunk.buf = bytearray(payload)
         chunk.records = records
         chunk.locked = True
+        chunk.routes_mask = routes_mask
         return chunk
 
     def scan_backlog(self) -> List[Chunk]:

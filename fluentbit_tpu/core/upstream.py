@@ -15,7 +15,7 @@ import asyncio
 import time
 from typing import List, Optional, Tuple
 
-from .config import parse_bool
+from .config import parse_bool, parse_time
 
 
 class Upstream:
@@ -35,15 +35,29 @@ class Upstream:
         props = getattr(instance, "properties", None)
         get = props.get if props is not None else (lambda *a: None)
         self.keepalive = parse_bool(get("net.keepalive", True))
-        self.idle_timeout = float(
+        # TIME-typed in the reference: "30s" etc. must parse
+        self.idle_timeout = parse_time(
             get("net.keepalive_idle_timeout", 30) or 30)
         self.max_recycle = int(get("net.keepalive_max_recycle", 0) or 0)
         self.max_idle = int(get("net.max_worker_connections", 4) or 4)
         self._idle: List[tuple] = []  # (reader, writer, parked_at, uses)
 
+    def _sweep(self, now: float) -> None:
+        """Close idles past the timeout — LIFO reuse would otherwise
+        strand the oldest parked sockets forever (the reference's
+        keepalive sweep runs off the 1.5s housekeeping timer)."""
+        keep = []
+        for entry in self._idle:
+            if now - entry[2] > self.idle_timeout:
+                self._close(entry[1])
+            else:
+                keep.append(entry)
+        self._idle = keep
+
     async def get(self) -> Tuple[object, object, bool, int]:
         """(reader, writer, reused, use_count)."""
         now = time.time()
+        self._sweep(now)
         while self._idle:
             reader, writer, parked, uses = self._idle.pop()
             if now - parked > self.idle_timeout:
@@ -62,6 +76,7 @@ class Upstream:
 
     def release(self, reader, writer, reusable: bool,
                 use_count: int = 0) -> None:
+        self._sweep(time.time())
         if (not reusable or not self.keepalive
                 or writer.is_closing()
                 or len(self._idle) >= self.max_idle

@@ -1,0 +1,85 @@
+"""Ingest-time conditional routing + memrb eviction.
+
+Reference: split_and_append_route_payloads (src/flb_input_log.c:1495) —
+per-record route conditions split payloads into per-route-mask chunks
+at ingest; memrb storage evicts oldest chunks with drop metrics
+(src/flb_input_chunk.c:2936-2966).
+"""
+
+import time
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events, encode_event
+
+
+def test_route_condition_splits_records():
+    """errors output receives ONLY level=error records; the
+    unconditional output receives everything."""
+    all_recs, err_recs = [], []
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib")
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: all_recs.extend(decode_events(d)))
+    ctx.output("lib", match="*", route_condition="$level eq error",
+               callback=lambda d, tag: err_recs.extend(decode_events(d)))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"level": "info", "n": 1}')
+        ctx.push(in_ffd, '{"level": "error", "n": 2}')
+        ctx.push(in_ffd, '{"level": "error", "n": 3}')
+        ctx.push(in_ffd, '{"level": "warn", "n": 4}')
+        deadline = time.time() + 5
+        while (len(all_recs) < 4 or len(err_recs) < 2) and \
+                time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    assert sorted(ev.body["n"] for ev in all_recs) == [1, 2, 3, 4]
+    assert sorted(ev.body["n"] for ev in err_recs) == [2, 3]
+
+
+def test_route_condition_numeric_comparison():
+    """route_condition coerces numeric literals: $status gte 500."""
+    errs = []
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib")
+    ctx.output("lib", match="*", route_condition="$status gte 500",
+               callback=lambda d, tag: errs.extend(decode_events(d)))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"status": 200}')
+        ctx.push(in_ffd, '{"status": 503}')
+        ctx.push(in_ffd, '{"status": 404}')
+        deadline = time.time() + 5
+        while not errs and time.time() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.2)
+    finally:
+        ctx.stop()
+    assert [ev.body["status"] for ev in errs] == [503]
+
+
+def test_memrb_evicts_oldest_with_metrics():
+    """memrb storage: appends never pause; over the limit, oldest
+    chunks drop and the memrb metrics count them."""
+    from fluentbit_tpu.core.engine import Engine
+
+    e = Engine()
+    ins = e.input("dummy", **{"storage.type": "memrb",
+                              "mem_buf_limit": "8k"})
+    for x in e.inputs:
+        x.configure()
+        x.plugin.init(x, e)
+    payload = encode_event({"log": "x" * 900}, 1.0)
+    accepted = 0
+    for i in range(40):
+        got = e.input_log_append(ins, "t", payload, n_records=1)
+        assert got == 1, "memrb must never reject an append"
+        accepted += 1
+    assert accepted == 40
+    # buffer stayed bounded and the oldest records were evicted
+    assert ins.pool.pending_bytes <= 8 * 1024
+    dropped = e.m_memrb_dropped_chunks.get((ins.display_name,))
+    assert dropped > 0
+    assert e.m_memrb_dropped_bytes.get((ins.display_name,)) > 0
+    assert not ins.paused  # memrb never pauses the input
