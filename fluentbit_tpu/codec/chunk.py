@@ -44,6 +44,7 @@ class Chunk:
         "created",
         "locked",
         "routes_mask",
+        "route_names",
         "in_name",
     )
 
@@ -56,6 +57,9 @@ class Chunk:
         self.created = time.time()
         self.locked = False
         self.routes_mask = 0
+        # recovered conditional chunks carry route NAMES (bit positions
+        # are meaningless across a config change/restart)
+        self.route_names = None
         self.in_name = in_name
 
     @property

@@ -542,15 +542,18 @@ class Engine:
         # pausing the input (src/flb_input_chunk.c:2936-2966)
         if ins.storage_type == "memrb":
             limit = ins.mem_buf_limit or 10 * 1024 * 1024
-            need = ins.pool.pending_bytes + len(data) - limit
-            if need > 0:
-                with ins.ingest_lock:
-                    evicted = ins.pool.evict_oldest(need)
-                for c in evicted:
-                    self.m_memrb_dropped_chunks.inc(
-                        1, (ins.display_name,))
-                    self.m_memrb_dropped_bytes.inc(
-                        c.size, (ins.display_name,))
+            # read + evict atomically under the input lock; sized on
+            # the incoming raw bytes, matching the reference's
+            # pre-filter check (src/flb_input_chunk.c:2936, which runs
+            # before flb_filter_do at :3078)
+            with ins.ingest_lock:
+                need = ins.pool.pending_bytes + len(data) - limit
+                evicted = ins.pool.evict_oldest(need) if need > 0 else []
+            for c in evicted:
+                self.m_memrb_dropped_chunks.inc(
+                    1, (ins.display_name,))
+                self.m_memrb_dropped_bytes.inc(
+                    c.size, (ins.display_name,))
 
         # backpressure (mem_buf_limit, src/flb_input.c:157,740-746;
         # storage.pause_on_chunks_overlimit, :169)
@@ -696,6 +699,15 @@ class Engine:
                         chunk = ins.pool.append(
                             tag, bytes(buf), counts[mask],
                             routes_mask=mask)
+                        if chunk.route_names is None:
+                            # persisted form: NAMES, not bit positions
+                            # — conditional routing must survive a
+                            # restart with reordered outputs
+                            chunk.route_names = tuple(
+                                o.display_name
+                                for i, o in enumerate(self.outputs)
+                                if (mask >> i) & 1
+                            )
                         if self.storage is not None and \
                                 ins.storage_type == "filesystem":
                             self.storage.write_through(chunk, bytes(buf))
@@ -884,6 +896,14 @@ class Engine:
                 routes = [
                     o for i, o in enumerate(self.outputs)
                     if (chunk.routes_mask >> i) & 1
+                    and chunk.event_type in o.plugin.event_types
+                ]
+            elif chunk.route_names is not None:
+                # recovered from disk: resolve by output NAME (bit
+                # positions do not survive a config change)
+                routes = [
+                    o for o in self.outputs
+                    if o.display_name in chunk.route_names
                     and chunk.event_type in o.plugin.event_types
                 ]
             else:

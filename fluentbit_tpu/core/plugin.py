@@ -237,7 +237,10 @@ class OutputInstance(Instance):
                         f"route_condition needs 'field op [value]': {c!r}")
                 field, op = parts[0], parts[1]
                 value: object = parts[2] if len(parts) > 2 else None
-                if isinstance(value, str):
+                # numeric coercion ONLY for ordering ops — eq/neq on a
+                # numeric-looking STRING field must stay expressible
+                if isinstance(value, str) and op.lower() in (
+                        "gt", "lt", "gte", "lte"):
                     try:
                         value = int(value)
                     except ValueError:

@@ -16,8 +16,10 @@ CRC is stamped when the chunk is finalized at drain time. Layout::
     <root>/dlq/<chunk_id>.flb                       quarantined chunks
 
 Header (v2): ``FBTC | ver u8 | type u8 | state u8 | pad u8 | crc32 u32le |
-tag_len u16le | routes_mask u64le | tag`` (v1 files — no mask field —
-still load, with mask 0). state 0 = open (crc not yet valid, a crash left
+tag_len u16le | routes_len u16le | route_names | tag`` (v1 files — no
+routes field — still load with tag routing; route NAMES, not bit
+positions, so conditional routing survives output reordering). state
+0 = open (crc not yet valid, a crash left
 it un-finalized — payload is still recovered), 1 = finalized (crc32 of
 the payload must match; mismatch → the file is renamed ``.corrupt`` and
 skipped, mirroring chunkio's checksum failure handling).
@@ -57,14 +59,16 @@ _TYPE_CODES = {
 _TYPE_NAMES = {v: k for k, v in _TYPE_CODES.items()}
 
 _HEAD = struct.Struct("<4sBBBBIH")  # magic, ver, type, state, pad, crc, tag_len
-_MASK = struct.Struct("<Q")  # v2: routes_mask (conditional routing survives restart)
+_RLEN = struct.Struct("<H")  # v2: route-names blob length
 
 
 def _mask_bytes(chunk) -> bytes:
-    m = getattr(chunk, "routes_mask", 0) or 0
-    if m >= 1 << 64:  # >64 outputs: fall back to tag routing on recovery
-        m = 0
-    return _MASK.pack(m)
+    """v2 route-names blob: conditionally-split chunks persist their
+    route OUTPUT NAMES (bit positions are meaningless after a config
+    reorder); empty blob = tag routing."""
+    names = getattr(chunk, "route_names", None) or ()
+    blob = "\n".join(names).encode("utf-8")[:65535]
+    return _RLEN.pack(len(blob)) + blob
 
 
 class Storage:
@@ -176,9 +180,12 @@ class Storage:
             magic, ver, tcode, state, _, crc, tag_len = _HEAD.unpack(head)
             if magic != MAGIC or ver not in (1, VERSION):
                 raise ValueError("bad magic/version")
-            routes_mask = 0
+            route_names = None
             if ver >= 2:
-                routes_mask = _MASK.unpack(f.read(_MASK.size))[0]
+                (rlen,) = _RLEN.unpack(f.read(_RLEN.size))
+                if rlen:
+                    route_names = tuple(
+                        f.read(rlen).decode("utf-8").split("\n"))
             tag = f.read(tag_len).decode("utf-8")
             payload = f.read()
         if state == STATE_FINAL and self.checksum and crc:
@@ -199,7 +206,7 @@ class Storage:
         chunk.buf = bytearray(payload)
         chunk.records = records
         chunk.locked = True
-        chunk.routes_mask = routes_mask
+        chunk.route_names = route_names
         return chunk
 
     def scan_backlog(self) -> List[Chunk]:

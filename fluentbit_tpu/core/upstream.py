@@ -57,12 +57,9 @@ class Upstream:
     async def get(self) -> Tuple[object, object, bool, int]:
         """(reader, writer, reused, use_count)."""
         now = time.time()
-        self._sweep(now)
+        self._sweep(now)  # the single expiry path
         while self._idle:
             reader, writer, parked, uses = self._idle.pop()
-            if now - parked > self.idle_timeout:
-                self._close(writer)
-                continue
             if reader.at_eof() or writer.is_closing():
                 self._close(writer)
                 continue

@@ -25,6 +25,7 @@ from . import processors  # noqa: F401
 from . import telemetry_extra  # noqa: F401
 from . import outputs_aws  # noqa: F401
 from . import outputs_cloud  # noqa: F401
+from . import outputs_cloud_extra  # noqa: F401
 from . import outputs_webhooks  # noqa: F401
 from . import opentelemetry  # noqa: F401
 from . import misc_plugins  # noqa: F401

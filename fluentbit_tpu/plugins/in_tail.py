@@ -35,7 +35,7 @@ log = logging.getLogger("flb.tail")
 
 class _TailFile:
     __slots__ = ("path", "fd", "inode", "offset", "pending", "skipping",
-                 "skip_anchor")
+                 "skip_anchor", "decoder")
 
     def __init__(self, path: str, inode: int, offset: int = 0):
         self.path = path
@@ -45,6 +45,35 @@ class _TailFile:
         self.pending = b""
         self.skipping = False  # discarding an oversized line's remainder
         self.skip_anchor = 0   # the discarded line's start offset
+        self.decoder = None    # incremental input-encoding decoder
+
+
+class _AutoUtf16Decoder:
+    """unicode.encoding=auto: sniff the BOM, fall back to UTF-16LE for
+    BOM-less streams (Python's own 'utf-16' codec raises on a missing
+    BOM even with errors='replace')."""
+
+    def __init__(self, errors: str = "replace"):
+        self._errors = errors
+        self._inner = None
+        self._head = b""
+
+    def decode(self, data: bytes, final: bool = False) -> str:
+        import codecs
+
+        if self._inner is None:
+            self._head += data
+            if len(self._head) < 2 and not final:
+                return ""
+            if self._head.startswith(codecs.BOM_UTF16_BE):
+                name, skip = "utf-16-be", 2
+            elif self._head.startswith(codecs.BOM_UTF16_LE):
+                name, skip = "utf-16-le", 2
+            else:
+                name, skip = "utf-16-le", 0
+            self._inner = codecs.getincrementaldecoder(name)(self._errors)
+            data, self._head = self._head[skip:], b""
+        return self._inner.decode(data, final)
 
 
 @registry.register
@@ -67,7 +96,25 @@ class TailInput(InputPlugin):
         ConfigMapEntry("rotate_wait", "time", default="5"),
         ConfigMapEntry("multiline.parser", "clist",
                        desc="concatenate lines with a multiline parser"),
+        ConfigMapEntry("unicode.encoding", "str",
+                       desc="UTF-16LE | UTF-16BE | auto → convert to "
+                            "UTF-8 (reference simdutf path)"),
+        ConfigMapEntry("generic.encoding", "str",
+                       desc="ShiftJIS/UHC/GBK/GB18030/Big5/Win866-1256 "
+                            "→ convert to UTF-8 (reference src/unicode)"),
     ]
+
+    # reference src/unicode/ conversion tables ↔ Python codec names
+    _ENCODINGS = {
+        "utf-16le": "utf-16-le", "utf-16be": "utf-16-be",
+        "auto": "auto",  # BOM sniff with LE fallback (see _AutoUtf16)
+        "shiftjis": "shift_jis", "shift_jis": "shift_jis",
+        "sjis": "shift_jis", "uhc": "cp949", "gbk": "gbk",
+        "gb18030": "gb18030", "big5": "big5",
+        "win866": "cp866", "win874": "cp874", "win1250": "cp1250",
+        "win1251": "cp1251", "win1252": "cp1252", "win1253": "cp1253",
+        "win1254": "cp1254", "win1255": "cp1255", "win1256": "cp1256",
+    }
 
     def init(self, instance, engine) -> None:
         if not self.path:
@@ -88,6 +135,22 @@ class TailInput(InputPlugin):
             # fail fast on unknown parser names (whole list)
             create_stream(self.multiline_parser, engine.ml_parsers,
                           lambda *_: None)
+        # input-encoding conversion (flb_unicode_convert /
+        # src/unicode/flb_conv.c): lines decode incrementally per file
+        # (multi-byte sequences may straddle read boundaries) and
+        # re-emit as UTF-8
+        self._codec = None
+        enc = (self.unicode_encoding or self.generic_encoding or "")
+        if enc:
+            codec = self._ENCODINGS.get(enc.strip().lower())
+            if codec is None:
+                raise ValueError(f"tail: unsupported encoding {enc!r}")
+            import codecs as _codecs
+
+            if codec == "auto":
+                self._codec = _AutoUtf16Decoder
+            else:
+                self._codec = _codecs.getincrementaldecoder(codec)
         self._db = None
         self._dirty: Dict[str, tuple] = {}
         if self.db:
@@ -159,8 +222,16 @@ class TailInput(InputPlugin):
         line's start — a restart re-reads and re-skips it whole rather
         than emitting its tail as a corrupt record."""
         if self._db is not None:
-            off = tf.skip_anchor if tf.skipping \
-                else tf.offset - len(tf.pending)
+            if self._codec is not None:
+                # converted streams: pending holds UTF-8 bytes whose
+                # length differs from the raw file bytes, so the raw
+                # read offset is the only exact resume point (a
+                # mid-line fragment at crash time is re-read as its
+                # tail — documented divergence for converted inputs)
+                off = tf.offset
+            else:
+                off = tf.skip_anchor if tf.skipping \
+                    else tf.offset - len(tf.pending)
             self._dirty[tf.path] = (tf.inode, off)
 
     def _checkpoint(self) -> None:
@@ -215,6 +286,7 @@ class TailInput(InputPlugin):
             tf.pending = b""
             tf.skipping = False
             tf.skip_anchor = 0
+            tf.decoder = None
         self._drain_fd(tf, engine)
         # rotation: name now points at a different inode — finish the old
         # file (drained above), then follow the new one from offset 0
@@ -229,6 +301,7 @@ class TailInput(InputPlugin):
             tf.pending = b""
             tf.skipping = False
             tf.skip_anchor = 0
+            tf.decoder = None
             self._drain_fd(tf, engine, reopen=True)
         elif st is None:
             try:
@@ -250,6 +323,16 @@ class TailInput(InputPlugin):
             if not chunk:
                 break
             tf.offset += len(chunk)
+            if self._codec is not None:
+                # convert to UTF-8 before line splitting (the reference
+                # converts the read buffer ahead of process_content);
+                # the incremental decoder carries split multi-byte
+                # sequences across reads
+                if tf.decoder is None:
+                    tf.decoder = self._codec(errors="replace")
+                chunk = tf.decoder.decode(chunk).encode("utf-8")
+                if not chunk:
+                    continue
             if tf.skipping:
                 # discard up to (and including) the oversized line's
                 # terminating newline so its tail never becomes a record

@@ -1,0 +1,479 @@
+"""Round-3 output tail: plot, vivo_exporter, skywalking, chronicle,
+azure_kusto, azure_logs_ingestion, oracle_log_analytics.
+
+Reference plugins: out_plot (gnuplot-consumable "<ts> <value>" file),
+out_vivo_exporter (in-process HTTP endpoint serving recent event
+streams), out_skywalking (log collector /v3/logs JSON), out_chronicle
+(Google Chronicle unstructuredlogentries:batchCreate with
+service-account OAuth), out_azure_kusto (ADX streaming ingest with AAD
+client-credentials auth), out_azure_logs_ingestion (DCR/DCE ingestion,
+same AAD flow), out_oracle_log_analytics (OCI Logging Analytics with
+the OCI request-signature scheme).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import datetime
+import hashlib
+import json
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..codec.events import decode_events
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from .outputs_cloud import _GoogleOutput
+from .outputs_http_based import _HttpDeliveryOutput, _dumps
+
+log = logging.getLogger("flb.cloud_extra")
+
+
+@registry.register
+class PlotOutput(OutputPlugin):
+    """plugins/out_plot: append "<timestamp> <value>" rows to a file
+    for gnuplot; `key` selects the numeric field."""
+
+    name = "plot"
+    config_map = [
+        ConfigMapEntry("file", "str"),
+        ConfigMapEntry("key", "str", default="value"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.file:
+            raise ValueError("plot: file is required")
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        rows = []
+        for ev in decode_events(data):
+            v = ev.body.get(self.key) if isinstance(ev.body, dict) else None
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            rows.append(f"{ev.ts_float:.9f} {v}\n")
+        if rows:
+            try:
+                with open(self.file, "a") as f:
+                    f.writelines(rows)
+            except OSError:
+                return FlushResult.RETRY
+        return FlushResult.OK
+
+
+@registry.register
+class VivoExporterOutput(OutputPlugin):
+    """plugins/out_vivo_exporter: buffer recent events per stream and
+    serve them over an HTTP GET endpoint (/logs, /metrics, /traces)."""
+
+    name = "vivo_exporter"
+    event_types = ("logs", "metrics", "traces")
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=2025),
+        ConfigMapEntry("buffer_max_records", "int", default=1000),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._streams: Dict[str, deque] = {
+            "logs": deque(maxlen=self.buffer_max_records),
+            "metrics": deque(maxlen=self.buffer_max_records),
+            "traces": deque(maxlen=self.buffer_max_records),
+        }
+        self.bound_port: Optional[int] = None
+        self._server_task = None
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        if self._server_task is None:
+            self._server_task = asyncio.ensure_future(self._serve())
+        from ..codec.msgpack import Unpacker
+        from ..codec.telemetry import is_traces_payload
+        from ..core.metrics import is_metrics_payload
+
+        try:
+            objs = list(Unpacker(data))
+        except Exception:
+            objs = []
+        if objs and all(is_metrics_payload(o) for o in objs):
+            self._streams["metrics"].extend(
+                json.dumps(o, default=str) for o in objs)
+        elif objs and all(is_traces_payload(o) for o in objs):
+            self._streams["traces"].extend(
+                json.dumps(o, default=str) for o in objs)
+        else:
+            for ev in decode_events(data):
+                self._streams["logs"].append(json.dumps(
+                    [ev.ts_float, tag, ev.body], default=str))
+        return FlushResult.OK
+
+    async def _serve(self) -> None:
+        from .net_http import http_response, read_http_request
+
+        async def handle(reader, writer):
+            try:
+                req = await read_http_request(reader)
+                if req is not None:
+                    _method, uri, _hdrs, _body = req
+                    stream = uri.split("?")[0].strip("/") or "logs"
+                    items = self._streams.get(stream)
+                    body = ("\n".join(items) + "\n").encode() \
+                        if items else b""
+                    writer.write(http_response(
+                        200 if items is not None else 404, body,
+                        "application/x-ndjson"))
+                    await writer.drain()
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        server = await asyncio.start_server(handle, self.listen, self.port)
+        self.bound_port = server.sockets[0].getsockname()[1]
+        async with server:
+            await server.serve_forever()
+
+    def exit(self) -> None:
+        if self._server_task is not None:
+            self._server_task.cancel()
+
+
+@registry.register
+class SkywalkingOutput(_HttpDeliveryOutput):
+    """plugins/out_skywalking: OAP log collector /v3/logs JSON."""
+
+    name = "skywalking"
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=12800),
+        ConfigMapEntry("svc_name", "str", default="fluent-bit"),
+        ConfigMapEntry("svc_inst_name", "str", default="fluent-bit"),
+        ConfigMapEntry("auth_token", "str"),
+    ]
+
+    def _uri(self) -> str:
+        return "/v3/logs"
+
+    def _headers(self) -> List[str]:
+        return ([f"Authentication: {self.auth_token}"]
+                if self.auth_token else [])
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        out = []
+        for ev in decode_events(data):
+            out.append({
+                "timestamp": int(ev.ts_float * 1000),
+                "service": self.svc_name,
+                "serviceInstance": self.svc_inst_name,
+                "body": {"json": {"json": _dumps(ev.body)}},
+            })
+        return _dumps(out).encode()
+
+
+@registry.register
+class ChronicleOutput(_GoogleOutput):
+    """plugins/out_chronicle: Google SecOps (Chronicle)
+    unstructuredlogentries:batchCreate with service-account OAuth."""
+
+    name = "chronicle"
+    scope = "https://www.googleapis.com/auth/malachite-ingestion"
+    config_map = [
+        ConfigMapEntry("google_service_credentials", "str"),
+        ConfigMapEntry("customer_id", "str"),
+        ConfigMapEntry("log_type", "str", default="GENERIC_EVENT"),
+        ConfigMapEntry("region", "str", default=""),
+        ConfigMapEntry("endpoint", "str",
+                       desc="override (test/dev); default is the "
+                            "regional malachite endpoint"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        super().init(instance, engine)
+        if not self.customer_id:
+            raise ValueError("chronicle: customer_id is required")
+
+    def _endpoint(self) -> str:
+        if self.endpoint:
+            return self.endpoint
+        region = f"{self.region}-" if self.region else ""
+        return (f"https://{region}malachiteingestion-pa.googleapis.com"
+                f"/v2/unstructuredlogentries:batchCreate")
+
+    def _payload(self, data: bytes, tag: str) -> dict:
+        entries = [{
+            "logText": _dumps(ev.body),
+            "timestamp": datetime.datetime.fromtimestamp(
+                ev.ts_float, datetime.timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%S.%fZ"),
+        } for ev in decode_events(data)]
+        return {
+            "customerId": self.customer_id,
+            "logType": self.log_type,
+            "entries": entries,
+        }
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        return _dumps(self._payload(data, tag)).encode()
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        host, port, path, tls = self._split_url(self._endpoint())
+        token = await self._bearer()
+        if token is None:
+            return FlushResult.RETRY
+        return await self._post_json(host, port, path,
+                                     self._payload(data, tag), tls)
+
+
+class _AadOutput(_HttpDeliveryOutput):
+    """Shared AAD client-credentials token flow (login.microsoftonline
+    .com/{tenant}/oauth2/v2.0/token) for the Azure data-plane outputs."""
+
+    aad_scope = ""
+
+    def init(self, instance, engine) -> None:
+        for opt in ("tenant_id", "client_id", "client_secret"):
+            if not getattr(self, opt, None):
+                raise ValueError(f"{self.name}: {opt} is required")
+        self._token: Optional[str] = None
+        self._token_exp = 0.0
+
+    async def _aad_token(self) -> Optional[str]:
+        if self._token and time.time() < self._token_exp - 60:
+            return self._token
+        from urllib.parse import quote
+
+        login = self.oauth_endpoint or \
+            f"https://login.microsoftonline.com"
+        host, port, path, tls = _GoogleOutput._split_url(login)
+        if path in ("", "/"):
+            path = f"/{self.tenant_id}/oauth2/v2.0/token"
+        body = ("grant_type=client_credentials"
+                f"&client_id={quote(self.client_id)}"
+                f"&client_secret={quote(self.client_secret)}"
+                f"&scope={quote(self.aad_scope)}").encode()
+        from .outputs_aws import _http_request
+
+        try:
+            status, resp = await _http_request(
+                self.instance, host, port, "POST", path,
+                {"Content-Type": "application/x-www-form-urlencoded"},
+                body, quote_path=False, use_tls=tls,
+            )
+            if status != 200:
+                return None
+            tok = json.loads(resp)
+            self._token = tok["access_token"]
+            self._token_exp = time.time() + float(
+                tok.get("expires_in", 3600))
+            return self._token
+        except (OSError, ValueError, KeyError, asyncio.TimeoutError):
+            return None
+
+    async def _post_bearer(self, body: bytes, uri: str) -> FlushResult:
+        token = await self._aad_token()
+        if token is None:
+            return FlushResult.RETRY
+        return await self._post(
+            body, extra_headers=[f"Authorization: Bearer {token}"],
+            uri=uri)
+
+
+@registry.register
+class AzureKustoOutput(_AadOutput):
+    """plugins/out_azure_kusto: ADX streaming ingest
+    (/v1/rest/ingest/{db}/{table}?streamFormat=MultiJSON)."""
+
+    name = "azure_kusto"
+    aad_scope = "https://kusto.kusto.windows.net/.default"
+    config_map = [
+        ConfigMapEntry("tenant_id", "str"),
+        ConfigMapEntry("client_id", "str"),
+        ConfigMapEntry("client_secret", "str"),
+        ConfigMapEntry("ingestion_endpoint", "str",
+                       desc="https://ingest-<cluster>.<region>.kusto."
+                            "windows.net (host[:port] for tests)"),
+        ConfigMapEntry("database_name", "str"),
+        ConfigMapEntry("table_name", "str"),
+        ConfigMapEntry("time_key", "str", default="timestamp"),
+        ConfigMapEntry("tag_key", "str", default="tag"),
+        ConfigMapEntry("include_tag_key", "bool", default=True),
+        ConfigMapEntry("oauth_endpoint", "str",
+                       desc="AAD override for tests"),
+        ConfigMapEntry("host", "str"),
+        ConfigMapEntry("port", "int", default=443),
+    ]
+
+    def init(self, instance, engine) -> None:
+        super().init(instance, engine)
+        if not (self.ingestion_endpoint and self.database_name
+                and self.table_name):
+            raise ValueError("azure_kusto: ingestion_endpoint + "
+                             "database_name + table_name are required")
+        host, port, _, tls = _GoogleOutput._split_url(
+            self.ingestion_endpoint)
+        self.host, self.port = host, port
+        if tls and "tls" not in instance.properties:
+            instance.set("tls", "on")
+
+    def _uri(self) -> str:
+        return (f"/v1/rest/ingest/{self.database_name}/"
+                f"{self.table_name}?streamFormat=MultiJSON")
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        rows = []
+        for ev in decode_events(data):
+            row = dict(ev.body) if isinstance(ev.body, dict) else {}
+            row[self.time_key] = ev.ts_float
+            if self.include_tag_key:
+                row[self.tag_key] = tag
+            rows.append(_dumps(row))
+        return "\n".join(rows).encode()
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        return await self._post_bearer(self.format(data, tag),
+                                       self._uri())
+
+
+@registry.register
+class AzureLogsIngestionOutput(_AadOutput):
+    """plugins/out_azure_logs_ingestion: DCR-based Logs Ingestion API
+    (POST {dce}/dataCollectionRules/{dcr}/streams/{stream})."""
+
+    name = "azure_logs_ingestion"
+    aad_scope = "https://monitor.azure.com/.default"
+    config_map = [
+        ConfigMapEntry("tenant_id", "str"),
+        ConfigMapEntry("client_id", "str"),
+        ConfigMapEntry("client_secret", "str"),
+        ConfigMapEntry("dce_url", "str"),
+        ConfigMapEntry("dcr_id", "str"),
+        ConfigMapEntry("table_name", "str"),
+        ConfigMapEntry("time_generated", "bool", default=True),
+        ConfigMapEntry("oauth_endpoint", "str"),
+        ConfigMapEntry("host", "str"),
+        ConfigMapEntry("port", "int", default=443),
+    ]
+
+    def init(self, instance, engine) -> None:
+        super().init(instance, engine)
+        if not (self.dce_url and self.dcr_id and self.table_name):
+            raise ValueError("azure_logs_ingestion: dce_url + dcr_id + "
+                             "table_name are required")
+        host, port, _, tls = _GoogleOutput._split_url(self.dce_url)
+        self.host, self.port = host, port
+        if tls and "tls" not in instance.properties:
+            instance.set("tls", "on")
+
+    def _uri(self) -> str:
+        return (f"/dataCollectionRules/{self.dcr_id}/streams/"
+                f"Custom-{self.table_name}?api-version=2023-01-01")
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        rows = []
+        for ev in decode_events(data):
+            row = dict(ev.body) if isinstance(ev.body, dict) else {}
+            if self.time_generated:
+                row["TimeGenerated"] = datetime.datetime.fromtimestamp(
+                    ev.ts_float, datetime.timezone.utc).isoformat()
+            rows.append(row)
+        return _dumps(rows).encode()
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        return await self._post_bearer(self.format(data, tag),
+                                       self._uri())
+
+
+@registry.register
+class OracleLogAnalyticsOutput(_HttpDeliveryOutput):
+    """plugins/out_oracle_log_analytics: OCI Logging Analytics upload
+    with the OCI HTTP signature scheme (RSA-SHA256 over date/(request-
+    target)/host/content headers; `cryptography` provides the RSA as it
+    does for the Google outputs)."""
+
+    name = "oracle_log_analytics"
+    config_map = [
+        ConfigMapEntry("namespace", "str"),
+        ConfigMapEntry("config_file_location", "str",
+                       desc="OCI config: user/fingerprint/tenancy/"
+                            "region/key_file"),
+        ConfigMapEntry("profile_name", "str", default="DEFAULT"),
+        ConfigMapEntry("oci_la_log_group_id", "str"),
+        ConfigMapEntry("oci_la_log_source_name", "str"),
+        ConfigMapEntry("host", "str"),
+        ConfigMapEntry("port", "int", default=443),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not (self.namespace and self.config_file_location):
+            raise ValueError("oracle_log_analytics: namespace + "
+                             "config_file_location are required")
+        import configparser
+
+        cp = configparser.ConfigParser()
+        cp.read(self.config_file_location)
+        prof = cp[self.profile_name or "DEFAULT"]
+        self._tenancy = prof.get("tenancy", "")
+        self._user = prof.get("user", "")
+        self._fingerprint = prof.get("fingerprint", "")
+        self._region = prof.get("region", "us-ashburn-1")
+        key_file = prof.get("key_file", "")
+        from cryptography.hazmat.primitives.serialization import \
+            load_pem_private_key
+
+        with open(key_file, "rb") as f:
+            self._key = load_pem_private_key(f.read(), password=None)
+        if not self.host:
+            self.host = (f"loganalytics.{self._region}.oci."
+                         f"oraclecloud.com")
+            instance.set("tls", "on")
+
+    def _uri(self) -> str:
+        return (f"/20200601/namespaces/{self.namespace}/actions/"
+                f"uploadLogEventsFile?logGroupId="
+                f"{self.oci_la_log_group_id}")
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        recs = [dict(ev.body) if isinstance(ev.body, dict) else
+                {"message": str(ev.body)} for ev in decode_events(data)]
+        return _dumps({"metadata": {
+            "logSourceName": self.oci_la_log_source_name or tag,
+        }, "logRecords": recs}).encode()
+
+    def _signed_headers(self, body: bytes) -> List[str]:
+        from cryptography.hazmat.primitives.asymmetric import padding
+        from cryptography.hazmat.primitives import hashes
+
+        date = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%a, %d %b %Y %H:%M:%S GMT")
+        sha = base64.b64encode(hashlib.sha256(body).digest()).decode()
+        # sign EXACTLY what the transport sends: Host carries the port
+        # (outputs_http_based builds "Host: {host}:{port}") and the
+        # request-target keeps its case (OCIDs are case-sensitive)
+        signing = (f"date: {date}\n"
+                   f"(request-target): post {self._uri()}\n"
+                   f"host: {self.host}:{self.port}\n"
+                   f"x-content-sha256: {sha}\n"
+                   f"content-type: application/octet-stream\n"
+                   f"content-length: {len(body)}")
+        sig = base64.b64encode(self._key.sign(
+            signing.encode(), padding.PKCS1v15(),
+            hashes.SHA256())).decode()
+        key_id = f"{self._tenancy}/{self._user}/{self._fingerprint}"
+        auth = ('Signature version="1",keyId="{}",algorithm='
+                '"rsa-sha256",headers="date (request-target) host '
+                'x-content-sha256 content-type content-length",'
+                'signature="{}"').format(key_id, sig)
+        return [f"date: {date}", f"x-content-sha256: {sha}",
+                f"Authorization: {auth}"]
+
+    def _content_type(self) -> str:
+        return "application/octet-stream"
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        body = self.format(data, tag)
+        return await self._post(body,
+                                extra_headers=self._signed_headers(body))

@@ -1,0 +1,247 @@
+"""Round-3 tail part 2: charset conversion + plot/vivo/skywalking/
+chronicle/kusto/logs_ingestion/oracle outputs."""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events, encode_event
+
+
+def _make_output(name, **props):
+    from fluentbit_tpu.core.plugin import registry
+
+    ins = registry.create_output(name)
+    for k, v in props.items():
+        ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+# ------------------------------------------------------- charset
+
+def test_tail_generic_encoding_sjis(tmp_path):
+    logf = tmp_path / "sjis.log"
+    text = "こんにちは世界\nさようなら\n"
+    logf.write_bytes(text.encode("shift_jis"))
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("tail", tag="t", path=str(logf), read_from_head="on",
+              refresh_interval="1", **{"generic.encoding": "ShiftJIS"})
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    assert [ev.body["log"] for ev in got] == ["こんにちは世界", "さようなら"]
+
+
+def test_tail_unicode_encoding_utf16le(tmp_path):
+    logf = tmp_path / "u16.log"
+    logf.write_bytes("first π\nsecond ∑\n".encode("utf-16-le"))
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("tail", tag="t", path=str(logf), read_from_head="on",
+              refresh_interval="1", **{"unicode.encoding": "UTF-16LE"})
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    assert [ev.body["log"] for ev in got] == ["first π", "second ∑"]
+
+
+def test_tail_gbk_big5_supported():
+    from fluentbit_tpu.core.plugin import registry
+
+    for enc, codec_text in (("GBK", "gbk"), ("Big5", "big5"),
+                            ("Win1251", "cp1251")):
+        ins = registry.create_input("tail")
+        ins.set("path", "/tmp/nope*")
+        ins.set("generic.encoding", enc)
+        ins.configure()
+        ins.plugin.init(ins, None)  # must not raise
+
+
+# ------------------------------------------------------- plot
+
+def test_plot_output_writes_gnuplot_rows(tmp_path):
+    out = tmp_path / "plot.dat"
+    p = _make_output("plot", file=str(out), key="v")
+    data = encode_event({"v": 1.5}, 10.0) + encode_event(
+        {"v": 2}, 11.0) + encode_event({"other": "x"}, 12.0)
+    asyncio.run(p.flush(bytes(data), "t", None))
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 2
+    ts, v = lines[0].split()
+    assert float(ts) == 10.0 and float(v) == 1.5
+
+
+# ------------------------------------------------------- formatters
+
+def test_skywalking_format():
+    p = _make_output("skywalking", svc_name="svc", svc_inst_name="i1")
+    body = p.format(encode_event({"log": "x"}, 5.0), "t")
+    arr = json.loads(body)
+    assert arr[0]["service"] == "svc"
+    assert arr[0]["timestamp"] == 5000
+    assert json.loads(arr[0]["body"]["json"]["json"]) == {"log": "x"}
+
+
+def test_azure_kusto_format():
+    p = _make_output(
+        "azure_kusto", tenant_id="t", client_id="c", client_secret="s",
+        ingestion_endpoint="http://127.0.0.1:9999",
+        database_name="db", table_name="tbl")
+    body = p.format(encode_event({"a": 1}, 5.0), "mytag")
+    row = json.loads(body.decode().splitlines()[0])
+    assert row["a"] == 1 and row["tag"] == "mytag"
+    assert p._uri().startswith("/v1/rest/ingest/db/tbl")
+
+
+def test_azure_logs_ingestion_format():
+    p = _make_output(
+        "azure_logs_ingestion", tenant_id="t", client_id="c",
+        client_secret="s", dce_url="http://127.0.0.1:9999",
+        dcr_id="dcr-123", table_name="MyTable")
+    rows = json.loads(p.format(encode_event({"a": 1}, 5.0), "t"))
+    assert rows[0]["a"] == 1 and "TimeGenerated" in rows[0]
+    assert "/dataCollectionRules/dcr-123/streams/Custom-MyTable" \
+        in p._uri()
+
+
+def test_chronicle_format(tmp_path):
+    sa = tmp_path / "sa.json"
+    sa.write_text(json.dumps({
+        "client_email": "x@y", "private_key": "nope",
+        "token_uri": "http://127.0.0.1:9/token"}))
+    p = _make_output("chronicle", google_service_credentials=str(sa),
+                     customer_id="cust-1", log_type="NIX_SYSTEM")
+    payload = json.loads(p.format(encode_event({"m": "hi"}, 5.0), "t"))
+    assert payload["customerId"] == "cust-1"
+    assert payload["logType"] == "NIX_SYSTEM"
+    assert json.loads(payload["entries"][0]["logText"]) == {"m": "hi"}
+
+
+# ------------------------------------------------------- vivo
+
+def test_vivo_exporter_serves_buffered_logs():
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib")
+    ctx.output("vivo_exporter", match="*", listen="127.0.0.1", port="0")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"msg": "vivo"}')
+        plugin = ctx.engine.outputs[0].plugin
+        deadline = time.time() + 5
+        while plugin.bound_port is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert plugin.bound_port is not None
+        with socket.create_connection(
+                ("127.0.0.1", plugin.bound_port), timeout=5) as s:
+            s.sendall(b"GET /logs HTTP/1.1\r\nHost: x\r\n"
+                      b"Connection: close\r\n\r\n")
+            resp = b""
+            while True:
+                b = s.recv(4096)
+                if not b:
+                    break
+                resp += b
+    finally:
+        ctx.stop()
+    body = resp.split(b"\r\n\r\n", 1)[1]
+    ts, tag, rec = json.loads(body.splitlines()[0])
+    assert rec == {"msg": "vivo"} and tag == "lib.0"
+
+
+# ------------------------------------------------------- kusto runtime
+
+def test_azure_kusto_streaming_ingest_runtime():
+    """AAD token exchange + streaming ingest against local stubs."""
+    requests = []
+    port_box = {}
+    loop_box = {}
+
+    def run():
+        async def handle(reader, writer):
+            try:
+                head = bytearray()
+                while not head.endswith(b"\r\n\r\n"):
+                    head += await reader.readexactly(1)
+                length = 0
+                for line in head.decode("latin-1").split("\r\n"):
+                    if line.lower().startswith("content-length:"):
+                        length = int(line.split(":", 1)[1])
+                body = await reader.readexactly(length) if length else b""
+                first = head.decode("latin-1").split("\r\n")[0]
+                requests.append((first, head.decode("latin-1"), body))
+                if "/oauth2/" in first or "/token" in first:
+                    resp = json.dumps({"access_token": "tok-1",
+                                       "expires_in": 3600}).encode()
+                else:
+                    resp = b"{}"
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: %d\r\n"
+                    b"Connection: close\r\n\r\n%s" % (len(resp), resp))
+                await writer.drain()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        async def main():
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            port_box["port"] = server.sockets[0].getsockname()[1]
+
+        loop = asyncio.new_event_loop()
+        loop_box["loop"] = loop
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(main())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while "port" not in port_box and time.time() < deadline:
+        time.sleep(0.02)
+    port = port_box["port"]
+
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib")
+    ctx.output("azure_kusto", match="*", tenant_id="tid",
+               client_id="cid", client_secret="sec",
+               ingestion_endpoint=f"http://127.0.0.1:{port}",
+               database_name="db", table_name="tbl",
+               oauth_endpoint=f"http://127.0.0.1:{port}/tid/oauth2"
+                              f"/v2.0/token")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"k": "kusto"}')
+        deadline = time.time() + 8
+        while len(requests) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+        loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)
+    ingest = [r for r in requests
+              if "/v1/rest/ingest/db/tbl" in r[0]]
+    assert ingest, requests
+    assert "Authorization: Bearer tok-1" in ingest[0][1]
+    assert json.loads(ingest[0][2].splitlines()[0])["k"] == "kusto"
