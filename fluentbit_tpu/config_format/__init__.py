@@ -228,7 +228,7 @@ def apply_to_context(ctx, cf: ConfigFile, base_dir: str = ".") -> None:
         elif sec.name == "output":
             ffd = ctx.output(name)
         else:
-            continue  # customs: accepted, none implemented yet
+            ffd = ctx.custom(name)
         for k, v in rest:
             ctx.set(ffd, **{k: v})
         if sec.processors:

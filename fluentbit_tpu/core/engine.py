@@ -175,6 +175,16 @@ class Engine:
         self.outputs.append(ins)
         return ins
 
+    def custom(self, name: str, **props):
+        """Custom plugin instance (flb_custom_create); initialized
+        before the pipeline at start()."""
+        ins = self.registry.create_custom(name)
+        self._number_instance(ins, self.customs)
+        for k, v in props.items():
+            ins.set(k, v)
+        self.customs.append(ins)
+        return ins
+
     def parser(self, name: str, **props):
         """Create + register a named parser (flb_parser_create)."""
         from ..parsers import create_parser
@@ -346,6 +356,14 @@ class Engine:
                                    checksum=self.service.storage_checksum)
         if self.storage is not None:
             self._backlog = self.storage.scan_backlog()
+        # customs first (flb_custom_init_all, src/flb_engine.c:973):
+        # they may create pipeline instances programmatically
+        for ins in self.customs:
+            if getattr(ins, "_initialized", False):
+                continue
+            ins.configure()
+            ins.plugin.init(ins, self)
+            ins._initialized = True
         for ins in self.inputs + self.filters + self.outputs:
             if getattr(ins, "_initialized", False):
                 continue  # hidden inputs are initialized at creation
@@ -507,7 +525,7 @@ class Engine:
         self._stopping = True
         self._thread.join(timeout=self.service.grace + 10)
         self._thread = None
-        for ins in self.inputs + self.filters + self.outputs:
+        for ins in self.inputs + self.filters + self.outputs + self.customs:
             try:
                 ins.plugin.exit()
             except Exception:

@@ -109,6 +109,13 @@ class OutputPlugin(Plugin):
         return FlushResult.OK
 
 
+class CustomPlugin(Plugin):
+    """Custom vtable (reference src/flb_custom.c, flb_custom_init_all at
+    src/flb_engine.c:973): initialized BEFORE the pipeline plugins; a
+    custom may create input/filter/output instances programmatically
+    (the calyptia control-plane pattern)."""
+
+
 class ProcessorPlugin(Plugin):
     """Processor vtable — per-instance pipelines with stages/conditions
     (reference src/flb_processor.c). Runs on decoded events at input ingest
@@ -281,6 +288,7 @@ class Registry:
         self.filters: Dict[str, Type[FilterPlugin]] = {}
         self.outputs: Dict[str, Type[OutputPlugin]] = {}
         self.processors: Dict[str, Type[ProcessorPlugin]] = {}
+        self.customs: Dict[str, Type[CustomPlugin]] = {}
 
     def register(self, cls: Type[Plugin]) -> Type[Plugin]:
         if issubclass(cls, InputPlugin):
@@ -291,6 +299,8 @@ class Registry:
             self.outputs[cls.name] = cls
         elif issubclass(cls, ProcessorPlugin):
             self.processors[cls.name] = cls
+        elif issubclass(cls, CustomPlugin):
+            self.customs[cls.name] = cls
         else:
             raise TypeError(f"unknown plugin kind {cls!r}")
         return cls
@@ -307,6 +317,10 @@ class Registry:
     def create_processor(self, name: str):
         inst = Instance(self._get(self.processors, name, "processor")(), "processor")
         return inst
+
+    def create_custom(self, name: str):
+        return Instance(self._get(self.customs, name, "custom")(),
+                        "custom")
 
     @staticmethod
     def _get(table: dict, name: str, kind: str):

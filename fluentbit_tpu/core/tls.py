@@ -62,18 +62,33 @@ def client_server_hostname(ins) -> Optional[str]:
 async def open_connection(ins, host: str, port: int, timeout=None):
     """Client connect honoring the instance's TLS properties — the one
     place the ssl/server_hostname dance lives (every TCP client plugin
-    uses this instead of repeating it)."""
+    uses this instead of repeating it). Name resolution rides the
+    TTL-cached resolver (core.upstream.resolve, the c-ares role)."""
     import asyncio
 
+    from .upstream import invalidate_dns, resolve
+
     ctx = client_context(ins)
-    coro = asyncio.open_connection(
-        host, port, ssl=ctx,
-        server_hostname=(client_server_hostname(ins) or None) if ctx
-        else None,
-    )
-    if timeout is not None:
-        return await asyncio.wait_for(coro, timeout)
-    return await coro
+    try:
+        addrs = await resolve(host, port)
+    except OSError:
+        addrs = [host]  # let the connect surface the resolution error
+    # dialing resolved ADDRESSES: SNI/verification must still use the
+    # original hostname (or the vhost override). Try each address in
+    # getaddrinfo order — dual-stack fallback must survive the cache.
+    sni = (client_server_hostname(ins) or host) if ctx else None
+    last_err: Exception = OSError(f"no addresses for {host}")
+    for addr in addrs:
+        coro = asyncio.open_connection(addr, port, ssl=ctx,
+                                       server_hostname=sni)
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(coro, timeout)
+            return await coro
+        except (OSError, asyncio.TimeoutError) as e:
+            last_err = e
+    invalidate_dns(host, port)  # every cached address failed
+    raise last_err
 
 
 def server_context(ins) -> Optional[ssl.SSLContext]:

@@ -17,6 +17,55 @@ from typing import List, Optional, Tuple
 
 from .config import parse_bool, parse_time
 
+# -- async DNS with TTL cache (the c-ares role: src/flb_net_dns.h) --
+# asyncio's default resolver blocks a thread per lookup and re-resolves
+# every dial; outputs dial per flush, so a short-TTL cache removes the
+# lookup from the hot path.
+
+_dns_cache: dict = {}
+_DNS_TTL = 30.0
+
+
+async def resolve(host: str, port: int) -> List[str]:
+    """Every resolved address for host, in getaddrinfo preference order
+    (literal addresses pass through as a single entry). Callers must
+    keep the multi-address connect fallback — returning one address
+    would break dual-stack / multi-A-record destinations."""
+    import ipaddress
+    import socket
+
+    try:
+        ipaddress.ip_address(host)
+        return [host]
+    except ValueError:
+        pass
+    now = time.time()
+    hit = _dns_cache.get((host, port))
+    if hit is not None and hit[1] > now:
+        return hit[0]
+    import asyncio as _asyncio
+
+    loop = _asyncio.get_running_loop()
+    infos = await loop.getaddrinfo(host, port,
+                                   type=socket.SOCK_STREAM)
+    addrs: List[str] = []
+    for info in infos:
+        a = info[4][0]
+        if a not in addrs:
+            addrs.append(a)
+    _dns_cache[(host, port)] = (addrs, now + _DNS_TTL)
+    if len(_dns_cache) > 512:
+        # bound the cache for real: evict the soonest-expiring entries
+        # (an expired-only sweep removes nothing when all are live)
+        for k in sorted(_dns_cache, key=lambda k: _dns_cache[k][1])[
+                : len(_dns_cache) - 512]:
+            _dns_cache.pop(k, None)
+    return addrs
+
+
+def invalidate_dns(host: str, port: int) -> None:
+    _dns_cache.pop((host, port), None)
+
 
 class Upstream:
     """Keepalive pool for one destination (flb_upstream equivalent).

@@ -57,6 +57,13 @@ class FLBContext:
         self._handles.append(ins)
         return len(self._handles) - 1
 
+    def custom(self, name: str, **props) -> int:
+        """flb_custom: control-plane plugins initialized before the
+        pipeline (may create instances programmatically)."""
+        ins = self.engine.custom(name, **props)
+        self._handles.append(ins)
+        return len(self._handles) - 1
+
     def parser(self, name: str, **props):
         """Create + register a named parser (flb_parser_create /
         parsers_file [PARSER] section equivalent)."""

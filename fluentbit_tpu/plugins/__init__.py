@@ -36,4 +36,5 @@ from . import inputs_exporters  # noqa: F401
 from . import in_kubernetes_events  # noqa: F401
 from . import out_websocket  # noqa: F401
 from . import out_pgsql  # noqa: F401
+from . import misc_tail3  # noqa: F401
 from . import gated  # noqa: F401

@@ -11,6 +11,7 @@ gates in utils).
 from __future__ import annotations
 
 from ..core.plugin import (
+    CustomPlugin,
     FilterPlugin,
     InputPlugin,
     OutputPlugin,
@@ -49,3 +50,11 @@ _gate(OutputPlugin, "prometheus_remote_write",
       "snappy (the remote-write protobuf frame is snappy-compressed)")
 _gate(InputPlugin, "prometheus_remote_write", "snappy")
 _gate(InputPlugin, "mqtt", "an MQTT broker protocol stack")
+
+_gate(CustomPlugin, "calyptia",
+      "the Calyptia Cloud control plane (remote fleet management API)",
+      "the custom-plugin machinery itself is live: see "
+      "tests/test_misc_tail3.py for a programmatic custom")
+_gate(InputPlugin, "serial", "a serial port (termios device access)")
+_gate(InputPlugin, "calyptia_fleet",
+      "the Calyptia Cloud control plane")

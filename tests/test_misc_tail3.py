@@ -1,0 +1,172 @@
+"""Round-3 tail part 3: custom plugin type, kafka_rest/nrlogs formats,
+in_blob, podman_metrics, DNS cache."""
+
+import asyncio
+import gzip
+import json
+import os
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import encode_event
+from fluentbit_tpu.codec.msgpack import Unpacker, unpackb
+
+
+def _make_output(name, **props):
+    from fluentbit_tpu.core.plugin import registry
+
+    ins = registry.create_output(name)
+    for k, v in props.items():
+        ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def test_custom_plugin_creates_pipeline():
+    """The flb_custom contract: a custom initialized BEFORE the
+    pipeline can create instances programmatically (the calyptia
+    control-plane pattern)."""
+    from fluentbit_tpu.codec.events import decode_events
+    from fluentbit_tpu.core.plugin import CustomPlugin, registry
+
+    class WireUp(CustomPlugin):
+        name = "test_wireup"
+        description = "test custom: builds a pipeline at init"
+
+        def init(self, instance, engine) -> None:
+            engine.input("dummy", tag="from.custom",
+                         dummy='{"via": "custom"}', rate="50",
+                         samples="3")
+
+    if "test_wireup" not in registry.customs:
+        registry.register(WireUp)
+    got = []
+    ctx = flb.create(flush="40ms", grace="1")
+    ctx.custom("test_wireup")
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(
+                   (tag, ev) for ev in decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while len(got) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    assert len(got) == 3
+    assert got[0][0] == "from.custom"
+    assert got[0][1].body == {"via": "custom"}
+
+
+def test_calyptia_custom_is_gated():
+    ctx = flb.create()
+    ctx.custom("calyptia")
+    with pytest.raises(RuntimeError, match="not vendored"):
+        ctx.start()
+
+
+def test_kafka_rest_format():
+    p = _make_output("kafka_rest", topic="logs",
+                     include_tag_key="on")
+    body = json.loads(p.format(encode_event({"a": 1}, 5.0), "t1"))
+    assert p._uri() == "/topics/logs"
+    assert p._content_type() == "application/vnd.kafka.json.v2+json"
+    rec = body["records"][0]["value"]
+    assert rec["a"] == 1 and rec["_flb-key"] == "t1"
+
+
+def test_nrlogs_format_and_keys():
+    p = _make_output("nrlogs", license_key="lk", host="127.0.0.1")
+    assert "X-License-Key: lk" in p._headers()
+    raw = p.format(encode_event({"log": "hello", "svc": "x"}, 5.0), "t")
+    batch = json.loads(gzip.decompress(raw))
+    entry = batch[0]["logs"][0]
+    assert entry["message"] == "hello"
+    assert entry["timestamp"] == 5000
+    assert entry["attributes"]["svc"] == "x"
+    with pytest.raises(ValueError):
+        _make_output("nrlogs", license_key="a", api_key="b")
+
+
+def test_blob_input_emits_whole_files(tmp_path):
+    from fluentbit_tpu.core.plugin import registry
+
+    f1 = tmp_path / "a.bin"
+    f1.write_bytes(b"\x00\x01BLOB")
+    ins = registry.create_input("blob")
+    ins.set("path", str(tmp_path / "*.bin"))
+    ins.configure()
+    ins.plugin.init(ins, None)
+    captured = []
+
+    class _Eng:
+        def input_event_append(self, instance, tag, payload, etype,
+                               n_records=1):
+            captured.append((unpackb(payload), etype))
+            return n_records
+
+    ins.plugin.collect(_Eng())  # scan 1: signature recorded, no emit
+    assert len(captured) == 0   # quiescence gate (mid-copy protection)
+    ins.plugin.collect(_Eng())  # scan 2: stable → emitted
+    ins.plugin.collect(_Eng())  # unchanged: emitted once
+    assert len(captured) == 1
+    blob, etype = captured[0]
+    assert etype == "blobs"
+    assert blob["data"] == b"\x00\x01BLOB"
+    assert blob["path"].endswith("a.bin")
+    # file grows → re-emitted after it stabilizes again
+    f1.write_bytes(b"\x00\x01BLOB+more")
+    ins.plugin.collect(_Eng())
+    ins.plugin.collect(_Eng())
+    assert len(captured) == 2
+    assert captured[1][0]["data"] == b"\x00\x01BLOB+more"
+
+
+def test_podman_metrics_from_fixtures(tmp_path):
+    from fluentbit_tpu.core.plugin import registry
+
+    cid = "ab" * 32
+    state = tmp_path / "containers.json"
+    state.write_text(json.dumps([{"id": cid, "names": ["web"]}]))
+    cg = tmp_path / "cgroup" / "machine.slice" / f"libpod-{cid}.scope"
+    cg.mkdir(parents=True)
+    (cg / "memory.current").write_text("1048576\n")
+    (cg / "cpu.stat").write_text("usage_usec 2500000\nuser_usec 1\n")
+
+    ins = registry.create_input("podman_metrics")
+    ins.set("path.config", str(state))
+    ins.set("path.sysfs", str(tmp_path / "cgroup"))
+    ins.configure()
+    ins.plugin.init(ins, None)
+    captured = {}
+
+    class _Eng:
+        def input_event_append(self, instance, tag, payload, etype,
+                               n_records=1):
+            captured["obj"] = unpackb(payload)
+            return n_records
+
+    ins.plugin.collect(_Eng())
+    metrics = {m["name"]: m for m in captured["obj"]["metrics"]}
+    mem = metrics["container_memory_usage_bytes"]["values"][0]
+    assert mem["value"] == 1048576.0
+    assert mem["labels"] == [cid[:12], "web"]
+    cpu = metrics["container_cpu_usage_seconds_total"]["values"][0]
+    assert cpu["value"] == 2.5
+
+
+def test_dns_cache_resolves_and_caches():
+    from fluentbit_tpu.core import upstream
+
+    async def main():
+        addrs = await upstream.resolve("localhost", 80)
+        # multi-address fallback preserved: full getaddrinfo order
+        assert set(addrs) & {"127.0.0.1", "::1"}
+        assert ("localhost", 80) in upstream._dns_cache
+        # literal addresses bypass the cache
+        assert await upstream.resolve("10.1.2.3", 80) == ["10.1.2.3"]
+
+    asyncio.run(main())
