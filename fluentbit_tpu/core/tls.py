@@ -78,12 +78,19 @@ async def open_connection(ins, host: str, port: int, timeout=None):
     # getaddrinfo order — dual-stack fallback must survive the cache.
     sni = (client_server_hostname(ins) or host) if ctx else None
     last_err: Exception = OSError(f"no addresses for {host}")
+    # the timeout bounds the WHOLE connect (all fallback addresses
+    # together), like the single wait_for before multi-address dialing
+    deadline = None if timeout is None else \
+        asyncio.get_event_loop().time() + timeout
     for addr in addrs:
         coro = asyncio.open_connection(addr, port, ssl=ctx,
                                        server_hostname=sni)
         try:
-            if timeout is not None:
-                return await asyncio.wait_for(coro, timeout)
+            if deadline is not None:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError()
+                return await asyncio.wait_for(coro, remaining)
             return await coro
         except (OSError, asyncio.TimeoutError) as e:
             last_err = e

@@ -37,4 +37,6 @@ from . import in_kubernetes_events  # noqa: F401
 from . import out_websocket  # noqa: F401
 from . import out_pgsql  # noqa: F401
 from . import misc_tail3  # noqa: F401
+from . import prometheus_remote_write  # noqa: F401
+from . import in_mqtt  # noqa: F401
 from . import gated  # noqa: F401

@@ -46,10 +46,6 @@ _gate(InputPlugin, "ebpf", "libbpf CO-RE")
 _gate(InputPlugin, "systemd", "libsystemd (journald)")
 _gate(InputPlugin, "winlog", "the Windows Event Log API")
 _gate(InputPlugin, "winevtlog", "the Windows Event Log API")
-_gate(OutputPlugin, "prometheus_remote_write",
-      "snappy (the remote-write protobuf frame is snappy-compressed)")
-_gate(InputPlugin, "prometheus_remote_write", "snappy")
-_gate(InputPlugin, "mqtt", "an MQTT broker protocol stack")
 
 _gate(CustomPlugin, "calyptia",
       "the Calyptia Cloud control plane (remote fleet management API)",

@@ -1,0 +1,170 @@
+"""MQTT 3.1.1 server input.
+
+Reference: plugins/in_mqtt (mqtt_prot.c — a broker-side listener, not a
+client: devices CONNECT straight to the agent and PUBLISH JSON payloads;
+the plugin answers CONNACK/PUBACK and appends each publish as one record
+``{"topic": <topic>, ...payload keys}``, or nesting the payload map under
+``payload_key`` when configured, mqtt_prot.c:126-200). QoS 0/1/2 publish
+flows are acknowledged (PUBACK / PUBREC+PUBCOMP, mqtt_prot.c:302-330);
+non-JSON payloads are warned and dropped, the connection stays up.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+log = logging.getLogger("flb.in_mqtt")
+
+# control packet types (spec §2.2.1)
+CONNECT = 1
+CONNACK = 2
+PUBLISH = 3
+PUBACK = 4
+PUBREC = 5
+PUBREL = 6
+PUBCOMP = 7
+SUBSCRIBE = 8
+SUBACK = 9
+UNSUBSCRIBE = 10
+UNSUBACK = 11
+PINGREQ = 12
+PINGRESP = 13
+DISCONNECT = 14
+
+
+async def _read_packet(reader):
+    """One control packet → (type, flags, payload bytes)."""
+    first = await reader.readexactly(1)
+    ptype = first[0] >> 4
+    flags = first[0] & 0x0F
+    # remaining length: 1..4 continuation-bit bytes (spec §2.2.3)
+    mult = 1
+    length = 0
+    for _ in range(4):
+        b = (await reader.readexactly(1))[0]
+        length += (b & 0x7F) * mult
+        if not b & 0x80:
+            break
+        mult *= 128
+    else:
+        raise ValueError("malformed remaining length")
+    payload = await reader.readexactly(length) if length else b""
+    return ptype, flags, payload
+
+
+@registry.register
+class MqttInput(InputPlugin):
+    name = "mqtt"
+    description = "MQTT 3.1.1 server (broker-side listener)"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=1883),
+        ConfigMapEntry("payload_key", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._server = None
+        self.bound_port: Optional[int] = None
+
+    async def start_server(self, engine) -> None:
+        from ..core.tls import server_context
+
+        async def handle(reader, writer):
+            await self._handle_conn(reader, writer, engine)
+
+        self._server = await asyncio.start_server(
+            handle, self.listen, self.port,
+            ssl=server_context(self.instance),
+        )
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def _handle_conn(self, reader, writer, engine) -> None:
+        connected = False
+        try:
+            while True:
+                try:
+                    ptype, flags, payload = await _read_packet(reader)
+                except (asyncio.IncompleteReadError, ValueError):
+                    break
+                if not connected:
+                    # the first packet MUST be CONNECT (mqtt_prot.c:391)
+                    if ptype != CONNECT:
+                        break
+                    # CONNACK: session-present 0, return code 0
+                    writer.write(bytes([CONNACK << 4, 2, 0, 0]))
+                    await writer.drain()
+                    connected = True
+                    continue
+                if ptype == PUBLISH:
+                    if not self._handle_publish(flags, payload, writer,
+                                                engine):
+                        break
+                    await writer.drain()
+                elif ptype == PUBREL:
+                    # QoS2 leg 2: answer PUBCOMP with the same packet id
+                    writer.write(bytes([PUBCOMP << 4, 2]) + payload[:2])
+                    await writer.drain()
+                elif ptype == PINGREQ:
+                    writer.write(bytes([PINGRESP << 4, 0]))
+                    await writer.drain()
+                elif ptype == DISCONNECT:
+                    break
+                elif ptype in (SUBSCRIBE, UNSUBSCRIBE):
+                    # not a broker: acknowledge with failure code so
+                    # well-behaved clients notice (0x80 = failure)
+                    resp = SUBACK if ptype == SUBSCRIBE else UNSUBACK
+                    body = payload[:2] + (b"\x80" if resp == SUBACK else b"")
+                    writer.write(bytes([resp << 4, len(body)]) + body)
+                    await writer.drain()
+                # anything else: ignore
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _handle_publish(self, flags, payload, writer, engine) -> bool:
+        qos = (flags >> 1) & 0x03
+        if len(payload) < 2:
+            return False
+        topic_len = int.from_bytes(payload[:2], "big")
+        if 2 + topic_len > len(payload):
+            return False
+        topic = payload[2:2 + topic_len].decode("utf-8", "replace")
+        pos = 2 + topic_len
+        if qos > 0:
+            if pos + 2 > len(payload):
+                return False
+            pkt_id = payload[pos:pos + 2]
+            pos += 2
+            ack = PUBACK if qos == 1 else PUBREC
+            writer.write(bytes([ack << 4, 2]) + pkt_id)
+        msg = payload[pos:]
+        try:
+            obj = json.loads(msg.decode("utf-8"))
+            if not isinstance(obj, dict):
+                raise ValueError
+        except (ValueError, UnicodeDecodeError):
+            log.warning("mqtt: packet incomplete or is not JSON")
+            return True  # drop the record, keep the connection
+        body = {"topic": topic}
+        if self.payload_key:
+            body[self.payload_key] = obj
+        else:
+            body.update(obj)
+        engine.input_log_append(
+            self.instance, self.instance.tag,
+            encode_event(body, now_event_time()), 1)
+        return True

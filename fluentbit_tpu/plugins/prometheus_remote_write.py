@@ -1,0 +1,270 @@
+"""Prometheus remote-write: server input + client output.
+
+Reference: plugins/in_prometheus_remote_write (HTTP server decoding
+snappy-compressed protobuf WriteRequest frames into cmetrics contexts
+via cmt_decode_prometheus_remote_write.c) and
+plugins/out_prometheus_remote_write (remote_write.c — encodes metrics
+chunks with cmt_encode_prometheus_remote_write.c, POSTs with
+``Content-Encoding: snappy`` + ``X-Prometheus-Remote-Write-Version:
+0.1.0``). Both ends here speak the same wire schema via the from-scratch
+``utils/snappy.py`` + ``utils/protobuf.py``:
+
+    message WriteRequest { repeated TimeSeries timeseries = 1; }
+    message TimeSeries   { repeated Label labels = 1;
+                           repeated Sample samples = 2; }
+    message Label        { string name = 1; string value = 2; }
+    message Sample       { double value = 1; int64 timestamp = 2; }  # ms
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+from ..codec.chunk import EVENT_TYPE_METRICS
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FlushResult, registry
+from ..utils import protobuf as pb
+from ..utils import snappy
+from .net_http import HttpServerInputBase
+from .outputs_basic import _metrics_payloads
+from .outputs_http_based import _HttpDeliveryOutput
+
+# ------------------------------------------------------ wire <-> series
+
+
+def encode_write_request(series: List[Tuple[List[Tuple[str, str]],
+                                            List[Tuple[float, int]]]]) -> bytes:
+    """[(labels, [(value, ts_ms)])] → WriteRequest bytes."""
+    out = bytearray()
+    for labels, samples in series:
+        ts_body = bytearray()
+        # spec: "Labels MUST be sorted by name" — __name__ sorts first
+        # naturally (underscores precede lowercase letters)
+        for name, value in sorted(labels):
+            lbl = bytearray()
+            pb.write_string_field(1, name, lbl)
+            pb.write_string_field(2, str(value), lbl)
+            pb.write_message_field(1, bytes(lbl), ts_body)
+        for value, ts_ms in samples:
+            smp = bytearray()
+            pb.write_double_field(1, float(value), smp)
+            pb.write_varint_field(2, ts_ms & 0xFFFFFFFFFFFFFFFF
+                                  if ts_ms < 0 else ts_ms, smp)
+            pb.write_message_field(2, bytes(smp), ts_body)
+        pb.write_message_field(1, bytes(ts_body), out)
+    return bytes(out)
+
+
+def decode_write_request(data: bytes) -> List[Tuple[Dict[str, str],
+                                                    List[Tuple[float, int]]]]:
+    """WriteRequest bytes → [(labels_dict, [(value, ts_ms)])]."""
+    series = []
+    for field, wt, body in pb.iter_fields(data):
+        if field != 1 or wt != 2:
+            continue
+        labels: Dict[str, str] = {}
+        samples: List[Tuple[float, int]] = []
+        for f2, w2, val in pb.iter_fields(body):
+            if f2 == 1 and w2 == 2:  # Label
+                name = value = ""
+                for f3, w3, v3 in pb.iter_fields(val):
+                    if f3 == 1 and w3 == 2:
+                        name = v3.decode("utf-8", "replace")
+                    elif f3 == 2 and w3 == 2:
+                        value = v3.decode("utf-8", "replace")
+                if name:
+                    labels[name] = value
+            elif f2 == 2 and w2 == 2:  # Sample
+                v = 0.0
+                ts = 0
+                for f3, w3, v3 in pb.iter_fields(val):
+                    if f3 == 1 and w3 == 1:
+                        v = pb.decode_double(v3)
+                    elif f3 == 2 and w3 == 0:
+                        ts = pb.to_int64(v3)
+                samples.append((v, ts))
+        series.append((labels, samples))
+    return series
+
+
+def payloads_to_series(payloads: List[dict]):
+    """Internal metrics snapshots → remote-write timeseries. Histograms
+    expand to the _bucket/_sum/_count convention (the same expansion
+    cmt_encode_prometheus_remote_write.c performs)."""
+    series = []
+    for payload in payloads:
+        for m in payload.get("metrics", []):
+            fq = m.get("name", "")
+            keys = tuple(m.get("labels", []))
+            ts_ms = int(float(m.get("ts") or time.time()) * 1000)
+            if m.get("type") == "histogram":
+                buckets = m.get("buckets", [])
+                for h in m.get("hist", []):
+                    lv = tuple(str(x) for x in h.get("labels", []))
+                    base = list(zip(keys, lv))
+                    cum = 0
+                    counts = h.get("counts", [])
+                    from ..core.metrics import _fmt_float
+                    for b, c in zip(buckets, counts):
+                        cum += c
+                        series.append((
+                            [("__name__", fq + "_bucket")] + base
+                            + [("le", _fmt_float(float(b)))],
+                            [(float(cum), ts_ms)]))
+                    if len(counts) > len(buckets):
+                        cum += counts[-1]
+                    series.append((
+                        [("__name__", fq + "_bucket")] + base
+                        + [("le", "+Inf")], [(float(cum), ts_ms)]))
+                    series.append(([("__name__", fq + "_sum")] + base,
+                                   [(float(h.get("sum", 0.0)), ts_ms)]))
+                    series.append(([("__name__", fq + "_count")] + base,
+                                   [(float(cum), ts_ms)]))
+            else:
+                for s in m.get("values", []):
+                    lv = tuple(str(x) for x in s.get("labels", []))
+                    series.append((
+                        [("__name__", fq)] + list(zip(keys, lv)),
+                        [(float(s.get("value", 0.0)), ts_ms)]))
+    return series
+
+
+def series_to_payload(series) -> dict:
+    """Decoded timeseries → ONE internal metrics snapshot. Series group
+    by metric name (__name__); the label-key set of the first series of
+    a name defines the entry's label schema (remote write carries no
+    type metadata — entries come back untyped, rendered as gauges,
+    matching the reference decoder's cmt untyped context)."""
+    entries: Dict[str, dict] = {}
+    order: List[str] = []
+    ts_s = time.time()
+    for labels, samples in series:
+        name = labels.get("__name__", "")
+        if not name:
+            continue
+        rest = {k: v for k, v in labels.items() if k != "__name__"}
+        entry = entries.get(name)
+        if entry is None:
+            entry = {"name": name, "type": "gauge", "desc": "",
+                     "labels": sorted(rest.keys()), "ts": ts_s,
+                     "values": []}
+            entries[name] = entry
+            order.append(name)
+        keys = entry["labels"]
+        for value, ts_ms in samples:
+            entry["values"].append(
+                {"labels": [rest.get(k, "") for k in keys],
+                 "value": value})
+            if ts_ms:
+                entry["ts"] = ts_ms / 1000.0
+    return {"meta": {"ts": ts_s},
+            "metrics": [entries[n] for n in order]}
+
+
+# ------------------------------------------------------------- input
+
+
+@registry.register
+class PrometheusRemoteWriteInput(HttpServerInputBase):
+    """plugins/in_prometheus_remote_write: POST /api/v1/write server."""
+
+    name = "prometheus_remote_write"
+    description = "Prometheus remote-write server"
+    config_map = [
+        ConfigMapEntry("listen", "str", default="0.0.0.0"),
+        ConfigMapEntry("port", "int", default=8080),
+        ConfigMapEntry("uri", "str", default="/api/v1/write"),
+        ConfigMapEntry("tag_from_uri", "bool", default=False),
+    ]
+
+    def handle_request(self, engine, method, path, headers, body):
+        if method != "POST":
+            return 405, b"method not allowed"
+        want = self.uri or "/api/v1/write"
+        if not self.tag_from_uri and path != want:
+            return 404, b"not found"
+        enc = (headers.get("content-encoding") or "snappy").lower()
+        try:
+            if enc == "snappy":
+                body = snappy.decompress(body)
+            elif enc in ("identity", ""):
+                pass
+            else:
+                return 400, b"unsupported content-encoding"
+            series = decode_write_request(body)
+        except (snappy.SnappyError, pb.ProtobufError, ValueError):
+            return 400, b"bad write request"
+        if series:
+            payload = series_to_payload(series)
+            from ..codec.msgpack import packb
+            tag = self.instance.tag
+            if self.tag_from_uri and path.strip("/"):
+                tag = path.strip("/").replace("/", ".")
+            engine.input_event_append(
+                self.instance, tag, packb(payload), EVENT_TYPE_METRICS,
+                n_records=len(payload["metrics"]))
+        # 204: the success status prometheus expects from a receiver
+        return 204, b""
+
+
+# ------------------------------------------------------------ output
+
+
+@registry.register
+class PrometheusRemoteWriteOutput(_HttpDeliveryOutput):
+    """plugins/out_prometheus_remote_write."""
+
+    name = "prometheus_remote_write"
+    event_types = (EVENT_TYPE_METRICS,)
+    config_map = [
+        ConfigMapEntry("host", "str", default="127.0.0.1"),
+        ConfigMapEntry("port", "int", default=8080),
+        ConfigMapEntry("uri", "str", default="/api/v1/write"),
+        ConfigMapEntry("http_user", "str"),
+        ConfigMapEntry("http_passwd", "str", default=""),
+        ConfigMapEntry("add_label", "slist", multiple=True,
+                       slist_max_split=1),
+        ConfigMapEntry("header", "slist", multiple=True,
+                       slist_max_split=1),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._extra_labels = []
+        for pair in self.add_label or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) == 2:
+                self._extra_labels.append((parts[0], parts[1]))
+
+    def _content_type(self) -> str:
+        return "application/x-protobuf"
+
+    def _headers(self) -> List[str]:
+        hdrs = ["Content-Encoding: snappy",
+                "X-Prometheus-Remote-Write-Version: 0.1.0"]
+        if self.http_user:
+            import base64
+            cred = base64.b64encode(
+                f"{self.http_user}:{self.http_passwd}".encode()).decode()
+            hdrs.append(f"Authorization: Basic {cred}")
+        for pair in self.header or []:
+            parts = pair if isinstance(pair, list) else pair.split(None, 1)
+            if len(parts) == 2:
+                hdrs.append(f"{parts[0]}: {parts[1]}")
+        return hdrs
+
+    def _format_payloads(self, payloads) -> bytes:
+        series = payloads_to_series(payloads)
+        if self._extra_labels:
+            series = [(labels + self._extra_labels, samples)
+                      for labels, samples in series]
+        return snappy.compress(encode_write_request(series))
+
+    def format(self, data: bytes, tag: str) -> bytes:
+        return self._format_payloads(_metrics_payloads(data))
+
+    async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        payloads = _metrics_payloads(data)
+        if not payloads:
+            return FlushResult.ERROR
+        return await self._post(self._format_payloads(payloads))

@@ -4,8 +4,9 @@ Reference: src/flb_gzip.c, src/flb_snappy.c, src/flb_zstd.c,
 src/flb_compression.c (payload compression for outputs/forward);
 src/flb_crypto.c, src/flb_hmac.c, src/flb_base64.c, src/flb_uri.c,
 src/flb_utf8.c (hashing, signing, encoding). Python's stdlib provides
-gzip/zlib/base64/hmac/hashlib; snappy and zstd have no vendored
-equivalents in this image and are gated — ``compress('snappy', ...)``
+gzip/zlib/base64/hmac/hashlib; snappy is implemented from scratch in
+``utils/snappy.py`` (block + framing formats); zstd has no vendored
+equivalent in this image and is gated — ``compress('zstd', ...)``
 raises a clear error instead of silently passing data through.
 """
 
@@ -24,7 +25,7 @@ class CompressionError(ValueError):
     pass
 
 
-_GATED = {"snappy", "zstd", "lz4"}
+_GATED = {"zstd", "lz4"}
 
 
 def compress(algo: str, data: bytes, level: int = 6) -> bytes:
@@ -34,6 +35,9 @@ def compress(algo: str, data: bytes, level: int = 6) -> bytes:
         return _gzip.compress(data, compresslevel=level)
     if a in ("zlib", "deflate"):
         return zlib.compress(data, level)
+    if a == "snappy":
+        from . import snappy as _snappy
+        return _snappy.compress(data)
     if a in _GATED:
         raise CompressionError(
             f"{a} is not available in this build (no vendored codec); "
@@ -48,6 +52,9 @@ def decompress(algo: str, data: bytes) -> bytes:
         return _gzip.decompress(data)
     if a in ("zlib", "deflate"):
         return zlib.decompress(data)
+    if a == "snappy":
+        from . import snappy as _snappy
+        return _snappy.decompress(data)
     if a in _GATED:
         raise CompressionError(
             f"{a} is not available in this build (no vendored codec)"

@@ -1,0 +1,138 @@
+"""Minimal protobuf wire-format helpers (proto3 encoding).
+
+The reference links full protobuf-c stacks for remote-write / OTLP
+(e.g. plugins/out_prometheus_remote_write uses cmetrics'
+cmt_encode_prometheus_remote_write.c, a hand-rolled wire encoder).
+This is the same stance: no codegen, just the five wire types —
+enough to encode/decode the small fixed schemas the plugins speak
+(prometheus.WriteRequest and friends).
+
+Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Tuple
+
+
+class ProtobufError(ValueError):
+    pass
+
+
+# ----------------------------------------------------------- encode
+
+def write_varint(n: int, out: bytearray) -> None:
+    if n < 0:
+        n &= 0xFFFFFFFFFFFFFFFF  # two's-complement 64-bit (int64 fields)
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+
+
+def write_tag(field: int, wire_type: int, out: bytearray) -> None:
+    write_varint((field << 3) | wire_type, out)
+
+
+def write_varint_field(field: int, value: int, out: bytearray) -> None:
+    if value == 0:
+        return
+    write_tag(field, 0, out)
+    write_varint(value, out)
+
+
+def write_double_field(field: int, value: float, out: bytearray) -> None:
+    if value == 0.0 and not _is_neg_zero(value):
+        return
+    write_tag(field, 1, out)
+    out += struct.pack("<d", value)
+
+
+def _is_neg_zero(v: float) -> bool:
+    return v == 0.0 and struct.pack("<d", v) != struct.pack("<d", 0.0)
+
+
+def write_bytes_field(field: int, value: bytes, out: bytearray) -> None:
+    if not value:
+        return
+    write_tag(field, 2, out)
+    write_varint(len(value), out)
+    out += value
+
+
+def write_string_field(field: int, value: str, out: bytearray) -> None:
+    write_bytes_field(field, value.encode("utf-8"), out)
+
+
+def write_message_field(field: int, body: bytes, out: bytearray) -> None:
+    """Submessages are emitted even when empty (presence semantics)."""
+    write_tag(field, 2, out)
+    write_varint(len(body), out)
+    out += body
+
+
+# ----------------------------------------------------------- decode
+
+def read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(data):
+            raise ProtobufError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ProtobufError("varint too long")
+
+
+def iter_fields(data: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value). Length-delimited values
+    come back as bytes; varints as int; fixed64/32 as raw bytes."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        key, pos = read_varint(data, pos)
+        field = key >> 3
+        wt = key & 7
+        if wt == 0:
+            val, pos = read_varint(data, pos)
+        elif wt == 1:
+            if pos + 8 > n:
+                raise ProtobufError("truncated fixed64")
+            val = data[pos:pos + 8]
+            pos += 8
+        elif wt == 2:
+            ln, pos = read_varint(data, pos)
+            if pos + ln > n:
+                raise ProtobufError("truncated length-delimited field")
+            val = data[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            if pos + 4 > n:
+                raise ProtobufError("truncated fixed32")
+            val = data[pos:pos + 4]
+            pos += 4
+        else:
+            raise ProtobufError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def decode_double(raw: bytes) -> float:
+    return struct.unpack("<d", raw)[0]
+
+
+def to_int64(v: int) -> int:
+    """Interpret a decoded varint as a signed int64."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def group_fields(data: bytes) -> Dict[int, List[object]]:
+    out: Dict[int, List[object]] = {}
+    for field, _wt, val in iter_fields(data):
+        out.setdefault(field, []).append(val)
+    return out

@@ -1,0 +1,285 @@
+"""Snappy codec — block format + framing format, from scratch.
+
+Reference: src/flb_snappy.c wraps the vendored C++ lib/snappy-fef67ac;
+this build implements the format directly (format_description.txt and
+framing_format.txt from the public spec) so the remote-write plugins
+(plugins/in_prometheus_remote_write, plugins/out_prometheus_remote_write)
+and forward's snappy option need no vendored runtime.
+
+Block format: a varint32 preamble with the uncompressed length, then a
+sequence of elements tagged by the low 2 bits of the first byte —
+00 literal (length in the high 6 bits, or 60..63 selecting 1..4
+little-endian length bytes), 01 copy with 3-bit length + 11-bit offset,
+10 copy with 6-bit length + 16-bit offset, 11 copy with 32-bit offset.
+
+Framing format: 4-byte chunk headers (type + 24-bit length); stream
+identifier chunk 0xFF "sNaPpY", compressed (0x00) / uncompressed (0x01)
+data chunks carrying a masked CRC-32C of the uncompressed data.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_MAX_BLOCK = 65536
+_HASH_BITS = 14
+_HASH_SIZE = 1 << _HASH_BITS
+
+
+class SnappyError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------ varint
+
+def _put_varint(n: int, out: bytearray) -> None:
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+
+
+def _get_varint(data, pos: int):
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(data):
+            raise SnappyError("truncated varint preamble")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 35:
+            raise SnappyError("varint preamble overflow")
+
+
+# -------------------------------------------------------- decompress
+
+def decompress(data: bytes) -> bytes:
+    """Snappy block-format decode (format_description.txt §2-4)."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise TypeError("snappy.decompress expects bytes")
+    data = bytes(data)
+    expected, pos = _get_varint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = tag >> 2
+            if length >= 60:
+                extra = length - 59
+                if pos + extra > n:
+                    raise SnappyError("truncated literal length")
+                length = int.from_bytes(data[pos:pos + extra], "little")
+                pos += extra
+            length += 1
+            if pos + length > n:
+                raise SnappyError("truncated literal body")
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            if pos >= n:
+                raise SnappyError("truncated copy-1 offset")
+            length = 4 + ((tag >> 2) & 0x7)
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            if pos + 2 > n:
+                raise SnappyError("truncated copy-2 offset")
+            length = (tag >> 2) + 1
+            offset = data[pos] | (data[pos + 1] << 8)
+            pos += 2
+        else:  # copy, 4-byte offset
+            if pos + 4 > n:
+                raise SnappyError("truncated copy-4 offset")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise SnappyError("copy offset out of range")
+        # overlapping copies are legal and meaningful (RLE-style)
+        if offset >= length:
+            start = len(out) - offset
+            out += out[start:start + length]
+        else:
+            start = len(out) - offset
+            for i in range(length):
+                out.append(out[start + i])
+    if len(out) != expected:
+        raise SnappyError(
+            f"decompressed length {len(out)} != preamble {expected}")
+    return bytes(out)
+
+
+# ---------------------------------------------------------- compress
+
+def _emit_literal(data, start: int, end: int, out: bytearray) -> None:
+    length = end - start
+    if length <= 0:
+        return
+    n = length - 1
+    if n < 60:
+        out.append(n << 2)
+    elif n < (1 << 8):
+        out.append(60 << 2)
+        out.append(n)
+    elif n < (1 << 16):
+        out.append(61 << 2)
+        out += n.to_bytes(2, "little")
+    elif n < (1 << 24):
+        out.append(62 << 2)
+        out += n.to_bytes(3, "little")
+    else:
+        out.append(63 << 2)
+        out += n.to_bytes(4, "little")
+    out += data[start:end]
+
+
+def _emit_copy(offset: int, length: int, out: bytearray) -> None:
+    # copy-2 carries length 1..64; split longer matches
+    while length > 64:
+        out.append((63 << 2) | 2)
+        out += offset.to_bytes(2, "little")
+        length -= 64
+    if 4 <= length <= 11 and offset < 2048:
+        out.append(((offset >> 8) << 5) | ((length - 4) << 2) | 1)
+        out.append(offset & 0xFF)
+    else:
+        out.append(((length - 1) << 2) | 2)
+        out += offset.to_bytes(2, "little")
+
+
+def _compress_block(data: bytes, out: bytearray) -> None:
+    n = len(data)
+    if n < 4:
+        _emit_literal(data, 0, n, out)
+        return
+    table = [0] * _HASH_SIZE
+    # table stores pos+1 (0 == empty)
+    shift = 32 - _HASH_BITS
+    lit_start = 0
+    pos = 0
+    limit = n - 3
+    u32 = struct.unpack_from
+    while pos < limit:
+        cur = u32("<I", data, pos)[0]
+        h = (cur * 0x1E35A7BD & 0xFFFFFFFF) >> shift
+        cand = table[h] - 1
+        table[h] = pos + 1
+        if cand >= 0 and u32("<I", data, cand)[0] == cur:
+            # extend the match
+            m = pos + 4
+            c = cand + 4
+            while m < n and data[m] == data[c]:
+                m += 1
+                c += 1
+            _emit_literal(data, lit_start, pos, out)
+            _emit_copy(pos - cand, m - pos, out)
+            pos = m
+            lit_start = m
+        else:
+            pos += 1
+    _emit_literal(data, lit_start, n, out)
+
+
+def compress(data: bytes) -> bytes:
+    """Snappy block-format encode (greedy hash-table matcher, the same
+    strategy class as the C++ reference encoder; any spec-conforming
+    stream is valid output)."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise TypeError("snappy.compress expects bytes")
+    data = bytes(data)
+    out = bytearray()
+    _put_varint(len(data), out)
+    for off in range(0, len(data), _MAX_BLOCK):
+        _compress_block(data[off:off + _MAX_BLOCK], out)
+    return bytes(out)
+
+
+# ------------------------------------------------------------ crc32c
+
+_CRC32C_POLY = 0x82F63B78
+_crc_table = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ _CRC32C_POLY if _c & 1 else _c >> 1
+    _crc_table.append(_c)
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    tab = _crc_table
+    for b in data:
+        crc = (crc >> 8) ^ tab[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    c = crc32c(data)
+    return ((c >> 15) | (c << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------- framing
+
+_STREAM_ID = b"\xff\x06\x00\x00sNaPpY"
+
+
+def frame_compress(data: bytes) -> bytes:
+    """Framing-format encode: stream identifier + compressed chunks."""
+    out = bytearray(_STREAM_ID)
+    for off in range(0, len(data), _MAX_BLOCK) or [0]:
+        block = data[off:off + _MAX_BLOCK]
+        body = compress(block)
+        crc = _masked_crc(block).to_bytes(4, "little")
+        if len(body) < len(block):
+            payload = crc + body
+            out.append(0x00)
+        else:
+            payload = crc + block
+            out.append(0x01)
+        out += len(payload).to_bytes(3, "little")
+        out += payload
+    return bytes(out)
+
+
+def frame_decompress(data: bytes) -> bytes:
+    """Framing-format decode with CRC-32C verification."""
+    pos = 0
+    n = len(data)
+    out = bytearray()
+    seen_id = False
+    while pos < n:
+        if pos + 4 > n:
+            raise SnappyError("truncated frame header")
+        ctype = data[pos]
+        length = int.from_bytes(data[pos + 1:pos + 4], "little")
+        pos += 4
+        if pos + length > n:
+            raise SnappyError("truncated frame body")
+        body = data[pos:pos + length]
+        pos += length
+        if ctype == 0xFF:
+            if body != _STREAM_ID[4:]:
+                raise SnappyError("bad stream identifier")
+            seen_id = True
+        elif ctype in (0x00, 0x01):
+            if not seen_id:
+                raise SnappyError("data chunk before stream identifier")
+            if length < 4:
+                raise SnappyError("data chunk too short for CRC")
+            crc = int.from_bytes(body[:4], "little")
+            block = decompress(body[4:]) if ctype == 0x00 else bytes(body[4:])
+            if _masked_crc(block) != crc:
+                raise SnappyError("frame CRC mismatch")
+            out += block
+        elif 0x02 <= ctype <= 0x7F:
+            raise SnappyError(f"unskippable chunk type {ctype:#x}")
+        # 0x80..0xFE: skippable, ignore
+    return bytes(out)

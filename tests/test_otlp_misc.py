@@ -161,10 +161,10 @@ def test_out_nats_stub():
     assert '"m":1' in data.replace(" ", "")
 
 
-def test_gated_prometheus_remote_write():
+def test_gated_output_fails_loudly():
     from fluentbit_tpu.core.plugin import registry
 
-    ins = registry.create_output("prometheus_remote_write")
+    ins = registry.create_output("kafka")
     ins.configure()
-    with pytest.raises(RuntimeError, match="snappy"):
+    with pytest.raises(RuntimeError, match="librdkafka"):
         ins.plugin.init(ins, None)

@@ -76,10 +76,10 @@ def test_device_kernel_python_stdlib_differential():
 
 def test_compression_roundtrip_and_gates():
     data = b"payload " * 100
-    for algo in ("gzip", "zlib"):
+    for algo in ("gzip", "zlib", "snappy"):
         assert utils.decompress(algo, utils.compress(algo, data)) == data
     with pytest.raises(utils.CompressionError):
-        utils.compress("snappy", data)
+        utils.compress("zstd", data)
     with pytest.raises(utils.CompressionError):
         utils.compress("nope", data)
 
