@@ -83,15 +83,17 @@ async def open_connection(ins, host: str, port: int, timeout=None):
     deadline = None if timeout is None else \
         asyncio.get_event_loop().time() + timeout
     for addr in addrs:
-        coro = asyncio.open_connection(addr, port, ssl=ctx,
-                                       server_hostname=sni)
         try:
             if deadline is not None:
                 remaining = deadline - asyncio.get_event_loop().time()
                 if remaining <= 0:
                     raise asyncio.TimeoutError()
-                return await asyncio.wait_for(coro, remaining)
-            return await coro
+                return await asyncio.wait_for(
+                    asyncio.open_connection(addr, port, ssl=ctx,
+                                            server_hostname=sni),
+                    remaining)
+            return await asyncio.open_connection(
+                addr, port, ssl=ctx, server_hostname=sni)
         except (OSError, asyncio.TimeoutError) as e:
             last_err = e
     invalidate_dns(host, port)  # every cached address failed

@@ -1,0 +1,75 @@
+"""From-scratch Lua 5.1 runtime for filter_lua.
+
+The reference embeds LuaJIT (lib/luajit-7152e154 via src/flb_luajit.c);
+this package interprets the language directly — lexer/parser
+(lexer.py, parser.py), tree-walking evaluator (interp.py), the stdlib
+subset scripts rely on (stdlib.py) including full Lua pattern matching
+(patterns.py). Python↔Lua value bridging mirrors flb_lua.c's
+msgpack↔lua conversions (flb_lua_pushmsgpack / flb_lua_tomsgpack).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .interp import (  # noqa: F401
+    LuaError,
+    LuaFunction,
+    LuaRuntime,
+    LuaTable,
+    lua_tostring,
+)
+
+
+def py_to_lua(v: Any):
+    """Python (decoded msgpack record) → Lua value (flb_lua_pushmsgpack,
+    src/flb_lua.c). Dicts/lists become tables; numbers become Lua
+    numbers (doubles); bytes decode as UTF-8 with replacement."""
+    if v is None or isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if isinstance(v, dict):
+        t = LuaTable()
+        for k, val in v.items():
+            t.set(py_to_lua(k), py_to_lua(val))
+        return t
+    if isinstance(v, (list, tuple)):
+        t = LuaTable()
+        for i, val in enumerate(v):
+            t.set(float(i + 1), py_to_lua(val))
+        return t
+    return str(v)
+
+
+def lua_to_py(v: Any):
+    """Lua value → Python (flb_lua_tomsgpack): a table whose keys are
+    exactly 1..n becomes a list, otherwise a dict; integral floats
+    become ints (so msgpack re-encodes them compactly, matching the
+    reference's dual int/double packing)."""
+    if v is None or isinstance(v, bool) or isinstance(v, str):
+        return v
+    if isinstance(v, float):
+        return int(v) if v.is_integer() and abs(v) < 2 ** 63 else v
+    if isinstance(v, LuaTable):
+        keys = list(v.hash.keys())
+        n = v.length()
+        if keys and n == len(keys):
+            return [lua_to_py(v.hash[i]) for i in range(1, n + 1)]
+        out = {}
+        for k, val in v.hash.items():
+            if isinstance(k, tuple):  # normalized bool key
+                k = k[1]
+            if isinstance(k, int):
+                key = k
+            elif isinstance(k, float):
+                key = int(k) if k.is_integer() else k
+            else:
+                key = k
+            out[key if isinstance(key, str) else str(key)] = lua_to_py(val)
+        return out
+    return lua_tostring(v)

@@ -1,0 +1,569 @@
+"""Lua 5.1 tree-walking interpreter.
+
+Values map: nil→None, boolean→bool, number→float, string→str,
+table→LuaTable, function→LuaFunction | Python callable. Multiple
+returns travel as Python lists at call/return boundaries; expression
+contexts truncate to the first value (adjust()).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+from .parser import parse
+
+
+class LuaError(Exception):
+    """error() / runtime faults; .value is the Lua error value."""
+
+    def __init__(self, value):
+        super().__init__(lua_tostring(value) if not isinstance(value, str)
+                         else value)
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Return(Exception):
+    def __init__(self, values: List[Any]):
+        self.values = values
+
+
+def _normkey(k):
+    """Table keys: float with integral value folds to int (Lua numbers
+    are doubles; 1 and 1.0 are the same key)."""
+    if isinstance(k, float) and k.is_integer():
+        return int(k)
+    if isinstance(k, bool):  # True is not 1 in Lua tables
+        return ("bool", k)
+    return k
+
+
+class LuaTable:
+    __slots__ = ("hash", "metatable")
+
+    def __init__(self):
+        self.hash: Dict[Any, Any] = {}
+        self.metatable: Optional["LuaTable"] = None
+
+    def get(self, key):
+        v = self.hash.get(_normkey(key))
+        if v is None and self.metatable is not None:
+            idx = self.metatable.hash.get("__index")
+            if isinstance(idx, LuaTable):
+                return idx.get(key)
+            if callable(idx) or isinstance(idx, LuaFunction):
+                return adjust(call_value(idx, [self, key]))
+        return v
+
+    def set(self, key, value):
+        if key is None:
+            raise LuaError("table index is nil")
+        if isinstance(key, float) and math.isnan(key):
+            raise LuaError("table index is NaN")
+        k = _normkey(key)
+        if value is None:
+            self.hash.pop(k, None)
+        else:
+            self.hash[k] = value
+
+    def length(self) -> int:
+        """'#': a border — count consecutive integer keys from 1."""
+        n = 0
+        while (n + 1) in self.hash:
+            n += 1
+        return n
+
+    def py_items(self):
+        return self.hash.items()
+
+
+class LuaFunction:
+    __slots__ = ("params", "is_vararg", "body", "scope", "name")
+
+    def __init__(self, params, is_vararg, body, scope, name="?"):
+        self.params = params
+        self.is_vararg = is_vararg
+        self.body = body
+        self.scope = scope
+        self.name = name
+
+
+class Scope:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent: Optional["Scope"]):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+
+    def lookup(self, name: str) -> Optional["Scope"]:
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return s
+            s = s.parent
+        return None
+
+
+def truthy(v) -> bool:
+    return v is not None and v is not False
+
+
+def adjust(values) -> Any:
+    """Multi-value → single value."""
+    if isinstance(values, list):
+        return values[0] if values else None
+    return values
+
+
+def lua_tostring(v) -> str:
+    if v is None:
+        return "nil"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float):
+        return fmt_number(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, LuaTable):
+        if v.metatable is not None:
+            ts = v.metatable.hash.get("__tostring")
+            if ts is not None:
+                return adjust(call_value(ts, [v]))
+        return f"table: 0x{id(v):012x}"
+    if isinstance(v, LuaFunction) or callable(v):
+        return f"function: 0x{id(v):012x}"
+    return str(v)
+
+
+def fmt_number(v: float) -> str:
+    """Lua's %.14g number formatting."""
+    if v != v:
+        return "nan" if not repr(v).startswith("-") else "-nan"
+    if v == math.inf:
+        return "inf"
+    if v == -math.inf:
+        return "-inf"
+    if v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.14g}"
+
+
+def lua_type(v) -> str:
+    if v is None:
+        return "nil"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, float):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, LuaTable):
+        return "table"
+    return "function"
+
+
+def tonumber(v, base=None):
+    if base is not None:
+        try:
+            return float(int(str(v).strip(), int(base)))
+        except (ValueError, TypeError):
+            return None
+    if isinstance(v, float):
+        return v
+    if isinstance(v, bool) or v is None:
+        return None
+    if isinstance(v, str):
+        s = v.strip()
+        try:
+            if s.lower().startswith(("0x", "-0x")):
+                return float(int(s, 16))
+            return float(s)
+        except ValueError:
+            return None
+    return None
+
+
+def _arith_num(v, op):
+    n = tonumber(v)
+    if n is None:
+        raise LuaError(
+            f"attempt to perform arithmetic ({op}) on a {lua_type(v)} value")
+    return n
+
+
+def call_value(fn, args: List[Any]) -> List[Any]:
+    """Invoke a Lua or Python function with a Lua argument list; always
+    returns a Python list of return values."""
+    if isinstance(fn, LuaFunction):
+        scope = Scope(fn.scope)
+        for i, p in enumerate(fn.params):
+            scope.vars[p] = args[i] if i < len(args) else None
+        if fn.is_vararg:
+            scope.vars["..."] = args[len(fn.params):]
+        try:
+            exec_block(fn.body, scope)
+        except _Return as r:
+            return r.values
+        return []
+    if callable(fn):
+        res = fn(*args)
+        if isinstance(res, list):
+            return res
+        if res is None:
+            return []
+        return [res]
+    if isinstance(fn, LuaTable) and fn.metatable is not None:
+        call = fn.metatable.hash.get("__call")
+        if call is not None:
+            return call_value(call, [fn] + args)
+    raise LuaError(f"attempt to call a {lua_type(fn)} value")
+
+
+# ------------------------------------------------------- interpreter
+
+
+def exec_block(block: list, scope: Scope) -> None:
+    for st in block:
+        exec_stmt(st, scope)
+
+
+def exec_stmt(st: tuple, scope: Scope) -> None:
+    op = st[0]
+    if op == "callstat":
+        eval_multi(st[1], scope)
+    elif op == "local":
+        _names, exprs = st[1], st[2]
+        vals = eval_exprlist(exprs, scope)
+        for i, name in enumerate(_names):
+            scope.vars[name] = vals[i] if i < len(vals) else None
+    elif op == "assign":
+        targets, exprs = st[1], st[2]
+        vals = eval_exprlist(exprs, scope)
+        for i, tg in enumerate(targets):
+            v = vals[i] if i < len(vals) else None
+            if tg[0] == "name":
+                s = scope.lookup(tg[1])
+                if s is None:
+                    g = scope
+                    while g.parent is not None:
+                        g = g.parent
+                    g.vars[tg[1]] = v
+                else:
+                    s.vars[tg[1]] = v
+            else:  # index
+                obj = eval_expr(tg[1], scope)
+                key = eval_expr(tg[2], scope)
+                settable(obj, key, v)
+    elif op == "if":
+        for cond, body in st[1]:
+            if truthy(eval_expr(cond, scope)):
+                exec_block(body, Scope(scope))
+                return
+        exec_block(st[2], Scope(scope))
+    elif op == "while":
+        while truthy(eval_expr(st[1], scope)):
+            try:
+                exec_block(st[2], Scope(scope))
+            except _Break:
+                break
+    elif op == "repeat":
+        while True:
+            inner = Scope(scope)
+            try:
+                exec_block(st[1], inner)
+            except _Break:
+                break
+            # until sees the body's locals (manual §2.4.4)
+            if truthy(eval_expr(st[2], inner)):
+                break
+    elif op == "fornum":
+        _, var, e1, e2, e3, body, _line = st
+        i = _arith_num(eval_expr(e1, scope), "for")
+        stop = _arith_num(eval_expr(e2, scope), "for")
+        step = _arith_num(eval_expr(e3, scope), "for")
+        if step == 0:
+            raise LuaError("'for' step is zero")
+        while (step > 0 and i <= stop) or (step < 0 and i >= stop):
+            inner = Scope(scope)
+            inner.vars[var] = i
+            try:
+                exec_block(body, inner)
+            except _Break:
+                break
+            i += step
+    elif op == "forin":
+        _, names, exprs, body, _line = st
+        vals = eval_exprlist(exprs, scope)
+        f = vals[0] if len(vals) > 0 else None
+        s = vals[1] if len(vals) > 1 else None
+        ctrl = vals[2] if len(vals) > 2 else None
+        while True:
+            rets = call_value(f, [s, ctrl])
+            first = rets[0] if rets else None
+            if first is None:
+                break
+            ctrl = first
+            inner = Scope(scope)
+            for i, name in enumerate(names):
+                inner.vars[name] = rets[i] if i < len(rets) else None
+            try:
+                exec_block(body, inner)
+            except _Break:
+                break
+    elif op == "do":
+        exec_block(st[1], Scope(scope))
+    elif op == "return":
+        raise _Return(eval_exprlist(st[1], scope))
+    elif op == "break":
+        raise _Break()
+    elif op == "localfunc":
+        _, name, fnexpr, _line = st
+        scope.vars[name] = None  # visible to itself (recursion)
+        fn = eval_expr(fnexpr, scope)
+        fn.name = name
+        scope.vars[name] = fn
+    else:  # pragma: no cover
+        raise LuaError(f"unknown statement {op}")
+
+
+def settable(obj, key, value) -> None:
+    if isinstance(obj, LuaTable):
+        if obj.metatable is not None and _normkey(key) not in obj.hash:
+            ni = obj.metatable.hash.get("__newindex")
+            if isinstance(ni, LuaTable):
+                return settable(ni, key, value)
+            if ni is not None:
+                call_value(ni, [obj, key, value])
+                return
+        obj.set(key, value)
+        return
+    raise LuaError(f"attempt to index a {lua_type(obj)} value")
+
+
+def gettable(obj, key):
+    if isinstance(obj, LuaTable):
+        return obj.get(key)
+    if isinstance(obj, str):
+        # strings carry the string library as methods (s:upper())
+        from .stdlib import STRING_LIB
+        return STRING_LIB.get(key)
+    raise LuaError(f"attempt to index a {lua_type(obj)} value")
+
+
+def eval_exprlist(exprs: List[tuple], scope: Scope) -> List[Any]:
+    """Lua adjustment: every expr but the last yields one value; the
+    last expands if it is a call/vararg."""
+    vals: List[Any] = []
+    for i, e in enumerate(exprs):
+        if i == len(exprs) - 1:
+            last = eval_multi(e, scope)
+            vals.extend(last if isinstance(last, list) else [last])
+        else:
+            vals.append(eval_expr(e, scope))
+    return vals
+
+
+def eval_multi(e: tuple, scope: Scope):
+    """Evaluate where multiple values are allowed (returns list for
+    calls/varargs, scalar otherwise)."""
+    op = e[0]
+    if op == "call":
+        fn = eval_expr(e[1], scope)
+        args = eval_exprlist(e[2], scope)
+        return call_value(fn, args)
+    if op == "method":
+        obj = eval_expr(e[1], scope)
+        fn = gettable(obj, e[2])
+        args = [obj] + eval_exprlist(e[3], scope)
+        return call_value(fn, args)
+    if op == "vararg":
+        s = scope.lookup("...")
+        return list(s.vars["..."]) if s else []
+    return eval_expr(e, scope)
+
+
+def eval_expr(e: tuple, scope: Scope) -> Any:
+    op = e[0]
+    if op == "num":
+        return e[1]
+    if op == "str":
+        return e[1]
+    if op == "nil":
+        return None
+    if op == "true":
+        return True
+    if op == "false":
+        return False
+    if op == "name":
+        s = scope.lookup(e[1])
+        return s.vars[e[1]] if s else None
+    if op == "paren":
+        return adjust(eval_multi(e[1], scope))
+    if op == "index":
+        return gettable(eval_expr(e[1], scope), eval_expr(e[2], scope))
+    if op in ("call", "method", "vararg"):
+        return adjust(eval_multi(e, scope))
+    if op == "func":
+        return LuaFunction(e[1], e[2], e[3], scope)
+    if op == "table":
+        t = LuaTable()
+        _, array, hash_ = e
+        idx = 1
+        for i, item in enumerate(array):
+            if i == len(array) - 1:
+                last = eval_multi(item, scope)
+                if isinstance(last, list):
+                    for v in last:
+                        t.set(float(idx), v)
+                        idx += 1
+                    continue
+                t.set(float(idx), last)
+            else:
+                t.set(float(idx), eval_expr(item, scope))
+            idx += 1
+        for k, v in hash_:
+            t.set(eval_expr(k, scope), eval_expr(v, scope))
+        return t
+    if op == "binop":
+        return eval_binop(e, scope)
+    if op == "unop":
+        o, v = e[1], eval_expr(e[2], scope)
+        if o == "-":
+            return -_arith_num(v, "unm")
+        if o == "not":
+            return not truthy(v)
+        if o == "#":
+            if isinstance(v, str):
+                return float(len(v))
+            if isinstance(v, LuaTable):
+                return float(v.length())
+            raise LuaError(f"attempt to get length of a {lua_type(v)} value")
+    raise LuaError(f"unknown expression {op}")  # pragma: no cover
+
+
+_NUM_OPS = {"+", "-", "*", "/", "%", "^"}
+_CMP_OPS = {"<", ">", "<=", ">="}
+
+
+def eval_binop(e: tuple, scope: Scope) -> Any:
+    op = e[1]
+    if op == "and":
+        left = eval_expr(e[2], scope)
+        return eval_expr(e[3], scope) if truthy(left) else left
+    if op == "or":
+        left = eval_expr(e[2], scope)
+        return left if truthy(left) else eval_expr(e[3], scope)
+    left = eval_expr(e[2], scope)
+    right = eval_expr(e[3], scope)
+    if op in _NUM_OPS:
+        ln = _arith_num(left, op)
+        rn = _arith_num(right, op)
+        if op == "+":
+            return ln + rn
+        if op == "-":
+            return ln - rn
+        if op == "*":
+            return ln * rn
+        if op == "/":
+            if rn == 0:
+                return math.inf if ln > 0 else (-math.inf if ln < 0
+                                                else math.nan)
+            return ln / rn
+        if op == "%":
+            if rn == 0:
+                return math.nan
+            return ln - math.floor(ln / rn) * rn
+        if op == "^":
+            return float(ln ** rn)
+    if op == "..":
+        for v in (left, right):
+            if not isinstance(v, (str, float)):
+                raise LuaError(
+                    f"attempt to concatenate a {lua_type(v)} value")
+        ls = fmt_number(left) if isinstance(left, float) else left
+        rs = fmt_number(right) if isinstance(right, float) else right
+        return ls + rs
+    if op == "==":
+        return lua_eq(left, right)
+    if op == "~=":
+        return not lua_eq(left, right)
+    if op in _CMP_OPS:
+        if isinstance(left, float) and isinstance(right, float):
+            pass
+        elif isinstance(left, str) and isinstance(right, str):
+            pass
+        else:
+            raise LuaError(
+                f"attempt to compare {lua_type(left)} with "
+                f"{lua_type(right)}")
+        if op == "<":
+            return left < right
+        if op == ">":
+            return left > right
+        if op == "<=":
+            return left <= right
+        return left >= right
+    raise LuaError(f"unknown operator {op}")  # pragma: no cover
+
+
+def lua_eq(a, b) -> bool:
+    if a is None and b is None:
+        return True
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    return a is b
+
+
+# --------------------------------------------------------- public API
+
+
+class LuaRuntime:
+    """One Lua state: load scripts into a shared global scope, call
+    global functions (the flb_luajit_load_script + lua_pcall surface)."""
+
+    def __init__(self):
+        from .stdlib import make_globals
+        self.globals = Scope(None)
+        self.globals.vars.update(make_globals())
+        # _G shares the global scope's dict: assignments through either
+        # surface are visible to both
+        gt = LuaTable()
+        gt.hash = self.globals.vars
+        self.globals.vars["_G"] = gt
+
+    def load(self, src: str, name: str = "script") -> None:
+        """Parse + run a chunk at global scope (function definitions
+        land in globals)."""
+        try:
+            block = parse(src)
+        except SyntaxError as e:
+            raise LuaError(f"{name}: {e}")
+        try:
+            exec_block(block, self.globals)
+        except _Return:
+            pass
+
+    def call(self, name: str, args: List[Any]) -> List[Any]:
+        fn = self.globals.vars.get(name)
+        if fn is None:
+            raise LuaError(f"attempt to call a nil value (global '{name}')")
+        return call_value(fn, list(args))
+
+    def eval(self, src: str):
+        """Convenience for tests: evaluate 'return <expr>'."""
+        block = parse(src)
+        try:
+            exec_block(block, self.globals)
+        except _Return as r:
+            return r.values
+        return []

@@ -1,0 +1,207 @@
+"""Lua 5.1 lexer.
+
+Part of the from-scratch Lua runtime that backs plugins/filter_lua
+(reference embeds LuaJIT via src/flb_luajit.c + lib/luajit-7152e154;
+this build interprets the language directly — same stance as the regex
+engine replacing Onigmo)."""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+
+class LuaSyntaxError(SyntaxError):
+    pass
+
+
+KEYWORDS = {
+    "and", "break", "do", "else", "elseif", "end", "false", "for",
+    "function", "if", "in", "local", "nil", "not", "or", "repeat",
+    "return", "then", "true", "until", "while",
+}
+
+# longest-first so '..' beats '.' and '...' beats '..'
+SYMBOLS = [
+    "...", "..", "==", "~=", "<=", ">=", "+", "-", "*", "/", "%", "^",
+    "#", "<", ">", "=", "(", ")", "{", "}", "[", "]", ";", ":", ",", ".",
+]
+
+
+class Token(NamedTuple):
+    kind: str       # 'name' | 'number' | 'string' | 'keyword' | 'sym' | 'eof'
+    value: object
+    line: int
+
+
+_ESCAPES = {"a": "\a", "b": "\b", "f": "\f", "n": "\n", "r": "\r",
+            "t": "\t", "v": "\v", "\\": "\\", '"': '"', "'": "'",
+            "\n": "\n"}
+
+
+def _long_bracket_level(src: str, pos: int) -> Optional[int]:
+    """At '[': return level if '[===[' style opener, else None."""
+    if src[pos] != "[":
+        return None
+    i = pos + 1
+    level = 0
+    while i < len(src) and src[i] == "=":
+        level += 1
+        i += 1
+    if i < len(src) and src[i] == "[":
+        return level
+    return None
+
+
+def tokenize(src: str) -> List[Token]:
+    toks: List[Token] = []
+    i = 0
+    n = len(src)
+    line = 1
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        # comments
+        if src.startswith("--", i):
+            i += 2
+            level = _long_bracket_level(src, i) if i < n else None
+            if level is not None:
+                close = "]" + "=" * level + "]"
+                end = src.find(close, i)
+                if end < 0:
+                    raise LuaSyntaxError(f"unfinished long comment at line {line}")
+                line += src.count("\n", i, end)
+                i = end + len(close)
+            else:
+                while i < n and src[i] != "\n":
+                    i += 1
+            continue
+        # long strings
+        level = _long_bracket_level(src, i)
+        if level is not None:
+            open_len = level + 2
+            close = "]" + "=" * level + "]"
+            start = i + open_len
+            if start < n and src[start] == "\n":
+                start += 1  # spec: leading newline dropped
+                line += 1
+            end = src.find(close, start)
+            if end < 0:
+                raise LuaSyntaxError(f"unfinished long string at line {line}")
+            s = src[start:end]
+            line += s.count("\n")
+            toks.append(Token("string", s, line))
+            i = end + len(close)
+            continue
+        # quoted strings
+        if c in "'\"":
+            quote = c
+            i += 1
+            buf = []
+            while True:
+                if i >= n:
+                    raise LuaSyntaxError(f"unfinished string at line {line}")
+                ch = src[i]
+                if ch == quote:
+                    i += 1
+                    break
+                if ch == "\n":
+                    raise LuaSyntaxError(f"unfinished string at line {line}")
+                if ch == "\\":
+                    i += 1
+                    if i >= n:
+                        raise LuaSyntaxError(f"unfinished string at line {line}")
+                    e = src[i]
+                    if e in _ESCAPES:
+                        buf.append(_ESCAPES[e])
+                        if e == "\n":
+                            line += 1
+                        i += 1
+                    elif e.isdigit():
+                        num = e
+                        i += 1
+                        for _ in range(2):
+                            if i < n and src[i].isdigit():
+                                num += src[i]
+                                i += 1
+                            else:
+                                break
+                        code = int(num)
+                        if code > 255:
+                            raise LuaSyntaxError(
+                                f"escape too large at line {line}")
+                        buf.append(chr(code))
+                    elif e == "x":  # 5.2 extension, commonly used
+                        hexd = src[i + 1:i + 3]
+                        try:
+                            buf.append(chr(int(hexd, 16)))
+                        except ValueError:
+                            raise LuaSyntaxError(
+                                f"hexadecimal digit expected at line {line}")
+                        i += 3
+                    else:
+                        raise LuaSyntaxError(
+                            f"invalid escape '\\{e}' at line {line}")
+                else:
+                    buf.append(ch)
+                    i += 1
+            toks.append(Token("string", "".join(buf), line))
+            continue
+        # numbers
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            start = i
+            if src.startswith(("0x", "0X"), i):
+                i += 2
+                while i < n and (src[i] in "0123456789abcdefABCDEF"):
+                    i += 1
+                try:
+                    num = float(int(src[start:i], 16))
+                except ValueError:
+                    raise LuaSyntaxError(
+                        f"malformed number near '{src[start:i]}' "
+                        f"line {line}")
+                toks.append(Token("number", num, line))
+                continue
+            while i < n and src[i].isdigit():
+                i += 1
+            if i < n and src[i] == ".":
+                i += 1
+                while i < n and src[i].isdigit():
+                    i += 1
+            if i < n and src[i] in "eE":
+                i += 1
+                if i < n and src[i] in "+-":
+                    i += 1
+                while i < n and src[i].isdigit():
+                    i += 1
+            try:
+                toks.append(Token("number", float(src[start:i]), line))
+            except ValueError:
+                raise LuaSyntaxError(
+                    f"malformed number near '{src[start:i]}' line {line}")
+            continue
+        # names / keywords
+        if c.isalpha() or c == "_":
+            start = i
+            while i < n and (src[i].isalnum() or src[i] == "_"):
+                i += 1
+            word = src[start:i]
+            toks.append(Token("keyword" if word in KEYWORDS else "name",
+                              word, line))
+            continue
+        # symbols
+        for sym in SYMBOLS:
+            if src.startswith(sym, i):
+                toks.append(Token("sym", sym, line))
+                i += len(sym)
+                break
+        else:
+            raise LuaSyntaxError(
+                f"unexpected character {c!r} at line {line}")
+    toks.append(Token("eof", None, line))
+    return toks

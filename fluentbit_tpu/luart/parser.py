@@ -1,0 +1,362 @@
+"""Lua 5.1 recursive-descent parser → tuple AST.
+
+Grammar per the Lua 5.1 manual §8. AST nodes are plain tuples with a
+string head — the interpreter (interp.py) dispatches on it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .lexer import LuaSyntaxError, Token, tokenize
+
+# binary precedence (left, right) — right > left for right-assoc ('..', '^')
+_BINPREC = {
+    "or": (1, 1), "and": (2, 2),
+    "<": (3, 3), ">": (3, 3), "<=": (3, 3), ">=": (3, 3),
+    "~=": (3, 3), "==": (3, 3),
+    "..": (9, 8),  # right associative
+    "+": (10, 10), "-": (10, 10),
+    "*": (11, 11), "/": (11, 11), "%": (11, 11),
+    "^": (14, 13),  # right associative, above unary
+}
+_UNARY_PREC = 12
+
+
+class Parser:
+    def __init__(self, src: str):
+        self.toks = tokenize(src)
+        self.pos = 0
+
+    # ------------------------------------------------------- helpers
+
+    @property
+    def tok(self) -> Token:
+        return self.toks[self.pos]
+
+    def next(self) -> Token:
+        t = self.toks[self.pos]
+        self.pos += 1
+        return t
+
+    def check(self, kind: str, value=None) -> bool:
+        t = self.tok
+        return t.kind == kind and (value is None or t.value == value)
+
+    def accept(self, kind: str, value=None) -> Optional[Token]:
+        if self.check(kind, value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value=None) -> Token:
+        if not self.check(kind, value):
+            t = self.tok
+            raise LuaSyntaxError(
+                f"line {t.line}: expected {value or kind}, got "
+                f"{t.value!r}")
+        return self.next()
+
+    # --------------------------------------------------------- entry
+
+    def parse_chunk(self) -> list:
+        block = self.parse_block()
+        self.expect("eof")
+        return block
+
+    _BLOCK_END = {"end", "else", "elseif", "until"}
+
+    def parse_block(self) -> list:
+        stmts = []
+        while True:
+            t = self.tok
+            if t.kind == "eof" or (t.kind == "keyword"
+                                   and t.value in self._BLOCK_END):
+                return stmts
+            if t.kind == "keyword" and t.value == "return":
+                self.next()
+                exprs = []
+                if not (self.tok.kind == "eof"
+                        or (self.tok.kind == "keyword"
+                            and self.tok.value in self._BLOCK_END)
+                        or self.check("sym", ";")):
+                    exprs = self.parse_exprlist()
+                self.accept("sym", ";")
+                stmts.append(("return", exprs, t.line))
+                return stmts
+            st = self.parse_statement()
+            if st is not None:
+                stmts.append(st)
+
+    # ---------------------------------------------------- statements
+
+    def parse_statement(self):
+        t = self.tok
+        if self.accept("sym", ";"):
+            return None
+        if t.kind == "keyword":
+            kw = t.value
+            if kw == "break":
+                self.next()
+                return ("break", t.line)
+            if kw == "do":
+                self.next()
+                body = self.parse_block()
+                self.expect("keyword", "end")
+                return ("do", body, t.line)
+            if kw == "while":
+                self.next()
+                cond = self.parse_expr()
+                self.expect("keyword", "do")
+                body = self.parse_block()
+                self.expect("keyword", "end")
+                return ("while", cond, body, t.line)
+            if kw == "repeat":
+                self.next()
+                body = self.parse_block()
+                self.expect("keyword", "until")
+                cond = self.parse_expr()
+                return ("repeat", body, cond, t.line)
+            if kw == "if":
+                return self.parse_if()
+            if kw == "for":
+                return self.parse_for()
+            if kw == "function":
+                return self.parse_funcstat()
+            if kw == "local":
+                return self.parse_local()
+            raise LuaSyntaxError(f"line {t.line}: unexpected '{kw}'")
+        # exprstat: assignment or call
+        expr = self.parse_suffixed()
+        if self.check("sym", "=") or self.check("sym", ","):
+            targets = [expr]
+            while self.accept("sym", ","):
+                targets.append(self.parse_suffixed())
+            self.expect("sym", "=")
+            exprs = self.parse_exprlist()
+            for tg in targets:
+                if tg[0] not in ("name", "index"):
+                    raise LuaSyntaxError(
+                        f"line {t.line}: cannot assign to this expression")
+            return ("assign", targets, exprs, t.line)
+        if expr[0] not in ("call", "method"):
+            raise LuaSyntaxError(f"line {t.line}: syntax error near "
+                                 f"{self.tok.value!r}")
+        return ("callstat", expr, t.line)
+
+    def parse_if(self):
+        line = self.expect("keyword", "if").line
+        arms = []
+        cond = self.parse_expr()
+        self.expect("keyword", "then")
+        arms.append((cond, self.parse_block()))
+        els: list = []
+        while True:
+            if self.accept("keyword", "elseif"):
+                c = self.parse_expr()
+                self.expect("keyword", "then")
+                arms.append((c, self.parse_block()))
+            elif self.accept("keyword", "else"):
+                els = self.parse_block()
+                self.expect("keyword", "end")
+                break
+            else:
+                self.expect("keyword", "end")
+                break
+        return ("if", arms, els, line)
+
+    def parse_for(self):
+        line = self.expect("keyword", "for").line
+        name1 = self.expect("name").value
+        if self.accept("sym", "="):
+            e1 = self.parse_expr()
+            self.expect("sym", ",")
+            e2 = self.parse_expr()
+            e3 = ("num", 1.0) if not self.accept("sym", ",") \
+                else self.parse_expr()
+            self.expect("keyword", "do")
+            body = self.parse_block()
+            self.expect("keyword", "end")
+            return ("fornum", name1, e1, e2, e3, body, line)
+        names = [name1]
+        while self.accept("sym", ","):
+            names.append(self.expect("name").value)
+        self.expect("keyword", "in")
+        exprs = self.parse_exprlist()
+        self.expect("keyword", "do")
+        body = self.parse_block()
+        self.expect("keyword", "end")
+        return ("forin", names, exprs, body, line)
+
+    def parse_funcstat(self):
+        line = self.expect("keyword", "function").line
+        target = ("name", self.expect("name").value)
+        is_method = False
+        while True:
+            if self.accept("sym", "."):
+                target = ("index", target, ("str",
+                                            self.expect("name").value))
+            elif self.accept("sym", ":"):
+                target = ("index", target, ("str",
+                                            self.expect("name").value))
+                is_method = True
+                break
+            else:
+                break
+        fn = self.parse_funcbody(is_method)
+        return ("assign", [target], [fn], line)
+
+    def parse_local(self):
+        line = self.expect("keyword", "local").line
+        if self.accept("keyword", "function"):
+            name = self.expect("name").value
+            fn = self.parse_funcbody(False)
+            return ("localfunc", name, fn, line)
+        names = [self.expect("name").value]
+        while self.accept("sym", ","):
+            names.append(self.expect("name").value)
+        exprs = self.parse_exprlist() if self.accept("sym", "=") else []
+        return ("local", names, exprs, line)
+
+    def parse_funcbody(self, is_method: bool):
+        self.expect("sym", "(")
+        params = ["self"] if is_method else []
+        is_vararg = False
+        if not self.check("sym", ")"):
+            while True:
+                if self.accept("sym", "..."):
+                    is_vararg = True
+                    break
+                params.append(self.expect("name").value)
+                if not self.accept("sym", ","):
+                    break
+        self.expect("sym", ")")
+        body = self.parse_block()
+        self.expect("keyword", "end")
+        return ("func", params, is_vararg, body)
+
+    # --------------------------------------------------- expressions
+
+    def parse_exprlist(self) -> List[tuple]:
+        exprs = [self.parse_expr()]
+        while self.accept("sym", ","):
+            exprs.append(self.parse_expr())
+        return exprs
+
+    def parse_expr(self, limit: int = 0):
+        t = self.tok
+        if (t.kind == "sym" and t.value in ("-", "#")) or \
+                (t.kind == "keyword" and t.value == "not"):
+            op = self.next().value
+            operand = self.parse_expr(_UNARY_PREC)
+            left = ("unop", op, operand)
+        else:
+            left = self.parse_simple()
+        while True:
+            t = self.tok
+            op = t.value if (t.kind == "sym" or t.kind == "keyword") else None
+            prec = _BINPREC.get(op)
+            if prec is None or prec[0] <= limit:
+                return left
+            self.next()
+            right = self.parse_expr(prec[1])
+            left = ("binop", op, left, right)
+
+    def parse_simple(self):
+        t = self.tok
+        if t.kind == "number":
+            self.next()
+            return ("num", t.value)
+        if t.kind == "string":
+            self.next()
+            return ("str", t.value)
+        if t.kind == "keyword":
+            if t.value == "nil":
+                self.next()
+                return ("nil",)
+            if t.value == "true":
+                self.next()
+                return ("true",)
+            if t.value == "false":
+                self.next()
+                return ("false",)
+            if t.value == "function":
+                self.next()
+                return self.parse_funcbody(False)
+        if self.check("sym", "..."):
+            self.next()
+            return ("vararg",)
+        if self.check("sym", "{"):
+            return self.parse_table()
+        return self.parse_suffixed()
+
+    def parse_primary(self):
+        t = self.tok
+        if t.kind == "name":
+            self.next()
+            return ("name", t.value)
+        if self.accept("sym", "("):
+            e = self.parse_expr()
+            self.expect("sym", ")")
+            return ("paren", e)  # truncates multiple returns to one
+        raise LuaSyntaxError(
+            f"line {t.line}: unexpected symbol near {t.value!r}")
+
+    def parse_suffixed(self):
+        e = self.parse_primary()
+        while True:
+            t = self.tok
+            if self.accept("sym", "."):
+                e = ("index", e, ("str", self.expect("name").value))
+            elif self.accept("sym", "["):
+                k = self.parse_expr()
+                self.expect("sym", "]")
+                e = ("index", e, k)
+            elif self.accept("sym", ":"):
+                name = self.expect("name").value
+                args = self.parse_callargs()
+                e = ("method", e, name, args)
+            elif t.kind == "string" or self.check("sym", "(") \
+                    or self.check("sym", "{"):
+                e = ("call", e, self.parse_callargs())
+            else:
+                return e
+
+    def parse_callargs(self) -> List[tuple]:
+        t = self.tok
+        if t.kind == "string":
+            self.next()
+            return [("str", t.value)]
+        if self.check("sym", "{"):
+            return [self.parse_table()]
+        self.expect("sym", "(")
+        args = [] if self.check("sym", ")") else self.parse_exprlist()
+        self.expect("sym", ")")
+        return args
+
+    def parse_table(self):
+        self.expect("sym", "{")
+        array: List[tuple] = []
+        hash_: List[Tuple[tuple, tuple]] = []
+        while not self.check("sym", "}"):
+            if self.check("sym", "["):
+                self.next()
+                k = self.parse_expr()
+                self.expect("sym", "]")
+                self.expect("sym", "=")
+                hash_.append((k, self.parse_expr()))
+            elif self.tok.kind == "name" \
+                    and self.toks[self.pos + 1].kind == "sym" \
+                    and self.toks[self.pos + 1].value == "=":
+                name = self.next().value
+                self.next()  # '='
+                hash_.append((("str", name), self.parse_expr()))
+            else:
+                array.append(self.parse_expr())
+            if not (self.accept("sym", ",") or self.accept("sym", ";")):
+                break
+        self.expect("sym", "}")
+        return ("table", array, hash_)
+
+
+def parse(src: str) -> list:
+    return Parser(src).parse_chunk()

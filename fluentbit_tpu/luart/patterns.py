@@ -1,0 +1,247 @@
+"""Lua 5.1 pattern matching (the lstrlib.c match machine).
+
+Not regex: classes %a %d %s %w etc., sets [], captures () incl.
+position captures, anchors ^/$, quantifiers * + - ?, %b balanced
+match, %f frontier. Powers string.find/match/gmatch/gsub in stdlib.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+class PatternError(ValueError):
+    pass
+
+
+_CLASS = {
+    "a": lambda c: c.isalpha(),
+    "c": lambda c: ord(c) < 32 or ord(c) == 127,
+    "d": lambda c: c.isdigit(),
+    "l": lambda c: c.islower(),
+    "p": lambda c: 32 < ord(c) < 127 and not c.isalnum(),
+    "s": lambda c: c in " \t\n\r\f\v",
+    "u": lambda c: c.isupper(),
+    "w": lambda c: c.isalnum(),
+    "x": lambda c: c in "0123456789abcdefABCDEF",
+}
+
+
+def _match_class(c: str, cl: str) -> bool:
+    f = _CLASS.get(cl.lower())
+    if f is None:
+        return c == cl  # escaped literal (%%, %., %()
+    res = f(c)
+    return res if cl.islower() else not res
+
+
+def _class_end(p: str, pi: int) -> int:
+    """Index just past the single pattern item starting at pi."""
+    c = p[pi]
+    pi += 1
+    if c == "%":
+        if pi >= len(p):
+            raise PatternError("malformed pattern (ends with '%')")
+        return pi + 1
+    if c == "[":
+        if pi < len(p) and p[pi] == "^":
+            pi += 1
+        # first ']' is literal
+        first = True
+        while True:
+            if pi >= len(p):
+                raise PatternError("malformed pattern (missing ']')")
+            c = p[pi]
+            pi += 1
+            if c == "%":
+                pi += 1
+            elif c == "]" and not first:
+                return pi
+            first = False
+    return pi
+
+
+def _match_set(c: str, p: str, pi: int, ep: int) -> bool:
+    """c against the set p[pi:ep] where p[pi]=='[' and p[ep-1]==']'."""
+    pi += 1
+    negate = False
+    if p[pi] == "^":
+        negate = True
+        pi += 1
+    found = False
+    while pi < ep - 1:
+        if p[pi] == "%":
+            pi += 1
+            if _match_class(c, p[pi]):
+                found = True
+            pi += 1
+        elif pi + 2 < ep - 1 and p[pi + 1] == "-":
+            if p[pi] <= c <= p[pi + 2]:
+                found = True
+            pi += 3
+        else:
+            if p[pi] == c:
+                found = True
+            pi += 1
+    return found != negate
+
+
+def _single_match(s: str, si: int, p: str, pi: int, ep: int) -> bool:
+    if si >= len(s):
+        return False
+    c = s[si]
+    pc = p[pi]
+    if pc == ".":
+        return True
+    if pc == "%":
+        return _match_class(c, p[pi + 1])
+    if pc == "[":
+        return _match_set(c, p, pi, ep)
+    return pc == c
+
+
+class _MatchState:
+    __slots__ = ("s", "p", "caps")
+
+    def __init__(self, s: str, p: str):
+        self.s = s
+        self.p = p
+        # (start, len) — len == -1 while open, -2 for position capture
+        self.caps: List[List[int]] = []
+
+
+def _do_match(ms: _MatchState, si: int, pi: int) -> Optional[int]:
+    s, p = ms.s, ms.p
+    while True:
+        if pi >= len(p):
+            return si
+        pc = p[pi]
+        if pc == "(":
+            if pi + 1 < len(p) and p[pi + 1] == ")":  # position capture
+                ms.caps.append([si, -2])
+                r = _do_match(ms, si, pi + 2)
+                if r is None:
+                    ms.caps.pop()
+                return r
+            ms.caps.append([si, -1])
+            r = _do_match(ms, si, pi + 1)
+            if r is None:
+                ms.caps.pop()
+            return r
+        if pc == ")":
+            for cap in reversed(ms.caps):
+                if cap[1] == -1:
+                    cap[1] = si - cap[0]
+                    r = _do_match(ms, si, pi + 1)
+                    if r is None:
+                        cap[1] = -1
+                    return r
+            raise PatternError("invalid pattern capture")
+        if pc == "$" and pi + 1 == len(p):
+            return si if si == len(s) else None
+        if pc == "%":
+            nxt = p[pi + 1] if pi + 1 < len(p) else ""
+            if nxt == "b":
+                if pi + 3 >= len(p):
+                    raise PatternError("missing arguments to %b")
+                o, cch = p[pi + 2], p[pi + 3]
+                if si >= len(s) or s[si] != o:
+                    return None
+                depth = 1
+                j = si + 1
+                while j < len(s):
+                    if s[j] == cch:
+                        depth -= 1
+                        if depth == 0:
+                            # tail continues after the balanced span
+                            pi2 = pi + 4
+                            r = _do_match(ms, j + 1, pi2)
+                            return r
+                    elif s[j] == o:
+                        depth += 1
+                    j += 1
+                return None
+            if nxt == "f":
+                if pi + 2 >= len(p) or p[pi + 2] != "[":
+                    raise PatternError("missing '[' after %f")
+                ep = _class_end(p, pi + 2)
+                prev = s[si - 1] if si > 0 else "\0"
+                cur = s[si] if si < len(s) else "\0"
+                if (not _match_set(prev, p, pi + 2, ep)
+                        and _match_set(cur, p, pi + 2, ep)):
+                    pi = ep
+                    continue
+                return None
+            if nxt.isdigit():  # back-reference %1-%9
+                idx = int(nxt) - 1
+                if idx >= len(ms.caps) or ms.caps[idx][1] < 0:
+                    raise PatternError(f"invalid capture index %{nxt}")
+                cs, cl = ms.caps[idx]
+                cap = s[cs:cs + cl]
+                if s.startswith(cap, si):
+                    si += len(cap)
+                    pi += 2
+                    continue
+                return None
+        ep = _class_end(p, pi)
+        quant = p[ep] if ep < len(p) else ""
+        if quant == "?":
+            if _single_match(s, si, p, pi, ep):
+                r = _do_match(ms, si + 1, ep + 1)
+                if r is not None:
+                    return r
+            pi = ep + 1
+            continue
+        if quant == "*":
+            count = 0
+            while _single_match(s, si + count, p, pi, ep):
+                count += 1
+            while count >= 0:
+                r = _do_match(ms, si + count, ep + 1)
+                if r is not None:
+                    return r
+                count -= 1
+            return None
+        if quant == "+":
+            count = 0
+            while _single_match(s, si + count, p, pi, ep):
+                count += 1
+            while count >= 1:
+                r = _do_match(ms, si + count, ep + 1)
+                if r is not None:
+                    return r
+                count -= 1
+            return None
+        if quant == "-":
+            while True:
+                r = _do_match(ms, si, ep + 1)
+                if r is not None:
+                    return r
+                if _single_match(s, si, p, pi, ep):
+                    si += 1
+                else:
+                    return None
+        if not _single_match(s, si, p, pi, ep):
+            return None
+        si += 1
+        pi = ep
+
+
+def find(s: str, pattern: str, init: int = 0):
+    """→ (start, end, captures) with 0-based start, end-exclusive; or
+    None. Captures are strings, or 1-based int for position captures."""
+    anchored = pattern.startswith("^")
+    p = pattern[1:] if anchored else pattern
+    si = init
+    while si <= len(s):
+        ms = _MatchState(s, p)
+        e = _do_match(ms, si, 0)
+        if e is not None:
+            caps = []
+            for cs, cl in ms.caps:
+                caps.append(float(cs + 1) if cl == -2 else s[cs:cs + cl])
+            return si, e, caps
+        if anchored:
+            return None
+        si += 1
+    return None

@@ -1,0 +1,585 @@
+"""Lua 5.1 standard library subset: base, string, table, math, os.
+
+The functions filter scripts actually lean on — string mangling
+(incl. full pattern-based find/match/gmatch/gsub/format), table
+manipulation, math, os.time/date/clock. Reference scope: what LuaJIT
+exposes to filter_lua scripts via src/flb_lua.c.
+"""
+
+from __future__ import annotations
+
+import math
+import time as _time
+from typing import Any, List
+
+from . import patterns
+from .interp import (
+    LuaError,
+    LuaFunction,
+    LuaTable,
+    adjust,
+    call_value,
+    fmt_number,
+    lua_eq,
+    lua_tostring,
+    lua_type,
+    tonumber,
+    truthy,
+)
+
+
+def _s(v, fn: str, arg: int = 1) -> str:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, float):
+        return fmt_number(v)
+    raise LuaError(f"bad argument #{arg} to '{fn}' "
+                   f"(string expected, got {lua_type(v)})")
+
+
+def _n(v, fn: str, arg: int = 1) -> float:
+    x = tonumber(v)
+    if x is None:
+        raise LuaError(f"bad argument #{arg} to '{fn}' "
+                       f"(number expected, got {lua_type(v)})")
+    return x
+
+
+def _t(v, fn: str, arg: int = 1) -> LuaTable:
+    if not isinstance(v, LuaTable):
+        raise LuaError(f"bad argument #{arg} to '{fn}' "
+                       f"(table expected, got {lua_type(v)})")
+    return v
+
+
+def _str_index(s: str, i: float, default: int) -> int:
+    """Lua string index → Python offset (1-based, negatives from end)."""
+    i = int(i) if i is not None else default
+    if i < 0:
+        i = max(len(s) + i + 1, 1)
+    elif i == 0:
+        i = 1
+    return i
+
+
+# ------------------------------------------------------------ string
+
+def _string_sub(s, i=1.0, j=-1.0):
+    s = _s(s, "sub")
+    start = _str_index(s, i, 1)
+    jj = int(j) if j is not None else -1
+    if jj < 0:
+        jj = len(s) + jj + 1
+    jj = min(jj, len(s))
+    if start > jj:
+        return ""
+    return s[start - 1:jj]
+
+
+def _string_find(s, pat, init=1.0, plain=None):
+    s = _s(s, "find")
+    pat = _s(pat, "find", 2)
+    start = _str_index(s, init, 1) - 1
+    if start > len(s):
+        return None
+    if truthy(plain):
+        idx = s.find(pat, start)
+        if idx < 0:
+            return None
+        return [float(idx + 1), float(idx + len(pat))]
+    m = patterns.find(s, pat, start)
+    if m is None:
+        return None
+    st, en, caps = m
+    return [float(st + 1), float(en)] + caps
+
+
+def _string_match(s, pat, init=1.0):
+    s = _s(s, "match")
+    pat = _s(pat, "match", 2)
+    start = _str_index(s, init, 1) - 1
+    m = patterns.find(s, pat, start)
+    if m is None:
+        return None
+    st, en, caps = m
+    return caps if caps else s[st:en]
+
+
+def _string_gmatch(s, pat):
+    s = _s(s, "gmatch")
+    pat = _s(pat, "gmatch", 2)
+    pos = [0]
+
+    def it(*_args):
+        while pos[0] <= len(s):
+            m = patterns.find(s, pat, pos[0])
+            if m is None:
+                return None
+            st, en, caps = m
+            pos[0] = en + 1 if en == st else en  # empty match advances
+            return caps if caps else s[st:en]
+        return None
+
+    return it
+
+
+def _gsub_value(repl_out, orig: str):
+    if repl_out is None or repl_out is False:
+        return orig
+    if isinstance(repl_out, (str, float)):
+        return lua_tostring(repl_out)
+    raise LuaError("invalid replacement value (a "
+                   f"{lua_type(repl_out)})")
+
+
+def _string_gsub(s, pat, repl, n=None):
+    s = _s(s, "gsub")
+    pat = _s(pat, "gsub", 2)
+    limit = int(_n(n, "gsub", 4)) if n is not None else -1
+    out: List[str] = []
+    pos = 0
+    count = 0
+    while (limit < 0 or count < limit) and pos <= len(s):
+        m = patterns.find(s, pat, pos)
+        if m is None:
+            break
+        st, en, caps = m
+        out.append(s[pos:st])
+        whole = s[st:en]
+        eff_caps = caps if caps else [whole]
+        if isinstance(repl, str) or isinstance(repl, float):
+            rs = lua_tostring(repl)
+            buf = []
+            i = 0
+            while i < len(rs):
+                c = rs[i]
+                if c == "%" and i + 1 < len(rs):
+                    d = rs[i + 1]
+                    if d == "0":
+                        buf.append(whole)
+                    elif d.isdigit():
+                        idx = int(d) - 1
+                        if idx >= len(eff_caps):
+                            raise LuaError(
+                                f"invalid capture index %{d} in "
+                                "replacement string")
+                        buf.append(lua_tostring(eff_caps[idx]))
+                    else:
+                        buf.append(d)
+                    i += 2
+                else:
+                    buf.append(c)
+                    i += 1
+            out.append("".join(buf))
+        elif isinstance(repl, LuaTable):
+            out.append(_gsub_value(repl.get(eff_caps[0]), whole))
+        elif callable(repl) or isinstance(repl, LuaFunction):
+            r = adjust(call_value(repl, list(eff_caps)))
+            out.append(_gsub_value(r, whole))
+        else:
+            raise LuaError("bad argument #3 to 'gsub' "
+                           "(string/function/table expected)")
+        count += 1
+        if en == st:  # empty match: copy one char and advance
+            if st < len(s):
+                out.append(s[st])
+            pos = st + 1
+        else:
+            pos = en
+    out.append(s[pos:])
+    return ["".join(out), float(count)]
+
+
+def _string_format(fmt, *args):
+    fmt = _s(fmt, "format")
+    out = []
+    i = 0
+    ai = 0
+    args = list(args)
+    while i < len(fmt):
+        c = fmt[i]
+        if c != "%":
+            out.append(c)
+            i += 1
+            continue
+        j = i + 1
+        while j < len(fmt) and fmt[j] in "-+ #0123456789.":
+            j += 1
+        if j >= len(fmt):
+            raise LuaError("invalid format string to 'format'")
+        conv = fmt[j]
+        spec = fmt[i:j + 1]
+        i = j + 1
+        if conv == "%":
+            out.append("%")
+            continue
+        arg = args[ai] if ai < len(args) else None
+        ai += 1
+        if conv in "di":
+            out.append((spec[:-1] + "d") % int(_n(arg, "format", ai)))
+        elif conv == "u":
+            out.append((spec[:-1] + "d") % int(_n(arg, "format", ai)))
+        elif conv in "fFgGeE":
+            out.append(spec % _n(arg, "format", ai))
+        elif conv in "xXo":
+            out.append(spec % int(_n(arg, "format", ai)))
+        elif conv == "c":
+            out.append(chr(int(_n(arg, "format", ai))))
+        elif conv == "s":
+            out.append(spec % lua_tostring(arg))
+        elif conv == "q":
+            q = lua_tostring(arg)
+            esc = q.replace("\\", "\\\\").replace('"', '\\"') \
+                   .replace("\n", "\\n").replace("\r", "\\r") \
+                   .replace("\0", "\\0")
+            out.append(f'"{esc}"')
+        else:
+            raise LuaError(
+                f"invalid option '%{conv}' to 'format'")
+    return "".join(out)
+
+
+STRING_LIB = {
+    "len": lambda s=None: float(len(_s(s, "len"))),
+    "sub": _string_sub,
+    "upper": lambda s=None: _s(s, "upper").upper(),
+    "lower": lambda s=None: _s(s, "lower").lower(),
+    "rep": lambda s=None, n=0.0: _s(s, "rep") * int(_n(n, "rep", 2)),
+    "reverse": lambda s=None: _s(s, "reverse")[::-1],
+    "byte": lambda s=None, i=1.0, j=None: [
+        float(ord(ch)) for ch in _string_sub(
+            s, i, j if j is not None else i)],
+    "char": lambda *a: "".join(chr(int(_n(x, "char", k + 1)))
+                               for k, x in enumerate(a)),
+    "find": _string_find,
+    "match": _string_match,
+    "gmatch": _string_gmatch,
+    "gsub": _string_gsub,
+    "format": _string_format,
+}
+
+
+# ------------------------------------------------------------- table
+
+def _table_insert(t, a=None, b=None):
+    t = _t(t, "insert")
+    n = t.length()
+    if b is None:
+        t.set(float(n + 1), a)
+    else:
+        pos = int(_n(a, "insert", 2))
+        for k in range(n, pos - 1, -1):
+            t.set(float(k + 1), t.get(float(k)))
+        t.set(float(pos), b)
+
+
+def _table_remove(t, pos=None):
+    t = _t(t, "remove")
+    n = t.length()
+    if n == 0:
+        return None
+    p = int(_n(pos, "remove", 2)) if pos is not None else n
+    v = t.get(float(p))
+    for k in range(p, n):
+        t.set(float(k), t.get(float(k + 1)))
+    t.set(float(n), None)
+    return v
+
+
+def _table_concat(t, sep="", i=1.0, j=None):
+    t = _t(t, "concat")
+    sep = _s(sep, "concat", 2) if sep != "" else ""
+    jj = int(_n(j, "concat", 4)) if j is not None else t.length()
+    parts = []
+    for k in range(int(_n(i, "concat", 3)), jj + 1):
+        v = t.get(float(k))
+        if not isinstance(v, (str, float)):
+            raise LuaError(f"invalid value (at index {k}) in table "
+                           "for 'concat'")
+        parts.append(lua_tostring(v))
+    return sep.join(parts)
+
+
+def _table_sort(t, comp=None):
+    t = _t(t, "sort")
+    n = t.length()
+    items = [t.get(float(k)) for k in range(1, n + 1)]
+    if comp is not None:
+        import functools
+
+        def cmp(a, b):
+            if truthy(adjust(call_value(comp, [a, b]))):
+                return -1
+            if truthy(adjust(call_value(comp, [b, a]))):
+                return 1
+            return 0
+
+        items.sort(key=functools.cmp_to_key(cmp))
+    else:
+        try:
+            items.sort()
+        except TypeError:
+            raise LuaError("attempt to compare incompatible values in "
+                           "'sort'")
+    for k, v in enumerate(items):
+        t.set(float(k + 1), v)
+
+
+# -------------------------------------------------------------- base
+
+def _next(t, key=None):
+    t = _t(t, "next")
+    keys = list(t.hash.keys())
+    if key is None:
+        idx = 0
+    else:
+        from .interp import _normkey
+        try:
+            idx = keys.index(_normkey(key)) + 1
+        except ValueError:
+            raise LuaError("invalid key to 'next'")
+    if idx >= len(keys):
+        return None
+    k = keys[idx]
+    out_k = float(k) if isinstance(k, int) else (
+        k[1] if isinstance(k, tuple) else k)
+    return [out_k, t.hash[k]]
+
+
+def _pairs(t, *_):
+    """Stateful iterator closure: O(1) per step (the standalone `next`
+    global keeps stateless semantics for explicit callers, but pairs()
+    iteration is on the filter hot path)."""
+    t = _t(t, "pairs")
+    it = iter(list(t.hash.items()))
+
+    def step(*_a):
+        for k, v in it:
+            out_k = float(k) if isinstance(k, int) else (
+                k[1] if isinstance(k, tuple) else k)
+            return [out_k, v]
+        return None
+
+    return [step, t, None]
+
+
+def _ipairs_iter(t, i):
+    i = (i or 0.0) + 1
+    v = t.get(i)
+    if v is None:
+        return None
+    return [float(i), v]
+
+
+def _ipairs(t, *_):
+    return [_ipairs_iter, _t(t, "ipairs"), 0.0]
+
+
+def _pcall(f=None, *args):
+    try:
+        return [True] + call_value(f, list(args))
+    except LuaError as e:
+        return [False, e.value]
+    except (ZeroDivisionError, RecursionError, TypeError,
+            ValueError, AttributeError, IndexError, KeyError) as e:
+        return [False, f"runtime error: {e}"]
+
+
+def _xpcall(f=None, handler=None, *args):
+    try:
+        return [True] + call_value(f, list(args))
+    except LuaError as e:
+        return [False] + call_value(handler, [e.value])
+
+
+def _error(msg=None, _level=None):
+    if isinstance(msg, str):
+        raise LuaError("script: " + msg)
+    raise LuaError(msg)
+
+
+def _assert(v=None, msg=None, *rest):
+    if not truthy(v):
+        _error(msg if msg is not None else "assertion failed!")
+    return [v, msg] + list(rest) if msg is not None else [v]
+
+
+def _select(n=None, *args):
+    if n == "#":
+        return float(len(args))
+    i = int(_n(n, "select"))
+    if i < 0:
+        i = len(args) + i + 1
+    if i < 1:
+        raise LuaError("bad argument #1 to 'select' (index out of range)")
+    return list(args[i - 1:])
+
+
+def _unpack(t, i=1.0, j=None):
+    t = _t(t, "unpack")
+    jj = int(_n(j, "unpack", 3)) if j is not None else t.length()
+    return [t.get(float(k)) for k in range(int(_n(i, "unpack", 2)),
+                                           jj + 1)]
+
+
+def _setmetatable(t=None, mt=None):
+    t = _t(t, "setmetatable")
+    if mt is not None and not isinstance(mt, LuaTable):
+        raise LuaError("bad argument #2 to 'setmetatable' "
+                       "(nil or table expected)")
+    t.metatable = mt
+    return t
+
+
+def _getmetatable(t=None):
+    if isinstance(t, LuaTable) and t.metatable is not None:
+        return t.metatable.hash.get("__metatable", t.metatable)
+    return None
+
+
+def _rawget(t=None, k=None):
+    from .interp import _normkey
+    return _t(t, "rawget").hash.get(_normkey(k))
+
+
+def _rawset(t=None, k=None, v=None):
+    _t(t, "rawset").set(k, v)
+    return t
+
+
+def _rawequal(a=None, b=None):
+    return lua_eq(a, b)
+
+
+def _print(*args):
+    print("\t".join(lua_tostring(a) for a in args))
+
+
+# ---------------------------------------------------------------- os
+
+def _os_time(t=None):
+    if isinstance(t, LuaTable):
+        import calendar
+        def g(k, d=None):
+            v = t.get(k)
+            return int(v) if v is not None else d
+        try:
+            return float(_time.mktime((
+                g("year"), g("month"), g("day"),
+                g("hour", 12), g("min", 0), g("sec", 0), 0, 0,
+                -1 if t.get("isdst") is None else int(truthy(t.get("isdst"))),
+            )))
+        except (ValueError, OverflowError):
+            return None
+    return float(int(_time.time()))
+
+
+def _os_date(fmt="%c", t=None):
+    fmt = _s(fmt, "date") if fmt is not None else "%c"
+    when = _n(t, "date", 2) if t is not None else _time.time()
+    utc = fmt.startswith("!")
+    if utc:
+        fmt = fmt[1:]
+    st = _time.gmtime(when) if utc else _time.localtime(when)
+    if fmt.startswith("*t"):
+        out = LuaTable()
+        out.set("year", float(st.tm_year))
+        out.set("month", float(st.tm_mon))
+        out.set("day", float(st.tm_mday))
+        out.set("hour", float(st.tm_hour))
+        out.set("min", float(st.tm_min))
+        out.set("sec", float(st.tm_sec))
+        out.set("wday", float(st.tm_wday + 2 if st.tm_wday < 6 else 1.0))
+        out.set("yday", float(st.tm_yday))
+        out.set("isdst", st.tm_isdst > 0)
+        return out
+    return _time.strftime(fmt, st)
+
+
+# ------------------------------------------------------------ export
+
+def _lib(d: dict) -> LuaTable:
+    t = LuaTable()
+    for k, v in d.items():
+        t.set(k, v)
+    return t
+
+
+def make_globals() -> dict:
+    import random as _random
+    math_lib = {
+        "floor": lambda x=None: float(math.floor(_n(x, "floor"))),
+        "ceil": lambda x=None: float(math.ceil(_n(x, "ceil"))),
+        "abs": lambda x=None: abs(_n(x, "abs")),
+        "max": lambda *a: max(_n(x, "max", i + 1)
+                              for i, x in enumerate(a)),
+        "min": lambda *a: min(_n(x, "min", i + 1)
+                              for i, x in enumerate(a)),
+        "sqrt": lambda x=None: math.sqrt(_n(x, "sqrt")),
+        "pow": lambda x=None, y=None: float(_n(x, "pow")
+                                            ** _n(y, "pow", 2)),
+        "exp": lambda x=None: math.exp(_n(x, "exp")),
+        "log": lambda x=None, b=None: (
+            math.log(_n(x, "log"), _n(b, "log", 2)) if b is not None
+            else math.log(_n(x, "log"))),
+        "log10": lambda x=None: math.log10(_n(x, "log10")),
+        "sin": lambda x=None: math.sin(_n(x, "sin")),
+        "cos": lambda x=None: math.cos(_n(x, "cos")),
+        "tan": lambda x=None: math.tan(_n(x, "tan")),
+        "fmod": lambda x=None, y=None: math.fmod(_n(x, "fmod"),
+                                                 _n(y, "fmod", 2)),
+        "modf": lambda x=None: list(
+            (lambda f: [float(int(f)) if f >= 0 else -float(int(-f)),
+                        f - (float(int(f)) if f >= 0
+                             else -float(int(-f)))])(_n(x, "modf"))),
+        "huge": math.inf,
+        "pi": math.pi,
+        "random": lambda m=None, n=None: (
+            _random.random() if m is None else
+            float(_random.randint(1, int(_n(m, "random")))) if n is None
+            else float(_random.randint(int(_n(m, "random")),
+                                       int(_n(n, "random", 2))))),
+        "randomseed": lambda x=None: _random.seed(
+            _n(x, "randomseed") if x is not None else None),
+    }
+    os_lib = {
+        "time": _os_time,
+        "date": _os_date,
+        "clock": lambda: _time.process_time(),
+        "getenv": lambda k=None: __import__("os").environ.get(
+            _s(k, "getenv")),
+    }
+    table_lib = {
+        "insert": _table_insert,
+        "remove": _table_remove,
+        "concat": _table_concat,
+        "sort": _table_sort,
+        "getn": lambda t=None: float(_t(t, "getn").length()),
+    }
+    g = {
+        "print": _print,
+        "type": lambda v=None: lua_type(v),
+        "tostring": lambda v=None: lua_tostring(v),
+        "tonumber": lambda v=None, b=None: tonumber(v, b),
+        "pairs": _pairs,
+        "ipairs": _ipairs,
+        "next": _next,
+        "select": _select,
+        "unpack": _unpack,
+        "error": _error,
+        "assert": _assert,
+        "pcall": _pcall,
+        "xpcall": _xpcall,
+        "setmetatable": _setmetatable,
+        "getmetatable": _getmetatable,
+        "rawget": _rawget,
+        "rawset": _rawset,
+        "rawequal": _rawequal,
+        "rawlen": lambda t=None: float(_t(t, "rawlen").length()),
+        "string": _lib(STRING_LIB),
+        "table": _lib(table_lib),
+        "math": _lib(math_lib),
+        "os": _lib(os_lib),
+        "_VERSION": "Lua 5.1",
+    }
+    return g

@@ -1,0 +1,166 @@
+"""filter_lua on the from-scratch Lua runtime (fluentbit_tpu.luart).
+
+Reference: plugins/filter_lua/lua.c + src/flb_lua.c (LuaJIT embed).
+Contract (lua.c:440-705): per record call
+
+    function <call>(tag, timestamp, record)
+        return code, timestamp, record
+    end
+
+code -1 → skip the record; 0 → keep as-is; 1 → modified, use returned
+timestamp + record; 2 → modified, keep ORIGINAL timestamp. A returned
+ARRAY of tables splits into one record each (lua.c pack loop). With
+``time_as_table on`` the timestamp travels as {sec=, nsec=} both ways
+(flb_lua_pushtimetable). ``protected_mode`` (default on) keeps the
+original record and logs when the script errors (lua_pcall stance).
+``type_int_key`` lists keys whose returned values are forced to
+integers (flb_lua dual int/double packing).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import List
+
+from ..codec.events import LogEvent
+from ..codec.msgpack import EventTime
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..luart import LuaError, LuaRuntime, LuaTable, lua_to_py, py_to_lua
+
+log = logging.getLogger("flb.lua")
+
+
+@registry.register
+class LuaFilter(FilterPlugin):
+    name = "lua"
+    description = "Lua script filter (from-scratch Lua 5.1 runtime)"
+    config_map = [
+        ConfigMapEntry("script", "str", desc="path of the Lua script"),
+        ConfigMapEntry("code", "str", desc="inline Lua source"),
+        ConfigMapEntry("call", "str",
+                       desc="Lua function name to invoke per record"),
+        ConfigMapEntry("protected_mode", "bool", default=True),
+        ConfigMapEntry("time_as_table", "bool", default=False),
+        ConfigMapEntry("type_int_key", "slist", multiple=True,
+                       desc="keys whose values are packed as integers"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.script and not self.code:
+            raise ValueError("lua filter requires 'script' or 'code'")
+        if not self.call:
+            raise ValueError("lua filter requires 'call'")
+        source = self.code or ""
+        name = "<inline>"
+        if self.script:
+            name = self.script
+            with open(self.script, "r", encoding="utf-8") as f:
+                source = f.read()
+        self._rt = LuaRuntime()
+        self._rt.load(source, name)
+        fn = self._rt.globals.vars.get(self.call)
+        if fn is None:
+            raise ValueError(
+                f"lua filter: function {self.call!r} not found in {name}")
+        self._int_keys = set()
+        for item in self.type_int_key or []:
+            for k in (item if isinstance(item, list) else [item]):
+                self._int_keys.add(k)
+
+    # ------------------------------------------------------ time repr
+
+    def _push_time(self, ts_float: float):
+        if not self.time_as_table:
+            return ts_float
+        t = LuaTable()
+        sec = math.floor(ts_float)
+        t.set("sec", float(sec))
+        t.set("nsec", float(round((ts_float - sec) * 1e9)))
+        return t
+
+    def _pop_time(self, v, fallback: float) -> float:
+        if isinstance(v, LuaTable):
+            sec = v.get("sec")
+            nsec = v.get("nsec")
+            if sec is not None:
+                return float(sec) + float(nsec or 0.0) / 1e9
+            return fallback
+        if isinstance(v, float):
+            return v
+        return fallback
+
+    # -------------------------------------------------------- filter
+
+    def _coerce(self, rec: dict) -> dict:
+        if not self._int_keys or not isinstance(rec, dict):
+            return rec
+        for k in list(rec.keys()):
+            if k in self._int_keys:
+                try:
+                    rec[k] = int(float(rec[k]))
+                except (TypeError, ValueError):
+                    pass
+        return rec
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        out: List[LogEvent] = []
+        modified = False
+        for ev in events:
+            if ev.is_group_start() or ev.is_group_end():
+                out.append(ev)
+                continue
+            try:
+                rets = self._rt.call(
+                    self.call,
+                    [tag, self._push_time(ev.ts_float),
+                     py_to_lua(ev.body)])
+            except (LuaError, RecursionError, ZeroDivisionError,
+                    TypeError, ValueError, OverflowError,
+                    AttributeError, IndexError, KeyError) as e:
+                # stdlib calls can surface raw Python exceptions (e.g.
+                # string.char out of range) — protection is per record
+                if not self.protected_mode:
+                    raise
+                log.error("lua filter %r failed: %s", self.call, e)
+                out.append(ev)
+                continue
+            code = rets[0] if len(rets) > 0 else None
+            l_ts = rets[1] if len(rets) > 1 else None
+            l_rec = rets[2] if len(rets) > 2 else None
+            code = int(code) if isinstance(code, float) else code
+            if code == -1:
+                modified = True
+                continue
+            if code == 0 or code not in (1, 2):
+                if code not in (-1, 0, 1, 2):
+                    log.warning(
+                        "unexpected Lua script return code %r, original "
+                        "record will be kept", code)
+                out.append(ev)
+                continue
+            # code 1: returned timestamp; code 2: original timestamp
+            if code == 1:
+                new_ts = EventTime.from_float(
+                    self._pop_time(l_ts, ev.ts_float))
+            else:
+                new_ts = ev.timestamp
+            py_rec = lua_to_py(l_rec)
+            if isinstance(py_rec, list):
+                # array return → one record per table (lua.c pack loop)
+                recs = [r for r in py_rec if isinstance(r, dict)]
+            elif isinstance(py_rec, dict):
+                recs = [py_rec]
+            else:
+                log.warning("invalid record type returned by the Lua "
+                            "script; keeping the original")
+                out.append(ev)
+                continue
+            out.extend(
+                LogEvent(new_ts, self._coerce(r), ev.metadata, raw=None)
+                for r in recs)
+            modified = True
+        if not modified:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, out)

@@ -11,11 +11,13 @@ Python callback with the filter_lua contract:
 
     code -1 → drop the record
           0 → keep unmodified
-          1 → record was modified
-          2 → record AND timestamp were modified
+          1 → record AND timestamp were modified
+          2 → record modified, original timestamp kept
+    (the filter_lua return contract, plugins/filter_lua/lua.c:659-705)
 
-``lua`` and ``wasm`` are registered as explicit gates (LuaJIT/WAMR are
-not vendored in this image) whose error points at ``script``.
+``wasm`` is registered as an explicit gate (WAMR is not vendored in
+this image); ``lua`` is real — the from-scratch Lua runtime in
+``fluentbit_tpu.luart`` (plugins/filter_lua.py).
 """
 
 from __future__ import annotations
@@ -76,7 +78,8 @@ class ScriptFilter(FilterPlugin):
                 if code == 0:
                     out.append(ev)
                     continue
-                new_ts = ts if code == 2 else ev.timestamp
+                # code 1: returned timestamp; code 2: original kept
+                new_ts = ts if code == 1 else ev.timestamp
                 if isinstance(record, list):
                     # split: one input record → several outputs (the
                     # filter_lua array return form)
@@ -109,13 +112,6 @@ class _GatedFilter(FilterPlugin):
             f"vendored in this build — the 'script' filter provides the "
             f"same cb_filter contract in Python"
         )
-
-
-@registry.register
-class LuaFilter(_GatedFilter):
-    name = "lua"
-    description = "gated: LuaJIT runtime not vendored (use 'script')"
-    runtime = "LuaJIT"
 
 
 @registry.register

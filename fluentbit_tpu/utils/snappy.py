@@ -63,6 +63,10 @@ def decompress(data: bytes) -> bytes:
         raise TypeError("snappy.decompress expects bytes")
     data = bytes(data)
     expected, pos = _get_varint(data, 0)
+    # no element emits more than 64 bytes per 3 input bytes — a larger
+    # declared size can never be honest
+    if expected > (len(data) * 64) // 3 + 64:
+        raise SnappyError("preamble length impossible for input size")
     out = bytearray()
     n = len(data)
     while pos < n:
@@ -80,6 +84,10 @@ def decompress(data: bytes) -> bytes:
             length += 1
             if pos + length > n:
                 raise SnappyError("truncated literal body")
+            if len(out) + length > expected:
+                # bound the expansion as we go: crafted bodies must not
+                # allocate past the declared size (network-facing path)
+                raise SnappyError("output exceeds preamble length")
             out += data[pos:pos + length]
             pos += length
             continue
@@ -103,6 +111,8 @@ def decompress(data: bytes) -> bytes:
             pos += 4
         if offset == 0 or offset > len(out):
             raise SnappyError("copy offset out of range")
+        if len(out) + length > expected:
+            raise SnappyError("output exceeds preamble length")
         # overlapping copies are legal and meaningful (RLE-style)
         if offset >= length:
             start = len(out) - offset

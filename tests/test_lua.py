@@ -1,0 +1,362 @@
+"""Lua runtime + filter_lua tests.
+
+Language/stdlib cases mirror what LuaJIT guarantees filter scripts
+(reference plugins/filter_lua + src/flb_lua.c); filter tests mirror
+tests/runtime/filter_lua.c scenarios (modify record, drop, split,
+timestamp handling, protected mode)."""
+
+import json
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.luart import (
+    LuaError,
+    LuaRuntime,
+    lua_to_py,
+    py_to_lua,
+)
+
+
+def run(src, *names):
+    rt = LuaRuntime()
+    rt.load(src)
+    vals = [lua_to_py(rt.globals.vars.get(n)) for n in names]
+    return vals[0] if len(vals) == 1 else vals
+
+
+# ----------------------------------------------------------- language
+
+def test_arith_and_precedence():
+    assert run("x = 2 + 3 * 4", "x") == 14
+    assert run("x = -2 ^ 2", "x") == -4          # ^ binds above unary -
+    assert run("x = 2 ^ 3 ^ 2", "x") == 512      # right assoc
+    assert run("x = 7 % 3", "x") == 1
+    assert run("x = -7 % 3", "x") == 2           # Lua floor-mod
+    assert run("x = 10 / 4", "x") == 2.5
+    assert run("x = 1 .. 2", "x") == "12"
+
+
+def test_string_number_coercion():
+    assert run('x = "10" + 5', "x") == 15
+    assert run('x = "3" * "4"', "x") == 12
+
+
+def test_comparison_and_logic():
+    assert run("x = 1 < 2 and 'yes' or 'no'", "x") == "yes"
+    assert run("x = nil and 1 or 2", "x") == 2
+    assert run('x = "a" < "b"', "x") is True
+    with pytest.raises(LuaError):
+        run('x = 1 < "2"', "x")
+
+
+def test_multiple_assignment_and_returns():
+    assert run("""
+function two() return 1, 2 end
+a, b, c = two()
+d = (two())            -- parens truncate
+t = {two()}            -- expands at tail
+u = {two(), 10}        -- truncated mid-list
+""", "a", "b", "c", "d", "t", "u") == [1, 2, None, 1, [1, 2], [1, 10]]
+
+
+def test_closures_and_upvalues():
+    assert run("""
+local function make()
+  local c = 0
+  return function() c = c + 1 return c end
+end
+f = make()
+f(); f()
+x = f()
+""", "x") == 3
+
+
+def test_varargs():
+    assert run("""
+function f(...)
+  local t = {...}
+  return #t, select("#", ...), select(2, ...)
+end
+a, b, c = f("x", "y", "z")
+""", "a", "b", "c") == [3, 3, "y"]
+
+
+def test_loops_and_break():
+    assert run("""
+s = 0
+for i = 1, 10 do if i > 5 then break end s = s + i end
+r = 0
+local i = 0
+repeat i = i + 1 r = r + i until i >= 3
+w = 0
+while w < 7 do w = w + 2 end
+""", "s", "r", "w") == [15, 6, 8]
+
+
+def test_generic_for_pairs():
+    assert run("""
+t = {a = 1, b = 2}
+ks = {}
+for k, v in pairs(t) do ks[k] = v * 10 end
+arr = {5, 6, 7}
+sum = 0
+for i, v in ipairs(arr) do sum = sum + i * v end
+""", "ks", "sum") == [{"a": 10, "b": 20}, 38]
+
+
+def test_table_methods_and_length():
+    assert run("""
+t = {}
+table.insert(t, "a"); table.insert(t, "c"); table.insert(t, 2, "b")
+removed = table.remove(t, 1)
+n = #t
+joined = table.concat({"x", "y", "z"}, "-")
+nested = {list = {1, 2, {deep = true}}}
+""", "removed", "n", "joined", "nested") == [
+        "a", 2, "x-y-z", {"list": [1, 2, {"deep": True}]}]
+
+
+def test_table_sort():
+    assert run("""
+t = {3, 1, 2}
+table.sort(t)
+u = {"b", "c", "a"}
+table.sort(u, function(a, b) return a > b end)
+""", "t", "u") == [[1, 2, 3], ["c", "b", "a"]]
+
+
+def test_metatables_index():
+    assert run("""
+Base = {greet = function(self) return "hi " .. self.name end}
+obj = setmetatable({name = "bob"}, {__index = Base})
+x = obj:greet()
+""", "x") == "hi bob"
+
+
+def test_method_definition_colon():
+    assert run("""
+Account = {}
+Account.__index = Account
+function Account.new(b)
+  return setmetatable({balance = b}, Account)
+end
+function Account:deposit(v) self.balance = self.balance + v end
+a = Account.new(100)
+a:deposit(50)
+x = a.balance
+""", "x") == 150
+
+
+def test_pcall_error():
+    assert run("""
+ok, err = pcall(function() error("kaboom") end)
+ok2, v = pcall(function() return 42 end)
+""", "ok", "ok2", "v") == [False, True, 42]
+    assert "kaboom" in run("ok, err = pcall(error, 'kaboom')", "err")
+
+
+def test_tostring_tonumber():
+    assert run("x = tostring(42)", "x") == "42"
+    assert run("x = tostring(1.5)", "x") == "1.5"
+    assert run("x = tonumber('0x1F')", "x") == 31
+    assert run("x = tonumber('1e2')", "x") == 100
+    assert run("x = tonumber('zz')", "x") is None
+    assert run("x = tonumber('ff', 16)", "x") == 255
+
+
+def test_string_library():
+    assert run('x = string.format("%d-%s-%.1f", 7, "a", 2.25)', "x") \
+        == "7-a-2.2"
+    assert run('x = ("log"):rep(2)', "x") == "loglog"
+    assert run('x = string.byte("A")', "x") == 65
+    assert run('x = string.char(104, 105)', "x") == "hi"
+    assert run('x = string.sub("abcdef", -3)', "x") == "def"
+    assert run('x = #"hello"', "x") == 5
+
+
+def test_lua_patterns():
+    assert run('x = string.match("2024-01-15", "(%d+)-(%d+)")',
+               "x") == "2024"
+    assert run("""
+k, v = string.match("level=error", "(%w+)=(%w+)")
+""", "k", "v") == ["level", "error"]
+    assert run('x, n = string.gsub("a.b.c", "%.", "/")', "x") == "a/b/c"
+    assert run("""
+t = {}
+for k, v in string.gmatch("a=1, b=2", "(%w+)=(%w+)") do t[k] = v end
+""", "t") == {"a": "1", "b": "2"}
+    assert run('x = string.find("hello", "l+")', "x") == 3
+    assert run('x = string.match("  trim  ", "^%s*(.-)%s*$")', "x") \
+        == "trim"
+    assert run('x = string.gsub("<a><b>", "%b<>", "T")', "x") == "TT"
+
+
+def test_os_and_math():
+    assert run("x = math.floor(3.7)", "x") == 3
+    assert run("x = math.max(1, 9, 4)", "x") == 9
+    assert run("x = math.huge > 1e300", "x") is True
+    assert isinstance(run("x = os.time()", "x"), int)
+    assert run('x = os.date("!%Y-%m-%d", 86400)', "x") == "1970-01-02"
+
+
+def test_conversion_roundtrip():
+    rec = {"msg": "x", "count": 3, "pi": 3.5, "ok": True,
+           "tags": ["a", "b"], "meta": {"k": None}}
+    back = lua_to_py(py_to_lua(rec))
+    rec["meta"] = {}  # nil value deletes the key — Lua semantics
+    assert back == rec
+
+
+def test_global_g_table():
+    assert run('_G["via_g"] = 5; x = via_g + 1', "x") == 6
+
+
+# --------------------------------------------------------- filter_lua
+
+def lua_pipeline(code, records, call="cb", **props):
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("lua", match="t", code=code, call=call, **props)
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for r in records:
+            ctx.push(in_ffd, json.dumps(r))
+        ctx.flush_now()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    return [e for d in got for e in decode_events(d)]
+
+
+def test_filter_lua_modify():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  record["seen"] = tag .. "!"
+  record["n"] = (record["n"] or 0) + 1
+  return 2, ts, record
+end
+""", [{"n": 1}, {"msg": "x"}])
+    assert [e.body for e in evs] == [
+        {"n": 2, "seen": "t!"}, {"msg": "x", "n": 1, "seen": "t!"}]
+
+
+def test_filter_lua_drop_and_keep():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  if record.level == "debug" then return -1, ts, record end
+  return 0, ts, record
+end
+""", [{"level": "debug"}, {"level": "error"}])
+    assert [e.body for e in evs] == [{"level": "error"}]
+
+
+def test_filter_lua_split_array():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  return 1, ts, {{part = 1}, {part = 2}}
+end
+""", [{"x": "y"}])
+    assert [e.body for e in evs] == [{"part": 1}, {"part": 2}]
+
+
+def test_filter_lua_code1_timestamp_override():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  return 1, 1700000000.25, record
+end
+""", [{"a": 1}])
+    assert abs(evs[0].ts_float - 1700000000.25) < 1e-6
+
+
+def test_filter_lua_code2_keeps_timestamp():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  record.touched = true
+  return 2, 12345.0, record
+end
+""", [{"a": 1}])
+    assert evs[0].body["touched"] is True
+    assert evs[0].ts_float > 1e9  # original ingest time, not 12345
+
+
+def test_filter_lua_time_as_table():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  record.sec = ts.sec
+  ts.sec = 1600000000
+  ts.nsec = 500000000
+  return 1, ts, record
+end
+""", [{"a": 1}], time_as_table="on")
+    assert evs[0].body["sec"] > 1e9
+    assert abs(evs[0].ts_float - 1600000000.5) < 1e-6
+
+
+def test_filter_lua_protected_mode():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  if record.bad then error("nope") end
+  return 0, ts, record
+end
+""", [{"bad": True}, {"ok": 1}])
+    # errored record kept (protected_mode default on)
+    assert [e.body for e in evs] == [{"bad": True}, {"ok": 1}]
+
+
+def test_filter_lua_type_int_key():
+    evs = lua_pipeline("""
+function cb(tag, ts, record)
+  record.count = "42"
+  return 2, ts, record
+end
+""", [{"a": 1}], type_int_key="count")
+    assert evs[0].body["count"] == 42
+
+
+def test_filter_lua_script_file(tmp_path):
+    f = tmp_path / "script.lua"
+    f.write_text("""
+-- classic k8s-style log mangler
+function mangle(tag, ts, record)
+  local log = record.log
+  if log then
+    local level = string.match(log, "%[(%u+)%]")
+    if level then record.level = string.lower(level) end
+  end
+  return 2, ts, record
+end
+""")
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("lua", match="t", script=str(f), call="mangle")
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"log": "[ERROR] disk full"}))
+        ctx.flush_now()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    evs = [e for d in got for e in decode_events(d)]
+    assert evs[0].body["level"] == "error"
+
+
+def test_filter_lua_requires_call():
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.filter("lua", match="t", code="x = 1")
+    ctx.output("null", match="*")
+    with pytest.raises(Exception):
+        ctx.start()
+    ctx.stop()

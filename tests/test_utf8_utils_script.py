@@ -150,11 +150,10 @@ def test_script_inline_code_and_protected_mode():
     assert bodies == [{"survives": 1}]  # protected mode keeps the record
 
 
-def test_lua_wasm_gated():
+def test_wasm_gated():
     from fluentbit_tpu.core.plugin import registry
 
-    for name in ("lua", "wasm"):
-        ins = registry.create_filter(name)
-        ins.configure()
-        with pytest.raises(RuntimeError, match="script"):
-            ins.plugin.init(ins, None)
+    ins = registry.create_filter("wasm")
+    ins.configure()
+    with pytest.raises(RuntimeError, match="script"):
+        ins.plugin.init(ins, None)
