@@ -196,6 +196,7 @@ CORE_INSTANCE_KEYS = {
     "threaded", "workers", "retry_limit", "no_multiplex", "host", "port", "tls",
     "tls.verify", "tls.ca_file", "tls.crt_file", "tls.key_file", "tls.vhost",
     "http2",  # HTTP-based outputs: prior-knowledge h2c delivery
+    "proxy",  # HTTP-based outputs: http:// forward proxy
     "route_condition",  # ingest-time conditional routing (outputs)
     "net.keepalive", "net.keepalive_idle_timeout",
     "net.keepalive_max_recycle", "net.max_worker_connections",
@@ -217,6 +218,7 @@ class ServiceConfig:
     scheduler_base: float = 5.0      # retry backoff base (flb_scheduler.h:29)
     scheduler_cap: float = 2000.0    # retry backoff cap  (flb_scheduler.h:30)
     retry_limit: int = 1             # default per-output retries
+    task_map_size: int = 2048        # FLB_CONFIG_DEFAULT_TASK_MAP_SIZE
     storage_path: Optional[str] = None
     storage_sync: str = "normal"
     storage_checksum: bool = False
@@ -241,6 +243,7 @@ class ServiceConfig:
         "scheduler.base": ("scheduler_base", parse_time),
         "scheduler.cap": ("scheduler_cap", parse_time),
         "retry_limit": ("retry_limit", int),
+        "task_map_size": ("task_map_size", int),
         "storage.path": ("storage_path", str),
         "storage.sync": ("storage_sync", str),
         "storage.checksum": ("storage_checksum", parse_bool),

@@ -92,6 +92,17 @@ class Engine:
         # src/flb_engine_dispatch.c:36-99): a retry is a loop timer +
         # this record, NOT a sleeping coroutine — key (chunk id, output)
         self._pending_retries: Dict[tuple, tuple] = {}
+        # priority bucket queue (flb_bucket_queue, 8 priorities): ready
+        # engine callbacks drain lowest-priority-number first, so retry
+        # fires (scheduler, top) outrun fresh flush spawns (flush, 2)
+        from .bucket_queue import BucketQueue
+
+        self._event_queue = BucketQueue()
+        self._event_queue_lock = threading.Lock()
+        # task id map, default 2048 slots (flb_task_map, flb_task.c:542
+        # + FLB_CONFIG_DEFAULT_TASK_MAP_SIZE): dispatch pauses when full
+        self._task_map: Dict[int, Task] = {}
+        self._task_map_warned = 0.0
         self._notification_subs: List = []
         self.started_at: float = 0.0
         self.reload_count = 0
@@ -369,6 +380,16 @@ class Engine:
                 continue  # hidden inputs are initialized at creation
             ins.configure()
             ins.plugin.init(ins, self)
+        # output worker thread pools (flb_output_thread_pool_create,
+        # src/flb_output_thread.c:472): flush callbacks leave the
+        # engine loop when `workers` is set
+        from .output_thread import OutputWorkerPool
+
+        for out in self.outputs:
+            if out.workers > 0 and out.worker_pool is None \
+                    and not out.plugin.synchronous:
+                out.worker_pool = OutputWorkerPool(
+                    out.display_name, out.workers, out.plugin)
         self.started_at = time.time()
         self._stopping = False
         self._stop_event.clear()
@@ -525,6 +546,10 @@ class Engine:
         self._stopping = True
         self._thread.join(timeout=self.service.grace + 10)
         self._thread = None
+        for out in self.outputs:
+            if out.worker_pool is not None:
+                out.worker_pool.stop()
+                out.worker_pool = None
         for ins in self.inputs + self.filters + self.outputs + self.customs:
             try:
                 ins.plugin.exit()
@@ -907,7 +932,7 @@ class Engine:
                         ins.plugin.resume()
                     except Exception:
                         pass
-        for ins, chunk in chunks:
+        for ci, (ins, chunk) in enumerate(chunks):
             if chunk.routes_mask:
                 # conditionally-split chunk: the ingest-time bitmask IS
                 # the route set (tag matching already folded in)
@@ -934,12 +959,58 @@ class Engine:
                 if self.storage is not None:
                     self.storage.delete(chunk)
                 continue
+            # bounded task id map (flb_task_map_get_task_id,
+            # src/flb_task.c:542): when every slot is in use the chunk
+            # stays in its pool and is re-dispatched next flush cycle —
+            # the reference's "task_id exhausted" stance
+            if len(self._task_map) >= self.service.task_map_size:
+                now = time.time()
+                if now - self._task_map_warned > 5.0:
+                    self._task_map_warned = now
+                    log.warning(
+                        "task map full (%d tasks in flight) — chunk "
+                        "dispatch paused until slots free",
+                        len(self._task_map))
+                # chunks were already drained from their pools: park
+                # them on the backlog so the next cycle re-dispatches
+                self._backlog.extend(c for _i, c in chunks[ci:])
+                break
             task = Task(chunk, routes)
+            self._task_map[task.id] = task
             for out in routes:
                 task.users += 1
                 self._spawn_flush(task, out)
 
-    def _spawn_flush(self, task: Task, out: OutputInstance) -> None:
+    def _task_unref(self, task: Task) -> None:
+        """flb_task_users_dec: the id-map slot frees when the last
+        route finishes (flb_task_destroy)."""
+        task.users -= 1
+        if task.users == 0:
+            self._task_map.pop(task.id, None)
+
+    def _enqueue_event(self, priority: int, fn) -> None:
+        """Queue a ready callback through the 8-priority bucket queue
+        (flb_engine_handle_event demux order): drains run lowest
+        priority number first on the engine loop."""
+        with self._event_queue_lock:
+            self._event_queue.add(priority, fn)
+        self.loop.call_soon_threadsafe(self._drain_event_queue)
+
+    def _drain_event_queue(self) -> None:
+        while True:
+            with self._event_queue_lock:
+                if not self._event_queue:
+                    return
+                fn = self._event_queue.pop()
+            try:
+                fn()
+            except Exception:
+                log.exception("engine event callback failed")
+
+    def _spawn_flush(self, task: Task, out: OutputInstance,
+                     priority: Optional[int] = None) -> None:
+        from .bucket_queue import PRIORITY_FLUSH
+
         coro = self._flush_one(task, out)
         if self.loop is None or not self.running:
             # synchronous fallback (engine not started: unit tests)
@@ -950,13 +1021,14 @@ class Engine:
             self._pending_flushes.add(fut)
             fut.add_done_callback(self._pending_flushes.discard)
         try:
-            self.loop.call_soon_threadsafe(_create)
+            self._enqueue_event(
+                PRIORITY_FLUSH if priority is None else priority, _create)
         except RuntimeError:
             # loop shut down mid-stop: account the chunk as dropped
             coro.close()
             self.m_out_errors.inc(1, (out.display_name,))
             self.m_out_dropped.inc(task.chunk.records, (out.display_name,))
-            task.users -= 1
+            self._task_unref(task)
 
     async def _flush_one(self, task: Task, out: OutputInstance) -> None:
         """One (task × output) flush ATTEMPT
@@ -1029,8 +1101,15 @@ class Engine:
                         result = FlushResult.ERROR
                 else:
                     try:
-                        result = await out.plugin.flush(data, chunk.tag,
-                                                        self)
+                        if out.worker_pool is not None:
+                            # run the plugin's flush on a worker thread
+                            # loop (flb_output_thread.c round-robin);
+                            # result/retry handling stays here
+                            result = await out.worker_pool.submit(
+                                out.plugin.flush(data, chunk.tag, self))
+                        else:
+                            result = await out.plugin.flush(
+                                data, chunk.tag, self)
                     except asyncio.CancelledError:
                         raise
                     except Exception:
@@ -1065,13 +1144,17 @@ class Engine:
         key = (task.chunk.id, out.name)
 
         def _fire():
+            from .bucket_queue import PRIORITY_TOP
+
             self._pending_retries.pop(key, None)
             # fire even while stopping: a retry coming due inside the
             # grace window gets its attempt (the reference services
             # retries until grace expires); if it RETRYs again,
             # _register drops it, and the stop-sequence cleanup handles
-            # whatever is still pending when grace runs out
-            self._spawn_flush(task, out)
+            # whatever is still pending when grace runs out.
+            # Scheduler events outrank flush spawns
+            # (FLB_ENGINE_PRIORITY_CB_SCHED = top)
+            self._spawn_flush(task, out, priority=PRIORITY_TOP)
 
         def _register():
             if self._stopping:
@@ -1097,7 +1180,7 @@ class Engine:
                 self.storage.quarantine(task.chunk)
             except Exception:
                 log.exception("retry quarantine failed")
-        task.users -= 1
+        self._task_unref(task)
 
     def _handle_flush_result(self, task: Task, out: OutputInstance,
                              result: FlushResult) -> Optional[float]:
@@ -1109,7 +1192,7 @@ class Engine:
             self.m_out_proc_records.inc(chunk.records, (name,))
             self.m_out_proc_bytes.inc(chunk.size, (name,))
             self.m_latency.observe(time.time() - chunk.created, (name,))
-            task.users -= 1
+            self._task_unref(task)
             if task.users == 0 and self.storage is not None:
                 self.storage.delete(chunk)  # every route delivered
             return None
@@ -1131,7 +1214,7 @@ class Engine:
                 self.storage.quarantine(chunk)
             except Exception:
                 log.exception("DLQ quarantine failed")
-        task.users -= 1
+        self._task_unref(task)
         if task.users == 0 and self.storage is not None:
             self.storage.delete(chunk)  # dlq copy (if any) is separate
         return None

@@ -1,0 +1,88 @@
+"""Output worker thread pools.
+
+Reference: src/flb_output_thread.c — an output configured with
+``workers N`` runs its flush callbacks on N dedicated OS threads, each
+with its own event loop; tasks are assigned round-robin
+(flb_output_thread.c:439-496), and workers get cb_worker_init/exit
+hooks (:249, :375). Here each worker thread runs its own asyncio loop
+and the engine submits the plugin's flush coroutine to the next worker,
+awaiting the result from the engine loop via a wrapped
+concurrent.futures future — delivery I/O (and any GIL-releasing work:
+socket sends, TLS, compression in C) leaves the engine thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import threading
+from typing import List, Optional
+
+log = logging.getLogger("flb.output_thread")
+
+
+class OutputWorkerPool:
+    def __init__(self, name: str, workers: int, plugin=None):
+        self.name = name
+        self.plugin = plugin
+        self._loops: List[asyncio.AbstractEventLoop] = []
+        self._threads: List[threading.Thread] = []
+        self._rr = itertools.cycle(range(workers))
+        ready = threading.Barrier(workers + 1)
+        for i in range(workers):
+            t = threading.Thread(target=self._worker, args=(i, ready),
+                                 daemon=True,
+                                 name=f"flb-out-{name}-w{i}")
+            t.start()
+            self._threads.append(t)
+        ready.wait(timeout=10)
+
+    def _worker(self, index: int, ready: threading.Barrier) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loops.append(loop)
+        # cb_worker_init hook (flb_output_thread.c:249)
+        init = getattr(self.plugin, "worker_init", None)
+        if init is not None:
+            try:
+                init(index)
+            except Exception:
+                log.exception("%s worker_init failed", self.name)
+        try:
+            ready.wait(timeout=10)
+        except threading.BrokenBarrierError:
+            pass
+        try:
+            loop.run_forever()
+        finally:
+            # drain callbacks scheduled right before stop
+            try:
+                loop.run_until_complete(asyncio.sleep(0))
+            except Exception:
+                pass
+            exit_cb = getattr(self.plugin, "worker_exit", None)
+            if exit_cb is not None:
+                try:
+                    exit_cb(index)
+                except Exception:
+                    log.exception("%s worker_exit failed", self.name)
+            loop.close()
+
+    def submit(self, coro) -> "asyncio.Future":
+        """Run the coroutine on the next worker loop (round-robin);
+        returns an awaitable for the CALLING loop."""
+        loop = self._loops[next(self._rr) % len(self._loops)]
+        cf = asyncio.run_coroutine_threadsafe(coro, loop)
+        return asyncio.wrap_future(cf)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        for loop in self._loops:
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._loops.clear()
+        self._threads.clear()

@@ -224,6 +224,8 @@ class OutputInstance(Instance):
         # src/flb_engine_dispatch.c:101-137)
         self.test_formatter: Optional[Callable] = None
         self.http2 = False  # prior-knowledge h2c delivery
+        self.proxy = None   # (host, port) of an http:// forward proxy
+        self.worker_pool = None  # OutputWorkerPool when workers > 0
         # ingest-time conditional route (flb_router_condition.c):
         # records failing the condition never enter this output's chunks
         self.route_condition = None
@@ -261,6 +263,25 @@ class OutputInstance(Instance):
         # fail fast on a bad value (config_map-typed options do the
         # same); an invalid bool must not surface per-flush
         self.http2 = parse_bool(self.properties.get("http2", False))
+        pxy = self.properties.get("proxy")
+        if pxy:
+            # reference proxy_parse (flb_http_client.c:744): http:// only
+            # (https proxies are an explicit FIXME there too)
+            from urllib.parse import urlsplit
+            if "://" not in pxy:
+                pxy = "http://" + pxy
+            parts = urlsplit(pxy)
+            if parts.scheme != "http":
+                raise ValueError(
+                    f"proxy: only http:// proxies are supported, got {pxy!r}")
+            self.proxy = (parts.hostname, parts.port or 80)
+            if parts.username:
+                import base64 as _b64
+                cred = f"{parts.username}:{parts.password or ''}"
+                self.proxy_auth = "Basic " + _b64.b64encode(
+                    cred.encode()).decode()
+            else:
+                self.proxy_auth = None
         rl = self.properties.get("retry_limit")
         if rl is not None:
             if str(rl).lower() in ("no_limits", "false", "no_retries_forever", "unlimited"):

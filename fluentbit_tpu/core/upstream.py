@@ -89,26 +89,36 @@ class Upstream:
             get("net.keepalive_idle_timeout", 30) or 30)
         self.max_recycle = int(get("net.keepalive_max_recycle", 0) or 0)
         self.max_idle = int(get("net.max_worker_connections", 4) or 4)
-        self._idle: List[tuple] = []  # (reader, writer, parked_at, uses)
+        # parked connections are keyed by their OWNING event loop: with
+        # output worker threads (flb_output_thread.c) flushes run on
+        # several loops, and an asyncio stream must only be awaited on
+        # the loop that created it (the reference keeps per-worker
+        # keepalive queues for the same reason)
+        self._idle: dict = {}  # loop -> [(reader, writer, parked, uses)]
 
-    def _sweep(self, now: float) -> None:
+    def _bucket(self) -> List[tuple]:
+        loop = asyncio.get_running_loop()
+        return self._idle.setdefault(loop, [])
+
+    def _sweep(self, bucket: List[tuple], now: float) -> None:
         """Close idles past the timeout — LIFO reuse would otherwise
         strand the oldest parked sockets forever (the reference's
         keepalive sweep runs off the 1.5s housekeeping timer)."""
         keep = []
-        for entry in self._idle:
+        for entry in bucket:
             if now - entry[2] > self.idle_timeout:
                 self._close(entry[1])
             else:
                 keep.append(entry)
-        self._idle = keep
+        bucket[:] = keep
 
     async def get(self) -> Tuple[object, object, bool, int]:
         """(reader, writer, reused, use_count)."""
         now = time.time()
-        self._sweep(now)  # the single expiry path
-        while self._idle:
-            reader, writer, parked, uses = self._idle.pop()
+        bucket = self._bucket()
+        self._sweep(bucket, now)  # the single expiry path
+        while bucket:
+            reader, writer, parked, uses = bucket.pop()
             if reader.at_eof() or writer.is_closing():
                 self._close(writer)
                 continue
@@ -122,15 +132,16 @@ class Upstream:
 
     def release(self, reader, writer, reusable: bool,
                 use_count: int = 0) -> None:
-        self._sweep(time.time())
+        bucket = self._bucket()
+        self._sweep(bucket, time.time())
         if (not reusable or not self.keepalive
                 or writer.is_closing()
-                or len(self._idle) >= self.max_idle
+                or len(bucket) >= self.max_idle
                 or (self.max_recycle and use_count + 1
                     >= self.max_recycle)):
             self._close(writer)
             return
-        self._idle.append((reader, writer, time.time(), use_count + 1))
+        bucket.append((reader, writer, time.time(), use_count + 1))
 
     def _close(self, writer) -> None:
         try:
@@ -139,9 +150,23 @@ class Upstream:
             pass
 
     def close(self) -> None:
-        while self._idle:
-            _, writer, _, _ = self._idle.pop()
-            self._close(writer)
+        """May run on any thread (plugin exit): sockets parked on other
+        loops are closed on their owning loop."""
+        for loop, bucket in list(self._idle.items()):
+            while bucket:
+                _, writer, _, _ = bucket.pop()
+                try:
+                    running = asyncio.get_running_loop()
+                except RuntimeError:
+                    running = None
+                if loop is running or loop.is_closed():
+                    self._close(writer)
+                else:
+                    try:
+                        loop.call_soon_threadsafe(self._close, writer)
+                    except RuntimeError:
+                        self._close(writer)
+        self._idle.clear()
 
 
 class UpstreamNode:

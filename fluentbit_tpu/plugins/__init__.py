@@ -40,4 +40,5 @@ from . import out_pgsql  # noqa: F401
 from . import misc_tail3  # noqa: F401
 from . import prometheus_remote_write  # noqa: F401
 from . import in_mqtt  # noqa: F401
+from . import filter_geoip2  # noqa: F401
 from . import gated  # noqa: F401

@@ -231,16 +231,17 @@ class TdaProcessor(ProcessorPlugin):
     reference computes Betti 0/1/2 with the vendored C++ ripser
     (src/ripser/flb_ripser_wrapper.cpp:39-45; tda.c:735-757). Here the
     Vietoris–Rips complex at ``epsilon`` is built exactly up to its
-    2-skeleton: Betti-0 by union-find over the edge set, Betti-1 by the
-    Euler/boundary identity β1 = E − V + β0 − rank(∂2) with the
-    triangle boundary rank computed over GF(2) — exact, because H1
-    depends only on the 2-skeleton. Betti-2 would need the 3-skeleton
-    (documented divergence: not emitted; the reference's ripser does
-    compute it). A triangle-count guard keeps pathological windows from
-    stalling ingest — when it trips, only Betti-0 is stamped."""
+    3-skeleton: Betti-0 by union-find over the edge set, Betti-1 by the
+    identity β1 = E − V + β0 − rank(∂2), Betti-2 by
+    β2 = dim ker ∂2 − rank ∂3 = (T − rank ∂2) − rank ∂3 with both
+    boundary ranks computed over GF(2) — exact, because Hk depends only
+    on the (k+1)-skeleton (tda.c:735-757 emits the same three gauges
+    via ripser). Simplex-count guards keep pathological windows from
+    stalling ingest — past max_triangles only β0 is stamped; past
+    max_tetrahedra β0/β1 are stamped without β2."""
 
     name = "tda"
-    description = "sliding-window Betti-0/1 anomaly signal"
+    description = "sliding-window Betti-0/1/2 anomaly signal"
     config_map = [
         ConfigMapEntry("fields", "clist",
                        desc="numeric fields forming the point cloud"),
@@ -248,8 +249,11 @@ class TdaProcessor(ProcessorPlugin):
         ConfigMapEntry("epsilon", "double", default=1.0),
         ConfigMapEntry("output_key", "str", default="betti_0"),
         ConfigMapEntry("output_key_b1", "str", default="betti_1"),
+        ConfigMapEntry("output_key_b2", "str", default="betti_2"),
         ConfigMapEntry("max_triangles", "int", default=20000,
                        desc="β1 guard: beyond this, only β0 is emitted"),
+        ConfigMapEntry("max_tetrahedra", "int", default=20000,
+                       desc="β2 guard: beyond this, β2 is not emitted"),
     ]
 
     def init(self, instance, engine) -> None:
@@ -285,19 +289,34 @@ class TdaProcessor(ProcessorPlugin):
         E = len(edge_idx)
         # triangle boundary rows: each triangle flips its 3 edge bits
         rows: List[int] = []
+        tri_idx: dict = {}
         for i in range(n):
             for j in range(i + 1, n):
                 if not adj[i][j]:
                     continue
                 for k in range(j + 1, n):
                     if adj[i][k] and adj[j][k]:
+                        tri_idx[(i, j, k)] = len(tri_idx)
                         rows.append((1 << edge_idx[(i, j)])
                                     | (1 << edge_idx[(i, k)])
                                     | (1 << edge_idx[(j, k)]))
                         if len(rows) > self.max_triangles:
-                            return b0, None  # guard tripped
-        b1 = E - n + b0 - _gf2_rank(rows)
-        return b0, b1
+                            return b0, None, None  # guard tripped
+        r2 = _gf2_rank(rows)
+        b1 = E - n + b0 - r2
+        # tetrahedra flip their 4 triangle faces (∂3)
+        rows3: List[int] = []
+        for (i, j, k) in tri_idx:
+            for l in range(k + 1, n):
+                if adj[i][l] and adj[j][l] and adj[k][l]:
+                    rows3.append((1 << tri_idx[(i, j, k)])
+                                 | (1 << tri_idx[(i, j, l)])
+                                 | (1 << tri_idx[(i, k, l)])
+                                 | (1 << tri_idx[(j, k, l)]))
+                    if len(rows3) > self.max_tetrahedra:
+                        return b0, b1, None
+        b2 = (len(tri_idx) - r2) - _gf2_rank(rows3)
+        return b0, b1, b2
 
     def process_logs(self, events: list, tag: str, engine) -> list:
         out = []
@@ -320,9 +339,11 @@ class TdaProcessor(ProcessorPlugin):
             if len(self._window) > self.window_size:
                 self._window.pop(0)
             body = dict(ev.body)
-            b0, b1 = self._betti()
+            b0, b1, b2 = self._betti()
             body[self.output_key] = b0
             if b1 is not None:
                 body[self.output_key_b1] = b1
+            if b2 is not None:
+                body[self.output_key_b2] = b2
             out.append(LogEvent(ev.timestamp, body, ev.metadata, raw=None))
         return out

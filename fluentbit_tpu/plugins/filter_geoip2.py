@@ -1,0 +1,100 @@
+"""filter_geoip2 on the from-scratch MMDB reader (utils/mmdb.py).
+
+Reference: plugins/filter_geoip2/geoip2.c (libmaxminddb). Properties:
+``database`` (mmdb path), ``lookup_key`` (multiple — record keys whose
+string values are IPs), ``record`` "KEY LOOKUP_KEY %{dot.path}"
+(multiple — geoip2.c:85-108). Every configured record key is appended
+to EVERY record; lookup misses, absent paths, and map/array results
+append null (geoip2.c:226-276) so the output shape is stable.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from ..codec.events import LogEvent
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..utils.mmdb import MMDBError, MMDBReader
+
+log = logging.getLogger("flb.geoip2")
+
+
+@registry.register
+class Geoip2Filter(FilterPlugin):
+    name = "geoip2"
+    description = "GeoIP2 enrichment from a MaxMind DB file"
+    config_map = [
+        ConfigMapEntry("database", "str"),
+        ConfigMapEntry("lookup_key", "slist", multiple=True),
+        ConfigMapEntry("record", "slist", multiple=True,
+                       slist_max_split=2),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.database:
+            raise ValueError("geoip2 filter requires 'database'")
+        try:
+            self._db = MMDBReader(self.database)
+        except (OSError, MMDBError) as e:
+            raise ValueError(f"geoip2: cannot open {self.database}: {e}")
+        self._lookup_keys: List[str] = []
+        for item in self.lookup_key or []:
+            for k in (item if isinstance(item, list) else [item]):
+                self._lookup_keys.append(k)
+        if not self._lookup_keys:
+            raise ValueError("at least one lookup_key is required")
+        # record = KEY LOOKUP_KEY %{path.inside.mmdb}; each configured
+        # occurrence arrives either pre-split by the config_map (a
+        # [key, lookup, value] triple) or as full strings (kwargs list)
+        self._records: List[Tuple[str, str, List[str]]] = []
+        flat: List[object] = []
+        for item in self.record or []:
+            if isinstance(item, list) and not (
+                    len(item) == 3 and " " not in str(item[0])
+                    and " " not in str(item[1])):
+                flat.extend(item)
+            else:
+                flat.append(item)
+        for item in flat:
+            parts = item if isinstance(item, list) \
+                else str(item).split(None, 2)
+            if len(parts) != 3:
+                log.error("invalid record parameter %r — expects "
+                          "'KEY LOOKUP_KEY VALUE'", item)
+                continue
+            key, lkey, val = parts
+            path = val[2:-1] if val.startswith("%{") and val.endswith("}") \
+                else val
+            self._records.append((key, lkey, path.split(".")))
+
+    def _ip_of(self, body: dict, lkey: str) -> Optional[str]:
+        v = body.get(lkey)
+        if isinstance(v, bytes):
+            v = v.decode("utf-8", "replace")
+        return v if isinstance(v, str) else None
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        if not self._records:
+            return (FilterResult.NOTOUCH, events)
+        out = []
+        for ev in events:
+            if ev.is_group_start() or ev.is_group_end():
+                out.append(ev)
+                continue
+            body = dict(ev.body)
+            for key, lkey, path in self._records:
+                value = None
+                ip = self._ip_of(ev.body, lkey)
+                if ip:
+                    try:
+                        value = self._db.get_path(ip, path)
+                    except MMDBError:
+                        value = None
+                if isinstance(value, (dict, list)):
+                    log.warning("Not supported MAP and ARRAY")
+                    value = None
+                body[key] = value
+            out.append(LogEvent(ev.timestamp, body, ev.metadata, raw=None))
+        return (FilterResult.MODIFIED, out)

@@ -39,7 +39,6 @@ _gate(InputPlugin, "kafka", "librdkafka")
 _gate(OutputPlugin, "kafka", "librdkafka")
 _gate(InputPlugin, "exec_wasi", "WAMR",
       "the 'exec' input runs native commands")
-_gate(FilterPlugin, "geoip2", "libmaxminddb")
 _gate(FilterPlugin, "tensorflow", "TensorFlow Lite")
 _gate(FilterPlugin, "nightfall", "the Nightfall DLP API (network)")
 _gate(InputPlugin, "ebpf", "libbpf CO-RE")

@@ -434,9 +434,16 @@ class AzureBlobOutput(_HttpDeliveryOutput):
         name = tag.replace("*", "_")
         if (self.blob_type or "").lower() != "appendblob":
             # ms timestamp + per-instance sequence: two flushes of one
-            # tag in the same millisecond must not overwrite each other
-            self._seq = getattr(self, "_seq", 0) + 1
-            name += f".{int(time.time() * 1000)}.{self._seq}"
+            # tag in the same millisecond must not overwrite each other.
+            # itertools.count.__next__ is atomic — with `workers N`
+            # flushes run on parallel OS threads and a bare
+            # read-modify-write could mint duplicate names
+            counter = getattr(self, "_seq_counter", None)
+            if counter is None:
+                import itertools
+                counter = self.__dict__.setdefault(
+                    "_seq_counter", itertools.count(1))
+            name += f".{int(time.time() * 1000)}.{next(counter)}"
         parts = [self.container_name] + \
             ([prefix] if prefix else []) + [name + ".log"]
         base = "/" + "/".join(parts)

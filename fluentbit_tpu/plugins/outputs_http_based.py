@@ -98,7 +98,7 @@ class _HttpDeliveryOutput(OutputPlugin):
                     writer.close()
                 except Exception:
                     pass
-        if 200 <= status < 300:
+        if 200 <= status < 300 or status in ok_statuses:
             return FlushResult.OK
         if status >= 500 or status in (408, 429):
             return FlushResult.RETRY
@@ -106,17 +106,95 @@ class _HttpDeliveryOutput(OutputPlugin):
 
     def _upstream(self):
         """Lazy per-plugin keepalive pool (flb_upstream equivalent;
-        net.keepalive* instance properties tune it)."""
+        net.keepalive* instance properties tune it). With an http://
+        ``proxy`` and a plain-HTTP target, the pool dials the proxy."""
         from ..core.upstream import Upstream
 
-        up = getattr(self, "_pool", None)
-        if up is None or (up.host, up.port) != (self.host, self.port):
-            if up is not None:
-                up.close()
-            self._pool = up = Upstream(
-                self.instance, self.host, self.port,
-                connect_timeout=self.CONNECT_TIMEOUT)
-        return up
+        host, port = self.host, self.port
+        if self._plain_proxy():
+            host, port = self.instance.proxy
+        # worker pools run flushes on several OS threads — the lazy
+        # init must not race (two pools → one leaks its sockets)
+        import threading
+        lock = getattr(self, "_pool_lock", None)
+        if lock is None:
+            lock = self.__dict__.setdefault("_pool_lock",
+                                            threading.Lock())
+        with lock:
+            up = getattr(self, "_pool", None)
+            if up is None or (up.host, up.port) != (host, port):
+                if up is not None:
+                    up.close()
+                self._pool = up = Upstream(
+                    self.instance, host, port,
+                    connect_timeout=self.CONNECT_TIMEOUT)
+            return up
+
+    def _plain_proxy(self):
+        """Proxy for a plain-http target → absolute-form requests."""
+        from ..core.tls import tls_enabled
+        return getattr(self.instance, "proxy", None) is not None \
+            and not tls_enabled(self.instance)
+
+    async def _post_via_connect(self, wire: bytes,
+                                ok_statuses: tuple = ()) -> FlushResult:
+        """TLS target behind an http proxy: CONNECT tunnel, then TLS
+        handshake toward the origin, one-shot (no pooling across the
+        tunnel — the reference marks https proxies FIXME; CONNECT is
+        the portable subset)."""
+        import ssl as _ssl
+
+        from ..core.tls import client_context, client_server_hostname
+
+        phost, pport = self.instance.proxy
+        writer = None
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(phost, pport),
+                self.CONNECT_TIMEOUT)
+            auth = getattr(self.instance, "proxy_auth", None)
+            auth_line = f"Proxy-Authorization: {auth}\r\n" if auth else ""
+            writer.write(
+                f"CONNECT {self.host}:{self.port} HTTP/1.1\r\n"
+                f"Host: {self.host}:{self.port}\r\n"
+                f"{auth_line}"
+                f"Proxy-Connection: Keep-Alive\r\n\r\n".encode())
+            await asyncio.wait_for(writer.drain(), self.IO_TIMEOUT)
+            status_line = await asyncio.wait_for(reader.readline(),
+                                                 self.IO_TIMEOUT)
+            if b" 407" in status_line:
+                # proxy auth misconfiguration will not heal on retry
+                return FlushResult.ERROR
+            if b" 200" not in status_line:
+                return FlushResult.RETRY
+            while True:  # drain CONNECT response headers
+                line = await asyncio.wait_for(reader.readline(),
+                                              self.IO_TIMEOUT)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            ctx = client_context(self.instance) or \
+                _ssl.create_default_context()
+            sni = client_server_hostname(self.instance) or self.host
+            await asyncio.wait_for(
+                writer.start_tls(ctx, server_hostname=sni),
+                self.IO_TIMEOUT)
+            writer.write(wire)
+            await asyncio.wait_for(writer.drain(), self.IO_TIMEOUT)
+            status, _close, _drained = await self._read_response(reader)
+        except (OSError, _ssl.SSLError, IndexError, ValueError,
+                asyncio.TimeoutError, asyncio.IncompleteReadError):
+            return FlushResult.RETRY
+        finally:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+        if 200 <= status < 300:
+            return FlushResult.OK
+        if status >= 500 or status in (408, 429):
+            return FlushResult.RETRY
+        return FlushResult.ERROR
 
     async def _post(self, body: bytes,
                     extra_headers: Optional[List[str]] = None,
@@ -124,17 +202,39 @@ class _HttpDeliveryOutput(OutputPlugin):
                     ok_statuses: tuple = ()) -> FlushResult:
         if self._use_http2():
             return await self._post_h2(body, extra_headers, uri)
+        from ..core.tls import tls_enabled
+        proxied = getattr(self.instance, "proxy", None) is not None
+        if proxied and tls_enabled(self.instance):
+            headers = [
+                f"{verb} {uri or self._uri()} HTTP/1.1",
+                f"Host: {self.host}:{self.port}",
+                f"Content-Length: {len(body)}",
+                f"Content-Type: {self._content_type()}",
+                "Connection: close",
+            ] + self._headers() + (extra_headers or [])
+            wire = ("\r\n".join(headers) + "\r\n\r\n").encode() + body
+            return await self._post_via_connect(wire, ok_statuses)
         pool = self._upstream()
+        # plain target behind a proxy: absolute-form request line +
+        # Proxy-Connection (flb_http_client.c fmt_proxy)
+        target = uri or self._uri()
+        if proxied:
+            target = f"http://{self.host}:{self.port}{target}"
         # per-request headers are passed in, never stashed on the
         # instance: concurrent flushes must not see each other's auth
         headers = [
-            f"{verb} {uri or self._uri()} HTTP/1.1",
+            f"{verb} {target} HTTP/1.1",
             f"Host: {self.host}:{self.port}",
             f"Content-Length: {len(body)}",
             f"Content-Type: {self._content_type()}",
             "Connection: " + ("keep-alive" if pool.keepalive
                               else "close"),
         ] + self._headers() + (extra_headers or [])
+        if proxied:
+            headers.append("Proxy-Connection: Keep-Alive")
+            auth = getattr(self.instance, "proxy_auth", None)
+            if auth:
+                headers.append(f"Proxy-Authorization: {auth}")
         wire = ("\r\n".join(headers) + "\r\n\r\n").encode() + body
         # one transparent redo when a REUSED keepalive connection turns
         # out dead mid-request (the normal keepalive race; reference

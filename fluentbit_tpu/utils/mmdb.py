@@ -1,0 +1,199 @@
+"""MaxMind DB (MMDB) format reader, from scratch.
+
+Reference: filter_geoip2 links libmaxminddb (plugins/filter_geoip2/
+geoip2.c MMDB_open/MMDB_lookup_string/MMDB_aget_value); this module
+implements the MaxMind-DB-spec binary format directly: metadata section
+located by the \\xab\\xcd\\xefMaxMind.com marker, binary search tree
+with 24/28/32-bit records, and the typed data section (pointers,
+strings, doubles, uints, maps, arrays).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import struct
+from typing import Any, List, Optional, Tuple
+
+_METADATA_MARKER = b"\xab\xcd\xefMaxMind.com"
+_DATA_SEPARATOR = 16  # bytes of zeros between tree and data section
+
+
+class MMDBError(ValueError):
+    pass
+
+
+class _Decoder:
+    """Data-section decoder (spec 'Data Section Separator' onward)."""
+
+    def __init__(self, buf: bytes, base: int):
+        self.buf = buf
+        self.base = base  # absolute offset of the data section
+
+    def decode(self, offset: int) -> Tuple[Any, int]:
+        """offset is relative to the data section; → (value, next_off)."""
+        buf = self.buf
+        pos = self.base + offset
+        if pos >= len(buf):
+            raise MMDBError("data offset out of range")
+        ctrl = buf[pos]
+        pos += 1
+        dtype = ctrl >> 5
+        if dtype == 0:  # extended
+            dtype = 7 + buf[pos]
+            pos += 1
+        if dtype == 1:  # pointer
+            ss = (ctrl >> 3) & 0x3
+            vvv = ctrl & 0x7
+            if ss == 0:
+                ptr = (vvv << 8) | buf[pos]
+                pos += 1
+            elif ss == 1:
+                ptr = ((vvv << 16) | (buf[pos] << 8) | buf[pos + 1]) + 2048
+                pos += 2
+            elif ss == 2:
+                ptr = ((vvv << 24) | (buf[pos] << 16) | (buf[pos + 1] << 8)
+                       | buf[pos + 2]) + 526336
+                pos += 3
+            else:
+                ptr = int.from_bytes(buf[pos:pos + 4], "big")
+                pos += 4
+            value, _ = self.decode(ptr)
+            return value, pos - self.base
+        size = ctrl & 0x1F
+        if size == 29:
+            size = 29 + buf[pos]
+            pos += 1
+        elif size == 30:
+            size = 285 + int.from_bytes(buf[pos:pos + 2], "big")
+            pos += 2
+        elif size == 31:
+            size = 65821 + int.from_bytes(buf[pos:pos + 3], "big")
+            pos += 3
+        if dtype == 2:  # utf8 string
+            v = buf[pos:pos + size].decode("utf-8", "replace")
+            return v, pos + size - self.base
+        if dtype == 3:  # double
+            return struct.unpack(">d", buf[pos:pos + 8])[0], \
+                pos + 8 - self.base
+        if dtype == 4:  # bytes
+            return bytes(buf[pos:pos + size]), pos + size - self.base
+        if dtype in (5, 6, 9, 10):  # uint16/32/64/128
+            return int.from_bytes(buf[pos:pos + size], "big"), \
+                pos + size - self.base
+        if dtype == 7:  # map
+            out = {}
+            off = pos - self.base
+            for _ in range(size):
+                k, off = self.decode(off)
+                v, off = self.decode(off)
+                out[k] = v
+            return out, off
+        if dtype == 8:  # int32
+            raw = buf[pos:pos + size]
+            return int.from_bytes(raw, "big", signed=True) if size else 0, \
+                pos + size - self.base
+        if dtype == 11:  # array
+            out_l: List[Any] = []
+            off = pos - self.base
+            for _ in range(size):
+                v, off = self.decode(off)
+                out_l.append(v)
+            return out_l, off
+        if dtype == 14:  # boolean (size IS the value)
+            return bool(size), pos - self.base
+        if dtype == 15:  # float
+            return struct.unpack(">f", buf[pos:pos + 4])[0], \
+                pos + 4 - self.base
+        raise MMDBError(f"unsupported data type {dtype}")
+
+
+class MMDBReader:
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            self.buf = f.read()
+        idx = self.buf.rfind(_METADATA_MARKER)
+        if idx < 0:
+            raise MMDBError("not an MMDB file (metadata marker missing)")
+        meta_dec = _Decoder(self.buf, idx + len(_METADATA_MARKER))
+        self.metadata, _ = meta_dec.decode(0)
+        self.node_count = int(self.metadata["node_count"])
+        self.record_size = int(self.metadata["record_size"])
+        if self.record_size not in (24, 28, 32):
+            raise MMDBError(f"unsupported record size {self.record_size}")
+        self.ip_version = int(self.metadata.get("ip_version", 6))
+        self.node_bytes = self.record_size * 2 // 8
+        self.tree_size = self.node_count * self.node_bytes
+        self.data = _Decoder(self.buf, self.tree_size + _DATA_SEPARATOR)
+
+    # ------------------------------------------------------ tree walk
+
+    def _record(self, node: int, side: int) -> int:
+        base = node * self.node_bytes
+        b = self.buf
+        if self.record_size == 24:
+            off = base + side * 3
+            return int.from_bytes(b[off:off + 3], "big")
+        if self.record_size == 28:
+            if side == 0:
+                return ((b[base + 3] >> 4) << 24) | \
+                    int.from_bytes(b[base:base + 3], "big")
+            return ((b[base + 3] & 0x0F) << 24) | \
+                int.from_bytes(b[base + 4:base + 7], "big")
+        off = base + side * 4
+        return int.from_bytes(b[off:off + 4], "big")
+
+    def lookup(self, ip: str) -> Optional[dict]:
+        try:
+            addr = ipaddress.ip_address(ip.strip())
+        except ValueError:
+            return None
+        if addr.version == 6 and self.ip_version == 4:
+            return None
+        bits = addr.packed
+        nbits = len(bits) * 8
+        node = 0
+        if addr.version == 4 and self.ip_version == 6:
+            # v4 entries live under ::/96 — follow 96 zero bits first.
+            # A data record met on the way covers the v4-mapped range
+            # (e.g. a ::/0 default entry) and must be returned, exactly
+            # as the full-width walk below would
+            for _ in range(96):
+                node = self._record(node, 0)
+                if node == self.node_count:
+                    return None
+                if node > self.node_count:
+                    offset = node - self.node_count - _DATA_SEPARATOR
+                    value, _ = self.data.decode(offset)
+                    return value if isinstance(value, dict) \
+                        else {"value": value}
+        for i in range(nbits):
+            bit = (bits[i >> 3] >> (7 - (i & 7))) & 1
+            node = self._record(node, bit)
+            if node == self.node_count:
+                return None  # no data
+            if node > self.node_count:
+                offset = node - self.node_count - _DATA_SEPARATOR
+                value, _ = self.data.decode(offset)
+                return value if isinstance(value, dict) else {"value": value}
+        return None
+
+    def get_path(self, ip: str, path: List[str]) -> Any:
+        """MMDB_aget_value: walk a dotted path into the looked-up map;
+        integer path components index arrays."""
+        node = self.lookup(ip)
+        if node is None:
+            return None
+        cur: Any = node
+        for part in path:
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            elif isinstance(cur, list):
+                try:
+                    cur = cur[int(part)]
+                except (ValueError, IndexError):
+                    return None
+            else:
+                return None
+            if cur is None:
+                return None
+        return cur

@@ -188,3 +188,32 @@ def test_tda_betti1_two_disjoint_loops():
     out = proc.plugin.process_logs(evs, "t", None)
     assert out[-1].body["betti_0"] == 2
     assert out[-1].body["betti_1"] == 2
+
+
+def test_tda_betti2_hollow_octahedron():
+    """β2 = 1 for the octahedron boundary: 6 points (±1,0,0),(0,±1,0),
+    (0,0,±1) with eps between √2 (adjacent) and 2 (antipodal) — every
+    face triangle exists, no tetrahedron does (each 4-subset contains
+    an antipodal pair), so the complex is a hollow 2-sphere. A solid
+    blob (all points mutually close) collapses β2 to 0."""
+    from fluentbit_tpu.core.plugin import registry as reg
+
+    proc = reg.create_processor("tda")
+    proc.set("fields", "x,y,z")
+    proc.set("window_size", "6")
+    proc.set("epsilon", "1.5")
+    proc.configure()
+    proc.plugin.init(proc, None)
+    pts = [(1, 0, 0), (-1, 0, 0), (0, 1, 0), (0, -1, 0),
+           (0, 0, 1), (0, 0, -1)]
+    events = [ev({"x": float(x), "y": float(y), "z": float(z)})
+              for x, y, z in pts]
+    out = proc.plugin.process_logs(events, "t", None)
+    assert out[-1].body["betti_0"] == 1
+    assert out[-1].body["betti_1"] == 0
+    assert out[-1].body["betti_2"] == 1
+    # collapse: tight cluster (window slides fully onto it) → solid
+    blob = [ev({"x": i * 0.01, "y": 0.0, "z": 0.0}) for i in range(6)]
+    out2 = proc.plugin.process_logs(blob, "t", None)
+    assert out2[-1].body["betti_2"] == 0
+    assert out2[-1].body["betti_1"] == 0

@@ -1,0 +1,272 @@
+"""MMDB reader + filter_geoip2 tests.
+
+The fixture is built by a from-scratch MMDB *writer* implementing the
+spec independently (tree + data section + metadata), so reader bugs
+can't self-confirm. Covers 24/28/32-bit record sizes, pointers, the
+v4-in-v6 ::/96 walk, and the filter's KEY LOOKUP_KEY %{path} contract
+(reference plugins/filter_geoip2/geoip2.c)."""
+
+import ipaddress
+import json
+import struct
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.utils.mmdb import MMDBReader
+
+
+# ------------------------------------------------------- MMDB writer
+
+def _enc_value(v, strings=None):
+    """Encode one data-section value (no pointer emission except via
+    explicit _Ptr)."""
+    if isinstance(v, _Ptr):
+        # 32-bit pointer form: ctrl 001 11 000 + 4 bytes
+        return bytes([0b00111000]) + v.offset.to_bytes(4, "big")
+    if isinstance(v, str):
+        b = v.encode()
+        assert len(b) < 29
+        return bytes([(2 << 5) | len(b)]) + b
+    if isinstance(v, bool):
+        # extended type 14: ctrl size bits carry the value, next byte
+        # is type-7
+        return bytes([1 if v else 0, 14 - 7])
+    if isinstance(v, float):
+        return bytes([(3 << 5) | 8]) + struct.pack(">d", v)
+    if isinstance(v, int):
+        if v < 0:
+            return bytes([(0 << 5) | 4, 1]) + v.to_bytes(4, "big",
+                                                         signed=True)
+        if v < 1 << 16:
+            b = v.to_bytes(2, "big").lstrip(b"\0")
+            return bytes([(5 << 5) | len(b)]) + b
+        b = v.to_bytes(4, "big").lstrip(b"\0")
+        return bytes([(6 << 5) | len(b)]) + b
+    if isinstance(v, dict):
+        out = bytearray([(7 << 5) | len(v)])
+        for k, val in v.items():
+            out += _enc_value(k)
+            out += _enc_value(val)
+        return bytes(out)
+    if isinstance(v, list):
+        # extended type 11: ctrl = size bits, next byte = type-7
+        out = bytearray([(0 << 5) | len(v), 11 - 7])
+        for item in v:
+            out += _enc_value(item)
+        return bytes(out)
+    raise AssertionError(f"unsupported fixture type {type(v)}")
+
+
+class _Ptr:
+    def __init__(self, offset):
+        self.offset = offset
+
+
+def build_mmdb(networks, record_size=28, ip_version=6, use_pointer=False):
+    """networks: [(cidr, data_dict)] → mmdb bytes."""
+    # ---- data section
+    data = bytearray()
+    offsets = []
+    extra = None
+    if use_pointer:
+        # place a shared map first, then point records at it
+        shared = _enc_value({"en": "Shared Name"})
+        shared_off = 0
+        data += shared
+        extra = shared_off
+    for _cidr, d in networks:
+        offsets.append(len(data))
+        if use_pointer:
+            d = dict(d)
+            d["names"] = _Ptr(extra)
+        data += _enc_value(d)
+    # ---- search tree
+    depth = 128 if ip_version == 6 else 32
+    # trie: node = [left, right]; leaf marker = ('data', idx)
+    root = [None, None]
+
+    def insert(cidr, idx):
+        net = ipaddress.ip_network(cidr)
+        bits = net.network_address.packed
+        nbits = net.prefixlen
+        if ip_version == 6 and net.version == 4:
+            bits = b"\0" * 12 + bits
+            nbits += 96
+        node = root
+        for i in range(nbits):
+            bit = (bits[i >> 3] >> (7 - (i & 7))) & 1
+            if i == nbits - 1:
+                node[bit] = ("data", idx)
+                return
+            if not isinstance(node[bit], list):
+                node[bit] = [None, None]
+            node = node[bit]
+
+    for i, (cidr, _d) in enumerate(networks):
+        insert(cidr, i)
+    # flatten breadth-first
+    nodes = []
+
+    def number(node):
+        nodes.append(node)
+        node_id = len(nodes) - 1
+        for side in (0, 1):
+            if isinstance(node[side], list):
+                number(node[side])
+        return node_id
+
+    number(root)
+    ids = {id(n): i for i, n in enumerate(nodes)}
+    node_count = len(nodes)
+
+    def record_value(entry):
+        if entry is None:
+            return node_count  # not found
+        if isinstance(entry, list):
+            return ids[id(entry)]
+        return node_count + 16 + offsets[entry[1]]
+
+    tree = bytearray()
+    for n in nodes:
+        left, right = record_value(n[0]), record_value(n[1])
+        if record_size == 24:
+            tree += left.to_bytes(3, "big") + right.to_bytes(3, "big")
+        elif record_size == 28:
+            tree += left.to_bytes(4, "big")[1:] \
+                + bytes([((left >> 24) << 4) | (right >> 24)]) \
+                + (right & 0xFFFFFF).to_bytes(3, "big")
+        else:
+            tree += left.to_bytes(4, "big") + right.to_bytes(4, "big")
+    meta = _enc_value({
+        "binary_format_major_version": 2,
+        "binary_format_minor_version": 0,
+        "node_count": node_count,
+        "record_size": record_size,
+        "ip_version": ip_version,
+        "database_type": "Test-City",
+    })
+    return bytes(tree) + b"\0" * 16 + bytes(data) \
+        + b"\xab\xcd\xefMaxMind.com" + meta
+
+
+US = {"country": {"iso_code": "US",
+                  "names": {"en": "United States"}},
+      "location": {"latitude": 37.5, "accuracy": 100}}
+DE = {"country": {"iso_code": "DE", "names": {"en": "Germany"}}}
+
+NETS = [("1.2.3.0/24", US), ("5.6.7.8/32", DE)]
+
+
+@pytest.fixture
+def db_path(tmp_path):
+    p = tmp_path / "test.mmdb"
+    p.write_bytes(build_mmdb(NETS))
+    return str(p)
+
+
+# ------------------------------------------------------------ reader
+
+@pytest.mark.parametrize("record_size", [24, 28, 32])
+def test_reader_record_sizes(tmp_path, record_size):
+    p = tmp_path / f"rs{record_size}.mmdb"
+    p.write_bytes(build_mmdb(NETS, record_size=record_size))
+    db = MMDBReader(str(p))
+    assert db.record_size == record_size
+    assert db.lookup("1.2.3.77")["country"]["iso_code"] == "US"
+    assert db.lookup("5.6.7.8")["country"]["iso_code"] == "DE"
+    assert db.lookup("5.6.7.9") is None
+    assert db.lookup("9.9.9.9") is None
+
+
+def test_reader_v4_tree(tmp_path):
+    p = tmp_path / "v4.mmdb"
+    p.write_bytes(build_mmdb(NETS, ip_version=4))
+    db = MMDBReader(str(p))
+    assert db.lookup("1.2.3.4")["location"]["latitude"] == 37.5
+    assert db.lookup("::1") is None  # v6 addr in v4 tree
+
+
+def test_reader_pointers(tmp_path):
+    p = tmp_path / "ptr.mmdb"
+    p.write_bytes(build_mmdb(NETS, use_pointer=True))
+    db = MMDBReader(str(p))
+    assert db.lookup("1.2.3.4")["names"]["en"] == "Shared Name"
+    assert db.lookup("5.6.7.8")["names"]["en"] == "Shared Name"
+
+
+def test_reader_paths(db_path):
+    db = MMDBReader(db_path)
+    assert db.get_path("1.2.3.4", ["country", "iso_code"]) == "US"
+    assert db.get_path("1.2.3.4", ["country", "names", "en"]) \
+        == "United States"
+    assert db.get_path("1.2.3.4", ["location", "accuracy"]) == 100
+    assert db.get_path("1.2.3.4", ["nope", "deep"]) is None
+    assert db.get_path("bogus-ip", ["country"]) is None
+
+
+def test_reader_rejects_garbage(tmp_path):
+    from fluentbit_tpu.utils.mmdb import MMDBError
+    p = tmp_path / "bad.mmdb"
+    p.write_bytes(b"definitely not a database")
+    with pytest.raises(MMDBError):
+        MMDBReader(str(p))
+
+
+# ------------------------------------------------------------ filter
+
+def run_filter(db_path, records, **props):
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("geoip2", match="t", database=db_path, **props)
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for r in records:
+            ctx.push(in_ffd, json.dumps(r))
+        ctx.flush_now()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    return [e.body for d in got for e in decode_events(d)]
+
+
+def test_filter_geoip2_enriches(db_path):
+    bodies = run_filter(
+        db_path,
+        [{"remote": "1.2.3.4", "msg": "hit"},
+         {"remote": "8.8.8.8", "msg": "miss"},
+         {"msg": "no ip"}],
+        lookup_key="remote",
+        record=["country remote %{country.iso_code}",
+                "country_name remote %{country.names.en}",
+                "lat remote %{location.latitude}"],
+    )
+    assert bodies[0]["country"] == "US"
+    assert bodies[0]["country_name"] == "United States"
+    assert bodies[0]["lat"] == 37.5
+    # misses append null (stable output shape, geoip2.c:231-238)
+    assert bodies[1]["country"] is None
+    assert bodies[2]["country"] is None
+
+
+def test_filter_geoip2_map_result_is_null(db_path):
+    bodies = run_filter(
+        db_path, [{"ip": "1.2.3.4"}],
+        lookup_key="ip", record=["c ip %{country}"])
+    assert bodies[0]["c"] is None  # MAP results unsupported → null
+
+
+def test_filter_geoip2_requires_database():
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.filter("geoip2", match="t", lookup_key="ip")
+    ctx.output("null", match="*")
+    with pytest.raises(Exception):
+        ctx.start()
+    ctx.stop()

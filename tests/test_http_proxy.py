@@ -1,0 +1,218 @@
+"""HTTP forward-proxy delivery tests.
+
+Reference: flb_http_client.c proxy_parse + fmt_proxy (absolute-form
+requests with Proxy-Connection for plain http) and the CONNECT tunnel
+form for TLS origins. The proxy stubs here assert the exact wire shape
+a real forward proxy (squid/envoy) would see."""
+
+import asyncio
+import json
+import socket
+import ssl
+import subprocess
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("proxycerts")
+    crt, key = str(d / "srv.crt"), str(d / "srv.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "2",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    return crt, key
+
+
+class PlainProxyStub:
+    """Accepts absolute-form requests, answers 200, records them."""
+
+    def __init__(self):
+        self.requests = []
+        self.port = None
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thr = threading.Thread(target=self._serve, daemon=True)
+        self._thr.start()
+
+    def _serve(self):
+        self._sock.settimeout(0.2)
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            with conn:
+                conn.settimeout(3)
+                data = b""
+                try:
+                    while b"\r\n\r\n" not in data:
+                        data += conn.recv(65536)
+                    head, _, rest = data.partition(b"\r\n\r\n")
+                    clen = 0
+                    for line in head.split(b"\r\n"):
+                        if line.lower().startswith(b"content-length:"):
+                            clen = int(line.split(b":")[1])
+                    while len(rest) < clen:
+                        rest += conn.recv(65536)
+                    self.requests.append((head.decode(), rest))
+                    conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Length: 2\r\n\r\nok")
+                except (socket.timeout, OSError):
+                    pass
+
+    def close(self):
+        self._stop = True
+        self._thr.join(timeout=2)
+        self._sock.close()
+
+
+def wait_for(cond, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError()
+
+
+def test_plain_http_via_proxy():
+    stub = PlainProxyStub()
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    # backend.invalid is never resolved — the proxy is dialed instead
+    ctx.output("http", match="t", host="backend.invalid", port="8080",
+               uri="/ingest", proxy=f"http://127.0.0.1:{stub.port}",
+               format="json")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"m": 1}))
+        ctx.flush_now()
+        wait_for(lambda: stub.requests)
+    finally:
+        ctx.stop()
+        stub.close()
+    head, body = stub.requests[0]
+    lines = head.split("\r\n")
+    # absolute-form request line naming the ORIGIN, not the proxy
+    assert lines[0] == "POST http://backend.invalid:8080/ingest HTTP/1.1"
+    assert "Proxy-Connection: Keep-Alive" in lines
+    assert any(line == "Host: backend.invalid:8080" for line in lines)
+    assert b'"m": 1' in body or b'"m":1' in body
+
+
+def test_connect_tunnel_for_tls(certs):
+    crt, key = certs
+    # TLS origin
+    origin_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    origin_ctx.load_cert_chain(crt, key)
+    origin = socket.socket()
+    origin.bind(("127.0.0.1", 0))
+    origin.listen(2)
+    oport = origin.getsockname()[1]
+    got = {}
+
+    def origin_serve():
+        origin.settimeout(8)
+        try:
+            conn, _ = origin.accept()
+        except socket.timeout:
+            return
+        with origin_ctx.wrap_socket(conn, server_side=True) as tls:
+            tls.settimeout(5)
+            data = b""
+            try:
+                while b"\r\n\r\n" not in data:
+                    data += tls.recv(65536)
+                got["head"] = data.partition(b"\r\n\r\n")[0].decode()
+                tls.sendall(b"HTTP/1.1 200 OK\r\n"
+                            b"Content-Length: 0\r\n\r\n")
+            except (socket.timeout, OSError):
+                pass
+
+    # CONNECT proxy: replies 200 then tunnels bytes to the origin
+    proxy = socket.socket()
+    proxy.bind(("127.0.0.1", 0))
+    proxy.listen(2)
+    pport = proxy.getsockname()[1]
+    connect_line = {}
+
+    def proxy_serve():
+        proxy.settimeout(8)
+        try:
+            conn, _ = proxy.accept()
+        except socket.timeout:
+            return
+        conn.settimeout(5)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            data += conn.recv(65536)
+        connect_line["line"] = data.split(b"\r\n")[0].decode()
+        upstream = socket.create_connection(("127.0.0.1", oport))
+        conn.sendall(b"HTTP/1.1 200 Connection established\r\n\r\n")
+
+        def pump(a, b):
+            try:
+                while True:
+                    chunk = a.recv(65536)
+                    if not chunk:
+                        break
+                    b.sendall(chunk)
+            except OSError:
+                pass
+            finally:
+                try:
+                    b.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t1 = threading.Thread(target=pump, args=(conn, upstream),
+                              daemon=True)
+        t2 = threading.Thread(target=pump, args=(upstream, conn),
+                              daemon=True)
+        t1.start()
+        t2.start()
+        t1.join(timeout=8)
+        t2.join(timeout=8)
+
+    to = threading.Thread(target=origin_serve, daemon=True)
+    tp = threading.Thread(target=proxy_serve, daemon=True)
+    to.start()
+    tp.start()
+
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.output("http", match="t", host="localhost", port=str(oport),
+               uri="/tls-ingest", proxy=f"http://127.0.0.1:{pport}",
+               tls="on", **{"tls.verify": "off"}, format="json")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"secure": True}))
+        ctx.flush_now()
+        wait_for(lambda: "head" in got)
+    finally:
+        ctx.stop()
+        proxy.close()
+        origin.close()
+    assert connect_line["line"] == f"CONNECT localhost:{oport} HTTP/1.1"
+    # origin sees a normal origin-form request THROUGH the tunnel
+    assert got["head"].split("\r\n")[0] == "POST /tls-ingest HTTP/1.1"
+
+
+def test_proxy_rejects_https_scheme():
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.output("http", match="t", host="h", proxy="https://secure-proxy:3128")
+    with pytest.raises(Exception):
+        ctx.start()
+    ctx.stop()
