@@ -147,13 +147,13 @@ def _load():
             ctypes.c_longlong, ctypes.POINTER(ctypes.c_uint8),
         ]
         try:
-            grep_fn = lib.fbtpu_grep_match
+            grep_fn = lib.fbtpu_grep_match_v2
         except AttributeError:
             # prebuilt .so from an older source (hash-less trust path):
             # the scanner entry points still work; grep_match() reports
             # unavailable and callers use their staged/Python paths
             grep_fn = None
-            log.warning("fbtpu_grep_match absent in %s (stale prebuilt?)",
+            log.warning("fbtpu_grep_match_v2 absent in %s (stale prebuilt?)",
                         _SO)
         if grep_fn is not None:
             grep_fn.restype = ctypes.c_longlong
@@ -168,7 +168,7 @@ def _grep_match_argtypes():
             ctypes.c_char_p,                             # keys_cat
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong,
-            ctypes.POINTER(ctypes.c_int32),              # trans_cat
+            ctypes.POINTER(ctypes.c_int16),              # trans_cat (i16)
             ctypes.POINTER(ctypes.c_longlong),           # troffs
             ctypes.POINTER(ctypes.c_int32),              # cmaps
             ctypes.POINTER(ctypes.c_int32),              # starts
@@ -259,12 +259,16 @@ class GrepTables:
             # pre-compose to k-byte super-steps (cuts the dependent-load
             # chain k-fold) while [S, C^k] stays cache-friendly; the
             # packed class count encodes C + 1000*(k-1) for the C side
+            if S >= 32768:  # int16 table states (never in practice)
+                raise ValueError(f"DFA too large for native tables ({S})")
+            budget = int(os.environ.get("FBTPU_KTABLE_BUDGET",
+                                        str(2 * 1024 * 1024)))
             k = 1
-            while k < 4 and S * (C ** (k + 1)) * 4 <= 2 * 1024 * 1024:
+            while k < 4 and S * (C ** (k + 1)) * 2 <= budget:
                 k += 1
             tk = compose_supersteps(t, k)
             trans_parts.append(np.ascontiguousarray(
-                tk, dtype=np.int32).reshape(-1))
+                tk, dtype=np.int16).reshape(-1))
             troffs.append(troffs[-1] + tk.size)
             ncls.append(C + 1000 * (k - 1))
             cmaps.append(np.ascontiguousarray(
@@ -290,7 +294,7 @@ def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
     (mask[R, n] bool, offsets[n+1] i64, n) or None (native unavailable /
     malformed buffer)."""
     lib = _load()
-    if lib is None or getattr(lib, "fbtpu_grep_match", None) is None:
+    if lib is None or getattr(lib, "fbtpu_grep_match_v2", None) is None:
         return None
     est = n_hint if n_hint is not None else count_records(buf)
     if est is None:
@@ -301,13 +305,13 @@ def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
     offsets = np.empty(cap + 1, dtype=np.int64)
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_longlong)
-    n = getattr(lib, "fbtpu_grep_match")(
+    n = getattr(lib, "fbtpu_grep_match_v2")(
         buf, len(buf),
         tables.keys_cat,
         tables.key_offs.ctypes.data_as(i64p),
         len(tables.key_offs) - 1,
         tables.key_of_rule.ctypes.data_as(i32p), R,
-        tables.trans_cat.ctypes.data_as(i32p),
+        tables.trans_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
         tables.troffs.ctypes.data_as(i64p),
         tables.cmaps.ctypes.data_as(i32p),
         tables.starts.ctypes.data_as(i32p),
@@ -318,7 +322,9 @@ def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
     )
     if n < 0:
         return None
-    return match[:, :n].astype(bool), offsets[: n + 1], int(n)
+    # u8 0/1 → bool is a reinterpret, not a copy (match is freshly
+    # allocated per call, so the view escapes safely)
+    return match[:, :n].view(bool), offsets[: n + 1], int(n)
 
 
 def stage_field(

@@ -30,7 +30,7 @@
 // shorter than the block's max length just spin on EOL — branch-free.
 #define FBTPU_DFA_LANES 8
 
-static void dfa_run_block(const int32_t *trans, const int32_t *cmap,
+static void dfa_run_block(const int16_t *trans, const int32_t *cmap,
                           int32_t C, int32_t start,
                           const uint8_t *const *vals,
                           const uint32_t *lens, int nrows,
@@ -75,7 +75,7 @@ static void dfa_run_block(const int32_t *trans, const int32_t *cmap,
 // host-side (GrepTables packs them while S*C^k fits the budget) cut the
 // dependent-load chain k-fold — k bytes per step, EOL^k absorbing.
 template <int K>
-static void dfa_run_block_k(const int32_t *transk, const int32_t *cmap,
+static void dfa_run_block_k(const int16_t *transk, const int32_t *cmap,
                             int32_t C, int32_t start,
                             const uint8_t *const *vals,
                             const uint32_t *lens, int nrows,
@@ -426,11 +426,11 @@ long long fbtpu_stage_field(const uint8_t *buf, long long buflen,
 
 #define FBTPU_MAX_KEYS 64
 
-long long fbtpu_grep_match(const uint8_t *buf, long long buflen,
+long long fbtpu_grep_match_v2(const uint8_t *buf, long long buflen,
                            const uint8_t *keys_cat,
                            const long long *key_offs, long long n_keys,
                            const int32_t *key_of_rule, long long n_rules,
-                           const int32_t *trans_cat,
+                           const int16_t *trans_cat,
                            const long long *troffs,
                            const int32_t *cmaps, const int32_t *starts,
                            const int32_t *ncls,
@@ -455,6 +455,7 @@ long long fbtpu_grep_match(const uint8_t *buf, long long buflen,
         for (long long kx = 0; kx < n_keys; kx++)
             vals[kx * max_records + rec] = nullptr;
         uint32_t outer;
+        const uint8_t *rec_end = nullptr;
         const uint8_t *q = read_array_hdr(p, end, &outer);
         if (q && outer >= 2) {
             const uint8_t *body = skip_obj(q, end, 0);
@@ -501,10 +502,15 @@ long long fbtpu_grep_match(const uint8_t *buf, long long buflen,
                         }
                         kv = skip_obj(val, end, 0);
                     }
+                    // the pair walk ended exactly at the map's end: for
+                    // the common [[ts, meta], body] shape that IS the
+                    // record end — reuse it instead of re-walking the
+                    // whole record with skip_obj
+                    if (kv && outer == 2) rec_end = kv;
                 }
             }
         }
-        p = skip_obj(rec_start, end, 0);
+        p = rec_end ? rec_end : skip_obj(rec_start, end, 0);
         if (!p) {
             delete[] vals;
             delete[] vlens;
@@ -517,7 +523,7 @@ long long fbtpu_grep_match(const uint8_t *buf, long long buflen,
     // large batches fan out across host threads (the ctypes caller has
     // already released the GIL). FBTPU_DFA_THREADS caps the fan-out.
     auto sweep = [&](long long r, long long lo, long long hi) {
-        const int32_t *trans = trans_cat + troffs[r];
+        const int16_t *trans = trans_cat + troffs[r];
         const int32_t *cmap = cmaps + r * 257;
         const uint8_t *const *kv = vals + key_of_rule[r] * max_records;
         const uint32_t *kl = vlens + key_of_rule[r] * max_records;
