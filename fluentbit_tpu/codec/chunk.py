@@ -93,14 +93,17 @@ class Chunk:
             self.locked = True
 
     def get_bytes(self) -> bytes:
-        parts = list(self._parts)  # snapshot copy: appends may race on
-        # the threaded raw-ingest path (reader holds a different lock)
+        # appends may race on the threaded raw-ingest path (reader
+        # holds a different lock). Reading `locked` BEFORE the parts
+        # snapshot makes the cache safe: append() publishes its part
+        # before setting locked, so locked-at-entry implies the
+        # snapshot is complete and final.
+        locked_first = self.locked
+        parts = list(self._parts)
         if len(parts) == 1:
             return parts[0]
         joined = b"".join(parts)
-        if self.locked:
-            # no further appends can land on a locked chunk — caching
-            # the join is safe only then
+        if locked_first:
             self._parts = [joined]
         return joined
 

@@ -22,6 +22,7 @@ from . import filters_basic  # noqa: F401
 from . import filters_extra  # noqa: F401
 from . import filter_script  # noqa: F401
 from . import filter_lua  # noqa: F401
+from . import filter_wasm  # noqa: F401
 from . import processors  # noqa: F401
 from . import telemetry_extra  # noqa: F401
 from . import outputs_aws  # noqa: F401

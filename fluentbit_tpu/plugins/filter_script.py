@@ -103,19 +103,6 @@ class ScriptFilter(FilterPlugin):
         return (FilterResult.MODIFIED, out)
 
 
-class _GatedFilter(FilterPlugin):
-    runtime = ""
-
-    def init(self, instance, engine) -> None:
-        raise RuntimeError(
-            f"filter_{self.name}: the {self.runtime} runtime is not "
-            f"vendored in this build — the 'script' filter provides the "
-            f"same cb_filter contract in Python"
-        )
-
-
-@registry.register
-class WasmFilter(_GatedFilter):
-    name = "wasm"
-    description = "gated: WAMR runtime not vendored (use 'script')"
-    runtime = "WAMR"
+# filter_lua (plugins/filter_lua.py, luart runtime) and filter_wasm
+# (plugins/filter_wasm.py, wasmrt interpreter) are real — no gates left
+# in the extension-runtime family except exec_wasi's WASI surface.

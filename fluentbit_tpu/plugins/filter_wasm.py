@@ -1,0 +1,126 @@
+"""filter_wasm on the from-scratch WASM interpreter (wasmrt).
+
+Reference: plugins/filter_wasm/filter_wasm.c + src/wasm/flb_wasm.c
+(WAMR embed). Per record (JSON event format, filter_wasm.c:131-183):
+the body is JSON-encoded, tag + record are copied into guest linear
+memory (wasm_runtime_module_dup_data), and
+
+    function_name(tag_ptr, tag_len, sec, nsec, rec_ptr, rec_len) -> i32
+
+returns a guest pointer to a NUL-terminated JSON string that REPLACES
+the record body (original timestamp kept). NULL (0) or an empty string
+skips (drops) the record; invalid returned JSON leaves the whole chunk
+untouched (the reference's on_error path). Modules must be
+self-contained — WASI imports are rejected at load (in_exec_wasi stays
+gated for the same reason).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import List
+
+from ..codec.events import LogEvent
+from ..core.config import ConfigMapEntry
+from ..core.plugin import FilterPlugin, FilterResult, registry
+from ..wasmrt import Module, Trap, WasmError
+
+log = logging.getLogger("flb.wasm")
+
+
+@registry.register
+class WasmFilter(FilterPlugin):
+    name = "wasm"
+    description = "WASM filter (from-scratch MVP interpreter)"
+    config_map = [
+        ConfigMapEntry("wasm_path", "str"),
+        ConfigMapEntry("function_name", "str"),
+        ConfigMapEntry("event_format", "str", default="json"),
+        ConfigMapEntry("accessible_paths", "clist"),  # accepted; no WASI
+        ConfigMapEntry("wasm_heap_size", "size", default="8192k"),
+        ConfigMapEntry("wasm_stack_size", "size", default="8192k"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.wasm_path:
+            raise ValueError("wasm filter requires 'wasm_path'")
+        if not self.function_name:
+            raise ValueError("wasm filter requires 'function_name'")
+        if (self.event_format or "json").lower() != "json":
+            raise ValueError(
+                "wasm filter: only event_format json is supported")
+        with open(self.wasm_path, "rb") as f:
+            self._binary = f.read()
+        try:
+            self._module = Module(self._binary)
+        except (WasmError, Trap) as e:
+            raise ValueError(f"wasm filter: cannot load "
+                             f"{self.wasm_path}: {e}")
+        exp = self._module.exports.get(self.function_name)
+        if exp is None or exp[0] != "func":
+            raise ValueError(
+                f"wasm filter: function {self.function_name!r} not "
+                f"exported by {self.wasm_path}")
+
+    def filter(self, events: list, tag: str, engine) -> tuple:
+        mod = self._module
+        out: List[LogEvent] = []
+        modified = False
+        tag_b = tag.encode("utf-8")
+        for ev in events:
+            if ev.is_group_start() or ev.is_group_end():
+                out.append(ev)
+                continue
+            rec_json = json.dumps(ev.body, separators=(",", ":"),
+                                  default=str).encode("utf-8")
+            ts = ev.ts_float
+            sec = int(ts)
+            nsec = int((ts - sec) * 1e9)
+            mod.reset_heap()
+            tag_ptr = mod.dup_data(tag_b)
+            rec_ptr = mod.dup_data(rec_json)
+            try:
+                rets = mod.call(self.function_name,
+                                [tag_ptr, len(tag_b), sec, nsec,
+                                 rec_ptr, len(rec_json)])
+                ptr = rets[0] if rets else 0
+                if not ptr:
+                    modified = True  # NULL → skip record
+                    continue
+                ret_str = mod.read_cstr(ptr)
+            except (Trap, WasmError) as e:
+                log.error("wasm function %r trapped: %s",
+                          self.function_name, e)
+                out.append(ev)  # exception → record kept
+                # a trap can abandon guest state mid-mutation (shadow
+                # stack pointer, heap metadata); reinstantiate from the
+                # cached binary so one hostile record can't poison
+                # every later call
+                try:
+                    self._module = mod = Module(self._binary)
+                except (WasmError, Trap):
+                    log.exception("wasm reinstantiate failed")
+                continue
+            if not ret_str:
+                modified = True  # empty string → skip record
+                continue
+            try:
+                new_body = json.loads(ret_str.decode("utf-8"))
+                if not isinstance(new_body, dict):
+                    raise ValueError("not a JSON object")
+            except (ValueError, UnicodeDecodeError):
+                # reference on_error: broken returned JSON leaves the
+                # whole chunk untouched
+                log.error("wasm function %r returned invalid JSON",
+                          self.function_name)
+                return (FilterResult.NOTOUCH, events)
+            if new_body == ev.body:
+                out.append(ev)
+                continue
+            out.append(LogEvent(ev.timestamp, new_body, ev.metadata,
+                                raw=None))
+            modified = True
+        if not modified:
+            return (FilterResult.NOTOUCH, events)
+        return (FilterResult.MODIFIED, out)

@@ -37,7 +37,8 @@ def _gate(kind, plugin_name: str, runtime: str, hint: str = ""):
 
 _gate(InputPlugin, "kafka", "librdkafka")
 _gate(OutputPlugin, "kafka", "librdkafka")
-_gate(InputPlugin, "exec_wasi", "WAMR",
+_gate(InputPlugin, "exec_wasi", "WASI (filesystem/clock imports; the "
+      "wasmrt interpreter runs only self-contained modules)",
       "the 'exec' input runs native commands")
 _gate(FilterPlugin, "tensorflow", "TensorFlow Lite")
 _gate(FilterPlugin, "nightfall", "the Nightfall DLP API (network)")

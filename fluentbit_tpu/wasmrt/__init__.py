@@ -1,0 +1,795 @@
+"""From-scratch WebAssembly MVP interpreter for filter_wasm.
+
+Reference embeds WAMR (lib/wasm-micro-runtime-WAMR-2.4.1 via
+src/wasm/flb_wasm.c); this package decodes and interprets the wasm MVP
+binary format directly: all sections, structured control flow
+(block/loop/if with label-indexed branches), the full i32/i64 numeric
+set plus the common f32/f64 ops, linear memory with all load/store
+widths, globals, and call/call_indirect. The host surface mirrors
+flb_wasm.c: ``dup_data`` copies host bytes into guest memory (the
+wasm_runtime_module_dup_data role, flb_wasm.c:269-270) and
+``call(name, args)`` invokes an exported function
+(wasm_runtime_call_wasm).
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+PAGE = 65536
+
+I32, I64, F32, F64 = 0x7F, 0x7E, 0x7D, 0x7C
+
+
+class WasmError(ValueError):
+    pass
+
+
+class Trap(RuntimeError):
+    """wasm trap (unreachable, div by zero, OOB access...)."""
+
+
+# ------------------------------------------------------------- reader
+
+
+class _Reader:
+    __slots__ = ("b", "pos")
+
+    def __init__(self, b: bytes, pos: int = 0):
+        self.b = b
+        self.pos = pos
+
+    def u8(self) -> int:
+        v = self.b[self.pos]
+        self.pos += 1
+        return v
+
+    def u32(self) -> int:  # LEB128 unsigned
+        result = 0
+        shift = 0
+        while True:
+            byte = self.u8()
+            result |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                return result
+            shift += 7
+            if shift > 35:
+                raise WasmError("u32 LEB overflow")
+
+    def s32(self) -> int:
+        return self._sleb(32)
+
+    def s64(self) -> int:
+        return self._sleb(64)
+
+    def _sleb(self, bits: int) -> int:
+        result = 0
+        shift = 0
+        while True:
+            byte = self.u8()
+            result |= (byte & 0x7F) << shift
+            shift += 7
+            if not byte & 0x80:
+                if shift < bits and byte & 0x40:
+                    result |= -(1 << shift)
+                return result
+            if shift > bits + 7:
+                raise WasmError("sleb overflow")
+
+    def f32(self) -> float:
+        v = struct.unpack_from("<f", self.b, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def f64(self) -> float:
+        v = struct.unpack_from("<d", self.b, self.pos)[0]
+        self.pos += 8
+        return v
+
+    def bytes_(self, n: int) -> bytes:
+        v = self.b[self.pos:self.pos + n]
+        if len(v) != n:
+            raise WasmError("truncated")
+        self.pos += n
+        return v
+
+    def name(self) -> str:
+        return self.bytes_(self.u32()).decode("utf-8")
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.b)
+
+
+# ----------------------------------------------------------- decoding
+# Function bodies decode into a nested structured form:
+#   instr = (opcode, *immediates)  |  block structures:
+#   (0x02, blocktype, body)            block
+#   (0x03, blocktype, body)            loop
+#   (0x04, blocktype, then, else)      if
+
+
+def _decode_expr(r: _Reader, terminators=(0x0B,)) -> Tuple[list, int]:
+    body: list = []
+    while True:
+        op = r.u8()
+        if op in terminators:
+            return body, op
+        if op in (0x02, 0x03):  # block / loop
+            bt = r.s32()
+            inner, _ = _decode_expr(r)
+            body.append((op, bt, inner))
+        elif op == 0x04:  # if
+            bt = r.s32()
+            then, term = _decode_expr(r, (0x0B, 0x05))
+            els: list = []
+            if term == 0x05:
+                els, _ = _decode_expr(r)
+            body.append((op, bt, then, els))
+        elif op in (0x0C, 0x0D):  # br / br_if
+            body.append((op, r.u32()))
+        elif op == 0x0E:  # br_table
+            n = r.u32()
+            targets = [r.u32() for _ in range(n)]
+            default = r.u32()
+            body.append((op, targets, default))
+        elif op == 0x10:  # call
+            body.append((op, r.u32()))
+        elif op == 0x11:  # call_indirect
+            body.append((op, r.u32(), r.u32()))
+        elif op in (0x20, 0x21, 0x22, 0x23, 0x24):  # local/global access
+            body.append((op, r.u32()))
+        elif 0x28 <= op <= 0x3E:  # memory load/store: align + offset
+            r.u32()
+            body.append((op, r.u32()))
+        elif op in (0x3F, 0x40):  # memory.size / grow
+            r.u8()
+            body.append((op,))
+        elif op == 0x41:
+            body.append((op, r.s32() & 0xFFFFFFFF))
+        elif op == 0x42:
+            body.append((op, r.s64() & 0xFFFFFFFFFFFFFFFF))
+        elif op == 0x43:
+            body.append((op, r.f32()))
+        elif op == 0x44:
+            body.append((op, r.f64()))
+        else:
+            body.append((op,))
+
+
+class _Func:
+    __slots__ = ("type_idx", "params", "results", "locals", "body",
+                 "name")
+
+    def __init__(self, type_idx, params, results, locals_, body):
+        self.type_idx = type_idx
+        self.params = params
+        self.results = results
+        self.locals = locals_
+        self.body = body
+        self.name = ""
+
+
+class Module:
+    """One instantiated module: memory, globals, exported functions."""
+
+    def __init__(self, binary: bytes):
+        r = _Reader(binary)
+        if r.bytes_(4) != b"\0asm":
+            raise WasmError("bad magic")
+        if struct.unpack("<I", r.bytes_(4))[0] != 1:
+            raise WasmError("unsupported wasm version")
+        self.types: List[Tuple[list, list]] = []
+        self.funcs: List[_Func] = []
+        self.exports: Dict[str, Tuple[str, int]] = {}
+        self.memory = bytearray()
+        self.mem_max_pages = 1 << 16
+        self.globals: List[list] = []  # [type, mutable, value]
+        self.table: List[Optional[int]] = []
+        self.start: Optional[int] = None
+        func_types: List[int] = []
+        code_bodies: List[bytes] = []
+        data_segs: List[Tuple[int, bytes]] = []
+        elem_segs: List[Tuple[int, List[int]]] = []
+        while not r.eof():
+            sec_id = r.u8()
+            size = r.u32()
+            sec = _Reader(r.bytes_(size))
+            if sec_id == 1:  # types
+                for _ in range(sec.u32()):
+                    if sec.u8() != 0x60:
+                        raise WasmError("bad functype")
+                    params = [sec.u8() for _ in range(sec.u32())]
+                    results = [sec.u8() for _ in range(sec.u32())]
+                    self.types.append((params, results))
+            elif sec_id == 2:  # imports
+                for _ in range(sec.u32()):
+                    mod = sec.name()
+                    field = sec.name()
+                    kind = sec.u8()
+                    raise WasmError(
+                        f"imports unsupported ({mod}.{field} kind "
+                        f"{kind}) — filter modules must be "
+                        "self-contained (no WASI)")
+            elif sec_id == 3:  # function decls
+                func_types = [sec.u32() for _ in range(sec.u32())]
+            elif sec_id == 4:  # table
+                for _ in range(sec.u32()):
+                    if sec.u8() != 0x70:
+                        raise WasmError("bad table elemtype")
+                    flags = sec.u8()
+                    n = sec.u32()
+                    if flags & 1:
+                        sec.u32()
+                    self.table = [None] * n
+            elif sec_id == 5:  # memory
+                for _ in range(sec.u32()):
+                    flags = sec.u8()
+                    n_min = sec.u32()
+                    if flags & 1:
+                        self.mem_max_pages = sec.u32()
+                    self.memory = bytearray(n_min * PAGE)
+            elif sec_id == 6:  # globals
+                for _ in range(sec.u32()):
+                    vt = sec.u8()
+                    mut = sec.u8()
+                    val = self._eval_const(sec)
+                    self.globals.append([vt, mut, val])
+            elif sec_id == 7:  # exports
+                for _ in range(sec.u32()):
+                    name = sec.name()
+                    kind = sec.u8()
+                    idx = sec.u32()
+                    kinds = {0: "func", 1: "table", 2: "mem", 3: "global"}
+                    self.exports[name] = (kinds.get(kind, "?"), idx)
+            elif sec_id == 8:
+                self.start = sec.u32()
+            elif sec_id == 9:  # elements
+                for _ in range(sec.u32()):
+                    if sec.u32() != 0:
+                        raise WasmError("unsupported element segment")
+                    off = self._eval_const(sec)
+                    elem_segs.append(
+                        (off, [sec.u32() for _ in range(sec.u32())]))
+            elif sec_id == 10:  # code
+                for _ in range(sec.u32()):
+                    code_bodies.append(sec.bytes_(sec.u32()))
+            elif sec_id == 11:  # data
+                for _ in range(sec.u32()):
+                    if sec.u32() != 0:
+                        raise WasmError("unsupported data segment")
+                    off = self._eval_const(sec)
+                    data_segs.append((off, sec.bytes_(sec.u32())))
+            # else: custom/unknown sections skipped
+        if len(func_types) != len(code_bodies):
+            raise WasmError("func/code section mismatch")
+        for ti, raw in zip(func_types, code_bodies):
+            br = _Reader(raw)
+            locals_: List[int] = []
+            for _ in range(br.u32()):
+                n = br.u32()
+                vt = br.u8()
+                locals_.extend([vt] * n)
+            body, _ = _decode_expr(br)
+            params, results = self.types[ti]
+            self.funcs.append(_Func(ti, params, results, locals_, body))
+        for off, data in data_segs:
+            if off + len(data) > len(self.memory):
+                raise WasmError("data segment out of range")
+            self.memory[off:off + len(data)] = data
+        for off, idxs in elem_segs:
+            if off + len(idxs) > len(self.table):
+                self.table.extend(
+                    [None] * (off + len(idxs) - len(self.table)))
+            for i, fi in enumerate(idxs):
+                self.table[off + i] = fi
+        # dup_data backing (the wasm_runtime_module_dup_data role):
+        # when the module exports its own malloc/free, allocations go
+        # through it — that is WAMR's behavior and the only way host
+        # buffers can coexist with a guest allocator that owns
+        # [__heap_base, memory.size). Allocator-less modules (no
+        # exported malloc) get a host bump heap in pages above the
+        # initial memory; such modules must not malloc (they can't)
+        # so the regions cannot collide.
+        self._bump_base = len(self.memory)
+        self._bump = self._bump_base
+        self._mallocs: List[int] = []
+        self._guest_alloc = (
+            "malloc" in self.exports
+            and self.exports["malloc"][0] == "func"
+        )
+        self._guest_free = (
+            "free" in self.exports and self.exports["free"][0] == "func"
+        )
+        if self.start is not None:
+            self._invoke(self.start, [])
+
+    # ------------------------------------------------------- host API
+
+    def dup_data(self, data: bytes) -> int:
+        """Copy host bytes (+NUL) into guest memory → guest pointer
+        (wasm_runtime_module_dup_data)."""
+        need = len(data) + 1
+        if self._guest_alloc:
+            rets = self.call("malloc", [need])
+            ptr = rets[0] if rets else 0
+            if not ptr or ptr + need > len(self.memory):
+                raise Trap("guest malloc failed for dup_data")
+            self._mallocs.append(ptr)
+        else:
+            if self._bump + need > len(self.memory):
+                pages = (self._bump + need - len(self.memory)
+                         + PAGE - 1) // PAGE
+                self.memory.extend(bytes(pages * PAGE))
+            ptr = self._bump
+            self._bump += need
+        self.memory[ptr:ptr + len(data)] = data
+        self.memory[ptr + len(data)] = 0
+        return ptr
+
+    def reset_heap(self) -> None:
+        """Release every dup_data allocation (between calls)."""
+        if self._guest_alloc and self._guest_free:
+            for ptr in self._mallocs:
+                try:
+                    self.call("free", [ptr])
+                except Trap:
+                    pass
+        self._mallocs.clear()
+        self._bump = self._bump_base
+
+    def read_cstr(self, ptr: int, max_len: int = 1 << 20) -> bytes:
+        """NUL-terminated guest string at ptr (the filter return
+        value)."""
+        if ptr <= 0 or ptr >= len(self.memory):
+            raise Trap("returned pointer out of range")
+        end = self.memory.find(b"\0", ptr, ptr + max_len)
+        if end < 0:
+            raise Trap("unterminated returned string")
+        return bytes(self.memory[ptr:end])
+
+    def call(self, name: str, args: List[Any]) -> List[Any]:
+        exp = self.exports.get(name)
+        if exp is None or exp[0] != "func":
+            raise WasmError(f"exported function {name!r} not found")
+        return self._invoke(exp[1], list(args))
+
+    # ----------------------------------------------------- execution
+
+    def _eval_const(self, r: _Reader):
+        body, _ = _decode_expr(r)
+        if len(body) != 1:
+            raise WasmError("unsupported const expr")
+        op = body[0]
+        if op[0] in (0x41, 0x42, 0x43, 0x44):
+            return op[1]
+        if op[0] == 0x23:
+            return self.globals[op[1]][2]
+        raise WasmError("unsupported const expr op")
+
+    def _invoke(self, fidx: int, args: List[Any], depth: int = 0):
+        if depth > 256:
+            raise Trap("call stack exhausted")
+        f = self.funcs[fidx]
+        locals_ = list(args)
+        for vt in f.locals:
+            locals_.append(0.0 if vt in (F32, F64) else 0)
+        stack: List[Any] = []
+        try:
+            self._exec_block(f.body, locals_, stack, depth)
+        except _Branch as b:
+            # depth 0 here = a br targeting the function frame itself
+            # (valid wasm, same as return); -1 = the return opcode
+            if b.depth > 0:
+                raise Trap("branch escaped function")
+        if f.results:
+            return stack[-len(f.results):]
+        return []
+
+    def _exec_block(self, body: list, locals_: List[Any],
+                    stack: List[Any], depth: int) -> None:
+        for ins in body:
+            op = ins[0]
+            if op == 0x02:  # block: branches target the END
+                h = len(stack)
+                try:
+                    self._exec_block(ins[2], locals_, stack, depth)
+                except _Branch as b:
+                    if b.depth == 0:
+                        # void blocktype decodes as SLEB -64 (0x40)
+                        arity = 0 if ins[1] == -64 else 1
+                        vals = stack[len(stack) - arity:] if arity else []
+                        del stack[h:]
+                        stack.extend(vals)
+                    else:
+                        if b.depth > 0:
+                            b.depth -= 1
+                        raise  # negative depth = function return
+            elif op == 0x03:  # loop: branches target the START
+                h = len(stack)
+                while True:
+                    try:
+                        self._exec_block(ins[2], locals_, stack, depth)
+                        break
+                    except _Branch as b:
+                        if b.depth == 0:
+                            del stack[h:]  # loop params: MVP arity 0
+                            continue
+                        if b.depth > 0:
+                            b.depth -= 1
+                        raise
+            elif op == 0x04:  # if
+                cond = stack.pop()
+                h = len(stack)
+                try:
+                    self._exec_block(ins[2] if cond else ins[3],
+                                     locals_, stack, depth)
+                except _Branch as b:
+                    if b.depth == 0:
+                        arity = 0 if ins[1] == -64 else 1
+                        vals = stack[len(stack) - arity:] if arity else []
+                        del stack[h:]
+                        stack.extend(vals)
+                    else:
+                        if b.depth > 0:
+                            b.depth -= 1
+                        raise
+            elif op == 0x0C:  # br
+                raise _Branch(ins[1])
+            elif op == 0x0D:  # br_if
+                if stack.pop():
+                    raise _Branch(ins[1])
+            elif op == 0x0E:  # br_table
+                i = stack.pop()
+                targets, default = ins[1], ins[2]
+                raise _Branch(targets[i] if 0 <= i < len(targets)
+                              else default)
+            elif op == 0x0F:  # return
+                raise _Branch(-1)
+            elif op == 0x10:  # call
+                self._do_call(ins[1], stack, depth)
+            elif op == 0x11:  # call_indirect
+                ti = ins[1]
+                elem = stack.pop()
+                if elem < 0 or elem >= len(self.table) \
+                        or self.table[elem] is None:
+                    raise Trap("undefined table element")
+                fi = self.table[elem]
+                if self.funcs[fi].type_idx != ti:
+                    raise Trap("indirect call type mismatch")
+                self._do_call(fi, stack, depth)
+            elif op == 0x00:
+                raise Trap("unreachable")
+            elif op == 0x01:  # nop
+                pass
+            elif op == 0x1A:  # drop
+                stack.pop()
+            elif op == 0x1B:  # select
+                c = stack.pop()
+                b = stack.pop()
+                a = stack.pop()
+                stack.append(a if c else b)
+            elif op == 0x20:
+                stack.append(locals_[ins[1]])
+            elif op == 0x21:
+                locals_[ins[1]] = stack.pop()
+            elif op == 0x22:
+                locals_[ins[1]] = stack[-1]
+            elif op == 0x23:
+                stack.append(self.globals[ins[1]][2])
+            elif op == 0x24:
+                self.globals[ins[1]][2] = stack.pop()
+            elif 0x28 <= op <= 0x35:
+                self._load(op, ins[1], stack)
+            elif 0x36 <= op <= 0x3E:
+                self._store(op, ins[1], stack)
+            elif op == 0x3F:
+                stack.append(len(self.memory) // PAGE)
+            elif op == 0x40:  # memory.grow
+                n = stack.pop()
+                old = len(self.memory) // PAGE
+                if old + n > self.mem_max_pages:
+                    stack.append(0xFFFFFFFF)
+                else:
+                    self.memory.extend(bytes(n * PAGE))
+                    # host bump allocations (allocator-less modules
+                    # only) stay valid: guest growth extends past them
+                    # and the bump base relocates on the next reset
+                    if not self._guest_alloc:
+                        self._bump_base = max(self._bump_base,
+                                              len(self.memory))
+                        self._bump = max(self._bump, self._bump_base)
+                    stack.append(old)
+            elif op in (0x41, 0x42, 0x43, 0x44):
+                stack.append(ins[1])
+            else:
+                self._numeric(op, stack)
+
+    def _do_call(self, fidx: int, stack: List[Any], depth: int) -> None:
+        f = self.funcs[fidx]
+        n = len(f.params)
+        args = stack[len(stack) - n:] if n else []
+        if n:
+            del stack[len(stack) - n:]
+        stack.extend(self._invoke(fidx, args, depth + 1))
+
+    # ------------------------------------------------- memory access
+
+    _LOADS = {
+        0x28: ("<I", 4, None), 0x29: ("<Q", 8, None),
+        0x2A: ("<f", 4, None), 0x2B: ("<d", 8, None),
+        0x2C: ("<b", 1, 32), 0x2D: ("<B", 1, 32),
+        0x2E: ("<h", 2, 32), 0x2F: ("<H", 2, 32),
+        0x30: ("<b", 1, 64), 0x31: ("<B", 1, 64),
+        0x32: ("<h", 2, 64), 0x33: ("<H", 2, 64),
+        0x34: ("<i", 4, 64), 0x35: ("<I", 4, 64),
+    }
+
+    def _load(self, op: int, offset: int, stack: List[Any]) -> None:
+        fmt, size, to = self._LOADS[op]
+        addr = stack.pop() + offset
+        if addr < 0 or addr + size > len(self.memory):
+            raise Trap("out of bounds memory access")
+        v = struct.unpack_from(fmt, self.memory, addr)[0]
+        if to is not None and v < 0:  # signed widen → two's complement
+            v &= (1 << to) - 1
+        stack.append(v)
+
+    _STORES = {
+        0x36: ("<I", 4, 0xFFFFFFFF), 0x37: ("<Q", 8, (1 << 64) - 1),
+        0x38: ("<f", 4, None), 0x39: ("<d", 8, None),
+        0x3A: ("<B", 1, 0xFF), 0x3B: ("<H", 2, 0xFFFF),
+        0x3C: ("<B", 1, 0xFF), 0x3D: ("<H", 2, 0xFFFF),
+        0x3E: ("<I", 4, 0xFFFFFFFF),
+    }
+
+    def _store(self, op: int, offset: int, stack: List[Any]) -> None:
+        fmt, size, mask = self._STORES[op]
+        v = stack.pop()
+        addr = stack.pop() + offset
+        if addr < 0 or addr + size > len(self.memory):
+            raise Trap("out of bounds memory access")
+        if mask is not None:
+            v &= mask
+        struct.pack_into(fmt, self.memory, addr, v)
+
+    # ------------------------------------------------- numeric ops
+
+    def _numeric(self, op: int, stack: List[Any]) -> None:
+        s = stack
+        if op == 0x45:  # i32.eqz
+            s.append(int(s.pop() == 0))
+        elif 0x46 <= op <= 0x4F:
+            b = s.pop()
+            a = s.pop()
+            s.append(_icmp(op - 0x46, a, b, 32))
+        elif op == 0x50:
+            s.append(int(s.pop() == 0))
+        elif 0x51 <= op <= 0x5A:
+            b = s.pop()
+            a = s.pop()
+            s.append(_icmp(op - 0x51, a, b, 64))
+        elif 0x5B <= op <= 0x60:  # f32 cmp
+            b = s.pop()
+            a = s.pop()
+            s.append(_fcmp(op - 0x5B, a, b))
+        elif 0x61 <= op <= 0x66:  # f64 cmp
+            b = s.pop()
+            a = s.pop()
+            s.append(_fcmp(op - 0x61, a, b))
+        elif op == 0x67:
+            s.append(_clz(s.pop(), 32))
+        elif op == 0x68:
+            s.append(_ctz(s.pop(), 32))
+        elif op == 0x69:
+            s.append(bin(s.pop()).count("1"))
+        elif 0x6A <= op <= 0x78:
+            b = s.pop()
+            a = s.pop()
+            s.append(_ibin(op - 0x6A, a, b, 32))
+        elif op == 0x79:
+            s.append(_clz(s.pop(), 64))
+        elif op == 0x7A:
+            s.append(_ctz(s.pop(), 64))
+        elif op == 0x7B:
+            s.append(bin(s.pop()).count("1"))
+        elif 0x7C <= op <= 0x8A:
+            b = s.pop()
+            a = s.pop()
+            s.append(_ibin(op - 0x7C, a, b, 64))
+        elif 0x8B <= op <= 0x98:  # f32 unary/binary
+            self._fop(op - 0x8B, s, 32)
+        elif 0x99 <= op <= 0xA6:  # f64
+            self._fop(op - 0x99, s, 64)
+        elif op == 0xA7:  # i32.wrap_i64
+            s.append(s.pop() & 0xFFFFFFFF)
+        elif op in (0xA8, 0xAA):  # i32.trunc_f32_s / f64_s
+            s.append(_trunc(s.pop(), 32, True))
+        elif op in (0xA9, 0xAB):
+            s.append(_trunc(s.pop(), 32, False))
+        elif op == 0xAC:  # i64.extend_i32_s
+            s.append(_sext(s.pop(), 32) & ((1 << 64) - 1))
+        elif op == 0xAD:
+            s.append(s.pop() & 0xFFFFFFFF)
+        elif op in (0xAE, 0xB0):
+            s.append(_trunc(s.pop(), 64, True))
+        elif op in (0xAF, 0xB1):
+            s.append(_trunc(s.pop(), 64, False))
+        elif op in (0xB2, 0xB7):  # fNN.convert_i32_s
+            s.append(float(_sext(s.pop(), 32)))
+        elif op in (0xB3, 0xB8):
+            s.append(float(s.pop() & 0xFFFFFFFF))
+        elif op in (0xB4, 0xB9):
+            s.append(float(_sext(s.pop(), 64)))
+        elif op in (0xB5, 0xBA):
+            s.append(float(s.pop() & ((1 << 64) - 1)))
+        elif op == 0xB6:  # f32.demote
+            s.append(struct.unpack("<f", struct.pack("<f", s.pop()))[0])
+        elif op == 0xBB:  # f64.promote
+            pass
+        elif op == 0xBC:  # i32.reinterpret_f32
+            s.append(struct.unpack("<I", struct.pack("<f", s.pop()))[0])
+        elif op == 0xBD:
+            s.append(struct.unpack("<Q", struct.pack("<d", s.pop()))[0])
+        elif op == 0xBE:
+            s.append(struct.unpack("<f", struct.pack("<I", s.pop()))[0])
+        elif op == 0xBF:
+            s.append(struct.unpack("<d", struct.pack("<Q", s.pop()))[0])
+        elif op == 0xC0:  # sign-extension ops (widely emitted)
+            s.append(_sext(s.pop() & 0xFF, 8) & 0xFFFFFFFF)
+        elif op == 0xC1:
+            s.append(_sext(s.pop() & 0xFFFF, 16) & 0xFFFFFFFF)
+        elif op == 0xC2:
+            s.append(_sext(s.pop() & 0xFF, 8) & ((1 << 64) - 1))
+        elif op == 0xC3:
+            s.append(_sext(s.pop() & 0xFFFF, 16) & ((1 << 64) - 1))
+        elif op == 0xC4:
+            s.append(_sext(s.pop() & 0xFFFFFFFF, 32) & ((1 << 64) - 1))
+        else:
+            raise Trap(f"unsupported opcode 0x{op:02x}")
+
+    def _fop(self, sub: int, s: List[Any], bits: int) -> None:
+        if sub <= 6:  # unary: abs neg ceil floor trunc nearest sqrt
+            a = s.pop()
+            if sub == 0:
+                v = abs(a)
+            elif sub == 1:
+                v = -a
+            elif sub == 2:
+                v = float(math.ceil(a))
+            elif sub == 3:
+                v = float(math.floor(a))
+            elif sub == 4:
+                v = float(math.trunc(a))
+            elif sub == 5:
+                v = float(round(a))  # round-half-even == nearest
+            else:
+                v = math.sqrt(a) if a >= 0 else math.nan
+        else:  # binary: add sub mul div min max copysign
+            b = s.pop()
+            a = s.pop()
+            if sub == 7:
+                v = a + b
+            elif sub == 8:
+                v = a - b
+            elif sub == 9:
+                v = a * b
+            elif sub == 10:
+                v = a / b if b != 0 else (
+                    math.nan if a == 0 else math.copysign(math.inf, a)
+                    * math.copysign(1, b))
+            elif sub == 11:
+                v = min(a, b)
+            elif sub == 12:
+                v = max(a, b)
+            else:
+                v = math.copysign(a, b)
+        if bits == 32:
+            v = struct.unpack("<f", struct.pack("<f", v))[0]
+        s.append(v)
+
+
+class _Branch(Exception):
+    def __init__(self, depth: int):
+        self.depth = depth
+
+
+# --------------------------------------------------- numeric helpers
+
+
+def _sext(v: int, bits: int) -> int:
+    return v - (1 << bits) if v >= (1 << (bits - 1)) else v
+
+
+def _icmp(sub: int, a: int, b: int, bits: int) -> int:
+    sa, sb = _sext(a, bits), _sext(b, bits)
+    ops = [a == b, a != b, sa < sb, a < b, sa > sb, a > b,
+           sa <= sb, a <= b, sa >= sb, a >= b]
+    return int(ops[sub])
+
+
+def _fcmp(sub: int, a: float, b: float) -> int:
+    if math.isnan(a) or math.isnan(b):
+        return int(sub == 1)  # only 'ne' is true for NaN operands
+    return int([a == b, a != b, a < b, a > b, a <= b, a >= b][sub])
+
+
+def _ibin(sub: int, a: int, b: int, bits: int) -> int:
+    mask = (1 << bits) - 1
+    if sub == 0:
+        return (a + b) & mask
+    if sub == 1:
+        return (a - b) & mask
+    if sub == 2:
+        return (a * b) & mask
+    if sub == 3:  # div_s
+        sa, sb = _sext(a, bits), _sext(b, bits)
+        if sb == 0:
+            raise Trap("integer divide by zero")
+        q = abs(sa) // abs(sb)
+        if (sa < 0) != (sb < 0):
+            q = -q
+        if q == 1 << (bits - 1):
+            raise Trap("integer overflow")
+        return q & mask
+    if sub == 4:  # div_u
+        if b == 0:
+            raise Trap("integer divide by zero")
+        return (a // b) & mask
+    if sub == 5:  # rem_s
+        sa, sb = _sext(a, bits), _sext(b, bits)
+        if sb == 0:
+            raise Trap("integer divide by zero")
+        r = abs(sa) % abs(sb)
+        if sa < 0:
+            r = -r
+        return r & mask
+    if sub == 6:  # rem_u
+        if b == 0:
+            raise Trap("integer divide by zero")
+        return (a % b) & mask
+    if sub == 7:
+        return a & b
+    if sub == 8:
+        return a | b
+    if sub == 9:
+        return a ^ b
+    if sub == 10:
+        return (a << (b % bits)) & mask
+    if sub == 11:  # shr_s
+        return (_sext(a, bits) >> (b % bits)) & mask
+    if sub == 12:  # shr_u
+        return a >> (b % bits)
+    if sub == 13:  # rotl
+        n = b % bits
+        return ((a << n) | (a >> (bits - n))) & mask if n else a
+    if sub == 14:  # rotr
+        n = b % bits
+        return ((a >> n) | (a << (bits - n))) & mask if n else a
+    raise Trap(f"bad ibin {sub}")
+
+
+def _clz(v: int, bits: int) -> int:
+    if v == 0:
+        return bits
+    return bits - v.bit_length()
+
+
+def _ctz(v: int, bits: int) -> int:
+    if v == 0:
+        return bits
+    return (v & -v).bit_length() - 1
+
+
+def _trunc(v: float, bits: int, signed: bool) -> int:
+    if math.isnan(v) or math.isinf(v):
+        raise Trap("invalid conversion to integer")
+    t = math.trunc(v)
+    if signed:
+        if not -(1 << (bits - 1)) <= t < (1 << (bits - 1)):
+            raise Trap("integer overflow")
+        return t & ((1 << bits) - 1)
+    if not 0 <= t < (1 << bits):
+        raise Trap("integer overflow")
+    return t

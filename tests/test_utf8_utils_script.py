@@ -150,10 +150,10 @@ def test_script_inline_code_and_protected_mode():
     assert bodies == [{"survives": 1}]  # protected mode keeps the record
 
 
-def test_wasm_gated():
+def test_wasm_requires_module_path():
     from fluentbit_tpu.core.plugin import registry
 
     ins = registry.create_filter("wasm")
     ins.configure()
-    with pytest.raises(RuntimeError, match="script"):
+    with pytest.raises(ValueError, match="wasm_path"):
         ins.plugin.init(ins, None)

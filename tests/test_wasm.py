@@ -1,0 +1,367 @@
+"""wasmrt interpreter + filter_wasm tests.
+
+Modules are hand-assembled by an independent binary encoder below (the
+spec's binary grammar), so interpreter bugs can't self-confirm.
+Filter scenarios mirror the reference filter_wasm contract
+(plugins/filter_wasm/filter_wasm.c: replace / drop / trap-keeps)."""
+
+import json
+import struct
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.wasmrt import Module, Trap, WasmError
+
+# ------------------------------------------------- binary assembler
+
+
+def leb(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def sleb(n):
+    out = bytearray()
+    more = True
+    while more:
+        b = n & 0x7F
+        n >>= 7
+        if (n == 0 and not b & 0x40) or (n == -1 and b & 0x40):
+            more = False
+        else:
+            b |= 0x80
+        out.append(b)
+    return bytes(out)
+
+
+def sec(sid, body):
+    return bytes([sid]) + leb(len(body)) + body
+
+
+def vec(items):
+    return leb(len(items)) + b"".join(items)
+
+
+I32 = 0x7F
+
+
+def functype(params, results):
+    return b"\x60" + vec([bytes([p]) for p in params]) \
+        + vec([bytes([r]) for r in results])
+
+
+def module(types, funcs, exports, memory_pages=1, data=(), tables=None,
+           elems=(), globals_=()):
+    """funcs: [(type_idx, locals:[(count, type)], body_bytes)]"""
+    out = bytearray(b"\0asm\x01\0\0\0")
+    out += sec(1, vec([functype(p, r) for p, r in types]))
+    out += sec(3, vec([leb(t) for t, _l, _b in funcs]))
+    if tables is not None:
+        out += sec(4, vec([b"\x70\x00" + leb(tables)]))
+    if memory_pages:
+        out += sec(5, vec([b"\x00" + leb(memory_pages)]))
+    if globals_:
+        out += sec(6, vec([bytes([vt, mut]) + init + b"\x0b"
+                           for vt, mut, init in globals_]))
+    out += sec(7, vec([leb(len(n)) + n.encode() + bytes([kind]) + leb(i)
+                       for n, kind, i in exports]))
+    if elems:
+        out += sec(9, vec([b"\x00\x41" + sleb(off) + b"\x0b"
+                           + vec([leb(f) for f in idxs])
+                           for off, idxs in elems]))
+    bodies = []
+    for _t, locals_, body in funcs:
+        lb = vec([leb(c) + bytes([vt]) for c, vt in locals_]) + body \
+            + b"\x0b"
+        bodies.append(leb(len(lb)) + lb)
+    out += sec(10, vec(bodies))
+    if data:
+        out += sec(11, vec([b"\x00\x41" + sleb(off) + b"\x0b"
+                            + leb(len(d)) + d for off, d in data]))
+    return bytes(out)
+
+
+# opcodes used below
+LOCAL_GET, LOCAL_SET = b"\x20", b"\x21"
+I32_CONST = b"\x41"
+I32_ADD, I32_SUB, I32_MUL = b"\x6a", b"\x6b", b"\x6c"
+I32_EQ, I32_LT_S, I32_GE_U, I32_EQZ = b"\x46", b"\x48", b"\x4f", b"\x45"
+CALL = b"\x10"
+IF_I32, IF_VOID, ELSE, END = b"\x04\x7f", b"\x04\x40", b"\x05", b"\x0b"
+BLOCK_VOID, LOOP_VOID = b"\x02\x40", b"\x03\x40"
+BR, BR_IF, RETURN = b"\x0c", b"\x0d", b"\x0f"
+I32_LOAD8_U = b"\x2d\x00\x00"  # align=0 offset=0
+I32_STORE8 = b"\x3a\x00\x00"
+
+
+def l(i):
+    return LOCAL_GET + leb(i)
+
+
+# ------------------------------------------------------ interpreter
+
+
+def test_add_function():
+    m = Module(module(
+        [([I32, I32], [I32])],
+        [(0, [], l(0) + l(1) + I32_ADD)],
+        [("add", 0, 0)], memory_pages=0))
+    assert m.call("add", [2, 3]) == [5]
+    assert m.call("add", [0xFFFFFFFF, 1]) == [0]  # i32 wraps
+
+
+def test_factorial_recursion():
+    # fac(n) = n<1 ? 1 : n*fac(n-1)
+    body = (l(0) + I32_CONST + sleb(1) + I32_LT_S
+            + IF_I32 + I32_CONST + sleb(1)
+            + ELSE + l(0) + l(0) + I32_CONST + sleb(1) + I32_SUB
+            + CALL + leb(0) + I32_MUL + END)
+    m = Module(module([([I32], [I32])], [(0, [], body)],
+                      [("fac", 0, 0)], memory_pages=0))
+    assert m.call("fac", [10]) == [3628800]
+
+
+def test_loop_sum():
+    # sum 1..n with a loop: local1 = acc
+    body = (
+        BLOCK_VOID
+        + LOOP_VOID
+        + l(0) + I32_EQZ + BR_IF + leb(1)          # exit when n == 0
+        + l(1) + l(0) + I32_ADD + LOCAL_SET + leb(1)
+        + l(0) + I32_CONST + sleb(1) + I32_SUB + LOCAL_SET + leb(0)
+        + BR + leb(0)
+        + END + END
+        + l(1)
+    )
+    m = Module(module([([I32], [I32])], [(0, [(1, I32)], body)],
+                      [("sum", 0, 0)], memory_pages=0))
+    assert m.call("sum", [100]) == [5050]
+
+
+def test_memory_and_data_segment():
+    # byte_at(i) -> mem[i]; data "hi!" at offset 8
+    m = Module(module(
+        [([I32], [I32])],
+        [(0, [], l(0) + I32_LOAD8_U)],
+        [("byte_at", 0, 0), ("memory", 2, 0)],
+        data=[(8, b"hi!")]))
+    assert m.call("byte_at", [8]) == [ord("h")]
+    assert m.call("byte_at", [10]) == [ord("!")]
+    assert m.call("byte_at", [11]) == [0]
+
+
+def test_store_and_trap_oob():
+    # poke(addr, v): mem[addr] = v
+    body = l(0) + l(1) + I32_STORE8
+    m = Module(module([([I32, I32], [])], [(0, [], body)],
+                      [("poke", 0, 0)]))
+    m.call("poke", [5, 65])
+    assert m.memory[5] == 65
+    with pytest.raises(Trap):
+        m.call("poke", [1 << 20, 1])  # beyond the single page
+
+
+def test_globals_and_call_indirect():
+    # two funcs f0()->10, f1()->20 in a table; pick(i) calls table[i]
+    g_init = I32_CONST + sleb(7)
+    m = Module(module(
+        [([], [I32]), ([I32], [I32])],
+        [(0, [], I32_CONST + sleb(10)),
+         (0, [], I32_CONST + sleb(20) + b"\x23\x00" + I32_ADD),  # +g0
+         (1, [], l(0) + b"\x11" + leb(0) + leb(0))],  # call_indirect
+        [("pick", 0, 2)], memory_pages=0, tables=2,
+        elems=[(0, [0, 1])], globals_=[(I32, 0, g_init)]))
+    assert m.call("pick", [0]) == [10]
+    assert m.call("pick", [1]) == [27]
+    with pytest.raises(Trap):
+        m.call("pick", [5])
+
+
+def test_div_by_zero_traps():
+    body = l(0) + l(1) + b"\x6d"  # i32.div_s
+    m = Module(module([([I32, I32], [I32])], [(0, [], body)],
+                      [("div", 0, 0)], memory_pages=0))
+    assert m.call("div", [7, 2]) == [3]
+    assert m.call("div", [(-7) & 0xFFFFFFFF, 2]) == [(-3) & 0xFFFFFFFF]
+    with pytest.raises(Trap):
+        m.call("div", [1, 0])
+
+
+def test_imports_rejected():
+    broken = bytearray(b"\0asm\x01\0\0\0")
+    broken += sec(2, vec([leb(3) + b"env" + leb(1) + b"f" + b"\x00\x00"]))
+    with pytest.raises(WasmError, match="import"):
+        Module(bytes(broken))
+
+
+# ------------------------------------------------------- filter_wasm
+
+
+def filter_module():
+    """The reference filter signature:
+    f(tag_ptr, tag_len, sec, nsec, rec_ptr, rec_len) -> i32 (cstr ptr).
+
+    Behavior: scan the record for the byte 'X' — found: return 0 (drop);
+    else if rec_len > 60: return ptr to '{"flag":"long"}'; else echo
+    the record back (rec_ptr)."""
+    drop_scan = (
+        # local6 = i (loop index)
+        BLOCK_VOID
+        + LOOP_VOID
+        + l(6) + l(5) + I32_GE_U + BR_IF + leb(1)   # i >= rec_len → exit
+        + l(4) + l(6) + I32_ADD + I32_LOAD8_U
+        + I32_CONST + sleb(ord("X")) + I32_EQ
+        + IF_VOID + I32_CONST + sleb(0) + RETURN + END
+        + l(6) + I32_CONST + sleb(1) + I32_ADD + LOCAL_SET + leb(6)
+        + BR + leb(0)
+        + END + END
+    )
+    tail = (
+        l(5) + I32_CONST + sleb(60) + b"\x4b"        # rec_len > 60 (gt_u)
+        + IF_I32 + I32_CONST + sleb(16)              # ptr to static JSON
+        + ELSE + l(4) + END
+    )
+    return module(
+        [([I32] * 6, [I32])],
+        [(0, [(1, I32)], drop_scan + tail)],
+        [("go", 0, 0)],
+        data=[(16, b'{"flag":"long"}\0')])
+
+
+def run_wasm_filter(records, tmp_path, **props):
+    path = tmp_path / "filter.wasm"
+    path.write_bytes(filter_module())
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("wasm", match="t", wasm_path=str(path),
+               function_name="go", **props)
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for r in records:
+            ctx.push(in_ffd, json.dumps(r))
+        ctx.flush_now()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    return [e.body for d in got for e in decode_events(d)]
+
+
+def test_filter_wasm_drop_replace_echo(tmp_path):
+    bodies = run_wasm_filter(
+        [{"msg": "contains X marker"},                   # dropped
+         {"msg": "a" * 80},                              # replaced
+         {"msg": "short"}],                              # echoed
+        tmp_path)
+    assert bodies == [{"flag": "long"}, {"msg": "short"}]
+
+
+def test_filter_wasm_missing_function(tmp_path):
+    path = tmp_path / "f.wasm"
+    path.write_bytes(filter_module())
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.filter("wasm", match="t", wasm_path=str(path),
+               function_name="nope")
+    ctx.output("null", match="*")
+    with pytest.raises(Exception):
+        ctx.start()
+    ctx.stop()
+
+
+def test_void_block_branch_preserves_operands():
+    """br out of a VOID block must not duplicate pending operands from
+    the enclosing frame (blocktype 0x40 decodes as SLEB -64)."""
+    body = (l(0) + l(1)
+            + BLOCK_VOID + BR + leb(0) + END
+            + I32_ADD)
+    m = Module(module([([I32, I32], [I32])], [(0, [], body)],
+                      [("f", 0, 0)], memory_pages=0))
+    assert m.call("f", [10, 20]) == [30]
+
+
+def test_br_to_function_frame_is_return():
+    """A br whose label is the function frame itself is a return."""
+    body = I32_CONST + sleb(7) + BR + leb(0)
+    m = Module(module([([], [I32])], [(0, [], body)],
+                      [("f", 0, 0)], memory_pages=0))
+    assert m.call("f", []) == [7]
+
+
+def test_dup_data_uses_exported_malloc():
+    """Modules exporting malloc get dup_data through THEIR allocator
+    (WAMR's wasm_runtime_module_malloc behavior) — no collision with a
+    guest-managed heap."""
+    # malloc(n): bump global 0 by n, return old value; free: no-op
+    g_init = I32_CONST + sleb(1024)
+    malloc_body = (b"\x23\x00"            # global.get 0
+                   + b"\x23\x00" + l(0) + I32_ADD
+                   + b"\x24\x00")         # global.set 0
+    free_body = b""
+    m = Module(module(
+        [([I32], [I32]), ([I32], [])],
+        [(0, [], malloc_body), (1, [], free_body)],
+        [("malloc", 0, 0), ("free", 0, 1)],
+        globals_=[(I32, 1, g_init)]))
+    p1 = m.dup_data(b"abc")
+    p2 = m.dup_data(b"defg")
+    assert p1 == 1024 and p2 == 1028  # allocated BY the guest malloc
+    assert bytes(m.memory[p1:p1 + 4]) == b"abc\0"
+    assert bytes(m.memory[p2:p2 + 5]) == b"defg\0"
+    m.reset_heap()
+    assert m._mallocs == []
+
+
+def test_filter_wasm_reinstantiates_after_trap(tmp_path):
+    """A trapping record must not poison guest state for later records:
+    the module reinstantiates (global resets to its init value)."""
+    # f(...6 args) -> i32: bump global; if rec_len == 1 trap (div 0);
+    # else return ptr to static json only when global == 1 (fresh)
+    body = (b"\x23\x00" + I32_CONST + sleb(1) + I32_ADD + b"\x24\x00"
+            + l(5) + I32_CONST + sleb(1) + I32_EQ
+            + IF_VOID + I32_CONST + sleb(1) + I32_CONST + sleb(0)
+            + b"\x6d" + b"\x1a" + END     # div_s by zero → trap
+            + b"\x23\x00" + I32_CONST + sleb(1) + I32_EQ
+            + IF_I32 + I32_CONST + sleb(32)
+            + ELSE + I32_CONST + sleb(48) + END)
+    mod_bytes = module(
+        [([I32] * 6, [I32])],
+        [(0, [], body)],
+        [("go", 0, 0)],
+        data=[(32, b'{"fresh":1}\0'), (48, b'{"stale":1}\0')],
+        globals_=[(I32, 1, I32_CONST + sleb(0))])
+    path = tmp_path / "trap.wasm"
+    path.write_bytes(mod_bytes)
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("wasm", match="t", wasm_path=str(path),
+               function_name="go")
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, "0")          # rec_len 1 → traps, kept as-is
+        ctx.push(in_ffd, json.dumps({"a": 1}))  # must see a FRESH module
+        ctx.flush_now()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    bodies = [e.body for d in got for e in decode_events(d)]
+    assert 0 in bodies or {"0": 0} not in bodies  # trapped record kept raw
+    assert {"fresh": 1} in bodies, bodies
