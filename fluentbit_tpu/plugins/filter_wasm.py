@@ -53,7 +53,7 @@ class WasmFilter(FilterPlugin):
         with open(self.wasm_path, "rb") as f:
             self._binary = f.read()
         try:
-            self._module = Module(self._binary)
+            self._module = self._instantiate()
         except (WasmError, Trap) as e:
             raise ValueError(f"wasm filter: cannot load "
                              f"{self.wasm_path}: {e}")
@@ -62,6 +62,16 @@ class WasmFilter(FilterPlugin):
             raise ValueError(
                 f"wasm filter: function {self.function_name!r} not "
                 f"exported by {self.wasm_path}")
+
+    def _instantiate(self) -> Module:
+        """wasm_heap_size caps linear memory (grow + dup_data);
+        wasm_stack_size maps onto the call-depth bound (each frame is
+        roughly a few KB of guest shadow stack in toolchain output)."""
+        depth = max(16, min(4096, int(self.wasm_stack_size or 0) // 4096
+                            or 256))
+        return Module(self._binary,
+                      max_memory_bytes=int(self.wasm_heap_size or 0),
+                      max_call_depth=depth)
 
     def filter(self, events: list, tag: str, engine) -> tuple:
         mod = self._module
@@ -89,16 +99,18 @@ class WasmFilter(FilterPlugin):
                     modified = True  # NULL → skip record
                     continue
                 ret_str = mod.read_cstr(ptr)
-            except (Trap, WasmError) as e:
-                log.error("wasm function %r trapped: %s",
+            except Exception as e:
+                # wasmrt does no load-time validation, so a hostile
+                # module can surface raw Python errors (IndexError on
+                # stack underflow, struct.error) alongside Trap —
+                # every per-call failure keeps the record and
+                # reinstantiates (guest state may be mid-mutation:
+                # shadow stack pointer, heap metadata)
+                log.error("wasm function %r failed: %s",
                           self.function_name, e)
-                out.append(ev)  # exception → record kept
-                # a trap can abandon guest state mid-mutation (shadow
-                # stack pointer, heap metadata); reinstantiate from the
-                # cached binary so one hostile record can't poison
-                # every later call
+                out.append(ev)
                 try:
-                    self._module = mod = Module(self._binary)
+                    self._module = mod = self._instantiate()
                 except (WasmError, Trap):
                     log.exception("wasm reinstantiate failed")
                 continue

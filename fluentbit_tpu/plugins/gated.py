@@ -46,6 +46,14 @@ _gate(InputPlugin, "ebpf", "libbpf CO-RE")
 _gate(InputPlugin, "systemd", "libsystemd (journald)")
 _gate(InputPlugin, "winlog", "the Windows Event Log API")
 _gate(InputPlugin, "winevtlog", "the Windows Event Log API")
+_gate(InputPlugin, "winstat", "the Windows performance counter API")
+_gate(InputPlugin, "windows_exporter_metrics",
+      "the Windows WMI/perflib APIs")
+_gate(InputPlugin, "etw", "Event Tracing for Windows")
+# in_stream_processor is not gated: CREATE STREAM results re-ingest
+# through the hidden emitter already (stream_processor/__init__.py)
+_gate(OutputPlugin, "calyptia", "the Calyptia Cloud ingestion API")
+_gate(OutputPlugin, "zig_demo", "the Zig native-plugin ABI demo")
 
 _gate(CustomPlugin, "calyptia",
       "the Calyptia Cloud control plane (remote fleet management API)",

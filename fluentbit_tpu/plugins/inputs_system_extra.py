@@ -1,0 +1,290 @@
+"""System-ish inputs rounding out the registry: docker,
+prometheus_textfile, gpu_metrics, event_type, event_test.
+
+Reference: plugins/in_docker (cgroup v1/v2 per-container cpu/mem
+snapshots, record {id, name, cpu_used, mem_used, mem_limit},
+docker.c:408-448), plugins/in_prometheus_textfile (glob *.prom files
+→ metrics, the node_exporter textfile-collector role),
+plugins/in_gpu_metrics (AMD sysfs /sys/class/drm/cardN/device gauges —
+gpu_metrics.c:95-126 metric names; NVML cards need the vendor library
+and report absent here), plugins/in_event_type + in_event_test (test
+generators emitting each signal type on an interval — the runtime-test
+scaffolding inputs).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import logging
+import os
+import time
+from typing import List, Optional
+
+from ..codec.chunk import EVENT_TYPE_METRICS
+from ..codec.events import encode_event, now_event_time
+from ..codec.msgpack import packb
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+log = logging.getLogger("flb.system_extra")
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            v = f.read().strip()
+        return int(v) if v != "max" else -1
+    except (OSError, ValueError):
+        return None
+
+
+@registry.register
+class DockerInput(InputPlugin):
+    """plugins/in_docker: per-container cpu/mem from cgroups."""
+
+    name = "docker"
+    description = "docker container cgroup metrics"
+    config_map = [
+        ConfigMapEntry("interval_sec", "int", default=1),
+        ConfigMapEntry("include", "str",
+                       desc="space-separated container ids to include"),
+        ConfigMapEntry("exclude", "str"),
+        ConfigMapEntry("path.sysfs", "str", default="/sys/fs/cgroup"),
+        ConfigMapEntry("path.containers", "str",
+                       default="/var/lib/docker/containers"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.interval_sec or 1)
+        self._include = set((self.include or "").split()) or None
+        self._exclude = set((self.exclude or "").split())
+
+    def _container_name(self, cid: str) -> str:
+        """config.v2.json carries the user-facing name
+        (docker.c docker_extract_name)."""
+        cfg = os.path.join(self.path_containers, cid, "config.v2.json")
+        try:
+            with open(cfg) as f:
+                name = json.load(f).get("Name", "")
+            return name.lstrip("/") or cid[:12]
+        except (OSError, ValueError):
+            return cid[:12]
+
+    def _stats(self, cid: str):
+        sysfs = self.path_sysfs
+        # cgroup v2: system.slice/docker-<id>.scope
+        base = os.path.join(sysfs, "system.slice", f"docker-{cid}.scope")
+        if os.path.isdir(base):
+            mem = _read_int(os.path.join(base, "memory.current"))
+            lim = _read_int(os.path.join(base, "memory.max"))
+            cpu = None
+            try:
+                with open(os.path.join(base, "cpu.stat")) as f:
+                    for line in f:
+                        if line.startswith("usage_usec"):
+                            cpu = int(line.split()[1]) * 1000  # → ns
+            except OSError:
+                pass
+            if mem is not None or cpu is not None:
+                return cpu or 0, mem or 0, lim if lim and lim > 0 else 0
+        # cgroup v1: cpu/docker/<id>, memory/docker/<id>
+        cpu = _read_int(os.path.join(sysfs, "cpu", "docker", cid,
+                                     "cpuacct.usage"))
+        mem = _read_int(os.path.join(sysfs, "memory", "docker", cid,
+                                     "memory.usage_in_bytes"))
+        lim = _read_int(os.path.join(sysfs, "memory", "docker", cid,
+                                     "memory.limit_in_bytes"))
+        if cpu is None and mem is None:
+            return None
+        return cpu or 0, mem or 0, lim or 0
+
+    def _ids(self) -> List[str]:
+        try:
+            return [d for d in os.listdir(self.path_containers)
+                    if len(d) == 64]
+        except OSError:
+            return []
+
+    def collect(self, engine) -> None:
+        out = bytearray()
+        n = 0
+        for cid in self._ids():
+            if self._include is not None and cid not in self._include \
+                    and cid[:12] not in self._include:
+                continue
+            if cid in self._exclude or cid[:12] in self._exclude:
+                continue
+            stats = self._stats(cid)
+            if stats is None:
+                continue
+            cpu, mem, lim = stats
+            out += encode_event({
+                "id": cid[:12],
+                "name": self._container_name(cid),
+                "cpu_used": cpu,
+                "mem_used": mem,
+                "mem_limit": lim,
+            }, now_event_time())
+            n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+
+
+@registry.register
+class PrometheusTextfileInput(InputPlugin):
+    """plugins/in_prometheus_textfile: glob .prom exposition files."""
+
+    name = "prometheus_textfile"
+    description = "scrape Prometheus exposition text files"
+    config_map = [
+        ConfigMapEntry("path", "str",
+                       desc="glob pattern of .prom files"),
+        ConfigMapEntry("scrape_interval", "time", default="10"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.path:
+            raise ValueError("prometheus_textfile requires 'path'")
+        self.collect_interval = float(self.scrape_interval or 10)
+
+    def collect(self, engine) -> None:
+        from .inputs_net_extra import parse_prometheus_text
+
+        metrics: List[dict] = []
+        for path in sorted(_glob.glob(self.path)):
+            try:
+                with open(path) as f:
+                    metrics.extend(parse_prometheus_text(f.read()))
+            except OSError:
+                log.debug("prometheus_textfile: cannot read %s", path)
+        if metrics:
+            payload = {"meta": {"ts": time.time()}, "metrics": metrics}
+            engine.input_event_append(
+                self.instance, self.instance.tag, packb(payload),
+                EVENT_TYPE_METRICS, n_records=len(metrics))
+
+
+# gpu_metrics.c:95-126 gauge names; per-card sysfs files (AMD)
+_GPU_FILES = [
+    ("gpu_utilization_percent", "gpu_busy_percent", 1.0),
+    ("gpu_memory_used_bytes", "mem_info_vram_used", 1.0),
+    ("gpu_memory_total_bytes", "mem_info_vram_total", 1.0),
+]
+_HWMON_FILES = [
+    ("gpu_power_watts", "power1_average", 1e-6),
+    ("gpu_temperature_celsius", "temp1_input", 1e-3),
+    ("gpu_fan_speed_rpm", "fan1_input", 1.0),
+]
+
+
+@registry.register
+class GpuMetricsInput(InputPlugin):
+    """plugins/in_gpu_metrics (AMD sysfs side; NVML needs the vendor
+    library and is reported absent)."""
+
+    name = "gpu_metrics"
+    description = "AMD GPU sysfs metrics"
+    config_map = [
+        ConfigMapEntry("interval_sec", "int", default=1),
+        ConfigMapEntry("path.sysfs", "str", default="/sys"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.interval_sec or 1)
+
+    def _cards(self) -> List[str]:
+        pattern = os.path.join(self.path_sysfs, "class", "drm",
+                               "card[0-9]*", "device")
+        return [d for d in sorted(_glob.glob(pattern))
+                if os.path.isdir(d)]
+
+    def collect(self, engine) -> None:
+        metrics: List[dict] = []
+        ts = time.time()
+        for dev in self._cards():
+            card = os.path.basename(os.path.dirname(dev))
+            values = []
+            for metric, fname, scale in _GPU_FILES:
+                v = _read_int(os.path.join(dev, fname))
+                if v is not None:
+                    values.append((metric, v * scale))
+            for hw in sorted(_glob.glob(os.path.join(dev, "hwmon",
+                                                     "hwmon[0-9]*"))):
+                for metric, fname, scale in _HWMON_FILES:
+                    v = _read_int(os.path.join(hw, fname))
+                    if v is not None:
+                        values.append((metric, v * scale))
+            for metric, value in values:
+                metrics.append({
+                    "name": metric, "type": "gauge", "desc": "",
+                    "labels": ["gpu"], "ts": ts,
+                    "values": [{"labels": [card], "value": value}],
+                })
+        if metrics:
+            payload = {"meta": {"ts": ts}, "metrics": metrics}
+            engine.input_event_append(
+                self.instance, self.instance.tag, packb(payload),
+                EVENT_TYPE_METRICS, n_records=len(metrics))
+
+
+@registry.register
+class EventTypeInput(InputPlugin):
+    """plugins/in_event_type: emit one record of the chosen signal type
+    per interval (test scaffolding; event_type.c send_logs/send_metrics)."""
+
+    name = "event_type"
+    description = "test generator for logs/metrics signals"
+    config_map = [
+        ConfigMapEntry("type", "str", default="logs"),
+        ConfigMapEntry("interval_sec", "int", default=1),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.interval_sec or 1)
+        kind = (self.type or "logs").lower()
+        if kind not in ("logs", "metrics"):
+            raise ValueError(f"event_type: unsupported type {kind!r}")
+        self._kind = kind
+        self._n = 0
+
+    def collect(self, engine) -> None:
+        self._n += 1
+        if self._kind == "logs":
+            engine.input_log_append(
+                self.instance, self.instance.tag,
+                encode_event({"event_type": "some logs"},
+                             now_event_time()), 1)
+        else:
+            payload = {"meta": {"ts": time.time()}, "metrics": [{
+                "name": "event_test_counter", "type": "counter",
+                "desc": "event_type test counter", "labels": [],
+                "ts": time.time(),
+                "values": [{"labels": [], "value": float(self._n)}],
+            }]}
+            engine.input_event_append(
+                self.instance, self.instance.tag, packb(payload),
+                EVENT_TYPE_METRICS, n_records=1)
+
+
+@registry.register
+class EventTestInput(InputPlugin):
+    """plugins/in_event_test: pause/resume exerciser — emits a counter
+    record per interval; the runtime tests toggle pause on it."""
+
+    name = "event_test"
+    description = "test input emitting sequence records"
+    config_map = [
+        ConfigMapEntry("interval_sec", "int", default=1),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self.collect_interval = float(self.interval_sec or 1)
+        self._seq = 0
+
+    def collect(self, engine) -> None:
+        self._seq += 1
+        engine.input_log_append(
+            self.instance, self.instance.tag,
+            encode_event({"seq": self._seq}, now_event_time()), 1)

@@ -154,6 +154,22 @@ def _decode_expr(r: _Reader, terminators=(0x0B,)) -> Tuple[list, int]:
             body.append((op, r.f32()))
         elif op == 0x44:
             body.append((op, r.f64()))
+        elif op == 0xFC:  # misc prefix: saturating trunc / bulk memory
+            sub = r.u32()
+            if sub <= 7:  # iNN.trunc_sat_fNN_{s,u}
+                body.append((0xFC, sub))
+            elif sub == 10:  # memory.copy
+                r.u8()
+                r.u8()
+                body.append((0xFC, sub))
+            elif sub == 11:  # memory.fill
+                r.u8()
+                body.append((0xFC, sub))
+            else:
+                raise WasmError(f"unsupported 0xFC sub-opcode {sub}")
+        elif op in (0xFB, 0xFD):
+            raise WasmError(
+                f"unsupported opcode prefix 0x{op:02x} (GC/SIMD)")
         else:
             body.append((op,))
 
@@ -174,7 +190,12 @@ class _Func:
 class Module:
     """One instantiated module: memory, globals, exported functions."""
 
-    def __init__(self, binary: bytes):
+    def __init__(self, binary: bytes, max_memory_bytes: int = 0,
+                 max_call_depth: int = 256):
+        """max_memory_bytes caps linear memory growth (memory.grow AND
+        the dup_data heap — the wasm_heap_size role); max_call_depth is
+        the wasm_stack_size analogue."""
+        self.max_call_depth = max(16, int(max_call_depth))
         r = _Reader(binary)
         if r.bytes_(4) != b"\0asm":
             raise WasmError("bad magic")
@@ -185,6 +206,8 @@ class Module:
         self.exports: Dict[str, Tuple[str, int]] = {}
         self.memory = bytearray()
         self.mem_max_pages = 1 << 16
+        if max_memory_bytes:
+            self.mem_max_pages = max(1, max_memory_bytes // PAGE)
         self.globals: List[list] = []  # [type, mutable, value]
         self.table: List[Optional[int]] = []
         self.start: Optional[int] = None
@@ -228,7 +251,9 @@ class Module:
                     flags = sec.u8()
                     n_min = sec.u32()
                     if flags & 1:
-                        self.mem_max_pages = sec.u32()
+                        # host cap wins over the declared maximum
+                        self.mem_max_pages = min(self.mem_max_pages,
+                                                 sec.u32())
                     self.memory = bytearray(n_min * PAGE)
             elif sec_id == 6:  # globals
                 for _ in range(sec.u32()):
@@ -321,6 +346,8 @@ class Module:
             if self._bump + need > len(self.memory):
                 pages = (self._bump + need - len(self.memory)
                          + PAGE - 1) // PAGE
+                if len(self.memory) // PAGE + pages > self.mem_max_pages:
+                    raise Trap("dup_data exceeds the memory limit")
                 self.memory.extend(bytes(pages * PAGE))
             ptr = self._bump
             self._bump += need
@@ -369,7 +396,7 @@ class Module:
         raise WasmError("unsupported const expr op")
 
     def _invoke(self, fidx: int, args: List[Any], depth: int = 0):
-        if depth > 256:
+        if depth > self.max_call_depth:
             raise Trap("call stack exhausted")
         f = self.funcs[fidx]
         locals_ = list(args)
@@ -503,8 +530,43 @@ class Module:
                     stack.append(old)
             elif op in (0x41, 0x42, 0x43, 0x44):
                 stack.append(ins[1])
+            elif op == 0xFC:
+                self._misc(ins[1], stack)
             else:
                 self._numeric(op, stack)
+
+    def _misc(self, sub: int, stack: List[Any]) -> None:
+        """0xFC prefix: saturating truncations + bulk memory."""
+        if sub <= 7:
+            bits = 32 if sub < 4 else 64
+            signed = sub % 2 == 0
+            v = stack.pop()
+            if math.isnan(v):
+                stack.append(0)
+                return
+            t = math.trunc(v)
+            if signed:
+                lo, hi = -(1 << (bits - 1)), (1 << (bits - 1)) - 1
+            else:
+                lo, hi = 0, (1 << bits) - 1
+            stack.append(max(lo, min(hi, t)) & ((1 << bits) - 1))
+        elif sub == 10:  # memory.copy
+            n = stack.pop()
+            src = stack.pop()
+            dst = stack.pop()
+            if src + n > len(self.memory) or dst + n > len(self.memory) \
+                    or n < 0 or src < 0 or dst < 0:
+                raise Trap("out of bounds memory access")
+            self.memory[dst:dst + n] = self.memory[src:src + n]
+        elif sub == 11:  # memory.fill
+            n = stack.pop()
+            val = stack.pop() & 0xFF
+            dst = stack.pop()
+            if dst + n > len(self.memory) or n < 0 or dst < 0:
+                raise Trap("out of bounds memory access")
+            self.memory[dst:dst + n] = bytes([val]) * n
+        else:
+            raise Trap(f"unsupported misc op {sub}")
 
     def _do_call(self, fidx: int, stack: List[Any], depth: int) -> None:
         f = self.funcs[fidx]
@@ -680,9 +742,13 @@ class Module:
                     math.nan if a == 0 else math.copysign(math.inf, a)
                     * math.copysign(1, b))
             elif sub == 11:
-                v = min(a, b)
+                # wasm min/max propagate NaN regardless of operand
+                # order (Python's min/max would return the first arg)
+                v = math.nan if math.isnan(a) or math.isnan(b) \
+                    else min(a, b)
             elif sub == 12:
-                v = max(a, b)
+                v = math.nan if math.isnan(a) or math.isnan(b) \
+                    else max(a, b)
             else:
                 v = math.copysign(a, b)
         if bits == 32:

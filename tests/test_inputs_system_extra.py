@@ -1,0 +1,142 @@
+"""docker / prometheus_textfile / gpu_metrics / event_type inputs.
+
+Filesystem fixtures stand in for cgroups and sysfs (the reference's
+path.sysfs / path.containers options exist exactly so tests and
+non-standard hosts can point elsewhere)."""
+
+import json
+import time
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.codec.msgpack import Unpacker
+
+
+def wait_for(cond, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError()
+
+
+def collect(input_name, seconds=1.2, **props):
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input(input_name, tag="t", **props)
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        wait_for(lambda: got, timeout=seconds + 6)
+    finally:
+        ctx.stop()
+    return got
+
+
+CID = "a" * 64
+
+
+def make_docker_tree(tmp_path, v2=True):
+    sysfs = tmp_path / "cgroup"
+    containers = tmp_path / "containers"
+    cdir = containers / CID
+    cdir.mkdir(parents=True)
+    (cdir / "config.v2.json").write_text(json.dumps({"Name": "/web-1"}))
+    if v2:
+        scope = sysfs / "system.slice" / f"docker-{CID}.scope"
+        scope.mkdir(parents=True)
+        (scope / "memory.current").write_text("104857600\n")
+        (scope / "memory.max").write_text("max\n")
+        (scope / "cpu.stat").write_text(
+            "usage_usec 2500000\nuser_usec 2000000\n")
+    else:
+        cpu = sysfs / "cpu" / "docker" / CID
+        mem = sysfs / "memory" / "docker" / CID
+        cpu.mkdir(parents=True)
+        mem.mkdir(parents=True)
+        (cpu / "cpuacct.usage").write_text("2500000000\n")
+        (mem / "memory.usage_in_bytes").write_text("104857600\n")
+        (mem / "memory.limit_in_bytes").write_text("536870912\n")
+    return str(sysfs), str(containers)
+
+
+def test_in_docker_cgroup_v2(tmp_path):
+    sysfs, containers = make_docker_tree(tmp_path, v2=True)
+    got = collect("docker", **{"path.sysfs": sysfs,
+                               "path.containers": containers})
+    ev = decode_events(got[0])[0]
+    assert ev.body["id"] == CID[:12]
+    assert ev.body["name"] == "web-1"
+    assert ev.body["mem_used"] == 104857600
+    assert ev.body["cpu_used"] == 2500000000  # usec → ns
+    assert ev.body["mem_limit"] == 0  # "max" → unlimited
+
+
+def test_in_docker_cgroup_v1_and_exclude(tmp_path):
+    sysfs, containers = make_docker_tree(tmp_path, v2=False)
+    got = collect("docker", **{"path.sysfs": sysfs,
+                               "path.containers": containers})
+    ev = decode_events(got[0])[0]
+    assert ev.body["mem_limit"] == 536870912
+    # excluded by short id → no records
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("docker", tag="t", exclude=CID[:12],
+              **{"path.sysfs": sysfs, "path.containers": containers})
+    got2 = []
+    ctx.output("lib", match="*", callback=lambda d, t: got2.append(d))
+    ctx.start()
+    time.sleep(0.8)
+    ctx.stop()
+    assert got2 == []
+
+
+def test_in_prometheus_textfile(tmp_path):
+    (tmp_path / "node.prom").write_text(
+        "# TYPE widget_total counter\n"
+        'widget_total{site="a"} 42\n'
+        "# TYPE temp gauge\n"
+        "temp 21.5\n")
+    got = collect("prometheus_textfile",
+                  path=str(tmp_path / "*.prom"), scrape_interval="0.2")
+    objs = [o for d in got for o in Unpacker(d)]
+    names = {m["name"]: m for o in objs for m in o.get("metrics", [])}
+    assert names["widget_total"]["values"][0]["value"] == 42.0
+    assert names["temp"]["values"][0]["value"] == 21.5
+
+
+def test_in_gpu_metrics(tmp_path):
+    dev = tmp_path / "class" / "drm" / "card0" / "device"
+    hw = dev / "hwmon" / "hwmon3"
+    hw.mkdir(parents=True)
+    (dev / "gpu_busy_percent").write_text("37\n")
+    (dev / "mem_info_vram_used").write_text("1073741824\n")
+    (dev / "mem_info_vram_total").write_text("8589934592\n")
+    (hw / "temp1_input").write_text("61000\n")
+    (hw / "power1_average").write_text("145000000\n")
+    got = collect("gpu_metrics", **{"path.sysfs": str(tmp_path)})
+    objs = [o for d in got for o in Unpacker(d)]
+    vals = {m["name"]: m["values"][0] for o in objs
+            for m in o.get("metrics", [])}
+    assert vals["gpu_utilization_percent"]["value"] == 37.0
+    assert vals["gpu_utilization_percent"]["labels"] == ["card0"]
+    assert vals["gpu_temperature_celsius"]["value"] == 61.0
+    assert vals["gpu_power_watts"]["value"] == 145.0
+    assert vals["gpu_memory_total_bytes"]["value"] == 8589934592.0
+
+
+def test_in_event_type_logs_and_metrics():
+    got = collect("event_type", interval_sec="1")
+    ev = decode_events(got[0])[0]
+    assert ev.body == {"event_type": "some logs"}
+    got2 = collect("event_type", type="metrics", interval_sec="1")
+    objs = [o for d in got2 for o in Unpacker(d)]
+    (m,) = objs[0]["metrics"]
+    assert m["name"] == "event_test_counter"
+
+
+def test_in_event_test_sequence():
+    got = collect("event_test", interval_sec="1")
+    ev = decode_events(got[0])[0]
+    assert ev.body["seq"] == 1

@@ -365,3 +365,64 @@ def test_filter_wasm_reinstantiates_after_trap(tmp_path):
     bodies = [e.body for d in got for e in decode_events(d)]
     assert 0 in bodies or {"0": 0} not in bodies  # trapped record kept raw
     assert {"fresh": 1} in bodies, bodies
+
+
+def test_saturating_trunc_and_memory_fill():
+    # sat(x f64) -> i32.trunc_sat_f64_s ; fill(d, v, n) via 0xFC 11
+    F64 = 0x7C
+    sat_body = l(0) + b"\xfc\x02"          # i32.trunc_sat_f64_s
+    fill_body = l(0) + l(1) + l(2) + b"\xfc\x0b\x00"
+    m = Module(module(
+        [([F64], [I32]), ([I32, I32, I32], [])],
+        [(0, [], sat_body), (1, [], fill_body)],
+        [("sat", 0, 0), ("fill", 0, 1)]))
+    assert m.call("sat", [3.9]) == [3]
+    assert m.call("sat", [float("nan")]) == [0]
+    assert m.call("sat", [1e300]) == [0x7FFFFFFF]
+    assert m.call("sat", [-1e300]) == [0x80000000]
+    m.call("fill", [10, 0x41, 5])
+    assert bytes(m.memory[10:16]) == b"AAAAA\0"
+
+
+def test_simd_prefix_rejected_at_load():
+    bad = module([([], [])], [(0, [], b"\xfd\x00")], [("f", 0, 0)],
+                 memory_pages=0)
+    with pytest.raises(WasmError, match="SIMD"):
+        Module(bad)
+
+
+def test_memory_limit_enforced():
+    # grow(n) -> memory.grow result
+    body = l(0) + b"\x40\x00"
+    m = Module(module([([I32], [I32])], [(0, [], body)],
+                      [("grow", 0, 0)]), max_memory_bytes=3 * 65536)
+    assert m.call("grow", [1]) == [1]     # 1 page → 2, under the cap
+    assert m.call("grow", [10]) == [0xFFFFFFFF]  # over the 3-page cap
+
+
+def test_filter_wasm_survives_stack_underflow(tmp_path):
+    """An invalid module raising a raw Python error (drop on empty
+    stack) must keep the record, not leak the exception."""
+    bad_body = b"\x1a"  # drop with nothing on the stack → IndexError
+    mod_bytes = module([([I32] * 6, [I32])], [(0, [], bad_body)],
+                       [("go", 0, 0)])
+    path = tmp_path / "bad.wasm"
+    path.write_bytes(mod_bytes)
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("wasm", match="t", wasm_path=str(path),
+               function_name="go")
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"keep": "me"}))
+        ctx.flush_now()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    bodies = [e.body for d in got for e in decode_events(d)]
+    assert bodies == [{"keep": "me"}]
