@@ -54,7 +54,10 @@ class WasmFilter(FilterPlugin):
             self._binary = f.read()
         try:
             self._module = self._instantiate()
-        except (WasmError, Trap) as e:
+        except Exception as e:
+            # the unvalidated decoder can surface raw Python errors
+            # (IndexError/struct.error) on corrupt files — all of them
+            # mean the same thing at init: unloadable module
             raise ValueError(f"wasm filter: cannot load "
                              f"{self.wasm_path}: {e}")
         exp = self._module.exports.get(self.function_name)

@@ -1,0 +1,270 @@
+"""Kafka wire protocol — the produce path, from scratch.
+
+Reference: plugins/out_kafka links librdkafka; this module speaks the
+broker protocol directly: request framing (4-byte length + header v1),
+Metadata v1 (partition leaders), Produce v3 carrying magic-v2
+RecordBatches (crc32c over the post-crc section, zigzag-varint record
+fields) — the subset a producer needs, kept wire-compatible with real
+brokers (KIP-98 batch format).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from .snappy import crc32c
+
+API_PRODUCE = 0
+API_METADATA = 3
+
+
+class KafkaProtocolError(ValueError):
+    pass
+
+
+# --------------------------------------------------------- primitives
+
+def _str(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    b = s.encode("utf-8")
+    return struct.pack(">h", len(b)) + b
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _varint(n: int) -> bytes:
+    u = _zigzag(n) & 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class _Reader:
+    __slots__ = ("b", "pos")
+
+    def __init__(self, b: bytes):
+        self.b = b
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        v = self.b[self.pos:self.pos + n]
+        if len(v) != n:
+            raise KafkaProtocolError("truncated response")
+        self.pos += n
+        return v
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self.take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self.take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self.take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self.take(8))[0]
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n < 0:
+            return None
+        return self.take(n).decode("utf-8")
+
+    def uvarint(self) -> int:
+        shift = 0
+        result = 0
+        while True:
+            b = self.take(1)[0]
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+
+    def varint(self) -> int:
+        u = self.uvarint()
+        return (u >> 1) ^ -(u & 1)
+
+
+# ----------------------------------------------------------- requests
+
+def request(api_key: int, api_version: int, correlation_id: int,
+            client_id: str, body: bytes) -> bytes:
+    hdr = struct.pack(">hhi", api_key, api_version, correlation_id) \
+        + _str(client_id)
+    payload = hdr + body
+    return struct.pack(">i", len(payload)) + payload
+
+
+def metadata_request(topics: List[str]) -> bytes:
+    body = struct.pack(">i", len(topics))
+    for t in topics:
+        body += _str(t)
+    return body
+
+
+def parse_metadata_response(data: bytes):
+    """v1 → (brokers {node_id: (host, port)},
+             topics {name: {partition: leader_node_id}}, errors)."""
+    r = _Reader(data)
+    brokers: Dict[int, Tuple[str, int]] = {}
+    for _ in range(r.i32()):
+        node = r.i32()
+        host = r.string() or ""
+        port = r.i32()
+        r.string()  # rack
+        brokers[node] = (host, port)
+    r.i32()  # controller id
+    topics: Dict[str, Dict[int, int]] = {}
+    errors: Dict[str, int] = {}
+    for _ in range(r.i32()):
+        terr = r.i16()
+        name = r.string() or ""
+        r.i8()  # is_internal
+        parts: Dict[int, int] = {}
+        for _ in range(r.i32()):
+            perr = r.i16()
+            pid = r.i32()
+            leader = r.i32()
+            for _ in range(r.i32()):
+                r.i32()  # replicas
+            for _ in range(r.i32()):
+                r.i32()  # isr
+            if perr == 0:
+                parts[pid] = leader
+        if terr:
+            # an errored topic (e.g. UNKNOWN_TOPIC during creation)
+            # must NOT enter the cache — callers would stop refreshing
+            errors[name] = terr
+        else:
+            topics[name] = parts
+    return brokers, topics, errors
+
+
+# --------------------------------------------------- record batch v2
+
+def encode_record_batch(records: List[Tuple[Optional[bytes], bytes]],
+                        base_ts_ms: int) -> bytes:
+    """records: [(key|None, value)] → one magic-v2 RecordBatch."""
+    body = bytearray()
+    for i, (key, value) in enumerate(records):
+        rec = bytearray()
+        rec += b"\x00"                       # attributes
+        rec += _varint(0)                    # timestampDelta
+        rec += _varint(i)                    # offsetDelta
+        if key is None:
+            rec += _varint(-1)
+        else:
+            rec += _varint(len(key))
+            rec += key
+        rec += _varint(len(value))
+        rec += value
+        rec += _varint(0)                    # headers
+        body += _varint(len(rec))
+        body += rec
+    n = len(records)
+    # post-crc section: attributes .. records
+    post = struct.pack(">hiqqqhii", 0, n - 1, base_ts_ms, base_ts_ms,
+                       -1, -1, -1, n) + bytes(body)
+    crc = crc32c(post)
+    # batchLength counts from partitionLeaderEpoch onward
+    batch_tail = struct.pack(">ib", -1, 2) \
+        + struct.pack(">I", crc) + post
+    return struct.pack(">q", 0) + struct.pack(">i", len(batch_tail)) \
+        + batch_tail
+
+
+def produce_request(topic_batches: Dict[str, Dict[int, bytes]],
+                    acks: int = 1, timeout_ms: int = 30000) -> bytes:
+    """{topic: {partition: record_set_bytes}} → Produce v3 body."""
+    body = _str(None)  # transactional_id
+    body += struct.pack(">hi", acks, timeout_ms)
+    body += struct.pack(">i", len(topic_batches))
+    for topic, parts in topic_batches.items():
+        body += _str(topic)
+        body += struct.pack(">i", len(parts))
+        for pid, record_set in parts.items():
+            body += struct.pack(">i", pid)
+            body += _bytes(record_set)
+    return body
+
+
+def parse_produce_response(data: bytes):
+    """v3 → [(topic, partition, error_code, base_offset)]."""
+    r = _Reader(data)
+    out = []
+    for _ in range(r.i32()):
+        topic = r.string() or ""
+        for _ in range(r.i32()):
+            pid = r.i32()
+            err = r.i16()
+            base = r.i64()
+            r.i64()  # log_append_time
+            out.append((topic, pid, err, base))
+    r.i32()  # throttle_time
+    return out
+
+
+def parse_response_header(data: bytes) -> Tuple[int, bytes]:
+    """→ (correlation_id, rest)."""
+    if len(data) < 4:
+        raise KafkaProtocolError("short response")
+    return struct.unpack(">i", data[:4])[0], data[4:]
+
+
+# ------------------------------------------ decode (for tests/consumers)
+
+def decode_record_batch(data: bytes):
+    """RecordBatch bytes → (crc_ok, [(key, value, ts_ms)])."""
+    r = _Reader(data)
+    r.i64()  # base offset
+    r.i32()  # batch length
+    r.i32()  # partition leader epoch
+    magic = r.i8()
+    if magic != 2:
+        raise KafkaProtocolError(f"unsupported magic {magic}")
+    crc = struct.unpack(">I", r.take(4))[0]
+    post = data[r.pos:]
+    crc_ok = crc32c(post) == crc
+    r.i16()  # attributes
+    r.i32()  # last offset delta
+    base_ts = r.i64()
+    r.i64()  # max ts
+    r.i64()  # producer id
+    r.i16()  # producer epoch
+    r.i32()  # base sequence
+    n = r.i32()
+    records = []
+    for _ in range(n):
+        r.varint()  # record length
+        r.i8()      # attributes
+        ts_delta = r.varint()
+        r.varint()  # offset delta
+        klen = r.varint()
+        key = bytes(r.take(klen)) if klen >= 0 else None
+        vlen = r.varint()
+        value = bytes(r.take(vlen))
+        for _ in range(r.varint()):  # headers
+            hk = r.varint()
+            r.take(hk)
+            hv = r.varint()
+            if hv >= 0:
+                r.take(hv)
+        records.append((key, value, base_ts + ts_delta))
+    return crc_ok, records

@@ -254,6 +254,13 @@ class Module:
                         # host cap wins over the declared maximum
                         self.mem_max_pages = min(self.mem_max_pages,
                                                  sec.u32())
+                    if n_min > self.mem_max_pages:
+                        # the limit must bind at instantiation too — a
+                        # declared 4GiB minimum is the exact exhaustion
+                        # max_memory_bytes exists to stop
+                        raise WasmError(
+                            f"memory minimum {n_min} pages exceeds the "
+                            f"limit ({self.mem_max_pages} pages)")
                     self.memory = bytearray(n_min * PAGE)
             elif sec_id == 6:  # globals
                 for _ in range(sec.u32()):
@@ -544,7 +551,10 @@ class Module:
             if math.isnan(v):
                 stack.append(0)
                 return
-            t = math.trunc(v)
+            if math.isinf(v):  # saturate, unlike the trapping trunc
+                t = (1 << 62) * 2 if v > 0 else -(1 << 62) * 2
+            else:
+                t = math.trunc(v)
             if signed:
                 lo, hi = -(1 << (bits - 1)), (1 << (bits - 1)) - 1
             else:

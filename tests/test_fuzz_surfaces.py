@@ -11,6 +11,7 @@ HTTP/collectd listen on sockets; config files come from operators).
 import asyncio
 import random
 import string
+import struct
 
 import pytest
 
@@ -294,3 +295,154 @@ def test_fuzz_collectd_parts_parser():
             parse_collectd_packet(rng.randbytes(rng.randrange(120)))
         except (ValueError, KeyError):
             pass
+
+
+# ----------------------------------------- round-3 parser surfaces
+
+def test_fuzz_snappy_decoder():
+    """Remote-write bodies come off the network snappy-compressed —
+    the decoder must reject corruption, never crash or over-allocate."""
+    from fluentbit_tpu.utils import snappy
+
+    rng = random.Random(0xC0FFEE)
+    seeds = [snappy.compress(b"hello world " * 50),
+             snappy.compress(bytes(range(256)) * 20),
+             snappy.frame_compress(b"abc" * 1000)]
+    for i in range(SEED_ROUNDS):
+        data = _mutate(rng, seeds[i % len(seeds)])
+        try:
+            out = snappy.decompress(data)
+            assert len(out) <= (len(data) * 64) // 3 + 64
+        except snappy.SnappyError:
+            pass
+        try:
+            snappy.frame_decompress(data)
+        except snappy.SnappyError:
+            pass
+
+
+def test_fuzz_protobuf_and_write_request():
+    from fluentbit_tpu.plugins.prometheus_remote_write import (
+        decode_write_request, encode_write_request)
+    from fluentbit_tpu.utils import protobuf as pb
+
+    rng = random.Random(0xBEEF)
+    seed = encode_write_request(
+        [([("__name__", "m"), ("a", "b")], [(1.5, 123456)])])
+    for i in range(SEED_ROUNDS):
+        data = _mutate(rng, seed)
+        try:
+            decode_write_request(data)
+        except (pb.ProtobufError, UnicodeDecodeError, ValueError):
+            pass
+
+
+def test_fuzz_mmdb_reader(tmp_path):
+    """GeoIP databases are operator-supplied files; a corrupt one must
+    fail loudly at open or return misses, never crash."""
+    import sys
+    sys.path.insert(0, str(tmp_path.parent))
+    from test_geoip2 import NETS, build_mmdb
+    from fluentbit_tpu.utils.mmdb import MMDBError, MMDBReader
+
+    rng = random.Random(0xDB)
+    seed = build_mmdb(NETS)
+    path = tmp_path / "fuzz.mmdb"
+    for i in range(150):
+        path.write_bytes(_mutate(rng, seed))
+        try:
+            db = MMDBReader(str(path))
+            db.lookup("1.2.3.4")
+            db.get_path("5.6.7.8", ["country", "iso_code"])
+        except (MMDBError, RecursionError, KeyError, TypeError,
+                ValueError, IndexError, struct.error, OverflowError,
+                MemoryError):
+            pass
+
+
+def test_fuzz_wasm_decoder(tmp_path):
+    """Wasm modules are operator-supplied; the decoder must reject
+    corruption at load (WasmError) — never crash or hang."""
+    import sys
+    sys.path.insert(0, str(tmp_path.parent))
+    from test_wasm import filter_module
+    from fluentbit_tpu.wasmrt import Module, Trap, WasmError
+
+    rng = random.Random(0xA5)
+    seed = filter_module()
+    for i in range(SEED_ROUNDS):
+        data = _mutate(rng, seed)
+        try:
+            m = Module(data)
+            # a loadable mutant must also be call-safe
+            if "go" in m.exports and m.exports["go"][0] == "func":
+                try:
+                    m.call("go", [0, 0, 0, 0, 0, 0])
+                except (Trap, IndexError, TypeError, struct.error,
+                        ZeroDivisionError, OverflowError):
+                    pass
+        except (WasmError, IndexError, struct.error,
+                UnicodeDecodeError, RecursionError, MemoryError,
+                OverflowError, ValueError):
+            pass
+
+
+def test_fuzz_lua_parser():
+    """Lua scripts are operator-supplied; malformed source must raise
+    LuaError/LuaSyntaxError from load(), never crash the process."""
+    from fluentbit_tpu.luart import LuaError, LuaRuntime
+    from fluentbit_tpu.luart.lexer import LuaSyntaxError
+
+    rng = random.Random(0x10A)
+    seed = b"""
+function cb(tag, ts, record)
+  local x = string.match(record.log or "", "(%d+)")
+  if x then record.n = tonumber(x) + #record.log end
+  for k, v in pairs(record) do record[k] = v end
+  return 2, ts, record
+end
+"""
+    for i in range(SEED_ROUNDS):
+        src = _mutate(rng, seed).decode("utf-8", "replace")
+        rt = LuaRuntime()
+        try:
+            rt.load(src)
+            if "cb" in rt.globals.vars:
+                from fluentbit_tpu.luart import py_to_lua
+                try:
+                    rt.call("cb", ["t", 1.0,
+                                   py_to_lua({"log": "x123"})])
+                except (LuaError, RecursionError, ZeroDivisionError,
+                        TypeError, ValueError, AttributeError,
+                        IndexError, KeyError, OverflowError):
+                    pass
+        except (LuaError, LuaSyntaxError, RecursionError):
+            pass
+
+
+def test_fuzz_mqtt_packets():
+    """in_mqtt reads length-prefixed packets from the socket; the
+    publish parser must survive arbitrary frames."""
+    from fluentbit_tpu.plugins.in_mqtt import MqttInput
+
+    class _W:
+        def write(self, b):
+            pass
+
+    class _Eng:
+        def input_log_append(self, *a, **k):
+            pass
+
+    rng = random.Random(0x30)
+    plugin = MqttInput.__new__(MqttInput)
+    plugin.payload_key = None
+
+    class _Ins:
+        tag = "t"
+
+    plugin.instance = _Ins()
+    seed = b"\x00\x0csensors/temp" + b'{"temp": 21.5}'
+    for i in range(SEED_ROUNDS):
+        payload = _mutate(rng, seed)
+        for flags in (0, 2, 4):
+            plugin._handle_publish(flags, payload, _W(), _Eng())

@@ -164,7 +164,7 @@ def test_out_nats_stub():
 def test_gated_output_fails_loudly():
     from fluentbit_tpu.core.plugin import registry
 
-    ins = registry.create_output("kafka")
+    ins = registry.create_output("calyptia")
     ins.configure()
-    with pytest.raises(RuntimeError, match="librdkafka"):
+    with pytest.raises(RuntimeError, match="Calyptia"):
         ins.plugin.init(ins, None)
