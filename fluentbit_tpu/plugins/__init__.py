@@ -44,4 +44,5 @@ from . import in_mqtt  # noqa: F401
 from . import filter_geoip2  # noqa: F401
 from . import inputs_system_extra  # noqa: F401
 from . import out_kafka  # noqa: F401
+from . import in_kafka  # noqa: F401
 from . import gated  # noqa: F401

@@ -35,8 +35,6 @@ def _gate(kind, plugin_name: str, runtime: str, hint: str = ""):
     return registry.register(Gated)
 
 
-_gate(InputPlugin, "kafka", "librdkafka (consumer-group protocol)",
-      "the out_kafka producer speaks the wire protocol natively")
 _gate(InputPlugin, "exec_wasi", "WASI (filesystem/clock imports; the "
       "wasmrt interpreter runs only self-contained modules)",
       "the 'exec' input runs native commands")

@@ -1,0 +1,224 @@
+"""in_kafka — native Kafka consumer (simple/partition mode).
+
+Reference: plugins/in_kafka/in_kafka.c (librdkafka consumer; record
+shape in_kafka.c:55-130: {topic, partition, offset, error, key,
+payload}). This build speaks the broker protocol directly: Metadata v1
+→ ListOffsets v1 (initial position) → Fetch v4 polling, decoding
+magic-v2 RecordBatches. Documented divergence: no consumer-group
+coordination (librdkafka's group_id rebalancing needs the group
+protocol) — this is a simple consumer reading every partition of the
+configured topics; ``initial_offset`` picks latest/earliest.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+from ..utils import kafka_protocol as kp
+
+log = logging.getLogger("flb.in_kafka")
+
+
+@registry.register
+class KafkaInput(InputPlugin):
+    name = "kafka"
+    description = "Kafka consumer (native wire protocol, no groups)"
+    server_task_needed = True
+    config_map = [
+        ConfigMapEntry("brokers", "str", default="127.0.0.1:9092"),
+        ConfigMapEntry("topics", "str"),
+        ConfigMapEntry("poll_ms", "int", default=500),
+        ConfigMapEntry("format", "str", default="none",
+                       desc="none | json (parse payloads)"),
+        ConfigMapEntry("initial_offset", "str", default="latest",
+                       desc="latest | earliest"),
+        ConfigMapEntry("client_id", "str", default="fluentbit-tpu"),
+        ConfigMapEntry("group_id", "str",
+                       desc="accepted for parity; group coordination "
+                            "is not implemented (simple consumer)"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.topics:
+            raise ValueError("in_kafka requires 'topics'")
+        self._topics = [t.strip() for t in self.topics.split(",")
+                        if t.strip()]
+        self._brokers: List[Tuple[str, int]] = []
+        for item in (self.brokers or "").split(","):
+            item = item.strip()
+            if item:
+                host, _, port = item.partition(":")
+                self._brokers.append((host, int(port or 9092)))
+        if not self._brokers:
+            raise ValueError("in_kafka: no brokers configured")
+        if self.group_id:
+            log.warning("in_kafka: group_id is accepted but consumer-"
+                        "group coordination is not implemented")
+        self._offsets: Dict[Tuple[str, int], int] = {}
+        self._expected_parts = 0
+        self._corr = 0
+        self._pools: Dict[Tuple[str, int], object] = {}
+
+    def _pool(self, addr):
+        from ..core.upstream import Upstream
+
+        pool = self._pools.get(addr)
+        if pool is None:
+            self._pools[addr] = pool = Upstream(
+                self.instance, addr[0], addr[1], connect_timeout=10.0)
+        return pool
+
+    def exit(self) -> None:
+        for pool in self._pools.values():
+            pool.close()
+        self._pools.clear()
+
+    async def _rpc(self, api: int, version: int, body: bytes) -> bytes:
+        """Pooled request/response (the poll loop runs twice a second
+        — per-RPC TCP churn would defeat the shared keepalive layer)."""
+        self._corr += 1
+        corr = self._corr
+        last: Exception = OSError("no brokers reachable")
+        for addr in self._brokers:
+            pool = self._pool(addr)
+            try:
+                reader, writer, _reused, uses = await pool.get()
+            except (OSError, asyncio.TimeoutError) as e:
+                last = e
+                continue
+            try:
+                writer.write(kp.request(api, version, corr,
+                                        self.client_id or "fbtpu",
+                                        body))
+                await asyncio.wait_for(writer.drain(), 10.0)
+                raw = await asyncio.wait_for(reader.readexactly(4), 10.0)
+                n = int.from_bytes(raw, "big")
+                if n < 4 or n > 64 * 1024 * 1024:
+                    raise kp.KafkaProtocolError("bad response length")
+                payload = await asyncio.wait_for(
+                    reader.readexactly(n), 15.0)
+                got, rest = kp.parse_response_header(payload)
+                if got != corr:
+                    raise kp.KafkaProtocolError("correlation mismatch")
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError,
+                    kp.KafkaProtocolError) as e:
+                pool.release(reader, writer, reusable=False)
+                last = e
+                continue
+            pool.release(reader, writer, reusable=True, use_count=uses)
+            return rest
+        raise last
+
+    async def _bootstrap(self) -> bool:
+        try:
+            rest = await self._rpc(kp.API_METADATA, 1,
+                                   kp.metadata_request(self._topics))
+            _nodes, tops, errors = kp.parse_metadata_response(rest)
+            for t, err in errors.items():
+                log.warning("in_kafka: metadata error %d for %s", err, t)
+            want: Dict[str, List[int]] = {
+                t: sorted(parts) for t, parts in tops.items() if parts
+            }
+            if not want:
+                return False
+            ts = -2 if (self.initial_offset or "latest").lower() \
+                == "earliest" else -1
+            rest = await self._rpc(kp.API_LIST_OFFSETS, 1,
+                                   kp.list_offsets_request(want, ts))
+            for topic, pid, err, off in \
+                    kp.parse_list_offsets_response(rest):
+                if err == 0 and (topic, pid) not in self._offsets:
+                    self._offsets[(topic, pid)] = off
+            self._expected_parts = max(
+                getattr(self, "_expected_parts", 0), len(self._offsets))
+            return bool(self._offsets)
+        except (OSError, asyncio.TimeoutError,
+                kp.KafkaProtocolError) as e:
+            log.debug("in_kafka bootstrap failed: %s", e)
+            return False
+
+    def _emit(self, engine, topic: str, pid: int, base: int,
+              records) -> int:
+        out = bytearray()
+        n = 0
+        fmt = (self.format or "none").lower()
+        for key, value, _ts, delta in records:
+            if value is None:
+                payload: object = None  # tombstone (compacted topics)
+            else:
+                payload = value.decode("utf-8", "replace")
+                if fmt == "json":
+                    try:
+                        payload = json.loads(value)
+                    except ValueError:
+                        pass  # keep the raw string (reference keeps going)
+            body = {
+                "topic": topic,
+                "partition": pid,
+                "offset": base + delta,
+                "error": None,
+                "key": key.decode("utf-8", "replace")
+                if key is not None else None,
+                "payload": payload,
+            }
+            out += encode_event(body, now_event_time())
+            n += 1
+        if n:
+            engine.input_log_append(self.instance, self.instance.tag,
+                                    bytes(out), n)
+        return n
+
+    async def start_server(self, engine) -> None:
+        poll = max(0.05, float(self.poll_ms or 500) / 1000.0)
+        while not await self._bootstrap():
+            await asyncio.sleep(poll)
+        while True:
+            try:
+                parts: Dict[str, List[Tuple[int, int]]] = {}
+                for (topic, pid), off in self._offsets.items():
+                    parts.setdefault(topic, []).append((pid, off))
+                rest = await self._rpc(
+                    kp.API_FETCH, 4,
+                    kp.fetch_request(parts,
+                                     max_wait_ms=int(poll * 1000)))
+                got_any = False
+                for topic, pid, err, _hw, record_set in \
+                        kp.parse_fetch_response(rest):
+                    if err:
+                        log.warning("in_kafka fetch error %d on %s[%d]",
+                                    err, topic, pid)
+                        # stale leadership / trimmed offset: drop the
+                        # position so the next bootstrap re-resolves it
+                        # via Metadata + ListOffsets instead of
+                        # re-fetching the same failure forever
+                        self._offsets.pop((topic, pid), None)
+                        continue
+                    for base, crc_ok, records, next_off in \
+                            kp.iter_record_batches(record_set):
+                        if not crc_ok:
+                            log.warning("in_kafka: CRC mismatch on "
+                                        "%s[%d]@%d", topic, pid, base)
+                            continue
+                        if self._emit(engine, topic, pid, base, records):
+                            got_any = True
+                        # honors lastOffsetDelta (compacted batches)
+                        self._offsets[(topic, pid)] = next_off
+                if not got_any:
+                    await asyncio.sleep(poll)
+                if len(self._offsets) < self._expected_parts:
+                    # partitions dropped by fetch errors re-resolve
+                    # through a fresh Metadata + ListOffsets pass
+                    await self._bootstrap()
+            except asyncio.CancelledError:
+                raise
+            except (OSError, asyncio.TimeoutError,
+                    kp.KafkaProtocolError) as e:
+                log.debug("in_kafka poll failed: %s", e)
+                await asyncio.sleep(poll)

@@ -231,7 +231,10 @@ def parse_response_header(data: bytes) -> Tuple[int, bytes]:
 # ------------------------------------------ decode (for tests/consumers)
 
 def decode_record_batch(data: bytes):
-    """RecordBatch bytes → (crc_ok, [(key, value, ts_ms)])."""
+    """RecordBatch bytes → (crc_ok, [(key, value|None, ts_ms,
+    offset_delta)], last_offset_delta). value None = tombstone
+    (compacted topics); offset deltas matter on compacted batches where
+    records were removed."""
     r = _Reader(data)
     r.i64()  # base offset
     r.i32()  # batch length
@@ -243,7 +246,7 @@ def decode_record_batch(data: bytes):
     post = data[r.pos:]
     crc_ok = crc32c(post) == crc
     r.i16()  # attributes
-    r.i32()  # last offset delta
+    last_offset_delta = r.i32()
     base_ts = r.i64()
     r.i64()  # max ts
     r.i64()  # producer id
@@ -255,16 +258,105 @@ def decode_record_batch(data: bytes):
         r.varint()  # record length
         r.i8()      # attributes
         ts_delta = r.varint()
-        r.varint()  # offset delta
+        offset_delta = r.varint()
         klen = r.varint()
         key = bytes(r.take(klen)) if klen >= 0 else None
         vlen = r.varint()
-        value = bytes(r.take(vlen))
+        value = bytes(r.take(vlen)) if vlen >= 0 else None  # tombstone
         for _ in range(r.varint()):  # headers
             hk = r.varint()
             r.take(hk)
             hv = r.varint()
             if hv >= 0:
                 r.take(hv)
-        records.append((key, value, base_ts + ts_delta))
-    return crc_ok, records
+        records.append((key, value, base_ts + ts_delta, offset_delta))
+    return crc_ok, records, last_offset_delta
+
+
+# ------------------------------------------------ consumer-side APIs
+
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+
+
+def list_offsets_request(parts: Dict[str, List[int]],
+                         timestamp: int = -1) -> bytes:
+    """v1 body: -1 = latest, -2 = earliest."""
+    body = struct.pack(">i", -1)  # replica id
+    body += struct.pack(">i", len(parts))
+    for topic, pids in parts.items():
+        body += _str(topic)
+        body += struct.pack(">i", len(pids))
+        for pid in pids:
+            body += struct.pack(">iq", pid, timestamp)
+    return body
+
+
+def parse_list_offsets_response(data: bytes):
+    """v1 → [(topic, partition, error, offset)]."""
+    r = _Reader(data)
+    out = []
+    for _ in range(r.i32()):
+        topic = r.string() or ""
+        for _ in range(r.i32()):
+            pid = r.i32()
+            err = r.i16()
+            r.i64()  # timestamp
+            off = r.i64()
+            out.append((topic, pid, err, off))
+    return out
+
+
+def fetch_request(parts: Dict[str, List[Tuple[int, int]]],
+                  max_wait_ms: int = 500, min_bytes: int = 1,
+                  max_bytes: int = 4 * 1024 * 1024) -> bytes:
+    """v4 body; parts: {topic: [(partition, fetch_offset)]}."""
+    body = struct.pack(">iiiib", -1, max_wait_ms, min_bytes,
+                       max_bytes, 0)
+    body += struct.pack(">i", len(parts))
+    for topic, plist in parts.items():
+        body += _str(topic)
+        body += struct.pack(">i", len(plist))
+        for pid, off in plist:
+            body += struct.pack(">iqi", pid, off, max_bytes)
+    return body
+
+
+def parse_fetch_response(data: bytes):
+    """v4 → [(topic, partition, error, high_watermark, record_set)]."""
+    r = _Reader(data)
+    r.i32()  # throttle
+    out = []
+    for _ in range(r.i32()):
+        topic = r.string() or ""
+        for _ in range(r.i32()):
+            pid = r.i32()
+            err = r.i16()
+            hw = r.i64()
+            r.i64()  # last stable offset
+            for _ in range(r.i32()):  # aborted txns
+                r.i64()
+                r.i64()
+            blen = r.i32()
+            record_set = r.take(blen) if blen > 0 else b""
+            out.append((topic, pid, err, hw, bytes(record_set)))
+    return out
+
+
+def iter_record_batches(record_set: bytes):
+    """A fetch record_set may concatenate several RecordBatches; yield
+    (base_offset, crc_ok, records, next_offset) per batch —
+    next_offset honors lastOffsetDelta, NOT len(records), so compacted
+    batches (records removed mid-batch) still advance correctly."""
+    pos = 0
+    n = len(record_set)
+    while pos + 17 <= n:
+        base_offset = struct.unpack_from(">q", record_set, pos)[0]
+        batch_len = struct.unpack_from(">i", record_set, pos + 8)[0]
+        end = pos + 12 + batch_len
+        if batch_len <= 0 or end > n:
+            return  # partial batch at the tail (broker may truncate)
+        crc_ok, records, last_delta = \
+            decode_record_batch(record_set[pos:end])
+        yield base_offset, crc_ok, records, base_offset + last_delta + 1
+        pos = end

@@ -552,7 +552,8 @@ class Module:
                 stack.append(0)
                 return
             if math.isinf(v):  # saturate, unlike the trapping trunc
-                t = (1 << 62) * 2 if v > 0 else -(1 << 62) * 2
+                # sentinel beyond EVERY type's range (u64 max included)
+                t = (1 << 64) if v > 0 else -(1 << 64)
             else:
                 t = math.trunc(v)
             if signed:

@@ -199,9 +199,9 @@ def test_cloudwatch_logs_format(monkeypatch):
 def test_gated_plugins_fail_loudly():
     from fluentbit_tpu.core.plugin import registry
 
-    ins = registry.create_input("kafka")
+    ins = registry.create_input("ebpf")
     ins.configure()
-    with pytest.raises(RuntimeError, match="librdkafka"):
+    with pytest.raises(RuntimeError, match="libbpf"):
         ins.plugin.init(ins, None)
 
 

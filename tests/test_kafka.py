@@ -23,6 +23,8 @@ class StubBroker:
         self.n_partitions = n_partitions
         self.produce_error = produce_error
         self.produced = []  # (topic, partition, crc_ok, records)
+        # consumer-side log: {(topic, pid): [batch_bytes]}
+        self.log = {}
         self.sock = socket.socket()
         self.sock.bind(("127.0.0.1", 0))
         self.sock.listen(8)
@@ -76,6 +78,10 @@ class StubBroker:
                     resp = self._metadata(body)
                 elif api == kp.API_PRODUCE:
                     resp = self._produce(body)
+                elif api == kp.API_LIST_OFFSETS:
+                    resp = self._list_offsets(body)
+                elif api == kp.API_FETCH:
+                    resp = self._fetch(body)
                 else:
                     return
                 out = struct.pack(">i", corr) + resp
@@ -114,7 +120,9 @@ class StubBroker:
                 pid = r.i32()
                 blen = r.i32()
                 batch = r.take(blen)
-                crc_ok, records = kp.decode_record_batch(batch)
+                crc_ok, records, _last = kp.decode_record_batch(batch)
+                # producer-side views keep the (key, value, ts) shape
+                records = [(k, v, ts) for k, v, ts, _d in records]
                 self.produced.append((topic, pid, crc_ok, records))
                 parts.append(pid)
             resp_topics.append((topic, parts))
@@ -125,6 +133,72 @@ class StubBroker:
                 out += struct.pack(">ihqq", pid, self.produce_error,
                                    0, -1)
         out += struct.pack(">i", 0)  # throttle
+        return out
+
+    def append_log(self, topic, pid, records, base=None):
+        """Make records fetchable (the broker-side log)."""
+        key = (topic, pid)
+        batches = self.log.setdefault(key, [])
+        if base is None:
+            base = sum(len(kp.decode_record_batch(b)[1])
+                       for _o, b in batches)
+        raw = kp.encode_record_batch(records, 1700000000000)
+        # stamp the real base offset into the batch header
+        raw = struct.pack(">q", base) + raw[8:]
+        batches.append((base, raw))
+
+    def _next_offset(self, topic, pid):
+        batches = self.log.get((topic, pid), [])
+        if not batches:
+            return 0
+        base, raw = batches[-1]
+        return base + kp.decode_record_batch(raw)[2] + 1
+
+    def _list_offsets(self, body):
+        r = kp._Reader(body)
+        r.i32()  # replica
+        topics = []
+        for _ in range(r.i32()):
+            t = r.string()
+            plist = []
+            for _ in range(r.i32()):
+                pid = r.i32()
+                ts = r.i64()
+                plist.append((pid, ts))
+            topics.append((t, plist))
+        out = struct.pack(">i", len(topics))
+        for t, plist in topics:
+            out += kp._str(t) + struct.pack(">i", len(plist))
+            for pid, ts in plist:
+                off = 0 if ts == -2 else self._next_offset(t, pid)
+                out += struct.pack(">ihqq", pid, 0, -1, off)
+        return out
+
+    def _fetch(self, body):
+        r = kp._Reader(body)
+        r.i32(); r.i32(); r.i32(); r.i32(); r.i8()
+        topics = []
+        for _ in range(r.i32()):
+            t = r.string()
+            plist = []
+            for _ in range(r.i32()):
+                pid = r.i32()
+                off = r.i64()
+                r.i32()  # partition max bytes
+                plist.append((pid, off))
+            topics.append((t, plist))
+        out = struct.pack(">i", 0)  # throttle
+        out += struct.pack(">i", len(topics))
+        for t, plist in topics:
+            out += kp._str(t) + struct.pack(">i", len(plist))
+            for pid, off in plist:
+                record_set = b"".join(
+                    raw for base, raw in self.log.get((t, pid), [])
+                    if base >= off)
+                hw = self._next_offset(t, pid)
+                out += struct.pack(">ihqq", pid, 0, hw, -1)
+                out += struct.pack(">i", 0)  # aborted txns
+                out += struct.pack(">i", len(record_set)) + record_set
         return out
 
     def close(self):
@@ -146,10 +220,10 @@ def wait_for(cond, timeout=8.0):
 def test_record_batch_roundtrip():
     batch = kp.encode_record_batch(
         [(b"k1", b"v1"), (None, b"v2")], 1700000000000)
-    crc_ok, records = kp.decode_record_batch(batch)
-    assert crc_ok
-    assert records == [(b"k1", b"v1", 1700000000000),
-                       (None, b"v2", 1700000000000)]
+    crc_ok, records, last_delta = kp.decode_record_batch(batch)
+    assert crc_ok and last_delta == 1
+    assert records == [(b"k1", b"v1", 1700000000000, 0),
+                       (None, b"v2", 1700000000000, 1)]
 
 
 def test_out_kafka_produces_json():
@@ -271,3 +345,57 @@ def test_out_kafka_requires_topics():
     with pytest.raises(Exception):
         ctx.start()
     ctx.stop()
+
+
+def test_in_kafka_consumes_from_latest():
+    from fluentbit_tpu.codec.events import decode_events
+
+    broker = StubBroker(n_partitions=2)
+    broker.append_log("logs", 0, [(None, b"old-before-subscribe")])
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("kafka", tag="k", brokers=f"127.0.0.1:{broker.port}",
+              topics="logs", poll_ms="100", format="json")
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        time.sleep(0.6)  # let it bootstrap at LATEST (past the old rec)
+        broker.append_log("logs", 0,
+                          [(b"key1", json.dumps({"n": 1}).encode())],
+                          base=1)
+        broker.append_log("logs", 1, [(None, b"plain text")], base=0)
+        wait_for(lambda: sum(len(decode_events(d)) for d in got) >= 2)
+    finally:
+        ctx.stop()
+        broker.close()
+    evs = [e.body for d in got for e in decode_events(d)]
+    by_part = {e["partition"]: e for e in evs}
+    assert by_part[0]["payload"] == {"n": 1}       # format json parsed
+    assert by_part[0]["key"] == "key1"
+    assert by_part[0]["offset"] == 1
+    assert by_part[1]["payload"] == "plain text"   # non-JSON kept raw
+    assert all(e["topic"] == "logs" for e in evs)
+    assert all(e["error"] is None for e in evs)
+    # the pre-subscribe record was skipped (initial_offset latest)
+    assert not any(e["offset"] == 0 and e["partition"] == 0 for e in evs)
+
+
+def test_in_kafka_earliest_reads_backlog():
+    from fluentbit_tpu.codec.events import decode_events
+
+    broker = StubBroker(n_partitions=1)
+    broker.append_log("logs", 0, [(None, b"one"), (None, b"two")])
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("kafka", tag="k", brokers=f"127.0.0.1:{broker.port}",
+              topics="logs", poll_ms="100", initial_offset="earliest")
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        wait_for(lambda: sum(len(decode_events(d)) for d in got) >= 2)
+    finally:
+        ctx.stop()
+        broker.close()
+    evs = [e.body for d in got for e in decode_events(d)]
+    assert [e["payload"] for e in evs[:2]] == ["one", "two"]
+    assert [e["offset"] for e in evs[:2]] == [0, 1]
