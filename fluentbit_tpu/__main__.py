@@ -207,11 +207,35 @@ def main(argv=None) -> int:
             # NEW configuration with the full original argv BEFORE the
             # old pipeline is torn down — a broken edit must not kill a
             # working service
-            log.info("reloading configuration %s", config_path)
+            # in_calyptia_fleet hands the engine a NEW config path to
+            # reload onto (reference do_reload swaps conf_path_file,
+            # in_calyptia_fleet.c:610-628)
+            override = getattr(ctx.engine, "reload_config_path", None)
+            # consume the override: a failed fleet revision must not
+            # hijack later operator-initiated reloads
+            ctx.engine.reload_config_path = None
+            reload_argv = argv
+            if override:
+                reload_argv = list(argv)
+                slots = [j + 1 for j, a in enumerate(reload_argv)
+                         if a in ("-c", "--config")
+                         and j + 1 < len(reload_argv)]
+                if len(slots) == 1:
+                    reload_argv[slots[0]] = override
+                else:
+                    # -c applies cumulatively: substituting a fleet
+                    # path into several slots would double-apply it
+                    log.warning(
+                        "fleet config %s ignored: need exactly one "
+                        "-c/--config on the command line (found %d)",
+                        override, len(slots))
+                    override = None
+                    reload_argv = argv
+            log.info("reloading configuration %s", override or config_path)
             reload_req.clear()
             stop_evt.clear()
             try:
-                new_ctx, *_ = build_context(argv)
+                new_ctx, *_ = build_context(reload_argv)
                 ok = bool(new_ctx.engine.inputs and new_ctx.engine.outputs)
             except (SystemExit, Exception) as e:  # noqa: BLE001
                 log.error("reload failed, keeping current pipeline: %s", e)
@@ -220,6 +244,12 @@ def main(argv=None) -> int:
                 log.error("reload failed, keeping current pipeline: "
                           "needs at least one input and one output")
                 continue
+            # commit the fleet override only once it VALIDATED — a
+            # broken fleet revision must not hijack later reloads of
+            # the operator's known-good config
+            if override:
+                argv = reload_argv
+                config_path = override
             log.info("stopping old pipeline (grace %ss)...",
                      ctx.engine.service.grace)
             ctx.stop()

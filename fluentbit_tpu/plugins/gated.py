@@ -11,7 +11,6 @@ gates in utils).
 from __future__ import annotations
 
 from ..core.plugin import (
-    CustomPlugin,
     FilterPlugin,
     InputPlugin,
     OutputPlugin,
@@ -35,11 +34,7 @@ def _gate(kind, plugin_name: str, runtime: str, hint: str = ""):
     return registry.register(Gated)
 
 
-_gate(InputPlugin, "exec_wasi", "WASI (filesystem/clock imports; the "
-      "wasmrt interpreter runs only self-contained modules)",
-      "the 'exec' input runs native commands")
 _gate(FilterPlugin, "tensorflow", "TensorFlow Lite")
-_gate(FilterPlugin, "nightfall", "the Nightfall DLP API (network)")
 _gate(InputPlugin, "ebpf", "libbpf CO-RE")
 _gate(InputPlugin, "systemd", "libsystemd (journald)")
 _gate(InputPlugin, "winlog", "the Windows Event Log API")
@@ -50,13 +45,4 @@ _gate(InputPlugin, "windows_exporter_metrics",
 _gate(InputPlugin, "etw", "Event Tracing for Windows")
 # in_stream_processor is not gated: CREATE STREAM results re-ingest
 # through the hidden emitter already (stream_processor/__init__.py)
-_gate(OutputPlugin, "calyptia", "the Calyptia Cloud ingestion API")
 _gate(OutputPlugin, "zig_demo", "the Zig native-plugin ABI demo")
-
-_gate(CustomPlugin, "calyptia",
-      "the Calyptia Cloud control plane (remote fleet management API)",
-      "the custom-plugin machinery itself is live: see "
-      "tests/test_misc_tail3.py for a programmatic custom")
-_gate(InputPlugin, "serial", "a serial port (termios device access)")
-_gate(InputPlugin, "calyptia_fleet",
-      "the Calyptia Cloud control plane")

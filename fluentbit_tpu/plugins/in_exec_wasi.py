@@ -1,0 +1,128 @@
+"""in_exec_wasi — run a WASI command module and ingest its stdout.
+
+Reference: plugins/in_exec_wasi/in_exec_wasi.c. Each collection tick
+instantiates the module and runs ``_start`` with stdout redirected to
+a capture buffer (the reference points the WAMR instance's stdoutfd at
+a tmpfile, in_exec_wasi.c:54-96); afterwards every stdout line is
+parsed with the configured parser (in_exec_wasi.c:99-152) or ingested
+as ``{"wasi_stdout": <line>}`` (in_exec_wasi.c:157-174). ``oneshot``
+runs exactly once; ``wasm_heap_size``/``wasm_stack_size`` bound the
+instance like filter_wasm's. The guest runs on the from-scratch
+wasmrt interpreter with its WASI preview1 host surface
+(`wasmrt/wasi.py`) — no filesystem preopens (``accessible_paths`` is
+accepted for config parity but the sandbox exposes no host paths).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry, parse_size
+from ..core.plugin import InputPlugin, registry
+from ..wasmrt import Module, Trap, WasmError
+from ..wasmrt.wasi import WasiEnv, WasiExit
+
+log = logging.getLogger("flb.exec_wasi")
+
+
+@registry.register
+class ExecWasiInput(InputPlugin):
+    name = "exec_wasi"
+    description = "Exec WASI Input"
+    config_map = [
+        ConfigMapEntry("wasi_path", "str"),
+        ConfigMapEntry("accessible_paths", "clist", default="."),
+        ConfigMapEntry("parser", "str"),
+        ConfigMapEntry("interval_sec", "int", default=1),
+        ConfigMapEntry("interval_nsec", "int", default=0),
+        ConfigMapEntry("wasm_heap_size", "size", default="8192k"),
+        ConfigMapEntry("wasm_stack_size", "size", default="8192k"),
+        ConfigMapEntry("buf_size", "size", default="8192"),
+        ConfigMapEntry("oneshot", "bool", default=False),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.wasi_path:
+            raise ValueError("exec_wasi: no input 'command' was given")
+        with open(self.wasi_path, "rb") as f:
+            self._binary = f.read()
+        # instantiate once up front so a broken module fails at init
+        self._instantiate()
+        self._ins = instance
+        self._parser = None
+        if self.parser:
+            self._parser = (engine.parsers.get(self.parser)
+                            if engine is not None else None)
+            if self._parser is None:
+                log.error("exec_wasi: requested parser '%s' not found",
+                          self.parser)
+        interval = max(0, int(self.interval_sec)) + \
+            max(0, int(self.interval_nsec)) / 1e9
+        self.collect_interval = interval if interval > 0 else 1.0
+        self._done = False
+
+    def _instantiate(self):
+        wasi = WasiEnv(args=[self.wasi_path])
+        mod = Module(
+            self._binary,
+            max_memory_bytes=parse_size(self.wasm_heap_size),
+            max_call_depth=max(64, parse_size(self.wasm_stack_size)
+                               // 4096),
+            host_imports=wasi.imports(),
+        )
+        return mod, wasi
+
+    def collect(self, engine) -> None:
+        if self._done:
+            return
+        if self.oneshot:
+            self._done = True
+        try:
+            mod, wasi = self._instantiate()
+        except (WasmError, Trap) as e:
+            log.error("exec_wasi: instantiation failed: %s", e)
+            return
+        try:
+            if "_start" in mod.exports:
+                mod.call("_start", [])
+            else:
+                log.error("exec_wasi: module has no _start export")
+                return
+        except WasiExit as e:
+            if e.code != 0:
+                log.warning("exec_wasi: guest exited with code %d",
+                            e.code)
+        except (Trap, WasmError) as e:
+            log.error("exec_wasi: guest trapped: %s", e)
+            return
+        except Exception as e:  # noqa: BLE001 — same containment
+            # stance as filter_wasm: a guest must never take the
+            # collector down (RecursionError from deep wasm recursion,
+            # struct.error from a bad pointer, ...)
+            log.error("exec_wasi: guest error: %r", e)
+            return
+        self._ingest_stdout(engine, bytes(wasi.stdout))
+
+    def _ingest_stdout(self, engine, data: bytes) -> None:
+        if not data:
+            return
+        buf_max = parse_size(self.buf_size)
+        events = []
+        for line in data.splitlines():
+            if not line:
+                continue
+            line = line[:buf_max]
+            text = line.decode("utf-8", "replace")
+            if self._parser is not None:
+                got = self._parser.do(text)
+                if got is not None:
+                    fields, ts = got
+                    events.append(encode_event(
+                        fields, ts if ts else now_event_time()))
+                    continue
+            events.append(encode_event({"wasi_stdout": text},
+                                       now_event_time()))
+        if events:
+            engine.input_log_append(self._ins, self._ins.tag,
+                                    b"".join(events), len(events))

@@ -1,0 +1,195 @@
+"""in_serial — read records from a serial character device.
+
+Reference: plugins/in_serial/in_serial.c. The device is opened and put
+into raw mode at the configured ``bitrate`` with ``VMIN=min_bytes``
+(in_serial.c:364-378); here the fd is non-blocking and polled by the
+engine's interval collector. Buffer semantics match cb_serial_collect
+(in_serial.c:131-270): a leading NUL (FTDI handshake) or bare CR/LF is
+consumed; with ``separator`` set, the buffer is split on each
+occurrence and every non-empty span becomes ``{"msg": <span>}``; with
+``format json``, concatenated JSON values are decoded incrementally
+(partial values wait for more bytes, invalid input drops the buffer)
+and each value becomes ``{"msg": <value>}``; otherwise every read
+drains the whole buffer into a single ``{"msg": <text>}`` record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..codec.events import encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+
+_BUF_MAX = 32 * 1024  # serial line discipline scale, like SERIAL_BUFFER_SIZE
+
+
+@registry.register
+class SerialInput(InputPlugin):
+    name = "serial"
+    description = "Serial input"
+    collect_interval = 0.05
+    threaded_capable = True
+    config_map = [
+        ConfigMapEntry("file", "str"),
+        ConfigMapEntry("bitrate", "str"),
+        ConfigMapEntry("separator", "str"),
+        ConfigMapEntry("format", "str"),
+        ConfigMapEntry("min_bytes", "int", default=0),
+    ]
+
+    def init(self, instance, engine) -> None:
+        if not self.file:
+            raise ValueError("serial: 'file' is required")
+        if not self.bitrate:
+            raise ValueError("serial: 'bitrate' is required")
+        fmt = (self.format or "").lower()
+        if fmt and fmt not in ("json", "none"):
+            raise ValueError(f"serial: unknown format {self.format!r}")
+        if fmt == "json" and self.separator:
+            # reference: separator wins; format only applies without one
+            fmt = ""
+        self._json = fmt == "json"
+        self._ins = instance
+        self._buf = b""
+        self._fd = os.open(self.file, os.O_RDWR | os.O_NOCTTY
+                           | os.O_NONBLOCK)
+        self._tio_orig = None
+        try:
+            if os.isatty(self._fd):
+                self._setup_termios()
+        except Exception:
+            # a failed instance never gets exit(): close here or leak
+            # one fd per rejected hot-reload validation
+            os.close(self._fd)
+            self._fd = None
+            raise
+
+    def _setup_termios(self) -> None:
+        import termios
+
+        br = int(self.bitrate)
+        speed = getattr(termios, f"B{br}", None)
+        if speed is None:
+            raise ValueError(f"serial: unsupported bitrate {br}")
+        self._tio_orig = termios.tcgetattr(self._fd)
+        tio = termios.tcgetattr(self._fd)
+        # raw 8N1, like the reference's cfmakeraw-style setup
+        tio[0] = 0                      # iflag
+        tio[1] = 0                      # oflag
+        tio[2] = (termios.CS8 | termios.CREAD | termios.CLOCAL)  # cflag
+        tio[3] = 0                      # lflag
+        tio[4] = speed                  # ispeed
+        tio[5] = speed                  # ospeed
+        tio[6][termios.VMIN] = max(0, min(255, self.min_bytes))
+        tio[6][termios.VTIME] = 0
+        termios.tcsetattr(self._fd, termios.TCSANOW, tio)
+
+    def exit(self) -> None:
+        if self._fd is not None:
+            if self._tio_orig is not None:
+                try:
+                    import termios
+                    termios.tcsetattr(self._fd, termios.TCSANOW,
+                                      self._tio_orig)
+                except (OSError, termios.error):
+                    pass
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+
+    def collect(self, engine) -> None:
+        while True:
+            try:
+                data = os.read(self._fd, _BUF_MAX)
+            except BlockingIOError:
+                break
+            except OSError:
+                return
+            if not data:
+                break
+            self._buf += data
+            self._drain(engine)
+            if len(self._buf) >= _BUF_MAX:
+                # no record boundary found and no more space: drop, the
+                # reference resets buf_len the same way (in_serial.c:220)
+                self._buf = b""
+        self._drain(engine)
+
+    def _emit(self, engine, bodies) -> None:
+        if not bodies:
+            return
+        ts = now_event_time()
+        buf = b"".join(encode_event({"msg": b}, ts) for b in bodies)
+        engine.input_log_append(self._ins, self._ins.tag, buf, len(bodies))
+
+    def _drain(self, engine) -> None:
+        # FTDI handshake NUL / stray leading CR-LF removal
+        while self._buf[:1] in (b"\x00", b"\r", b"\n"):
+            self._buf = self._buf[1:]
+        if not self._buf:
+            return
+        bodies = []
+        if self.separator:
+            sep = self.separator.encode()
+            while True:
+                pos = self._buf.find(sep)
+                if pos < 0:
+                    break
+                if pos > 0:
+                    bodies.append(
+                        self._buf[:pos].decode("utf-8", "replace"))
+                self._buf = self._buf[pos + len(sep):]
+        elif self._json:
+            dec = json.JSONDecoder()
+            # decode a strict UTF-8 prefix so a multi-byte character
+            # split across reads survives in the byte remainder — text
+            # is ALWAYS strictly decoded, keeping the char→byte
+            # mapping exact for the consumed-bytes arithmetic below
+            try:
+                text = self._buf.decode("utf-8")
+                prefix_bytes = len(self._buf)
+                hard_invalid = False
+            except UnicodeDecodeError as e:
+                text = self._buf[:e.start].decode("utf-8")
+                prefix_bytes = e.start
+                # within the last 3 bytes = possibly a truncated tail;
+                # earlier = a hard-invalid byte (never valid JSON)
+                hard_invalid = e.start < len(self._buf) - 3
+            at = 0
+            while at < len(text):
+                while at < len(text) and text[at] in " \t\r\n":
+                    at += 1
+                if at >= len(text):
+                    break
+                try:
+                    value, end = dec.raw_decode(text, at)
+                except ValueError:
+                    head = text[at:].lstrip()
+                    if hard_invalid or (
+                            head and head[0] not in
+                            "{[\"-0123456789tfn"):
+                        # cannot ever become valid JSON: drop buffer
+                        self._buf = b""
+                        self._emit(engine, bodies)
+                        return
+                    # else: partial value — wait for more bytes
+                    break
+                bodies.append(value)
+                at = end
+            if at >= len(text):
+                if hard_invalid:
+                    # everything up to the bad byte parsed; the bad
+                    # byte itself can never become valid JSON
+                    self._buf = b""
+                else:
+                    self._buf = self._buf[prefix_bytes:]
+            else:
+                self._buf = self._buf[len(text[:at].encode("utf-8")):]
+        else:
+            bodies.append(self._buf.decode("utf-8", "replace"))
+            self._buf = b""
+        self._emit(engine, bodies)

@@ -120,10 +120,36 @@ class HttpServerInputBase(InputPlugin):
                        body):  # pragma: no cover
         raise NotImplementedError
 
+    # subclasses that own their Content-Encoding handling (prometheus
+    # remote-write's mandatory snappy) opt out of base decoding
+    decode_content = True
+
+    def _decode_content(self, headers, body):
+        """Transparent request-body decompression (reference in_http
+        rides flb_http_server's gzip/zstd/snappy handling). Returns
+        the decoded body or None for an undecodable payload."""
+        if not self.decode_content:
+            return body
+        algo = headers.get("content-encoding", "").lower()
+        if not algo or not body:
+            return body
+        if algo not in ("gzip", "zstd", "snappy", "deflate"):
+            return body  # unknown encoding: hand through untouched
+        from ..utils import decompress
+        try:
+            return decompress(algo, body)
+        except Exception:  # zlib.error, EOFError, CompressionError, ...
+            # any undecodable body answers 400, never a dropped
+            # connection or an unhandled task error
+            return None
+
     async def start_server(self, engine) -> None:
         from ..core.tls import server_context
 
         async def h2_handler(method, path, headers, body):
+            body = self._decode_content(headers, body)
+            if body is None:
+                return 400, b"bad content encoding\n", self.content_type
             try:
                 status, resp = self.handle_request(
                     engine, method, path.split("?")[0], headers, body)
@@ -162,15 +188,19 @@ class HttpServerInputBase(InputPlugin):
                             log.debug("h2c connection error",
                                       exc_info=True)
                         break
-                    try:
-                        status, resp = self.handle_request(
-                            engine, method, uri.split("?")[0], headers,
-                            body,
-                        )
-                    except Exception:
-                        log.exception("%s request handler failed",
-                                      self.name)
-                        status, resp = 500, b"{}"
+                    decoded = self._decode_content(headers, body)
+                    if decoded is None:
+                        status, resp = 400, b"bad content encoding\n"
+                    else:
+                        try:
+                            status, resp = self.handle_request(
+                                engine, method, uri.split("?")[0],
+                                headers, decoded,
+                            )
+                        except Exception:
+                            log.exception("%s request handler failed",
+                                          self.name)
+                            status, resp = 500, b"{}"
                     if method == "HEAD":
                         resp = b""  # RFC 9110: HEAD carries no body
                     writer.write(http_response(status, resp,
@@ -248,6 +278,14 @@ class HttpOutput(_HttpDeliveryOutput):
         ConfigMapEntry("compress", "str"),
     ]
 
+    def init(self, instance, engine) -> None:
+        algo = (self.compress or "").lower()
+        if algo in ("gzip", "snappy", "zstd"):
+            from ..utils import compression_available
+            if not compression_available(algo):
+                raise ValueError(f"http: {algo} codec unavailable on "
+                                 "this host")
+
     def _fmt(self) -> str:
         # the `format` OPTION collides with the wire-builder method
         # required by the delivery base, so it reads from properties
@@ -261,8 +299,10 @@ class HttpOutput(_HttpDeliveryOutput):
 
     def _headers(self) -> list:
         out = []
-        if (self.compress or "").lower() == "gzip":
-            out.append("Content-Encoding: gzip")
+        algo = (self.compress or "").lower()
+        if algo in ("gzip", "snappy", "zstd"):
+            # reference out_http supports all three (http.c:147-167)
+            out.append(f"Content-Encoding: {algo}")
         for pair in self.header or []:
             parts = pair if isinstance(pair, list) else pair.split(None, 1)
             if len(parts) == 2:
@@ -286,8 +326,9 @@ class HttpOutput(_HttpDeliveryOutput):
                 body = ("[" + text.replace("\n", ",") + "]").encode()
             else:
                 body = (text + "\n").encode()
-        if (self.compress or "").lower() == "gzip":
-            import gzip as _gzip
+        algo = (self.compress or "").lower()
+        if algo in ("gzip", "snappy", "zstd"):
+            from ..utils import compress as _compress
 
-            body = _gzip.compress(body)
+            body = _compress(algo, body)
         return body

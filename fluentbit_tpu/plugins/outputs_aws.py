@@ -93,6 +93,12 @@ class S3Output(OutputPlugin):
     def init(self, instance, engine) -> None:
         if not self.bucket:
             raise ValueError("s3: bucket is required")
+        algo = (self.compression or "").lower()
+        if algo in ("gzip", "zstd"):
+            from ..utils import compression_available
+            if not compression_available(algo):
+                raise ValueError(f"s3: {algo} codec unavailable on "
+                                 "this host")
         self._fstore = FStore(self.store_dir)
         self._stream = self._fstore.stream(f"s3-{instance.name}")
         self._opened: Dict[str, float] = {}  # tag → first-append time
@@ -115,10 +121,11 @@ class S3Output(OutputPlugin):
         return key if key.startswith("/") else "/" + key
 
     async def _upload(self, tag: str, payload: bytes) -> FlushResult:
-        if (self.compression or "").lower() == "gzip":
+        algo = (self.compression or "").lower()
+        if algo in ("gzip", "zstd"):  # reference out_s3 codecs
             from ..utils import compress
 
-            payload = compress("gzip", payload)
+            payload = compress(algo, payload)
         host, port = self._endpoint()
         path = f"/{self.bucket}{self._key_for(tag)}"
         url = f"http://{host}:{port}{path}"

@@ -133,12 +133,32 @@ class KinesisStreamsOutput(_KinesisBase):
         ConfigMapEntry("region", "str", default="us-east-1"),
         ConfigMapEntry("endpoint", "str"),
         ConfigMapEntry("partition_key", "str"),
+        # per-record codec (reference flb_aws_compress: gzip|zstd|snappy,
+        # out_kinesis_streams/kinesis.c "compression" option)
+        ConfigMapEntry("compression", "str"),
     ]
 
     def init(self, instance, engine) -> None:
         super().init(instance, engine)
         if not self.stream:
             raise ValueError("kinesis_streams: stream is required")
+        algo = (self.compression or "").lower()
+        if algo and algo not in ("gzip", "zstd", "snappy"):
+            raise ValueError(
+                f"kinesis_streams: unknown compression {self.compression!r}")
+        if algo:
+            from ..utils import compression_available
+            if not compression_available(algo):
+                raise ValueError(
+                    f"kinesis_streams: {algo} codec unavailable on "
+                    "this host")
+
+    def _encode_record(self, blob: bytes) -> bytes:
+        algo = (self.compression or "").lower()
+        if algo:
+            from ..utils import compress
+            blob = compress(algo, blob)
+        return blob
 
     def _body(self, data: bytes) -> dict:
         records = []
@@ -147,8 +167,8 @@ class KinesisStreamsOutput(_KinesisBase):
             if self.partition_key and isinstance(ev.body, dict):
                 pk = str(ev.body.get(self.partition_key, i))
             records.append({
-                "Data": base64.b64encode(
-                    (_dumps(ev.body) + "\n").encode()).decode(),
+                "Data": base64.b64encode(self._encode_record(
+                    (_dumps(ev.body) + "\n").encode())).decode(),
                 "PartitionKey": pk,
             })
         return {"StreamName": self.stream, "Records": records}

@@ -171,6 +171,7 @@ class PrometheusRemoteWriteInput(HttpServerInputBase):
 
     name = "prometheus_remote_write"
     description = "Prometheus remote-write server"
+    decode_content = False  # snappy framing is part of the protocol
     config_map = [
         ConfigMapEntry("listen", "str", default="0.0.0.0"),
         ConfigMapEntry("port", "int", default=8080),

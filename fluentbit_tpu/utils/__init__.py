@@ -25,7 +25,7 @@ class CompressionError(ValueError):
     pass
 
 
-_GATED = {"zstd", "lz4"}
+_GATED = {"lz4"}
 
 
 def compress(algo: str, data: bytes, level: int = 6) -> bytes:
@@ -38,6 +38,12 @@ def compress(algo: str, data: bytes, level: int = 6) -> bytes:
     if a == "snappy":
         from . import snappy as _snappy
         return _snappy.compress(data)
+    if a == "zstd":
+        from . import zstd as _zstd
+        try:
+            return _zstd.compress(data)
+        except OSError as e:
+            raise CompressionError(f"zstd unavailable: {e}") from e
     if a in _GATED:
         raise CompressionError(
             f"{a} is not available in this build (no vendored codec); "
@@ -55,11 +61,31 @@ def decompress(algo: str, data: bytes) -> bytes:
     if a == "snappy":
         from . import snappy as _snappy
         return _snappy.decompress(data)
+    if a == "zstd":
+        from . import zstd as _zstd
+        try:
+            return _zstd.decompress(data)
+        except OSError as e:
+            raise CompressionError(f"zstd unavailable: {e}") from e
+        except ValueError as e:
+            raise CompressionError(str(e)) from e
     if a in _GATED:
         raise CompressionError(
             f"{a} is not available in this build (no vendored codec)"
         )
     raise CompressionError(f"unknown compression algorithm {algo!r}")
+
+
+def compression_available(algo: str) -> bool:
+    """Init-time probe so a configured codec missing from the host
+    fails at startup, not on every flush."""
+    a = (algo or "").lower()
+    if a in ("gzip", "zlib", "deflate", "snappy"):
+        return True
+    if a == "zstd":
+        from . import zstd as _zstd
+        return _zstd.available()
+    return False
 
 
 def crc32(data: bytes) -> int:
@@ -70,15 +96,38 @@ def plain_http_request(host: str, port: int, method: str, path: str,
                        headers=None, body: bytes = b"",
                        timeout: float = 2.0):
     """Minimal blocking HTTP/1.1 request → (status, body) or None on
-    socket failure. The one shared helper for metadata-style fetches
-    (filter_kubernetes kube_url, filter_aws IMDS, filter_ecs) — the
-    reference funnels these through its flb_http_client."""
-    import socket as _socket
+    socket failure. The shared helper for metadata-style fetches
+    (filter_kubernetes kube_url, filter_aws IMDS, filter_ecs) — a
+    status+body view over sync_http_request."""
+    got = sync_http_request(host, port, method, path, headers=headers,
+                            body=body, timeout=timeout)
+    if got is None:
+        return None
+    status, _hdrs, resp = got
+    return status, resp
 
-    host_hdr = host if port in (80, None) else f"{host}:{port}"
+
+def sync_http_request(host: str, port: int, method: str, path: str,
+                      headers=None, body: bytes = b"", tls: bool = False,
+                      tls_verify: bool = True, timeout: float = 10.0,
+                      max_bytes: int = 64 * 1024 * 1024):
+    """Blocking HTTP/1.1 request with optional TLS →
+    (status, headers_dict, body) or None. The synchronous-upstream
+    analogue (reference flb_stream_disable_async_mode +
+    flb_http_client, used by control-plane style init-time calls:
+    out_calyptia api_agent_create, filter_nightfall scan_log)."""
+    import socket as _socket
+    import ssl as _ssl
+
     try:
         s = _socket.create_connection((host, port), timeout=timeout)
-        req = [f"{method} {path} HTTP/1.1", f"Host: {host_hdr}",
+        if tls:
+            ctx = _ssl.create_default_context()
+            if not tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            s = ctx.wrap_socket(s, server_hostname=host)
+        req = [f"{method} {path} HTTP/1.1", f"Host: {host}:{port}",
                "Connection: close", f"Content-Length: {len(body)}"]
         for k, v in (headers or {}).items():
             req.append(f"{k}: {v}")
@@ -89,11 +138,32 @@ def plain_http_request(host: str, port: int, method: str, path: str,
             if not chunk:
                 break
             data += chunk
+            if len(data) > max_bytes:
+                # a response past the cap is abandoned, not truncated —
+                # callers must never see a silently cut body
+                s.close()
+                return None
         s.close()
         head, _, resp = data.partition(b"\r\n\r\n")
-        status = int(head.split(b" ", 2)[1])
-        return status, resp
-    except (OSError, ValueError, IndexError):
+        lines = head.split(b"\r\n")
+        status = int(lines[0].split(b" ", 2)[1])
+        hdrs = {}
+        for line in lines[1:]:
+            k, _, v = line.partition(b":")
+            hdrs[k.strip().decode("latin-1").lower()] = \
+                v.strip().decode("latin-1")
+        if hdrs.get("transfer-encoding", "").lower() == "chunked":
+            out, rest = b"", resp
+            while rest:
+                size_line, _, rest = rest.partition(b"\r\n")
+                size = int(size_line.split(b";")[0], 16)
+                if size == 0:
+                    break
+                out += rest[:size]
+                rest = rest[size + 2:]
+            resp = out
+        return status, hdrs, resp
+    except (OSError, ValueError, IndexError, _ssl.SSLError):
         return None
 
 

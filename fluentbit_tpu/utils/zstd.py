@@ -1,0 +1,97 @@
+"""zstd codec bound to the system libzstd via ctypes.
+
+Reference: src/flb_zstd.c wraps the vendored lib/zstd with exactly
+this surface (flb_zstd_compress / flb_zstd_uncompress use the simple
+one-shot ZSTD_compress/ZSTD_decompress API, sizing the destination
+with ZSTD_compressBound / ZSTD_getFrameContentSize). This image ships
+libzstd.so.1, so the binding replaces the vendored copy; no Python
+zstd package is required.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+from typing import Optional
+
+_ZSTD_CONTENTSIZE_UNKNOWN = 2 ** 64 - 1
+_ZSTD_CONTENTSIZE_ERROR = 2 ** 64 - 2
+
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    name = ctypes.util.find_library("zstd") or "libzstd.so.1"
+    try:
+        lib = ctypes.CDLL(name)
+    except OSError as e:
+        _load_error = str(e)
+        return None
+    lib.ZSTD_compressBound.restype = ctypes.c_size_t
+    lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+    lib.ZSTD_compress.restype = ctypes.c_size_t
+    lib.ZSTD_compress.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int]
+    lib.ZSTD_decompress.restype = ctypes.c_size_t
+    lib.ZSTD_decompress.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+        ctypes.c_size_t]
+    lib.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+    lib.ZSTD_getFrameContentSize.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t]
+    lib.ZSTD_isError.restype = ctypes.c_uint
+    lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def compress(data: bytes, level: int = 3) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise OSError(f"libzstd unavailable: {_load_error}")
+    bound = lib.ZSTD_compressBound(len(data))
+    dst = ctypes.create_string_buffer(bound)
+    n = lib.ZSTD_compress(dst, bound, data, len(data), level)
+    if lib.ZSTD_isError(n):
+        raise ValueError("zstd compression failed")
+    return dst.raw[:n]
+
+
+def decompress(data: bytes,
+               max_output: int = 256 * 1024 * 1024) -> bytes:
+    """One-shot decompress. Frames without a content-size header fall
+    back to doubling buffers the way flb_zstd_uncompress retries; the
+    expansion is bounded so a hostile frame can't exhaust memory."""
+    lib = _load()
+    if lib is None:
+        raise OSError(f"libzstd unavailable: {_load_error}")
+    size = lib.ZSTD_getFrameContentSize(data, len(data))
+    if size == _ZSTD_CONTENTSIZE_ERROR:
+        raise ValueError("not a zstd frame")
+    if size != _ZSTD_CONTENTSIZE_UNKNOWN:
+        if size > max_output:
+            raise ValueError("zstd content size exceeds limit")
+        dst = ctypes.create_string_buffer(max(1, size))
+        n = lib.ZSTD_decompress(dst, size, data, len(data))
+        if lib.ZSTD_isError(n) or n != size:
+            raise ValueError("zstd decompression failed")
+        return dst.raw[:n]
+    cap = min(max(64 * 1024, 4 * len(data)), max_output)
+    while True:
+        dst = ctypes.create_string_buffer(cap)
+        n = lib.ZSTD_decompress(dst, cap, data, len(data))
+        if not lib.ZSTD_isError(n):
+            return dst.raw[:n]
+        if cap >= max_output:
+            break
+        cap = min(cap * 2, max_output)  # always try the limit itself
+    raise ValueError("zstd decompression failed (or exceeds limit)")

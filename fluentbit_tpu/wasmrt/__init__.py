@@ -191,11 +191,16 @@ class Module:
     """One instantiated module: memory, globals, exported functions."""
 
     def __init__(self, binary: bytes, max_memory_bytes: int = 0,
-                 max_call_depth: int = 256):
+                 max_call_depth: int = 256, host_imports=None):
         """max_memory_bytes caps linear memory growth (memory.grow AND
         the dup_data heap — the wasm_heap_size role); max_call_depth is
-        the wasm_stack_size analogue."""
+        the wasm_stack_size analogue. host_imports maps
+        ``(module, field)`` to a host callable ``fn(mod, *args)``
+        returning a result list (the WASI/native-symbol surface —
+        WAMR's wasm_runtime_register_natives role); without it, any
+        import is rejected (filter modules stay self-contained)."""
         self.max_call_depth = max(16, int(max_call_depth))
+        self.imported: List[tuple] = []  # (callable, type_idx)
         r = _Reader(binary)
         if r.bytes_(4) != b"\0asm":
             raise WasmError("bad magic")
@@ -231,10 +236,16 @@ class Module:
                     mod = sec.name()
                     field = sec.name()
                     kind = sec.u8()
-                    raise WasmError(
-                        f"imports unsupported ({mod}.{field} kind "
-                        f"{kind}) — filter modules must be "
-                        "self-contained (no WASI)")
+                    if kind != 0 or host_imports is None:
+                        raise WasmError(
+                            f"imports unsupported ({mod}.{field} kind "
+                            f"{kind}) — filter modules must be "
+                            "self-contained (no WASI)")
+                    fn = host_imports.get((mod, field))
+                    if fn is None:
+                        raise WasmError(
+                            f"unresolved import {mod}.{field}")
+                    self.imported.append((fn, sec.u32()))
             elif sec_id == 3:  # function decls
                 func_types = [sec.u32() for _ in range(sec.u32())]
             elif sec_id == 4:  # table
@@ -405,7 +416,13 @@ class Module:
     def _invoke(self, fidx: int, args: List[Any], depth: int = 0):
         if depth > self.max_call_depth:
             raise Trap("call stack exhausted")
-        f = self.funcs[fidx]
+        if fidx < len(self.imported):
+            fn, _ti = self.imported[fidx]
+            res = fn(self, *args)
+            if res is None:
+                return []
+            return list(res) if isinstance(res, (list, tuple)) else [res]
+        f = self.funcs[fidx - len(self.imported)]
         locals_ = list(args)
         for vt in f.locals:
             locals_.append(0.0 if vt in (F32, F64) else 0)
@@ -490,7 +507,11 @@ class Module:
                         or self.table[elem] is None:
                     raise Trap("undefined table element")
                 fi = self.table[elem]
-                if self.funcs[fi].type_idx != ti:
+                if fi < len(self.imported):
+                    actual_ti = self.imported[fi][1]
+                else:
+                    actual_ti = self.funcs[fi - len(self.imported)].type_idx
+                if actual_ti != ti:
                     raise Trap("indirect call type mismatch")
                 self._do_call(fi, stack, depth)
             elif op == 0x00:
@@ -580,8 +601,10 @@ class Module:
             raise Trap(f"unsupported misc op {sub}")
 
     def _do_call(self, fidx: int, stack: List[Any], depth: int) -> None:
-        f = self.funcs[fidx]
-        n = len(f.params)
+        if fidx < len(self.imported):
+            n = len(self.types[self.imported[fidx][1]][0])
+        else:
+            n = len(self.funcs[fidx - len(self.imported)].params)
         args = stack[len(stack) - n:] if n else []
         if n:
             del stack[len(stack) - n:]

@@ -1,0 +1,221 @@
+"""Calyptia control plane (out_calyptia / custom_calyptia /
+in_calyptia_fleet) against a local stub of the Cloud API.
+
+Reference: plugins/out_calyptia/calyptia.c,
+plugins/custom_calyptia/calyptia.c,
+plugins/in_calyptia_fleet/in_calyptia_fleet.c."""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.core.plugin import registry
+
+
+class _StubCloud(BaseHTTPRequestHandler):
+    log = []
+    fleet_config = "[INPUT]\n    name dummy\n"
+    fleet_last_modified = "Mon, 02 Jan 2006 15:04:05 GMT"
+
+    def _reply(self, code, body=b"", headers=None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _record(self, body=b""):
+        type(self).log.append({
+            "method": self.command, "path": self.path,
+            "project": self.headers.get("X-Project-Token"),
+            "agent_token": self.headers.get("X-Agent-Token"),
+            "ctype": self.headers.get("Content-Type"),
+            "body": body,
+        })
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self._record(body)
+        if self.path == "/v1/agents":
+            self._reply(200, json.dumps(
+                {"id": "agent-1", "token": "tok-1"}).encode())
+        elif self.path.startswith("/v1/agents/") and \
+                self.path.endswith("/metrics"):
+            self._reply(200)
+        else:
+            self._reply(404)
+
+    def do_PATCH(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self._record(body)
+        self._reply(204)
+
+    def do_GET(self):
+        self._record()
+        if self.path.startswith("/v1/search"):
+            self._reply(200, json.dumps([{"id": "fleet-42"}]).encode())
+        elif "/config" in self.path and self.path.startswith("/v1/fleets/"):
+            self._reply(200, self.fleet_config.encode(),
+                        {"Last-Modified": self.fleet_last_modified})
+        else:
+            self._reply(404)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def cloud():
+    _StubCloud.log = []
+    srv = HTTPServer(("127.0.0.1", 0), _StubCloud)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _make_output(port, **props):
+    ins = registry.create_output("calyptia")
+    ins.set("api_key", "proj-token")
+    ins.set("machine_id", "m-1")
+    ins.set("cloud_host", "127.0.0.1")
+    ins.set("cloud_port", str(port))
+    for k, v in props.items():
+        ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def test_agent_registration_on_init(cloud):
+    port = cloud.server_address[1]
+    plug = _make_output(port)
+    assert plug.agent_id == "agent-1" and plug.agent_token == "tok-1"
+    (reg,) = _StubCloud.log
+    assert reg["method"] == "POST" and reg["path"] == "/v1/agents"
+    assert reg["project"] == "proj-token"
+    meta = json.loads(reg["body"])
+    assert meta["type"] == "fluentbit" and meta["machineID"] == "m-1"
+    assert meta["edition"] == "community" and meta["os"] == "linux"
+
+
+def test_session_reuse_patches_instead_of_registering(cloud, tmp_path):
+    port = cloud.server_address[1]
+    _make_output(port, store_path=str(tmp_path))
+    assert (tmp_path / "session.CALYPTIA").is_file()
+    _StubCloud.log = []
+    plug2 = _make_output(port, store_path=str(tmp_path))
+    assert plug2.agent_id == "agent-1"
+    (patch,) = _StubCloud.log
+    assert patch["method"] == "PATCH"
+    assert patch["path"] == "/v1/agents/agent-1"
+
+
+def test_metrics_flush_carries_agent_token(cloud):
+    import asyncio
+
+    from fluentbit_tpu.codec.msgpack import packb
+    from fluentbit_tpu.core.plugin import FlushResult
+
+    port = cloud.server_address[1]
+    plug = _make_output(port)
+    plug.instance.set("add_label", "pipeline main")
+    plug.instance.configure()
+    plug._labels = [("pipeline", "main")]
+    payload = packb({"meta": {"ts": 1.0}, "metrics": [
+        {"name": "m", "type": "counter", "desc": "", "labels": [],
+         "ts": 1.0, "values": [{"labels": [], "value": 3.0}]}]})
+    res = asyncio.run(plug.flush(payload, "_calyptia_cloud", None))
+    assert res == FlushResult.OK
+    push = _StubCloud.log[-1]
+    assert push["path"] == "/v1/agents/agent-1/metrics"
+    assert push["agent_token"] == "tok-1"
+    assert push["ctype"] == "application/x-msgpack"
+    from fluentbit_tpu.codec.msgpack import unpackb
+    sent = unpackb(push["body"])
+    m = sent["metrics"][0]
+    assert m["labels"] == ["pipeline"]
+    assert m["values"][0]["labels"] == ["main"]
+
+
+def _fleet_api_key():
+    head = base64.b64encode(
+        json.dumps({"ProjectID": "p-9"}).encode()).decode().rstrip("=")
+    return head + ".signature"
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.reload_config_path = None
+        self.reloaded = 0
+
+        def cb():
+            self.reloaded += 1
+        self.reload_callback = cb
+
+
+def _make_fleet(port, tmp_path, **props):
+    ins = registry.create_input("calyptia_fleet")
+    ins.set("api_key", _fleet_api_key())
+    ins.set("host", "127.0.0.1")
+    ins.set("port", str(port))
+    ins.set("config_dir", str(tmp_path))
+    for k, v in props.items():
+        ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def test_fleet_name_resolution_and_reload(cloud, tmp_path):
+    port = cloud.server_address[1]
+    plug = _make_fleet(port, tmp_path, fleet_name="prod",
+                       machine_id="m-1")
+    eng = _FakeEngine()
+    plug.collect(eng)
+    # name → id via /v1/search with the ProjectID from the api_key
+    search = [e for e in _StubCloud.log if e["path"].startswith("/v1/search")]
+    assert search and "project_id=p-9" in search[0]["path"]
+    assert "term=prod" in search[0]["path"]
+    assert plug.fleet_id == "fleet-42"
+    # config fetched, written under config_dir, reload fired
+    assert eng.reloaded == 1
+    assert eng.reload_config_path and eng.reload_config_path.endswith(".conf")
+    with open(eng.reload_config_path) as f:
+        assert f.read() == _StubCloud.fleet_config
+    # same config again → no second reload
+    plug.collect(eng)
+    assert eng.reloaded == 1
+
+
+def test_custom_wires_hidden_pipeline(cloud, tmp_path):
+    port = cloud.server_address[1]
+    ctx = flb.create(flush="100ms", grace="1")
+    ctx.custom("calyptia", api_key=_fleet_api_key(),
+               calyptia_host="127.0.0.1", calyptia_port=str(port),
+               calyptia_tls="off", fleet_id="fleet-42",
+               store_path=str(tmp_path / "store"),
+               fleet_config_dir=str(tmp_path / "fleet"))
+    ctx.output("null", match="nothing")
+    ctx.start()
+    try:
+        deadline = time.time() + 6
+        while time.time() < deadline:
+            if any(e["path"] == "/v1/agents/agent-1/metrics"
+                   for e in _StubCloud.log):
+                break
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    paths = [e["path"] for e in _StubCloud.log]
+    assert "/v1/agents" in paths  # registration happened
+    assert any(p == "/v1/agents/agent-1/metrics" for p in paths)
+    # machine-id was provisioned and persisted
+    assert (tmp_path / "store" / "machine-id").is_file()

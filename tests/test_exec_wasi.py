@@ -1,0 +1,209 @@
+"""in_exec_wasi + the wasmrt WASI preview1 host surface.
+
+The guest module is hand-assembled (independent encoder, like
+tests/test_wasm.py) and imports fd_write/proc_exit from
+wasi_snapshot_preview1 — exercising wasmrt's host-import path end to
+end. Reference: plugins/in_exec_wasi/in_exec_wasi.c."""
+
+import json
+import struct
+import time
+import types
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.wasmrt import Module, WasmError
+from fluentbit_tpu.wasmrt.wasi import WasiEnv, WasiExit
+
+
+def leb(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def sec(sid, body):
+    return bytes([sid]) + leb(len(body)) + body
+
+
+def vec(items):
+    return leb(len(items)) + b"".join(items)
+
+
+def functype(params, results):
+    return b"\x60" + vec([bytes([p]) for p in params]) \
+        + vec([bytes([r]) for r in results])
+
+
+I32 = 0x7F
+
+
+def name(s):
+    return leb(len(s)) + s.encode()
+
+
+def wasi_module(message: bytes) -> bytes:
+    """_start writes `message` to stdout via fd_write, then proc_exit(0).
+
+    Imports (function index space 0/1): fd_write(i32×4)->i32,
+    proc_exit(i32)->(). Local _start is function index 2.
+    Memory layout: iovec at 8 → (base=100, len), message at 100."""
+    out = bytearray(b"\0asm\x01\0\0\0")
+    out += sec(1, vec([
+        functype([I32, I32, I32, I32], [I32]),   # t0: fd_write
+        functype([I32], []),                     # t1: proc_exit
+        functype([], []),                        # t2: _start
+    ]))
+    out += sec(2, vec([
+        name("wasi_snapshot_preview1") + name("fd_write")
+        + b"\x00" + leb(0),
+        name("wasi_snapshot_preview1") + name("proc_exit")
+        + b"\x00" + leb(1),
+    ]))
+    out += sec(3, vec([leb(2)]))            # _start : t2
+    out += sec(5, vec([b"\x00" + leb(1)]))  # 1 page memory
+    out += sec(7, vec([name("_start") + b"\x00" + leb(2)]))
+    body = (b"\x41\x01"        # i32.const 1 (stdout fd)
+            b"\x41\x08"        # i32.const 8 (iovs ptr)
+            b"\x41\x01"        # i32.const 1 (iovs len)
+            b"\x41\x32"        # i32.const 50 (nwritten ptr)
+            b"\x10\x00"        # call fd_write (import 0)
+            b"\x1a"            # drop errno
+            b"\x41\x00"        # i32.const 0
+            b"\x10\x01"        # call proc_exit (import 1)
+            b"\x0b")
+    lb = vec([]) + body
+    out += sec(10, vec([leb(len(lb)) + lb]))
+    iov = struct.pack("<II", 100, len(message))
+    out += sec(11, vec([
+        b"\x00\x41\x08\x0b" + leb(len(iov)) + iov,
+        b"\x00\x41\xe4\x00\x0b" + leb(len(message)) + message,
+    ]))
+    return bytes(out)
+
+
+def test_wasi_module_runs_standalone():
+    wasi = WasiEnv(args=["prog"])
+    mod = Module(wasi_module(b"hello wasi\n"),
+                 host_imports=wasi.imports())
+    with pytest.raises(WasiExit):
+        mod.call("_start", [])
+    assert bytes(wasi.stdout) == b"hello wasi\n"
+    assert wasi.exit_code == 0
+
+
+def test_unresolved_import_fails_loudly():
+    with pytest.raises(WasmError, match="unresolved|import"):
+        Module(wasi_module(b"x"), host_imports={})
+
+
+def test_imports_still_rejected_without_host_table():
+    with pytest.raises(WasmError, match="import"):
+        Module(wasi_module(b"x"))
+
+
+def run_exec_wasi(tmp_path, message: bytes, records: int, **props):
+    wasm = tmp_path / "guest.wasm"
+    wasm.write_bytes(wasi_module(message))
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("exec_wasi", tag="w", wasi_path=str(wasm),
+              interval_sec="0", interval_nsec="100000000", **props)
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while len(got) < records and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    return got
+
+
+def test_exec_wasi_stdout_lines(tmp_path):
+    got = run_exec_wasi(tmp_path, b"first line\nsecond line\n", 2)
+    assert [ev.body["wasi_stdout"] for ev in got[:2]] == [
+        "first line", "second line"]
+
+
+def test_exec_wasi_json_parser(tmp_path):
+    got = []
+    wasm = tmp_path / "guest.wasm"
+    wasm.write_bytes(wasi_module(b'{"level": "info", "n": 7}\n'))
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.parser("wjson", format="json")
+    ctx.input("exec_wasi", tag="w", wasi_path=str(wasm),
+              parser="wjson", oneshot="on")
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    assert got and got[0].body == {"level": "info", "n": 7}
+    # oneshot: no more executions piled up
+    assert len(got) == 1
+
+
+def _fake_mod(pages=1):
+    return types.SimpleNamespace(memory=bytearray(pages * 65536))
+
+
+def test_wasi_args_environ_layout():
+    env = WasiEnv(args=["prog", "arg1"], env={"K": "v"})
+    mod = _fake_mod()
+    assert env._args_sizes_get(mod, 0, 4) == [0]
+    argc, buflen = struct.unpack_from("<II", mod.memory, 0)
+    assert argc == 2 and buflen == len(b"prog\0arg1\0")
+    assert env._args_get(mod, 8, 100) == [0]
+    p0, p1 = struct.unpack_from("<II", mod.memory, 8)
+    assert mod.memory[p0:p0 + 5] == b"prog\0"
+    assert mod.memory[p1:p1 + 5] == b"arg1\0"
+    assert env._environ_sizes_get(mod, 16, 20) == [0]
+    envc, ebuflen = struct.unpack_from("<II", mod.memory, 16)
+    assert envc == 1 and ebuflen == len(b"K=v\0")
+
+
+def test_wasi_fd_read_stdin_and_misc():
+    env = WasiEnv(stdin=b"abcdef")
+    mod = _fake_mod()
+    struct.pack_into("<II", mod.memory, 0, 100, 4)  # iovec base=100 len=4
+    assert env._fd_read(mod, 0, 0, 1, 8) == [0]
+    assert struct.unpack_from("<I", mod.memory, 8)[0] == 4
+    assert mod.memory[100:104] == b"abcd"
+    assert env._fd_read(mod, 0, 0, 1, 8) == [0]  # remaining 2 bytes
+    assert struct.unpack_from("<I", mod.memory, 8)[0] == 2
+    assert env._fd_write(mod, 7, 0, 1, 8) == [8]   # EBADF
+    assert env._fd_seek(mod, 1, 0, 0, 0) == [70]   # ESPIPE
+    assert env._fd_prestat_get(mod, 3, 0) == [8]   # no preopens
+    assert env._clock_time_get(mod, 0, 0, 24) == [0]
+    ns = struct.unpack_from("<Q", mod.memory, 24)[0]
+    assert abs(ns / 1e9 - time.time()) < 5
+    assert env._random_get(mod, 32, 8) == [0]
+
+
+def test_wasi_pointer_bounds_trap():
+    from fluentbit_tpu.wasmrt import Trap
+
+    env = WasiEnv()
+    mod = _fake_mod()
+    with pytest.raises(Trap):
+        env._random_get(mod, len(mod.memory) - 2, 8)
+    with pytest.raises(Trap):
+        env._args_sizes_get(mod, len(mod.memory), 0)
+    # iovec pointing outside memory traps instead of struct.error
+    struct.pack_into("<II", mod.memory, 0, 2 ** 31, 4)
+    with pytest.raises(Trap):
+        env._fd_write(mod, 1, 0, 1, 8)

@@ -61,10 +61,12 @@ def test_custom_plugin_creates_pipeline():
     assert got[0][1].body == {"via": "custom"}
 
 
-def test_calyptia_custom_is_gated():
+def test_calyptia_custom_requires_api_key():
+    # the calyptia custom is real now (tests/test_calyptia.py); a
+    # missing api_key must still fail loudly at startup
     ctx = flb.create()
     ctx.custom("calyptia")
-    with pytest.raises(RuntimeError, match="not vendored"):
+    with pytest.raises(ValueError, match="api_key"):
         ctx.start()
 
 

@@ -1,0 +1,230 @@
+"""filter_nightfall against a local stub of the Nightfall scan API.
+
+Reference semantics: plugins/filter_nightfall/nightfall.c (DFS field
+extraction, key-context joining, byteRange star-redaction)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.core.plugin import FilterResult, registry
+
+
+class _StubNightfall(BaseHTTPRequestHandler):
+    # class-level: last request payload + a rule function set per test
+    requests = []
+    rule = staticmethod(lambda items: [[] for _ in items])
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        req = json.loads(body)
+        type(self).requests.append(
+            {"req": req, "auth": self.headers.get("Authorization")})
+        findings = []
+        for per_item in self.rule(req["payload"]):
+            findings.append([
+                {"location": {"byteRange": {"start": s, "end": e}}}
+                for s, e in per_item
+            ])
+        resp = json.dumps({"findings": findings}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def stub():
+    _StubNightfall.requests = []
+    srv = HTTPServer(("127.0.0.1", 0), _StubNightfall)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def make_filter(port, **props):
+    ins = registry.create_filter("nightfall")
+    ins.set("nightfall_api_key", "test-key-123")
+    ins.set("policy_id", "11111111-2222-3333-4444-555555555555")
+    ins.set("api_url", f"http://127.0.0.1:{port}")
+    for k, v in props.items():
+        ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def make_events(bodies):
+    return [decode_events(encode_event(b, float(i)))[0]
+            for i, b in enumerate(bodies)]
+
+
+def test_payload_shape_and_auth(stub):
+    port = stub.server_address[1]
+    plug = make_filter(port)
+    events = make_events([
+        {"msg": "hello world", "count": 7,
+         "nested": {"inner": "secret"}, "arr": ["a", 1, {"b": "c"}]},
+    ])
+    res, out = plug.filter(events, "t", None)
+    assert res == FilterResult.NOTOUCH
+    (req,) = _StubNightfall.requests
+    assert req["auth"] == "Bearer test-key-123"
+    body = req["req"]
+    assert body["policyUUIDs"] == ["11111111-2222-3333-4444-555555555555"]
+    # DFS order: map keys then values, key-context joined for scalar
+    # values under string keys, nested objects walked in place
+    assert body["payload"] == [
+        "msg", "msg hello world", "count", "count 7",
+        "nested", "inner", "inner secret",
+        "arr", "a", "1", "b", "b c",
+    ]
+
+
+def test_string_range_redaction(stub):
+    port = stub.server_address[1]
+
+    def rule(items):
+        out = []
+        for it in items:
+            if it.startswith("card "):
+                # finding over the card number inside "card <16 digits>"
+                out.append([(5, 5 + 16)])
+            else:
+                out.append([])
+        return out
+
+    _StubNightfall.rule = staticmethod(rule)
+    plug = make_filter(port)
+    events = make_events([{"card": "4242424242424242", "ok": "fine"}])
+    res, out = plug.filter(events, "t", None)
+    assert res == FilterResult.MODIFIED
+    # byteRange applies to the joined "card <value>" string; the filter
+    # subtracts len("card ")==5 and stars the value alone
+    assert out[0].body == {"card": "*" * 16, "ok": "fine"}
+
+
+def test_integer_and_key_redaction(stub):
+    port = stub.server_address[1]
+
+    def rule(items):
+        out = []
+        for it in items:
+            if it == "ssn 78051120":  # int under context key
+                out.append([(4, 12)])
+            elif it == "topsecretkey":  # a map key itself
+                out.append([(0, 3)])
+            else:
+                out.append([])
+        return out
+
+    _StubNightfall.rule = staticmethod(rule)
+    plug = make_filter(port)
+    events = make_events([{"ssn": 78051120, "topsecretkey": "v"}])
+    res, out = plug.filter(events, "t", None)
+    assert res == FilterResult.MODIFIED
+    # integers with findings are replaced whole; string keys star-fill
+    assert out[0].body == {"ssn": "******", "***secretkey": "v"}
+
+
+def test_partial_range_clamping(stub):
+    port = stub.server_address[1]
+    _StubNightfall.rule = staticmethod(
+        lambda items: [[(4, 99)] for _ in items])
+    plug = make_filter(port)
+    events = make_events([{"m": "abcdefgh"}])
+    res, out = plug.filter(events, "t", None)
+    assert res == FilterResult.MODIFIED
+    # offset len("m ")==2: start 4-2=2, end clamped to len
+    assert out[0].body == {"m": "ab******"}
+
+
+def test_no_findings_passthrough_and_raw_identity(stub):
+    port = stub.server_address[1]
+    _StubNightfall.rule = staticmethod(lambda items: [[] for _ in items])
+    plug = make_filter(port)
+    events = make_events([{"a": "b"}, {"c": 5}])
+    res, out = plug.filter(events, "t", None)
+    assert res == FilterResult.NOTOUCH
+    assert out is events
+
+
+def test_api_down_is_notouch():
+    # connect refused → scan error → records pass through untouched
+    plug = make_filter(1)  # port 1: nothing listening
+    events = make_events([{"a": "b"}])
+    res, out = plug.filter(events, "t", None)
+    assert res == FilterResult.NOTOUCH
+
+
+def test_bad_config_rejected():
+    with pytest.raises(ValueError):
+        make_filter(80, sampling_rate="0")
+    ins = registry.create_filter("nightfall")
+    ins.set("policy_id", "x")
+    ins.configure()
+    with pytest.raises(ValueError):
+        ins.plugin.init(ins, None)
+
+
+def test_sync_http_request_chunked_response():
+    import socket
+    import threading
+
+    from fluentbit_tpu.utils import sync_http_request
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n"
+                     b"5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n")
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    got = sync_http_request("127.0.0.1", port, "GET", "/")
+    srv.close()
+    assert got is not None
+    status, headers, body = got
+    assert status == 200 and body == b"hello world"
+
+
+def test_colliding_redacted_keys_both_survive(stub):
+    port = stub.server_address[1]
+    _StubNightfall.rule = staticmethod(
+        lambda items: [[(0, 16)] if len(it) == 16 and it.isdigit()
+                       else [] for it in items])
+    plug = make_filter(port)
+    events = make_events([
+        {"4111111111111111": "a", "4242424242424242": "b"}])
+    res, out = plug.filter(events, "t", None)
+    assert res == FilterResult.MODIFIED
+    # both fields survive with disambiguated star keys
+    assert sorted(out[0].body.values()) == ["a", "b"]
+    assert all(k.startswith("*" * 16) for k in out[0].body)
+
+
+def test_batched_single_request_per_chunk(stub):
+    port = stub.server_address[1]
+    _StubNightfall.rule = staticmethod(lambda items: [[] for _ in items])
+    plug = make_filter(port)
+    events = make_events([{"a": "x"}, {"b": "y"}, {"c": "z"}])
+    plug.filter(events, "t", None)
+    # 3 records, ONE API round trip carrying all fields in DFS order
+    assert len(_StubNightfall.requests) == 1
+    assert _StubNightfall.requests[0]["req"]["payload"] == [
+        "a", "a x", "b", "b y", "c", "c z"]

@@ -164,7 +164,9 @@ def test_out_nats_stub():
 def test_gated_output_fails_loudly():
     from fluentbit_tpu.core.plugin import registry
 
-    ins = registry.create_output("calyptia")
+    # calyptia is real now (tests/test_calyptia.py); zig_demo remains
+    # the gated-output canary
+    ins = registry.create_output("zig_demo")
     ins.configure()
-    with pytest.raises(RuntimeError, match="Calyptia"):
+    with pytest.raises(RuntimeError, match="not vendored"):
         ins.plugin.init(ins, None)

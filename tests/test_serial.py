@@ -1,0 +1,96 @@
+"""in_serial over a pty pair (a real tty, so the termios raw-mode path
+runs). Reference: plugins/in_serial/in_serial.c."""
+
+import os
+import pty
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.core.plugin import registry
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, data, tag):
+        self.events.extend(decode_events(data))
+
+
+def run_serial(writes, deadline_records, **props):
+    master, slave = pty.openpty()
+    sink = _Sink()
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("serial", tag="ser", file=os.ttyname(slave),
+              bitrate="9600", **props)
+    ctx.output("lib", match="*", callback=sink)
+    ctx.start()
+    try:
+        for w in writes:
+            os.write(master, w)
+            time.sleep(0.08)
+        stop = time.time() + 5
+        while len(sink.events) < deadline_records and time.time() < stop:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+        os.close(master)
+        os.close(slave)
+    return sink.events
+
+
+def test_separator_mode_splits_records():
+    events = run_serial([b"alpha\nbeta\n", b"gam", b"ma\n"], 3,
+                        separator="\n")
+    assert [ev.body["msg"] for ev in events[:3]] == [
+        "alpha", "beta", "gamma"]
+
+
+def test_json_mode_parses_concatenated_values():
+    events = run_serial([b'{"a": 1}{"b"', b': 2} 3 '], 3, format="json")
+    bodies = [ev.body["msg"] for ev in events[:3]]
+    assert bodies == [{"a": 1}, {"b": 2}, 3]
+
+
+def test_raw_mode_whole_read_is_one_record():
+    events = run_serial([b"hello serial"], 1)
+    assert events and events[0].body["msg"] == "hello serial"
+
+
+def test_leading_nul_and_crlf_stripped():
+    # FTDI handshake NUL and a bare newline ahead of the payload
+    events = run_serial([b"\x00\nline one\n"], 1, separator="\n")
+    assert events and events[0].body["msg"] == "line one"
+
+
+def test_bad_config_rejected():
+    ins = registry.create_input("serial")
+    ins.set("bitrate", "9600")
+    ins.configure()
+    with pytest.raises(ValueError):
+        ins.plugin.init(ins, None)
+    ins2 = registry.create_input("serial")
+    ins2.set("file", "/dev/null")
+    ins2.configure()
+    with pytest.raises(ValueError):
+        ins2.plugin.init(ins2, None)
+
+
+def test_json_mode_multibyte_split_across_reads():
+    # a multi-byte UTF-8 char split at the read boundary must survive
+    payload = '{"msg": "café"}'.encode("utf-8")
+    cut = payload.index(b"caf") + 4  # mid-'é'
+    events = run_serial([payload[:cut], payload[cut:]], 1, format="json")
+    assert events and events[0].body["msg"] == {"msg": "café"}
+
+
+def test_json_mode_hard_invalid_byte_drops_buffer():
+    # a hard-invalid byte mid-buffer: parsed values before it are
+    # emitted, the poisoned remainder is dropped, later records flow
+    events = run_serial([b'{"a": 1} \xff {"b', b'{"c": 3}'], 2,
+                        format="json")
+    bodies = [ev.body["msg"] for ev in events[:2]]
+    assert bodies == [{"a": 1}, {"c": 3}]

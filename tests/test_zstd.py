@@ -1,0 +1,120 @@
+"""zstd codec (ctypes binding over the system libzstd — the
+src/flb_zstd.c role) + Content-Encoding paths through out_http and
+the in_http server base."""
+
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.utils import CompressionError, compress, decompress
+from fluentbit_tpu.utils import zstd as zstd_mod
+
+
+pytestmark = pytest.mark.skipif(not zstd_mod.available(),
+                                reason="libzstd not present")
+
+
+def test_roundtrip_and_magic():
+    data = b"the quick brown fox " * 500
+    comp = compress("zstd", data)
+    assert comp[:4] == b"\x28\xb5\x2f\xfd"  # zstd frame magic
+    assert len(comp) < len(data)
+    assert decompress("zstd", comp) == data
+
+
+def test_empty_and_incompressible():
+    assert decompress("zstd", compress("zstd", b"")) == b""
+    import os
+    blob = os.urandom(4096)
+    assert decompress("zstd", compress("zstd", blob)) == blob
+
+
+def test_bad_frame_rejected():
+    with pytest.raises(CompressionError):
+        decompress("zstd", b"not a zstd frame at all")
+
+
+def test_content_size_limit():
+    comp = compress("zstd", b"x" * 100000)
+    with pytest.raises(ValueError):
+        zstd_mod.decompress(comp, max_output=1024)
+
+
+def test_out_http_zstd_body():
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.core.plugin import registry
+
+    ins = registry.create_output("http")
+    ins.set("format", "json")
+    ins.set("compress", "zstd")
+    ins.configure()
+    ins.plugin.init(ins, None)
+    body = ins.plugin._build(encode_event({"a": 1}, 5.0), "t")
+    assert body[:4] == b"\x28\xb5\x2f\xfd"
+    assert b'"a":1' in decompress("zstd", body)
+    assert any("Content-Encoding: zstd" in h
+               for h in ins.plugin._headers())
+
+
+def test_in_http_accepts_zstd_and_gzip_bodies():
+    import json
+    import socket
+
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("http", tag="h", listen="127.0.0.1", port="0")
+    in_ins = ctx.engine.inputs[-1]
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while in_ins.plugin.bound_port is None and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        port = in_ins.plugin.bound_port
+        for algo in ("zstd", "gzip"):
+            payload = compress(
+                algo, json.dumps({"via": algo}).encode())
+            s = socket.create_connection(("127.0.0.1", port), timeout=3)
+            s.sendall((f"POST /t HTTP/1.1\r\nHost: x\r\n"
+                       f"Content-Encoding: {algo}\r\n"
+                       f"Content-Length: {len(payload)}\r\n"
+                       "Connection: close\r\n\r\n").encode() + payload)
+            resp = s.recv(4096)
+            s.close()
+            assert b" 201" in resp.split(b"\r\n", 1)[0]
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    assert sorted(ev.body["via"] for ev in got[:2]) == ["gzip", "zstd"]
+
+
+def test_in_http_rejects_corrupt_encoding():
+    import socket
+
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("http", tag="h", listen="127.0.0.1", port="0")
+    in_ins = ctx.engine.inputs[-1]
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while in_ins.plugin.bound_port is None and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        port = in_ins.plugin.bound_port
+        s = socket.create_connection(("127.0.0.1", port), timeout=3)
+        s.sendall(b"POST /t HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Encoding: zstd\r\n"
+                  b"Content-Length: 7\r\n"
+                  b"Connection: close\r\n\r\ngarbage")
+        resp = s.recv(4096)
+        s.close()
+        assert b" 400" in resp.split(b"\r\n", 1)[0]
+    finally:
+        ctx.stop()
