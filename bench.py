@@ -223,13 +223,17 @@ def check_bit_exact(raw_chunks) -> bool:
 
 def kernel_only(raw_chunks) -> dict:
     """Device-kernel dispatch alone over a pre-staged batch (what the
-    TPU actually executes, no host pipeline)."""
+    TPU actually executes, no host pipeline). Measures BOTH kernel
+    variants — the sequential scan and the parallel-in-time
+    function-composition (assoc) kernel — and reports each; the assoc
+    kernel's log2-depth compose tree is the TPU-shaped alternative to
+    Lk serialized gather steps."""
     import numpy as np
 
     from fluentbit_tpu import native
-    from fluentbit_tpu.ops.grep import program_for
+    from fluentbit_tpu.ops.grep import GrepProgram, program_for
+    from fluentbit_tpu.regex.dfa import compile_dfa
 
-    prog = program_for((APACHE2,), 512)
     staged = native.stage_field(raw_chunks[0], b"log", 512,
                                 n_hint=CHUNK_RECORDS)
     if staged is None:
@@ -237,13 +241,29 @@ def kernel_only(raw_chunks) -> dict:
     batch, lengths, _, n = staged
     b = np.stack([batch])
     ln = np.stack([lengths])
-    prog.match(b, ln)  # warm + compile
-    t0 = time.perf_counter()
-    reps = 0
-    while time.perf_counter() - t0 < 2.0:
-        prog.match(b, ln)
-        reps += 1
-    dt = time.perf_counter() - t0
+
+    def rate(prog) -> int:
+        prog.match(b, ln)  # warm + compile
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 2.0:
+            prog.match(b, ln)
+            reps += 1
+        return round(reps * n / (time.perf_counter() - t0))
+
+    out = {}
+    scan_rate = rate(program_for((APACHE2,), 512))
+    out["kernel_scan_lines_per_sec"] = scan_rate
+    try:
+        assoc_rate = rate(GrepProgram([compile_dfa(APACHE2)], 512,
+                                      kernel="assoc"))
+        out["kernel_assoc_lines_per_sec"] = assoc_rate
+    except Exception as e:
+        assoc_rate = 0
+        out["kernel_assoc_error"] = repr(e)
+    out["kernel_lines_per_sec"] = max(scan_rate, assoc_rate)
+    out["kernel_best_variant"] = (
+        "assoc" if assoc_rate > scan_rate else "scan")
     # staging throughput (the H2D feed path)
     t0 = time.perf_counter()
     sreps = 0
@@ -252,10 +272,8 @@ def kernel_only(raw_chunks) -> dict:
                            n_hint=CHUNK_RECORDS)
         sreps += 1
     sdt = time.perf_counter() - t0
-    return {
-        "kernel_lines_per_sec": round(reps * n / dt),
-        "staging_lines_per_sec": round(sreps * n / sdt),
-    }
+    out["staging_lines_per_sec"] = round(sreps * n / sdt)
+    return out
 
 
 def child_main(mode: str) -> None:
@@ -385,6 +403,11 @@ def final_line(cpu, dev, dev_err, extras):
         "p50_chunk_ms": (best or {}).get("p50_chunk_ms"),
         "kernel_only_lines_per_sec": (best or {}).get(
             "kernel_lines_per_sec"),
+        "kernel_scan_lines_per_sec": (best or {}).get(
+            "kernel_scan_lines_per_sec"),
+        "kernel_assoc_lines_per_sec": (best or {}).get(
+            "kernel_assoc_lines_per_sec"),
+        "kernel_best_variant": (best or {}).get("kernel_best_variant"),
         "staging_lines_per_sec": (best or {}).get(
             "staging_lines_per_sec"),
         "unfiltered_ingest_lines_per_sec": (best or {}).get(

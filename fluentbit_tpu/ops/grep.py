@@ -70,11 +70,24 @@ class GrepProgram:
     Produces ``match(batch_u8[R,B,L], lengths[R,B]) -> bool[R,B]``.
     """
 
-    def __init__(self, dfas: Sequence[DFA], max_len: int = 512):
+    def __init__(self, dfas: Sequence[DFA], max_len: int = 512,
+                 kernel: Optional[str] = None, segment: int = 32):
         if not HAVE_JAX:
             raise RuntimeError("jax is unavailable")
         self.dfas = list(dfas)
         self.max_len = max_len
+        # kernel variant: "scan" = sequential lax.scan of table gathers
+        # (Lk serialized steps, minimal FLOPs); "assoc" = parallel-in-
+        # time function composition (segments scanned as transition
+        # FUNCTIONS over all states, then a log2-depth tree of
+        # compositions) — sequential depth m + log2(Lk/m) instead of
+        # Lk, trading S× more parallel work the TPU's lanes absorb
+        import os as _os
+        self.kernel = (kernel or
+                       _os.environ.get("FBTPU_GREP_KERNEL", "scan"))
+        if self.kernel not in ("scan", "assoc"):
+            raise ValueError(f"unknown grep kernel {self.kernel!r}")
+        self.segment = max(2, int(segment))
         R = len(self.dfas)
 
         # Table prep is pure numpy — cheap and safe at plugin init. The
@@ -102,6 +115,7 @@ class GrepProgram:
             "starts": np.asarray([d.start for d in self.dfas],
                                  dtype=np.int32),
         }
+        self.max_states = max(d.n_states for d in self.dfas)
         self._jit = None
         self._mat_lock = threading.Lock()
         self._sharded_cache: dict = {}
@@ -118,7 +132,10 @@ class GrepProgram:
             self.class_maps = jnp.asarray(t["class_maps"])
             self.eol_cls = jnp.asarray(t["eol_cls"])
             self.starts = jnp.asarray(t["starts"])
-            self._jit = jax.jit(self._match_impl)
+            impl = (self._match_assoc_impl if self.kernel == "assoc"
+                    else self._match_impl)
+            self._impl = impl
+            self._jit = jax.jit(impl)
             self._np = None  # tables now live on device; free host copy
 
     def try_ready(self) -> bool:
@@ -137,7 +154,9 @@ class GrepProgram:
 
     # -- the kernel --
 
-    def _match_impl(self, batch: "jnp.ndarray", lengths: "jnp.ndarray"):
+    def _super_symbols(self, batch: "jnp.ndarray",
+                       lengths: "jnp.ndarray") -> "jnp.ndarray":
+        """bytes → per-rule k-byte super-symbols: [R, B, Lk]."""
         R, B, L = batch.shape
         k = self.k
         # byte → class, per rule
@@ -157,6 +176,11 @@ class GrepProgram:
         comb = cls[..., 0]
         for j in range(1, k):
             comb = comb * self.C[:, None, None] + cls[..., j]
+        return comb
+
+    def _match_impl(self, batch: "jnp.ndarray", lengths: "jnp.ndarray"):
+        R, B, L = batch.shape
+        comb = self._super_symbols(batch, lengths)
         comb_t = jnp.moveaxis(comb, 2, 0)  # [Lk, R, B]
 
         # + 0*lengths: ties the carry to the (possibly mesh-sharded) batch
@@ -171,6 +195,72 @@ class GrepProgram:
 
         final, _ = lax.scan(step, state0, comb_t)
         return (final == ACC) & (lengths >= 0)
+
+    def _match_assoc_impl(self, batch: "jnp.ndarray",
+                          lengths: "jnp.ndarray"):
+        """Parallel-in-time DFA: the line's symbols are composed as
+        transition FUNCTIONS instead of stepped as states.
+
+        Each segment of m super-symbols is scanned once over ALL S
+        states (m sequential steps on [R,B,G,S] gathers), producing a
+        per-segment function table; segments then combine in a
+        log2(G)-deep tree of compositions ``(f∘g)[s] = g[f[s]]``
+        (take_along_axis over the state axis). Sequential depth drops
+        from Lk to m + log2(G) — the S× extra parallel work is exactly
+        what the TPU's vector lanes absorb, where the scan kernel's
+        serialized gather chain leaves them idle. Bit-identical to
+        _match_impl (differentially tested)."""
+        R, B, L = batch.shape
+        m = self.segment
+        S = self.max_states
+        comb = self._super_symbols(batch, lengths)  # [R, B, Lk]
+        Lk = comb.shape[2]
+        G = -(-Lk // m)
+        # pad the segment grid to a power of two with all-EOL segments
+        # (EOL is absorbing, so they compose as no-ops past the line)
+        G2 = 1
+        while G2 < G:
+            G2 *= 2
+        pad = G2 * m - Lk
+        if pad:
+            # super-symbol of k EOL classes: eol * (C^{k-1}+...+C+1)
+            radix = jnp.ones_like(self.C)
+            eol_super = jnp.zeros_like(self.eol_cls)
+            for _ in range(self.k):
+                eol_super = eol_super + self.eol_cls * radix
+                radix = radix * self.C
+            comb = jnp.concatenate(
+                [comb, jnp.broadcast_to(eol_super[:, None, None],
+                                        (R, B, pad))], axis=2)
+        comb = comb.reshape(R, B, G2, m)
+
+        def gather_rule(tf, idx):
+            return tf[idx]
+
+        states = jnp.arange(S, dtype=jnp.int32)
+        idx0 = (states[None, None, None, :]
+                * self.Ck[:, None, None, None] + comb[..., 0:1])
+        F = jax.vmap(gather_rule)(self.trans_flat, idx0)  # [R,B,G2,S]
+
+        def seg_step(F, c_j):  # c_j: [R, B, G2]
+            idx = F * self.Ck[:, None, None, None] + c_j[..., None]
+            return jax.vmap(gather_rule)(self.trans_flat, idx), None
+
+        if m > 1:
+            comb_j = jnp.moveaxis(comb[..., 1:], 3, 0)  # [m-1, R, B, G2]
+            F, _ = lax.scan(seg_step, F, comb_j)
+        g = G2
+        while g > 1:  # static tree: g halves each round
+            f_half = F[:, :, 0::2]
+            g_half = F[:, :, 1::2]
+            F = jnp.take_along_axis(g_half, f_half, axis=3)
+            g //= 2
+        final_fn = F[:, :, 0, :]  # [R, B, S]: whole-line function
+        start_idx = jnp.broadcast_to(self.starts[:, None, None], (R, B, 1))
+        final = jnp.take_along_axis(final_fn, start_idx, axis=2)[..., 0]
+        # + 0*lengths keeps the shard_map varying-axes annotation tied
+        # to the batch, mirroring _match_impl's state0 trick
+        return (final + 0 * lengths == ACC) & (lengths >= 0)
 
     def match(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         """Run the kernel; returns bool [R, B] (numpy). Blocks up to the
@@ -212,7 +302,7 @@ class GrepProgram:
             self._materialize()
 
         def step(batch, lengths):
-            mask = self._match_impl(batch, lengths)
+            mask = self._impl(batch, lengths)
             counts = lax.psum(
                 jnp.sum(mask.astype(jnp.int32), axis=1), axis_name=axis
             )

@@ -60,6 +60,22 @@ HDR_PROJECT = "X-Project-Token"
 HDR_AGENT_TOKEN = "X-Agent-Token"
 
 
+def _tls_settings(ins) -> Tuple[bool, bool]:
+    """(tls_on, tls_verify) from an instance's tls.* properties."""
+    from ..core.config import parse_bool
+    from ..core.tls import tls_enabled
+    return (tls_enabled(ins),
+            parse_bool(ins.properties.get("tls.verify", True)))
+
+
+def _parse_label(entry) -> Tuple[str, str]:
+    parts = entry if isinstance(entry, list) \
+        else str(entry).split(None, 1)
+    if len(parts) != 2:
+        raise ValueError(f"calyptia: bad add_label {entry!r}")
+    return str(parts[0]), str(parts[1])
+
+
 def _machine_arch() -> str:
     m = platform.machine().lower()
     return {"x86_64": "x86_64", "amd64": "x86_64", "aarch64": "arm64",
@@ -111,12 +127,8 @@ class CalyptiaOutput(_HttpDeliveryOutput):
             raise ValueError("calyptia: machine_id has not been set")
         self.host = self.cloud_host
         self.port = self.cloud_port
-        self._labels: List[Tuple[str, str]] = []
-        for e in self.add_label or []:
-            parts = e if isinstance(e, list) else str(e).split(None, 1)
-            if len(parts) != 2:
-                raise ValueError(f"calyptia: bad add_label {e!r}")
-            self._labels.append((parts[0], parts[1]))
+        self._labels: List[Tuple[str, str]] = [
+            _parse_label(e) for e in self.add_label or []]
         self.agent_id: Optional[str] = None
         self.agent_token: Optional[str] = None
         self._load_session()
@@ -161,12 +173,7 @@ class CalyptiaOutput(_HttpDeliveryOutput):
     # -- registration (api_agent_create, calyptia.c:608-715) -----------
 
     def _tls_pair(self) -> Tuple[bool, bool]:
-        from ..core.config import parse_bool
-        from ..core.tls import tls_enabled
-        tls = tls_enabled(self.instance)
-        verify = parse_bool(
-            self.instance.properties.get("tls.verify", True))
-        return tls, verify
+        return _tls_settings(self.instance)
 
     def _register_agent(self) -> bool:
         raw_config = ""
@@ -309,11 +316,7 @@ class CalyptiaFleetInput(InputPlugin):
                     pass
 
     def _tls_pair(self) -> Tuple[bool, bool]:
-        from ..core.config import parse_bool
-        from ..core.tls import tls_enabled
-        tls = tls_enabled(self._ins)
-        verify = parse_bool(self._ins.properties.get("tls.verify", True))
-        return tls, verify
+        return _tls_settings(self._ins)
 
     def _project_id(self) -> Optional[str]:
         """First '.'-separated api_key segment is padded base64 JSON
@@ -488,8 +491,7 @@ class CalyptiaCustom(CustomPlugin):
             out_props["fleet_id"] = self.fleet_id
         out_ins = engine.output("calyptia", **out_props)
         for e in self.add_label or []:
-            parts = e if isinstance(e, list) else str(e).split(None, 1)
-            out_ins.set("add_label", " ".join(str(p) for p in parts))
+            out_ins.set("add_label", " ".join(_parse_label(e)))
         if self.fleet_id or self.fleet_name:
             fleet_props = {
                 "tag": "_calyptia_fleet",

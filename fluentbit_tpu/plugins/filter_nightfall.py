@@ -149,11 +149,17 @@ class NightfallFilter(FilterPlugin):
             return "******", True
         raw = bytearray(value.encode("utf-8"))
         offset = len(key.encode("utf-8")) + 1 if key is not None else 0
+        changed = False
         for start, end in ranges:
             start = max(0, start - offset)
             end = min(len(raw), end - offset)
             for i in range(start, end):
+                changed = changed or raw[i] != 0x2A
                 raw[i] = 0x2A  # '*'
+        if not changed:
+            # every range clamped empty (e.g. a finding entirely inside
+            # the key-context prefix): nothing was redacted
+            return value, False
         return raw.decode("utf-8", "replace"), True
 
     def _rebuild(self, obj, ranges, idx: List[int], touched: List[bool]):

@@ -34,9 +34,7 @@ def _gate(kind, plugin_name: str, runtime: str, hint: str = ""):
     return registry.register(Gated)
 
 
-_gate(FilterPlugin, "tensorflow", "TensorFlow Lite")
 _gate(InputPlugin, "ebpf", "libbpf CO-RE")
-_gate(InputPlugin, "systemd", "libsystemd (journald)")
 _gate(InputPlugin, "winlog", "the Windows Event Log API")
 _gate(InputPlugin, "winevtlog", "the Windows Event Log API")
 _gate(InputPlugin, "winstat", "the Windows performance counter API")

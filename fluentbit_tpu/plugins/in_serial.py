@@ -149,16 +149,24 @@ class SerialInput(InputPlugin):
             # split across reads survives in the byte remainder — text
             # is ALWAYS strictly decoded, keeping the char→byte
             # mapping exact for the consumed-bytes arithmetic below
-            try:
-                text = self._buf.decode("utf-8")
-                prefix_bytes = len(self._buf)
-                hard_invalid = False
-            except UnicodeDecodeError as e:
-                text = self._buf[:e.start].decode("utf-8")
-                prefix_bytes = e.start
-                # within the last 3 bytes = possibly a truncated tail;
-                # earlier = a hard-invalid byte (never valid JSON)
-                hard_invalid = e.start < len(self._buf) - 3
+            while True:
+                try:
+                    text = self._buf.decode("utf-8")
+                    prefix_bytes = len(self._buf)
+                    hard_invalid = False
+                except UnicodeDecodeError as e:
+                    if e.start == 0 and len(self._buf) > 3:
+                        # garbage at the buffer head (e.g. a bad byte
+                        # retained last round as a possible truncated
+                        # tail): skip it and re-sync on what follows
+                        self._buf = self._buf[1:]
+                        continue
+                    text = self._buf[:e.start].decode("utf-8")
+                    prefix_bytes = e.start
+                    # within the last 3 bytes = possibly a truncated
+                    # tail; earlier = hard-invalid (never valid JSON)
+                    hard_invalid = e.start < len(self._buf) - 3
+                break
             at = 0
             while at < len(text):
                 while at < len(text) and text[at] in " \t\r\n":

@@ -1,0 +1,200 @@
+"""in_systemd — journald reader input.
+
+Reference: plugins/in_systemd/systemd.c (sd_journal-based). The same
+surface is served by the from-scratch journal-file reader
+(`utils/journal.py`): every new journal entry becomes a record whose
+body maps field names to values (systemd.c:340-380), with
+``lowercase`` and ``strip_underscores`` transforms (systemd.c:160-200),
+``systemd_filter`` KEY=value matches combined by ``systemd_filter_type``
+and/or, a dynamic tag — ``*`` in the tag replaced by the entry's
+``_SYSTEMD_UNIT`` (tag_compose, systemd.c:34-66) — and the record
+timestamp from ``_SOURCE_REALTIME_TIMESTAMP`` when present, else the
+entry's own realtime. ``read_from_tail`` skips the backlog;
+``db`` persists per-file consumed positions (the sd_journal cursor
+role) so a restart resumes where it stopped.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.events import EventTime, encode_event, now_event_time
+from ..core.config import ConfigMapEntry
+from ..core.plugin import InputPlugin, registry
+from ..utils.journal import (
+    JournalError,
+    JournalFile,
+    peek_header,
+    scan_journal_dir,
+)
+
+log = logging.getLogger("flb.systemd")
+
+_DEFAULT_PATHS = ("/var/log/journal", "/run/log/journal")
+
+
+@registry.register
+class SystemdInput(InputPlugin):
+    name = "systemd"
+    description = "Systemd (Journal) reader"
+    collect_interval = 1.0
+    threaded_capable = True
+    config_map = [
+        ConfigMapEntry("path", "str"),
+        ConfigMapEntry("max_fields", "int", default=8000),
+        ConfigMapEntry("max_entries", "int", default=5000),
+        ConfigMapEntry("systemd_filter_type", "str", default="and"),
+        ConfigMapEntry("systemd_filter", "slist", multiple=True,
+                       slist_max_split=0),
+        ConfigMapEntry("read_from_tail", "bool", default=False),
+        ConfigMapEntry("lowercase", "bool", default=False),
+        ConfigMapEntry("strip_underscores", "bool", default=False),
+        ConfigMapEntry("db", "str"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        self._ins = instance
+        if self.path:
+            if not os.path.isdir(self.path):
+                raise ValueError(
+                    f"systemd: journal path {self.path!r} not found")
+            self._root = self.path
+        else:
+            self._root = next(
+                (p for p in _DEFAULT_PATHS if os.path.isdir(p)), None)
+            if self._root is None:
+                raise ValueError(
+                    "systemd: no journal directory found (set 'path')")
+        ftype = (self.systemd_filter_type or "and").lower()
+        if ftype not in ("and", "or"):
+            raise ValueError(
+                "systemd: systemd_filter_type must be 'and' or 'or'")
+        self._filter_and = ftype == "and"
+        self._filters: List[Tuple[str, str]] = []
+        for f in self.systemd_filter or []:
+            text = f if isinstance(f, str) else " ".join(f)
+            key, sep, value = text.partition("=")
+            if not sep:
+                raise ValueError(f"systemd: bad systemd_filter {f!r}")
+            self._filters.append((key.strip(), value.strip()))
+        self._dynamic_tag = "*" in (instance.tag or "")
+        # consumed-entry counts keyed by the file's file_id, which
+        # survives journald rotation renames (a fresh file after
+        # rotation gets a new id and starts at 0; the archived file
+        # keeps its id and its cursor) — the sd_journal cursor role
+        self._pos: Dict[str, int] = {}
+        if self.db and os.path.isfile(self.db):
+            try:
+                with open(self.db, "r", encoding="utf-8") as f:
+                    self._pos = {str(k): int(v)
+                                 for k, v in json.load(f).items()}
+            except (OSError, ValueError):
+                log.warning("systemd: could not load db %s", self.db)
+        elif self.read_from_tail:
+            for path in scan_journal_dir(self._root):
+                try:
+                    file_id, n_entries = peek_header(path)
+                    self._pos[file_id] = n_entries
+                except (JournalError, OSError) as e:
+                    log.warning("systemd: %s", e)
+
+    def _persist(self) -> None:
+        if not self.db:
+            return
+        try:
+            tmp = self.db + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._pos, f)
+            os.replace(tmp, self.db)
+        except OSError:
+            log.warning("systemd: could not persist db %s", self.db)
+
+    def _matches(self, fields: Dict[str, str]) -> bool:
+        if not self._filters:
+            return True
+        hits = (fields.get(k) == v for k, v in self._filters)
+        return all(hits) if self._filter_and else any(hits)
+
+    def _tag_for(self, fields: Dict[str, str]) -> str:
+        tag = self._ins.tag or "systemd"
+        if not self._dynamic_tag:
+            return tag
+        unit = fields.get("_SYSTEMD_UNIT", "unknown")
+        return tag.replace("*", unit)
+
+    def collect(self, engine) -> None:
+        budget = max(1, int(self.max_entries))
+        changed = False
+        for path in scan_journal_dir(self._root):
+            if budget <= 0:
+                break
+            try:
+                # header-only freshness probe: idle files (the usual
+                # archived majority) never load their body
+                file_id, n_entries = peek_header(path)
+                skip = self._pos.get(file_id, 0)
+                if n_entries <= skip:
+                    continue
+                jf = JournalFile(path)
+            except (JournalError, OSError) as e:
+                log.debug("systemd: %s: %s", path, e)
+                continue
+            groups: Dict[str, List[bytes]] = {}
+            consumed = 0
+            # per-entry containment: one corrupt object must neither
+            # discard already-decoded entries nor stall the cursor —
+            # the bad entry is skipped (logged) and reading goes on
+            it = jf.entries(skip=skip, max_entries=budget)
+            while True:
+                try:
+                    entry = next(it)
+                except StopIteration:
+                    break
+                except JournalError as e:
+                    log.warning("systemd: %s (skipping one entry)", e)
+                    consumed += 1
+                    break  # the iterator's position is unrecoverable
+                consumed += 1
+                fields: Dict[str, str] = {}
+                for k, v in entry.fields[:int(self.max_fields)]:
+                    fields[k] = v
+                if not self._matches(fields):
+                    continue
+                tag = self._tag_for(fields)
+                ts = self._timestamp(entry, fields)
+                body = self._transform(fields)
+                groups.setdefault(tag, []).append(
+                    encode_event(body, ts))
+            budget -= consumed
+            self._pos[jf.file_id] = skip + consumed
+            changed = True
+            for tag, bufs in groups.items():
+                engine.input_log_append(
+                    self._ins, tag, b"".join(bufs), len(bufs))
+        if changed:
+            self._persist()
+
+    @staticmethod
+    def _timestamp(entry, fields: Dict[str, str]):
+        src = fields.get("_SOURCE_REALTIME_TIMESTAMP")
+        usec = None
+        if src and src.isdigit():
+            usec = int(src)
+        elif entry.realtime:
+            usec = entry.realtime
+        if usec is None:
+            return now_event_time()
+        return EventTime(usec // 1_000_000, (usec % 1_000_000) * 1000)
+
+    def _transform(self, fields: Dict[str, str]) -> Dict[str, str]:
+        out = {}
+        for k, v in fields.items():
+            if self.strip_underscores:
+                k = k.lstrip("_")
+            if self.lowercase:
+                k = k.lower()
+            out[k] = v
+        return out

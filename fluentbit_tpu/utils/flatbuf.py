@@ -1,0 +1,125 @@
+"""Minimal FlatBuffers reader — just enough of the wire format
+(https://flatbuffers.dev/internals) to walk a .tflite model.
+
+Reference: the TF-Lite runtime fluent-bit links (filter_tensorflow,
+plugins/filter_tensorflow/tensorflow.c includes tensorflow/lite/c)
+parses the same FlatBuffers layout through the generated C API; here
+the three structural pieces are implemented directly: root offset →
+table, vtable-indirected fields, and vectors/strings.
+
+Wire format facts used:
+- root: u32 offset at position 0 to the root table
+- table: i32 at table pos = relative offset BACK to its vtable;
+  vtable: u16 vtable size, u16 table size, then u16 per field id —
+  0 means the field is absent (default applies)
+- offsets inside tables are u32 FORWARD offsets from the field slot
+- vector: u32 length at the target, elements follow
+- string: vector of bytes (NUL-terminated, length excludes the NUL)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+
+class Table:
+    """A flatbuffer table view: field(n) accessors by field id."""
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int):
+        self.buf = buf
+        self.pos = pos
+
+    def _slot(self, field_id: int) -> int:
+        """Absolute position of the field slot, 0 if absent."""
+        vtable = self.pos - struct.unpack_from("<i", self.buf,
+                                               self.pos)[0]
+        vsize = struct.unpack_from("<H", self.buf, vtable)[0]
+        entry = 4 + 2 * field_id
+        if entry + 2 > vsize:
+            return 0
+        rel = struct.unpack_from("<H", self.buf, vtable + entry)[0]
+        return self.pos + rel if rel else 0
+
+    # -- scalar fields --
+
+    def i8(self, fid: int, default: int = 0) -> int:
+        p = self._slot(fid)
+        return struct.unpack_from("<b", self.buf, p)[0] if p else default
+
+    def u8(self, fid: int, default: int = 0) -> int:
+        p = self._slot(fid)
+        return struct.unpack_from("<B", self.buf, p)[0] if p else default
+
+    def i32(self, fid: int, default: int = 0) -> int:
+        p = self._slot(fid)
+        return struct.unpack_from("<i", self.buf, p)[0] if p else default
+
+    def u32(self, fid: int, default: int = 0) -> int:
+        p = self._slot(fid)
+        return struct.unpack_from("<I", self.buf, p)[0] if p else default
+
+    def f32(self, fid: int, default: float = 0.0) -> float:
+        p = self._slot(fid)
+        return struct.unpack_from("<f", self.buf, p)[0] if p else default
+
+    def bool_(self, fid: int, default: bool = False) -> bool:
+        p = self._slot(fid)
+        return bool(self.buf[p]) if p else default
+
+    # -- offset fields --
+
+    def _indirect(self, p: int) -> int:
+        return p + struct.unpack_from("<I", self.buf, p)[0]
+
+    def table(self, fid: int) -> Optional["Table"]:
+        p = self._slot(fid)
+        return Table(self.buf, self._indirect(p)) if p else None
+
+    def string(self, fid: int) -> Optional[str]:
+        p = self._slot(fid)
+        if not p:
+            return None
+        v = self._indirect(p)
+        n = struct.unpack_from("<I", self.buf, v)[0]
+        return self.buf[v + 4:v + 4 + n].decode("utf-8", "replace")
+
+    def _vector(self, fid: int):
+        p = self._slot(fid)
+        if not p:
+            return None, 0
+        v = self._indirect(p)
+        n = struct.unpack_from("<I", self.buf, v)[0]
+        return v + 4, n
+
+    def vector_len(self, fid: int) -> int:
+        _, n = self._vector(fid)
+        return n
+
+    def i32_vector(self, fid: int) -> List[int]:
+        base, n = self._vector(fid)
+        if base is None:
+            return []
+        return list(struct.unpack_from(f"<{n}i", self.buf, base))
+
+    def bytes_vector(self, fid: int) -> bytes:
+        base, n = self._vector(fid)
+        if base is None:
+            return b""
+        return bytes(self.buf[base:base + n])
+
+    def table_vector(self, fid: int) -> List["Table"]:
+        base, n = self._vector(fid)
+        if base is None:
+            return []
+        out = []
+        for i in range(n):
+            slot = base + 4 * i
+            out.append(Table(self.buf, self._indirect(slot)))
+        return out
+
+
+def root(buf: bytes) -> Table:
+    return Table(buf, struct.unpack_from("<I", buf, 0)[0])

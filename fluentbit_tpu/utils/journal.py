@@ -1,0 +1,244 @@
+"""systemd journal file reader — from scratch, per the documented
+Journal File Format (systemd.io/JOURNAL_FILE_FORMAT).
+
+Reference: plugins/in_systemd reads journald through libsystemd's
+sd_journal API; this image has no libsystemd, but journal files are
+just memory-mapped object stores, so the reader walks them directly:
+header → entry-array chain → ENTRY objects → DATA objects ("KEY=value"
+payloads). Supports regular AND compact layouts, and XZ / LZ4 / ZSTD
+compressed payloads (lzma stdlib, liblz4/libzstd via ctypes — the same
+codecs journald itself links).
+
+Layout facts used (offsets from the object/file start):
+- header: "LPKSHHRH", compatible u32, incompatible u32, state u8,
+  7 reserved, 4×16-byte ids, then u64s: header_size, arena_size,
+  data_hash_table offset/size, field_hash_table offset/size,
+  tail_object_offset, n_objects, n_entries, tail_entry_seqnum,
+  head_entry_seqnum, entry_array_offset, head/tail realtime,
+  tail monotonic
+- object header: type u8, flags u8, 6 reserved, size u64 (incl. hdr)
+- ENTRY: seqnum, realtime, monotonic (u64×3), boot_id 16, xor_hash
+  u64, then items — regular: (object_offset u64, hash u64) pairs;
+  compact: u32 object offsets
+- ENTRY_ARRAY: next_entry_array_offset u64, then items — u64
+  (regular) or u32 (compact) entry offsets, zero-padded tail
+- DATA: hash, next_hash, next_field, entry_offset,
+  entry_array_offset, n_entries (u64×6), then — compact only — two
+  u32s (tail entry array offset/count), then the payload
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+HEADER_SIGNATURE = b"LPKSHHRH"
+
+# incompatible flags
+F_COMPRESSED_XZ = 1
+F_COMPRESSED_LZ4 = 2
+F_KEYED_HASH = 4
+F_COMPRESSED_ZSTD = 8
+F_COMPACT = 16
+_SUPPORTED = (F_COMPRESSED_XZ | F_COMPRESSED_LZ4 | F_KEYED_HASH
+              | F_COMPRESSED_ZSTD | F_COMPACT)
+
+# object types
+OBJECT_DATA = 1
+OBJECT_ENTRY = 3
+OBJECT_ENTRY_ARRAY = 6
+
+# object flags (DATA payload compression)
+OBJ_XZ = 1
+OBJ_LZ4 = 2
+OBJ_ZSTD = 4
+
+
+class JournalError(ValueError):
+    pass
+
+
+_lz4_lib = None
+
+
+def _lz4_block_decompress(data: bytes, dst_size: int) -> bytes:
+    import ctypes
+
+    global _lz4_lib
+    if _lz4_lib is None:
+        import ctypes.util
+
+        name = ctypes.util.find_library("lz4") or "liblz4.so.1"
+        lib = ctypes.CDLL(name)  # cached: find_library forks ldconfig
+        lib.LZ4_decompress_safe.restype = ctypes.c_int
+        lib.LZ4_decompress_safe.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int]
+        _lz4_lib = lib
+    dst = ctypes.create_string_buffer(dst_size)
+    n = _lz4_lib.LZ4_decompress_safe(data, dst, len(data), dst_size)
+    if n < 0:
+        raise JournalError("corrupt LZ4 payload")
+    return dst.raw[:n]
+
+
+class Entry:
+    __slots__ = ("seqnum", "realtime", "monotonic", "boot_id", "fields")
+
+    def __init__(self, seqnum, realtime, monotonic, boot_id, fields):
+        self.seqnum = seqnum
+        self.realtime = realtime  # usec
+        self.monotonic = monotonic
+        self.boot_id = boot_id
+        self.fields = fields  # list of (key, value) strings
+
+
+def peek_header(path: str):
+    """Cheap header-only read → (file_id_hex, n_entries) without
+    loading the (possibly 128MB) file body — the per-poll freshness
+    check. file_id survives journald's rotation renames, so it is the
+    stable cursor key (the sd_journal cursor role)."""
+    with open(path, "rb") as f:
+        head = f.read(208)
+    if len(head) < 208 or head[:8] != HEADER_SIGNATURE:
+        raise JournalError(f"{path}: not a journal file")
+    file_id = head[24:40].hex()
+    n_entries = struct.unpack_from("<Q", head, 152)[0]
+    return file_id, n_entries
+
+
+class JournalFile:
+    """One .journal file; `entries(skip)` iterates in write order."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            self.buf = f.read()
+        if len(self.buf) < 208 or self.buf[:8] != HEADER_SIGNATURE:
+            raise JournalError(f"{path}: not a journal file")
+        self.incompatible = struct.unpack_from("<I", self.buf, 12)[0]
+        if self.incompatible & ~_SUPPORTED:
+            raise JournalError(
+                f"{path}: unsupported incompatible flags "
+                f"{self.incompatible:#x}")
+        self.compact = bool(self.incompatible & F_COMPACT)
+        self.file_id = self.buf[24:40].hex()
+        (self.header_size, self.arena_size) = struct.unpack_from(
+            "<QQ", self.buf, 88)
+        (self.n_objects, self.n_entries, self.tail_seqnum,
+         self.head_seqnum, self.entry_array_offset) = \
+            struct.unpack_from("<QQQQQ", self.buf, 144)
+
+    # -- object plumbing ----------------------------------------------
+
+    def _object(self, offset: int) -> Tuple[int, int, int, int]:
+        """→ (type, flags, payload_start, payload_end)."""
+        if offset <= 0 or offset + 16 > len(self.buf):
+            raise JournalError(f"{self.path}: object offset out of range")
+        otype = self.buf[offset]
+        oflags = self.buf[offset + 1]
+        size = struct.unpack_from("<Q", self.buf, offset + 8)[0]
+        if size < 16 or offset + size > len(self.buf):
+            raise JournalError(f"{self.path}: bad object size")
+        return otype, oflags, offset + 16, offset + size
+
+    def _data_payload(self, offset: int) -> bytes:
+        otype, oflags, start, end = self._object(offset)
+        if otype != OBJECT_DATA:
+            raise JournalError(f"{self.path}: expected DATA object")
+        start += 48  # six u64 bookkeeping fields
+        if self.compact:
+            start += 8  # two u32 tail-entry-array fields
+        raw = self.buf[start:end]
+        if oflags & OBJ_ZSTD:
+            from . import zstd
+            return zstd.decompress(bytes(raw))
+        if oflags & OBJ_LZ4:
+            if len(raw) < 8:
+                raise JournalError("short LZ4 payload")
+            dst_size = struct.unpack_from("<Q", raw, 0)[0]
+            if dst_size > 256 * 1024 * 1024:
+                raise JournalError("LZ4 payload too large")
+            return _lz4_block_decompress(bytes(raw[8:]), dst_size)
+        if oflags & OBJ_XZ:
+            import lzma
+            return lzma.decompress(bytes(raw))
+        return bytes(raw)
+
+    def _entry(self, offset: int) -> Entry:
+        otype, _oflags, start, end = self._object(offset)
+        if otype != OBJECT_ENTRY:
+            raise JournalError(f"{self.path}: expected ENTRY object")
+        seqnum, realtime, monotonic = struct.unpack_from(
+            "<QQQ", self.buf, start)
+        boot_id = bytes(self.buf[start + 24:start + 40])
+        items_at = start + 48  # + xor_hash u64
+        fields: List[Tuple[str, str]] = []
+        if self.compact:
+            count = (end - items_at) // 4
+            offs = struct.unpack_from(f"<{count}I", self.buf, items_at)
+        else:
+            count = (end - items_at) // 16
+            offs = [struct.unpack_from("<Q", self.buf,
+                                       items_at + 16 * i)[0]
+                    for i in range(count)]
+        for data_off in offs:
+            if not data_off:
+                continue
+            payload = self._data_payload(data_off)
+            key, sep, value = payload.partition(b"=")
+            if not sep:
+                continue
+            fields.append((key.decode("utf-8", "replace"),
+                           value.decode("utf-8", "replace")))
+        return Entry(seqnum, realtime, monotonic, boot_id, fields)
+
+    def _entry_offsets(self) -> Iterator[int]:
+        array = self.entry_array_offset
+        seen = set()
+        while array:
+            if array in seen:
+                raise JournalError(f"{self.path}: entry array loop")
+            seen.add(array)
+            otype, _f, start, end = self._object(array)
+            if otype != OBJECT_ENTRY_ARRAY:
+                raise JournalError(
+                    f"{self.path}: expected ENTRY_ARRAY object")
+            next_array = struct.unpack_from("<Q", self.buf, start)[0]
+            items_at = start + 8
+            if self.compact:
+                count = (end - items_at) // 4
+                offs = struct.unpack_from(f"<{count}I", self.buf,
+                                          items_at)
+            else:
+                count = (end - items_at) // 8
+                offs = struct.unpack_from(f"<{count}Q", self.buf,
+                                          items_at)
+            for off in offs:
+                if off == 0:
+                    return  # zero-padded tail of the last array
+                yield off
+            array = next_array
+
+    def entries(self, skip: int = 0,
+                max_entries: Optional[int] = None) -> Iterator[Entry]:
+        produced = 0
+        for i, off in enumerate(self._entry_offsets()):
+            if i < skip:
+                continue
+            if max_entries is not None and produced >= max_entries:
+                return
+            yield self._entry(off)
+            produced += 1
+
+
+def scan_journal_dir(path: str) -> List[str]:
+    """All .journal files under a journald directory tree."""
+    out = []
+    for base, _dirs, files in os.walk(path):
+        for f in files:
+            if f.endswith(".journal"):
+                out.append(os.path.join(base, f))
+    out.sort()
+    return out

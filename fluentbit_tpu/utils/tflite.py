@@ -1,0 +1,314 @@
+"""TF-Lite model loader + batched float32 executor.
+
+Reference: plugins/filter_tensorflow/tensorflow.c drives the vendored
+TF-Lite C API (TfLiteModelCreateFromFile → Invoke); here the .tflite
+FlatBuffers schema (tensorflow/lite/schema/schema.fbs) is read with
+`utils/flatbuf.py` and a float32 subset of the builtin operators is
+executed with numpy over a whole BATCH of inputs at once — the filter
+stacks every record in the chunk into one forward pass instead of one
+Invoke per record.
+
+Field ids below follow schema.fbs declaration order (flatbuffers
+assigns id = position unless annotated). Supported builtins:
+FULLY_CONNECTED, CONV_2D (NHWC), MAX_POOL_2D, AVERAGE_POOL_2D, ADD,
+MUL, SUB, RELU, RELU6, LOGISTIC, TANH, SOFTMAX, RESHAPE, MEAN.
+Anything else raises TFLiteError naming the op, so unsupported models
+fail loudly at load (the reference fails inside TfLiteInterpreter the
+same way).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .flatbuf import root
+
+# TensorType enum (schema.fbs)
+FLOAT32, INT32, UINT8, INT64 = 0, 2, 3, 4
+
+# BuiltinOperator codes (schema.fbs)
+OP_ADD = 0
+OP_AVERAGE_POOL_2D = 1
+OP_CONV_2D = 3
+OP_FULLY_CONNECTED = 9
+OP_LOGISTIC = 14
+OP_MAX_POOL_2D = 17
+OP_MUL = 18
+OP_RELU = 19
+OP_RELU6 = 21
+OP_RESHAPE = 22
+OP_SOFTMAX = 25
+OP_TANH = 28
+OP_SUB = 41
+OP_MEAN = 40
+
+_OP_NAMES = {
+    OP_ADD: "ADD", OP_AVERAGE_POOL_2D: "AVERAGE_POOL_2D",
+    OP_CONV_2D: "CONV_2D", OP_FULLY_CONNECTED: "FULLY_CONNECTED",
+    OP_LOGISTIC: "LOGISTIC", OP_MAX_POOL_2D: "MAX_POOL_2D",
+    OP_MUL: "MUL", OP_RELU: "RELU", OP_RELU6: "RELU6",
+    OP_RESHAPE: "RESHAPE", OP_SOFTMAX: "SOFTMAX", OP_TANH: "TANH",
+    OP_SUB: "SUB", OP_MEAN: "MEAN",
+}
+
+# ActivationFunctionType enum
+ACT_NONE, ACT_RELU, ACT_RELU_N1_TO_1, ACT_RELU6, ACT_TANH = 0, 1, 2, 3, 4
+
+
+class TFLiteError(ValueError):
+    pass
+
+
+def _activation(x: np.ndarray, act: int) -> np.ndarray:
+    if act == ACT_NONE:
+        return x
+    if act == ACT_RELU:
+        return np.maximum(x, 0.0)
+    if act == ACT_RELU_N1_TO_1:
+        return np.clip(x, -1.0, 1.0)
+    if act == ACT_RELU6:
+        return np.clip(x, 0.0, 6.0)
+    if act == ACT_TANH:
+        return np.tanh(x)
+    raise TFLiteError(f"unsupported fused activation {act}")
+
+
+class _TensorInfo:
+    __slots__ = ("shape", "dtype", "buffer", "name")
+
+    def __init__(self, shape, dtype, buffer, name):
+        self.shape = shape
+        self.dtype = dtype
+        self.buffer = buffer
+        self.name = name
+
+
+class Model:
+    """One loaded subgraph, runnable over a batch of inputs."""
+
+    def __init__(self, binary: bytes):
+        if len(binary) < 8:
+            raise TFLiteError("truncated tflite file")
+        # file_identifier "TFL3" at offset 4 (optional but emitted by
+        # every converter)
+        if binary[4:8] not in (b"TFL3", b"\x00\x00\x00\x00"):
+            raise TFLiteError("not a TFLite flatbuffer (missing TFL3)")
+        m = root(binary)
+        # Model: version(0) operator_codes(1) subgraphs(2)
+        # description(3) buffers(4)
+        self.version = m.u32(0, 0)
+        opcodes = m.table_vector(1)
+        subgraphs = m.table_vector(2)
+        buffers = m.table_vector(4)
+        if not subgraphs:
+            raise TFLiteError("model has no subgraph")
+        self._builtins: List[int] = []
+        for oc in opcodes:
+            # OperatorCode: deprecated_builtin_code(0, i8),
+            # custom_code(1), version(2), builtin_code(3, i32)
+            code = oc.i32(3, 0)
+            if code == 0:
+                code = oc.i8(0, 0)
+            self._builtins.append(code)
+        g = subgraphs[0]
+        # SubGraph: tensors(0) inputs(1) outputs(2) operators(3) name(4)
+        self.tensors: List[_TensorInfo] = []
+        for t in g.table_vector(0):
+            # Tensor: shape(0) type(1) buffer(2) name(3) quantization(4)
+            shape = t.i32_vector(0)
+            dtype = t.i8(1, 0)
+            bidx = t.u32(2, 0)
+            data = buffers[bidx].bytes_vector(0) if bidx < len(buffers) \
+                else b""
+            self.tensors.append(
+                _TensorInfo(shape, dtype, data, t.string(3)))
+        self.inputs = g.i32_vector(1)
+        self.outputs = g.i32_vector(2)
+        self.operators = []
+        for op in g.table_vector(3):
+            # Operator: opcode_index(0) inputs(1) outputs(2)
+            # builtin_options_type(3) builtin_options(4)
+            idx = op.u32(0, 0)
+            if idx >= len(self._builtins):
+                raise TFLiteError("bad opcode index")
+            code = self._builtins[idx]
+            if code not in _OP_NAMES:
+                raise TFLiteError(
+                    f"unsupported builtin operator {code}")
+            self.operators.append(
+                (code, op.i32_vector(1), op.i32_vector(2),
+                 op.table(4)))
+        if len(self.inputs) != 1 or len(self.outputs) != 1:
+            raise TFLiteError("exactly one input and one output "
+                              "tensor are supported")
+        ti = self.tensors[self.inputs[0]]
+        if ti.dtype != FLOAT32:
+            raise TFLiteError("only float32 input tensors supported")
+        self.input_shape = list(ti.shape)
+        self.output_shape = list(self.tensors[self.outputs[0]].shape)
+
+    # -- constants -----------------------------------------------------
+
+    def _const(self, idx: int) -> np.ndarray:
+        t = self.tensors[idx]
+        if not t.buffer:
+            raise TFLiteError(
+                f"tensor {idx} ({t.name}) has no constant data")
+        if t.dtype == FLOAT32:
+            arr = np.frombuffer(t.buffer, dtype=np.float32)
+        elif t.dtype == INT32:
+            arr = np.frombuffer(t.buffer, dtype=np.int32)
+        elif t.dtype == INT64:
+            arr = np.frombuffer(t.buffer, dtype=np.int64)
+        else:
+            raise TFLiteError(f"unsupported constant dtype {t.dtype}")
+        return arr.reshape(t.shape) if t.shape else arr
+
+    # -- execution -----------------------------------------------------
+
+    def run(self, batch: np.ndarray) -> np.ndarray:
+        """batch: [N, *input_shape[1:]] float32 → [N, *output[1:]]."""
+        vals: Dict[int, np.ndarray] = {}
+        x = np.asarray(batch, dtype=np.float32)
+        per_rec = list(self.input_shape[1:])
+        x = x.reshape([x.shape[0]] + per_rec)
+        vals[self.inputs[0]] = x
+        n = x.shape[0]
+        for code, ins, outs, opts in self.operators:
+            get = (lambda i: vals[i] if i in vals else self._const(i))
+            if code == OP_FULLY_CONNECTED:
+                a = get(ins[0])
+                w = get(ins[1])  # [units, in]
+                a2 = a.reshape(n, -1)
+                y = a2 @ w.T
+                if len(ins) > 2 and ins[2] >= 0:
+                    y = y + get(ins[2])
+                # FullyConnectedOptions: fused_activation_function(0)
+                y = _activation(y, opts.i8(0, 0) if opts else 0)
+            elif code == OP_CONV_2D:
+                y = self._conv2d(get(ins[0]), get(ins[1]),
+                                 get(ins[2]) if len(ins) > 2 else None,
+                                 opts)
+            elif code in (OP_MAX_POOL_2D, OP_AVERAGE_POOL_2D):
+                y = self._pool(get(ins[0]), opts,
+                               avg=(code == OP_AVERAGE_POOL_2D))
+            elif code == OP_ADD:
+                y = _activation(get(ins[0]) + get(ins[1]),
+                                opts.i8(0, 0) if opts else 0)
+            elif code == OP_SUB:
+                y = _activation(get(ins[0]) - get(ins[1]),
+                                opts.i8(0, 0) if opts else 0)
+            elif code == OP_MUL:
+                y = _activation(get(ins[0]) * get(ins[1]),
+                                opts.i8(0, 0) if opts else 0)
+            elif code == OP_RELU:
+                y = np.maximum(get(ins[0]), 0.0)
+            elif code == OP_RELU6:
+                y = np.clip(get(ins[0]), 0.0, 6.0)
+            elif code == OP_LOGISTIC:
+                y = 1.0 / (1.0 + np.exp(-get(ins[0])))
+            elif code == OP_TANH:
+                y = np.tanh(get(ins[0]))
+            elif code == OP_SOFTMAX:
+                # SoftmaxOptions: beta(0) — softmax(beta * x)
+                a = get(ins[0]) * (opts.f32(0, 1.0) if opts else 1.0)
+                e = np.exp(a - a.max(axis=-1, keepdims=True))
+                y = e / e.sum(axis=-1, keepdims=True)
+            elif code == OP_RESHAPE:
+                shape = (list(get(ins[1]).astype(int))
+                         if len(ins) > 1 else
+                         list(opts.i32_vector(0)) if opts else [])
+                if not shape:
+                    raise TFLiteError("reshape without target shape")
+                shape = [n if i == 0 else int(s)
+                         for i, s in enumerate(shape)]
+                y = get(ins[0]).reshape(shape)
+            elif code == OP_MEAN:
+                axes = tuple(int(a) for a in get(ins[1]).ravel())
+                y = get(ins[0]).mean(axis=axes)
+            else:  # pragma: no cover — load() already rejected it
+                raise TFLiteError(
+                    f"unsupported op {_OP_NAMES.get(code, code)}")
+            vals[outs[0]] = np.asarray(y, dtype=np.float32)
+        return vals[self.outputs[0]].reshape(n, -1)
+
+    @staticmethod
+    def _conv2d(x, w, b, opts):
+        # Conv2DOptions: padding(0) stride_w(1) stride_h(2)
+        # fused_activation_function(3)
+        padding = opts.i8(0, 0) if opts else 0  # 0=SAME 1=VALID
+        sw = opts.i32(1, 1) if opts else 1
+        sh = opts.i32(2, 1) if opts else 1
+        act = opts.i8(3, 0) if opts else 0
+        n, h, wd, cin = x.shape
+        co, kh, kw, _ = w.shape  # [out, kh, kw, in]
+        if padding == 0:  # SAME
+            oh = -(-h // sh)
+            ow = -(-wd // sw)
+            ph = max(0, (oh - 1) * sh + kh - h)
+            pw = max(0, (ow - 1) * sw + kw - wd)
+            x = np.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                           (pw // 2, pw - pw // 2), (0, 0)))
+            h, wd = x.shape[1], x.shape[2]
+        oh = (h - kh) // sh + 1
+        ow = (wd - kw) // sw + 1
+        out = np.zeros((n, oh, ow, co), dtype=np.float32)
+        for i in range(kh):
+            for j in range(kw):
+                patch = x[:, i:i + oh * sh:sh, j:j + ow * sw:sw, :]
+                out += np.einsum("nhwc,oc->nhwo", patch, w[:, i, j, :])
+        if b is not None:
+            out = out + b
+        return _activation(out, act)
+
+    @staticmethod
+    def _pool(x, opts, avg: bool):
+        # Pool2DOptions: padding(0) stride_w(1) stride_h(2)
+        # filter_width(3) filter_height(4) fused_activation(5)
+        padding = opts.i8(0, 0) if opts else 0  # 0=SAME 1=VALID
+        sw = opts.i32(1, 1) if opts else 1
+        sh = opts.i32(2, 1) if opts else 1
+        fw = opts.i32(3, 1) if opts else 1
+        fh = opts.i32(4, 1) if opts else 1
+        act = opts.i8(5, 0) if opts else 0
+        n, h, wd, c = x.shape
+        counts = None
+        if padding == 0:  # SAME: ceil-div output, edge padding
+            oh = -(-h // sh)
+            ow = -(-wd // sw)
+            ph = max(0, (oh - 1) * sh + fh - h)
+            pw = max(0, (ow - 1) * sw + fw - wd)
+            pad_spec = ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0))
+            if avg:
+                # TFLite SAME avg pool averages VALID elements only
+                ones = np.pad(np.ones_like(x), pad_spec)
+                x = np.pad(x, pad_spec)
+                counts = ones
+            else:
+                x = np.pad(x, pad_spec,
+                           constant_values=-np.float32(np.inf))
+            h, wd = x.shape[1], x.shape[2]
+        oh = (h - fh) // sh + 1
+        ow = (wd - fw) // sw + 1
+        stack = []
+        cstack = []
+        for i in range(fh):
+            for j in range(fw):
+                stack.append(x[:, i:i + oh * sh:sh,
+                               j:j + ow * sw:sw, :])
+                if counts is not None:
+                    cstack.append(counts[:, i:i + oh * sh:sh,
+                                         j:j + ow * sw:sw, :])
+        block = np.stack(stack)
+        if avg:
+            if counts is not None:
+                y = block.sum(axis=0) / np.maximum(
+                    np.stack(cstack).sum(axis=0), 1.0)
+            else:
+                y = block.mean(axis=0)
+        else:
+            y = block.max(axis=0)
+        return _activation(y, act)

@@ -121,3 +121,47 @@ def test_apache2_bulk_bit_exact():
     assert (got == expect).all()
     scalar = np.array([dfa.match_bytes(ln) for ln in lines])
     assert (got == scalar).all()
+
+
+def test_assoc_kernel_bit_exact_vs_scan():
+    """The parallel-in-time (function-composition) kernel must be
+    bit-identical to the sequential scan kernel on every input class:
+    matches, misses, empty, padding-only, overflow rows."""
+    rng = random.Random(4242)
+    patterns = ["GET", r"^\d+$", APACHE2]
+    dfas = [compile_dfa(p) for p in patterns]
+    lines = make_lines(97, rng) + [b"", b"x" * 999, None]
+    b = assemble(lines, max_len=192)
+    batch = np.stack([b.batch] * 3)
+    lengths = np.stack([b.lengths] * 3)
+    scan_prog = GrepProgram(dfas, max_len=192, kernel="scan")
+    for seg in (2, 8, 32, 1024):  # incl. seg > Lk (single segment)
+        assoc_prog = GrepProgram(dfas, max_len=192, kernel="assoc",
+                                 segment=seg)
+        got_scan = scan_prog.match(batch, lengths)
+        got_assoc = assoc_prog.match(batch, lengths)
+        assert (got_scan == got_assoc).all(), f"segment={seg}"
+    # and vs the ground-truth CPU matcher on the valid rows
+    expect = np.array([dfas[0].match_bytes(ln)
+                       if isinstance(ln, bytes) and len(ln) <= 192
+                       else False for ln in lines])
+    assert (got_assoc[0] == expect).all()
+
+
+def test_assoc_kernel_sharded_matches_single_device():
+    import jax
+    from jax.sharding import Mesh
+
+    rng = random.Random(77)
+    dfas = [compile_dfa("GET"), compile_dfa(APACHE2)]
+    lines = make_lines(41, rng)
+    b = assemble(lines, max_len=128)
+    batch = np.stack([b.batch] * 2)
+    lengths = np.stack([b.lengths] * 2)
+    prog = GrepProgram(dfas, max_len=128, kernel="assoc", segment=8)
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs[:8]), ("batch",))
+    mask, counts, _ = prog.match_sharded(mesh, batch, lengths)
+    single = prog.match(batch, lengths)
+    assert (mask == single).all()
+    assert (counts == single.sum(axis=1)).all()

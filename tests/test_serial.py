@@ -94,3 +94,11 @@ def test_json_mode_hard_invalid_byte_drops_buffer():
                         format="json")
     bodies = [ev.body["msg"] for ev in events[:2]]
     assert bodies == [{"a": 1}, {"c": 3}]
+
+
+def test_json_mode_garbage_head_resyncs():
+    # trailing bad byte retained as a possible truncated tail must not
+    # poison the next read's valid records
+    events = run_serial([b'{"a":1}\xff', b'{"b":2} '], 2, format="json")
+    bodies = [ev.body["msg"] for ev in events[:2]]
+    assert bodies == [{"a": 1}, {"b": 2}]

@@ -1,0 +1,234 @@
+"""in_systemd + the from-scratch journal-file reader.
+
+Journal files are produced by an independent writer below that lays
+objects out per systemd.io/JOURNAL_FILE_FORMAT (regular and compact
+layouts, XZ/ZSTD-compressed payloads), so the reader in
+utils/journal.py cannot self-confirm. Reference:
+plugins/in_systemd/systemd.c."""
+
+import lzma
+import os
+import struct
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.core.plugin import registry
+from fluentbit_tpu.utils.journal import (
+    F_COMPACT,
+    F_COMPRESSED_XZ,
+    F_COMPRESSED_ZSTD,
+    JournalFile,
+)
+from fluentbit_tpu.utils import zstd as zstd_mod
+
+
+# ------------------------------------------------- journal writer
+
+def _obj(buf, otype, payload, flags=0):
+    """Append one object (16-byte header + payload, 8-aligned)."""
+    while len(buf) % 8:
+        buf.append(0)
+    off = len(buf)
+    size = 16 + len(payload)
+    buf += bytes([otype, flags]) + b"\0" * 6 + struct.pack("<Q", size)
+    buf += payload
+    return off
+
+
+def write_journal(path, entries, compact=False, compress=None):
+    """entries: list of (realtime_usec, [(key, value), ...])."""
+    incompatible = 0
+    if compact:
+        incompatible |= F_COMPACT
+    if compress == "xz":
+        incompatible |= F_COMPRESSED_XZ
+    elif compress == "zstd":
+        incompatible |= F_COMPRESSED_ZSTD
+    buf = bytearray()
+    buf += b"LPKSHHRH"
+    buf += struct.pack("<II", 0, incompatible)  # compatible, incompat
+    buf += bytes([1]) + b"\0" * 7                # state ONLINE + pad
+    buf += b"\x11" * 16 + b"\x22" * 16 + b"\x33" * 16 + b"\x44" * 16
+    header_fix = len(buf)
+    # header_size..tail_entry_monotonic placeholders (15 u64)
+    buf += b"\0" * (15 * 8)
+    header_size = len(buf)
+
+    entry_offsets = []
+    for seq, (realtime, fields) in enumerate(entries, start=1):
+        data_offs = []
+        for k, v in fields:
+            payload = f"{k}={v}".encode()
+            oflags = 0
+            if compress == "xz":
+                comp = lzma.compress(payload)
+                if True:  # journald compresses large fields; we force
+                    payload, oflags = comp, 1
+            elif compress == "zstd":
+                payload, oflags = zstd_mod.compress(payload), 4
+            body = struct.pack("<QQQQQQ", 0, 0, 0, 0, 0, 0)
+            if compact:
+                body += struct.pack("<II", 0, 0)
+            data_offs.append(_obj(buf, 1, body + payload, oflags))
+        items = b""
+        if compact:
+            for off in data_offs:
+                items += struct.pack("<I", off)
+        else:
+            for off in data_offs:
+                items += struct.pack("<QQ", off, 0)
+        entry_body = struct.pack("<QQQ", seq, realtime, realtime)
+        entry_body += b"\x55" * 16 + struct.pack("<Q", 0)  # boot, xor
+        entry_offsets.append(_obj(buf, 3, entry_body + items))
+
+    # one entry array holding every entry (+ one zero pad slot)
+    fmt = "<I" if compact else "<Q"
+    items = b"".join(struct.pack(fmt, o) for o in entry_offsets)
+    items += struct.pack(fmt, 0)
+    ea_off = _obj(buf, 6, struct.pack("<Q", 0) + items)
+
+    struct.pack_into(
+        "<QQQQQQQQQQQQQQQ", buf, header_fix,
+        header_size,                 # header_size
+        len(buf) - header_size,      # arena_size
+        0, 0, 0, 0,                  # data/field hash tables (absent)
+        ea_off,                      # tail_object_offset
+        2 * len(entries) + 1,        # n_objects (approx)
+        len(entries),                # n_entries
+        len(entries),                # tail_entry_seqnum
+        1 if entries else 0,         # head_entry_seqnum
+        ea_off,                      # entry_array_offset
+        entries[0][0] if entries else 0,   # head realtime
+        entries[-1][0] if entries else 0,  # tail realtime
+        entries[-1][0] if entries else 0,  # tail monotonic
+    )
+    with open(path, "wb") as f:
+        f.write(buf)
+
+
+SAMPLE = [
+    (1_700_000_000_000_000, [
+        ("MESSAGE", "boot ok"), ("_SYSTEMD_UNIT", "kernel.service"),
+        ("PRIORITY", "6")]),
+    (1_700_000_001_000_000, [
+        ("MESSAGE", "nginx started"),
+        ("_SYSTEMD_UNIT", "nginx.service"),
+        ("_SOURCE_REALTIME_TIMESTAMP", "1700000000500000")]),
+    (1_700_000_002_000_000, [
+        ("MESSAGE", "nginx reload"),
+        ("_SYSTEMD_UNIT", "nginx.service"), ("PRIORITY", "5")]),
+]
+
+
+@pytest.mark.parametrize("compact", [False, True])
+def test_reader_layouts(tmp_path, compact):
+    p = tmp_path / "a.journal"
+    write_journal(str(p), SAMPLE, compact=compact)
+    jf = JournalFile(str(p))
+    assert jf.n_entries == 3 and jf.compact == compact
+    got = list(jf.entries())
+    assert [e.seqnum for e in got] == [1, 2, 3]
+    assert dict(got[0].fields)["MESSAGE"] == "boot ok"
+    assert got[1].realtime == SAMPLE[1][0]
+
+
+@pytest.mark.parametrize("codec", ["xz", "zstd"])
+def test_reader_compressed_payloads(tmp_path, codec):
+    if codec == "zstd" and not zstd_mod.available():
+        pytest.skip("libzstd absent")
+    p = tmp_path / "c.journal"
+    write_journal(str(p), SAMPLE[:2], compress=codec)
+    got = list(JournalFile(str(p)).entries())
+    assert dict(got[0].fields)["MESSAGE"] == "boot ok"
+    assert dict(got[1].fields)["_SYSTEMD_UNIT"] == "nginx.service"
+
+
+def test_reader_skip_resume(tmp_path):
+    p = tmp_path / "s.journal"
+    write_journal(str(p), SAMPLE)
+    jf = JournalFile(str(p))
+    assert [e.seqnum for e in jf.entries(skip=2)] == [3]
+    assert len(list(jf.entries(skip=0, max_entries=1))) == 1
+
+
+def run_systemd(tmp_path, records, **props):
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("systemd", tag=props.pop("tag", "sd"),
+              path=str(tmp_path), **props)
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(
+                   (tag, ev) for ev in decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while len(got) < records and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    return got
+
+
+def test_input_records_and_source_timestamp(tmp_path):
+    write_journal(str(tmp_path / "x.journal"), SAMPLE)
+    got = run_systemd(tmp_path, 3)
+    assert len(got) == 3
+    tag, ev = got[1]
+    assert ev.body["MESSAGE"] == "nginx started"
+    # _SOURCE_REALTIME_TIMESTAMP wins over the entry realtime
+    assert abs(ev.ts_float - 1700000000.5) < 0.001
+
+
+def test_dynamic_tag_filters_and_transforms(tmp_path):
+    write_journal(str(tmp_path / "x.journal"), SAMPLE)
+    got = run_systemd(
+        tmp_path, 2, tag="journal.*",
+        systemd_filter="_SYSTEMD_UNIT=nginx.service",
+        lowercase="on", strip_underscores="on")
+    assert len(got) == 2
+    tags = {t for t, _ in got}
+    assert tags == {"journal.nginx.service"}
+    _, ev = got[0]
+    assert ev.body["systemd_unit"] == "nginx.service"  # transformed
+
+
+def test_db_resume_and_tail(tmp_path):
+    jdir = tmp_path / "j"
+    jdir.mkdir()
+    db = tmp_path / "pos.db"
+    write_journal(str(jdir / "x.journal"), SAMPLE)
+    got = run_systemd(jdir, 3, db=str(db))
+    assert len(got) == 3
+    # second run with the same db: nothing re-emitted
+    got2 = run_systemd(jdir, 1, db=str(db))
+    assert got2 == []
+    # read_from_tail skips the backlog entirely
+    got3 = run_systemd(jdir, 1, read_from_tail="on")
+    assert got3 == []
+
+
+def test_rotation_cursor_keyed_by_file_id(tmp_path):
+    """journald rotation renames the file; the file_id-keyed cursor
+    must neither re-emit the archived entries nor skip the fresh
+    file's first entries."""
+    jdir = tmp_path / "j"
+    jdir.mkdir()
+    write_journal(str(jdir / "system.journal"), SAMPLE)
+    db = tmp_path / "pos.db"
+    got = run_systemd(jdir, 3, db=str(db))
+    assert len(got) == 3
+    # rotate: archive under a new name, fresh file with ONE new entry
+    os.rename(str(jdir / "system.journal"),
+              str(jdir / "system@0001.journal"))
+    fresh = [(1_700_000_009_000_000, [("MESSAGE", "fresh"),
+                                      ("_SYSTEMD_UNIT", "new.service")])]
+    write_journal(str(jdir / "system.journal"), fresh)
+    # make the fresh file's file_id differ from the archived one
+    raw = bytearray((jdir / "system.journal").read_bytes())
+    raw[24:40] = b"\x77" * 16
+    (jdir / "system.journal").write_bytes(bytes(raw))
+    got2 = run_systemd(jdir, 1, db=str(db))
+    assert [ev.body["MESSAGE"] for _, ev in got2] == ["fresh"]

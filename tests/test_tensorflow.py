@@ -1,0 +1,308 @@
+"""filter_tensorflow + the from-scratch TF-Lite loader/executor.
+
+The .tflite file is produced by an independent FlatBuffers builder
+below (children written after parents, forward UOffsets, per-field
+vtable slots — the wire layout of flatbuffers.dev/internals), so the
+reader in utils/flatbuf.py cannot self-confirm.
+Reference: plugins/filter_tensorflow/tensorflow.c."""
+
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.core.plugin import FilterResult, registry
+from fluentbit_tpu.utils.tflite import Model, TFLiteError
+
+
+# ---------------------------------------------------- fb builder
+
+class T:
+    """Table spec: {field_id: value}. Values: ('i8',n) ('i32',n)
+    ('f32',x) ('bool',b) ('str',s) ('i32v',[..]) ('bytes',b'') (T)
+    ('tabv',[T,..])"""
+
+    def __init__(self, fields):
+        self.fields = fields
+
+
+def _build(out: bytearray, t: T) -> int:
+    fids = sorted(t.fields)
+    n_slots = (max(fids) + 1) if fids else 0
+    vt_size = 4 + 2 * n_slots
+    while len(out) % 4:
+        out.append(0)
+    vt_pos = len(out)
+    tbl_pos = vt_pos + vt_size
+    if tbl_pos % 4:
+        pad = 4 - tbl_pos % 4
+        vt_size += pad  # pad between vtable and table
+        tbl_pos += pad
+    # table: i32 back-offset to vtable, then one 4-byte slot per field
+    slot_of = {}
+    off = 4
+    for fid in fids:
+        slot_of[fid] = off
+        off += 4
+    tbl_size = off
+    vt = struct.pack("<HH", 4 + 2 * n_slots, tbl_size)
+    slots = bytearray(2 * n_slots)
+    for fid in fids:
+        struct.pack_into("<H", slots, 2 * fid, slot_of[fid])
+    out += vt + slots
+    while len(out) < tbl_pos:
+        out.append(0)
+    out += struct.pack("<i", tbl_pos - vt_pos)
+    body_pos = len(out)
+    patches = []  # (slot_abs, child)
+    for fid in fids:
+        kind = t.fields[fid]
+        abs_slot = tbl_pos + slot_of[fid]
+        assert len(out) == abs_slot
+        if isinstance(kind, T):
+            patches.append((abs_slot, kind))
+            out += b"\0\0\0\0"
+            continue
+        tag, val = kind
+        if tag == "i8":
+            out += struct.pack("<b", val) + b"\0\0\0"
+        elif tag == "bool":
+            out += bytes([1 if val else 0]) + b"\0\0\0"
+        elif tag == "i32":
+            out += struct.pack("<i", val)
+        elif tag == "u32":
+            out += struct.pack("<I", val)
+        elif tag == "f32":
+            out += struct.pack("<f", val)
+        else:  # offset kinds
+            patches.append((abs_slot, kind))
+            out += b"\0\0\0\0"
+    for abs_slot, child in patches:
+        while len(out) % 4:
+            out.append(0)
+        if isinstance(child, T):
+            child_pos = _build(out, child)
+        else:
+            tag, val = child
+            child_pos = len(out)
+            if tag == "str":
+                raw = val.encode()
+                out += struct.pack("<I", len(raw)) + raw + b"\0"
+            elif tag == "bytes":
+                out += struct.pack("<I", len(val)) + bytes(val)
+            elif tag == "i32v":
+                out += struct.pack("<I", len(val))
+                out += struct.pack(f"<{len(val)}i", *val)
+            elif tag == "tabv":
+                out += struct.pack("<I", len(val))
+                vec_pos = len(out)
+                out += b"\0\0\0\0" * len(val)
+                for i, sub in enumerate(val):
+                    while len(out) % 4:
+                        out.append(0)
+                    sub_pos = _build(out, sub)
+                    slot = vec_pos + 4 * i
+                    struct.pack_into("<I", out, slot, sub_pos - slot)
+            else:
+                raise AssertionError(tag)
+        struct.pack_into("<I", out, abs_slot, child_pos - abs_slot)
+    return tbl_pos
+
+
+def build_tflite(model: T) -> bytes:
+    out = bytearray(b"\0\0\0\0TFL3")
+    root_pos = _build(out, model)
+    struct.pack_into("<I", out, 0, root_pos)
+    return bytes(out)
+
+
+# ------------------------------------------------ model: MLP 4→3
+
+W = np.array([[0.5, -1.0, 0.25, 2.0],
+              [1.0, 1.0, 1.0, 1.0],
+              [-0.5, 0.5, -0.25, 0.0]], dtype=np.float32)
+BIAS = np.array([0.1, -0.2, 0.3], dtype=np.float32)
+
+
+def tensor(shape, dtype, buffer_idx, name):
+    return T({0: ("i32v", shape), 1: ("i8", dtype),
+              2: ("u32", buffer_idx), 3: ("str", name)})
+
+
+def mlp_model() -> bytes:
+    # tensors: 0 input [1,4], 1 W [3,4], 2 bias [3], 3 fc out [1,3],
+    # 4 softmax out [1,3]
+    subgraph = T({
+        0: ("tabv", [
+            tensor([1, 4], 0, 0, "input"),
+            tensor([3, 4], 0, 1, "w"),
+            tensor([3], 0, 2, "b"),
+            tensor([1, 3], 0, 0, "fc"),
+            tensor([1, 3], 0, 0, "prob"),
+        ]),
+        1: ("i32v", [0]),
+        2: ("i32v", [4]),
+        3: ("tabv", [
+            # FULLY_CONNECTED with fused RELU (activation=1)
+            T({0: ("u32", 0), 1: ("i32v", [0, 1, 2]),
+               2: ("i32v", [3]), 4: T({0: ("i8", 1)})}),
+            # SOFTMAX
+            T({0: ("u32", 1), 1: ("i32v", [3]), 2: ("i32v", [4])}),
+        ]),
+        4: ("str", "main"),
+    })
+    model = T({
+        0: ("u32", 3),
+        1: ("tabv", [
+            T({3: ("i32", 9)}),    # FULLY_CONNECTED
+            T({3: ("i32", 25)}),   # SOFTMAX
+        ]),
+        2: ("tabv", [subgraph]),
+        3: ("str", "test mlp"),
+        4: ("tabv", [
+            T({}),  # buffer 0: empty (activations)
+            T({0: ("bytes", W.tobytes())}),
+            T({0: ("bytes", BIAS.tobytes())}),
+        ]),
+    })
+    return build_tflite(model)
+
+
+def expected(batch: np.ndarray) -> np.ndarray:
+    y = np.maximum(batch @ W.T + BIAS, 0.0)
+    e = np.exp(y - y.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def test_model_loads_and_runs_batched():
+    m = Model(mlp_model())
+    assert m.input_shape == [1, 4] and m.output_shape == [1, 3]
+    batch = np.array([[1, 2, 3, 4], [0, 0, 0, 0], [-1, 5, 0.5, 2]],
+                     dtype=np.float32)
+    got = m.run(batch)
+    np.testing.assert_allclose(got, expected(batch), rtol=1e-5)
+
+
+def test_unsupported_op_rejected():
+    bad = T({
+        0: ("u32", 3),
+        1: ("tabv", [T({3: ("i32", 32)})]),  # CUSTOM
+        2: ("tabv", [T({
+            0: ("tabv", [tensor([1, 4], 0, 0, "input")]),
+            1: ("i32v", [0]), 2: ("i32v", [0]),
+            3: ("tabv", [T({0: ("u32", 0), 1: ("i32v", [0]),
+                            2: ("i32v", [0])})]),
+        })]),
+        4: ("tabv", [T({})]),
+    })
+    with pytest.raises(TFLiteError, match="unsupported"):
+        Model(build_tflite(bad))
+
+
+def make_filter(tmp_path, **props):
+    path = tmp_path / "model.tflite"
+    path.write_bytes(mlp_model())
+    ins = registry.create_filter("tensorflow")
+    ins.set("input_field", "data")
+    ins.set("model_file", str(path))
+    for k, v in props.items():
+        ins.set(k, v)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def events(bodies):
+    return [decode_events(encode_event(b, float(i)))[0]
+            for i, b in enumerate(bodies)]
+
+
+def test_filter_inference_output(tmp_path):
+    plug = make_filter(tmp_path)
+    evs = events([{"data": [1, 2, 3, 4], "k": "v"},
+                  {"nodata": True},
+                  {"data": [0, 0, 0, 0]}])
+    res, out = plug.filter(evs, "t", None)
+    assert res == FilterResult.MODIFIED
+    exp = expected(np.array([[1, 2, 3, 4], [0, 0, 0, 0]],
+                            dtype=np.float32))
+    np.testing.assert_allclose(out[0].body["output"], exp[0], rtol=1e-5)
+    np.testing.assert_allclose(out[2].body["output"], exp[1], rtol=1e-5)
+    assert out[0].body["k"] == "v"  # include_input_fields default true
+    assert out[0].body["inference_time"] > 0
+    assert out[1].body == {"nodata": True}  # untouched passthrough
+
+
+def test_filter_exclude_inputs_and_normalization(tmp_path):
+    plug = make_filter(tmp_path, include_input_fields="off",
+                       normalization_value="2.0")
+    evs = events([{"data": [2, 4, 6, 8], "extra": 1}])
+    res, out = plug.filter(evs, "t", None)
+    assert res == FilterResult.MODIFIED
+    exp = expected(np.array([[1, 2, 3, 4]], dtype=np.float32))
+    np.testing.assert_allclose(out[0].body["output"], exp[0], rtol=1e-5)
+    assert "extra" not in out[0].body
+
+
+def test_filter_size_mismatch_passthrough(tmp_path):
+    plug = make_filter(tmp_path)
+    evs = events([{"data": [1, 2]}])
+    res, out = plug.filter(evs, "t", None)
+    assert res == FilterResult.NOTOUCH
+
+
+def test_filter_runtime_pipeline(tmp_path):
+    path = tmp_path / "model.tflite"
+    path.write_bytes(mlp_model())
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("dummy", tag="t", dummy='{"data": [1, 2, 3, 4]}',
+              rate="10", samples="2")
+    ctx.filter("tensorflow", match="t", input_field="data",
+               model_file=str(path))
+    ctx.output("lib", match="*",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+    exp = expected(np.array([[1, 2, 3, 4]], dtype=np.float32))
+    assert len(got) >= 2
+    np.testing.assert_allclose(got[0].body["output"], exp[0], rtol=1e-5)
+
+
+def test_corrupt_model_clean_config_error(tmp_path):
+    path = tmp_path / "bad.tflite"
+    path.write_bytes(b"\0\0\0\x40TFL3trunc")
+    ins = registry.create_filter("tensorflow")
+    ins.set("input_field", "data")
+    ins.set("model_file", str(path))
+    ins.configure()
+    with pytest.raises(ValueError, match="tensorflow"):
+        ins.plugin.init(ins, None)
+
+
+def test_pool_same_padding_and_softmax_beta():
+    from fluentbit_tpu.utils.tflite import Model as M
+
+    class Opts:
+        """Pool2DOptions stand-in: SAME padding, 2x2/2 pooling."""
+        def i8(self, fid, d=0):
+            return {0: 0, 5: 0}.get(fid, d)
+
+        def i32(self, fid, d=0):
+            return {1: 2, 2: 2, 3: 2, 4: 2}.get(fid, d)
+
+    x = np.arange(25, dtype=np.float32).reshape(1, 5, 5, 1)
+    y = M._pool(x, Opts(), avg=False)
+    assert y.shape == (1, 3, 3, 1)  # ceil(5/2) = 3 with SAME
+    assert y[0, 2, 2, 0] == 24.0    # corner max over valid elements
+    ya = M._pool(x, Opts(), avg=True)
+    # corner averages only the single valid element, not padding
+    assert ya[0, 2, 2, 0] == 24.0
