@@ -27,6 +27,8 @@ fluentbit_tpu — TPU-native telemetry pipeline
 Options:
   -c, --config FILE     load a configuration file (classic INI or YAML)
   -R, --parser FILE     load a parsers file
+  -e, --plugin FILE     load a dynamic (.so) plugin (C ABI, see
+                        native/fbtpu_plugin.h)
   -i, --input NAME      add an input plugin instance
   -F, --filter NAME     add a filter plugin instance
   -o, --output NAME     add an output plugin instance
@@ -86,6 +88,11 @@ def build_context(argv):
             from fluentbit_tpu.config_format import _apply_parsers
 
             _apply_parsers(ctx, load_config_file(path, env=env))
+        elif a in ("-e", "--plugin"):
+            # dynamic .so plugin (flb_plugin_load, src/flb_plugin.c)
+            from fluentbit_tpu.core.dso import load_dso_plugin
+
+            load_dso_plugin(need_arg(a))
         elif a in ("-i", "--input"):
             last = ("input", ctx.input(need_arg(a)))
         elif a in ("-F", "--filter"):

@@ -157,6 +157,13 @@ def parse_yaml(text: str, base_dir: str = ".",
                 sec.properties.append((str(k), interp_val(v)))
         cf.sections.append(sec)
 
+    # top-level `plugins:` list of shared-object paths (the upstream
+    # YAML schema for dynamic plugins, flb_cf_yaml.c plugins key)
+    for p in doc.get("plugins") or []:
+        sec = Section("plugins")
+        sec.properties.append(("path", interp_val(p)))
+        cf.sections.append(sec)
+
     pipeline = doc.get("pipeline") or {}
     for kind, sec_name in (("inputs", "input"), ("filters", "filter"),
                            ("outputs", "output")):
@@ -183,6 +190,23 @@ def load_config_file(path: str, env: Optional[Dict[str, str]] = None) -> ConfigF
 _PARSER_FILE_KEYS = ("parsers_file", "parsers_files")
 
 
+def _apply_dso_plugins(cf: "ConfigFile", base_dir: str) -> None:
+    """[PLUGINS] sections: every `path` is dlopened + registered
+    (flb_plugin_load_config_format, src/flb_plugin.c:356)."""
+    for sec in cf.sections:
+        if sec.name != "plugins":
+            continue
+        from ..core.dso import load_dso_plugin
+
+        for key, value in sec.properties:
+            if key.lower() != "path":
+                raise ValueError(
+                    f"[PLUGINS] supports only 'path' (got {key!r})")
+            path = value if os.path.isabs(value) \
+                else os.path.join(base_dir, value)
+            load_dso_plugin(path)
+
+
 def apply_to_context(ctx, cf: ConfigFile, base_dir: str = ".") -> None:
     """Materialize a parsed config onto an FLBContext (the flb_cf →
     flb_config translation the CLI performs)."""
@@ -201,13 +225,21 @@ def apply_to_context(ctx, cf: ConfigFile, base_dir: str = ".") -> None:
                 path = value if os.path.isabs(value) \
                     else os.path.join(base_dir, value)
                 _apply_streams(ctx, load_config_file(path, env=cf.env))
+            elif lk == "plugins_file":
+                # flb_plugin_load_config_file: a file whose [PLUGINS]
+                # section lists shared objects to dlopen
+                path = value if os.path.isabs(value) \
+                    else os.path.join(base_dir, value)
+                _apply_dso_plugins(load_config_file(path, env=cf.env),
+                                   os.path.dirname(path))
             else:
                 ctx.service_set(**{lk: value})
+    _apply_dso_plugins(cf, base_dir)
     _apply_parsers(ctx, cf)
     _apply_streams(ctx, cf)
     for sec in cf.sections:
         if sec.name in ("service", "parser", "multiline_parser",
-                        "stream_task"):
+                        "stream_task", "plugins"):
             continue
         if sec.name not in ("input", "filter", "output", "custom"):
             raise ValueError(f"unknown config section [{sec.name}]")

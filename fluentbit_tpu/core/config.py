@@ -162,6 +162,10 @@ def apply_config_map(config_map: List[ConfigMapEntry], props: Properties,
             # allow shared/core keys handled by the engine itself
             if lk in CORE_INSTANCE_KEYS:
                 continue
+            if getattr(target, "allow_unknown_properties", False):
+                # dynamic (.so) plugins declare no config_map: every
+                # property passes through to the native side verbatim
+                continue
             raise ValueError(f"unknown property {key!r}")
         coerced = entry.coerce(value)
         attr = _attr_name(entry.name)

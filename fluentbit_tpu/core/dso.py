@@ -1,0 +1,223 @@
+"""Dynamic (.so) plugin loading — the flb_plugin.c role.
+
+Reference: src/flb_plugin.c:200-326 — ``flb_plugin_load`` dlopens a
+shared object, derives the registration symbol from the file name, and
+links the plugin struct into the registry; exposed via the CLI ``-e``
+flag and ``[PLUGINS]``/plugins-file config. The same contract here:
+``load_dso_plugin(path)`` loads a C ABI object (``native/
+fbtpu_plugin.h``), wraps its vtable in an InputPlugin/OutputPlugin
+subclass, and registers it under the struct's name. The reference
+proves native-language plugins with its Zig demo (lib/zig_fluent_bit);
+this build's proof is ``native/demo_plugins/`` built with g++ in the
+runtime tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("flb.dso")
+
+FBTPU_PLUGIN_ABI_VERSION = 1
+
+_EMIT_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.c_char_p, ctypes.c_longlong)
+
+
+class _OutputVtable(ctypes.Structure):
+    _fields_ = [
+        ("abi_version", ctypes.c_int),
+        ("name", ctypes.c_char_p),
+        ("description", ctypes.c_char_p),
+        ("init", ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_char_p)),
+        ("flush", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_longlong,
+            ctypes.c_char_p)),
+        ("destroy", ctypes.CFUNCTYPE(None, ctypes.c_void_p)),
+    ]
+
+
+class _InputVtable(ctypes.Structure):
+    _fields_ = [
+        ("abi_version", ctypes.c_int),
+        ("name", ctypes.c_char_p),
+        ("description", ctypes.c_char_p),
+        ("collect_interval", ctypes.c_double),
+        ("init", ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_char_p)),
+        ("collect", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_char_p, _EMIT_FN)),
+        ("destroy", ctypes.CFUNCTYPE(None, ctypes.c_void_p)),
+    ]
+
+
+def plugin_stem(path: str) -> str:
+    """File name → registration stem (path_to_plugin_name role): strip
+    directory, extension, and an optional flb- prefix."""
+    base = os.path.basename(path)
+    stem = base.split(".", 1)[0]
+    if stem.startswith("flb-"):
+        stem = stem[len("flb-"):]
+    return stem
+
+
+def _props_json(instance) -> bytes:
+    props = {}
+    for _lk, key, value in instance.properties._items:
+        props[key] = value if isinstance(value, (str, int, float, bool)) \
+            else str(value)
+    return json.dumps(props).encode()
+
+
+def load_dso_plugin(path: str, registry=None):
+    """dlopen + register; returns the new plugin class. Raises
+    ValueError on a malformed object (missing/unsupported symbol)."""
+    from .plugin import InputPlugin, OutputPlugin
+    from .plugin import registry as default_registry
+
+    reg = registry if registry is not None else default_registry
+    stem = plugin_stem(path)
+    symbol = f"{stem}_plugin"
+    if not stem.startswith(("in_", "out_")):
+        # cheap check FIRST — rejected objects must never be mapped
+        # (dlopen runs their static initializers)
+        raise ValueError(
+            f"cannot load plugin {path!r}: stem {stem!r} must start "
+            f"with in_ or out_")
+    try:
+        dso = ctypes.CDLL(os.path.abspath(path))
+    except OSError as e:
+        raise ValueError(f"cannot load plugin {path!r}: {e}") from e
+    if stem.startswith("out_"):
+        try:
+            vt = _OutputVtable.in_dll(dso, symbol)
+        except ValueError as e:
+            raise ValueError(
+                f"cannot load plugin {path!r}: registration structure "
+                f"is missing {symbol!r}") from e
+        return _register_output(reg, OutputPlugin, dso, vt, path)
+    if stem.startswith("in_"):
+        try:
+            vt = _InputVtable.in_dll(dso, symbol)
+        except ValueError as e:
+            raise ValueError(
+                f"cannot load plugin {path!r}: registration structure "
+                f"is missing {symbol!r}") from e
+        return _register_input(reg, InputPlugin, dso, vt, path)
+    raise AssertionError("unreachable")  # stem validated above
+
+
+def _check_abi(vt, path: str) -> str:
+    if vt.abi_version != FBTPU_PLUGIN_ABI_VERSION:
+        raise ValueError(
+            f"plugin {path!r}: ABI version {vt.abi_version} "
+            f"(host speaks {FBTPU_PLUGIN_ABI_VERSION})")
+    name = (vt.name or b"").decode("utf-8", "replace")
+    if not name:
+        raise ValueError(f"plugin {path!r}: empty plugin name")
+    return name
+
+
+def _register_output(reg, OutputPlugin, dso, vt, path):
+    from .plugin import FlushResult
+
+    name = _check_abi(vt, path)
+
+    class DsoOutput(OutputPlugin):
+        description = (vt.description or b"").decode("utf-8", "replace")
+        allow_unknown_properties = True  # props pass through as JSON
+        _dso = dso  # keep the handle alive with the class
+        _vt = vt
+
+        def init(self, instance, engine) -> None:
+            ctx = self._vt.init(_props_json(instance))
+            if not ctx:
+                raise RuntimeError(f"{self.name}: native init failed")
+            self._ctx = ctypes.c_void_p(ctx)
+
+        async def flush(self, data: bytes, tag: str, engine):
+            buf = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
+            rc = self._vt.flush(self._ctx, buf, len(data),
+                                tag.encode("utf-8", "replace"))
+            return {0: FlushResult.OK, 1: FlushResult.RETRY}.get(
+                rc, FlushResult.ERROR)
+
+        def exit(self) -> None:
+            ctx = getattr(self, "_ctx", None)
+            if ctx:
+                self._vt.destroy(ctx)
+                self._ctx = None
+
+    DsoOutput.name = name
+    DsoOutput.__name__ = f"Dso_{name}"
+    reg.register(DsoOutput)
+    log.info("dso: registered output plugin %r from %s", name, path)
+    return DsoOutput
+
+
+def _register_input(reg, InputPlugin, dso, vt, path):
+    name = _check_abi(vt, path)
+    interval = vt.collect_interval if vt.collect_interval > 0 else 1.0
+
+    class DsoInput(InputPlugin):
+        description = (vt.description or b"").decode("utf-8", "replace")
+        allow_unknown_properties = True  # props pass through as JSON
+        collect_interval = interval
+        _dso = dso
+        _vt = vt
+
+        def init(self, instance, engine) -> None:
+            ctx = self._vt.init(_props_json(instance))
+            if not ctx:
+                raise RuntimeError(f"{self.name}: native init failed")
+            self._ctx = ctypes.c_void_p(ctx)
+
+        def collect(self, engine) -> None:
+            from ..codec.events import encode_event, now_event_time
+
+            records = []
+
+            def emit(_host, tag, json_text, length):
+                # c_char_p already arrived as a NUL-bounded bytes
+                # object; slicing by the advertised length stays
+                # inside it even when the plugin lies about length
+                try:
+                    body = json.loads((json_text or b"")[:length])
+                except (ValueError, TypeError):
+                    return
+                records.append((
+                    (tag or b"").decode("utf-8", "replace"), body))
+
+            cb = _EMIT_FN(emit)
+            rc = self._vt.collect(
+                self._ctx, None,
+                (self.instance.tag or "").encode("utf-8", "replace"),
+                cb)
+            if rc < 0:
+                log.warning("%s: native collect failed", self.name)
+                return
+            groups = {}
+            for tag, body in records:
+                tag = tag or self.instance.tag
+                groups.setdefault(tag, []).append(
+                    encode_event(body, now_event_time()))
+            for tag, bufs in groups.items():
+                engine.input_log_append(self.instance, tag,
+                                        b"".join(bufs), len(bufs))
+
+        def exit(self) -> None:
+            ctx = getattr(self, "_ctx", None)
+            if ctx:
+                self._vt.destroy(ctx)
+                self._ctx = None
+
+    DsoInput.name = name
+    DsoInput.__name__ = f"Dso_{name}"
+    reg.register(DsoInput)
+    log.info("dso: registered input plugin %r from %s", name, path)
+    return DsoInput

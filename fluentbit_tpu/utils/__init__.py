@@ -5,9 +5,10 @@ src/flb_compression.c (payload compression for outputs/forward);
 src/flb_crypto.c, src/flb_hmac.c, src/flb_base64.c, src/flb_uri.c,
 src/flb_utf8.c (hashing, signing, encoding). Python's stdlib provides
 gzip/zlib/base64/hmac/hashlib; snappy is implemented from scratch in
-``utils/snappy.py`` (block + framing formats); zstd has no vendored
-equivalent in this image and is gated — ``compress('zstd', ...)``
-raises a clear error instead of silently passing data through.
+``utils/snappy.py`` (block + framing formats); zstd and lz4 bind the
+system libraries via ctypes (``utils/zstd.py`` / ``utils/lz4.py`` —
+the src/flb_zstd.c role) and fail with a clear CompressionError when
+the shared library is genuinely absent.
 """
 
 from __future__ import annotations
@@ -25,9 +26,6 @@ class CompressionError(ValueError):
     pass
 
 
-_GATED = {"lz4"}
-
-
 def compress(algo: str, data: bytes, level: int = 6) -> bytes:
     """flb_compression_compress equivalent."""
     a = (algo or "gzip").lower()
@@ -38,17 +36,16 @@ def compress(algo: str, data: bytes, level: int = 6) -> bytes:
     if a == "snappy":
         from . import snappy as _snappy
         return _snappy.compress(data)
-    if a == "zstd":
+    if a in ("zstd", "lz4"):
+        from . import lz4 as _lz4
         from . import zstd as _zstd
+        mod = _zstd if a == "zstd" else _lz4
         try:
-            return _zstd.compress(data)
+            return mod.compress(data)
         except OSError as e:
-            raise CompressionError(f"zstd unavailable: {e}") from e
-    if a in _GATED:
-        raise CompressionError(
-            f"{a} is not available in this build (no vendored codec); "
-            f"use gzip or zlib"
-        )
+            raise CompressionError(f"{a} unavailable: {e}") from e
+        except ValueError as e:
+            raise CompressionError(str(e)) from e
     raise CompressionError(f"unknown compression algorithm {algo!r}")
 
 
@@ -61,18 +58,16 @@ def decompress(algo: str, data: bytes) -> bytes:
     if a == "snappy":
         from . import snappy as _snappy
         return _snappy.decompress(data)
-    if a == "zstd":
+    if a in ("zstd", "lz4"):
+        from . import lz4 as _lz4
         from . import zstd as _zstd
+        mod = _zstd if a == "zstd" else _lz4
         try:
-            return _zstd.decompress(data)
+            return mod.decompress(data)
         except OSError as e:
-            raise CompressionError(f"zstd unavailable: {e}") from e
+            raise CompressionError(f"{a} unavailable: {e}") from e
         except ValueError as e:
             raise CompressionError(str(e)) from e
-    if a in _GATED:
-        raise CompressionError(
-            f"{a} is not available in this build (no vendored codec)"
-        )
     raise CompressionError(f"unknown compression algorithm {algo!r}")
 
 
@@ -85,6 +80,9 @@ def compression_available(algo: str) -> bool:
     if a == "zstd":
         from . import zstd as _zstd
         return _zstd.available()
+    if a == "lz4":
+        from . import lz4 as _lz4
+        return _lz4.available()
     return False
 
 

@@ -1,0 +1,110 @@
+"""LZ4 frame codec bound to the system liblz4 via ctypes.
+
+Reference: fluent-bit links lz4 through its vendored deps (e.g. the
+chunkio/journal paths); the compression surface here mirrors
+`utils/zstd.py` — one-shot frame compress/decompress via
+LZ4F_compressFrame / LZ4F_decompress with the frame API, so output
+interoperates with the standard `lz4` CLI and libraries.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+from typing import Optional
+
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+_LZ4F_VERSION = 100
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    name = ctypes.util.find_library("lz4") or "liblz4.so.1"
+    try:
+        lib = ctypes.CDLL(name)
+        lib.LZ4F_compressFrameBound.restype = ctypes.c_size_t
+        lib.LZ4F_compressFrameBound.argtypes = [ctypes.c_size_t,
+                                                ctypes.c_void_p]
+        lib.LZ4F_compressFrame.restype = ctypes.c_size_t
+        lib.LZ4F_compressFrame.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+            ctypes.c_size_t, ctypes.c_void_p]
+        lib.LZ4F_isError.restype = ctypes.c_uint
+        lib.LZ4F_isError.argtypes = [ctypes.c_size_t]
+        lib.LZ4F_createDecompressionContext.restype = ctypes.c_size_t
+        lib.LZ4F_createDecompressionContext.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint]
+        lib.LZ4F_freeDecompressionContext.restype = ctypes.c_size_t
+        lib.LZ4F_freeDecompressionContext.argtypes = [ctypes.c_void_p]
+        lib.LZ4F_decompress.restype = ctypes.c_size_t
+        lib.LZ4F_decompress.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_void_p]
+    except (OSError, AttributeError) as e:
+        _load_error = str(e)
+        return None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise OSError(f"liblz4 unavailable: {_load_error}")
+    bound = lib.LZ4F_compressFrameBound(len(data), None)
+    dst = ctypes.create_string_buffer(bound)
+    n = lib.LZ4F_compressFrame(dst, bound, data, len(data), None)
+    if lib.LZ4F_isError(n):
+        raise ValueError("lz4 frame compression failed")
+    return dst.raw[:n]
+
+
+def decompress(data: bytes,
+               max_output: int = 256 * 1024 * 1024) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise OSError(f"liblz4 unavailable: {_load_error}")
+    ctx = ctypes.c_void_p()
+    if lib.LZ4F_isError(
+            lib.LZ4F_createDecompressionContext(
+                ctypes.byref(ctx), _LZ4F_VERSION)):
+        raise ValueError("lz4 context creation failed")
+    try:
+        out = bytearray()
+        src = ctypes.create_string_buffer(data, len(data))  # one copy
+        src_off = 0
+        code = None
+        chunk = ctypes.create_string_buffer(256 * 1024)
+        while src_off < len(data):
+            dst_size = ctypes.c_size_t(len(chunk))
+            src_size = ctypes.c_size_t(len(data) - src_off)
+            code = lib.LZ4F_decompress(
+                ctx, chunk, ctypes.byref(dst_size),
+                ctypes.byref(src, src_off), ctypes.byref(src_size),
+                None)
+            if lib.LZ4F_isError(code):
+                raise ValueError("corrupt lz4 frame")
+            if src_size.value == 0 and dst_size.value == 0:
+                raise ValueError("lz4 frame stalled (truncated input)")
+            out += chunk.raw[:dst_size.value]
+            if len(out) > max_output:
+                raise ValueError("lz4 output exceeds limit")
+            src_off += src_size.value
+            if code == 0 and src_off >= len(data):
+                break
+        # hint code 0 means the frame completed; anything else at EOF
+        # is a truncated frame (the silent-partial-output trap)
+        if code != 0:
+            raise ValueError("truncated lz4 frame")
+        return bytes(out)
+    finally:
+        lib.LZ4F_freeDecompressionContext(ctx)

@@ -1,0 +1,64 @@
+/* Demo native INPUT plugin for the fbtpu dynamic plugin ABI: each
+ * collect emits `copies` JSON records carrying a running counter
+ * (an in_dummy written in C++, proving the input side of the ABI the
+ * way the reference's Zig bindings prove its vtables). */
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "../fbtpu_plugin.h"
+
+namespace {
+
+struct Ctx {
+    long long counter = 0;
+    int copies = 1;
+};
+
+int json_int_prop(const char *json, const char *key, int fallback) {
+    std::string needle = std::string("\"") + key + "\":";
+    const char *p = strstr(json, needle.c_str());
+    if (!p) return fallback;
+    p += needle.size();
+    while (*p == ' ') p++;
+    if (*p == '"') p++;
+    int v = atoi(p);
+    return v > 0 ? v : fallback;
+}
+
+void *demo_init(const char *props_json) {
+    Ctx *ctx = new Ctx();
+    ctx->copies = json_int_prop(props_json ? props_json : "{}",
+                                "copies", 1);
+    return ctx;
+}
+
+int demo_collect(void *vctx, void *host, const char *tag,
+                 fbtpu_emit_fn emit) {
+    Ctx *ctx = static_cast<Ctx *>(vctx);
+    char buf[128];
+    for (int i = 0; i < ctx->copies; i++) {
+        int n = snprintf(buf, sizeof(buf),
+                         "{\"source\": \"native\", \"n\": %lld}",
+                         ctx->counter++);
+        emit(host, tag, buf, n);
+    }
+    return ctx->copies;
+}
+
+void demo_destroy(void *vctx) {
+    delete static_cast<Ctx *>(vctx);
+}
+
+}  // namespace
+
+extern "C" fbtpu_input_plugin in_demo_plugin = {
+    FBTPU_PLUGIN_ABI_VERSION,
+    "native_demo",
+    "demo native input (dynamic plugin ABI)",
+    0.05,
+    demo_init,
+    demo_collect,
+    demo_destroy,
+};

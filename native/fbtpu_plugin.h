@@ -1,0 +1,70 @@
+/* fbtpu dynamic plugin ABI (v1)
+ *
+ * The native-plugin surface of the framework: a shared object built
+ * against this header is loaded at startup with dlopen (CLI `-e
+ * <path>` or a `[PLUGINS]` section), mirroring the reference's
+ * dynamic plugin loader (src/flb_plugin.c:200 — dlopen + a
+ * registration symbol derived from the file name) and its
+ * native-language plugin proof (lib/zig_fluent_bit + out_zig_demo).
+ *
+ * Contract:
+ * - the object exports ONE registration symbol named `<stem>_plugin`
+ *   where <stem> is the file name without directory/extension (an
+ *   optional `flb-` prefix is stripped): `out_demo.so` must export
+ *   `fbtpu_output_plugin out_demo_plugin`.
+ * - the stem's prefix picks the type: `in_` → fbtpu_input_plugin,
+ *   `out_` → fbtpu_output_plugin.
+ * - strings returned by the plugin must stay valid for the object's
+ *   lifetime; buffers passed IN are only valid during the call.
+ */
+
+#ifndef FBTPU_PLUGIN_H
+#define FBTPU_PLUGIN_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define FBTPU_PLUGIN_ABI_VERSION 1
+
+/* flush verdicts (FLB_OK / FLB_RETRY / FLB_ERROR) */
+#define FBTPU_PLUGIN_OK    0
+#define FBTPU_PLUGIN_RETRY 1
+#define FBTPU_PLUGIN_ERROR 2
+
+/* Host ingest callback handed to input plugins: emit ONE record as a
+ * JSON object (the host parses and re-encodes it as a log event). */
+typedef void (*fbtpu_emit_fn)(void *host, const char *tag,
+                              const char *json, long long len);
+
+typedef struct fbtpu_output_plugin {
+    int abi_version;           /* FBTPU_PLUGIN_ABI_VERSION */
+    const char *name;          /* registry name */
+    const char *description;
+    /* props_json: the instance properties as a JSON object.
+     * Return a context pointer, or NULL to fail initialization. */
+    void *(*init)(const char *props_json);
+    /* data: the chunk's raw msgpack event stream. Return a verdict. */
+    int (*flush)(void *ctx, const unsigned char *data, long long len,
+                 const char *tag);
+    void (*destroy)(void *ctx);
+} fbtpu_output_plugin;
+
+typedef struct fbtpu_input_plugin {
+    int abi_version;
+    const char *name;
+    const char *description;
+    double collect_interval;   /* seconds between collect() calls */
+    void *(*init)(const char *props_json);
+    /* Called every interval; emit records via emit(host, tag, ...).
+     * Return the number of records emitted, or -1 on error. */
+    int (*collect)(void *ctx, void *host, const char *tag,
+                   fbtpu_emit_fn emit);
+    void (*destroy)(void *ctx);
+} fbtpu_input_plugin;
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FBTPU_PLUGIN_H */

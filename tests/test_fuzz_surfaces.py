@@ -446,3 +446,91 @@ def test_fuzz_mqtt_packets():
         payload = _mutate(rng, seed)
         for flags in (0, 2, 4):
             plugin._handle_publish(flags, payload, _W(), _Eng())
+
+
+def test_fuzz_journal_file_reader():
+    """utils/journal.py walks attacker-controllable binary files (a
+    hostile journal dir); every mutation must raise JournalError/OSError
+    or parse — never crash or loop."""
+    import os
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    from test_systemd import SAMPLE, write_journal
+
+    from fluentbit_tpu.utils.journal import (JournalError, JournalFile,
+                                             peek_header)
+
+    with tempfile.TemporaryDirectory() as d:
+        seed_path = os.path.join(d, "seed.journal")
+        write_journal(seed_path, SAMPLE)
+        seed = open(seed_path, "rb").read()
+        rng = random.Random(0x5D)
+        path = os.path.join(d, "fuzz.journal")
+        for i in range(SEED_ROUNDS):
+            blob = _mutate(rng, seed)
+            with open(path, "wb") as f:
+                f.write(blob)
+            try:
+                peek_header(path)
+                jf = JournalFile(path)
+                for entry in jf.entries(max_entries=64):
+                    dict(entry.fields)
+            except (JournalError, OSError, ValueError, struct.error):
+                pass
+
+
+def test_fuzz_tflite_loader():
+    """utils/tflite.py parses user-supplied model files; mutations must
+    fail with TFLiteError/struct errors, never hang or segfault-style
+    recursion."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    import numpy as np
+    from test_tensorflow import mlp_model
+
+    from fluentbit_tpu.utils.tflite import Model, TFLiteError
+
+    seed = mlp_model()
+    rng = random.Random(0x7F)
+    for i in range(SEED_ROUNDS):
+        blob = _mutate(rng, seed)
+        try:
+            m = Model(blob)
+            m.run(np.zeros((2, len(m.input_shape) and 4), np.float32))
+        except (TFLiteError, ValueError, IndexError, KeyError,
+                struct.error, ZeroDivisionError, MemoryError,
+                OverflowError):
+            pass
+
+
+def test_fuzz_wasi_module_instantiation():
+    """wasmrt host-import loading + WASI calls under mutation: WasmError
+    / Trap / WasiExit only."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    from test_exec_wasi import wasi_module
+
+    from fluentbit_tpu.wasmrt import Module, Trap, WasmError
+    from fluentbit_tpu.wasmrt.wasi import WasiEnv, WasiExit
+
+    seed = wasi_module(b"fuzz line\n")
+    rng = random.Random(0xA5)
+    for i in range(SEED_ROUNDS):
+        blob = _mutate(rng, seed)
+        wasi = WasiEnv(args=["fuzz"])
+        try:
+            mod = Module(blob, max_memory_bytes=1 << 20,
+                         host_imports=wasi.imports())
+            if "_start" in mod.exports:
+                mod.call("_start", [])
+        except (WasmError, Trap, WasiExit, RecursionError,
+                struct.error, IndexError, KeyError, ValueError,
+                TypeError, ZeroDivisionError, MemoryError,
+                OverflowError):
+            pass

@@ -77,15 +77,18 @@ def test_device_kernel_python_stdlib_differential():
 def test_compression_roundtrip_and_gates():
     data = b"payload " * 100
     algos = ["gzip", "zlib", "snappy"]
+    from fluentbit_tpu.utils import lz4 as _lz4
     from fluentbit_tpu.utils import zstd as _zstd
-    if _zstd.available():  # zstd is real now (utils/zstd.py)
+    if _zstd.available():  # ctypes binding over the system libzstd
         algos.append("zstd")
+    if _lz4.available():   # ctypes binding over the system liblz4
+        algos.append("lz4")
     for algo in algos:
         assert utils.decompress(algo, utils.compress(algo, data)) == data
     with pytest.raises(utils.CompressionError):
-        utils.compress("lz4", data)
-    with pytest.raises(utils.CompressionError):
         utils.compress("nope", data)
+    with pytest.raises(utils.CompressionError):
+        utils.decompress("lz4", b"not an lz4 frame")
 
 
 def test_crypto_encoding():
@@ -161,3 +164,14 @@ def test_wasm_requires_module_path():
     ins.configure()
     with pytest.raises(ValueError, match="wasm_path"):
         ins.plugin.init(ins, None)
+
+
+def test_lz4_truncated_frame_rejected():
+    from fluentbit_tpu.utils import lz4 as _lz4
+    if not _lz4.available():
+        pytest.skip("liblz4 absent")
+    comp = utils.compress("lz4", b"payload " * 100)
+    with pytest.raises(utils.CompressionError):
+        utils.decompress("lz4", comp[:18])
+    with pytest.raises(utils.CompressionError):
+        utils.decompress("lz4", b"")
