@@ -48,9 +48,10 @@ _TOKEN_RE = re.compile(
 )
 
 KEYWORDS = {
-    "create", "stream", "snapshot", "with", "as", "select", "from",
-    "where", "window", "tumbling", "hopping", "advance", "by", "second",
-    "minute", "hour", "group", "and", "or", "not", "is", "null", "tag",
+    "create", "stream", "snapshot", "flush", "with", "as", "select",
+    "from", "where", "window", "tumbling", "hopping", "advance", "by",
+    "second", "minute", "hour", "group", "and", "or", "not", "is",
+    "null", "tag", "limit",
 }
 
 AGG_FUNCS = ("avg", "sum", "count", "min", "max", "timeseries_forecast")
@@ -112,6 +113,10 @@ class Query:
     where: Optional[object]
     window: Optional[Tuple[str, float, float]]  # (kind, size_s, advance_s)
     group_by: List[str]
+    # 'stream' | 'snapshot' | 'flush_snapshot' (FLB_SP_CREATE_STREAM /
+    # CREATE_SNAPSHOT / FLUSH_SNAPSHOT command types, sql.y:108-146)
+    kind: str = "stream"
+    limit: int = 0               # CREATE SNAPSHOT ... LIMIT n
 
     @property
     def has_aggregates(self) -> bool:
@@ -145,25 +150,50 @@ class _Parser:
         return False
 
     # CREATE STREAM name [WITH (...)] AS SELECT ... | SELECT ...
+    def _parse_with(self, props: Dict[str, str]) -> None:
+        if self.accept("kw", "with"):
+            self.expect("op", "(")
+            while True:
+                k = self.next()[1]
+                self.expect("op", "=")
+                props[str(k)] = self.next()[1]
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+
     def parse(self) -> Query:
         name = None
+        kind = "stream"
         props: Dict[str, str] = {}
         if self.accept("kw", "create"):
-            self.expect("kw", "stream")
+            if self.accept("kw", "snapshot"):
+                # CREATE SNAPSHOT name [WITH(seconds=N)] AS SELECT *
+                # FROM source [LIMIT n]  (sql.y:122-132)
+                kind = "snapshot"
+            else:
+                self.expect("kw", "stream")
             name = self.expect("id")
-            if self.accept("kw", "with"):
-                self.expect("op", "(")
-                while True:
-                    k = self.next()[1]
-                    self.expect("op", "=")
-                    props[str(k)] = self.next()[1]
-                    if not self.accept("op", ","):
-                        break
-                self.expect("op", ")")
+            self._parse_with(props)
+            self.expect("kw", "as")
+        elif self.accept("kw", "flush"):
+            # FLUSH SNAPSHOT name AS SELECT * FROM source WHERE cond
+            # (sql.y:134-146)
+            self.expect("kw", "snapshot")
+            kind = "flush_snapshot"
+            name = self.expect("id")
+            self._parse_with(props)
             self.expect("kw", "as")
         q = self.parse_select()
         q.stream_name = name
         q.props = props
+        q.kind = kind
+        if kind == "snapshot" and q.limit == 0 and \
+                not str(props.get("seconds", "")).strip():
+            raise SQLError(
+                f"snapshot {name!r}: size is not defined "
+                "(use LIMIT n and/or WITH(seconds=N))")
+        if kind != "snapshot" and q.limit:
+            raise SQLError("LIMIT is only valid on CREATE SNAPSHOT")
         self.accept("op", ";")
         return q
 
@@ -199,8 +229,14 @@ class _Parser:
             group_by.append(self.expect("id"))
             while self.accept("op", ","):
                 group_by.append(self.expect("id"))
+        limit = 0
+        if self.accept("kw", "limit"):
+            k, v = self.next()
+            if k != "num":
+                raise SQLError(f"LIMIT needs a number, got {v!r}")
+            limit = int(v)
         return Query(None, {}, keys, source_type, source, where, window,
-                     group_by)
+                     group_by, limit=limit)
 
     def parse_select_key(self) -> SelectKey:
         k, v = self.next()
@@ -489,6 +525,13 @@ class SPTask:
         self._panes: List[Dict[tuple, _Agg]] = []
         self._window_start = (now or time.time)()
         self._now = now or time.time
+        # CREATE SNAPSHOT ring: (ts, body) bounded by LIMIT records
+        # and/or WITH(seconds=N) age (flb_sp_snapshot.c pages)
+        self._snap: List[tuple] = []
+        self._snap_seconds = float(q.props.get("seconds", 0) or 0)
+        # FLUSH SNAPSHOT looks its CREATE twin up through this hook
+        # (flb_sp_snapshot_flush walks sp->tasks the same way)
+        self.find_snapshot = lambda name: None
 
     def matches(self, tag: str, stream_name: Optional[str] = None) -> bool:
         if self.query.source_type == "tag":
@@ -497,8 +540,53 @@ class SPTask:
 
     # -- ingest-side processing --
 
+    # -- snapshots (flb_sp_snapshot.c) --
+
+    def snapshot_update(self, ts: float, body: dict) -> None:
+        self._snap.append((ts, body))
+        if self.query.limit:
+            del self._snap[:max(0, len(self._snap) - self.query.limit)]
+        if self._snap_seconds > 0:
+            cutoff = self._now() - self._snap_seconds
+            i = 0
+            while i < len(self._snap) and self._snap[i][0] < cutoff:
+                i += 1
+            if i:
+                del self._snap[:i]
+
+    def snapshot_take(self) -> List[tuple]:
+        taken, self._snap = self._snap, []
+        return taken
+
     def process(self, events: list, tag: str) -> None:
         q = self.query
+        if q.kind == "snapshot":
+            # WHERE and the SELECT projection apply to what gets
+            # buffered, same as any other query kind
+            for ev in events:
+                if not isinstance(ev.body, dict):
+                    continue
+                if q.where is not None and \
+                        not eval_cond(q.where, ev.body, ev.ts_float):
+                    continue
+                self.snapshot_update(ev.ts_float, self._project(ev.body))
+            return
+        if q.kind == "flush_snapshot":
+            fire = any(
+                isinstance(ev.body, dict)
+                and (q.where is None
+                     or eval_cond(q.where, ev.body, ev.ts_float))
+                for ev in events)
+            if not fire:
+                return
+            snap_task = self.find_snapshot(q.stream_name)
+            if snap_task is None:
+                return
+            taken = snap_task.snapshot_take()
+            if taken:
+                # emit preserves the buffered records' own timestamps
+                self.emit(self.out_tag, taken)
+            return
         immediate: List[dict] = []
         for ev in events:
             body = ev.body
@@ -612,15 +700,26 @@ class StreamProcessor:
 
     def create_task(self, sql: str) -> SPTask:
         task = SPTask(sql, lambda tag, bodies: self._emit(task, tag, bodies))
+        task.find_snapshot = self._find_snapshot
         self.tasks.append(task)
         return task
+
+    def _find_snapshot(self, name: str):
+        for t in self.tasks:
+            if t.query.kind == "snapshot" and t.query.stream_name == name:
+                return t
+        return None
 
     def _emit(self, src_task: SPTask, tag: str, bodies: List[dict]) -> None:
         from ..codec.events import decode_events, encode_event, now_event_time
 
         buf = bytearray()
         for b in bodies:
-            buf += encode_event(b, now_event_time())
+            if isinstance(b, tuple):  # snapshot flush: (orig_ts, body)
+                ts, body = b
+            else:
+                ts, body = now_event_time(), b
+            buf += encode_event(body, ts)
         data = bytes(buf)
         if self._emitter is None:
             raise RuntimeError(

