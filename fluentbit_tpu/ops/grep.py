@@ -289,7 +289,7 @@ class GrepProgram:
         counts[R])`` with ``B`` divisible by the mesh size; ``counts`` is
         the global (all-device) per-rule match total.
         """
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         if self._jit is None:

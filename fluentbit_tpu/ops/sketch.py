@@ -321,7 +321,7 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
                        lengths: np.ndarray) -> None:
     """Update over a mesh: each device absorbs its batch shard into a
     local register set, merged with lax.pmax (union of HLLs)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     axis = mesh.axis_names[0]
@@ -355,7 +355,7 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
 def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
                        lengths: np.ndarray) -> None:
     """Count-min over a mesh: local scatter-adds, psum merge."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     axis = mesh.axis_names[0]
