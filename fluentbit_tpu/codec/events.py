@@ -145,6 +145,8 @@ def fast_count_records(buf: bytes):
     Python fallback fails."""
     from .. import native
 
+    if not isinstance(buf, bytes):
+        buf = bytes(buf)  # a raw filter may hand back a memoryview
     n = native.count_records(buf)
     if n is not None:
         return n

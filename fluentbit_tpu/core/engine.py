@@ -789,26 +789,41 @@ class Engine:
         decode path (native unavailable / a filter declined)."""
         from ..codec import events as _events
 
-        if n_records is None:
-            n_records = _events.fast_count_records(data)
-            if n_records is None:
-                return None
         in_bytes = len(data)
+        # n may stay None until the FIRST raw filter discovers it (the
+        # fused grep walk returns the record count as a third element),
+        # skipping the counting pre-pass on the hot path entirely
         n = n_records
         deltas = []  # metric updates deferred until the chain commits:
         for f in matching:  # a later decline re-runs the decode path,
-            try:            # which must not double-count earlier drops
+            prev = data     # which must not double-count earlier drops
+            try:
                 got = f.plugin.filter_raw(data, tag, self, n_records=n)
             except Exception:
                 log.exception("filter %s raw path failed", f.display_name)
                 return None
             if got is None:
                 return None  # filter declined: decode path handles it
-            n2, data = got
+            if len(got) == 3:
+                n2, data, n_in = got
+                if n is None:
+                    n = n_in
+            else:
+                n2, data = got
+                if n is None:  # filter didn't count: count its input
+                    n = _events.fast_count_records(prev)
+                    if n is None:
+                        return None
             deltas.append((f.display_name, n, n2))
             n = n2
             if n == 0:
                 break
+        if n is None:  # no filter matched: count natively
+            n = _events.fast_count_records(data)
+            if n is None:
+                return None
+        if n_records is None:
+            n_records = deltas[0][1] if deltas else n
         for name, before, after in deltas:
             if after < before:
                 self.m_filter_drop.inc(before - after, (name,))

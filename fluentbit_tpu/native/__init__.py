@@ -158,6 +158,26 @@ def _load():
         if grep_fn is not None:
             grep_fn.restype = ctypes.c_longlong
             grep_fn.argtypes = _grep_match_argtypes()
+        filter_fn = getattr(lib, "fbtpu_grep_filter", None)
+        if filter_fn is not None:
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            i64p = ctypes.POINTER(ctypes.c_longlong)
+            filter_fn.restype = ctypes.c_longlong
+            filter_fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong,       # buf
+                ctypes.c_char_p,                          # keys_cat
+                i64p, ctypes.c_longlong,                  # key_offs
+                i32p, ctypes.c_longlong,                  # key_of_rule
+                ctypes.POINTER(ctypes.c_int16),           # trans_cat
+                i64p,                                     # troffs
+                i32p, i32p, i32p,                         # cmaps/starts/ncls
+                ctypes.POINTER(ctypes.c_uint16), i64p,    # cmap2/cm2offs
+                ctypes.POINTER(ctypes.c_uint8),           # rule_exclude
+                ctypes.c_int32,                           # op_mode
+                ctypes.c_longlong,                        # max_records
+                ctypes.POINTER(ctypes.c_uint8),           # out
+                i64p,                                     # out_info
+            ]
         _lib = lib
         return _lib
 
@@ -235,7 +255,8 @@ class GrepTables:
     are bit-exact with the device kernel and the Python regex engine."""
 
     __slots__ = ("n_rules", "keys_cat", "key_offs", "key_of_rule",
-                 "trans_cat", "troffs", "cmaps", "starts", "ncls")
+                 "trans_cat", "troffs", "cmaps", "starts", "ncls",
+                 "cmap2_cat", "cm2offs")
 
     def __init__(self, rules):
         """rules: iterable of (field_key: bytes, dfa) pairs."""
@@ -245,6 +266,9 @@ class GrepTables:
         trans_parts = []
         troffs = [0]
         cmaps = []
+        cmap2_parts = []
+        cm2offs = []
+        cm2_len = 0
         starts = []
         ncls = []
         for key, dfa in rules:
@@ -263,9 +287,23 @@ class GrepTables:
                 raise ValueError(f"DFA too large for native tables ({S})")
             budget = int(os.environ.get("FBTPU_KTABLE_BUDGET",
                                         str(2 * 1024 * 1024)))
-            k = 1
-            while k < 4 and S * (C ** (k + 1)) * 2 <= budget:
-                k += 1
+            # EVEN k preferred: the prepass then classifies via the
+            # byte-PAIR table (one load per two bytes). k=4 may exceed
+            # the plain budget — the walk only touches the visited
+            # states' rows, so a larger-but-cold table still wins.
+            k4_budget = int(os.environ.get("FBTPU_K4_BUDGET",
+                                           str(12 * 1024 * 1024)))
+            if C ** 4 <= 65535 and S * (C ** 4) * 2 <= k4_budget:
+                k = 4
+            else:
+                k = 1
+                # C^k <= 65535: super-symbols travel as uint16 through
+                # the prepass scratch (dfa_prepass_block)
+                while (k < 4 and S * (C ** (k + 1)) * 2 <= budget
+                       and C ** (k + 1) <= 65535):
+                    k += 1
+                if k >= 2 and k % 2 == 1:
+                    k -= 1  # even k unlocks the pair-table prepass
             tk = compose_supersteps(t, k)
             trans_parts.append(np.ascontiguousarray(
                 tk, dtype=np.int16).reshape(-1))
@@ -273,6 +311,16 @@ class GrepTables:
             ncls.append(C + 1000 * (k - 1))
             cmaps.append(np.ascontiguousarray(
                 dfa.class_map, dtype=np.int32))
+            if k % 2 == 0:
+                # cmap2[b0 + (b1<<8)] = class(b0)*C + class(b1)
+                cm = dfa.class_map[:256].astype(np.uint32)
+                w = np.arange(65536, dtype=np.uint32)
+                pair = cm[w & 255] * C + cm[w >> 8]
+                cmap2_parts.append(pair.astype(np.uint16))
+                cm2offs.append(cm2_len)
+                cm2_len += 65536
+            else:
+                cm2offs.append(-1)
             starts.append(dfa.start)
         self.n_rules = len(key_of_rule)
         self.keys_cat = b"".join(keys)
@@ -284,6 +332,9 @@ class GrepTables:
         self.trans_cat = np.concatenate(trans_parts)
         self.troffs = np.asarray(troffs[:-1], dtype=np.int64)
         self.cmaps = np.concatenate(cmaps)
+        self.cmap2_cat = (np.concatenate(cmap2_parts) if cmap2_parts
+                          else np.zeros(1, dtype=np.uint16))
+        self.cm2offs = np.asarray(cm2offs, dtype=np.int64)
         self.starts = np.asarray(starts, dtype=np.int32)
         self.ncls = np.asarray(ncls, dtype=np.int32)
 
@@ -325,6 +376,90 @@ def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
     # u8 0/1 → bool is a reinterpret, not a copy (match is freshly
     # allocated per call, so the view escapes safely)
     return match[:, :n].view(bool), offsets[: n + 1], int(n)
+
+
+class GrepFilterTables(GrepTables):
+    """GrepTables (k-super-stepped int16 transition tables) plus the
+    verdict inputs for the fused one-pass filter (fbtpu_grep_filter):
+    per-rule exclude flags and the logical_op mode. The matcher splits
+    each record into a branchless super-symbol prepass and a
+    two-loads-per-step lockstep walk (dfa_prepass_block)."""
+
+    __slots__ = ("excl", "op_mode")
+
+    def __init__(self, rules, op: str = "legacy"):
+        """rules: iterable of (field_key: bytes, dfa, is_exclude) trios."""
+        rules = list(rules)
+        super().__init__([(key, dfa) for key, dfa, _ in rules])
+        self.excl = np.asarray(
+            [1 if is_exclude else 0 for _, _, is_exclude in rules],
+            dtype=np.uint8)
+        self.op_mode = {"LEGACY": 0, "AND": 1, "OR": 2}.get(op.upper(), 0)
+
+
+_tls = threading.local()
+
+
+def _arena(size: int) -> np.ndarray:
+    """Reusable per-thread output buffer (the fused filter writes the
+    compacted chunk here; the engine copies it into the chunk store
+    before the next call on this thread can overwrite it)."""
+    buf = getattr(_tls, "out", None)
+    if buf is None or buf.size < size:
+        buf = np.empty(max(size, 1 << 20), dtype=np.uint8)
+        _tls.out = buf
+    return buf
+
+
+def grep_filter(buf, tables: "GrepFilterTables",
+                n_hint: Optional[int] = None):
+    """One-pass extract + accel-DFA + verdict + compaction.
+
+    Returns (n_records, n_kept, out) where out is the original ``buf``
+    when nothing was dropped, b"" when everything was, else a memoryview
+    of this thread's arena holding the surviving records byte-identically
+    (caller must consume it before its next grep_filter call on this
+    thread). None = native unavailable / malformed buffer."""
+    lib = _load()
+    if lib is None or getattr(lib, "fbtpu_grep_filter", None) is None:
+        return None
+    if not isinstance(buf, (bytes, bytearray)):
+        buf = bytes(buf)
+    # no counting pre-pass: the walk discovers the record count, so an
+    # unknown count just means sizing scratch to the 3-bytes-per-record
+    # floor (array [ts, body] is at least 3 bytes)
+    cap = max(n_hint if n_hint is not None else len(buf) // 3 + 1, 1)
+    out = _arena(len(buf))
+    info = np.zeros(3, dtype=np.int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_longlong)
+    w = lib.fbtpu_grep_filter(
+        bytes(buf) if isinstance(buf, bytearray) else buf, len(buf),
+        tables.keys_cat,
+        tables.key_offs.ctypes.data_as(i64p),
+        len(tables.key_offs) - 1,
+        tables.key_of_rule.ctypes.data_as(i32p), tables.n_rules,
+        tables.trans_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        tables.troffs.ctypes.data_as(i64p),
+        tables.cmaps.ctypes.data_as(i32p),
+        tables.starts.ctypes.data_as(i32p),
+        tables.ncls.ctypes.data_as(i32p),
+        tables.cmap2_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        tables.cm2offs.ctypes.data_as(i64p),
+        tables.excl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        tables.op_mode,
+        cap,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        info.ctypes.data_as(i64p),
+    )
+    if w < 0:
+        return None
+    n, n_keep, wrote = int(info[0]), int(info[1]), int(info[2])
+    if not wrote:
+        return n, n_keep, buf
+    if n_keep == 0:
+        return n, 0, b""
+    return n, n_keep, memoryview(out)[:w]
 
 
 def stage_field(

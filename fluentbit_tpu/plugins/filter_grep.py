@@ -152,6 +152,7 @@ class GrepFilter(FilterPlugin):
 
         self._program = None
         self._native_tables = None
+        self._native_filter = None
         self.raw_timings = {"extract_s": 0.0, "kernel_s": 0.0,
                             "compact_s": 0.0, "records": 0}
         self._tm_lock = threading.Lock()
@@ -181,6 +182,16 @@ class GrepFilter(FilterPlugin):
                     )
                 except Exception:
                     self._native_tables = None
+                # fused single-pass variant (extract + accel DFA +
+                # verdict + compaction in one native call)
+                try:
+                    self._native_filter = _native.GrepFilterTables(
+                        [(r.ra.head.encode("utf-8"), r.dfa, r.is_exclude)
+                         for r in self.rules],
+                        op=self.op,
+                    )
+                except Exception:
+                    self._native_filter = None
 
     # -- verdicts (bit-exact vs grep.c) --
 
@@ -319,6 +330,22 @@ class GrepFilter(FilterPlugin):
         use_native = self._native_tables is not None and (
             device.platform() == "cpu" or not self._program.try_ready()
         )
+        if use_native and self._native_filter is not None:
+            # fused path: extraction + prepass DFA + verdict + compaction
+            # in ONE native pass; all-kept chunks return the input
+            # buffer untouched (zero copies). The walk discovers the
+            # record count, so the triple return lets the engine skip
+            # its counting pre-pass entirely.
+            t0 = _time.perf_counter()
+            got = native.grep_filter(data, self._native_filter,
+                                     n_hint=n_records)
+            if got is None:
+                return None
+            n, n_keep, out = got
+            with tm_lock:
+                tm["kernel_s"] += _time.perf_counter() - t0
+                tm["records"] += n
+            return (n_keep, out, n)
         if use_native:
             t0 = _time.perf_counter()
             got = native.grep_match(

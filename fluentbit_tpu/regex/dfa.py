@@ -211,6 +211,56 @@ def compose_supersteps(trans: np.ndarray, k: int) -> np.ndarray:
     return out
 
 
+def _minimize(trans: np.ndarray, start: int) -> Tuple[np.ndarray, int]:
+    """Moore partition refinement. Subset construction leaves many
+    equivalent states (every optional trailing group of a pattern forks
+    the subsets), which (a) bloats the kernel tables S-fold — the
+    parallel-in-time device kernel does S× work per position — and
+    (b) hides the self-loop structure the native accel scan needs: a
+    `[^ ]*` skeleton state only LOOKS like a self-loop after its clones
+    are merged. Language is unchanged, so all verdict paths stay
+    bit-identical.
+
+    Keeps the DEAD=0 / ACC=1 absorbing-id contract: any state from
+    which ACC is unreachable merges into DEAD; ACC (the only accepting
+    state, absorbing) stays a singleton partition."""
+    S, C = trans.shape
+    # initial partition: accepting (ACC) vs rest
+    part = np.zeros(S, dtype=np.int64)
+    part[ACC] = 1
+    n_blocks = 2
+    while True:
+        # signature: own block + successor blocks per class
+        sig = np.empty((S, C + 1), dtype=np.int64)
+        sig[:, 0] = part
+        sig[:, 1:] = part[trans]
+        _, new = np.unique(sig, axis=0, return_inverse=True)
+        n_new = int(new.max()) + 1
+        if n_new == n_blocks:  # refinement only splits: no growth = fixed point
+            break
+        part, n_blocks = new, n_new
+    # renumber blocks: DEAD's block -> 0, ACC's block -> 1, rest 2..
+    remap = np.full(int(part.max()) + 1, -1, dtype=np.int64)
+    remap[part[DEAD]] = DEAD
+    remap[part[ACC]] = ACC
+    nxt = 2
+    for b in part:
+        if remap[b] < 0:
+            remap[b] = nxt
+            nxt += 1
+    new_ids = remap[part]
+    n_new = nxt
+    new_trans = np.zeros((n_new, C), dtype=np.int32)
+    # one representative per block suffices (blocks are equivalence classes)
+    seen = np.zeros(n_new, dtype=bool)
+    for s in range(S):
+        ns = new_ids[s]
+        if not seen[ns]:
+            seen[ns] = True
+            new_trans[ns] = new_ids[trans[s]]
+    return new_trans, int(new_ids[start])
+
+
 def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,
                 max_states: int = 4096) -> DFA:
     """Compile a pattern (str or ParsedRegex) to a scan DFA.
@@ -355,12 +405,13 @@ def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,
             table[sid][cid] = get_id(move(states, sym))
 
     trans = np.asarray(table, dtype=np.int32)
+    trans, start_id = _minimize(trans, start_id)
     class_map = sym_class[:257].astype(np.uint8)
     return DFA(
         trans=trans,
         class_map=class_map,
         start=start_id,
-        n_states=len(table),
+        n_states=trans.shape[0],
         n_classes=n_classes,
         pattern=parsed.pattern,
     )

@@ -23,6 +23,11 @@
 #include <cstring>
 #include <thread>
 
+#if defined(__SSE2__) || defined(_M_X64) || defined(__x86_64__)
+#include <emmintrin.h>
+#define FBTPU_HAVE_SSE2 1
+#endif
+
 // W-way interleaved DFA over extracted values: W independent
 // state-transition chains hide the dependent-load latency that caps a
 // scalar table walk. DEAD(0)/ACC(1) rows and the EOL class are all
@@ -601,5 +606,397 @@ long long fbtpu_compact(const uint8_t *buf, long long buflen,
     }
     return w;
 }
+
+// ---------------------------------------------------------------------
+// Fused grep filter: one pass over chunk bytes doing field extraction,
+// accelerated DFA matching, verdict, and run-coalesced compaction.
+//
+// The DFA acceleration exploits the dominant shape of log-matching
+// automata (apache2-style "[^ ]* ... [^\]]* ... .*$" skeletons): most
+// live states SELF-LOOP on nearly every byte and leave only on one or
+// two delimiter bytes. The Python side (native.GrepFilterTables)
+// precomputes, per state, the escape-byte set; states with <=2 escape
+// bytes carry an accel word and the runtime skips straight to the next
+// escape byte with a 16-lane SIMD compare instead of walking the
+// transition table byte-by-byte. Self-loop skipping is exact (the
+// state is unchanged by skipped bytes, by construction), so verdicts
+// stay bit-identical to the table walk, the jax kernel, and the Python
+// regex engine.
+//
+// accel[s] encoding: bits 0-1 = 0 none / 1 one escape byte / 2 two /
+// 3 no escape bytes at all (skip to end); bits 8-15 byte1; 16-23 byte2.
+// ---------------------------------------------------------------------
+
+// ---------------------------------------------------------------------
+// Super-symbol prepass + interleaved walk (the fused filter's matcher).
+//
+// A DFA walk is a serial dependency chain; the classic fix (8
+// interleaved lanes, dfa_run_block above) leaves the per-step class
+// lookups and k-byte combines INSIDE the latency-bound loop. Splitting
+// the work makes both halves fast:
+//   A. prepass — per record, byte classes combine into k-byte
+//      super-symbols in a branchless, position-independent loop the
+//      CPU can run at superscalar width;
+//   B. walk — per 8-lane block, each step is exactly one scratch load
+//      and one dependent table load: s = T[s*Ck + sym].
+// Pad steps use the absorbing EOL super-symbol, so lanes of different
+// lengths stay in lockstep with no branches.
+// ---------------------------------------------------------------------
+
+#define FBTPU_PRE_LANES 16
+
+// cmap2 (optional, even k only): 64K-entry byte-PAIR class table
+// cmap2[b0 + (b1<<8)] = class(b0)*C + class(b1) — one load classifies
+// two bytes, and for k=4 two pair-lookups make a whole super-symbol:
+// sym = p01*C^2 + p23. Halves the prepass load count, which dominates
+// the matcher once the walk is down to two loads per step.
+static void dfa_prepass_block(const int16_t *transk, const int32_t *cmap,
+                              const uint16_t *cmap2,
+                              int32_t C, int k, int32_t Ck, int32_t start,
+                              const uint8_t *const *vals,
+                              const uint32_t *lens, int nrows,
+                              uint8_t *out, uint16_t *syms) {
+    const int W = FBTPU_PRE_LANES;
+    int32_t eol = cmap[256];
+    int32_t eol_super = 0;
+    for (int b = 0; b < k; b++) eol_super = eol_super * C + eol;
+    long long steps[W];
+    long long max_steps = 0;
+    for (int j = 0; j < W; j++) {
+        long long len = (j < nrows && vals[j] != nullptr) ? lens[j] : -1;
+        if (len < 0) {
+            steps[j] = 0;  // missing/non-string: stays DEAD
+        } else {
+            steps[j] = len / k + 1;  // >=1 trailing EOL symbol
+            if (steps[j] > max_steps) max_steps = steps[j];
+        }
+    }
+    // phase A: branchless super-symbol build. Scratch layout is
+    // [step][lane] so phase B's 8 lane loads per step share one cache
+    // line instead of touching 8 strided rows.
+    for (int j = 0; j < W; j++) {
+        if (steps[j] == 0) {
+            continue;  // lane is DEAD from the start; column never read
+        }
+        uint16_t *col = syms + j;
+        const uint8_t *v = vals[j];
+        long long len = lens[j];
+        long long full = len / k;  // groups with no pad byte
+        long long i = 0;
+        if (cmap2 != nullptr && k == 4) {
+            int32_t C2 = C * C;
+            for (; i < full; i++) {
+                const uint8_t *g = v + i * 4;
+                uint16_t w0, w1;
+                memcpy(&w0, g, 2);      // little-endian: b0 + (b1<<8)
+                memcpy(&w1, g + 2, 2);
+                col[i * W] = (uint16_t)(cmap2[w0] * C2 + cmap2[w1]);
+            }
+        } else if (cmap2 != nullptr && k == 2) {
+            for (; i < full; i++) {
+                uint16_t w0;
+                memcpy(&w0, v + i * 2, 2);
+                col[i * W] = cmap2[w0];
+            }
+        } else {
+            for (; i < full; i++) {
+                long long base = i * k;
+                int32_t cc = cmap[v[base]];
+                for (int b = 1; b < k; b++)
+                    cc = cc * C + cmap[v[base + b]];
+                col[i * W] = (uint16_t)cc;
+            }
+        }
+        for (; i < steps[j]; i++) {  // tail group: pad with EOL
+            long long base = i * k;
+            int32_t cc = 0;
+            for (int b = 0; b < k; b++) {
+                long long idx = base + b;
+                cc = cc * C + (idx < len ? cmap[v[idx]] : eol);
+            }
+            col[i * W] = (uint16_t)cc;
+        }
+        for (; i < max_steps; i++) col[i * W] = (uint16_t)eol_super;
+    }
+    // phase B: lockstep walk — 2 loads per lane-step
+    int32_t s[W];
+    for (int j = 0; j < W; j++)
+        s[j] = steps[j] ? start : 0;
+    const uint16_t *row = syms;
+    for (long long i = 0; i < max_steps; i++, row += W) {
+        int32_t acc = 0;
+        for (int j = 0; j < W; j++) {
+            s[j] = transk[s[j] * Ck + row[j]];
+            acc |= s[j];
+        }
+        if (acc <= 1) break;  // all lanes absorbed (DEAD/ACC)
+    }
+    for (int j = 0; j < W && j < nrows; j++)
+        out[j] = (uint8_t)(s[j] == 1);
+}
+
+#define FBTPU_OP_LEGACY 0
+#define FBTPU_OP_AND 1
+#define FBTPU_OP_OR 2
+
+// Verdict semantics are grep.c's (plugins/filter_grep/grep.c:167-284 in
+// the reference; same logic as plugins/filter_grep.py keep_record /
+// keep_mask):
+//  legacy — first matching rule decides (!exclude), a non-matching
+//           keep-rule decides EXCLUDE, fallthrough keeps;
+//  AND/OR — all/any rules match, verdict = found XOR exclude (rule
+//           kinds are uniform in these modes, enforced at config time).
+//
+// Three phases over chunk bytes:
+//   1. one msgpack walk extracts every key's (ptr, len) per record
+//   2. per rule, the interleaved accel matcher fills a match row
+//   3. verdict + run-coalesced compaction (contiguous kept records
+//      collapse into single memcpys; an all-kept chunk copies nothing
+//      and the caller reuses the input buffer)
+//
+// out_info[0]=n_records, out_info[1]=n_kept, out_info[2]=1 if `out`
+// holds the compacted bytes (0 = every record kept, out untouched).
+// Returns bytes written, -1 malformed, -2 capacity exceeded.
+long long fbtpu_grep_filter(const uint8_t *buf, long long buflen,
+                            const uint8_t *keys_cat,
+                            const long long *key_offs, long long n_keys,
+                            const int32_t *key_of_rule, long long n_rules,
+                            const int16_t *trans_cat,
+                            const long long *troffs,
+                            const int32_t *cmaps, const int32_t *starts,
+                            const int32_t *ncls,
+                            const uint16_t *cmap2_cat,
+                            const long long *cm2offs,
+                            const uint8_t *rule_exclude, int32_t op_mode,
+                            long long max_records,
+                            uint8_t *out, long long *out_info) {
+    if (n_keys > FBTPU_MAX_KEYS) return -1;
+    const uint8_t *p = buf, *end = buf + buflen;
+    long long n_rec = 0;
+    // ---- phase 1: extraction walk ----
+    // thread-local growable scratch: the fused filter runs per chunk on
+    // the ingest hot path, so per-call new[]/delete[] of multi-MB
+    // arrays (and the page faults behind them) must not recur
+    static thread_local const uint8_t **vals = nullptr;
+    static thread_local uint32_t *vlens = nullptr;
+    static thread_local long long *offsets = nullptr;
+    static thread_local uint8_t *match = nullptr;
+    static thread_local long long cap_vals = 0, cap_offs = 0, cap_match = 0;
+    if (n_keys * max_records > cap_vals) {
+        delete[] vals; delete[] vlens;
+        cap_vals = n_keys * max_records;
+        vals = new const uint8_t *[cap_vals];
+        vlens = new uint32_t[cap_vals];
+    }
+    if (max_records + 1 > cap_offs) {
+        delete[] offsets;
+        cap_offs = max_records + 1;
+        offsets = new long long[cap_offs];
+    }
+    if (n_rules * max_records > cap_match) {
+        delete[] match;
+        cap_match = n_rules * max_records;
+        match = new uint8_t[cap_match];
+    }
+    while (p < end) {
+        if (n_rec >= max_records) return -2;
+        offsets[n_rec] = p - buf;
+        const uint8_t *rec_start = p;
+        for (long long kx = 0; kx < n_keys; kx++)
+            vals[kx * max_records + n_rec] = nullptr;
+        uint32_t outer;
+        const uint8_t *rec_end = nullptr;
+        const uint8_t *q = read_array_hdr(p, end, &outer);
+        if (q && outer >= 2) {
+            const uint8_t *body = skip_obj(q, end, 0);
+            if (body) {
+                uint32_t pairs;
+                const uint8_t *kv = read_map_hdr(body, end, &pairs);
+                if (kv) {
+                    // one map walk resolves every rule's field; LAST
+                    // duplicate occurrence wins (dict-decode parity)
+                    for (uint32_t i = 0; i < pairs && kv; i++) {
+                        uint32_t klen;
+                        const uint8_t *kstr = read_str_hdr(kv, end, &klen);
+                        const uint8_t *val;
+                        long long match_kx = -1;
+                        if (kstr) {
+                            val = kstr + klen;
+                            if (val > end) { kv = nullptr; break; }
+                            for (long long kx = 0; kx < n_keys; kx++) {
+                                long long kl =
+                                    key_offs[kx + 1] - key_offs[kx];
+                                if (kl == (long long)klen &&
+                                    memcmp(kstr, keys_cat + key_offs[kx],
+                                           klen) == 0) {
+                                    match_kx = kx;
+                                    break;
+                                }
+                            }
+                        } else {
+                            val = skip_obj(kv, end, 0);  // non-str key
+                            if (!val) { kv = nullptr; break; }
+                        }
+                        if (match_kx >= 0) {
+                            uint32_t vlen;
+                            const uint8_t *vstr =
+                                read_str_hdr(val, end, &vlen);
+                            long long slot = match_kx * max_records + n_rec;
+                            if (vstr && vstr + vlen <= end) {
+                                vals[slot] = vstr;
+                                vlens[slot] = vlen;
+                            } else {
+                                vals[slot] = nullptr;  // non-string
+                            }
+                        }
+                        kv = skip_obj(val, end, 0);
+                    }
+                    if (kv && outer == 2) rec_end = kv;
+                }
+            }
+        }
+        p = rec_end ? rec_end : skip_obj(rec_start, end, 0);
+        if (!p) return -1;
+        n_rec++;
+    }
+    offsets[n_rec] = buflen;
+    // ---- phase 2: per-rule prepass + lockstep walk ----
+    // scratch sized to the longest value in the chunk
+    long long max_vlen = 0;
+    for (long long kx = 0; kx < n_keys; kx++)
+        for (long long i = 0; i < n_rec; i++)
+            if (vals[kx * max_records + i] != nullptr &&
+                (long long)vlens[kx * max_records + i] > max_vlen)
+                max_vlen = vlens[kx * max_records + i];
+    static thread_local uint16_t *syms = nullptr;
+    static thread_local long long syms_cap = 0;
+    // length-sorted processing order (per key): blocks of 16 lanes pad
+    // every lane to the block's longest value, so feeding blocks
+    // length-homogeneous records removes the padding waste of mixed
+    // traffic. Counting sort over 64-byte length buckets; match rows
+    // are written through the order array, so output order is intact.
+    static thread_local int32_t *order = nullptr;
+    static thread_local long long order_cap = 0;
+    if (n_keys * n_rec > order_cap) {
+        delete[] order;
+        order_cap = n_keys * n_rec;
+        order = new int32_t[order_cap];
+    }
+    bool order_built[FBTPU_MAX_KEYS] = {false};
+    const int N_BUCKETS = 64;
+    for (long long r = 0; r < n_rules; r++) {
+        long long kx = key_of_rule[r];
+        if (!order_built[kx]) {
+            order_built[kx] = true;
+            int32_t *ord = order + kx * n_rec;
+            const uint8_t *const *kv = vals + kx * max_records;
+            const uint32_t *kl = vlens + kx * max_records;
+            long long counts[N_BUCKETS + 1] = {0};
+            auto bucket = [&](long long i) -> int {
+                if (kv[i] == nullptr) return 0;
+                long long b = kl[i] / 64 + 1;
+                return b > N_BUCKETS ? N_BUCKETS : (int)b;
+            };
+            for (long long i = 0; i < n_rec; i++) counts[bucket(i)]++;
+            long long pos = 0;
+            long long starts_b[N_BUCKETS + 1];
+            for (int b = 0; b <= N_BUCKETS; b++) {
+                starts_b[b] = pos;
+                pos += counts[b];
+            }
+            for (long long i = 0; i < n_rec; i++)
+                ord[starts_b[bucket(i)]++] = (int32_t)i;
+        }
+    }
+    for (long long r = 0; r < n_rules; r++) {
+        const int16_t *trans = trans_cat + troffs[r];
+        const int32_t *cmap = cmaps + r * 257;
+        const uint16_t *cmap2 =
+            cm2offs[r] >= 0 ? cmap2_cat + cm2offs[r] : nullptr;
+        // ncls encodes C and the super-step k: C + 1000*(k-1)
+        int32_t enc = ncls[r];
+        int k = enc / 1000 + 1;
+        int32_t C = enc % 1000;
+        int32_t Ck = 1;
+        for (int b = 0; b < k; b++) Ck *= C;
+        long long need = FBTPU_PRE_LANES * (max_vlen / k + 2);
+        if (need > syms_cap) {
+            delete[] syms;
+            syms = new uint16_t[need];
+            syms_cap = need;
+        }
+        const uint8_t *const *kv = vals + key_of_rule[r] * max_records;
+        const uint32_t *kl = vlens + key_of_rule[r] * max_records;
+        const int32_t *ord = order + key_of_rule[r] * n_rec;
+        uint8_t *mrow = match + r * max_records;
+        const uint8_t *bv[FBTPU_PRE_LANES];
+        uint32_t bl[FBTPU_PRE_LANES];
+        uint8_t bm[FBTPU_PRE_LANES];
+        for (long long i = 0; i < n_rec; i += FBTPU_PRE_LANES) {
+            int nrows = (int)(n_rec - i < FBTPU_PRE_LANES
+                              ? n_rec - i : FBTPU_PRE_LANES);
+            for (int j = 0; j < nrows; j++) {
+                bv[j] = kv[ord[i + j]];
+                bl[j] = kl[ord[i + j]];
+            }
+            dfa_prepass_block(trans, cmap, cmap2, C, k, Ck, starts[r],
+                              bv, bl, nrows, bm, syms);
+            for (int j = 0; j < nrows; j++)
+                mrow[ord[i + j]] = bm[j];
+        }
+    }
+    // ---- phase 3: verdict + run-coalesced compaction ----
+    long long n_keep = 0, w = 0, run_s = 0, run_e = 0;
+    for (long long i = 0; i < n_rec; i++) {
+        int keep;
+        if (n_rules == 0) {
+            keep = 1;
+        } else if (op_mode == FBTPU_OP_LEGACY) {
+            keep = 1;  // fallthrough keeps
+            for (long long r = 0; r < n_rules; r++) {
+                if (match[r * max_records + i]) {
+                    keep = !rule_exclude[r];
+                    break;
+                }
+                if (!rule_exclude[r]) { keep = 0; break; }
+            }
+        } else {
+            int found = (op_mode == FBTPU_OP_AND);
+            for (long long r = 0; r < n_rules; r++) {
+                found = match[r * max_records + i];
+                if (op_mode == FBTPU_OP_OR && found) break;
+                if (op_mode == FBTPU_OP_AND && !found) break;
+            }
+            keep = rule_exclude[0] ? !found : found;
+        }
+        if (keep) {
+            n_keep++;
+            long long rs = offsets[i], re = offsets[i + 1];
+            if (rs == run_e) {
+                run_e = re;  // contiguous keep: extend the pending run
+            } else {
+                if (run_e > run_s) {
+                    memcpy(out + w, buf + run_s, (size_t)(run_e - run_s));
+                    w += run_e - run_s;
+                }
+                run_s = rs;
+                run_e = re;
+            }
+        }
+    }
+    out_info[0] = n_rec;
+    out_info[1] = n_keep;
+    if (n_keep == n_rec) {
+        out_info[2] = 0;  // nothing dropped: caller reuses the input
+        return 0;
+    }
+    if (run_e > run_s) {
+        memcpy(out + w, buf + run_s, (size_t)(run_e - run_s));
+        w += run_e - run_s;
+    }
+    out_info[2] = 1;
+    return w;
+}
+
 
 }  // extern "C"
