@@ -663,7 +663,8 @@ static void dfa_prepass_block(const int16_t *transk, const int32_t *cmap,
     long long steps[W];
     long long max_steps = 0;
     for (int j = 0; j < W; j++) {
-        long long len = (j < nrows && vals[j] != nullptr) ? lens[j] : -1;
+        long long len =
+            (j < nrows && vals[j] != nullptr) ? (long long)lens[j] : -1LL;
         if (len < 0) {
             steps[j] = 0;  // missing/non-string: stays DEAD
         } else {
@@ -676,7 +677,15 @@ static void dfa_prepass_block(const int16_t *transk, const int32_t *cmap,
     // line instead of touching 8 strided rows.
     for (int j = 0; j < W; j++) {
         if (steps[j] == 0) {
-            continue;  // lane is DEAD from the start; column never read
+            // lane is DEAD from the start (missing/non-string field or
+            // j >= nrows). Phase B still LOADS this lane's column every
+            // step, so it must hold valid symbols (< Ck) — fill with the
+            // absorbing EOL super-symbol. Leaving it uninitialized reads
+            // garbage that can index past the transition table.
+            uint16_t *col = syms + j;
+            for (long long i = 0; i < max_steps; i++)
+                col[i * W] = (uint16_t)eol_super;
+            continue;
         }
         uint16_t *col = syms + j;
         const uint8_t *v = vals[j];

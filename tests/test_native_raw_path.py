@@ -166,6 +166,85 @@ def test_native_scanner_fuzz_robustness():
         assert native.count_records(buf) == count_records(buf)
 
 
+@pytest.mark.parametrize("n", [1, 2, 15, 16, 17, 31, 33])
+def test_fused_filter_odd_block_sizes(n):
+    """Regression for the uninitialized dead-lane read: any chunk whose
+    record count isn't a multiple of 16, or with missing/non-string
+    fields, leaves prepass lanes DEAD — those columns must still hold
+    valid symbols for the lockstep walk (fbtpu_native.cpp
+    dfa_prepass_block)."""
+    from fluentbit_tpu.regex import FlbRegex
+    from fluentbit_tpu.regex.dfa import compile_dfa
+
+    tables = native.GrepFilterTables(
+        [(b"log", compile_dfa("GET"), False),
+         (b"log", compile_dfa("500$"), True)], "legacy")
+    rx = FlbRegex("GET")
+    rng = random.Random(n)
+    buf = bytearray()
+    bodies = []
+    for i in range(n):
+        roll = rng.random()
+        if roll < 0.25:
+            body = {}                       # missing field
+        elif roll < 0.5:
+            body = {"log": i}               # non-string
+        else:
+            body = {"log": f"GET /x/{i} 200"}
+        bodies.append(body)
+        buf += encode_event(body, float(i))
+    got = native.grep_filter(bytes(buf), tables)
+    assert got is not None
+    n_rec, n_keep, out = got
+    assert n_rec == n
+    expect = sum(
+        1 for b in bodies
+        if isinstance(b.get("log"), str) and rx.match(b["log"]))
+    assert n_keep == expect
+    kept = decode_events(bytes(out))
+    assert len(kept) == expect
+    for ev in kept:
+        assert isinstance(ev.body.get("log"), str)
+        assert rx.match(ev.body["log"])
+
+
+def test_fused_filter_fuzz_mutated_msgpack():
+    """fbtpu_grep_filter / fbtpu_stage_field must survive arbitrary
+    byte-flipped msgpack without crashing; valid buffers must keep the
+    same records as the Python regex engine."""
+    from fluentbit_tpu.regex import FlbRegex
+    from fluentbit_tpu.regex.dfa import compile_dfa
+
+    tables = native.GrepFilterTables(
+        [(b"log", compile_dfa("ERROR|WARN"), False)], "legacy")
+    rx = FlbRegex("ERROR|WARN")
+    rng = random.Random(1234)
+    for trial in range(120):
+        n = rng.randrange(1, 24)
+        buf = bytearray()
+        bodies = []
+        for i in range(n):
+            body = {"log": rng.choice(
+                ["ERROR boom", "WARN hm", "info ok", "", "x" * 300])}
+            if rng.random() < 0.2:
+                body["log"] = rng.randrange(10**6)
+            bodies.append(body)
+            buf += encode_event(body, float(i))
+        raw = bytes(buf)
+        got = native.grep_filter(raw, tables)
+        assert got is not None
+        expect = sum(1 for b in bodies
+                     if isinstance(b["log"], str) and rx.match(b["log"]))
+        assert got[1] == expect
+        # mutate: flip bytes / truncate — must not crash, may return None
+        mut = bytearray(raw)
+        for _ in range(rng.randrange(1, 6)):
+            mut[rng.randrange(len(mut))] = rng.randrange(256)
+        mut = bytes(mut[: rng.randrange(1, len(mut) + 1)])
+        native.grep_filter(mut, tables)
+        native.stage_field(mut, b"log", 64)
+
+
 def test_native_grep_match_differential():
     """One-pass C++ DFA matcher vs the Python regex engine over mixed
     corpora: apache2, alternation, anchors, bounded reps; missing /
