@@ -139,6 +139,17 @@ def _load():
             ctypes.c_longlong, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_longlong),
         ]
+        mt_fn = getattr(lib, "fbtpu_stage_field_mt", None)
+        if mt_fn is not None:
+            mt_fn.restype = ctypes.c_longlong
+            mt_fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_int32,
+            ]
         lib.fbtpu_compact.restype = ctypes.c_longlong
         lib.fbtpu_compact.argtypes = [
             ctypes.c_char_p, ctypes.c_longlong,
@@ -462,6 +473,21 @@ def grep_filter(buf, tables: "GrepFilterTables",
     return n, n_keep, memoryview(out)[:w]
 
 
+_stage_threads_cached: Optional[int] = None
+
+
+def _stage_threads() -> int:
+    global _stage_threads_cached
+    if _stage_threads_cached is None:
+        try:
+            _stage_threads_cached = int(
+                os.environ.get("FBTPU_STAGE_THREADS", "0")
+            ) or (os.cpu_count() or 1)
+        except ValueError:
+            _stage_threads_cached = os.cpu_count() or 1
+    return _stage_threads_cached
+
+
 def stage_field(
     buf: bytes, key: bytes, max_len: int, pad_to: Optional[int] = None,
     n_hint: Optional[int] = None,
@@ -469,7 +495,15 @@ def stage_field(
     """Fill the staging matrix for one top-level string field straight
     from chunk bytes: (batch[B, L] u8, lengths[B] i32, offsets[n+1] i64,
     n_records). ``pad_to`` rounds B for jit shape stability; ``n_hint``
-    (a caller-known record count) skips the counting pre-pass."""
+    (a caller-known record count) skips the counting pre-pass.
+
+    The returned arrays are views of a per-thread arena reused across
+    calls (the VERDICT-r4 staging-ceiling fix: a fresh zeroed [B, L]
+    matrix per chunk was pure memset bandwidth) — consume or copy them
+    before this thread's next stage_field call. Bytes past lengths[i]
+    in a row are NOT zeroed; consumers mask by length (both DFA kernels
+    do). Extraction fans out across the native worker pool
+    (fbtpu_stage_field_mt) when the chunk is large enough."""
     lib = _load()
     if lib is None:
         return None
@@ -477,16 +511,31 @@ def stage_field(
     if est is None:
         return None
     B = pad_to if pad_to and pad_to >= est else est
-    batch = np.zeros((B, max_len), dtype=np.uint8)
-    lengths = np.full((B,), -1, dtype=np.int32)
-    offsets = np.empty(est + 1, dtype=np.int64)
-    n = lib.fbtpu_stage_field(
-        buf, len(buf), key, len(key),
-        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        est, max_len,
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-    )
+    arena = getattr(_tls, "stage", None)
+    if (arena is None or arena[0].shape[0] < B
+            or arena[0].shape[1] != max_len):
+        batch = np.zeros((max(B, 1024), max_len), dtype=np.uint8)
+        lengths = np.empty((batch.shape[0],), dtype=np.int32)
+        offsets = np.empty(batch.shape[0] + 1, dtype=np.int64)
+        # ctypes pointers cached alongside: data_as() builds fresh
+        # pointer objects (~µs each), pure overhead at bench chunk rates
+        _tls.stage = arena = (
+            batch, lengths, offsets,
+            batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        )
+    batch, lengths, offsets, p_b, p_l, p_o = arena
+    mt_fn = getattr(lib, "fbtpu_stage_field_mt", None)
+    if mt_fn is not None:
+        n = mt_fn(buf, len(buf), key, len(key), p_b, p_l, est, max_len,
+                  p_o, _stage_threads())
+    else:
+        n = lib.fbtpu_stage_field(buf, len(buf), key, len(key), p_b, p_l,
+                                  est, max_len, p_o)
     if n < 0:
         return None
-    return batch, lengths, offsets, int(n)
+    n = int(n)
+    if n < B:
+        lengths[n:B] = -1  # pad rows (jit shape stability) stay "missing"
+    return batch[:B], lengths[:B], offsets[: n + 1], n

@@ -376,6 +376,11 @@ class GrepFilter(FilterPlugin):
                 batch, lengths, offs, count = got
                 if n is None:
                     n, offsets = count, offs
+                if len(by_key) > 1:
+                    # stage_field returns views of a per-thread arena
+                    # that the NEXT call overwrites — multi-key rule
+                    # sets must copy each key's staging out first
+                    batch, lengths = batch.copy(), lengths.copy()
                 staged[key] = (batch, lengths)
             if n is None or n < self.tpu_batch_records:
                 return None  # small batches: decode path is cheaper

@@ -99,6 +99,49 @@ def test_program_built_only_when_capable():
     assert f2._program is None
 
 
+def test_staged_multi_key_rules_raw_path():
+    """Rules over TWO different field heads through the staged raw path:
+    stage_field returns per-thread arena views, so the per-key staging
+    loop must copy each key's batch out before staging the next key
+    (regression: the second call overwrote the first key's bytes and
+    every rule matched against the last key's field)."""
+    from fluentbit_tpu import native
+
+    if not native.available():
+        pytest.skip("native unavailable")
+    f = make_filter([
+        ("regex", "log GET"), ("exclude", "stream stderr"),
+        ("tpu_batch_records", "1"),
+    ])
+    if f._program is None or not f._program.try_ready():
+        pytest.skip("device program unavailable")
+    # force the staged (by_key) path: no fused/native tables
+    f._native_filter = None
+    f._native_tables = None
+    rng = random.Random(5)
+    buf = bytearray()
+    bodies = []
+    for i in range(300):
+        body = {
+            "log": f"{rng.choice(['GET', 'POST'])} /x/{i} 200",
+            "stream": rng.choice(["stdout", "stderr"]),
+        }
+        if rng.random() < 0.1:
+            body.pop("log")
+        bodies.append(body)
+        buf += encode_event(body, float(i))
+    got = f.filter_raw(bytes(buf), "t", None, n_records=len(bodies))
+    assert got is not None
+    n_keep, out = got
+    kept = decode_events(bytes(out))
+    expected = [b for b in bodies if f.keep_record(b)]
+    assert n_keep == len(expected)
+    assert [e.body for e in kept] == expected
+    # sanity: the expectation itself must depend on BOTH fields
+    assert any(b.get("stream") == "stderr" for b in bodies)
+    assert 0 < len(expected) < len(bodies)
+
+
 def test_non_string_values_never_match():
     """String-only matching (src/flb_ra_key.c:418): ints don't match."""
     f = make_filter([("regex", r"n \d+")])
