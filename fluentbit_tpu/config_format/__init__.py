@@ -283,6 +283,10 @@ def _apply_processors(ctx, ffd, processors: Dict[str, list]) -> None:
             if not isinstance(unit, dict) or "name" not in unit:
                 raise ValueError(f"processor unit needs a name: {unit!r}")
             proc = ins.create_processor(unit["name"])
+            # which side of the pipeline this unit runs on — plugins
+            # whose semantics are side-specific (tail sampling re-
+            # injection) validate against it at init
+            proc.side = target.kind
             for k, v in unit.items():
                 if k in ("name", "condition"):
                     continue

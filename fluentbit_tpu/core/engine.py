@@ -461,6 +461,16 @@ class Engine:
                         drain(self)
                     except Exception:
                         log.exception("%s drain failed", ins.display_name)
+                # attached processors may hold state too (tail sampler's
+                # undecided traces): give them the same drain window
+                for proc in getattr(ins, "processors", None) or []:
+                    pdrain = getattr(proc.plugin, "drain", None)
+                    if pdrain is not None:
+                        try:
+                            pdrain(self)
+                        except Exception:
+                            log.exception("%s processor drain failed",
+                                          proc.name)
             if self.sp is not None:  # flush open SQL windows
                 with self._ingest_lock:
                     try:
@@ -773,10 +783,16 @@ class Engine:
         self.m_in_records.inc(n_records, (ins.display_name,))
         self.m_in_bytes.inc(len(data), (ins.display_name,))
         with self._ingest_lock:
-            # input-side metrics processors (flb_processor_run on the
-            # typed append path)
+            # input-side metrics/traces processors (flb_processor_run on
+            # the typed append path)
             if ins.processors and event_type == EVENT_TYPE_METRICS:
                 data = self._run_metrics_processors(ins.processors, data, tag)
+            elif ins.processors and event_type == EVENT_TYPE_TRACES:
+                data, n_records = self._run_traces_processors(
+                    ins.processors, data, tag, n_records)
+                if not data:
+                    # all spans buffered (tail sampling) or dropped
+                    return n_records
             with ins.ingest_lock:
                 chunk = ins.pool.append(tag, data, n_records, event_type)
                 if self.storage is not None and ins.storage_type == "filesystem":
@@ -859,18 +875,48 @@ class Engine:
             events = out
         return events
 
-    def _run_metrics_processors(self, procs, data: bytes, tag: str) -> bytes:
-        """Run a metrics processor pipeline over encoded payloads."""
+    def _run_payload_processors(self, procs, data: bytes, tag: str,
+                                method: str) -> Optional[bytes]:
+        """Shared unpack → per-plugin pipeline → repack shape for the
+        typed (metrics/traces) processor paths. Returns the re-encoded
+        payloads, b"" when a stage consumed everything, or None on
+        pipeline failure (caller keeps the original bytes)."""
         from ..codec.msgpack import Unpacker, packb
 
         try:
             payloads = list(Unpacker(data))
             for proc in procs:
-                payloads = proc.plugin.process_metrics(payloads, tag, self)
+                payloads = getattr(proc.plugin, method)(payloads, tag, self)
+                if not payloads:
+                    return b""
             return b"".join(packb(p) for p in payloads)
         except Exception:
-            log.exception("metrics processor pipeline failed")
-            return data
+            log.exception("%s processor pipeline failed", method)
+            return None
+
+    def _run_metrics_processors(self, procs, data: bytes, tag: str) -> bytes:
+        """Run a metrics processor pipeline over encoded payloads."""
+        out = self._run_payload_processors(procs, data, tag,
+                                           "process_metrics")
+        return data if out is None else out
+
+    def _run_traces_processors(self, procs, data: bytes, tag: str,
+                               n_records: int):
+        """Run a traces processor pipeline over encoded typed payloads
+        (flb_processor_run on the trace append path,
+        src/flb_input_trace.c). Returns (data, n_spans); b"" data means
+        every span was consumed (dropped, or buffered by a tail sampler
+        that re-injects later via its emitter)."""
+        from ..codec.msgpack import Unpacker
+        from ..codec.telemetry import count_spans
+
+        out = self._run_payload_processors(procs, data, tag,
+                                           "process_traces")
+        if out is None:
+            return data, n_records
+        if not out:
+            return b"", 0
+        return out, sum(count_spans(p) for p in Unpacker(out))
 
     def _run_filters(self, events: List[LogEvent], tag: str,
                      trace_ctx: Optional[dict] = None) -> List[LogEvent]:
@@ -1093,6 +1139,9 @@ class Engine:
         elif out.processors and chunk.event_type == EVENT_TYPE_METRICS:
             data = self._run_metrics_processors(out.processors, data,
                                                 chunk.tag)
+        elif out.processors and chunk.event_type == EVENT_TYPE_TRACES:
+            data, _ = self._run_traces_processors(out.processors, data,
+                                                  chunk.tag, chunk.records)
         if out.processors:
             task.processed[out.name] = data
         return data

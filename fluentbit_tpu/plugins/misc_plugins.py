@@ -1,11 +1,9 @@
-"""Remaining breadth: processor_sampling, out_nats, in_kmsg,
-in_docker_events.
+"""Remaining breadth: out_nats, in_kmsg, in_docker_events.
 
-Reference: plugins/processor_sampling (probabilistic + tail trace
-sampling — probabilistic mode applied per record here; tail mode needs
-trace grouping and is gated), plugins/out_nats (NATS text protocol
-CONNECT/PUB), plugins/in_kmsg (/dev/kmsg kernel log), plugins/
-in_docker_events (docker daemon /events over the unix socket).
+Reference: plugins/out_nats (NATS text protocol CONNECT/PUB),
+plugins/in_kmsg (/dev/kmsg kernel log), plugins/in_docker_events
+(docker daemon /events over the unix socket). The sampling processor
+moved to processor_sampling.py (probabilistic + tail modes).
 """
 
 from __future__ import annotations
@@ -14,9 +12,8 @@ import asyncio
 import json
 import logging
 import os
-import random
 import time
-from typing import Dict, List, Optional
+from typing import Dict
 
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
@@ -24,40 +21,11 @@ from ..core.plugin import (
     FlushResult,
     InputPlugin,
     OutputPlugin,
-    ProcessorPlugin,
     registry,
 )
 from .outputs_basic import format_json_lines
 
 log = logging.getLogger("flb.misc")
-
-
-@registry.register
-class SamplingProcessor(ProcessorPlugin):
-    name = "sampling"
-    description = "probabilistic record sampling"
-    config_map = [
-        ConfigMapEntry("type", "str", default="probabilistic"),
-        ConfigMapEntry("sampling_settings_sampling_percentage", "double",
-                       default=10.0),
-        ConfigMapEntry("percentage", "double"),
-        ConfigMapEntry("seed", "int"),
-    ]
-
-    def init(self, instance, engine) -> None:
-        if (self.type or "probabilistic").lower() != "probabilistic":
-            raise ValueError(
-                "sampling: only probabilistic mode is implemented "
-                "(tail sampling needs trace grouping)"
-            )
-        pct = self.percentage
-        if pct is None:
-            pct = self.sampling_settings_sampling_percentage
-        self._p = max(0.0, min(100.0, float(pct))) / 100.0
-        self._rng = random.Random(self.seed)
-
-    def process_logs(self, events: list, tag: str, engine) -> list:
-        return [ev for ev in events if self._rng.random() < self._p]
 
 
 @registry.register

@@ -1,0 +1,490 @@
+"""Trace sampling processor — probabilistic and tail modes.
+
+Reference: plugins/processor_sampling/sampling.c (mode vtable),
+sampling_probabilistic.c:63-90 (deterministic trace-id percentage over
+spans), sampling_tail.c:677-745 (decision window + condition check +
+reconcile + re-injection via the input pipeline), and
+sampling_span_registry.c (trace-keyed span registry with max_traces
+eviction). Condition evaluators mirror sampling_cond_latency.c,
+sampling_cond_span_count.c, sampling_cond_status_codes.c,
+sampling_cond_string_attribute.c, sampling_cond_numeric_attribute.c,
+sampling_cond_boolean_attribute.c and sampling_cond_trace_state.c.
+
+Tail mode buffers every span by trace id; ``decision_wait`` after a
+trace's first span arrives, its spans are evaluated against the
+configured conditions — ONE matching span samples the whole trace
+(check_conditions, sampling_tail.c:677) — and sampled traces are
+reconciled into fresh typed payloads and re-injected through a hidden
+emitter input (the flb_input_trace_append_skip_processor_stages
+equivalent: the emitter carries no processors, so re-entry is
+impossible by construction).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..codec.msgpack import packb
+from ..core.config import ConfigMapEntry
+from ..core.plugin import ProcessorPlugin, registry
+
+log = logging.getLogger("flb.sampling")
+
+_STATUS = {"UNSET": 0, "OK": 1, "ERROR": 2}
+
+
+def _parse_time_s(v, default: float) -> float:
+    """'30s' / '500ms' / '2m' / bare numbers → seconds."""
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip().lower()
+    m = re.fullmatch(r"([0-9.]+)\s*(ms|s|m|h)?", s)
+    if not m:
+        raise ValueError(f"invalid time value {v!r}")
+    n = float(m.group(1))
+    return n * {"ms": 1e-3, None: 1.0, "s": 1.0, "m": 60.0,
+                "h": 3600.0}[m.group(2)]
+
+
+def _latency_ms(span: dict) -> Optional[int]:
+    start = int(span.get("startTimeUnixNano", 0) or 0)
+    end = int(span.get("endTimeUnixNano", 0) or 0)
+    if start > end:
+        return None  # sampling_cond_latency.c:34 — malformed: no match
+    return (end - start) // 1_000_000
+
+
+class _Cond:
+    """One evaluator; check(entry_spans, span) -> bool."""
+
+    def __init__(self, cfg: Dict[str, Any]):
+        self.cfg = cfg
+
+    def check(self, trace_spans: List[dict], span: dict) -> bool:
+        raise NotImplementedError
+
+
+class _CondLatency(_Cond):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.low = int(cfg.get("threshold_ms_low", 0) or 0)
+        self.high = int(cfg.get("threshold_ms_high", 0) or 0)
+        if not self.low and not self.high:
+            raise ValueError(
+                "latency condition needs threshold_ms_low or "
+                "threshold_ms_high")
+
+    def check(self, trace_spans, span):
+        lat = _latency_ms(span)
+        if lat is None:
+            return False
+        return bool((self.low and lat <= self.low)
+                    or (self.high and lat >= self.high))
+
+
+class _CondStatusCodes(_Cond):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        codes = cfg.get("status_codes")
+        if not codes:
+            raise ValueError("status_code condition needs 'status_codes'")
+        self.codes = set()
+        for c in codes:
+            cu = str(c).upper()
+            if cu not in _STATUS:
+                raise ValueError(f"invalid status code {c!r}")
+            self.codes.add(_STATUS[cu])
+
+    def check(self, trace_spans, span):
+        code = int((span.get("status") or {}).get("code", 0) or 0)
+        return code in self.codes
+
+
+class _CondSpanCount(_Cond):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        if "min_spans" not in cfg:
+            raise ValueError("span_count condition needs 'min_spans'")
+        self.min = int(cfg["min_spans"])
+        self.max = int(cfg.get("max_spans", 2**31 - 1))
+
+    def check(self, trace_spans, span):
+        return self.min <= len(trace_spans) <= self.max
+
+
+class _CondStringAttribute(_Cond):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.key = cfg.get("key")
+        if not self.key:
+            raise ValueError("string_attribute condition needs 'key'")
+        self.match_type = str(cfg.get("match_type", "strict")).lower()
+        if self.match_type not in ("strict", "exists", "regex"):
+            raise ValueError(
+                f"invalid match_type {cfg.get('match_type')!r}")
+        values = cfg.get("values") or []
+        if not values and self.match_type != "exists":
+            raise ValueError("string_attribute condition needs 'values'")
+        if self.match_type == "regex":
+            from ..regex import FlbRegex
+
+            self.patterns = [FlbRegex(str(v)) for v in values]
+        else:
+            self.values = {str(v) for v in values}
+
+    def check(self, trace_spans, span):
+        attrs = span.get("attributes") or {}
+        if self.key not in attrs:
+            return False
+        if self.match_type == "exists":
+            return True
+        v = attrs[self.key]
+        if not isinstance(v, str):
+            return False
+        if self.match_type == "regex":
+            return any(p.match(v) for p in self.patterns)
+        return v in self.values
+
+
+class _CondNumericAttribute(_Cond):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.key = cfg.get("key")
+        if not self.key:
+            raise ValueError("numeric_attribute condition needs 'key'")
+        self.match_type = str(cfg.get("match_type", "strict")).lower()
+        if self.match_type not in ("strict", "exists"):
+            raise ValueError(
+                f"invalid match_type {cfg.get('match_type')!r}")
+        if self.match_type == "strict":
+            if "min_value" not in cfg or "max_value" not in cfg:
+                raise ValueError(
+                    "numeric_attribute condition needs 'min_value' and "
+                    "'max_value'")
+            self.min = int(cfg["min_value"])
+            self.max = int(cfg["max_value"])
+
+    def check(self, trace_spans, span):
+        attrs = span.get("attributes") or {}
+        if self.key not in attrs:
+            return False
+        if self.match_type == "exists":
+            return True
+        v = attrs[self.key]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return False
+        return self.min <= v <= self.max
+
+
+class _CondBooleanAttribute(_Cond):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.key = cfg.get("key")
+        if not self.key:
+            raise ValueError("boolean_attribute condition needs 'key'")
+        if "value" not in cfg:
+            raise ValueError("boolean_attribute condition needs 'value'")
+        v = cfg["value"]
+        if isinstance(v, str):
+            v = v.strip().lower() == "true"
+        self.value = bool(v)
+
+    def check(self, trace_spans, span):
+        v = (span.get("attributes") or {}).get(self.key)
+        if not isinstance(v, bool):
+            return False
+        return v is self.value
+
+
+class _CondTraceState(_Cond):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        values = cfg.get("values")
+        if not values:
+            raise ValueError("trace_state condition needs 'values'")
+        self.values = {str(v).strip() for v in values}
+
+    def check(self, trace_spans, span):
+        state = span.get("traceState") or ""
+        for kv in state.split(","):
+            if kv.strip() in self.values:
+                return True
+        return False
+
+
+_COND_TYPES = {
+    "latency": _CondLatency,
+    "status_code": _CondStatusCodes,
+    "status_codes": _CondStatusCodes,
+    "span_count": _CondSpanCount,
+    "string_attribute": _CondStringAttribute,
+    "numeric_attribute": _CondNumericAttribute,
+    "boolean_attribute": _CondBooleanAttribute,
+    "trace_state": _CondTraceState,
+}
+
+
+def make_condition(cfg: Dict[str, Any]) -> _Cond:
+    t = str(cfg.get("type", "")).lower()
+    if t not in _COND_TYPES:
+        raise ValueError(f"unknown sampling condition type {cfg.get('type')!r}")
+    return _COND_TYPES[t](cfg)
+
+
+class _TraceEntry:
+    __slots__ = ("ts_created", "tag", "spans")
+
+    def __init__(self, ts: float, tag: str):
+        self.ts_created = ts
+        self.tag = tag
+        # (resource_attrs, scope, span) trios preserving origin context
+        self.spans: List[Tuple[dict, dict, dict]] = []
+
+
+def _trace_key(span: dict) -> str:
+    tid = span.get("traceId") or b""
+    return tid.hex() if isinstance(tid, bytes) else str(tid)
+
+
+def _reconcile(entry: _TraceEntry) -> dict:
+    """Group a trace's spans back into resourceSpans/scopeSpans trees
+    (reconcile_and_create_ctrace_optimized, sampling_tail.c:694-735)."""
+    rs_list: List[dict] = []
+    rs_index: Dict[str, dict] = {}
+    for resource, scope, span in entry.spans:
+        rkey = repr(sorted((resource or {}).items()))
+        rs = rs_index.get(rkey)
+        if rs is None:
+            rs = {"resource": resource or {}, "scopeSpans": [],
+                  "_scopes": {}}
+            rs_index[rkey] = rs
+            rs_list.append(rs)
+        skey = ((scope or {}).get("name", ""),
+                (scope or {}).get("version", ""))
+        ss = rs["_scopes"].get(skey)
+        if ss is None:
+            ss = {"scope": scope or {}, "spans": []}
+            rs["_scopes"][skey] = ss
+            rs["scopeSpans"].append(ss)
+        ss["spans"].append(span)
+    for rs in rs_list:
+        del rs["_scopes"]
+    return {"resourceSpans": rs_list}
+
+
+def _trace_id_fraction(span: dict) -> float:
+    """First 8 bytes of trace_id, big-endian, mod 1e6 / 1e4 — the
+    deterministic hash of sampling_probabilistic.c:63-90 (same trace
+    always gets the same verdict across agents)."""
+    tid = span.get("traceId") or b""
+    if isinstance(tid, str):
+        try:
+            tid = bytes.fromhex(tid)
+        except ValueError:
+            tid = b""
+    if len(tid) < 8:
+        return 0.0
+    return (int.from_bytes(tid[:8], "big") % 1_000_000) / 10_000.0
+
+
+@registry.register
+class SamplingProcessor(ProcessorPlugin):
+    """processor_sampling: probabilistic (logs + traces) and tail
+    (traces) sampling."""
+
+    name = "sampling"
+    description = "probabilistic and tail trace sampling"
+    config_map = [
+        ConfigMapEntry("type", "str", default="probabilistic"),
+        ConfigMapEntry("sampling_settings", "raw"),
+        ConfigMapEntry("conditions", "raw"),
+        ConfigMapEntry("sampling_settings_sampling_percentage", "double",
+                       default=10.0),
+        ConfigMapEntry("percentage", "double"),
+        ConfigMapEntry("seed", "int"),
+    ]
+
+    def init(self, instance, engine) -> None:
+        import random
+
+        self.mode = (self.type or "probabilistic").lower()
+        self._lock = threading.Lock()
+        self._emitter = None
+        settings = instance.prop("sampling_settings") or {}
+        if isinstance(settings, str):
+            import json
+
+            settings = json.loads(settings)  # classic .conf: JSON value
+        if not isinstance(settings, dict):
+            raise ValueError("sampling_settings must be a mapping")
+        if self.mode == "probabilistic":
+            pct = self.percentage
+            if pct is None:
+                pct = settings.get("sampling_percentage")
+            if pct is None:
+                pct = self.sampling_settings_sampling_percentage
+            self._p = max(0.0, min(100.0, float(pct)))
+            self._rng = random.Random(self.seed)
+            return
+        if self.mode != "tail":
+            raise ValueError(
+                f"sampling: unknown type {self.mode!r} "
+                "(probabilistic|tail)")
+        if getattr(instance, "side", "input") == "output":
+            # an output-side tail sampler would buffer at flush and
+            # re-inject through the pipeline BACK to the same output —
+            # an infinite buffer/re-route cycle that never delivers
+            raise ValueError(
+                "tail sampling must run on an input's traces pipeline, "
+                "not on an output")
+        self.decision_wait = _parse_time_s(
+            settings.get("decision_wait"), 30.0)
+        self.max_traces = int(settings.get("max_traces", 50000))
+        conds = instance.prop("conditions")
+        if conds is None:
+            conds = settings.get("conditions")
+        if isinstance(conds, str):
+            import json
+
+            conds = json.loads(conds)
+        self.conditions = [make_condition(c) for c in (conds or [])]
+        self._traces: "OrderedDict[str, _TraceEntry]" = OrderedDict()
+        self._evicted = 0
+        if engine is not None:
+            self._attach_timer(engine)
+
+    def _attach_timer(self, engine) -> None:
+        """Hidden emitter input: carries the decision timer (the
+        FLB_SCHED_TIMER_CB_PERM of sampling_tail.c:860) and re-injects
+        sampled traces with no processors attached."""
+        ins = engine.hidden_input(
+            "emitter", alias=f"emitter_for_{self.instance.name}")
+        self._emitter = ins
+        proc = self
+
+        def _tick(eng):
+            proc.flush_decided(eng)
+
+        ins.plugin.collect_interval = min(1.0, self.decision_wait)
+        ins.plugin.collect = _tick
+        engine.ensure_collector(ins)
+
+    # ------------------------------------------------------------ logs
+
+    def process_logs(self, events: list, tag: str, engine) -> list:
+        if self.mode != "probabilistic":
+            return events
+        p = self._p / 100.0
+        return [ev for ev in events if self._rng.random() < p]
+
+    # ---------------------------------------------------------- traces
+
+    def process_traces(self, payloads: list, tag: str, engine) -> list:
+        if self.mode == "probabilistic":
+            return self._probabilistic_traces(payloads)
+        self._register_spans(payloads, tag)
+        return []  # buffered; the timer emits decided traces
+
+    def _probabilistic_traces(self, payloads: list) -> list:
+        out = []
+        for payload in payloads:
+            rs_out = []
+            for rs in payload.get("resourceSpans", []):
+                ss_out = []
+                for ss in rs.get("scopeSpans", []):
+                    spans = [s for s in ss.get("spans", [])
+                             if _trace_id_fraction(s) < self._p]
+                    if spans:
+                        ss_out.append({**ss, "spans": spans})
+                if ss_out:
+                    rs_out.append({**rs, "scopeSpans": ss_out})
+            if rs_out:
+                out.append({"resourceSpans": rs_out})
+        return out
+
+    def _register_spans(self, payloads: list, tag: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for payload in payloads:
+                for rs in payload.get("resourceSpans", []):
+                    resource = rs.get("resource") or {}
+                    for ss in rs.get("scopeSpans", []):
+                        scope = ss.get("scope") or {}
+                        for span in ss.get("spans", []):
+                            key = _trace_key(span)
+                            entry = self._traces.get(key)
+                            if entry is None:
+                                entry = _TraceEntry(now, tag)
+                                self._traces[key] = entry
+                                # max_traces cap: evict the OLDEST trace
+                                # undecided (sampling_span_registry.c)
+                                while len(self._traces) > self.max_traces:
+                                    old_key, old = self._traces.popitem(
+                                        last=False)
+                                    self._evicted += 1
+                                    log.warning(
+                                        "sampling: max_traces=%d "
+                                        "exceeded, evicted trace %s "
+                                        "(%d spans)", self.max_traces,
+                                        old_key, len(old.spans))
+                            entry.spans.append((resource, scope, span))
+
+    def _sampled(self, entry: _TraceEntry) -> bool:
+        """ONE span matching ANY condition samples the trace; no
+        conditions configured → sample everything
+        (check_conditions, sampling_tail.c:677-691)."""
+        if not self.conditions:
+            return True
+        spans = [s for _, _, s in entry.spans]
+        for span in spans:
+            for cond in self.conditions:
+                if cond.check(spans, span):
+                    return True
+        return False
+
+    def flush_decided(self, engine, force: bool = False) -> int:
+        """Evaluate traces whose decision window elapsed; re-inject the
+        sampled ones through the emitter. Returns spans emitted."""
+        from ..codec.chunk import EVENT_TYPE_TRACES
+        from ..codec.telemetry import count_spans
+
+        now = time.monotonic()
+        decided: List[Tuple[str, _TraceEntry]] = []
+        with self._lock:
+            for key, entry in list(self._traces.items()):
+                if force or now - entry.ts_created >= self.decision_wait:
+                    decided.append((key, entry))
+                    del self._traces[key]
+        emitted = 0
+        for key, entry in decided:
+            if not self._sampled(entry):
+                continue
+            payload = _reconcile(entry)
+            n = count_spans(payload)
+            if engine is not None:
+                if self._emitter is None:
+                    self._attach_timer(engine)
+                engine.input_event_append(
+                    self._emitter, entry.tag, packb(payload),
+                    EVENT_TYPE_TRACES, n_records=n)
+            emitted += n
+        return emitted
+
+    def pending_traces(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def drain(self, engine) -> None:
+        """Engine shutdown: decide everything still buffered NOW so a
+        stop inside the decision window doesn't lose sampled traces
+        (the engine drains plugins + processors before its final
+        flush)."""
+        if self.mode == "tail":
+            self.flush_decided(engine, force=True)

@@ -1,0 +1,379 @@
+"""Tail trace sampling: span registry, decision window, condition
+evaluators, reconcile + re-injection (reference
+plugins/processor_sampling/sampling_tail.c, sampling_cond_*.c)."""
+
+import time
+
+import pytest
+
+from fluentbit_tpu.codec.msgpack import Unpacker, packb
+from fluentbit_tpu.codec.telemetry import count_spans
+from fluentbit_tpu.core.engine import Engine
+from fluentbit_tpu.core.plugin import registry
+
+
+def make_span(trace_id: bytes, span_id: bytes, name="op", lat_ms=50,
+              status=None, attrs=None, trace_state=None):
+    start = 1_700_000_000_000_000_000
+    s = {
+        "traceId": trace_id,
+        "spanId": span_id,
+        "name": name,
+        "kind": 2,
+        "startTimeUnixNano": start,
+        "endTimeUnixNano": start + lat_ms * 1_000_000,
+        "attributes": attrs or {},
+    }
+    if status is not None:
+        s["status"] = {"code": status, "message": ""}
+    if trace_state is not None:
+        s["traceState"] = trace_state
+    return s
+
+
+def payload_of(*spans, resource=None, scope=None):
+    return {"resourceSpans": [{
+        "resource": resource or {"service.name": "svc"},
+        "scopeSpans": [{"scope": scope or {"name": "lib", "version": "1"},
+                        "spans": list(spans)}],
+    }]}
+
+
+def make_proc(settings=None, conditions=None, mode="tail", engine=None):
+    proc = registry.create_processor("sampling")
+    proc.set("type", mode)
+    if settings is not None:
+        proc.set("sampling_settings", settings)
+    if conditions is not None:
+        proc.set("conditions", conditions)
+    proc.configure()
+    proc.plugin.init(proc, engine)
+    return proc.plugin
+
+
+def tid(i):
+    return bytes([i]) * 16
+
+
+def sid(i):
+    return bytes([i]) * 8
+
+
+def test_tail_mode_initializes():
+    p = make_proc({"decision_wait": "5s", "max_traces": 100})
+    assert p.decision_wait == 5.0
+    assert p.max_traces == 100
+
+
+def test_tail_buffers_and_emits_on_decision():
+    p = make_proc({"decision_wait": "60s"})
+    out = p.process_traces(
+        [payload_of(make_span(tid(1), sid(1)),
+                    make_span(tid(1), sid(2)))], "tr", None)
+    assert out == []  # buffered
+    assert p.pending_traces() == 1
+    # window not elapsed: nothing decided
+    assert p.flush_decided(None) == 0
+    assert p.pending_traces() == 1
+    # force: no conditions configured -> sampled
+    assert p.flush_decided(None, force=True) == 2
+    assert p.pending_traces() == 0
+
+
+def test_latency_condition():
+    p = make_proc({"decision_wait": "60s"},
+                  [{"type": "latency", "threshold_ms_high": 500}])
+    p.process_traces([payload_of(make_span(tid(1), sid(1), lat_ms=900))],
+                     "tr", None)
+    p.process_traces([payload_of(make_span(tid(2), sid(2), lat_ms=30))],
+                     "tr", None)
+    kept = []
+    for key, entry in list(p._traces.items()):
+        if p._sampled(entry):
+            kept.append(key)
+    assert kept == [tid(1).hex()]
+    # threshold_ms_low keeps FAST traces (ref: lat <= low matches)
+    p2 = make_proc({"decision_wait": "60s"},
+                   [{"type": "latency", "threshold_ms_low": 40}])
+    p2.process_traces([payload_of(make_span(tid(3), sid(3), lat_ms=30))],
+                      "tr", None)
+    p2.process_traces([payload_of(make_span(tid(4), sid(4), lat_ms=300))],
+                      "tr", None)
+    assert p2._sampled(p2._traces[tid(3).hex()])
+    assert not p2._sampled(p2._traces[tid(4).hex()])
+
+
+def test_status_codes_condition():
+    p = make_proc({"decision_wait": "60s"},
+                  [{"type": "status_code", "status_codes": ["ERROR"]}])
+    p.process_traces([payload_of(make_span(tid(1), sid(1), status=2))],
+                     "tr", None)
+    p.process_traces([payload_of(make_span(tid(2), sid(2), status=1))],
+                     "tr", None)
+    p.process_traces([payload_of(make_span(tid(3), sid(3)))], "tr", None)
+    assert p._sampled(p._traces[tid(1).hex()])
+    assert not p._sampled(p._traces[tid(2).hex()])
+    assert not p._sampled(p._traces[tid(3).hex()])
+
+
+def test_span_count_condition():
+    p = make_proc({"decision_wait": "60s"},
+                  [{"type": "span_count", "min_spans": 3}])
+    p.process_traces([payload_of(*[make_span(tid(1), sid(i))
+                                   for i in range(4)])], "tr", None)
+    p.process_traces([payload_of(make_span(tid(2), sid(9)))], "tr", None)
+    assert p._sampled(p._traces[tid(1).hex()])
+    assert not p._sampled(p._traces[tid(2).hex()])
+
+
+def test_string_attribute_condition():
+    conds = [{"type": "string_attribute", "key": "http.method",
+              "values": ["POST", "PUT"]}]
+    p = make_proc({"decision_wait": "60s"}, conds)
+    p.process_traces([payload_of(
+        make_span(tid(1), sid(1), attrs={"http.method": "POST"}))],
+        "tr", None)
+    p.process_traces([payload_of(
+        make_span(tid(2), sid(2), attrs={"http.method": "GET"}))],
+        "tr", None)
+    assert p._sampled(p._traces[tid(1).hex()])
+    assert not p._sampled(p._traces[tid(2).hex()])
+    # regex + exists
+    p2 = make_proc({"decision_wait": "60s"},
+                   [{"type": "string_attribute", "key": "url",
+                     "match_type": "regex", "values": ["^/api/"]}])
+    p2.process_traces([payload_of(
+        make_span(tid(3), sid(3), attrs={"url": "/api/v1/x"}))], "tr", None)
+    p2.process_traces([payload_of(
+        make_span(tid(4), sid(4), attrs={"url": "/health"}))], "tr", None)
+    assert p2._sampled(p2._traces[tid(3).hex()])
+    assert not p2._sampled(p2._traces[tid(4).hex()])
+    p3 = make_proc({"decision_wait": "60s"},
+                   [{"type": "string_attribute", "key": "tenant",
+                     "match_type": "exists"}])
+    p3.process_traces([payload_of(
+        make_span(tid(5), sid(5), attrs={"tenant": "x"}))], "tr", None)
+    p3.process_traces([payload_of(make_span(tid(6), sid(6)))], "tr", None)
+    assert p3._sampled(p3._traces[tid(5).hex()])
+    assert not p3._sampled(p3._traces[tid(6).hex()])
+
+
+def test_numeric_and_boolean_attribute_conditions():
+    p = make_proc({"decision_wait": "60s"},
+                  [{"type": "numeric_attribute", "key": "http.status",
+                    "min_value": 500, "max_value": 599}])
+    p.process_traces([payload_of(
+        make_span(tid(1), sid(1), attrs={"http.status": 503}))], "tr", None)
+    p.process_traces([payload_of(
+        make_span(tid(2), sid(2), attrs={"http.status": 200}))], "tr", None)
+    assert p._sampled(p._traces[tid(1).hex()])
+    assert not p._sampled(p._traces[tid(2).hex()])
+    p2 = make_proc({"decision_wait": "60s"},
+                   [{"type": "boolean_attribute", "key": "error",
+                     "value": True}])
+    p2.process_traces([payload_of(
+        make_span(tid(3), sid(3), attrs={"error": True}))], "tr", None)
+    p2.process_traces([payload_of(
+        make_span(tid(4), sid(4), attrs={"error": False}))], "tr", None)
+    p2.process_traces([payload_of(
+        make_span(tid(5), sid(5), attrs={"error": "true"}))], "tr", None)
+    assert p2._sampled(p2._traces[tid(3).hex()])
+    assert not p2._sampled(p2._traces[tid(4).hex()])
+    assert not p2._sampled(p2._traces[tid(5).hex()])  # string, not bool
+
+
+def test_trace_state_condition():
+    p = make_proc({"decision_wait": "60s"},
+                  [{"type": "trace_state", "values": ["sampled=1"]}])
+    p.process_traces([payload_of(
+        make_span(tid(1), sid(1), trace_state="vendor=x,sampled=1"))],
+        "tr", None)
+    p.process_traces([payload_of(
+        make_span(tid(2), sid(2), trace_state="vendor=x"))], "tr", None)
+    assert p._sampled(p._traces[tid(1).hex()])
+    assert not p._sampled(p._traces[tid(2).hex()])
+
+
+def test_max_traces_evicts_oldest():
+    p = make_proc({"decision_wait": "60s", "max_traces": 3})
+    for i in range(1, 6):
+        p.process_traces([payload_of(make_span(tid(i), sid(i)))],
+                         "tr", None)
+    assert p.pending_traces() == 3
+    assert tid(1).hex() not in p._traces
+    assert tid(5).hex() in p._traces
+
+
+def test_reconcile_groups_by_resource_and_scope():
+    p = make_proc({"decision_wait": "60s"})
+    p.process_traces([
+        payload_of(make_span(tid(1), sid(1)),
+                   resource={"service.name": "a"}),
+        payload_of(make_span(tid(1), sid(2)),
+                   resource={"service.name": "b"}),
+        payload_of(make_span(tid(1), sid(3)),
+                   resource={"service.name": "a"}),
+    ], "tr", None)
+    from fluentbit_tpu.plugins.processor_sampling import _reconcile
+
+    entry = p._traces[tid(1).hex()]
+    payload = _reconcile(entry)
+    assert count_spans(payload) == 3
+    assert len(payload["resourceSpans"]) == 2  # a + b, a merged
+
+
+def test_condition_config_errors():
+    with pytest.raises(ValueError):
+        make_proc({"decision_wait": "1s"}, [{"type": "latency"}])
+    with pytest.raises(ValueError):
+        make_proc({"decision_wait": "1s"}, [{"type": "nope"}])
+    with pytest.raises(ValueError):
+        make_proc({"decision_wait": "1s"},
+                  [{"type": "string_attribute", "key": "k"}])
+    with pytest.raises(ValueError):
+        make_proc({"decision_wait": "1s"},
+                  [{"type": "numeric_attribute", "key": "k"}])
+
+
+def test_probabilistic_traces_deterministic_by_trace_id():
+    p = make_proc(mode="probabilistic")
+    p._p = 50.0
+    spans = [make_span(bytes([i, i + 1]) * 8, sid(1)) for i in range(50)]
+    out1 = p._probabilistic_traces([payload_of(*spans)])
+    out2 = p._probabilistic_traces([payload_of(*spans)])
+    n1 = sum(count_spans(pl) for pl in out1)
+    assert 0 < n1 < 50
+    assert out1 == out2  # deterministic: same trace ids, same verdicts
+
+
+def test_tail_end_to_end_reinjection():
+    """Engine path: OTLP-style typed append with a tail sampler attached
+    to the input; decided+sampled traces re-enter through the emitter
+    and reach the chunk pool; dropped traces never do."""
+    e = Engine()
+    ins = e.input("dummy")
+    ins.configure()
+    ins.plugin.init(ins, e)
+    proc = registry.create_processor("sampling")
+    proc.set("type", "tail")
+    proc.set("sampling_settings", {"decision_wait": "60s"})
+    proc.set("conditions", [{"type": "status_code",
+                             "status_codes": ["ERROR"]}])
+    proc.configure()
+    proc.plugin.init(proc, e)
+    ins.processors = [proc]
+
+    err = payload_of(make_span(tid(1), sid(1), status=2),
+                     make_span(tid(1), sid(2)))
+    ok = payload_of(make_span(tid(2), sid(3), status=1))
+    from fluentbit_tpu.codec.chunk import EVENT_TYPE_TRACES
+
+    e.input_event_append(ins, "otel", packb(err), EVENT_TYPE_TRACES,
+                         n_records=2)
+    e.input_event_append(ins, "otel", packb(ok), EVENT_TYPE_TRACES,
+                         n_records=1)
+    # nothing appended yet (all buffered)
+    assert ins.pool.drain() == []
+    emitted = proc.plugin.flush_decided(e, force=True)
+    assert emitted == 2  # only the ERROR trace, both spans
+    emitter_ins = proc.plugin._emitter
+    chunks = emitter_ins.pool.drain()
+    assert len(chunks) == 1
+    payloads = list(Unpacker(bytes(chunks[0].buf)))
+    assert sum(count_spans(pl) for pl in payloads) == 2
+    got_ids = {s["traceId"] for pl in payloads
+               for rs in pl["resourceSpans"]
+               for ss in rs["scopeSpans"] for s in ss["spans"]}
+    assert got_ids == {tid(1)}
+    assert chunks[0].tag == "otel"
+    assert chunks[0].event_type == EVENT_TYPE_TRACES
+
+
+def test_tail_rejected_on_output_side():
+    proc = registry.create_processor("sampling")
+    proc.side = "output"
+    proc.set("type", "tail")
+    proc.configure()
+    with pytest.raises(ValueError, match="input"):
+        proc.plugin.init(proc, None)
+
+
+def test_settings_accepts_json_string():
+    """Classic .conf values are strings; sampling_settings must parse."""
+    proc = registry.create_processor("sampling")
+    proc.set("type", "tail")
+    proc.set("sampling_settings",
+             '{"decision_wait": "5s", "max_traces": 7}')
+    proc.configure()
+    proc.plugin.init(proc, None)
+    assert proc.plugin.decision_wait == 5.0
+    assert proc.plugin.max_traces == 7
+
+
+def test_engine_stop_drains_buffered_traces():
+    """Spans still inside the decision window at stop are decided and
+    delivered during the grace drain, not dropped."""
+    e = Engine()
+    ins = e.input("dummy")
+    ins.configure()
+    ins.plugin.init(ins, e)
+    proc = registry.create_processor("sampling")
+    proc.set("type", "tail")
+    proc.set("sampling_settings", {"decision_wait": "3600s"})
+    proc.configure()
+    proc.plugin.init(proc, e)
+    ins.processors = [proc]
+    got = []
+    out = e.output("lib")
+    out.set("match", "*")
+    out.set("callback", lambda data, tag: got.append((tag, data)))
+    out.configure()
+    out.plugin.init(out, e)
+    e.start()
+    try:
+        from fluentbit_tpu.codec.chunk import EVENT_TYPE_TRACES
+
+        e.input_event_append(
+            ins, "otel",
+            packb(payload_of(make_span(tid(9), sid(9)))),
+            EVENT_TYPE_TRACES, n_records=1)
+    finally:
+        e.stop()
+    assert got, "buffered trace lost at shutdown"
+    from fluentbit_tpu.codec.telemetry import is_traces_payload
+
+    payloads = [pl for _, data in got for pl in Unpacker(data)
+                if is_traces_payload(pl)]
+    assert sum(count_spans(pl) for pl in payloads) == 1
+
+
+def test_tail_timer_fires_in_running_engine():
+    """Full runtime: short decision window, engine running — spans are
+    re-injected by the timer without any manual flush."""
+    e = Engine()
+    ins = e.input("dummy")
+    ins.configure()
+    ins.plugin.init(ins, e)
+    proc = registry.create_processor("sampling")
+    proc.set("type", "tail")
+    proc.set("sampling_settings", {"decision_wait": "0.3s"})
+    proc.configure()
+    proc.plugin.init(proc, e)
+    ins.processors = [proc]
+    e.start()
+    try:
+        from fluentbit_tpu.codec.chunk import EVENT_TYPE_TRACES
+
+        e.input_event_append(
+            ins, "otel",
+            packb(payload_of(make_span(tid(7), sid(7)))),
+            EVENT_TYPE_TRACES, n_records=1)
+        deadline = time.time() + 10
+        emitter = proc.plugin._emitter
+        got = []
+        while time.time() < deadline and not got:
+            got = [c for c in emitter.pool.drain()]
+            time.sleep(0.1)
+        assert got, "timer never re-injected the sampled trace"
+    finally:
+        e.stop()
