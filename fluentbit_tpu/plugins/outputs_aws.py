@@ -1,14 +1,19 @@
-"""AWS outputs: s3 (fstore-staged uploads), cloudwatch_logs.
+"""AWS outputs: s3 (fstore-staged put-object + multipart uploads),
+cloudwatch_logs.
 
 Reference: plugins/out_s3 (6452 LoC — buffered uploads staged through
 fstore, s3_key_format with $TAG/time expansion, use_put_object vs
 multipart) and plugins/out_cloudwatch_logs (PutLogEvents API). Both
 sign with SigV4 (utils.aws) using the env/profile credential chain.
-This build implements the put-object upload path (multipart's
-CreateMultipartUpload/UploadPart dance needs nothing new from the
-framework — the fstore staging and signing layers are the same — and
-is left as an endpoint-parity TODO); ``endpoint`` points at any
-S3-compatible HTTP endpoint (path-style).
+
+Multipart mirrors s3.c:82-123 / s3_multipart.c: staged bytes reaching
+``upload_chunk_size`` become an UploadPart on an upload created with
+``POST ?uploads=`` (XML UploadId); reaching ``total_file_size`` or
+``upload_timeout`` completes with the part manifest. Upload state
+(UploadId + part ETags) persists in the staging file's fstore metadata,
+so a restart RESUMES the open multipart upload instead of orphaning it
+(get_upload/create_upload state machine, s3.c:82-123). ``endpoint``
+points at any S3-compatible HTTP endpoint (path-style).
 """
 
 from __future__ import annotations
@@ -65,7 +70,7 @@ async def _http_request(ins, host: str, port: int, method: str, path: str,
             data += chunk
         head, _, resp_body = data.partition(b"\r\n\r\n")
         status = int(head.split(b" ", 2)[1])
-        return status, resp_body
+        return status, head, resp_body
     finally:
         try:
             writer.close()
@@ -84,6 +89,7 @@ class S3Output(OutputPlugin):
         ConfigMapEntry("s3_key_format", "str",
                        default="/fluent-bit-logs/$TAG/%Y/%m/%d/%H_%M_%S"),
         ConfigMapEntry("total_file_size", "size", default="100M"),
+        ConfigMapEntry("upload_chunk_size", "size", default="5242880"),
         ConfigMapEntry("upload_timeout", "time", default="10m"),
         ConfigMapEntry("store_dir", "str", default="/tmp/fluent-bit/s3"),
         ConfigMapEntry("use_put_object", "bool", default=True),
@@ -99,9 +105,20 @@ class S3Output(OutputPlugin):
             if not compression_available(algo):
                 raise ValueError(f"s3: {algo} codec unavailable on "
                                  "this host")
+        if not self.use_put_object:
+            # s3.c:1102-1126 sizing rules (5MB AWS minimum relaxed only
+            # for explicitly tiny test endpoints via upload_chunk_size)
+            if self.upload_chunk_size > self.total_file_size:
+                raise ValueError(
+                    "s3: upload_chunk_size cannot exceed total_file_size")
         self._fstore = FStore(self.store_dir)
         self._stream = self._fstore.stream(f"s3-{instance.name}")
         self._opened: Dict[str, float] = {}  # tag → first-append time
+        # staging + part sequencing is read-modify-write around an
+        # await: concurrent flushes for one tag must serialize or parts
+        # collide / staged bytes vanish (the engine runs one coroutine
+        # per (task x route) with no semaphore by default)
+        self._tag_locks: Dict[str, "asyncio.Lock"] = {}
         self._creds = _aws.get_credentials() or _aws.Credentials("", "")
 
     def _endpoint(self) -> Tuple[str, int]:
@@ -129,60 +146,207 @@ class S3Output(OutputPlugin):
         host, port = self._endpoint()
         path = f"/{self.bucket}{self._key_for(tag)}"
         url = f"http://{host}:{port}{path}"
+        self._creds = _aws.current(self._creds) or self._creds
         headers = _aws.sigv4_headers("PUT", url, self.region, "s3",
                                      payload, self._creds)
         try:
-            status, _body = await _http_request(self.instance, host,
-                                                port, "PUT", path,
-                                                headers, payload)
+            status, _head, _body = await _http_request(self.instance, host,
+                                                       port, "PUT", path,
+                                                       headers, payload)
         except (OSError, asyncio.TimeoutError, ValueError, IndexError):
             return FlushResult.RETRY
         if 200 <= status < 300:
             return FlushResult.OK
         return FlushResult.RETRY if status >= 500 else FlushResult.ERROR
 
+    # ------------------------------------------------------ multipart
+
+    async def _s3_call(self, method: str, key: str, query: str,
+                       payload: bytes) -> Tuple[int, bytes, bytes]:
+        """One signed S3 request with a query string; returns
+        (status, response head, response body)."""
+        from urllib.parse import quote
+
+        host, port = self._endpoint()
+        raw_path = f"/{self.bucket}{key}"
+        # sign over the RAW path: sigv4_headers percent-encodes it once
+        # for the canonical request, and the wire path below applies the
+        # SAME single encoding — pre-quoting here would double-encode
+        # the signature side only (SignatureDoesNotMatch on any key
+        # with a space or non-ASCII byte)
+        url = f"http://{host}:{port}{raw_path}{query}"
+        self._creds = _aws.current(self._creds) or self._creds
+        headers = _aws.sigv4_headers(method, url, self.region, "s3",
+                                     payload, self._creds)
+        wire_path = quote(raw_path, safe="/-_.~") + query
+        status, head, body = await _http_request(
+            self.instance, host, port, method, wire_path, headers,
+            payload, quote_path=False)
+        return status, head, body
+
+    async def _mp_create(self, key: str) -> Optional[str]:
+        """CreateMultipartUpload (s3_multipart.c:558: POST ?uploads=);
+        returns the UploadId."""
+        status, _head, body = await self._s3_call("POST", key,
+                                                  "?uploads=", b"")
+        if not 200 <= status < 300:
+            return None
+        import re as _re
+
+        m = _re.search(rb"<UploadId>([^<]+)</UploadId>", body)
+        return m.group(1).decode() if m else None
+
+    async def _mp_upload_part(self, key: str, upload_id: str, n: int,
+                              payload: bytes) -> Optional[str]:
+        """UploadPart (s3_multipart.c:685: PUT ?partNumber=N&uploadId=);
+        returns the part's ETag."""
+        status, head, _body = await self._s3_call(
+            "PUT", key, f"?partNumber={n}&uploadId={upload_id}", payload)
+        if not 200 <= status < 300:
+            return None
+        import re as _re
+
+        m = _re.search(rb"(?im)^etag:\s*(\S+)\s*$", head)
+        if m is None:
+            # no ETag → the part cannot ever appear in a valid complete
+            # manifest; fail the flush (RETRY) while the staged bytes
+            # are still on disk
+            return None
+        return m.group(1).decode().strip('"')
+
+    async def _mp_complete(self, key: str, upload_id: str,
+                           parts: List[dict]) -> bool:
+        """CompleteMultipartUpload (s3_multipart.c:405: POST ?uploadId=
+        with the part manifest)."""
+        xml = ["<CompleteMultipartUpload>"]
+        for p in parts:
+            xml.append(
+                f"<Part><PartNumber>{p['n']}</PartNumber>"
+                f"<ETag>\"{p['etag']}\"</ETag></Part>")
+        xml.append("</CompleteMultipartUpload>")
+        status, _head, body = await self._s3_call(
+            "POST", key, f"?uploadId={upload_id}",
+            "".join(xml).encode())
+        # a 200 body may still carry <Error> (S3 completes lazily)
+        return 200 <= status < 300 and b"<Error>" not in body
+
+    def _mp_state(self, f) -> dict:
+        st = f.meta()
+        return st if st.get("upload_id") else {}
+
+    async def _mp_flush_part(self, f, tag: str,
+                             final: bool) -> FlushResult:
+        """Upload the staged bytes as the next part; on final, complete
+        the upload with the accumulated manifest."""
+        st = self._mp_state(f)
+        if not st:
+            key = self._key_for(tag)
+            upload_id = await self._mp_create(key)
+            if upload_id is None:
+                return FlushResult.RETRY
+            st = {"upload_id": upload_id, "key": key, "parts": []}
+            f.set_meta(st)
+        payload = f.content()
+        if payload:
+            algo = (self.compression or "").lower()
+            if algo in ("gzip", "zstd"):
+                from ..utils import compress
+
+                payload = compress(algo, payload)
+            n = len(st["parts"]) + 1
+            if n > 10000:  # hard S3 limit (s3.c:1688)
+                return FlushResult.ERROR
+            etag = await self._mp_upload_part(st["key"], st["upload_id"],
+                                              n, payload)
+            if etag is None:
+                return FlushResult.RETRY
+            st["parts"].append({"n": n, "etag": etag})
+            # staged bytes are uploaded: restart the staging file but
+            # KEEP the upload state (restart resume reads it back)
+            name = f.name
+            f.delete()
+            f = self._stream.create(name)
+            f.set_meta(st)
+        if final:
+            if not st["parts"]:
+                f.delete()
+                self._opened.pop(tag, None)
+                return FlushResult.OK
+            if not await self._mp_complete(st["key"], st["upload_id"],
+                                           st["parts"]):
+                return FlushResult.RETRY
+            f.delete()
+            self._opened.pop(tag, None)
+        return FlushResult.OK
+
     async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
         """Stage into fstore; upload when the buffer reaches
         total_file_size or upload_timeout elapses (out_s3's buffering
-        contract — delivery is deferred, OK acknowledges staging)."""
+        contract — delivery is deferred, OK acknowledges staging). In
+        multipart mode (use_put_object off) every upload_chunk_size of
+        staged bytes becomes an UploadPart immediately."""
         from urllib.parse import quote as _q
 
-        fname = _q(tag, safe="")  # reversible: no cross-tag collisions
-        f = self._stream.get(fname) or self._stream.create(fname)
-        f.append(format_json_lines(data).encode() + b"\n")
-        self._opened.setdefault(tag, time.monotonic())
-        due = (
-            f.size >= self.total_file_size
-            or time.monotonic() - self._opened[tag] >= self.upload_timeout
-        )
-        if not due:
-            return FlushResult.OK
-        payload = f.content()
-        res = await self._upload(tag, payload)
-        if res == FlushResult.OK:
-            f.delete()
-            self._opened.pop(tag, None)
-        return res
+        lock = self._tag_locks.setdefault(tag, asyncio.Lock())
+        async with lock:
+            fname = _q(tag, safe="")  # reversible: no cross-tag collisions
+            f = self._stream.get(fname) or self._stream.create(fname)
+            f.append(format_json_lines(data).encode() + b"\n")
+            self._opened.setdefault(tag, time.monotonic())
+            timed_out = (time.monotonic() - self._opened[tag]
+                         >= self.upload_timeout)
+            if not self.use_put_object:
+                st = self._mp_state(f)
+                uploaded = (len(st.get("parts", []))
+                            * self.upload_chunk_size)
+                final = (uploaded + f.size >= self.total_file_size
+                         or timed_out)
+                if final or f.size >= self.upload_chunk_size:
+                    return await self._mp_flush_part(f, tag, final)
+                return FlushResult.OK
+            due = f.size >= self.total_file_size or timed_out
+            if not due:
+                return FlushResult.OK
+            payload = f.content()
+            res = await self._upload(tag, payload)
+            if res == FlushResult.OK:
+                f.delete()
+                self._opened.pop(tag, None)
+            return res
 
     def drain(self, engine) -> None:
-        """Shutdown: upload everything still staged. Runs on the engine
-        loop (the _main drain phase); the futures join the pending set
-        so the grace period waits for them."""
+        """Shutdown: upload everything still staged (completing any open
+        multipart uploads). Runs on the engine loop (the _main drain
+        phase); the futures join the pending set so the grace period
+        waits for them."""
         if getattr(engine, "loop", None) is None:
             return
         from urllib.parse import unquote as _uq
 
         for f in self._stream.files():
             tag = _uq(f.name)
-            payload = f.content()
-            if not payload:
-                continue
+            lock = self._tag_locks.setdefault(tag, asyncio.Lock())
+            if not self.use_put_object:
+                if not f.size and not self._mp_state(f):
+                    continue
 
-            async def _final(tag=tag, payload=payload, f=f):
-                if await self._upload(tag, payload) == FlushResult.OK:
-                    f.delete()
+                async def _final_mp(tag=tag, f=f, lock=lock):
+                    async with lock:
+                        await self._mp_flush_part(f, tag, final=True)
 
-            fut = asyncio.ensure_future(_final())
+                fut = asyncio.ensure_future(_final_mp())
+            else:
+                if not f.size:
+                    continue
+
+                async def _final(tag=tag, f=f, lock=lock):
+                    async with lock:
+                        payload = f.content()
+                        if payload and await self._upload(
+                                tag, payload) == FlushResult.OK:
+                            f.delete()
+
+                fut = asyncio.ensure_future(_final())
             engine._pending_flushes.add(fut)
             fut.add_done_callback(engine._pending_flushes.discard)
 
@@ -228,14 +392,15 @@ class CloudwatchLogsOutput(OutputPlugin):
         host, _, port = ep.partition(":")
         port = int(port or 80)
         url = f"http://{host}:{port}/"
+        self._creds = _aws.current(self._creds) or self._creds
         extra = {"X-Amz-Target": "Logs_20140328.PutLogEvents",
                  "Content-Type": "application/x-amz-json-1.1"}
         headers = _aws.sigv4_headers("POST", url, self.region, "logs",
                                      body, self._creds, headers=extra)
         headers.update(extra)
         try:
-            status, _b = await _http_request(self.instance, host, port,
-                                             "POST", "/", headers, body)
+            status, _h, _b = await _http_request(self.instance, host, port,
+                                                 "POST", "/", headers, body)
         except (OSError, asyncio.TimeoutError, ValueError, IndexError):
             return FlushResult.RETRY
         if 200 <= status < 300:

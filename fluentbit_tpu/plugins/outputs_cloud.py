@@ -112,7 +112,7 @@ class _KinesisBase(OutputPlugin):
                                      headers=extra)
         headers.update(extra)
         try:
-            status, _b = await _http_request(self.instance, host, port,
+            status, _h, _b = await _http_request(self.instance, host, port,
                                              "POST", "/", headers, body)
         except (OSError, asyncio.TimeoutError, ValueError, IndexError):
             return FlushResult.RETRY
@@ -267,7 +267,7 @@ class _GoogleOutput(OutputPlugin):
         body = ("grant_type=urn%3Aietf%3Aparams%3Aoauth%3A"
                 "grant-type%3Ajwt-bearer&assertion=" + assertion).encode()
         try:
-            status, resp = await _http_request(
+            status, _head, resp = await _http_request(
                 self.instance, host, port, "POST", path,
                 {"Content-Type": "application/x-www-form-urlencoded"},
                 body, quote_path=False, use_tls=tls,
@@ -291,7 +291,7 @@ class _GoogleOutput(OutputPlugin):
         headers = {"Content-Type": "application/json",
                    "Authorization": f"Bearer {token}"}
         try:
-            status, _b = await _http_request(
+            status, _h, _b = await _http_request(
                 self.instance, host, port, "POST", path, headers, body,
                 quote_path=False, use_tls=use_tls,
             )

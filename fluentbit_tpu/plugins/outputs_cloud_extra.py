@@ -258,7 +258,7 @@ class _AadOutput(_HttpDeliveryOutput):
         from .outputs_aws import _http_request
 
         try:
-            status, resp = await _http_request(
+            status, _head, resp = await _http_request(
                 self.instance, host, port, "POST", path,
                 {"Content-Type": "application/x-www-form-urlencoded"},
                 body, quote_path=False, use_tls=tls,

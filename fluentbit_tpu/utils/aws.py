@@ -1,10 +1,14 @@
 """AWS common — credentials providers + Signature Version 4.
 
-Reference: src/aws/ (flb_aws_credentials.c: env → profile → STS/IMDS
-chain; src/flb_signv4.c request signing shared by all AWS outputs +
-filter_aws). Implemented from the public SigV4 specification; the
-network-dependent providers (IMDS/STS/HTTP) are gated — env and
-profile-file credentials cover the offline build.
+Reference: src/aws/ (flb_aws_credentials.c provider chain: env →
+credential_process → profile → STS web identity → ECS/HTTP container
+creds; flb_aws_credentials_sts.c AssumeRole + AssumeRoleWithWebIdentity;
+flb_aws_credentials_process.c; flb_aws_credentials_http.c;
+src/flb_signv4.c request signing shared by all AWS outputs +
+filter_aws). Implemented from the public SigV4 / STS specifications.
+IMDS enrichment lives in filter_aws (stub-tested); expiring credentials
+(STS/process/HTTP) refresh automatically 5 minutes before expiry
+(FLB_AWS_REFRESH_WINDOW, include/fluent-bit/aws/flb_aws_credentials.h).
 """
 
 from __future__ import annotations
@@ -13,9 +17,12 @@ import configparser
 import datetime
 import hashlib
 import hmac
+import json
 import os
+import re
+import time
 import urllib.parse
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
 
@@ -24,6 +31,12 @@ class Credentials:
     access_key: str
     secret_key: str
     session_token: Optional[str] = None
+    expiration: Optional[float] = field(default=None)  # epoch seconds
+
+    def expired(self, window: float = 300.0) -> bool:
+        """True once inside the pre-expiry refresh window."""
+        return (self.expiration is not None
+                and time.time() >= self.expiration - window)
 
 
 def env_provider() -> Optional[Credentials]:
@@ -56,9 +69,218 @@ def profile_provider(profile: Optional[str] = None,
     return Credentials(ak, sk, sec.get("aws_session_token"))
 
 
-def get_credentials() -> Optional[Credentials]:
-    """The provider chain (env → profile; IMDS/STS are gated offline)."""
-    return env_provider() or profile_provider()
+def _parse_iso8601(s: Optional[str]) -> Optional[float]:
+    """Lenient ISO-8601 (fractional seconds, Z or numeric offsets) —
+    an unparseable expiration must not silently mean 'never expires'
+    for common formats."""
+    if not s:
+        return None
+    try:
+        dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.timestamp()
+    except ValueError:
+        return None
+
+
+def _sts_endpoint(region: str) -> Tuple[str, int]:
+    ep = (os.environ.get("AWS_STS_ENDPOINT")
+          or f"sts.{region}.amazonaws.com")
+    ep = ep.replace("https://", "").replace("http://", "")
+    host, _, port = ep.partition(":")
+    return host, int(port or 80)
+
+
+def _parse_sts_xml(body: bytes) -> Optional[Credentials]:
+    def grab(tag):
+        m = re.search(rf"<{tag}>([^<]+)</{tag}>".encode(), body)
+        return m.group(1).decode() if m else None
+
+    ak, sk = grab("AccessKeyId"), grab("SecretAccessKey")
+    if not ak or not sk:
+        return None
+    return Credentials(ak, sk, grab("SessionToken"),
+                       _parse_iso8601(grab("Expiration")))
+
+
+def sts_assume_role_provider(role_arn: str, session_name: str = "fluent-bit",
+                             region: str = "us-east-1",
+                             base: Optional[Credentials] = None,
+                             external_id: Optional[str] = None,
+                             ) -> Optional[Credentials]:
+    """STS AssumeRole signed with the base chain's credentials
+    (flb_aws_credentials_sts.c:295-340, flb_sts_uri)."""
+    from . import plain_http_request
+
+    base = base or env_provider() or profile_provider()
+    if base is None:
+        return None
+    host, port = _sts_endpoint(region)
+    query = ("Version=2011-06-15&Action=AssumeRole"
+             f"&RoleArn={urllib.parse.quote(role_arn, safe='')}"
+             f"&RoleSessionName={urllib.parse.quote(session_name, safe='')}")
+    if external_id:
+        query += f"&ExternalId={urllib.parse.quote(external_id, safe='')}"
+    path = "/?" + query
+    url = f"http://{host}:{port}{path}"
+    headers = sigv4_headers("GET", url, region, "sts", b"", base)
+    try:
+        got = plain_http_request(host, port, "GET", path,
+                                 headers=headers)
+    except OSError:
+        got = None
+    if got is None or got[0] != 200:  # None on socket failure
+        return None
+    return _parse_sts_xml(got[1])
+
+
+def web_identity_provider(region: str = "us-east-1"
+                          ) -> Optional[Credentials]:
+    """STS AssumeRoleWithWebIdentity from AWS_ROLE_ARN +
+    AWS_WEB_IDENTITY_TOKEN_FILE — unsigned (the token IS the proof;
+    flb_aws_credentials_sts.c:642,712-740)."""
+    from . import plain_http_request
+
+    role_arn = os.environ.get("AWS_ROLE_ARN")
+    token_file = os.environ.get("AWS_WEB_IDENTITY_TOKEN_FILE")
+    if not role_arn or not token_file:
+        return None
+    try:
+        with open(token_file) as f:
+            token = f.read().strip()
+    except OSError:
+        return None
+    session = os.environ.get("AWS_ROLE_SESSION_NAME", "fluent-bit")
+    host, port = _sts_endpoint(region)
+    path = ("/?Version=2011-06-15&Action=AssumeRoleWithWebIdentity"
+            f"&RoleArn={urllib.parse.quote(role_arn, safe='')}"
+            f"&RoleSessionName={urllib.parse.quote(session, safe='')}"
+            f"&WebIdentityToken={urllib.parse.quote(token, safe='')}")
+    try:
+        got = plain_http_request(host, port, "GET", path)
+    except OSError:
+        got = None
+    if got is None or got[0] != 200:
+        return None
+    return _parse_sts_xml(got[1])
+
+
+def process_provider(profile: Optional[str] = None) -> Optional[Credentials]:
+    """``credential_process`` from the AWS config file: run the command,
+    parse the JSON credential document
+    (flb_aws_credentials_process.c; the documented external-process
+    contract: Version/AccessKeyId/SecretAccessKey/SessionToken/
+    Expiration)."""
+    import shlex
+    import subprocess
+
+    path = os.environ.get("AWS_CONFIG_FILE",
+                          os.path.expanduser("~/.aws/config"))
+    profile = profile or os.environ.get("AWS_PROFILE", "default")
+    cp = configparser.ConfigParser()
+    try:
+        cp.read(path)
+    except (OSError, configparser.Error):
+        return None
+    section = profile if profile in cp else f"profile {profile}"
+    if section not in cp:
+        return None
+    cmd = cp[section].get("credential_process")
+    if not cmd:
+        return None
+    try:
+        proc = subprocess.run(shlex.split(cmd), capture_output=True,
+                              timeout=30)
+        doc = json.loads(proc.stdout)
+        if proc.returncode != 0 or int(doc.get("Version", 0)) != 1:
+            return None
+        ak, sk = doc.get("AccessKeyId"), doc.get("SecretAccessKey")
+        if not ak or not sk:
+            return None
+        return Credentials(ak, sk, doc.get("SessionToken"),
+                           _parse_iso8601(doc.get("Expiration")))
+    except (OSError, subprocess.TimeoutExpired, ValueError, TypeError,
+            AttributeError):
+        # malformed external-process output must fall through the
+        # chain, never crash plugin init or an in-flight refresh
+        return None
+
+
+def http_provider() -> Optional[Credentials]:
+    """ECS/EKS container credentials over HTTP:
+    AWS_CONTAINER_CREDENTIALS_RELATIVE_URI (against 169.254.170.2) or
+    AWS_CONTAINER_CREDENTIALS_FULL_URI (flb_aws_credentials_http.c;
+    optional bearer token via AWS_CONTAINER_AUTHORIZATION_TOKEN)."""
+    from . import plain_http_request
+
+    rel = os.environ.get("AWS_CONTAINER_CREDENTIALS_RELATIVE_URI")
+    full = os.environ.get("AWS_CONTAINER_CREDENTIALS_FULL_URI")
+    if rel:
+        host, port, path = "169.254.170.2", 80, rel
+    elif full:
+        parsed = urllib.parse.urlsplit(full)
+        host = parsed.hostname or ""
+        port = parsed.port or 80
+        path = parsed.path or "/"
+        if parsed.query:
+            path += "?" + parsed.query
+    else:
+        return None
+    headers = {}
+    token = os.environ.get("AWS_CONTAINER_AUTHORIZATION_TOKEN")
+    if token:
+        headers["Authorization"] = token
+    try:
+        got = plain_http_request(host, port, "GET", path,
+                                 headers=headers)
+        if got is None or got[0] != 200:
+            return None
+        doc = json.loads(got[1])
+        ak, sk = doc.get("AccessKeyId"), doc.get("SecretAccessKey")
+        if not ak or not sk:
+            return None
+        return Credentials(ak, sk, doc.get("Token"),
+                           _parse_iso8601(doc.get("Expiration")))
+    except (OSError, ValueError, TypeError, AttributeError):
+        return None
+
+
+_refresh_backoff_until = 0.0
+
+
+def current(creds: Optional[Credentials]) -> Optional[Credentials]:
+    """Per-request refresh hook for plugins holding credentials from
+    init: hands back the same object until it enters the expiry window,
+    then re-resolves the chain. The chain is blocking (subprocess /
+    sockets), so a FAILED refresh backs off 60 s — without it every
+    request past expiry would re-run the full chain inline."""
+    global _refresh_backoff_until
+    if creds is not None and not creds.expired():
+        return creds
+    if time.time() < _refresh_backoff_until:
+        return creds
+    got = get_credentials(refresh=True)
+    if got is None or (creds is not None and got is creds):
+        _refresh_backoff_until = time.time() + 60.0
+    return got or creds
+
+
+_cached: Optional[Credentials] = None
+
+
+def get_credentials(refresh: bool = False) -> Optional[Credentials]:
+    """The standard provider chain (flb_aws_credentials.c:
+    env → credential_process → profile → STS web identity → ECS/HTTP).
+    Expiring credentials re-resolve inside the 5-minute refresh
+    window."""
+    global _cached
+    if not refresh and _cached is not None and not _cached.expired():
+        return _cached
+    creds = (env_provider() or process_provider() or profile_provider()
+             or web_identity_provider() or http_provider())
+    _cached = creds if creds is not None and creds.expiration else None
+    return creds
 
 
 # ------------------------------------------------------------------ sigv4

@@ -1,0 +1,159 @@
+"""AWS credential providers: STS AssumeRole (signed), web identity
+(unsigned), credential_process, ECS/HTTP container creds, expiry
+refresh (reference src/aws/flb_aws_credentials_sts.c,
+flb_aws_credentials_process.c, flb_aws_credentials_http.c)."""
+
+import json
+import os
+import re
+import socket
+import stat
+import threading
+import time
+
+import pytest
+
+from fluentbit_tpu.utils import aws as _aws
+
+
+class StubServer:
+    def __init__(self, responder):
+        self.requests = []
+        self.responder = responder
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                c, _ = self.srv.accept()
+            except OSError:
+                return
+            c.settimeout(3)
+            try:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += c.recv(65536)
+                head = data.partition(b"\r\n\r\n")[0]
+                self.requests.append(head)
+                body = self.responder(head)
+                c.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                          + str(len(body)).encode() + b"\r\n\r\n" + body)
+            except OSError:
+                pass
+            c.close()
+
+    def close(self):
+        self.srv.close()
+
+
+STS_XML = (b"<AssumeRoleResponse><AssumeRoleResult><Credentials>"
+           b"<AccessKeyId>ASIA123</AccessKeyId>"
+           b"<SecretAccessKey>sts-secret</SecretAccessKey>"
+           b"<SessionToken>sts-token</SessionToken>"
+           b"<Expiration>2099-01-01T00:00:00Z</Expiration>"
+           b"</Credentials></AssumeRoleResult></AssumeRoleResponse>")
+
+
+def test_sts_assume_role(monkeypatch):
+    stub = StubServer(lambda head: STS_XML)
+    monkeypatch.setenv("AWS_STS_ENDPOINT", f"127.0.0.1:{stub.port}")
+    try:
+        creds = _aws.sts_assume_role_provider(
+            "arn:aws:iam::123:role/r", "sess",
+            base=_aws.Credentials("AK", "SK"))
+    finally:
+        stub.close()
+    assert creds is not None
+    assert creds.access_key == "ASIA123"
+    assert creds.secret_key == "sts-secret"
+    assert creds.session_token == "sts-token"
+    assert creds.expiration and creds.expiration > time.time()
+    assert not creds.expired()
+    head = stub.requests[0].decode()
+    assert "Action=AssumeRole" in head
+    assert "RoleArn=arn%3Aaws%3Aiam%3A%3A123%3Arole%2Fr" in head
+    assert "Authorization: AWS4-HMAC-SHA256 Credential=AK/" in head
+    assert "/sts/aws4_request" in head
+
+
+def test_web_identity_provider(monkeypatch, tmp_path):
+    tok = tmp_path / "token"
+    tok.write_text("the-oidc-token")
+    stub = StubServer(lambda head: STS_XML)
+    monkeypatch.setenv("AWS_STS_ENDPOINT", f"127.0.0.1:{stub.port}")
+    monkeypatch.setenv("AWS_ROLE_ARN", "arn:aws:iam::123:role/web")
+    monkeypatch.setenv("AWS_WEB_IDENTITY_TOKEN_FILE", str(tok))
+    try:
+        creds = _aws.web_identity_provider()
+    finally:
+        stub.close()
+    assert creds is not None and creds.access_key == "ASIA123"
+    head = stub.requests[0].decode()
+    assert "Action=AssumeRoleWithWebIdentity" in head
+    assert "WebIdentityToken=the-oidc-token" in head
+    assert "Authorization" not in head  # unsigned by design
+
+
+def test_process_provider(monkeypatch, tmp_path):
+    script = tmp_path / "cred.sh"
+    doc = {"Version": 1, "AccessKeyId": "PAK", "SecretAccessKey": "PSK",
+           "SessionToken": "PTOK",
+           "Expiration": "2099-06-01T00:00:00Z"}
+    script.write_text("#!/bin/sh\necho '" + json.dumps(doc) + "'\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    cfg = tmp_path / "config"
+    cfg.write_text(f"[default]\ncredential_process = {script}\n")
+    monkeypatch.setenv("AWS_CONFIG_FILE", str(cfg))
+    monkeypatch.delenv("AWS_PROFILE", raising=False)
+    creds = _aws.process_provider()
+    assert creds is not None
+    assert (creds.access_key, creds.secret_key, creds.session_token) == \
+        ("PAK", "PSK", "PTOK")
+    assert creds.expiration is not None
+
+
+def test_process_provider_rejects_bad_version(monkeypatch, tmp_path):
+    script = tmp_path / "cred.sh"
+    script.write_text('#!/bin/sh\necho \'{"Version": 2, '
+                      '"AccessKeyId": "x", "SecretAccessKey": "y"}\'\n')
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    cfg = tmp_path / "config"
+    cfg.write_text(f"[profile p2]\ncredential_process = {script}\n")
+    monkeypatch.setenv("AWS_CONFIG_FILE", str(cfg))
+    assert _aws.process_provider("p2") is None
+
+
+def test_http_provider_full_uri(monkeypatch):
+    doc = {"AccessKeyId": "HAK", "SecretAccessKey": "HSK",
+           "Token": "HTOK", "Expiration": "2099-01-01T00:00:00Z"}
+    stub = StubServer(lambda head: json.dumps(doc).encode())
+    monkeypatch.delenv("AWS_CONTAINER_CREDENTIALS_RELATIVE_URI",
+                       raising=False)
+    monkeypatch.setenv("AWS_CONTAINER_CREDENTIALS_FULL_URI",
+                       f"http://127.0.0.1:{stub.port}/v2/creds")
+    monkeypatch.setenv("AWS_CONTAINER_AUTHORIZATION_TOKEN", "Bearer abc")
+    try:
+        creds = _aws.http_provider()
+    finally:
+        stub.close()
+    assert creds is not None
+    assert (creds.access_key, creds.session_token) == ("HAK", "HTOK")
+    head = stub.requests[0].decode()
+    assert head.startswith("GET /v2/creds ")
+    assert "Authorization: Bearer abc" in head
+
+
+def test_current_refreshes_expired(monkeypatch):
+    """A credential inside its 5-minute window re-resolves the chain."""
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "NEWAK")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "NEWSK")
+    stale = _aws.Credentials("OLD", "OLD", expiration=time.time() + 10)
+    assert stale.expired()  # inside the 300s window
+    got = _aws.current(stale)
+    assert got.access_key == "NEWAK"
+    fresh = _aws.Credentials("F", "F", expiration=time.time() + 3600)
+    assert _aws.current(fresh) is fresh
