@@ -5,9 +5,10 @@ kube_property.c, kube_regex.h). Tag → pod identity (the in_tail
 ``kube.var.log.containers.<pod>_<namespace>_<container>-<id>.log``
 convention), metadata from a TTL cache fed by (a) a pre-warmed cache
 directory of ``<namespace>_<pod>.meta`` JSON files (kube_meta.c:331-360
-— the offline/test path), or (b) an HTTP GET against ``kube_url``
-(API-server/kubelet style; plain HTTP here — the reference's TLS
-upstream has no equivalent in this build yet). ``merge_log`` parses the
+— the offline/test path), or (b) a GET against ``kube_url`` —
+https with the service-account CA (``kube_ca_file``) and bearer token
+(``kube_token_file`` / ``kube_token_command``, TTL-refreshed with a
+401-driven re-read; kube_meta.c:101-191,240-248). ``merge_log`` parses the
 ``log`` field (JSON or a named parser) into structured fields
 (kubernetes.c:295-330); pod annotations ``fluentbit.io/parser`` and
 ``fluentbit.io/exclude`` override per-pod behavior when enabled by
@@ -66,6 +67,14 @@ class KubernetesFilter(FilterPlugin):
         ConfigMapEntry("buffer_size", "str", default="32k"),
         ConfigMapEntry("tls.verify", "bool", default=True),
         ConfigMapEntry("use_kubelet", "bool", default=False),
+        ConfigMapEntry("kube_ca_file", "str",
+                       default="/var/run/secrets/kubernetes.io/"
+                               "serviceaccount/ca.crt"),
+        ConfigMapEntry("kube_token_file", "str",
+                       default="/var/run/secrets/kubernetes.io/"
+                               "serviceaccount/token"),
+        ConfigMapEntry("kube_token_command", "str"),
+        ConfigMapEntry("kube_token_ttl", "time", default="10m"),
     ]
 
     def init(self, instance, engine) -> None:
@@ -73,6 +82,8 @@ class KubernetesFilter(FilterPlugin):
         self._tag_rx = FlbRegex(TAG_REGEX)
         self._cache: Dict[Tuple[str, str], Tuple[float, dict]] = {}
         self._merge_parser = None
+        self._token: Optional[str] = None
+        self._token_created = 0.0
         if self.merge_parser:
             self._merge_parser = (engine.parsers if engine else {}).get(
                 self.merge_parser
@@ -113,28 +124,103 @@ class KubernetesFilter(FilterPlugin):
         self._cache[key] = (now, meta)
         return meta
 
-    def _fetch_meta(self, namespace: str, pod: str) -> dict:
-        """Blocking HTTP GET of the pod object (API-server path shape:
-        /api/v1/namespaces/<ns>/pods/<pod>)."""
-        url = self.kube_url.rstrip("/")
-        if not url.startswith("http://"):
-            log.warning("kubernetes: only plain http kube_url supported")
-            return {}
-        from ..utils import plain_http_request
+    def _auth_token(self) -> Optional[str]:
+        """Service-account bearer token, refreshed every kube_token_ttl
+        (kube_meta.c:101-191 get_token_with_command / file_to_buffer,
+        refresh_token_if_needed at :240-248)."""
+        now = time.monotonic()
+        ttl = self.kube_token_ttl or 600
+        if self._token is not None and now - self._token_created < ttl:
+            return self._token
+        if now < getattr(self, "_token_retry_at", 0.0):
+            return self._token  # failed refresh backs off (stale token)
+        token = None
+        if self.kube_token_command:
+            import subprocess
 
-        hostport = url[len("http://"):].split("/")[0]
+            try:
+                proc = subprocess.run(self.kube_token_command, shell=True,
+                                      capture_output=True, timeout=10)
+                if proc.returncode == 0 and proc.stdout.strip():
+                    token = proc.stdout.strip().decode(
+                        "utf-8", "replace")
+                else:
+                    log.warning("kubernetes: kube_token_command failed "
+                                "rc=%d", proc.returncode)
+            except (OSError, subprocess.TimeoutExpired) as e:
+                log.warning("kubernetes: kube_token_command: %s", e)
+        elif self.kube_token_file:
+            try:
+                with open(self.kube_token_file, encoding="utf-8") as f:
+                    token = f.read().strip()
+            except OSError:
+                pass  # not in-cluster: unauthenticated fetch
+        if token:
+            self._token = token
+            self._token_created = now
+            self._token_retry_at = 0.0
+        else:
+            # a hanging/failing kube_token_command must not re-run
+            # (blocking, up to 10 s) on every cache miss
+            self._token_retry_at = now + 30.0
+        return self._token
+
+    def _fetch_meta(self, namespace: str, pod: str) -> dict:
+        """Blocking GET of the pod object (API-server path shape:
+        /api/v1/namespaces/<ns>/pods/<pod>) — https with the
+        service-account CA + bearer token when kube_url is https
+        (kube_meta.c:101-191; TLS to the apiserver is the in-cluster
+        default, flb_kube_conf.c FLB_API_TLS)."""
+        url = self.kube_url.rstrip("/")
+        use_tls = url.startswith("https://")
+        if not use_tls and not url.startswith("http://"):
+            log.warning("kubernetes: kube_url must be http(s)://")
+            return {}
+        from ..utils import sync_http_request
+
+        hostport = url.split("://", 1)[1].split("/")[0]
         host, _, port = hostport.partition(":")
         try:
-            port_n = int(port or 80)
+            port_n = int(port or (443 if use_tls else 80))
         except ValueError:
             log.warning("kubernetes: malformed kube_url port %r", port)
             return {}
         path = f"/api/v1/namespaces/{namespace}/pods/{pod}"
-        got = plain_http_request(host, port_n, "GET", path, timeout=3)
-        if got is None or got[0] != 200:
+        headers = {}
+        token = self._auth_token()
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        ca = self.kube_ca_file if (self.kube_ca_file
+                                   and os.path.exists(self.kube_ca_file)) \
+            else None
+        got = sync_http_request(
+            host, port_n, "GET", path, headers=headers, timeout=3,
+            tls=use_tls, tls_verify=bool(self.tls_verify),
+            tls_ca_file=ca)
+        if got is None:
+            return {}
+        status, _hdrs, body = got
+        if status == 401 and token:
+            # token rotated under us: force a refresh and retry once
+            # (also clear the failure backoff — the 401 IS the signal
+            # that a re-read is worth it right now)
+            self._token = None
+            self._token_created = 0.0
+            self._token_retry_at = 0.0
+            token = self._auth_token()
+            if token:
+                headers["Authorization"] = f"Bearer {token}"
+                got = sync_http_request(
+                    host, port_n, "GET", path, headers=headers, timeout=3,
+                    tls=use_tls, tls_verify=bool(self.tls_verify),
+                    tls_ca_file=ca)
+                if got is None:
+                    return {}
+                status, _hdrs, body = got
+        if status != 200:
             return {}
         try:
-            return json.loads(got[1])
+            return json.loads(body)
         except ValueError:
             return {}
 

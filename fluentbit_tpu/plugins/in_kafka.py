@@ -74,7 +74,11 @@ class KafkaInput(InputPlugin):
         self._coordinator: Optional[Tuple[str, int]] = None
         self._assignment: Dict[str, List[int]] = {}
         self._last_heartbeat = 0.0
+        self._hb_ok = time.monotonic()
         self._uncommitted = False
+        # partitions whose COMMITTED offset came back trimmed
+        # (OFFSET_OUT_OF_RANGE): resolution bypasses OffsetFetch
+        self._oor: set = set()
 
     def _pool(self, addr):
         from ..core.upstream import Upstream
@@ -166,6 +170,10 @@ class KafkaInput(InputPlugin):
         self._generation = -1
         self._assignment = {}
         self._offsets = {}
+        # fresh session: a stale pre-outage timestamp would turn the
+        # FIRST transient heartbeat failure after rejoin into another
+        # full reset (rebalance churn on flaky networks)
+        self._hb_ok = time.monotonic()
         if forget_member:
             self._member_id = ""
 
@@ -253,15 +261,26 @@ class KafkaInput(InputPlugin):
                     missing.setdefault(topic, []).append(pid)
         if not missing:
             return
-        rest = await self._rpc_to(
-            self._coordinator, kp.API_OFFSET_FETCH, 1,
-            kp.offset_fetch_request(self.group_id, missing))
+        # partitions whose committed offset was trimmed
+        # (OFFSET_OUT_OF_RANGE) bypass OffsetFetch entirely
+        oor_now = {tp for tp in self._oor
+                   if tp[0] in missing and tp[1] in missing[tp[0]]}
+        fetchable = {t: [p for p in ps if (t, p) not in oor_now]
+                     for t, ps in missing.items()}
+        fetchable = {t: ps for t, ps in fetchable.items() if ps}
         uncommitted: Dict[str, List[int]] = {}
-        for topic, pid, off, err in kp.parse_offset_fetch_response(rest):
-            if err == 0 and off >= 0:
-                self._offsets[(topic, pid)] = off
-            else:
-                uncommitted.setdefault(topic, []).append(pid)
+        for topic, pid in oor_now:
+            uncommitted.setdefault(topic, []).append(pid)
+        if fetchable:
+            rest = await self._rpc_to(
+                self._coordinator, kp.API_OFFSET_FETCH, 1,
+                kp.offset_fetch_request(self.group_id, fetchable))
+            for topic, pid, off, err in \
+                    kp.parse_offset_fetch_response(rest):
+                if err == 0 and off >= 0:
+                    self._offsets[(topic, pid)] = off
+                else:
+                    uncommitted.setdefault(topic, []).append(pid)
         if uncommitted:
             ts = -2 if (self.initial_offset or "latest").lower() \
                 == "earliest" else -1
@@ -272,6 +291,12 @@ class KafkaInput(InputPlugin):
                     kp.parse_list_offsets_response(rest):
                 if err == 0:
                     self._offsets[(topic, pid)] = off
+                    if (topic, pid) in self._oor:
+                        self._oor.discard((topic, pid))
+                        # commit the reset position promptly so a
+                        # rebalance doesn't hand the trimmed offset to
+                        # another member
+                        self._uncommitted = True
 
     async def _group_heartbeat_and_commit(self) -> bool:
         """Heartbeat on schedule + commit consumed offsets; returns
@@ -316,10 +341,22 @@ class KafkaInput(InputPlugin):
             if err == kp.ERR_UNKNOWN_MEMBER_ID:
                 self._reset_group(forget_member=True)
                 return False
+            self._hb_ok = now
             return True
         except (OSError, asyncio.TimeoutError,
                 kp.KafkaProtocolError) as e:
             log.debug("in_kafka heartbeat failed: %s", e)
+            # transient failures tolerated only within the session
+            # timeout: past it the broker has already evicted this
+            # member and rebalanced its partitions elsewhere —
+            # continuing to fetch makes a ZOMBIE consuming duplicates
+            # it can never commit. Rejoin instead.
+            session = max(1.0, int(self.session_timeout_ms) / 1000.0)
+            if now - self._hb_ok >= session:
+                log.info("in_kafka: no successful heartbeat for %.0fs "
+                         "(session timeout) — rejoining group", session)
+                self._reset_group(forget_member=True)
+                return False
             return True  # transient: keep fetching, retry next tick
 
     def _emit(self, engine, topic: str, pid: int, base: int,
@@ -416,6 +453,13 @@ class KafkaInput(InputPlugin):
                         # via Metadata + ListOffsets instead of
                         # re-fetching the same failure forever
                         self._offsets.pop((topic, pid), None)
+                        if err == kp.ERR_OFFSET_OUT_OF_RANGE:
+                            # the COMMITTED offset itself is trimmed:
+                            # grouped-mode re-resolution must skip
+                            # OffsetFetch (it would hand the same bad
+                            # offset back forever) and go straight to
+                            # ListOffsets
+                            self._oor.add((topic, pid))
                         continue
                     for base, crc_ok, records, next_off in \
                             kp.iter_record_batches(record_set):

@@ -171,6 +171,7 @@ class SystemdInput(InputPlugin):
             budget -= consumed
             self._pos[jf.file_id] = skip + consumed
             changed = True
+            jf.close()  # release the mmap before the next tick
             for tag, bufs in groups.items():
                 engine.input_log_append(
                     self._ins, tag, b"".join(bufs), len(bufs))

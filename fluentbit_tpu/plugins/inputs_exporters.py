@@ -48,12 +48,17 @@ class NodeExporterMetricsInput(InputPlugin):
         ConfigMapEntry("path.procfs", "str", default="/proc"),
         ConfigMapEntry("path.sysfs", "str", default="/sys"),
         ConfigMapEntry("collectors", "clist",
-                       default="cpu,meminfo,loadavg,filesystem,netdev,uname"),
+                       default="cpu,cpufreq,meminfo,diskstats,filesystem,"
+                               "uptime,loadavg,netdev,stat,time,vmstat,"
+                               "filefd,uname"),
+        ConfigMapEntry("textfile.directory", "str"),
     ]
 
     def init(self, instance, engine) -> None:
         self.collect_interval = float(self.scrape_interval or 5)
         self._enabled = {c.strip().lower() for c in (self.collectors or [])}
+        if self.textfile_directory:
+            self._enabled.add("textfile")
 
     # -- collectors --
 
@@ -155,17 +160,213 @@ class NodeExporterMetricsInput(InputPlugin):
             pass
         return out
 
+    def _diskstats(self) -> List[dict]:
+        """/proc/diskstats → the node_exporter core disk series
+        (reference in_node_exporter_metrics/ne_diskstats.c; sectors are
+        fixed 512-byte units)."""
+        reads, read_b, writes, written_b, io_t = [], [], [], [], []
+        with open(os.path.join(self.path_procfs, "diskstats")) as f:
+            for line in f:
+                p = line.split()
+                if len(p) < 14:
+                    continue
+                dev = (p[2],)
+                reads.append((dev, int(p[3])))
+                read_b.append((dev, int(p[5]) * 512))
+                writes.append((dev, int(p[7])))
+                written_b.append((dev, int(p[9]) * 512))
+                io_t.append((dev, int(p[12]) / 1000.0))
+        k = ("device",)
+        return [
+            _counter("node_disk_reads_completed_total",
+                     "The total number of reads completed successfully.",
+                     reads, k),
+            _counter("node_disk_read_bytes_total",
+                     "The total number of bytes read successfully.",
+                     read_b, k),
+            _counter("node_disk_writes_completed_total",
+                     "The total number of writes completed successfully.",
+                     writes, k),
+            _counter("node_disk_written_bytes_total",
+                     "The total number of bytes written successfully.",
+                     written_b, k),
+            _counter("node_disk_io_time_seconds_total",
+                     "Total seconds spent doing I/Os.", io_t, k),
+        ]
+
+    def _vmstat(self) -> List[dict]:
+        """node_exporter exports the ^(oom_kill|pgpg|pswp|pg.*fault)
+        subset of /proc/vmstat (ne_vmstat.c)."""
+        import re as _re
+
+        keep = _re.compile(r"^(oom_kill|pgpg|pswp|pg.*fault)")
+        out = []
+        with open(os.path.join(self.path_procfs, "vmstat")) as f:
+            for line in f:
+                key, _, val = line.partition(" ")
+                if not keep.match(key):
+                    continue
+                out.append(_counter(f"node_vmstat_{key}",
+                                    f"/proc/vmstat information field {key}.",
+                                    [((), int(val))]))
+        return out
+
+    def _stat(self) -> List[dict]:
+        """context switches / interrupts / forks / procs gauges from
+        /proc/stat (ne_stat.c)."""
+        out = []
+        with open(os.path.join(self.path_procfs, "stat")) as f:
+            for line in f:
+                p = line.split()
+                if not p:
+                    continue
+                if p[0] == "intr":
+                    out.append(_counter(
+                        "node_intr_total",
+                        "Total number of interrupts serviced.",
+                        [((), int(p[1]))]))
+                elif p[0] == "ctxt":
+                    out.append(_counter(
+                        "node_context_switches_total",
+                        "Total number of context switches.",
+                        [((), int(p[1]))]))
+                elif p[0] == "processes":
+                    out.append(_counter(
+                        "node_forks_total", "Total number of forks.",
+                        [((), int(p[1]))]))
+                elif p[0] == "procs_running":
+                    out.append(_gauge(
+                        "node_procs_running",
+                        "Number of processes in runnable state.",
+                        [((), int(p[1]))]))
+                elif p[0] == "procs_blocked":
+                    out.append(_gauge(
+                        "node_procs_blocked",
+                        "Number of processes blocked waiting for I/O.",
+                        [((), int(p[1]))]))
+        return out
+
+    def _filefd(self) -> List[dict]:
+        with open(os.path.join(self.path_procfs,
+                               "sys/fs/file-nr")) as f:
+            alloc, _unused, maximum = f.read().split()[:3]
+        return [_gauge("node_filefd_allocated",
+                       "File descriptor statistics: allocated.",
+                       [((), int(alloc))]),
+                _gauge("node_filefd_maximum",
+                       "File descriptor statistics: maximum.",
+                       [((), int(maximum))])]
+
+    def _cpufreq(self) -> List[dict]:
+        """scaling frequencies from sysfs (ne_cpufreq.c); kHz → Hz."""
+        import glob as _glob
+
+        cur, mn, mx = [], [], []
+        base = os.path.join(self.path_sysfs, "devices/system/cpu")
+        for d in sorted(_glob.glob(os.path.join(base, "cpu[0-9]*"))):
+            cpu = (os.path.basename(d)[3:],)
+            for fname, dest in (("scaling_cur_freq", cur),
+                                ("scaling_min_freq", mn),
+                                ("scaling_max_freq", mx)):
+                try:
+                    with open(os.path.join(d, "cpufreq", fname)) as f:
+                        dest.append((cpu, int(f.read()) * 1000.0))
+                except (OSError, ValueError):
+                    continue
+        k = ("cpu",)
+        out = []
+        if cur:
+            out.append(_gauge("node_cpu_scaling_frequency_hertz",
+                              "Current scaled CPU thread frequency in "
+                              "hertz.", cur, k))
+        if mn:
+            out.append(_gauge("node_cpu_scaling_frequency_min_hertz",
+                              "Minimum scaled CPU thread frequency in "
+                              "hertz.", mn, k))
+        if mx:
+            out.append(_gauge("node_cpu_scaling_frequency_max_hertz",
+                              "Maximum scaled CPU thread frequency in "
+                              "hertz.", mx, k))
+        return out
+
+    def _hwmon(self) -> List[dict]:
+        """temperature sensors from /sys/class/hwmon (ne_hwmon.c);
+        milli-celsius → celsius."""
+        import glob as _glob
+
+        temps = []
+        for hw in sorted(_glob.glob(
+                os.path.join(self.path_sysfs, "class/hwmon/hwmon*"))):
+            try:
+                with open(os.path.join(hw, "name")) as f:
+                    chip = f.read().strip()
+            except OSError:
+                chip = os.path.basename(hw)
+            for t in sorted(_glob.glob(os.path.join(hw, "temp*_input"))):
+                sensor = os.path.basename(t)[: -len("_input")]
+                try:
+                    with open(t) as f:
+                        temps.append(((chip, sensor),
+                                      int(f.read()) / 1000.0))
+                except (OSError, ValueError):
+                    continue
+        if not temps:
+            return []
+        return [_gauge("node_hwmon_temp_celsius",
+                       "Hardware monitor for temperature.",
+                       temps, ("chip", "sensor"))]
+
+    def _time(self) -> List[dict]:
+        return [_gauge("node_time_seconds",
+                       "System time in seconds since epoch (1970).",
+                       [((), time.time())])]
+
+    def _uptime(self) -> List[dict]:
+        with open(os.path.join(self.path_procfs, "uptime")) as f:
+            up = float(f.read().split()[0])
+        return [_counter("node_uptime_seconds_total",
+                         "Seconds since the system booted.",
+                         [((), up)])]
+
+    def _textfile(self) -> List[dict]:
+        """*.prom exposition files (ne_textfile.c / the node_exporter
+        textfile collector contract)."""
+        import glob as _glob
+
+        from .inputs_net_extra import parse_prometheus_text
+
+        if not self.textfile_directory:
+            return []
+        out: List[dict] = []
+        for path in sorted(_glob.glob(
+                os.path.join(self.textfile_directory, "*.prom"))):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    out.extend(parse_prometheus_text(f.read()))
+            except OSError as e:
+                log.debug("node_exporter textfile %s: %s", path, e)
+        return out
+
     def collect(self, engine) -> None:
         entries: List[dict] = []
         for name, fn in (("cpu", self._cpu), ("meminfo", self._meminfo),
                          ("loadavg", self._loadavg),
                          ("filesystem", self._filesystem),
-                         ("netdev", self._netdev), ("uname", self._uname)):
+                         ("netdev", self._netdev), ("uname", self._uname),
+                         ("diskstats", self._diskstats),
+                         ("vmstat", self._vmstat), ("stat", self._stat),
+                         ("filefd", self._filefd),
+                         ("cpufreq", self._cpufreq),
+                         ("hwmon", self._hwmon), ("time", self._time),
+                         ("uptime", self._uptime),
+                         ("textfile", self._textfile)):
             if name not in self._enabled:
                 continue
             try:
                 entries.extend(fn())
-            except OSError as e:
+            except (OSError, ValueError, UnicodeDecodeError) as e:
+                # one broken source (malformed *.prom, short procfs
+                # file) must not abort the other collectors' tick
                 log.debug("node_exporter: collector %s failed: %s", name, e)
         if not entries:
             return

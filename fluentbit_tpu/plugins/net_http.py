@@ -133,8 +133,14 @@ class HttpServerInputBase(InputPlugin):
         algo = headers.get("content-encoding", "").lower()
         if not algo or not body:
             return body
+        if algo == "identity":
+            return body
         if algo not in ("gzip", "zstd", "snappy", "deflate"):
-            return body  # unknown encoding: hand through untouched
+            # an unknown encoding handed through would be parsed as if
+            # it were plaintext, minting garbage records — reject (400)
+            # like the reference's http server does for unsupported
+            # encodings
+            return None
         from ..utils import decompress
         try:
             return decompress(algo, body)

@@ -108,19 +108,22 @@ def plain_http_request(host: str, port: int, method: str, path: str,
 def sync_http_request(host: str, port: int, method: str, path: str,
                       headers=None, body: bytes = b"", tls: bool = False,
                       tls_verify: bool = True, timeout: float = 10.0,
-                      max_bytes: int = 64 * 1024 * 1024):
+                      max_bytes: int = 64 * 1024 * 1024,
+                      tls_ca_file: Optional[str] = None):
     """Blocking HTTP/1.1 request with optional TLS →
     (status, headers_dict, body) or None. The synchronous-upstream
     analogue (reference flb_stream_disable_async_mode +
     flb_http_client, used by control-plane style init-time calls:
-    out_calyptia api_agent_create, filter_nightfall scan_log)."""
+    out_calyptia api_agent_create, filter_nightfall scan_log).
+    ``tls_ca_file`` pins a private CA (kubernetes service-account
+    ca.crt)."""
     import socket as _socket
     import ssl as _ssl
 
     try:
         s = _socket.create_connection((host, port), timeout=timeout)
         if tls:
-            ctx = _ssl.create_default_context()
+            ctx = _ssl.create_default_context(cafile=tls_ca_file)
             if not tls_verify:
                 ctx.check_hostname = False
                 ctx.verify_mode = _ssl.CERT_NONE

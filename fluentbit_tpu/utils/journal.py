@@ -112,13 +112,26 @@ class JournalFile:
     """One .journal file; `entries(skip)` iterates in write order."""
 
     def __init__(self, path: str):
+        import mmap
+
         self.path = path
-        with open(path, "rb") as f:
-            self.buf = f.read()
+        # mmap, not read(): an ACTIVE journal is re-opened every
+        # collect tick, and a full slurp of a multi-GB file per tick is
+        # pure waste — the entry walk touches only the pages it needs
+        self._f = open(path, "rb")
+        try:
+            self.buf = mmap.mmap(self._f.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+        except (ValueError, OSError):
+            # empty or unmappable file: fall back to a byte snapshot
+            self._f.seek(0)
+            self.buf = self._f.read()
         if len(self.buf) < 208 or self.buf[:8] != HEADER_SIGNATURE:
+            self.close()
             raise JournalError(f"{path}: not a journal file")
         self.incompatible = struct.unpack_from("<I", self.buf, 12)[0]
         if self.incompatible & ~_SUPPORTED:
+            self.close()  # raising skips the caller's close
             raise JournalError(
                 f"{path}: unsupported incompatible flags "
                 f"{self.incompatible:#x}")
@@ -220,6 +233,17 @@ class JournalFile:
                     return  # zero-padded tail of the last array
                 yield off
             array = next_array
+
+    def close(self) -> None:
+        try:
+            if hasattr(self.buf, "close"):
+                self.buf.close()
+        except (BufferError, ValueError):
+            pass
+        try:
+            self._f.close()
+        except (OSError, AttributeError):
+            pass
 
     def entries(self, skip: int = 0,
                 max_entries: Optional[int] = None) -> Iterator[Entry]:

@@ -375,6 +375,7 @@ API_LEAVE_GROUP = 13
 API_SYNC_GROUP = 14
 
 # error codes the group state machine reacts to
+ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_ILLEGAL_GENERATION = 22
 ERR_UNKNOWN_MEMBER_ID = 25
 ERR_REBALANCE_IN_PROGRESS = 27

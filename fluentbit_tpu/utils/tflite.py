@@ -188,8 +188,12 @@ class Model:
                 # FullyConnectedOptions: fused_activation_function(0)
                 y = _activation(y, opts.i8(0, 0) if opts else 0)
             elif code == OP_CONV_2D:
+                # optional bias is encoded as tensor index -1; get(-1)
+                # would silently read an unrelated tensor
                 y = self._conv2d(get(ins[0]), get(ins[1]),
-                                 get(ins[2]) if len(ins) > 2 else None,
+                                 get(ins[2])
+                                 if len(ins) > 2 and ins[2] >= 0
+                                 else None,
                                  opts)
             elif code in (OP_MAX_POOL_2D, OP_AVERAGE_POOL_2D):
                 y = self._pool(get(ins[0]), opts,

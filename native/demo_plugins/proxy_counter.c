@@ -1,0 +1,96 @@
+/* Go-proxy-contract demo OUTPUT — the shape a cgo-built
+ * fluent-bit-go plugin exports (reference src/proxy/go/go.{c,h},
+ * flb_plugin_proxy.c:347-433): the host calls FLBPluginRegister with
+ * a definition struct the plugin fills, then FLBPluginInit receives
+ * the plugin table and reads config through the api callback table;
+ * FLBPluginFlush gets raw msgpack chunk bytes. Built live by the
+ * runtime tests (tests/test_dso_proxy.py). */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+struct flb_plugin_proxy_def {
+    int type;
+    int proxy;
+    int flags;
+    char *name;
+    char *description;
+    int event_type;
+};
+
+struct flb_api {
+    char *(*output_get_property)(char *, void *);
+    char *(*input_get_property)(char *, void *);
+    char *(*custom_get_property)(char *, void *);
+    void *(*output_get_cmt_instance)(void *);
+    void *(*input_get_cmt_instance)(void *);
+    void *log_print;
+    int (*input_log_check)(void *, int);
+    int (*output_log_check)(void *, int);
+    int (*custom_log_check)(void *, int);
+};
+
+struct flbgo_output_plugin {
+    char *name;
+    struct flb_api *api;
+    void *o_ins;
+    void *context;
+    int (*cb_init)(struct flbgo_output_plugin *);
+    int (*cb_flush)(const void *, size_t, const char *);
+    int (*cb_flush_ctx)(void *, const void *, size_t, char *);
+    int (*cb_exit)(void);
+    int (*cb_exit_ctx)(void *);
+};
+
+#define FLB_PROXY_OUTPUT_PLUGIN 2
+#define FLB_PROXY_GOLANG 11
+#define FLB_ERROR 0
+#define FLB_OK 1
+#define FLB_RETRY 2
+
+static char out_path[1024];
+
+int FLBPluginRegister(struct flb_plugin_proxy_def *def)
+{
+    def->type = FLB_PROXY_OUTPUT_PLUGIN;
+    def->proxy = FLB_PROXY_GOLANG;
+    def->flags = 0;
+    def->name = strdup("gocounter");
+    def->description = strdup("proxy-contract demo output");
+    def->event_type = 0;
+    return 0;
+}
+
+int FLBPluginInit(struct flbgo_output_plugin *p)
+{
+    char *v = p->api->output_get_property((char *) "path", p->o_ins);
+    if (v == NULL || v[0] == '\0') {
+        return FLB_ERROR;
+    }
+    snprintf(out_path, sizeof(out_path), "%s", v);
+    return FLB_OK;
+}
+
+int FLBPluginFlush(const void *data, size_t size, const char *tag)
+{
+    FILE *f = fopen(out_path, "ab");
+    if (f == NULL) {
+        return FLB_RETRY;
+    }
+    fprintf(f, "tag=%s size=%zu\n", tag, size);
+    fwrite(data, 1, size, f);
+    fputc('\n', f);
+    fclose(f);
+    return FLB_OK;
+}
+
+int FLBPluginExit(void)
+{
+    FILE *f = fopen(out_path, "ab");
+    if (f != NULL) {
+        fputs("EXIT\n", f);
+        fclose(f);
+    }
+    return FLB_OK;
+}

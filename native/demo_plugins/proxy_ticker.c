@@ -1,0 +1,98 @@
+/* Go-proxy-contract demo INPUT (reference src/proxy/go/go.c
+ * proxy_go_input_*): FLBPluginInputCallback returns a malloc'd
+ * msgpack event buffer the host ingests and then hands to
+ * FLBPluginInputCleanupCallback. */
+
+#include <stdlib.h>
+#include <string.h>
+
+struct flb_plugin_proxy_def {
+    int type;
+    int proxy;
+    int flags;
+    char *name;
+    char *description;
+    int event_type;
+};
+
+struct flb_api;
+
+struct flbgo_input_plugin {
+    char *name;
+    struct flb_api *api;
+    void *i_ins;
+    void *context;
+    int (*cb_init)(struct flbgo_input_plugin *);
+    int (*cb_collect)(void **, size_t *);
+    int (*cb_collect_ctx)(void *, void **, size_t *);
+    int (*cb_cleanup)(void *);
+    int (*cb_cleanup_ctx)(void *, void *);
+    int (*cb_exit)(void);
+};
+
+#define FLB_PROXY_INPUT_PLUGIN 1
+#define FLB_PROXY_GOLANG 11
+
+static int g_ticks = 0;
+static int g_cleanups = 0;
+
+int FLBPluginRegister(struct flb_plugin_proxy_def *def)
+{
+    def->type = FLB_PROXY_INPUT_PLUGIN;
+    def->proxy = FLB_PROXY_GOLANG;
+    def->flags = 0;
+    def->name = strdup("goticker");
+    def->description = strdup("proxy-contract demo input");
+    def->event_type = 0;
+    return 0;
+}
+
+int FLBPluginInit(struct flbgo_input_plugin *p)
+{
+    (void) p;
+    return 1;
+}
+
+/* legacy msgpack event: [double ts, {"msg": "tick", "n": <i>}] */
+int FLBPluginInputCallback(void **data, size_t *size)
+{
+    unsigned char *buf = malloc(64);
+    size_t w = 0;
+    union { double d; unsigned long long u; } ts;
+    int i;
+
+    if (buf == NULL) {
+        return -1;
+    }
+    ts.d = 1700000000.0 + g_ticks;
+    buf[w++] = 0x92;              /* fixarray 2 */
+    buf[w++] = 0xcb;              /* float64, big-endian */
+    for (i = 7; i >= 0; i--) {
+        buf[w++] = (unsigned char) ((ts.u >> (i * 8)) & 0xff);
+    }
+    buf[w++] = 0x82;              /* fixmap 2 */
+    buf[w++] = 0xa3; memcpy(buf + w, "msg", 3); w += 3;
+    buf[w++] = 0xa4; memcpy(buf + w, "tick", 4); w += 4;
+    buf[w++] = 0xa1; buf[w++] = 'n';
+    buf[w++] = (unsigned char) (g_ticks & 0x7f);  /* positive fixint */
+    g_ticks++;
+    *data = buf;
+    *size = w;
+    return 0;
+}
+
+int FLBPluginInputCleanupCallback(void *data)
+{
+    free(data);
+    g_cleanups++;
+    return 0;
+}
+
+/* test hooks */
+int demo_ticks(void) { return g_ticks; }
+int demo_cleanups(void) { return g_cleanups; }
+
+int FLBPluginExit(void)
+{
+    return 1;
+}

@@ -41,10 +41,10 @@ def test_stem_derivation():
 def test_load_rejects_bad_objects(tmp_path, demo_so):
     import shutil
 
-    # stem without an in_/out_ prefix
+    # stem without an in_/out_ prefix and no proxy register export
     weird = str(tmp_path / "weird.so")
     shutil.copy(demo_so["out"], weird)
-    with pytest.raises(ValueError, match="stem"):
+    with pytest.raises(ValueError, match="FLBPluginRegister"):
         load_dso_plugin(weird)
     # wrong symbol name for the stem
     bad = str(tmp_path / "out_nosuch.so")

@@ -39,6 +39,90 @@ def test_node_exporter_collectors():
     assert fs["labels"] == ["device", "mountpoint", "fstype"]
 
 
+def test_node_exporter_extended_collectors(tmp_path):
+    """diskstats / vmstat / stat / filefd / cpufreq / hwmon / time /
+    uptime / textfile against a synthetic procfs+sysfs tree
+    (reference in_node_exporter_metrics/ne.c:34-49 collector set)."""
+    proc = tmp_path / "proc"
+    sys_ = tmp_path / "sys"
+    (proc / "sys/fs").mkdir(parents=True)
+    (proc / "diskstats").write_text(
+        "   8  0 sda 100 0 2048 50 200 0 4096 80 0 30 1500\n"
+        "   8  1 sda1 10 0 16 5 20 0 64 8 0 3 150\n")
+    (proc / "vmstat").write_text(
+        "nr_free_pages 100\npgpgin 555\npgpgout 666\npswpin 7\n"
+        "pgfault 888\npgmajfault 99\noom_kill 2\n")
+    (proc / "stat").write_text(
+        "cpu  10 0 20 300 0 0 0 0\ncpu0 10 0 20 300 0 0 0 0\n"
+        "intr 12345 1 2 3\nctxt 99999\nbtime 1700000000\n"
+        "processes 4321\nprocs_running 3\nprocs_blocked 1\n")
+    (proc / "sys/fs/file-nr").write_text("1234\t0\t808348\n")
+    (proc / "uptime").write_text("5000.5 9000.0\n")
+    cf = sys_ / "devices/system/cpu/cpu0/cpufreq"
+    cf.mkdir(parents=True)
+    (cf / "scaling_cur_freq").write_text("2200000\n")
+    (cf / "scaling_min_freq").write_text("800000\n")
+    (cf / "scaling_max_freq").write_text("3400000\n")
+    hw = sys_ / "class/hwmon/hwmon0"
+    hw.mkdir(parents=True)
+    (hw / "name").write_text("coretemp\n")
+    (hw / "temp1_input").write_text("45500\n")
+    tfd = tmp_path / "textfile"
+    tfd.mkdir()
+    (tfd / "job.prom").write_text(
+        "# HELP my_job_last_success Last success.\n"
+        "# TYPE my_job_last_success gauge\n"
+        "my_job_last_success 1700000001\n")
+
+    from fluentbit_tpu.core.plugin import registry
+
+    ins = registry.create_input("node_exporter_metrics")
+    ins.set("path.procfs", str(proc))
+    ins.set("path.sysfs", str(sys_))
+    ins.set("collectors",
+            "diskstats,vmstat,stat,filefd,cpufreq,hwmon,time,uptime")
+    ins.set("textfile.directory", str(tfd))
+    ins.configure()
+    ins.plugin.init(ins, None)
+
+    captured = {}
+
+    class Eng:
+        def input_event_append(self, instance, tag, data, etype,
+                               n_records=1):
+            captured["data"] = data
+            captured["n"] = n_records
+
+    ins.plugin.collect(Eng())
+    obj = next(iter(Unpacker(captured["data"])))
+    by_name = {m["name"]: m for m in obj["metrics"]}
+
+    disk = by_name["node_disk_read_bytes_total"]
+    vals = {tuple(s["labels"]): s["value"] for s in disk["values"]}
+    assert vals[("sda",)] == 2048 * 512
+    assert by_name["node_disk_io_time_seconds_total"]["values"][0][
+        "value"] == pytest.approx(0.03)  # field 13 (ms doing I/O) / 1000
+    assert by_name["node_vmstat_oom_kill"]["values"][0]["value"] == 2
+    assert by_name["node_vmstat_pgfault"]["values"][0]["value"] == 888
+    assert "node_vmstat_nr_free_pages" not in by_name  # filtered set
+    assert by_name["node_context_switches_total"]["values"][0][
+        "value"] == 99999
+    assert by_name["node_forks_total"]["values"][0]["value"] == 4321
+    assert by_name["node_procs_running"]["values"][0]["value"] == 3
+    assert by_name["node_filefd_allocated"]["values"][0]["value"] == 1234
+    assert by_name["node_filefd_maximum"]["values"][0]["value"] == 808348
+    freq = by_name["node_cpu_scaling_frequency_hertz"]
+    assert freq["values"][0]["value"] == 2200000 * 1000
+    temp = by_name["node_hwmon_temp_celsius"]
+    assert temp["values"][0]["labels"] == ["coretemp", "temp1"]
+    assert temp["values"][0]["value"] == pytest.approx(45.5)
+    assert by_name["node_uptime_seconds_total"]["values"][0][
+        "value"] == pytest.approx(5000.5)
+    assert by_name["node_time_seconds"]["values"][0]["value"] > 1e9
+    assert by_name["my_job_last_success"]["values"][0][
+        "value"] == 1700000001
+
+
 def collectd_packet():
     def part_str(ptype, s):
         b = s.encode() + b"\x00"

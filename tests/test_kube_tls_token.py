@@ -1,0 +1,175 @@
+"""filter_kubernetes metadata over TLS with service-account bearer
+token: https kube_url + private CA, token file, kube_token_command,
+TTL refresh, and 401-driven re-read (reference
+plugins/filter_kubernetes/kube_meta.c:101-191, 240-248)."""
+
+import json
+import socket
+import ssl
+import subprocess
+import threading
+
+import pytest
+
+from fluentbit_tpu.core.plugin import registry
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("kubecerts")
+    crt, key = str(d / "srv.crt"), str(d / "srv.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "2",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return crt, key
+
+
+class TlsApiServer:
+    """Minimal apiserver: requires Bearer <expected>, returns the pod
+    object; anything else gets 401."""
+
+    def __init__(self, certs, expected_tokens):
+        self.requests = []
+        self.expected = expected_tokens  # set, mutated by tests
+        crt, key = certs
+        self.ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self.ctx.load_cert_chain(crt, key)
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            try:
+                tls = self.ctx.wrap_socket(conn, server_side=True)
+            except (ssl.SSLError, OSError):
+                conn.close()
+                continue
+            tls.settimeout(3)
+            try:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += tls.recv(65536)
+                head = data.partition(b"\r\n\r\n")[0].decode()
+                self.requests.append(head)
+                auth = ""
+                for line in head.split("\r\n"):
+                    if line.lower().startswith("authorization:"):
+                        auth = line.split(":", 1)[1].strip()
+                if auth.replace("Bearer ", "") in self.expected:
+                    pod = {"metadata": {
+                        "name": "mypod", "namespace": "ns1",
+                        "labels": {"app": "web"},
+                        "annotations": {"note": "hi"}}}
+                    body = json.dumps(pod).encode()
+                    tls.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                                + str(len(body)).encode()
+                                + b"\r\n\r\n" + body)
+                else:
+                    tls.sendall(b"HTTP/1.1 401 Unauthorized\r\n"
+                                b"Content-Length: 0\r\n\r\n")
+            except (OSError, ssl.SSLError):
+                pass
+            tls.close()
+
+    def close(self):
+        self.srv.close()
+
+
+def make_kube(port, ca_file, token_file=None, token_command=None,
+              token_ttl="10m"):
+    ins = registry.create_filter("kubernetes")
+    ins.set("kube_url", f"https://127.0.0.1:{port}")
+    ins.set("kube_ca_file", ca_file)
+    ins.set("kube_token_file", token_file or "/nonexistent")
+    if token_command:
+        ins.set("kube_token_command", token_command)
+    ins.set("kube_token_ttl", token_ttl)
+    ins.configure()
+    ins.plugin.init(ins, None)
+    return ins.plugin
+
+
+def test_https_fetch_with_token_file(certs, tmp_path):
+    tok = tmp_path / "token"
+    tok.write_text("sa-token-1\n")
+    srv = TlsApiServer(certs, {"sa-token-1"})
+    try:
+        k = make_kube(srv.port, certs[0], token_file=str(tok))
+        meta = k._fetch_meta("ns1", "mypod")
+    finally:
+        srv.close()
+    assert meta["metadata"]["labels"] == {"app": "web"}
+    assert any("Authorization: Bearer sa-token-1" in r
+               for r in srv.requests)
+
+
+def test_https_verifies_ca(certs, tmp_path):
+    """With a WRONG CA the TLS handshake must fail closed (no meta),
+    not fall back to plaintext or skip verification."""
+    wrong_ca = tmp_path / "other.crt"
+    wrong_key = tmp_path / "other.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(wrong_key), "-out", str(wrong_ca), "-days", "2",
+         "-subj", "/CN=untrusted"],
+        check=True, capture_output=True)
+    tok = tmp_path / "token"
+    tok.write_text("sa-token-1")
+    srv = TlsApiServer(certs, {"sa-token-1"})
+    try:
+        k = make_kube(srv.port, str(wrong_ca), token_file=str(tok))
+        meta = k._fetch_meta("ns1", "mypod")
+    finally:
+        srv.close()
+    assert meta == {}
+
+
+def test_token_command_and_ttl_refresh(certs, tmp_path):
+    """kube_token_command output is cached for kube_token_ttl, then the
+    command runs again (kube_meta.c:240 refresh_token_if_needed)."""
+    counter = tmp_path / "n"
+    counter.write_text("0")
+    script = tmp_path / "tok.sh"
+    script.write_text(
+        f"#!/bin/sh\nn=$(cat {counter})\nn=$((n+1))\n"
+        f"echo $n > {counter}\necho cmd-token-$n\n")
+    script.chmod(0o755)
+    srv = TlsApiServer(certs, {"cmd-token-1", "cmd-token-2"})
+    try:
+        k = make_kube(srv.port, certs[0], token_command=str(script),
+                      token_ttl="1000s")
+        assert k._fetch_meta("ns1", "mypod")  # token 1 fetched + cached
+        assert k._fetch_meta("ns1", "mypod2" if False else "mypod")
+        assert counter.read_text().strip() == "1"  # cached, no re-run
+        k._token_created -= 2000  # age past the TTL
+        assert k._fetch_meta("ns1", "mypod")
+        assert counter.read_text().strip() == "2"  # refreshed
+    finally:
+        srv.close()
+    assert any("Bearer cmd-token-2" in r for r in srv.requests)
+
+
+def test_rotated_token_retries_once_on_401(certs, tmp_path):
+    tok = tmp_path / "token"
+    tok.write_text("old-token")
+    srv = TlsApiServer(certs, {"new-token"})
+    try:
+        k = make_kube(srv.port, certs[0], token_file=str(tok))
+        assert k._fetch_meta("ns1", "mypod") == {}  # old token rejected
+        tok.write_text("new-token")  # kubelet rotated the projected token
+        meta = k._fetch_meta("ns1", "mypod")
+    finally:
+        srv.close()
+    assert meta.get("metadata", {}).get("name") == "mypod"
+    # the 401 forced an immediate re-read despite the TTL cache
+    assert any("Bearer new-token" in r for r in srv.requests)
