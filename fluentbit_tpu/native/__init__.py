@@ -183,6 +183,8 @@ def _load():
                 i64p,                                     # troffs
                 i32p, i32p, i32p,                         # cmaps/starts/ncls
                 ctypes.POINTER(ctypes.c_uint16), i64p,    # cmap2/cm2offs
+                ctypes.POINTER(ctypes.c_int16), i64p,     # btrans/btroffs
+                ctypes.POINTER(ctypes.c_uint32), i64p,    # accel/aoffs
                 ctypes.POINTER(ctypes.c_uint8),           # rule_exclude
                 ctypes.c_int32,                           # op_mode
                 ctypes.c_longlong,                        # max_records
@@ -260,6 +262,41 @@ def compact(buf: bytes, offsets: np.ndarray,
     return out[:w].tobytes()
 
 
+def _build_accel(trans: np.ndarray, class_map: np.ndarray):
+    """Per-state escape-byte acceleration (the self-loop-skipping
+    design documented at native/fbtpu_native.cpp: states that leave
+    only on <=2 bytes get a memchr/SIMD skip instead of a table walk).
+
+    accel[s] u32: bits 0-1 = 0 none / 1 one escape byte / 2 two /
+    3 no escape bytes at all (state is fixed until EOL);
+    bits 8-15 byte1; 16-23 byte2. Returns (accel u32[S], usable bool).
+
+    Opt-in (FBTPU_ACCEL=1): on the bench corpus (short ~10-30 byte
+    fields between delimiters) the scalar skip chain MEASURES SLOWER
+    than the 16-lane interleaved k-composed walk — the skips save few
+    table loads while forfeiting cross-record load-latency hiding
+    (4.4M vs 8.1M lines/s). It wins on long self-loop runs (multi-KB
+    lines, .*-tail patterns), so the engine stays available and
+    differentially tested rather than default."""
+    S = trans.shape[0]
+    if not os.environ.get("FBTPU_ACCEL"):
+        return np.zeros(1, dtype=np.uint32), False  # analysis skipped
+    cm = class_map[:256].astype(np.int64)
+    tb = trans[:, cm]  # [S, 256] next state per BYTE
+    esc = tb != np.arange(S, dtype=tb.dtype)[:, None]
+    n_esc = esc.sum(axis=1)
+    accel = np.zeros(S, dtype=np.uint32)
+    accel[n_esc == 0] = 3
+    for s in np.nonzero(n_esc == 1)[0]:
+        b = int(np.nonzero(esc[s])[0][0])
+        accel[s] = 1 | (b << 8)
+    for s in np.nonzero(n_esc == 2)[0]:
+        b1, b2 = (int(x) for x in np.nonzero(esc[s])[0][:2])
+        accel[s] = 2 | (b1 << 8) | (b2 << 16)
+    skippy = int((accel != 0).sum())
+    return accel, skippy * 20 >= S and skippy >= 2
+
+
 class GrepTables:
     """Packed DFA tables for the one-pass native grep matcher — the
     host-side twin of ops.grep.GrepProgram (same tables, k=1). Verdicts
@@ -267,7 +304,8 @@ class GrepTables:
 
     __slots__ = ("n_rules", "keys_cat", "key_offs", "key_of_rule",
                  "trans_cat", "troffs", "cmaps", "starts", "ncls",
-                 "cmap2_cat", "cm2offs")
+                 "cmap2_cat", "cm2offs", "btrans_cat", "btroffs",
+                 "accel_cat", "aoffs")
 
     def __init__(self, rules):
         """rules: iterable of (field_key: bytes, dfa) pairs."""
@@ -282,6 +320,12 @@ class GrepTables:
         cm2_len = 0
         starts = []
         ncls = []
+        btrans_parts = []
+        btroffs = []
+        btrans_len = 0
+        accel_parts = []
+        aoffs = []
+        accel_len = 0
         for key, dfa in rules:
             if key not in key_idx:
                 key_idx[key] = len(keys)
@@ -333,6 +377,20 @@ class GrepTables:
             else:
                 cm2offs.append(-1)
             starts.append(dfa.start)
+            # escape-byte accel: byte-level table + skip words for
+            # DFAs whose states mostly self-loop (log-matching shapes)
+            accel, usable = _build_accel(t, dfa.class_map)
+            if usable:
+                aoffs.append(accel_len)
+                accel_parts.append(accel)
+                accel_len += accel.size
+                btrans_parts.append(np.ascontiguousarray(
+                    t, dtype=np.int16).reshape(-1))
+                btroffs.append(btrans_len)
+                btrans_len += t.size
+            else:
+                aoffs.append(-1)
+                btroffs.append(0)
         self.n_rules = len(key_of_rule)
         self.keys_cat = b"".join(keys)
         offs = [0]
@@ -348,6 +406,12 @@ class GrepTables:
         self.cm2offs = np.asarray(cm2offs, dtype=np.int64)
         self.starts = np.asarray(starts, dtype=np.int32)
         self.ncls = np.asarray(ncls, dtype=np.int32)
+        self.btrans_cat = (np.concatenate(btrans_parts) if btrans_parts
+                           else np.zeros(1, dtype=np.int16))
+        self.btroffs = np.asarray(btroffs, dtype=np.int64)
+        self.accel_cat = (np.concatenate(accel_parts) if accel_parts
+                          else np.zeros(1, dtype=np.uint32))
+        self.aoffs = np.asarray(aoffs, dtype=np.int64)
 
 
 def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
@@ -457,6 +521,10 @@ def grep_filter(buf, tables: "GrepFilterTables",
         tables.ncls.ctypes.data_as(i32p),
         tables.cmap2_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
         tables.cm2offs.ctypes.data_as(i64p),
+        tables.btrans_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        tables.btroffs.ctypes.data_as(i64p),
+        tables.accel_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        tables.aoffs.ctypes.data_as(i64p),
         tables.excl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         tables.op_mode,
         cap,

@@ -808,6 +808,105 @@ long long fbtpu_compact(const uint8_t *buf, long long buflen,
 
 #define FBTPU_PRE_LANES 16
 
+// ---------------------------------------------------------------------
+// Escape-byte accelerated scalar matcher (the accel[s] design in the
+// fused-filter comment above): a state that leaves only on one or two
+// bytes skips straight to the next escape byte with memchr / a 16-wide
+// SIMD compare; a state with NO escape bytes is fixed until EOL. Exact:
+// skipped bytes provably keep the state unchanged.
+//   accel[s]: bits 0-1 kind (0 step / 1 one byte / 2 two / 3 fixed),
+//   bits 8-15 byte1, 16-23 byte2.
+// ---------------------------------------------------------------------
+
+static inline uint32_t scan_one_byte(const uint8_t *v, uint32_t i,
+                                     uint32_t len, uint8_t b1) {
+#ifdef FBTPU_HAVE_SSE2
+    __m128i m1 = _mm_set1_epi8((char)b1);
+    while (i + 16 <= len) {
+        __m128i x = _mm_loadu_si128((const __m128i *)(v + i));
+        int mask = _mm_movemask_epi8(_mm_cmpeq_epi8(x, m1));
+        if (mask) return i + (uint32_t)__builtin_ctz((unsigned)mask);
+        i += 16;
+    }
+#endif
+    for (; i < len; i++)
+        if (v[i] == b1) return i;
+    return 0xFFFFFFFFu;
+}
+
+static inline uint32_t scan_two_bytes(const uint8_t *v, uint32_t i,
+                                      uint32_t len, uint8_t b1,
+                                      uint8_t b2) {
+#ifdef FBTPU_HAVE_SSE2
+    __m128i m1 = _mm_set1_epi8((char)b1), m2 = _mm_set1_epi8((char)b2);
+    while (i + 16 <= len) {
+        __m128i x = _mm_loadu_si128((const __m128i *)(v + i));
+        int mask = _mm_movemask_epi8(
+            _mm_or_si128(_mm_cmpeq_epi8(x, m1), _mm_cmpeq_epi8(x, m2)));
+        if (mask) return i + (uint32_t)__builtin_ctz((unsigned)mask);
+        i += 16;
+    }
+#endif
+    for (; i < len; i++)
+        if (v[i] == b1 || v[i] == b2) return i;
+    return 0xFFFFFFFFu;
+}
+
+// One record through the tables with skipping — a HYBRID walk:
+// skippy states (<=2 escape bytes) jump via memchr/SIMD; dense states
+// step through the k-composed table (4 bytes per dependent load when
+// the pair-class table is available) so a skip-poor stretch costs no
+// more than the lockstep engine's per-byte work. DEAD(0) and ACC(1)
+// are absorbing; the trailing EOL step is safe from either.
+static inline uint8_t dfa_accel_match(const int16_t *bt,
+                                      const int32_t *cmap, int32_t C,
+                                      int32_t start,
+                                      const uint32_t *accel,
+                                      const int16_t *transk,
+                                      const uint16_t *cmap2,
+                                      int k, int32_t Ck,
+                                      const uint8_t *v, uint32_t len) {
+    int32_t s = start;
+    int32_t C2 = C * C;
+    uint32_t i = 0;
+    while (i < len) {
+        uint32_t a = accel[s];
+        uint32_t kind = a & 3u;
+        if (kind == 0u) {
+            // dense state: composed 4-byte step when possible
+            if (k == 4 && cmap2 != nullptr && i + 4 <= len) {
+                uint16_t w0, w1;
+                memcpy(&w0, v + i, 2);
+                memcpy(&w1, v + i + 2, 2);
+                s = transk[s * Ck + (int32_t)cmap2[w0] * C2 + cmap2[w1]];
+                i += 4;
+            } else {
+                s = bt[s * C + cmap[v[i]]];
+                i++;
+            }
+            if (s <= 1) break;
+            continue;
+        }
+        if (kind == 3u) {
+            i = len;  // state cannot change before EOL
+            break;
+        }
+        if (kind == 1u) {
+            i = scan_one_byte(v, i, len, (uint8_t)((a >> 8) & 0xffu));
+            if (i == 0xFFFFFFFFu) { i = len; break; }
+        } else {  // kind == 2
+            i = scan_two_bytes(v, i, len, (uint8_t)((a >> 8) & 0xffu),
+                               (uint8_t)((a >> 16) & 0xffu));
+            if (i == 0xFFFFFFFFu) { i = len; break; }
+        }
+        s = bt[s * C + cmap[v[i]]];  // step on the escape byte
+        i++;
+        if (s <= 1) break;  // absorbed
+    }
+    s = bt[s * C + cmap[256]];  // EOL step
+    return (uint8_t)(s == 1);
+}
+
 // cmap2 (optional, even k only): 64K-entry byte-PAIR class table
 // cmap2[b0 + (b1<<8)] = class(b0)*C + class(b1) — one load classifies
 // two bytes, and for k=4 two pair-lookups make a whole super-symbol:
@@ -939,6 +1038,10 @@ long long fbtpu_grep_filter(const uint8_t *buf, long long buflen,
                             const int32_t *ncls,
                             const uint16_t *cmap2_cat,
                             const long long *cm2offs,
+                            const int16_t *btrans_cat,
+                            const long long *btroffs,
+                            const uint32_t *accel_cat,
+                            const long long *aoffs,
                             const uint8_t *rule_exclude, int32_t op_mode,
                             long long max_records,
                             uint8_t *out, long long *out_info) {
@@ -1057,6 +1160,8 @@ long long fbtpu_grep_filter(const uint8_t *buf, long long buflen,
     bool order_built[FBTPU_MAX_KEYS] = {false};
     const int N_BUCKETS = 64;
     for (long long r = 0; r < n_rules; r++) {
+        if (aoffs != nullptr && aoffs[r] >= 0)
+            continue;  // accel rules don't use the sorted order
         long long kx = key_of_rule[r];
         if (!order_built[kx]) {
             order_built[kx] = true;
@@ -1081,8 +1186,33 @@ long long fbtpu_grep_filter(const uint8_t *buf, long long buflen,
         }
     }
     for (long long r = 0; r < n_rules; r++) {
-        const int16_t *trans = trans_cat + troffs[r];
         const int32_t *cmap = cmaps + r * 257;
+        if (aoffs != nullptr && aoffs[r] >= 0) {
+            // skip-friendly DFA: escape-byte hybrid matcher (memchr /
+            // SIMD skips in self-loop states, composed 4-byte steps in
+            // dense ones)
+            const uint32_t *accel = accel_cat + aoffs[r];
+            const int16_t *bt = btrans_cat + btroffs[r];
+            int32_t enc_a = ncls[r];
+            int ka = enc_a / 1000 + 1;
+            int32_t Cb = enc_a % 1000;
+            int32_t Cka = 1;
+            for (int b = 0; b < ka; b++) Cka *= Cb;
+            const int16_t *transk_a = trans_cat + troffs[r];
+            const uint16_t *cmap2_a =
+                cm2offs[r] >= 0 ? cmap2_cat + cm2offs[r] : nullptr;
+            const uint8_t *const *kv = vals + key_of_rule[r] * max_records;
+            const uint32_t *kl = vlens + key_of_rule[r] * max_records;
+            uint8_t *mrow = match + r * max_records;
+            for (long long i = 0; i < n_rec; i++)
+                mrow[i] = kv[i] != nullptr
+                    ? dfa_accel_match(bt, cmap, Cb, starts[r], accel,
+                                      transk_a, cmap2_a, ka, Cka,
+                                      kv[i], kl[i])
+                    : 0;
+            continue;
+        }
+        const int16_t *trans = trans_cat + troffs[r];
         const uint16_t *cmap2 =
             cm2offs[r] >= 0 ? cmap2_cat + cm2offs[r] : nullptr;
         // ncls encodes C and the super-step k: C + 1000*(k-1)

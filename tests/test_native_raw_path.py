@@ -208,6 +208,50 @@ def test_fused_filter_odd_block_sizes(n):
         assert rx.match(ev.body["log"])
 
 
+def test_accel_engine_differential(monkeypatch):
+    """The opt-in escape-byte hybrid matcher (FBTPU_ACCEL=1) must be
+    verdict-identical to the default lockstep engine across corpora
+    incl. long self-loop runs (its winning case) and odd blocks."""
+    from fluentbit_tpu.regex import FlbRegex
+    from fluentbit_tpu.regex.dfa import compile_dfa
+
+    monkeypatch.setenv("FBTPU_ACCEL", "1")
+    apache2 = (
+        r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
+        r'"(?<method>\S+)(?: +(?<path>[^ ]*) +\S*)?" (?<code>[^ ]*) '
+        r'(?<size>[^ ]*)(?: "(?<referer>[^\"]*)" "(?<agent>.*)")?$'
+    )
+    patterns = [apache2, "ERROR|WARN", "GET"]
+    rng = random.Random(77)
+    bodies = []
+    buf = bytearray()
+    for i in range(333):
+        roll = rng.random()
+        if roll < 0.2:
+            line = ('10.0.0.9 - u [10/Oct/2000:13:55:36 -0700] '
+                    f'"GET /l{i} HTTP/1.1" 200 77 "r" "a"')
+        elif roll < 0.4:
+            line = "x" * rng.randrange(500, 4000) + " ERROR tail"
+        elif roll < 0.5:
+            line = ""
+        else:
+            line = f"plain WARN line {i} " + "y" * rng.randrange(50)
+        body = {"log": line} if rng.random() > 0.1 else {"n": i}
+        bodies.append(body)
+        buf += encode_event(body, float(i))
+    for pattern in patterns:
+        dfa = compile_dfa(pattern)
+        tables = native.GrepFilterTables([(b"log", dfa, False)], "legacy")
+        assert tables.aoffs[0] >= 0, f"accel not engaged for {pattern}"
+        rx = FlbRegex(pattern)
+        got = native.grep_filter(bytes(buf), tables)
+        assert got is not None
+        expect = sum(1 for b in bodies
+                     if isinstance(b.get("log"), str)
+                     and rx.match(b["log"]))
+        assert got[1] == expect, pattern
+
+
 def test_fused_filter_fuzz_mutated_msgpack():
     """fbtpu_grep_filter / fbtpu_stage_field must survive arbitrary
     byte-flipped msgpack without crashing; valid buffers must keep the
