@@ -2,9 +2,12 @@
 
 Reference: plugins/in_tail (tail.c, tail_file.c line processing,
 tail_scan_glob.c path scanning, tail_db.c sqlite offset persistence,
-rotation via inode tracking in tail_fs_inotify.c/tail_fs_stat.c). This
-implementation polls (stat-based; the reference also falls back to stat
-mode when inotify is unavailable):
+rotation via inode tracking in tail_fs_inotify.c/tail_fs_stat.c).
+Watching is event-driven by default (``inotify_watcher on`` — raw
+Linux inotify over ctypes: file watches gate which files are read
+each tick, directory watches pick up new files immediately instead of
+waiting out ``refresh_interval``), with the reference's stat-polling
+fallback when inotify is unavailable or disabled:
 
 - ``path``: comma-separated globs, re-scanned every ``refresh_interval``
 - per-file offset + inode tracking; rotation = inode change under the
@@ -76,6 +79,68 @@ class _AutoUtf16Decoder:
         return self._inner.decode(data, final)
 
 
+class _Inotify:
+    """Linux inotify over the raw syscalls (ctypes — inotify needs no
+    library): the tail_fs_inotify.c role. Non-blocking; ``events()``
+    drains whatever the kernel queued since the last call."""
+
+    IN_MODIFY = 0x00000002
+    IN_ATTRIB = 0x00000004
+    IN_MOVED_TO = 0x00000080
+    IN_CREATE = 0x00000100
+    IN_DELETE_SELF = 0x00000400
+    IN_MOVE_SELF = 0x00000800
+    IN_Q_OVERFLOW = 0x00004000
+    IN_IGNORED = 0x00008000
+
+    FILE_MASK = IN_MODIFY | IN_ATTRIB | IN_DELETE_SELF | IN_MOVE_SELF
+    DIR_MASK = IN_CREATE | IN_MOVED_TO
+
+    def __init__(self):
+        import ctypes
+
+        self._libc = ctypes.CDLL(None, use_errno=True)
+        fd = self._libc.inotify_init1(os.O_NONBLOCK)
+        if fd < 0:
+            raise OSError("inotify_init1 failed")
+        self.fd = fd
+
+    def add_watch(self, path: str, mask: int) -> int:
+        """→ watch descriptor, or -1 (unwatchable path)."""
+        return self._libc.inotify_add_watch(self.fd, path.encode(), mask)
+
+    def rm_watch(self, wd: int) -> None:
+        """Free the kernel watch (stale watches on rotated-away inodes
+        otherwise accumulate toward fs.inotify.max_user_watches)."""
+        self._libc.inotify_rm_watch(self.fd, wd)
+
+    def events(self):
+        """Drain pending events → [(wd, mask, name)]."""
+        import struct as _struct
+
+        out = []
+        while True:
+            try:
+                data = os.read(self.fd, 65536)
+            except (BlockingIOError, OSError):
+                break
+            off = 0
+            while off + 16 <= len(data):
+                # NATIVE byte order: the kernel writes host-endian
+                wd, mask, _cookie, ln = _struct.unpack_from(
+                    "=iIII", data, off)
+                name = data[off + 16: off + 16 + ln].split(b"\0", 1)[0]
+                out.append((wd, mask, name.decode("utf-8", "replace")))
+                off += 16 + ln
+        return out
+
+    def close(self) -> None:
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+
 @registry.register
 class TailInput(InputPlugin):
     name = "tail"
@@ -83,6 +148,9 @@ class TailInput(InputPlugin):
     collect_interval = 0.25
     config_map = [
         ConfigMapEntry("path", "clist"),
+        ConfigMapEntry("inotify_watcher", "bool", default=True,
+                       desc="event-driven file watching (Linux inotify; "
+                            "off = pure stat polling)"),
         ConfigMapEntry("exclude_path", "clist"),
         ConfigMapEntry("path_key", "str"),
         ConfigMapEntry("key", "str", default="log"),
@@ -151,6 +219,20 @@ class TailInput(InputPlugin):
                 self._codec = _AutoUtf16Decoder
             else:
                 self._codec = _codecs.getincrementaldecoder(codec)
+        # inotify (tail_fs_inotify.c role): event-driven readiness —
+        # between refresh scans only MODIFIED files are read instead of
+        # stat-polling every file every tick. Missing/unsupported →
+        # silent stat fallback (the reference does the same off-Linux).
+        self._ino = None
+        self._wd_file: Dict[int, str] = {}
+        self._wd_dir: Dict[int, str] = {}
+        self._watched_files: Dict[str, int] = {}
+        self._watched_dirs: Dict[str, int] = {}
+        if self.inotify_watcher:
+            try:
+                self._ino = _Inotify()
+            except (OSError, AttributeError):
+                self._ino = None
         self._db = None
         self._dirty: Dict[str, tuple] = {}
         if self.db:
@@ -177,13 +259,85 @@ class TailInput(InputPlugin):
                     tf.fd.close()
                 except OSError:
                     pass
+        if self._ino is not None:
+            self._ino.close()
         if self._db is not None:
             self._checkpoint()  # final offsets before close
             self._db.close()
 
+    # -- inotify plumbing --
+
+    def _watch_path(self, path: str) -> None:
+        if self._ino is None or path in self._watched_files:
+            return
+        wd = self._ino.add_watch(path, _Inotify.FILE_MASK)
+        if wd >= 0:
+            self._wd_file[wd] = path
+            self._watched_files[path] = wd
+
+    def _watch_dirs(self) -> None:
+        """Watch glob parent dirs (static, non-glob dirnames) and the
+        dirs of discovered files: CREATE/MOVED_TO there triggers an
+        immediate re-scan instead of waiting out refresh_interval."""
+        if self._ino is None:
+            return
+        dirs = set()
+        for pat in self.path or []:
+            d = os.path.dirname(pat) or "."
+            if not _glob.has_magic(d) and os.path.isdir(d):
+                dirs.add(d)
+        dirs.update(os.path.dirname(p) or "." for p in self._files)
+        for d in dirs:
+            if d in self._watched_dirs:
+                continue
+            wd = self._ino.add_watch(d, _Inotify.DIR_MASK)
+            if wd >= 0:
+                self._wd_dir[wd] = d
+                self._watched_dirs[d] = wd
+
+    def _rewatch(self, path: str) -> None:
+        """After rotation the old wd follows the RENAMED inode; drop it
+        and watch the path's new inode."""
+        if self._ino is None:
+            return
+        wd = self._watched_files.pop(path, None)
+        if wd is not None:
+            self._wd_file.pop(wd, None)
+            self._ino.rm_watch(wd)
+        self._watch_path(path)
+
+    def _poll_inotify(self):
+        """→ (modified file paths, any-dir-event, overflow). IN_IGNORED
+        prunes the wd maps (the kernel freed the watch — deleted dir or
+        rotated-away inode), so a recreated directory re-watches instead
+        of being shadowed by its dead entry. IN_Q_OVERFLOW means events
+        were dropped: the caller must fall back to reading everything."""
+        modified = set()
+        dir_event = False
+        overflow = False
+        for wd, mask, _name in self._ino.events():
+            if mask & _Inotify.IN_Q_OVERFLOW:
+                overflow = True
+                continue
+            if mask & _Inotify.IN_IGNORED:
+                path = self._wd_file.pop(wd, None)
+                if path is not None:
+                    self._watched_files.pop(path, None)
+                d = self._wd_dir.pop(wd, None)
+                if d is not None:
+                    self._watched_dirs.pop(d, None)
+                    dir_event = True  # dir may have been recreated
+                continue
+            path = self._wd_file.get(wd)
+            if path is not None:
+                modified.add(path)
+            elif wd in self._wd_dir:
+                dir_event = True
+        return modified, dir_event, overflow
+
     # -- scanning --
 
-    def _scan(self) -> None:
+    def _scan(self, initial: bool = False) -> None:
         excluded = set()
         for pat in self.exclude_path or []:
             excluded.update(_glob.glob(pat))
@@ -195,7 +349,12 @@ class TailInput(InputPlugin):
                     st = os.stat(path)
                 except OSError:
                     continue
-                offset = 0 if self.read_from_head else st.st_size
+                # read_from_head governs files present at STARTUP;
+                # files appearing later are always read from 0 (the
+                # reference's tail_scan semantics — skipping to
+                # st_size would silently drop their initial content)
+                offset = 0 if (self.read_from_head or not initial) \
+                    else st.st_size
                 inode = st.st_ino
                 if self._db is not None:
                     rows = self._db.query(
@@ -250,11 +409,39 @@ class TailInput(InputPlugin):
     # -- reading --
 
     def collect(self, engine) -> None:
+        initial = self._since_scan == float("inf")
         self._since_scan += self.collect_interval
-        if self._since_scan >= self.refresh_interval:
-            self._scan()
+        scan_due = self._since_scan >= self.refresh_interval
+        # stat mode reads every file every tick but still scans only on
+        # the refresh cadence (a per-tick re-glob of broad patterns is
+        # pure I/O waste); inotify mode reads only modified files
+        # between refreshes
+        read_all = self._ino is None or scan_due
+        targets = None
+        if self._ino is not None:
+            modified, dir_event, overflow = self._poll_inotify()
+            if overflow:
+                # the kernel dropped events: trust nothing this tick
+                read_all = True
+                scan_due = True
+            if dir_event and not scan_due:
+                # something appeared in a watched dir: re-scan NOW
+                before = set(self._files)
+                self._scan()
+                modified |= set(self._files) - before
+            if not read_all:
+                targets = [self._files[p] for p in modified
+                           if p in self._files]
+        if scan_due:
+            self._scan(initial=initial)
             self._since_scan = 0.0
-        for tf in list(self._files.values()):
+        if read_all:
+            targets = list(self._files.values())
+        if self._ino is not None:
+            for path in self._files:
+                self._watch_path(path)
+            self._watch_dirs()
+        for tf in targets or ():
             self._read_file(tf, engine)
         self._checkpoint()
         # flush multiline groups that waited past their flush window
@@ -302,6 +489,7 @@ class TailInput(InputPlugin):
             tf.skipping = False
             tf.skip_anchor = 0
             tf.decoder = None
+            self._rewatch(tf.path)  # old wd follows the renamed inode
             self._drain_fd(tf, engine, reopen=True)
         elif st is None:
             try:
@@ -309,6 +497,11 @@ class TailInput(InputPlugin):
             except OSError:
                 pass
             self._files.pop(tf.path, None)
+            wd = self._watched_files.pop(tf.path, None)
+            if wd is not None:
+                self._wd_file.pop(wd, None)
+                if self._ino is not None:
+                    self._ino.rm_watch(wd)
             self._drop_ml_stream(tf.path, engine)
         self._persist(tf)
 
