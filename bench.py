@@ -227,6 +227,78 @@ def measure_multi_input(raw_chunks, n_inputs: int,
 # by arithmetic, not by lock contention).
 
 
+def measure_secondary(seconds: float = 1.5) -> dict:
+    """BASELINE configs 2-3: NDJSON → filter_parser json, and an
+    8-rule filter_rewrite_tag chain — the non-grep filter stages'
+    single-core throughput."""
+    import json as _json
+    import random
+
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.core.engine import Engine
+
+    rng = random.Random(7)
+    n = 4096
+    json_buf = bytearray()
+    for i in range(n):
+        line = _json.dumps({"level": rng.choice(["info", "warn", "err"]),
+                            "msg": f"m{i}", "n": i})
+        json_buf += encode_event({"log": line}, float(i))
+    json_buf = bytes(json_buf)
+
+    out = {}
+    e = Engine()
+    e.parser("jp", format="json")
+    f = e.filter("parser")
+    f.set("key_name", "log")
+    f.set("parser", "jp")
+    ins = e.input("dummy")
+    for x in e.inputs + e.filters:
+        x.configure()
+        x.plugin.init(x, e)
+    e.input_log_append(ins, "b", json_buf)
+    ins.pool.drain()
+    t0 = time.perf_counter()
+    lines = 0
+    while time.perf_counter() - t0 < seconds:
+        e.input_log_append(ins, "b", json_buf)
+        ins.pool.drain()
+        lines += n
+    out["parser_json_lines_per_sec"] = round(
+        lines / (time.perf_counter() - t0))
+
+    e2 = Engine()
+    rt = e2.filter("rewrite_tag")
+    for i, word in enumerate(["alpha", "beta", "gamma", "delta",
+                              "epsilon", "zeta", "eta", "theta"]):
+        rt.set("rule", f"$log ^{word} routed.{word} false")
+    ins2 = e2.input("dummy")
+    for x in e2.inputs + e2.filters:
+        x.configure()
+        x.plugin.init(x, e2)
+    words = ["alpha x", "beta y", "omega z", "theta q"]
+    rt_buf = b"".join(
+        encode_event({"log": rng.choice(words) + f" {i}"}, float(i))
+        for i in range(n))
+    emitter_ins = e2.filters[0].plugin.emitter.instance
+    e2.input_log_append(ins2, "b", rt_buf)
+    ins2.pool.drain()
+    emitter_ins.pool.drain()
+    t0 = time.perf_counter()
+    lines = 0
+    while time.perf_counter() - t0 < seconds:
+        e2.input_log_append(ins2, "b", rt_buf)
+        ins2.pool.drain()
+        # drain the emitter too: a saturated (never-drained) emitter
+        # would flip every add_record into the backpressure-reject
+        # path and measure the wrong regime
+        emitter_ins.pool.drain()
+        lines += n
+    out["rewrite_tag_lines_per_sec"] = round(
+        lines / (time.perf_counter() - t0))
+    return out
+
+
 def check_bit_exact(raw_chunks) -> bool:
     """Device/native raw path vs the pure-Python verdict chain."""
     ok = True
@@ -518,6 +590,12 @@ def child_main(mode: str) -> None:
         }
     except Exception as e:
         result["multi_input"] = {"error": repr(e)}
+    if mode == "cpu":
+        _progress(stage="cpu:secondary")
+        try:
+            result["secondary"] = measure_secondary()
+        except Exception as e:
+            result["secondary"] = {"error": repr(e)}
     if ok and mode == "cpu":
         run_kernel_only()
     from fluentbit_tpu import native
@@ -671,6 +749,7 @@ def final_line(cpu, dev, dev_err, extras):
         "cpu_backend_lines_per_sec": (cpu or {}).get("lines_per_sec"),
         "multi_input": (best or {}).get("multi_input"),
         "native_staging": bool((best or {}).get("native_staging", False)),
+        "secondary": (cpu or {}).get("secondary"),
         "host_cpus": os.cpu_count(),
         "chunk_records": CHUNK_RECORDS,
         "wall_seconds": round(time.time() - _T0, 1),

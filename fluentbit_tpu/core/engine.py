@@ -672,107 +672,120 @@ class Engine:
 
         with self._ingest_lock:
             # expose the appending input to filters that must recognise
-            # their own emitter's records (filter_multiline's
-            # i_ins == ctx->ins_emitter check in the reference)
+            # their own emitter's records (filter_multiline's and
+            # filter_rewrite_tag's i_ins == ctx->ins_emitter checks in
+            # the reference). Saved/restored because emitters re-enter
+            # input_log_append synchronously mid-chain — without the
+            # restore the OUTER chain's remaining filters would see the
+            # nested append's source
+            prev_src = self._ingest_src
             self._ingest_src = ins
+            try:
+                return self._log_append_decoded(ins, tag, data,
+                                                n_records, cond_routing)
+            finally:
+                self._ingest_src = prev_src
 
-            events = decode_events(data)
-            if n_records is None:
-                n_records = len(events)
-            self.m_in_records.inc(n_records, (ins.display_name,))
-            self.m_in_bytes.inc(len(data), (ins.display_name,))
+    def _log_append_decoded(self, ins, tag, data, n_records, cond_routing):
+        """The decode branch of input_log_append (runs under the global
+        ingest lock, with _ingest_src already pointing at ``ins``)."""
+        events = decode_events(data)
+        if n_records is None:
+            n_records = len(events)
+        self.m_in_records.inc(n_records, (ins.display_name,))
+        self.m_in_bytes.inc(len(data), (ins.display_name,))
 
-            # input-side processors (flb_processor_run, src/flb_input_log.c:1562)
-            events = self._run_log_processors(ins.processors, events, tag)
-            if not events:
-                return 0
+        # input-side processors (flb_processor_run, src/flb_input_log.c:1562)
+        events = self._run_log_processors(ins.processors, events, tag)
+        if not events:
+            return 0
 
-            # chunk trace: input stamp (flb_chunk_trace_do_input,
-            # src/flb_input_chunk.c:3049)
-            trace_ctx = self._trace_ctx(ins)
-            if trace_ctx is not None:
-                trace_ctx["count"] += 1
-                trace_ctx["trace_id"] = trace_id = \
-                    f"{ins.name}.{trace_ctx['count']}"
-                self._trace_emit(trace_ctx, {
-                    "type": "input", "trace_id": trace_id,
-                    "input_instance": ins.display_name, "tag": tag,
-                    "records": n_records,
-                })
+        # chunk trace: input stamp (flb_chunk_trace_do_input,
+        # src/flb_input_chunk.c:3049)
+        trace_ctx = self._trace_ctx(ins)
+        if trace_ctx is not None:
+            trace_ctx["count"] += 1
+            trace_ctx["trace_id"] = trace_id = \
+                f"{ins.name}.{trace_ctx['count']}"
+            self._trace_emit(trace_ctx, {
+                "type": "input", "trace_id": trace_id,
+                "input_instance": ins.display_name, "tag": tag,
+                "records": n_records,
+            })
 
-            # filter chain — synchronous, pre-storage
-            events = self._run_filters(events, tag, trace_ctx)
-            if not events:
-                return 0
+        # filter chain — synchronous, pre-storage
+        events = self._run_filters(events, tag, trace_ctx)
+        if not events:
+            return 0
 
-            # stream processor on the filtered records (flb_sp_do,
-            # src/flb_input_chunk.c:3155); never on its OWN emitter's
-            # records — a task whose TAG pattern matches its output tag
-            # must not feed back into itself
-            if (
-                self.sp is not None
-                and self.sp.tasks
-                and ins is not self.sp.emitter_instance
-            ):
-                try:
-                    self.sp.do(events, tag)
-                except Exception:
-                    log.exception("stream processor failed")
+        # stream processor on the filtered records (flb_sp_do,
+        # src/flb_input_chunk.c:3155); never on its OWN emitter's
+        # records — a task whose TAG pattern matches its output tag
+        # must not feed back into itself
+        if (
+            self.sp is not None
+            and self.sp.tasks
+            and ins is not self.sp.emitter_instance
+        ):
+            try:
+                self.sp.do(events, tag)
+            except Exception:
+                log.exception("stream processor failed")
 
-            if cond_routing:
-                # split_and_append_route_payloads
-                # (src/flb_input_log.c:1495): group records by the set
-                # of outputs whose condition admits them; each group
-                # lands in its own chunk carrying that route bitmask
-                groups: Dict[int, bytearray] = {}
-                counts: Dict[int, int] = {}
-                # tag is constant for the append: resolve the matching
-                # candidates once, per-record work is condition eval only
-                candidates = [
-                    (1 << i, o.route_condition)
-                    for i, o in enumerate(self.outputs)
-                    if o.route.matches(tag)
-                ]
-                for ev in events:
-                    mask = 0
-                    for bit, cond in candidates:
-                        if cond is None or cond.eval(ev.body):
-                            mask |= bit
-                    if mask == 0:
-                        # no output admits this record (every matching
-                        # route's condition failed): nothing to deliver
-                        # — parity with dispatch finding zero routes
-                        continue
-                    raw = ev.raw if ev.raw is not None \
-                        else reencode_event(ev)
-                    groups.setdefault(mask, bytearray()).extend(raw)
-                    counts[mask] = counts.get(mask, 0) + 1
-                with ins.ingest_lock:
-                    for mask, buf in groups.items():
-                        chunk = ins.pool.append(
-                            tag, bytes(buf), counts[mask],
-                            routes_mask=mask)
-                        if chunk.route_names is None:
-                            # persisted form: NAMES, not bit positions
-                            # — conditional routing must survive a
-                            # restart with reordered outputs
-                            chunk.route_names = tuple(
-                                o.display_name
-                                for i, o in enumerate(self.outputs)
-                                if (mask >> i) & 1
-                            )
-                        if self.storage is not None and \
-                                ins.storage_type == "filesystem":
-                            self.storage.write_through(chunk, bytes(buf))
-                return len(events)
-
-            out = bytearray()
+        if cond_routing:
+            # split_and_append_route_payloads
+            # (src/flb_input_log.c:1495): group records by the set
+            # of outputs whose condition admits them; each group
+            # lands in its own chunk carrying that route bitmask
+            groups: Dict[int, bytearray] = {}
+            counts: Dict[int, int] = {}
+            # tag is constant for the append: resolve the matching
+            # candidates once, per-record work is condition eval only
+            candidates = [
+                (1 << i, o.route_condition)
+                for i, o in enumerate(self.outputs)
+                if o.route.matches(tag)
+            ]
             for ev in events:
-                out += ev.raw if ev.raw is not None else reencode_event(ev)
+                mask = 0
+                for bit, cond in candidates:
+                    if cond is None or cond.eval(ev.body):
+                        mask |= bit
+                if mask == 0:
+                    # no output admits this record (every matching
+                    # route's condition failed): nothing to deliver
+                    # — parity with dispatch finding zero routes
+                    continue
+                raw = ev.raw if ev.raw is not None \
+                    else reencode_event(ev)
+                groups.setdefault(mask, bytearray()).extend(raw)
+                counts[mask] = counts.get(mask, 0) + 1
             with ins.ingest_lock:
-                chunk = ins.pool.append(tag, bytes(out), len(events))
-                if self.storage is not None and ins.storage_type == "filesystem":
-                    self.storage.write_through(chunk, bytes(out))
+                for mask, buf in groups.items():
+                    chunk = ins.pool.append(
+                        tag, bytes(buf), counts[mask],
+                        routes_mask=mask)
+                    if chunk.route_names is None:
+                        # persisted form: NAMES, not bit positions
+                        # — conditional routing must survive a
+                        # restart with reordered outputs
+                        chunk.route_names = tuple(
+                            o.display_name
+                            for i, o in enumerate(self.outputs)
+                            if (mask >> i) & 1
+                        )
+                    if self.storage is not None and \
+                            ins.storage_type == "filesystem":
+                        self.storage.write_through(chunk, bytes(buf))
+            return len(events)
+
+        out = bytearray()
+        for ev in events:
+            out += ev.raw if ev.raw is not None else reencode_event(ev)
+        with ins.ingest_lock:
+            chunk = ins.pool.append(tag, bytes(out), len(events))
+            if self.storage is not None and ins.storage_type == "filesystem":
+                self.storage.write_through(chunk, bytes(out))
         return len(events)
 
     def input_event_append(self, ins: InputInstance, tag: Optional[str],

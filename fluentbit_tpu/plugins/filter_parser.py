@@ -149,9 +149,14 @@ class ParserFilter(FilterPlugin):
             self._get_value(ev.body) if isinstance(ev.body, dict) else None
             for ev in events
         ]
+        from ..ops import device
+
         mask = None
+        # platform gate first (as in filter_grep/rewrite_tag): the
+        # prefilter kernel only pays for itself on a real accelerator
         if (self._prefilter is not None
                 and len(events) >= self.tpu_batch_records
+                and device.platform() not in (None, "cpu")
                 and self._prefilter.try_ready()):
             mask = self._device_match_mask(values)
         out: List[LogEvent] = []

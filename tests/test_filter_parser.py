@@ -122,7 +122,12 @@ def test_json_time_zero_does_not_override():
     assert out[0].timestamp == 42.5
 
 
-def test_device_prefilter_equivalence():
+def test_device_prefilter_equivalence(monkeypatch):
+    # the platform gate keeps the kernel off CPU backends in prod;
+    # force it open here so the device matrix path is actually tested
+    from fluentbit_tpu.ops import device
+
+    monkeypatch.setattr(device, "platform", lambda: "tpu")
     e = engine_with_parsers()
     f_dev = make_filter(e, key_name="log", parser="apache2",
                         tpu_batch_records="1", reserve_data="true")

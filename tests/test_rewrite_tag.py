@@ -106,9 +106,13 @@ def test_emitted_bytes_identical():
     assert evs[0].body == {"log": "x", "n": 7}
 
 
-def test_device_path_equivalence_config3():
+def test_device_path_equivalence_config3(monkeypatch):
     """BASELINE config 3 shape: 8 regex rules, syslog-ish corpus; device
-    and CPU paths must produce identical routing."""
+    and CPU paths must produce identical routing. The platform gate is
+    forced open (it keeps the kernel off CPU backends in prod)."""
+    from fluentbit_tpu.ops import device
+
+    monkeypatch.setattr(device, "platform", lambda: "tpu")
     rules = [
         r"$log sshd sec.ssh false",
         r"$log kernel: sys.kernel false",
