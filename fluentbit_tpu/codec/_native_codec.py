@@ -1,0 +1,74 @@
+"""Build + load the fbtpu_codec C extension (native/fbtpu_codec.c).
+
+Shares the hash-cached build scheme with fluentbit_tpu.native via
+native.buildlib (incl. the prebuilt-artifact trust paths); silently
+absent when the toolchain/headers are missing — callers keep the
+pure-Python decoder. FBTPU_NO_NATIVE disables it together with the
+data-plane .so.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sysconfig
+import threading
+
+log = logging.getLogger("flb.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(os.path.dirname(_HERE))
+_SRC = os.path.join(_ROOT, "native", "fbtpu_codec.c")
+_SO = os.path.join(_ROOT, "native", "build", "fbtpu_codec.so")
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def load():
+    """→ the initialized extension module, or None (pure-Python path).
+
+    Lock-free fast path: encode_event calls this per record, so the
+    settled states (loaded / declined) must not take the lock."""
+    if _mod is not None or _tried:
+        return _mod
+    return _load_slow()
+
+
+def _load_slow():
+    global _mod, _tried
+    with _lock:
+        if _mod is not None or _tried:
+            return _mod
+        _tried = True
+        if os.environ.get("FBTPU_NO_NATIVE"):
+            return None
+        include = sysconfig.get_paths().get("include")
+        if not include or not os.path.exists(
+                os.path.join(include, "Python.h")):
+            # no headers: only a prebuilt artifact can serve
+            if not os.path.exists(_SO):
+                return None
+        from ..native.buildlib import ensure_built
+
+        cmd = ["gcc", "-O2", "-fPIC", "-shared", "-I", include or ".",
+               _SRC, "-o", _SO]
+        if not ensure_built(_SRC, _SO, cmd):
+            return None
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "fbtpu_codec", _SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except (ImportError, OSError) as e:
+            log.warning("codec extension load failed: %s", e)
+            return None
+        from .events import LogEvent
+        from .msgpack import EventTime
+
+        mod._init(LogEvent, EventTime)
+        _mod = mod
+        return _mod

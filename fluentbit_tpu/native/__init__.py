@@ -17,10 +17,8 @@ API:
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import logging
 import os
-import subprocess
 import threading
 from typing import Optional, Tuple
 
@@ -40,39 +38,6 @@ _lib = None
 _tried = False
 
 
-def _src_hash() -> Optional[str]:
-    try:
-        with open(_SRC, "rb") as f:
-            return hashlib.sha256(f.read()).hexdigest()
-    except OSError:
-        return None
-
-
-def _build(src_hash: Optional[str]) -> bool:
-    try:
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-    except OSError:
-        return False
-    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        log.warning("native build unavailable: %s", e)
-        return False
-    if proc.returncode != 0:
-        log.warning("native build failed: %s", proc.stderr[-2000:])
-        return False
-    if src_hash:
-        try:
-            with open(_SO + ".hash", "w") as f:
-                f.write(src_hash)
-        except OSError:
-            pass  # staleness check degrades; the .so itself is fine
-    return True
-
-
 def _load():
     global _lib, _tried
     with _lock:
@@ -81,43 +46,15 @@ def _load():
         _tried = True
         if os.environ.get("FBTPU_NO_NATIVE"):
             return None
-        # rebuild on source-hash mismatch (mtime is unreliable: git
-        # stamps source and artifacts with the same checkout time)
-        have_so = os.path.exists(_SO)
-        if not os.path.exists(_SRC):
-            if not have_so:
-                return None
-        else:
-            built_hash = None
-            try:
-                with open(_SO + ".hash") as f:
-                    built_hash = f.read().strip()
-            except OSError:
-                pass
-            src_hash = _src_hash()
-            if have_so and built_hash is None and src_hash is not None:
-                # prebuilt .so with no hash sidecar: assume it matches
-                # the current source and record that assumption, so a
-                # LATER source edit triggers exactly one rebuild instead
-                # of a failing g++ attempt on every process start. The
-                # assumption holds even when the sidecar write fails
-                # (read-only filesystem) — the .so must still load.
-                built_hash = src_hash
-                try:
-                    with open(_SO + ".hash", "w") as f:
-                        f.write(src_hash)
-                except OSError:
-                    pass
-            if not have_so or (src_hash is not None
-                               and built_hash != src_hash):
-                if not _build(src_hash):
-                    # a KNOWN-stale .so (recorded hash differs from the
-                    # current source) must never load — its ABI may not
-                    # match the Python callers, and a silent mismatch
-                    # corrupts memory. Only a hash-less prebuilt .so
-                    # (provenance unknown, assumed current above) is a
-                    # safe fallback, and that case never reaches here.
-                    return None
+        # hash-cached build with prebuilt trust paths (buildlib: a
+        # KNOWN-stale .so never loads — its ABI may not match the
+        # Python callers, and a silent mismatch corrupts memory)
+        from .buildlib import ensure_built
+
+        cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+               "-pthread", _SRC, "-o", _SO]
+        if not ensure_built(_SRC, _SO, cmd):
+            return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError as e:

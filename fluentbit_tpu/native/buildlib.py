@@ -1,0 +1,82 @@
+"""Shared on-demand native build machinery (hash-cached compile).
+
+Both native loaders — the ctypes data plane (fluentbit_tpu.native) and
+the CPython codec extension (codec._native_codec) — need the same
+scheme: compile the source once, cache the artifact with a source-hash
+sidecar, rebuild on hash mismatch, and TRUST two prebuilt shapes:
+
+- artifact present but SOURCE missing (binary-only deployment): load it;
+- artifact present with no hash sidecar: assume it matches the current
+  source and record that assumption so one later source edit triggers
+  exactly one rebuild.
+
+A KNOWN-stale artifact (sidecar hash differs from the source) must
+never load — its ABI may not match the callers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+from typing import List, Optional
+
+log = logging.getLogger("flb.native")
+
+
+def src_hash(src: str) -> Optional[str]:
+    try:
+        with open(src, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _compile(cmd: List[str], so: str, digest: Optional[str]) -> bool:
+    try:
+        os.makedirs(os.path.dirname(so), exist_ok=True)
+    except OSError:
+        return False
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable: %s", e)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed: %s", proc.stderr[-2000:])
+        return False
+    if digest:
+        try:
+            with open(so + ".hash", "w") as f:
+                f.write(digest)
+        except OSError:
+            pass  # staleness check degrades; the artifact is fine
+    return True
+
+
+def ensure_built(src: str, so: str, cmd: List[str]) -> bool:
+    """→ True when ``so`` exists and is safe to load."""
+    have_so = os.path.exists(so)
+    if not os.path.exists(src):
+        return have_so  # binary-only deployment: trust the artifact
+    built_hash = None
+    try:
+        with open(so + ".hash") as f:
+            built_hash = f.read().strip()
+    except OSError:
+        pass
+    digest = src_hash(src)
+    if have_so and built_hash is None and digest is not None:
+        # prebuilt artifact with no sidecar: adopt the current source's
+        # hash (works even when the write fails — read-only checkout)
+        built_hash = digest
+        try:
+            with open(so + ".hash", "w") as f:
+                f.write(digest)
+        except OSError:
+            pass
+    if not have_so or (digest is not None and built_hash != digest):
+        return _compile(cmd, so, digest)
+    return True

@@ -1,0 +1,135 @@
+"""fbtpu_codec C extension: byte/semantic parity with the pure-Python
+msgpack codec across the full log-event surface, plus the FallbackError
+escape hatch (native/fbtpu_codec.c)."""
+
+import random
+
+import pytest
+
+import fluentbit_tpu.codec._native_codec as nc
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.codec.msgpack import EventTime, ExtType, packb
+
+mod = nc.load()
+pytestmark = pytest.mark.skipif(mod is None,
+                                reason="codec extension unavailable")
+
+
+def corpus(seed=0, n=400):
+    rng = random.Random(seed)
+    buf = bytearray()
+    for i in range(n):
+        body = {
+            "log": f"line {i} " + "x" * rng.randrange(0, 300),
+            "i": rng.randrange(-2**40, 2**40),
+            "u": 2**63 + rng.randrange(2**62),
+            "f": rng.random() * 10 ** rng.randrange(-6, 6),
+            "b": bool(i % 2),
+            "none": None,
+            "nested": {"a": [1, "x", {"y": -2}], "t": (3, 4)},
+            "by": bytes(range(i % 60)),
+            "uni": "héllo wörld ☃" * (i % 3),
+        }
+        ts = rng.choice([
+            EventTime(1700000000 + i, rng.randrange(10**9)),
+            float(i) + 0.25, i, -1, -2,
+        ])
+        meta = {"m": i} if i % 3 else {}
+        buf += encode_event(body, ts, meta)
+    buf += packb([1234, {"log": "legacy"}])  # legacy record
+    return bytes(buf)
+
+
+def _py_decode(buf):
+    prev_mod, prev_tried = nc._mod, nc._tried
+    nc._mod, nc._tried = None, True
+    try:
+        return decode_events(buf)
+    finally:
+        nc._mod, nc._tried = prev_mod, prev_tried
+
+
+def test_decode_differential():
+    buf = corpus()
+    got_c = mod.decode_events(buf)
+    got_py = _py_decode(buf)
+    assert len(got_c) == len(got_py)
+    for a, b in zip(got_c, got_py):
+        assert type(a.timestamp) is type(b.timestamp)
+        if isinstance(a.timestamp, EventTime):
+            assert (a.timestamp.sec, a.timestamp.nsec) == \
+                (b.timestamp.sec, b.timestamp.nsec)
+        else:
+            assert a.timestamp == b.timestamp
+        assert a.body == b.body
+        assert a.metadata == b.metadata
+        assert a.raw == b.raw
+
+
+def test_pack_differential():
+    rng = random.Random(5)
+    for i in range(200):
+        body = {"s": "x" * rng.randrange(0, 70000 if i == 0 else 400),
+                "i": rng.randrange(-2**40, 2**40), "n": None,
+                "lst": list(range(i % 20)), "big": 2**63 + i}
+        ts = rng.choice([EventTime(1, 2), float(i), i, True])
+        meta = {str(k): k for k in range(i % 20)}  # exercises map16
+        assert mod.pack_event(ts, meta, body) == \
+            packb([[ts, meta], body])
+
+
+def test_fallback_on_ext_types():
+    with pytest.raises(mod.FallbackError):
+        mod.pack_event(1.0, {}, {"x": ExtType(5, b"zz")})
+    # decode side: a non-EventTime ext in the stream
+    weird = packb([[1.0, {}], {"x": ExtType(9, b"abc")}])
+    with pytest.raises(mod.FallbackError):
+        mod.decode_events(weird)
+    # the public API falls back transparently
+    evs = decode_events(weird)
+    assert evs[0].body["x"] == ExtType(9, b"abc")
+
+
+def test_torn_tail_returns_decoded_prefix():
+    """Python-Unpacker parity: a truncated trailing record ends the
+    stream (the valid prefix is returned), it does not raise — a chunk
+    file torn by a crash mid-write must still flush its good records."""
+    good = encode_event({"log": "x"}, 1.0)
+    torn = good + encode_event({"log": "y"}, 2.0)[:-3]
+    evs = mod.decode_events(torn)
+    assert len(evs) == 1 and evs[0].body == {"log": "x"}
+    assert _py_decode(torn)[0].body == {"log": "x"}
+    assert mod.decode_events(good[:-2]) == []
+    assert mod.decode_events(b"\xd9") == []  # truncated str8 header
+    assert mod.decode_events(b"") == []
+    with pytest.raises(ValueError):
+        mod.decode_events(b"\xc1")  # reserved byte still raises
+
+
+def test_deep_nesting_raises_not_segfaults():
+    """A hostile deeply-nested buffer must raise, never overflow the C
+    stack (the pure-Python path dies with a recoverable RecursionError
+    at similar depth)."""
+    hostile = b"\x91" * 2_000_000 + b"\x90"
+    with pytest.raises(ValueError, match="nesting"):
+        mod.decode_events(hostile)
+    # pack side: self-referencing depth is impossible for msgpack data,
+    # but a 10k-deep list must raise rather than smash the stack
+    deep = []
+    cur = deep
+    for _ in range(10000):
+        nxt = []
+        cur.append(nxt)
+        cur = nxt
+    with pytest.raises(ValueError, match="nesting"):
+        mod.pack_event(1.0, {}, {"d": deep})
+
+
+def test_unhashable_map_keys_degrade_to_repr():
+    raw = packb([[1.0, {}], {"k": 1}])
+    # hand-craft a map with an array key: fixmap1 { [1,2]: "v" }
+    crafted = packb([[1.0, {}], {}])[:-1] + b"\x81\x92\x01\x02\xa1v"
+    got_c = mod.decode_events(crafted)
+    got_py = _py_decode(crafted)
+    assert got_c[0].body == got_py[0].body == {"[1, 2]": "v"}
+    assert mod.decode_events(raw)[0].body == {"k": 1}
