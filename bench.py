@@ -296,6 +296,37 @@ def measure_secondary(seconds: float = 1.5) -> dict:
         lines += n
     out["rewrite_tag_lines_per_sec"] = round(
         lines / (time.perf_counter() - t0))
+
+    # BASELINE config 4 shape: log_to_metrics counter over matching
+    # records (the firehose → metrics stage, CPU path)
+    e3 = Engine()
+    lm = e3.filter("log_to_metrics")
+    lm.set("regex", "log ERROR")
+    lm.set("metric_mode", "counter")
+    lm.set("metric_name", "errors")
+    lm.set("metric_description", "bench")
+    lm.set("tag", "metrics")
+    ins3 = e3.input("dummy")
+    for x in e3.inputs + e3.filters:
+        x.configure()
+        x.plugin.init(x, e3)
+    lm_buf = b"".join(
+        encode_event({"log": rng.choice(
+            ["ERROR boom", "info ok", "WARN hm", "ERROR again"])
+            + f" {i}"}, float(i))
+        for i in range(n))
+    lm_emitter = getattr(e3.filters[0].plugin, "emitter", None)
+    e3.input_log_append(ins3, "b", lm_buf)
+    t0 = time.perf_counter()
+    lines = 0
+    while time.perf_counter() - t0 < seconds:
+        e3.input_log_append(ins3, "b", lm_buf)
+        ins3.pool.drain()
+        if lm_emitter is not None:
+            lm_emitter.instance.pool.drain()
+        lines += n
+    out["log_to_metrics_lines_per_sec"] = round(
+        lines / (time.perf_counter() - t0))
     return out
 
 

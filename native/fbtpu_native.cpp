@@ -445,6 +445,96 @@ long long fbtpu_stage_field(const uint8_t *buf, long long buflen,
 
 namespace {
 
+// generic slice-parallel job: fn(ctx, slice_idx) for slices 1..n-1 on
+// pool workers, slice 0 on the caller's thread
+typedef void (*pool_fn)(const void *ctx, int slice);
+
+struct PoolJob {
+    pool_fn fn;
+    const void *ctx;
+    int n_slices;
+};
+
+struct WorkPool {
+    std::mutex m;
+    std::condition_variable cv_work;
+    std::condition_variable cv_done;
+    uint64_t gen = 0;
+    int remaining = 0;
+    int n_workers = 0;
+    PoolJob job{};
+
+    void worker(int idx) {
+        uint64_t seen = 0;
+        for (;;) {
+            PoolJob j;
+            {
+                std::unique_lock<std::mutex> lk(m);
+                cv_work.wait(lk, [&] { return gen != seen; });
+                seen = gen;
+                j = job;
+            }
+            // slice 0 runs on the caller's thread; workers take 1..n
+            if (idx + 1 < j.n_slices) j.fn(j.ctx, idx + 1);
+            {
+                std::lock_guard<std::mutex> lk(m);
+                if (--remaining == 0) cv_done.notify_one();
+            }
+        }
+    }
+
+    // start exactly once; pool size is fixed at first use (daemon
+    // threads, process lifetime — the .so is never unloaded)
+    void ensure(int want_workers) {
+        std::lock_guard<std::mutex> lk(m);
+        if (n_workers > 0) return;
+        n_workers = want_workers;
+        for (int i = 0; i < want_workers; i++)
+            std::thread([this, i] { worker(i); }).detach();
+    }
+
+    // serializes dispatch: threaded inputs may enter concurrently, and
+    // the pool's job/remaining slots are single-occupancy. Waiters
+    // queue here; each dispatch still fans out over every worker.
+    std::mutex run_m;
+
+    void run(pool_fn fn, const void *ctx, int n_slices) {
+        std::lock_guard<std::mutex> run_lk(run_m);
+        {
+            std::lock_guard<std::mutex> lk(m);
+            job = PoolJob{fn, ctx, n_slices};
+            remaining = n_workers;
+            gen++;
+        }
+        cv_work.notify_all();
+        fn(ctx, 0);
+        std::unique_lock<std::mutex> lk(m);
+        cv_done.wait(lk, [&] { return remaining == 0; });
+    }
+};
+
+// deliberately leaked: detached workers may be parked in cv_work.wait
+// at process exit, and destroying a condvar/mutex with waiters is UB —
+// a static instance's destructor would run exactly then
+WorkPool &g_pool = *new WorkPool;
+
+// FBTPU_DFA_THREADS: unset → all cores (capped 16); 0 or negative →
+// threading disabled (1). The ONE parser for every threaded path.
+int pool_threads_wanted() {
+    unsigned hw = std::thread::hardware_concurrency();
+    const char *env = getenv("FBTPU_DFA_THREADS");
+    long want;
+    if (env != nullptr) {
+        want = strtol(env, nullptr, 10);
+        if (want <= 0) return 1;
+    } else {
+        want = hw ? (long)hw : 1;
+    }
+    if (hw && want > (long)hw) want = hw;
+    if (want > 16) want = 16;
+    return (int)want;
+}
+
 struct StageJob {
     const uint8_t *buf;
     const uint8_t *end;
@@ -469,68 +559,9 @@ static void stage_run_slice(const StageJob &j, int sx) {
                                         nullptr);
 }
 
-struct StagePool {
-    std::mutex m;
-    std::condition_variable cv_work;
-    std::condition_variable cv_done;
-    uint64_t gen = 0;
-    int remaining = 0;
-    int n_workers = 0;
-    StageJob job{};
-
-    void worker(int idx) {
-        uint64_t seen = 0;
-        for (;;) {
-            StageJob j;
-            {
-                std::unique_lock<std::mutex> lk(m);
-                cv_work.wait(lk, [&] { return gen != seen; });
-                seen = gen;
-                j = job;
-            }
-            // slice 0 runs on the caller's thread; workers take 1..n
-            if (idx + 1 < j.n_slices) stage_run_slice(j, idx + 1);
-            {
-                std::lock_guard<std::mutex> lk(m);
-                if (--remaining == 0) cv_done.notify_one();
-            }
-        }
-    }
-
-    // start exactly once; pool size is fixed at first use (daemon
-    // threads, process lifetime — the .so is never unloaded)
-    void ensure(int want_workers) {
-        std::lock_guard<std::mutex> lk(m);
-        if (n_workers > 0) return;
-        n_workers = want_workers;
-        for (int i = 0; i < want_workers; i++)
-            std::thread([this, i] { worker(i); }).detach();
-    }
-
-    // serializes dispatch: threaded inputs may stage concurrently, and
-    // the pool's job/remaining slots are single-occupancy. Waiters
-    // queue here; each dispatch still fans out over every worker.
-    std::mutex run_m;
-
-    void run(const StageJob &j) {
-        std::lock_guard<std::mutex> run_lk(run_m);
-        {
-            std::lock_guard<std::mutex> lk(m);
-            job = j;
-            remaining = n_workers;
-            gen++;
-        }
-        cv_work.notify_all();
-        stage_run_slice(j, 0);
-        std::unique_lock<std::mutex> lk(m);
-        cv_done.wait(lk, [&] { return remaining == 0; });
-    }
-};
-
-// deliberately leaked: detached workers may be parked in cv_work.wait
-// at process exit, and destroying a condvar/mutex with waiters is UB —
-// a static instance's destructor would run exactly then
-StagePool &g_stage_pool = *new StagePool;
+static void stage_slice_adapter(const void *ctx, int sx) {
+    stage_run_slice(*(const StageJob *)ctx, sx);
+}
 
 }  // namespace
 
@@ -562,13 +593,17 @@ long long fbtpu_stage_field_mt(const uint8_t *buf, long long buflen,
         stage_run_slice(j, 0);
         return n;
     }
-    g_stage_pool.ensure(nthreads - 1);
-    int slices = g_stage_pool.n_workers + 1;
+    // pool is sized once to the machine-wide cap; each dispatch caps
+    // its own slice count (workers past n_slices no-op), so one
+    // caller's thread request never inflates another's
+    g_pool.ensure(pool_threads_wanted() - 1);
+    int slices = g_pool.n_workers + 1;
+    if (slices > nthreads) slices = nthreads;
     long long slice = (n + slices - 1) / slices;
     StageJob j{buf, buf + buflen, key, keylen, out, lengths,
                offsets, n, max_len, slice,
                (int)((n + slice - 1) / slice)};
-    g_stage_pool.run(j);
+    g_pool.run(stage_slice_adapter, &j, j.n_slices);
     return n;
 }
 
@@ -717,16 +752,7 @@ long long fbtpu_grep_match_v2(const uint8_t *buf, long long buflen,
                               kv + i, kl + i, nrows, out + i);
         }
     };
-    int nthreads = 1;
-    if (rec >= 4096) {
-        const char *env = getenv("FBTPU_DFA_THREADS");
-        long want = env ? strtol(env, nullptr, 10) : 4;
-        unsigned hw = std::thread::hardware_concurrency();
-        if (want < 1) want = 1;
-        if (hw && want > (long)hw) want = hw;
-        if (want > 16) want = 16;
-        nthreads = (int)want;
-    }
+    int nthreads = rec >= 4096 ? pool_threads_wanted() : 1;
     if (nthreads <= 1) {
         for (long long r = 0; r < n_rules; r++) sweep(r, 0, rec);
     } else {
@@ -1006,6 +1032,86 @@ static void dfa_prepass_block(const int16_t *transk, const int32_t *cmap,
         out[j] = (uint8_t)(s[j] == 1);
 }
 
+// slice-parallel jobs for the fused filter's phase 2 (records within
+// a rule are independent; mrow writes are disjoint per slice)
+struct GrepAccelJob {
+    const int16_t *bt;
+    const int32_t *cmap;
+    const uint32_t *accel;
+    const int16_t *transk;
+    const uint16_t *cmap2;
+    int32_t C;
+    int k;
+    int32_t Ck;
+    int32_t start;
+    const uint8_t *const *kv;
+    const uint32_t *kl;
+    uint8_t *mrow;
+    long long n_rec;
+    long long slice;
+    int n_slices;
+};
+
+static void grep_accel_slice(const void *ctx, int sx) {
+    const GrepAccelJob *j = (const GrepAccelJob *)ctx;
+    long long lo = (long long)sx * j->slice;
+    long long hi = lo + j->slice < j->n_rec ? lo + j->slice : j->n_rec;
+    for (long long i = lo; i < hi; i++)
+        j->mrow[i] = j->kv[i] != nullptr
+            ? dfa_accel_match(j->bt, j->cmap, j->C, j->start, j->accel,
+                              j->transk, j->cmap2, j->k, j->Ck,
+                              j->kv[i], j->kl[i])
+            : 0;
+}
+
+struct GrepBlockJob {
+    const int16_t *trans;
+    const int32_t *cmap;
+    const uint16_t *cmap2;
+    int32_t C;
+    int k;
+    int32_t Ck;
+    int32_t start;
+    long long max_vlen;
+    const uint8_t *const *kv;
+    const uint32_t *kl;
+    const int32_t *ord;
+    uint8_t *mrow;
+    long long n_rec;
+    long long slice;  // records per slice (multiple of FBTPU_PRE_LANES)
+    int n_slices;
+};
+
+static void grep_block_slice(const void *ctx, int sx) {
+    const GrepBlockJob *j = (const GrepBlockJob *)ctx;
+    // per-worker prepass scratch (grows to the chunk's longest value)
+    static thread_local uint16_t *syms = nullptr;
+    static thread_local long long syms_cap = 0;
+    long long need = FBTPU_PRE_LANES * (j->max_vlen / j->k + 2);
+    if (need > syms_cap) {
+        delete[] syms;
+        syms = new uint16_t[need];
+        syms_cap = need;
+    }
+    long long lo = (long long)sx * j->slice;
+    long long hi = lo + j->slice < j->n_rec ? lo + j->slice : j->n_rec;
+    const uint8_t *bv[FBTPU_PRE_LANES];
+    uint32_t bl[FBTPU_PRE_LANES];
+    uint8_t bm[FBTPU_PRE_LANES];
+    for (long long i = lo; i < hi; i += FBTPU_PRE_LANES) {
+        int nrows = (int)(hi - i < FBTPU_PRE_LANES
+                          ? hi - i : FBTPU_PRE_LANES);
+        for (int jj = 0; jj < nrows; jj++) {
+            bv[jj] = j->kv[j->ord[i + jj]];
+            bl[jj] = j->kl[j->ord[i + jj]];
+        }
+        dfa_prepass_block(j->trans, j->cmap, j->cmap2, j->C, j->k,
+                          j->Ck, j->start, bv, bl, nrows, bm, syms);
+        for (int jj = 0; jj < nrows; jj++)
+            j->mrow[j->ord[i + jj]] = bm[jj];
+    }
+}
+
 #define FBTPU_OP_LEGACY 0
 #define FBTPU_OP_AND 1
 #define FBTPU_OP_OR 2
@@ -1143,8 +1249,6 @@ long long fbtpu_grep_filter(const uint8_t *buf, long long buflen,
             if (vals[kx * max_records + i] != nullptr &&
                 (long long)vlens[kx * max_records + i] > max_vlen)
                 max_vlen = vlens[kx * max_records + i];
-    static thread_local uint16_t *syms = nullptr;
-    static thread_local long long syms_cap = 0;
     // length-sorted processing order (per key): blocks of 16 lanes pad
     // every lane to the block's longest value, so feeding blocks
     // length-homogeneous records removes the padding waste of mixed
@@ -1185,67 +1289,74 @@ long long fbtpu_grep_filter(const uint8_t *buf, long long buflen,
                 ord[starts_b[bucket(i)]++] = (int32_t)i;
         }
     }
-    for (long long r = 0; r < n_rules; r++) {
+    // records are independent within a rule, so each rule's matcher
+    // fans out over LANE-ALIGNED record slices on the worker pool when
+    // the host has cores to spend (the per-worker prepass scratch is
+    // thread_local inside the slice fns). A 1-core host keeps the
+    // single-slice path with zero dispatch overhead.
+    int p2_threads = n_rec >= 4096 ? pool_threads_wanted() : 1;
+    if (p2_threads > 1) g_pool.ensure(pool_threads_wanted() - 1);
+    for (long long r = 0; n_rec > 0 && r < n_rules; r++) {
         const int32_t *cmap = cmaps + r * 257;
         if (aoffs != nullptr && aoffs[r] >= 0) {
             // skip-friendly DFA: escape-byte hybrid matcher (memchr /
             // SIMD skips in self-loop states, composed 4-byte steps in
             // dense ones)
-            const uint32_t *accel = accel_cat + aoffs[r];
-            const int16_t *bt = btrans_cat + btroffs[r];
             int32_t enc_a = ncls[r];
-            int ka = enc_a / 1000 + 1;
-            int32_t Cb = enc_a % 1000;
-            int32_t Cka = 1;
-            for (int b = 0; b < ka; b++) Cka *= Cb;
-            const int16_t *transk_a = trans_cat + troffs[r];
-            const uint16_t *cmap2_a =
-                cm2offs[r] >= 0 ? cmap2_cat + cm2offs[r] : nullptr;
-            const uint8_t *const *kv = vals + key_of_rule[r] * max_records;
-            const uint32_t *kl = vlens + key_of_rule[r] * max_records;
-            uint8_t *mrow = match + r * max_records;
-            for (long long i = 0; i < n_rec; i++)
-                mrow[i] = kv[i] != nullptr
-                    ? dfa_accel_match(bt, cmap, Cb, starts[r], accel,
-                                      transk_a, cmap2_a, ka, Cka,
-                                      kv[i], kl[i])
-                    : 0;
+            GrepAccelJob aj;
+            aj.bt = btrans_cat + btroffs[r];
+            aj.cmap = cmap;
+            aj.accel = accel_cat + aoffs[r];
+            aj.transk = trans_cat + troffs[r];
+            aj.cmap2 = cm2offs[r] >= 0 ? cmap2_cat + cm2offs[r] : nullptr;
+            aj.C = enc_a % 1000;
+            aj.k = enc_a / 1000 + 1;
+            aj.Ck = 1;
+            for (int b = 0; b < aj.k; b++) aj.Ck *= aj.C;
+            aj.start = starts[r];
+            aj.kv = vals + key_of_rule[r] * max_records;
+            aj.kl = vlens + key_of_rule[r] * max_records;
+            aj.mrow = match + r * max_records;
+            aj.n_rec = n_rec;
+            int slices = p2_threads > 1 ? g_pool.n_workers + 1 : 1;
+            if (slices > p2_threads) slices = p2_threads;
+            aj.slice = (n_rec + slices - 1) / slices;
+            aj.n_slices = (int)((n_rec + aj.slice - 1) / aj.slice);
+            if (aj.n_slices > 1)
+                g_pool.run(grep_accel_slice, &aj, aj.n_slices);
+            else
+                grep_accel_slice(&aj, 0);
             continue;
         }
-        const int16_t *trans = trans_cat + troffs[r];
-        const uint16_t *cmap2 =
-            cm2offs[r] >= 0 ? cmap2_cat + cm2offs[r] : nullptr;
-        // ncls encodes C and the super-step k: C + 1000*(k-1)
         int32_t enc = ncls[r];
-        int k = enc / 1000 + 1;
-        int32_t C = enc % 1000;
-        int32_t Ck = 1;
-        for (int b = 0; b < k; b++) Ck *= C;
-        long long need = FBTPU_PRE_LANES * (max_vlen / k + 2);
-        if (need > syms_cap) {
-            delete[] syms;
-            syms = new uint16_t[need];
-            syms_cap = need;
-        }
-        const uint8_t *const *kv = vals + key_of_rule[r] * max_records;
-        const uint32_t *kl = vlens + key_of_rule[r] * max_records;
-        const int32_t *ord = order + key_of_rule[r] * n_rec;
-        uint8_t *mrow = match + r * max_records;
-        const uint8_t *bv[FBTPU_PRE_LANES];
-        uint32_t bl[FBTPU_PRE_LANES];
-        uint8_t bm[FBTPU_PRE_LANES];
-        for (long long i = 0; i < n_rec; i += FBTPU_PRE_LANES) {
-            int nrows = (int)(n_rec - i < FBTPU_PRE_LANES
-                              ? n_rec - i : FBTPU_PRE_LANES);
-            for (int j = 0; j < nrows; j++) {
-                bv[j] = kv[ord[i + j]];
-                bl[j] = kl[ord[i + j]];
-            }
-            dfa_prepass_block(trans, cmap, cmap2, C, k, Ck, starts[r],
-                              bv, bl, nrows, bm, syms);
-            for (int j = 0; j < nrows; j++)
-                mrow[ord[i + j]] = bm[j];
-        }
+        GrepBlockJob bj;
+        bj.trans = trans_cat + troffs[r];
+        bj.cmap = cmap;
+        bj.cmap2 = cm2offs[r] >= 0 ? cmap2_cat + cm2offs[r] : nullptr;
+        // ncls encodes C and the super-step k: C + 1000*(k-1)
+        bj.k = enc / 1000 + 1;
+        bj.C = enc % 1000;
+        bj.Ck = 1;
+        for (int b = 0; b < bj.k; b++) bj.Ck *= bj.C;
+        bj.start = starts[r];
+        bj.max_vlen = max_vlen;
+        bj.kv = vals + key_of_rule[r] * max_records;
+        bj.kl = vlens + key_of_rule[r] * max_records;
+        bj.ord = order + key_of_rule[r] * n_rec;
+        bj.mrow = match + r * max_records;
+        bj.n_rec = n_rec;
+        int slices = p2_threads > 1 ? g_pool.n_workers + 1 : 1;
+        if (slices > p2_threads) slices = p2_threads;
+        long long per = (n_rec + slices - 1) / slices;
+        // lane-aligned slices: blocks of FBTPU_PRE_LANES stay whole
+        per = ((per + FBTPU_PRE_LANES - 1) / FBTPU_PRE_LANES)
+              * FBTPU_PRE_LANES;
+        bj.slice = per;
+        bj.n_slices = (int)((n_rec + per - 1) / per);
+        if (bj.n_slices > 1)
+            g_pool.run(grep_block_slice, &bj, bj.n_slices);
+        else
+            grep_block_slice(&bj, 0);
     }
     // ---- phase 3: verdict + run-coalesced compaction ----
     long long n_keep = 0, w = 0, run_s = 0, run_e = 0;

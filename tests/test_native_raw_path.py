@@ -208,6 +208,18 @@ def test_fused_filter_odd_block_sizes(n):
         assert rx.match(ev.body["log"])
 
 
+def test_fused_filter_empty_buffer():
+    """Zero-record chunks must return (0, 0, input) — the slice-count
+    arithmetic once divided by zero here (SIGFPE)."""
+    from fluentbit_tpu.regex.dfa import compile_dfa
+
+    tables = native.GrepFilterTables(
+        [(b"log", compile_dfa("GET"), False)], "legacy")
+    got = native.grep_filter(b"", tables)
+    assert got is not None
+    assert got[0] == 0 and got[1] == 0
+
+
 def test_accel_engine_differential(monkeypatch):
     """The opt-in escape-byte hybrid matcher (FBTPU_ACCEL=1) must be
     verdict-identical to the default lockstep engine across corpora
