@@ -125,6 +125,19 @@ def decode_events(buf: bytes) -> List[LogEvent]:
 
 
 def iter_events(buf: bytes) -> Iterator[LogEvent]:
+    """Iterate the buffer's events. NOTE: with the native codec loaded
+    the whole buffer decodes eagerly before the first yield (chunks are
+    bounded at ~2MB, and every in-tree caller consumes fully) — only
+    the pure-Python fallback streams one record at a time."""
+    from . import _native_codec
+
+    mod = _native_codec.load()
+    if mod is not None:
+        try:
+            yield from mod.decode_events(buf)
+            return
+        except mod.FallbackError:
+            pass  # ExtType payload: the Python decoder handles it
     u = Unpacker(buf)
     pos = 0
     for obj in u:
