@@ -520,6 +520,8 @@ WorkPool &g_pool = *new WorkPool;
 
 // FBTPU_DFA_THREADS: unset → all cores (capped 16); 0 or negative →
 // threading disabled (1). The ONE parser for every threaded path.
+// FBTPU_THREADS_NO_HW_CAP lifts the core clamp so single-core CI can
+// still EXERCISE the pool dispatch paths (oversubscribed but correct).
 int pool_threads_wanted() {
     unsigned hw = std::thread::hardware_concurrency();
     const char *env = getenv("FBTPU_DFA_THREADS");
@@ -530,7 +532,9 @@ int pool_threads_wanted() {
     } else {
         want = hw ? (long)hw : 1;
     }
-    if (hw && want > (long)hw) want = hw;
+    if (hw && want > (long)hw
+            && getenv("FBTPU_THREADS_NO_HW_CAP") == nullptr)
+        want = hw;
     if (want > 16) want = 16;
     return (int)want;
 }
@@ -578,7 +582,9 @@ long long fbtpu_stage_field_mt(const uint8_t *buf, long long buflen,
                                long long max_records, long long max_len,
                                long long *offsets, int nthreads) {
     unsigned hw = std::thread::hardware_concurrency();
-    if (hw && nthreads > (int)hw) nthreads = (int)hw;
+    if (hw && nthreads > (int)hw
+            && getenv("FBTPU_THREADS_NO_HW_CAP") == nullptr)
+        nthreads = (int)hw;
     if (nthreads > 16) nthreads = 16;
     if (nthreads < 2)
         // single-core host: the fused one-walk serial path beats the

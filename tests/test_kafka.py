@@ -630,3 +630,71 @@ def test_in_kafka_clean_stop_sends_leave_group():
         time.sleep(0.2)
     assert broker.left, "LeaveGroup not received on clean stop"
     broker.close()
+
+
+def test_in_kafka_oor_partitions_bypass_offset_fetch():
+    """OFFSET_OUT_OF_RANGE re-resolution: partitions whose COMMITTED
+    offset was trimmed must resolve via ListOffsets, never OffsetFetch
+    (the committed offset would be handed back forever — the round-3
+    livelock)."""
+    import asyncio
+
+    from fluentbit_tpu.core.plugin import registry
+    from fluentbit_tpu.utils import kafka_protocol as kp
+
+    ins = registry.create_input("kafka")
+    ins.set("brokers", "127.0.0.1:19092")
+    ins.set("topics", "t")
+    ins.set("group_id", "g")
+    ins.configure()
+    ins.plugin.init(ins, None)
+    p = ins.plugin
+    p._assignment = {"t": [0, 1]}
+    p._coordinator = ("127.0.0.1", 19092)
+    p._oor = {("t", 0)}  # partition 0's committed offset was trimmed
+    calls = []
+
+    async def fake_rpc_to(addr, api, ver, payload):
+        calls.append(("to", api))
+        assert api == kp.API_OFFSET_FETCH
+        return _offset_fetch(1, 77)  # committed offset ONLY for part 1
+
+    async def fake_rpc(api, ver, payload):
+        calls.append(("rpc", api))
+        assert api == kp.API_LIST_OFFSETS
+        return _list_offsets("t", 0, 1000)
+
+    def _offset_fetch(pid, off):
+        # [throttle? v1: [topics]] — build via the protocol helpers'
+        # inverse: craft the response the parser expects
+        import struct
+
+        def s(x):
+            b = x.encode()
+            return struct.pack(">h", len(b)) + b
+
+        return (struct.pack(">i", 1) + s("t") + struct.pack(">i", 1)
+                + struct.pack(">iq", pid, off) + s("") +
+                struct.pack(">h", 0))
+
+    def _list_offsets(topic, pid, off):
+        import struct
+
+        def s(x):
+            b = x.encode()
+            return struct.pack(">h", len(b)) + b
+
+        # v1: [topics: name [partitions: pid err ts offset]]
+        return (struct.pack(">i", 1) + s(topic) + struct.pack(">i", 1)
+                + struct.pack(">ihqq", pid, 0, -1, off))
+
+    p._rpc_to = fake_rpc_to
+    p._rpc = fake_rpc
+    asyncio.run(p._resolve_group_offsets())
+    # partition 0 resolved via ListOffsets, partition 1 via OffsetFetch
+    assert p._offsets[("t", 0)] == 1000
+    assert p._offsets[("t", 1)] == 77
+    assert ("rpc", kp.API_LIST_OFFSETS) in calls
+    # the OOR partition is cleared and queued for a prompt commit
+    assert ("t", 0) not in p._oor
+    assert p._uncommitted

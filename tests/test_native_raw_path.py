@@ -355,3 +355,58 @@ def test_native_grep_match_differential():
             v = body.get(k)
             exp = rx.match(v) if isinstance(v, str) else False
             assert bool(mask[r, i]) == bool(exp), (r, i, body)
+
+
+def test_pool_dispatch_paths_exercised(monkeypatch):
+    """The worker-pool fan-out (staging MT + fused-filter phase 2) is
+    normally clamped to host cores and would first run IN PRODUCTION on
+    a multicore box; FBTPU_THREADS_NO_HW_CAP lifts the clamp so this
+    box exercises the dispatch/slice machinery and verifies results are
+    identical to the serial path."""
+    from fluentbit_tpu.regex import FlbRegex
+    from fluentbit_tpu.regex.dfa import compile_dfa
+
+    monkeypatch.setenv("FBTPU_THREADS_NO_HW_CAP", "1")
+    monkeypatch.setenv("FBTPU_DFA_THREADS", "4")
+    # staging reads its thread count in PYTHON (_stage_threads, cached
+    # per process) — set + uncache it so the MT entry point really
+    # dispatches on this box instead of the nthreads<2 serial fallback
+    monkeypatch.setenv("FBTPU_STAGE_THREADS", "4")
+    monkeypatch.setattr(native, "_stage_threads_cached", None)
+    # the DFA thread count IS read inside the C call per invocation;
+    # build a >=4096-record chunk so phase 2 engages the pool
+    rng = random.Random(42)
+    buf = bytearray()
+    bodies = []
+    for i in range(5000):
+        roll = rng.random()
+        if roll < 0.1:
+            body = {}
+        elif roll < 0.2:
+            body = {"log": i}
+        else:
+            body = {"log": f"{rng.choice(['GET', 'POST'])} /p{i} "
+                           f"{rng.choice(['200', '500'])}"}
+        bodies.append(body)
+        buf += encode_event(body, float(i))
+    raw = bytes(buf)
+    tables = native.GrepFilterTables(
+        [(b"log", compile_dfa("GET"), False),
+         (b"log", compile_dfa("500$"), True)], "legacy")
+    rx = FlbRegex("GET")
+    got = native.grep_filter(raw, tables)
+    assert got is not None
+    expect = sum(1 for b in bodies
+                 if isinstance(b.get("log"), str) and rx.match(b["log"]))
+    assert got[0] == 5000 and got[1] == expect
+    # staging MT path: identical to the Python extraction
+    batch, lengths, offs, n = native.stage_field(raw, b"log", 128,
+                                                 n_hint=5000)
+    assert n == 5000
+    evs = decode_events(raw)
+    for i in (0, 1, 2499, 4998, 4999):
+        v = evs[i].body.get("log")
+        if isinstance(v, str):
+            assert bytes(batch[i][: lengths[i]]) == v.encode()
+        else:
+            assert lengths[i] == -1

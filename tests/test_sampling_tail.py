@@ -347,6 +347,58 @@ def test_engine_stop_drains_buffered_traces():
     assert sum(count_spans(pl) for pl in payloads) == 1
 
 
+def test_tail_sampling_wired_from_yaml(tmp_path):
+    """Full config-format path: YAML processors.traces unit with
+    sampling_settings + conditions reaches the processor (side attr,
+    raw config_map entries, condition build) and the pipeline samples
+    end-to-end."""
+    import fluentbit_tpu as flb
+    from fluentbit_tpu.config_format import (apply_to_context,
+                                             load_config_file)
+
+    conf = tmp_path / "tail.yaml"
+    conf.write_text("""
+service: {flush: 0.05, grace: 1}
+pipeline:
+  inputs:
+    - name: lib
+      tag: otel
+      processors:
+        traces:
+          - name: sampling
+            type: tail
+            sampling_settings:
+              decision_wait: 60s
+              max_traces: 500
+            conditions:
+              - type: status_code
+                status_codes: [ERROR]
+  outputs:
+    - name: "null"
+      match: "*"
+""")
+    ctx = flb.create()
+    apply_to_context(ctx, load_config_file(str(conf)), str(tmp_path))
+    ins = ctx.engine.inputs[0]
+    assert len(ins.processors) == 1
+    proc = ins.processors[0].plugin
+    assert proc.mode == "tail"
+    assert proc.decision_wait == 60.0
+    assert proc.max_traces == 500
+    assert len(proc.conditions) == 1
+    # drive spans through the engine append path
+    from fluentbit_tpu.codec.chunk import EVENT_TYPE_TRACES
+
+    err = payload_of(make_span(tid(1), sid(1), status=2))
+    ok = payload_of(make_span(tid(2), sid(2), status=1))
+    ctx.engine.input_event_append(ins, "otel", packb(err),
+                                  EVENT_TYPE_TRACES, n_records=1)
+    ctx.engine.input_event_append(ins, "otel", packb(ok),
+                                  EVENT_TYPE_TRACES, n_records=1)
+    assert proc.pending_traces() == 2
+    assert proc.flush_decided(ctx.engine, force=True) == 1  # ERROR only
+
+
 def test_tail_timer_fires_in_running_engine():
     """Full runtime: short decision window, engine running — spans are
     re-injected by the timer without any manual flush."""
