@@ -61,6 +61,38 @@ for n in (1, 2, 15, 16, 17, 100, 4097):
         native.count_records(cut)
         native.scan_offsets(cut)
 native.grep_filter(b"", tables)
+
+# --- codec extension (C parsing of untrusted bytes) ---
+import fluentbit_tpu.codec._native_codec as nc
+nc._SO = %(codec_so)r
+nc._mod, nc._tried = None, False
+mod = nc.load()
+assert mod is not None, "asan codec extension failed to load"
+from fluentbit_tpu.codec.msgpack import EventTime
+good = b"".join(
+    encode_event({"log": "x" * rng.randrange(0, 200), "n": i,
+                  "d": {"a": [1, "b"]}},
+                 EventTime(1700000000 + i, 5) if i %% 2 else float(i))
+    for i in range(200))
+evs = mod.decode_events(good)
+assert len(evs) == 200
+for _ in range(300):
+    mut = bytearray(good)
+    for _ in range(rng.randrange(1, 10)):
+        mut[rng.randrange(len(mut))] = rng.randrange(256)
+    cut = bytes(mut[: rng.randrange(1, len(mut) + 1)])
+    try:
+        mod.decode_events(cut)
+    except ValueError:
+        pass  # malformed is fine; faulting is not
+try:
+    mod.decode_events(b"\x91" * 100000 + b"\x90")  # depth bound
+except ValueError:
+    pass
+for _ in range(100):  # pack side round-trips
+    body = {"s": "y" * rng.randrange(300), "l": [1, {"k": (2, 3)}],
+            "b": bytes(range(rng.randrange(50)))}
+    mod.pack_event(EventTime(1, 2), {}, body)
 print("ASAN_DRIVER_OK")
 """
 
@@ -80,6 +112,18 @@ def test_native_data_plane_under_asan(tmp_path):
         capture_output=True, text=True, timeout=300)
     if build.returncode != 0:
         pytest.skip(f"asan build failed: {build.stderr[-400:]}")
+    import sysconfig
+
+    include = sysconfig.get_paths().get("include")
+    codec_so = str(tmp_path / "fbtpu_codec_asan.so")
+    cbuild = subprocess.run(
+        ["gcc", "-O1", "-g", "-fPIC", "-shared",
+         "-fsanitize=address,undefined", "-I", include or ".",
+         os.path.join(REPO, "native", "fbtpu_codec.c"),
+         "-o", codec_so],
+        capture_output=True, text=True, timeout=300)
+    if cbuild.returncode != 0:
+        pytest.skip(f"asan codec build failed: {cbuild.stderr[-400:]}")
     env = dict(os.environ)
     env.update({
         "LD_PRELOAD": libasan,
@@ -91,7 +135,8 @@ def test_native_data_plane_under_asan(tmp_path):
         "FBTPU_DFA_THREADS": "4",
     })
     proc = subprocess.run(
-        [sys.executable, "-c", DRIVER % {"repo": REPO, "so": so}],
+        [sys.executable, "-c",
+         DRIVER % {"repo": REPO, "so": so, "codec_so": codec_so}],
         capture_output=True, text=True, timeout=420, env=env)
     assert proc.returncode == 0, (
         f"sanitizer report (rc={proc.returncode}):\n"
