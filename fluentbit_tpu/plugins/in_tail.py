@@ -27,7 +27,7 @@ from __future__ import annotations
 import glob as _glob
 import logging
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry, parse_size
