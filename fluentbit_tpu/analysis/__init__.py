@@ -1,0 +1,183 @@
+"""fbtpu-lint — repo-native static analysis for the data plane.
+
+Round 4's heap overflow taught us that the bug classes this codebase
+actually ships are not caught by example-based tests: they live in the
+gaps *between* correct components — a guarded attribute touched off-lock
+by a new call path, an ``await`` slipped inside a ``threading`` lock, a
+host sync added to a traced kernel. This package makes those invariants
+machine-checked, the same way ``tests/test_asan_native.py`` made the
+memory-safety invariant repeatable.
+
+Three rule families (see ANALYSIS.md for the full contract):
+
+- **lock discipline** (`guarded-by`, `await-in-lock`): a declarative
+  guarded-by registry (`analysis.registry.GUARDS`) names, per module,
+  the attributes/globals whose access must hold a named lock; the
+  checker flags accesses outside a lexical ``with <lock>:`` scope, and
+  flags ``await`` while a ``threading`` lock is held inside async code.
+- **JAX kernel purity** (`jax-host-sync`, `jax-side-effect`,
+  `jax-retrace`): functions reachable from ``jit``/``pmap``/
+  ``shard_map``/``lax.scan``/``lax.fori_loop`` tracing must not host-sync
+  (``block_until_ready``, ``np.asarray``, ``float()``/``int()`` on traced
+  values), must not carry Python side effects, and must not branch on
+  shapes/data in Python (recompile storms / tracer errors).
+- **silent failures** (`swallowed-error`): ``except Exception: pass`` on
+  data-path modules hides real errors; narrow the type, count it in a
+  metric, or justify the swallow with an explicit suppression.
+
+Suppressions: a ``# fbtpu-lint: allow(<rule>[, <rule>...])`` comment on
+the flagged line (or the line above) silences that rule there. Every
+suppression must carry an inline justification.
+
+Run: ``python -m fluentbit_tpu.analysis [paths...]`` (exit 1 on
+findings); ``tests/test_lint.py`` gates the whole package tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "Module", "lint_source", "lint_path", "lint_paths",
+    "iter_py_files", "RULES", "rule_names",
+]
+
+_ALLOW_RE = re.compile(r"#\s*fbtpu-lint:\s*allow\(([^)]*)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """Parsed unit handed to every rule: AST + raw lines (for the
+    suppression comments ast discards) + the posix-ish path rules match
+    registry entries and data-path prefixes against."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+
+    def allowed(self, rule: str, line: int, extra_lines: Sequence[int] = ()) -> bool:
+        """True when an allow(<rule>) comment covers ``line`` (or the
+        line above it, or any of ``extra_lines`` — multi-line constructs
+        like except handlers accept the comment on their body too)."""
+        for ln in {line, line - 1, *extra_lines}:
+            if 1 <= ln <= len(self.lines):
+                m = _ALLOW_RE.search(self.lines[ln - 1])
+                if m:
+                    names = {p.strip() for p in m.group(1).split(",")}
+                    if rule in names or "*" in names:
+                        return True
+        return False
+
+
+class Rule:
+    """Base rule: subclasses set ``name`` and implement ``check``."""
+
+    name = ""
+    description = ""
+
+    def check(self, module: Module) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                extra_lines: Sequence[int] = ()) -> Optional[Finding]:
+        """Build a Finding unless a suppression comment covers it."""
+        line = getattr(node, "lineno", 1)
+        if module.allowed(self.name, line, extra_lines):
+            return None
+        return Finding(module.path, line, getattr(node, "col_offset", 0),
+                       self.name, message)
+
+
+def _build_rules(guards=None) -> List[Rule]:
+    from .locks import AwaitUnderLockRule, GuardedByRule
+    from .purity import JaxPurityRules
+    from .silent import SwallowedErrorRule
+
+    return [
+        GuardedByRule(guards),
+        AwaitUnderLockRule(),
+        JaxPurityRules(),
+        SwallowedErrorRule(),
+    ]
+
+
+#: Default rule set (module-level so ``--list-rules`` and tests share it).
+RULES: List[Rule] = _build_rules()
+
+
+def rule_names() -> List[str]:
+    names: List[str] = []
+    for r in RULES:
+        for n in ([r.name] if isinstance(r.name, str) else list(r.name)):
+            if n not in names:
+                names.append(n)
+    return names
+
+
+def lint_source(source: str, path: str, guards=None) -> List[Finding]:
+    """Lint one source string as if it lived at ``path`` (the test
+    fixture entry point — registry matching keys off the path)."""
+    module = Module(path, source)
+    rules = RULES if guards is None else _build_rules(guards)
+    out: List[Finding] = []
+    for rule in rules:
+        out.extend(rule.check(module))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def lint_path(path: str, guards=None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        source = fh.read()
+    try:
+        return lint_source(source, path, guards)
+    except SyntaxError as e:
+        return [Finding(path.replace(os.sep, "/"), e.lineno or 1, 0,
+                        "parse", f"syntax error: {e.msg}")]
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    """Expand paths to .py files. A path that is neither a directory
+    nor an existing .py file raises — a lint gate that silently lints
+    nothing on a typo'd/moved path would stay green forever."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(root, n))
+        elif p.endswith(".py") and os.path.isfile(p):
+            files.append(p)
+        else:
+            raise FileNotFoundError(
+                f"fbtpu-lint: not a directory or .py file: {p!r}")
+    return files
+
+
+def lint_paths(paths: Iterable[str], guards=None) -> List[Finding]:
+    out: List[Finding] = []
+    for f in iter_py_files(paths):
+        out.extend(lint_path(f, guards))
+    return out

@@ -1,0 +1,57 @@
+"""CLI: ``python -m fluentbit_tpu.analysis [paths...]``.
+
+Exit status 0 = clean, 1 = findings (or unparseable files). With no
+paths, lints the installed ``fluentbit_tpu`` package tree — the same
+invocation ``tests/test_lint.py`` gates every PR with.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import RULES, lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fluentbit_tpu.analysis",
+        description="fbtpu-lint: concurrency + JAX-purity + "
+                    "silent-failure analysis (see ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the "
+                         "fluentbit_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule set and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.name}: {r.description}")
+        return 0
+
+    paths = args.paths or [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    try:
+        findings = lint_paths(paths)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"fbtpu-lint: {n} finding{'s' if n != 1 else ''} in "
+              f"{', '.join(paths)}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""Lock-discipline rules.
+
+``guarded-by``: accesses to registry-listed attributes/globals must sit
+lexically inside ``with <lock>:`` for the registered lock name. The
+checker understands single-level aliasing (``lock = a.ingest_lock if p
+else self._ingest_lock`` followed by ``with lock:`` counts as holding
+both), exempts construction (``__init__``/``__new__`` for attributes,
+module top level for globals), and honors per-entry ``writes_only``.
+
+``await-in-lock``: an ``await`` while holding a ``threading`` lock
+parks the coroutine WITH the lock held — every other thread touching
+that lock (collector threads, output workers, library callers) then
+blocks for the full duration of the awaited I/O, and a second coroutine
+on the same loop acquiring the same lock deadlocks outright. Flags any
+``await`` lexically inside a synchronous ``with`` whose context
+expression names a lock (terminal name containing "lock");
+``async with`` (asyncio locks) is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from . import Finding, Module, Rule
+from .registry import GUARDS, GuardEntry
+
+__all__ = ["GuardedByRule", "AwaitUnderLockRule"]
+
+
+def _terminal_names(expr: ast.AST) -> Set[str]:
+    """Every bare Name id and Attribute terminal attr in ``expr``."""
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+class _GuardVisitor(ast.NodeVisitor):
+    def __init__(self, rule: "GuardedByRule", module: Module,
+                 entries: Sequence[GuardEntry]):
+        self.rule = rule
+        self.module = module
+        self.entries = entries
+        self.lock_names = {e.lock for e in entries}
+        #: attr name → entries guarding it (kind-separated)
+        self.attr_entries: Dict[str, List[GuardEntry]] = {}
+        self.global_entries: Dict[str, List[GuardEntry]] = {}
+        for e in entries:
+            table = (self.global_entries if e.kind == "global"
+                     else self.attr_entries)
+            for a in e.attrs:
+                table.setdefault(a, []).append(e)
+        self.held: List[Set[str]] = []
+        self.func_stack: List[str] = []
+        #: alias name → lock names it may carry
+        self.aliases: Dict[str, Set[str]] = {}
+        self.findings: List[Finding] = []
+
+    # -- helpers ------------------------------------------------------
+
+    def _held_names(self) -> Set[str]:
+        out: Set[str] = set()
+        for s in self.held:
+            out |= s
+        return out
+
+    def _lock_refs(self, expr: ast.AST) -> Set[str]:
+        names = _terminal_names(expr)
+        out = names & self.lock_names
+        for n in names:
+            out |= self.aliases.get(n, set())
+        return out
+
+    def _in_ctor(self) -> bool:
+        return bool(self.func_stack) and \
+            self.func_stack[-1] in ("__init__", "__new__")
+
+    def _report(self, node: ast.AST, entry: GuardEntry, what: str) -> None:
+        msg = (f"{what} must hold `{entry.lock}` "
+               f"(guarded-by registry: {entry.module})")
+        if entry.note:
+            msg += f" — {entry.note}"
+        f = self.rule.finding(self.module, node, msg)
+        if f is not None:
+            self.findings.append(f)
+
+    # -- traversal ----------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a def's body runs in its own context: a closure created inside
+        # `with lock:` executes later, when the lock is NOT held.
+        # Aliases are function-scoped (inherited by nested defs, never
+        # shared between siblings) — `lock = self._ingest_lock` in one
+        # function must not legitimize `with lock:` in another
+        saved_held, self.held = self.held, []
+        saved_aliases = self.aliases
+        self.aliases = {k: set(v) for k, v in saved_aliases.items()}
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.aliases = saved_aliases
+        self.held = saved_held
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # same deferral rule as nested defs: a lambda born under the
+        # lock runs later, without it
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: Set[str] = set()
+        for item in node.items:
+            acquired |= self._lock_refs(item.context_expr)
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held.append(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held.pop()
+
+    # async with = asyncio primitives; not a threading-lock scope
+    # (its body still gets visited for guarded accesses)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        refs = self._lock_refs(node.value)
+        if refs:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.aliases.setdefault(tgt.id, set()).update(refs)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        entries = self.attr_entries.get(node.attr)
+        if entries and not self._in_ctor():
+            held = self._held_names()
+            is_read = isinstance(node.ctx, ast.Load)
+            for e in entries:
+                if e.writes_only and is_read:
+                    continue
+                if e.lock not in held:
+                    verb = "read of" if is_read else "write to"
+                    self._report(node, e, f"{verb} `.{node.attr}`")
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        entries = self.global_entries.get(node.id)
+        # module top level (empty function stack) = import-time init
+        if entries and self.func_stack:
+            held = self._held_names()
+            is_read = isinstance(node.ctx, ast.Load)
+            for e in entries:
+                if e.writes_only and is_read:
+                    continue
+                if e.lock not in held:
+                    verb = "read of" if is_read else "write to"
+                    self._report(node, e, f"{verb} global `{node.id}`")
+        self.generic_visit(node)
+
+
+class GuardedByRule(Rule):
+    name = "guarded-by"
+    description = ("registry-listed shared state accessed outside its "
+                   "`with <lock>:` scope")
+
+    def __init__(self, guards: Optional[Sequence[GuardEntry]] = None):
+        self.guards = tuple(guards) if guards is not None else GUARDS
+
+    def check(self, module: Module) -> List[Finding]:
+        entries = [e for e in self.guards if module.path.endswith(e.module)]
+        if not entries:
+            return []
+        v = _GuardVisitor(self, module, entries)
+        v.visit(module.tree)
+        return v.findings
+
+
+class _AwaitVisitor(ast.NodeVisitor):
+    def __init__(self, rule: "AwaitUnderLockRule", module: Module):
+        self.rule = rule
+        self.module = module
+        self.held: List[str] = []
+        self.findings: List[Finding] = []
+
+    @staticmethod
+    def _lockish(expr: ast.AST) -> Optional[str]:
+        # the context expr's own terminal only: `with a.b.ingest_lock:`
+        # → "ingest_lock"; calls like `with open(lockfile):` don't count
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        else:
+            return None
+        return name if "lock" in name.lower() else None
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a nested def's body runs in its own (later) context
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            n = self._lockish(item.context_expr)
+            if n is not None:
+                acquired.append(n)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(acquired):]
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self.held:
+            f = self.rule.finding(
+                self.module, node,
+                f"`await` while holding threading lock "
+                f"`{self.held[-1]}` — the lock spans the suspension; "
+                f"move the await outside the `with`, or use an "
+                f"asyncio primitive")
+            if f is not None:
+                self.findings.append(f)
+        self.generic_visit(node)
+
+
+class AwaitUnderLockRule(Rule):
+    name = "await-in-lock"
+    description = "`await` inside a synchronous `with <threading lock>:`"
+
+    def check(self, module: Module) -> List[Finding]:
+        if "await" not in module.source:
+            return []
+        v = _AwaitVisitor(self, module)
+        v.visit(module.tree)
+        return v.findings

@@ -1,0 +1,110 @@
+"""The guarded-by registry — the declarative core of the lock rule.
+
+Each entry names, for ONE module, the attributes (``kind="attr"``:
+``obj.<name>`` accesses) or module globals (``kind="global"``: bare
+names under a module-level lock) whose access must lexically sit inside
+``with <lock>:``. The checker is intentionally name-based — matching the
+lock *object* would need points-to analysis; matching the lock *name*
+catches the real bug class (a new call path touching guarded state
+off-lock) at zero false-positive cost in a codebase where lock names are
+unique per module.
+
+``writes_only=True`` entries allow lock-free reads: these are the
+documented benign-staleness probes (``device.ready()``, the codec
+loader's double-checked fast path, the ``paused`` backpressure flag read
+by collectors) where a stale read is part of the design and only the
+check-then-act WRITE must serialize.
+
+Accesses inside ``__init__``/``__new__`` (attr kind) and at module top
+level (global kind) are exempt: construction precedes sharing.
+
+Adding state to a guarded structure? Extend the entry (or add one) in
+the same PR — the lint gate then enforces the discipline on every
+future caller.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+__all__ = ["GuardEntry", "GUARDS"]
+
+
+@dataclass(frozen=True)
+class GuardEntry:
+    #: module path suffix the entry applies to (posix separators)
+    module: str
+    #: lock name: the terminal attribute (``self._lock`` → ``_lock``) or
+    #: the bare global holding the lock
+    lock: str
+    #: guarded attribute / global names
+    attrs: Tuple[str, ...]
+    #: True → lock-free reads are a documented part of the design
+    writes_only: bool = False
+    #: "attr" = obj.<name> accesses; "global" = module-level bare names
+    kind: str = "attr"
+    #: why the entry exists (shown in findings)
+    note: str = ""
+
+
+GUARDS: Tuple[GuardEntry, ...] = (
+    # -- engine: the asyncio-loop / collector-thread / caller boundary --
+    GuardEntry(
+        "fluentbit_tpu/core/engine.py", "_ingest_lock",
+        ("_ingest_src", "_backlog", "_task_map"),
+        note="ingest path state: appends run on collector threads and "
+             "library callers while flush_all runs on the engine loop "
+             "(and flush_now on any thread)",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/engine.py", "_event_queue_lock",
+        ("_event_queue",),
+        note="priority bucket queue: enqueued from any thread, drained "
+             "on the engine loop",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/engine.py", "ingest_lock", ("pool",),
+        note="per-input chunk pool: parallel raw-path ingest appends "
+             "race flush_all's drain without the input's lock",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/engine.py", "ingest_lock", ("paused",),
+        writes_only=True,
+        note="backpressure flag: collectors read it lock-free (benign "
+             "staleness) but the check-then-act pause/resume flip must "
+             "not double-fire plugin callbacks",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/plugin.py", "ingest_lock", ("paused",),
+        writes_only=True,
+        note="same flag, defining module (InputInstance.set_paused)",
+    ),
+    # -- metrics: counters incremented from every thread family --
+    GuardEntry(
+        "fluentbit_tpu/core/metrics.py", "_lock",
+        ("_values", "_counts", "_sums", "_metrics"),
+        note="cmetrics state: ingest threads, the engine loop, output "
+             "workers and the admin server all touch the same registry",
+    ),
+    # -- native loaders: double-checked module singletons --
+    GuardEntry(
+        "fluentbit_tpu/codec/_native_codec.py", "_lock",
+        ("_mod", "_tried"), writes_only=True, kind="global",
+        note="codec loader: lock-free settled-state fast path is "
+             "documented; the build/load transition must serialize",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/native/__init__.py", "_lock",
+        ("_lib", "_tried"), writes_only=True, kind="global",
+        note="data-plane loader: same double-checked pattern",
+    ),
+    # -- device attach controller --
+    GuardEntry(
+        "fluentbit_tpu/ops/device.py", "_lock",
+        ("_state", "_error", "_attach_seconds", "_platform", "_thread"),
+        writes_only=True, kind="global",
+        note="attach state machine: ready()/failed()/status() are "
+             "lock-free probes by design; transitions serialize",
+    ),
+)

@@ -1,0 +1,81 @@
+"""Silent-failure rule.
+
+``swallowed-error``: a broad ``except Exception: pass`` (or bare
+``except:`` / ``except BaseException:``) on a data-path module turns
+every future bug at that site into silently dropped telemetry — the
+exact failure mode this pipeline exists to prevent. Narrow handlers
+(``except OSError: pass`` on a close path) are deliberate and stay
+legal; broad ones must either do something observable (log, metrics
+increment — any non-trivial body passes) or carry a justified
+``# fbtpu-lint: allow(swallowed-error)`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, Module, Rule
+
+__all__ = ["SwallowedErrorRule"]
+
+#: module path fragments that put a file on the data path
+DATA_PATH_PREFIXES = (
+    "fluentbit_tpu/core/",
+    "fluentbit_tpu/codec/",
+    "fluentbit_tpu/plugins/",
+    "fluentbit_tpu/ops/",
+    "fluentbit_tpu/native/",
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:  # bare except
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    return False
+
+
+def _is_trivial(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+class SwallowedErrorRule(Rule):
+    name = "swallowed-error"
+    description = ("broad `except ...: pass` on a data-path module — "
+                   "narrow the type, count it, or justify the swallow")
+
+    def check(self, module: Module) -> List[Finding]:
+        if not any(p in module.path for p in DATA_PATH_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type) or not _is_trivial(node.body):
+                continue
+            shown = (ast.unparse(node.type) if node.type is not None
+                     else "")
+            f = self.finding(
+                module, node,
+                f"broad `except {shown or 'bare'}: pass` swallows real "
+                f"errors on the data path — narrow the exception type, "
+                f"log it, or increment a metric",
+                extra_lines=tuple(s.lineno for s in node.body[:1]))
+            if f is not None:
+                out.append(f)
+        return out

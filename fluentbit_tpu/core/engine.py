@@ -366,7 +366,8 @@ class Engine:
             self.storage = Storage(self.service.storage_path,
                                    checksum=self.service.storage_checksum)
         if self.storage is not None:
-            self._backlog = self.storage.scan_backlog()
+            with self._ingest_lock:  # uniform discipline (fbtpu-lint)
+                self._backlog = self.storage.scan_backlog()
         # customs first (flb_custom_init_all, src/flb_engine.c:973):
         # they may create pipeline instances programmatically
         for ins in self.customs:
@@ -609,22 +610,21 @@ class Engine:
                     c.size, (ins.display_name,))
 
         # backpressure (mem_buf_limit, src/flb_input.c:157,740-746;
-        # storage.pause_on_chunks_overlimit, :169)
-        over = ins.storage_type != "memrb" and ((
-            ins.mem_buf_limit
-            and ins.pool.pending_bytes >= ins.mem_buf_limit
-        ) or (
-            getattr(ins, "pause_on_chunks_overlimit", False)
-            and ins.pool.pending_chunks
-            >= self.service.storage_max_chunks_up
-        ))
+        # storage.pause_on_chunks_overlimit, :169) — pool counters are
+        # snapshotted under the input's lock (parallel raw-path appends
+        # mutate them concurrently); the pause flip itself is atomic in
+        # set_paused
+        with ins.ingest_lock:
+            over = ins.storage_type != "memrb" and ((
+                ins.mem_buf_limit
+                and ins.pool.pending_bytes >= ins.mem_buf_limit
+            ) or (
+                getattr(ins, "pause_on_chunks_overlimit", False)
+                and ins.pool.pending_chunks
+                >= self.service.storage_max_chunks_up
+            ))
         if over:
-            if not ins.paused:
-                ins.paused = True
-                try:
-                    ins.plugin.pause()
-                except Exception:
-                    pass
+            ins.set_paused(True)
             return -1
 
         # ---- raw fast path (VERDICT r1: no decode-per-append) ----
@@ -992,20 +992,21 @@ class Engine:
                     ):
                         self.storage.finalize(chunk)
                     chunks.append((ins, chunk))
-                # resume paused inputs once the buffer drains
-                if ins.paused and (
-                    not ins.mem_buf_limit
-                    or ins.pool.pending_bytes < ins.mem_buf_limit
-                ) and (
-                    not getattr(ins, "pause_on_chunks_overlimit", False)
-                    or ins.pool.pending_chunks
-                    < self.service.storage_max_chunks_up
-                ):
-                    ins.paused = False
-                    try:
-                        ins.plugin.resume()
-                    except Exception:
-                        pass
+                # resume paused inputs once the buffer drains (pool
+                # counters read under the input's lock; flip is atomic)
+                if ins.paused:
+                    with ins.ingest_lock:
+                        drained_ok = (
+                            not ins.mem_buf_limit
+                            or ins.pool.pending_bytes < ins.mem_buf_limit
+                        ) and (
+                            not getattr(ins, "pause_on_chunks_overlimit",
+                                        False)
+                            or ins.pool.pending_chunks
+                            < self.service.storage_max_chunks_up
+                        )
+                    if drained_ok:
+                        ins.set_paused(False)
         for ci, (ins, chunk) in enumerate(chunks):
             if chunk.routes_mask:
                 # conditionally-split chunk: the ingest-time bitmask IS
@@ -1036,31 +1037,47 @@ class Engine:
             # bounded task id map (flb_task_map_get_task_id,
             # src/flb_task.c:542): when every slot is in use the chunk
             # stays in its pool and is re-dispatched next flush cycle —
-            # the reference's "task_id exhausted" stance
-            if len(self._task_map) >= self.service.task_map_size:
-                now = time.time()
-                if now - self._task_map_warned > 5.0:
-                    self._task_map_warned = now
-                    log.warning(
-                        "task map full (%d tasks in flight) — chunk "
-                        "dispatch paused until slots free",
-                        len(self._task_map))
-                # chunks were already drained from their pools: park
-                # them on the backlog so the next cycle re-dispatches
-                self._backlog.extend(c for _i, c in chunks[ci:])
+            # the reference's "task_id exhausted" stance. The map is
+            # mutated here (engine loop or flush_now's caller thread)
+            # and in _task_unref (loop callbacks, sync-fallback flush on
+            # any thread) — both hold the ingest lock.
+            task = None
+            with self._ingest_lock:
+                if len(self._task_map) >= self.service.task_map_size:
+                    now = time.time()
+                    if now - self._task_map_warned > 5.0:
+                        self._task_map_warned = now
+                        log.warning(
+                            "task map full (%d tasks in flight) — chunk "
+                            "dispatch paused until slots free",
+                            len(self._task_map))
+                    # chunks were already drained from their pools: park
+                    # them on the backlog so the next cycle re-dispatches
+                    self._backlog.extend(c for _i, c in chunks[ci:])
+                else:
+                    task = Task(chunk, routes)
+                    # fully referenced BEFORE the first spawn: a route
+                    # completing synchronously must not see users hit 0
+                    # (and free the slot / delete the chunk) while its
+                    # siblings are still being spawned
+                    task.users = len(routes)
+                    self._task_map[task.id] = task
+            if task is None:
                 break
-            task = Task(chunk, routes)
-            self._task_map[task.id] = task
             for out in routes:
-                task.users += 1
                 self._spawn_flush(task, out)
 
-    def _task_unref(self, task: Task) -> None:
+    def _task_unref(self, task: Task) -> bool:
         """flb_task_users_dec: the id-map slot frees when the last
-        route finishes (flb_task_destroy)."""
-        task.users -= 1
-        if task.users == 0:
-            self._task_map.pop(task.id, None)
+        route finishes (flb_task_destroy). Returns True when this was
+        the last reference (callers gate storage cleanup on it instead
+        of re-reading task.users unlocked)."""
+        with self._ingest_lock:
+            task.users -= 1
+            done = task.users == 0
+            if done:
+                self._task_map.pop(task.id, None)
+        return done
 
     def _enqueue_event(self, priority: int, fn) -> None:
         """Queue a ready callback through the 8-priority bucket queue
@@ -1269,8 +1286,7 @@ class Engine:
             self.m_out_proc_records.inc(chunk.records, (name,))
             self.m_out_proc_bytes.inc(chunk.size, (name,))
             self.m_latency.observe(time.time() - chunk.created, (name,))
-            self._task_unref(task)
-            if task.users == 0 and self.storage is not None:
+            if self._task_unref(task) and self.storage is not None:
                 self.storage.delete(chunk)  # every route delivered
             return None
         if result == FlushResult.RETRY:
@@ -1291,8 +1307,7 @@ class Engine:
                 self.storage.quarantine(chunk)
             except Exception:
                 log.exception("DLQ quarantine failed")
-        self._task_unref(task)
-        if task.users == 0 and self.storage is not None:
+        if self._task_unref(task) and self.storage is not None:
             self.storage.delete(chunk)  # dlq copy (if any) is separate
         return None
 

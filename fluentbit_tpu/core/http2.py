@@ -460,8 +460,8 @@ class Http2Client:
         try:
             self.writer.write(frame(GOAWAY, 0, 0, struct.pack("!II", 0, 0)))
             self.writer.close()
-        except Exception:
-            pass
+        except (OSError, RuntimeError):
+            pass  # peer gone / loop closed: nothing left to say goodbye to
 
 
 # ---------------------------------------------------------------- server

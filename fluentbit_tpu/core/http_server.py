@@ -28,6 +28,7 @@ import time
 from typing import Optional
 
 from ..plugins.net_http import http_response, read_http_request
+from .upstream import close_quietly
 
 log = logging.getLogger("flb.http_server")
 
@@ -76,10 +77,7 @@ class AdminServer:
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
+            close_quietly(writer)
 
     def _route(self, method: str, path: str, req_body: bytes = b""):
         e = self.engine

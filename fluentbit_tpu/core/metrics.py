@@ -68,7 +68,9 @@ class Counter(_Metric):
     add = inc
 
     def get(self, labels: Sequence[str] = ()) -> float:
-        return self._values.get(self._key(labels), 0.0)
+        k = self._key(labels)
+        with self._lock:
+            return self._values.get(k, 0.0)
 
 
 class Gauge(_Metric):
@@ -87,7 +89,9 @@ class Gauge(_Metric):
         self.inc(-value, labels)
 
     def get(self, labels: Sequence[str] = ()) -> float:
-        return self._values.get(self._key(labels), 0.0)
+        k = self._key(labels)
+        with self._lock:
+            return self._values.get(k, 0.0)
 
 
 class Histogram(_Metric):
@@ -129,30 +133,39 @@ class MetricsRegistry:
         with self._lock:
             self._metrics[metric.fqname] = metric
 
+    # get-or-create runs entirely under the registry lock (RLock — the
+    # metric constructor re-enters it via _add): two threads racing to
+    # create the same counter must get the SAME object, or one side's
+    # increments land on an orphan and vanish from exposition
+
     def counter(self, ns: str, subsystem: str, name: str, desc: str = "",
                 label_keys: Sequence[str] = ()) -> Counter:
         key = "_".join(p for p in (ns, subsystem, name) if p)
-        m = self._metrics.get(key)
-        if isinstance(m, Counter):
-            return m
-        return Counter(self, ns, subsystem, name, desc, label_keys)
+        with self._lock:
+            m = self._metrics.get(key)
+            if isinstance(m, Counter):
+                return m
+            return Counter(self, ns, subsystem, name, desc, label_keys)
 
     def gauge(self, ns: str, subsystem: str, name: str, desc: str = "",
               label_keys: Sequence[str] = ()) -> Gauge:
         key = "_".join(p for p in (ns, subsystem, name) if p)
-        m = self._metrics.get(key)
-        if isinstance(m, Gauge):
-            return m
-        return Gauge(self, ns, subsystem, name, desc, label_keys)
+        with self._lock:
+            m = self._metrics.get(key)
+            if isinstance(m, Gauge):
+                return m
+            return Gauge(self, ns, subsystem, name, desc, label_keys)
 
     def histogram(self, ns: str, subsystem: str, name: str, desc: str = "",
                   label_keys: Sequence[str] = (),
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
         key = "_".join(p for p in (ns, subsystem, name) if p)
-        m = self._metrics.get(key)
-        if isinstance(m, Histogram):
-            return m
-        return Histogram(self, ns, subsystem, name, desc, label_keys, buckets)
+        with self._lock:
+            m = self._metrics.get(key)
+            if isinstance(m, Histogram):
+                return m
+            return Histogram(self, ns, subsystem, name, desc, label_keys,
+                             buckets)
 
     def metrics(self) -> Iterable[_Metric]:
         with self._lock:

@@ -59,8 +59,8 @@ class OutputWorkerPool:
             # drain callbacks scheduled right before stop
             try:
                 loop.run_until_complete(asyncio.sleep(0))
-            except Exception:
-                pass
+            except RuntimeError:
+                pass  # loop already stopped/closed: nothing to drain
             exit_cb = getattr(self.plugin, "worker_exit", None)
             if exit_cb is not None:
                 try:

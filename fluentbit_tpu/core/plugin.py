@@ -184,6 +184,25 @@ class InputInstance(Instance):
         # global-lock paths nest it around their pool touches.
         self.ingest_lock = threading.RLock()
 
+    def set_paused(self, paused: bool) -> bool:
+        """Atomically flip the backpressure flag and fire the plugin's
+        cb_pause/cb_resume (src/flb_input.c:740-788). Ingest threads and
+        the engine loop both reach the check-then-act; without the lock
+        two appends crossing the limit double-fire pause() (fbtpu-lint
+        guarded-by: `paused`). Collectors still READ the flag lock-free
+        — transient staleness there only delays a collect tick."""
+        with self.ingest_lock:
+            if self.paused == paused:
+                return False
+            self.paused = paused
+            cb = self.plugin.pause if paused else self.plugin.resume
+            try:
+                cb()
+            except Exception:
+                log.exception("%s %s callback failed", self.display_name,
+                              "pause" if paused else "resume")
+        return True
+
     def configure(self) -> None:
         super().configure()
         # default tag = per-instance name (dummy.0, dummy.1, ...) so two

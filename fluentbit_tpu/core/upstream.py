@@ -26,6 +26,18 @@ _dns_cache: dict = {}
 _DNS_TTL = 30.0
 
 
+def close_quietly(writer) -> None:
+    """Best-effort transport teardown — THE close for every socket
+    error/exit path. ``StreamWriter.close()`` raises ``OSError`` on an
+    already-dead transport and ``RuntimeError`` on a closed owning
+    loop; both mean "nothing left to close". Anything else is a real
+    bug and propagates (fbtpu-lint swallowed-error stance)."""
+    try:
+        writer.close()
+    except (OSError, RuntimeError):
+        pass
+
+
 async def resolve(host: str, port: int) -> List[str]:
     """Every resolved address for host, in getaddrinfo preference order
     (literal addresses pass through as a single entry). Callers must
@@ -144,10 +156,7 @@ class Upstream:
         bucket.append((reader, writer, time.time(), use_count + 1))
 
     def _close(self, writer) -> None:
-        try:
-            writer.close()
-        except Exception:
-            pass
+        close_quietly(writer)
 
     def close(self) -> None:
         """May run on any thread (plugin exit): sockets parked on other

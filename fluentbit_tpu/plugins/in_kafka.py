@@ -403,8 +403,8 @@ class KafkaInput(InputPlugin):
                              kp.leave_group_request(self.group_id,
                                                     self._member_id)),
                 1.0)
-        except Exception:  # noqa: BLE001 — shutdown must not stall
-            pass
+        except Exception as e:  # noqa: BLE001 — shutdown must not stall
+            log.debug("leave_group at shutdown failed: %r", e)
 
     async def start_server(self, engine) -> None:
         try:

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
 from ..core.plugin import InputPlugin, registry
+from ..core.upstream import close_quietly
 
 log = logging.getLogger("flb.k8s_events")
 
@@ -155,10 +156,7 @@ class KubernetesEventsInput(InputPlugin):
             return None
         finally:
             if writer is not None:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+                close_quietly(writer)
 
     def _emit(self, engine, events: List[dict]) -> None:
         buf = bytearray()

@@ -19,6 +19,7 @@ from typing import Optional
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
 from ..core.plugin import InputPlugin, registry
+from ..core.upstream import close_quietly
 
 log = logging.getLogger("flb.in_mqtt")
 
@@ -130,10 +131,7 @@ class MqttInput(InputPlugin):
         except (OSError, ConnectionError):
             pass
         finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
+            close_quietly(writer)
 
     def _handle_publish(self, flags, payload, writer, engine) -> bool:
         qos = (flags >> 1) & 0x03

@@ -23,6 +23,7 @@ from ..core.plugin import (
     OutputPlugin,
     registry,
 )
+from ..core.upstream import close_quietly
 from .outputs_basic import format_json_lines
 
 log = logging.getLogger("flb.misc")
@@ -97,10 +98,7 @@ class NatsOutput(OutputPlugin):
             await self._service_incoming()  # catch -ERR for this publish
         except (OSError, ConnectionError, asyncio.TimeoutError):
             if self._writer is not None:
-                try:
-                    self._writer.close()
-                except Exception:
-                    pass
+                close_quietly(self._writer)
             self._writer = None
             return FlushResult.RETRY
         return FlushResult.OK

@@ -30,6 +30,7 @@ from ..codec.events import encode_event
 from ..codec.msgpack import EventTime, OutOfData, Unpacker, packb
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from ..core.upstream import close_quietly
 
 log = logging.getLogger("flb.forward")
 
@@ -74,10 +75,7 @@ class ForwardInput(InputPlugin):
             except Exception:
                 log.exception("in_forward connection failed")
             finally:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+                close_quietly(writer)
 
         from ..core.tls import server_context
 

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Tuple
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from ..core.upstream import close_quietly
 from .outputs_basic import format_json_lines
 from .outputs_http_based import _HttpDeliveryOutput
 
@@ -217,10 +218,7 @@ class HttpServerInputBase(InputPlugin):
             except (ConnectionError, asyncio.IncompleteReadError):
                 pass
             finally:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+                close_quietly(writer)
 
         server = await asyncio.start_server(
             handle, self.listen, self.port,

@@ -40,6 +40,7 @@ from ..codec.telemetry import (any_value_to_py, decode_otlp_metrics,
                                kvlist_to_dict, py_to_any_value)
 from ..core.config import ConfigMapEntry
 from ..core.plugin import InputPlugin, registry
+from ..core.upstream import close_quietly
 
 log = logging.getLogger("flb.otlp")
 
@@ -219,10 +220,7 @@ class OpentelemetryInput(InputPlugin):
             except (ConnectionError, asyncio.IncompleteReadError):
                 pass
             finally:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+                close_quietly(writer)
 
         server = await asyncio.start_server(
             handle, self.listen, self.port,

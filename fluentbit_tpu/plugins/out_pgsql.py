@@ -20,6 +20,7 @@ from typing import List, Optional, Tuple
 from ..codec.events import decode_events
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FlushResult, OutputPlugin, registry
+from ..core.upstream import close_quietly
 from .outputs_http_based import _json_default
 
 log = logging.getLogger("flb.pgsql")
@@ -164,10 +165,7 @@ class PgsqlOutput(OutputPlugin):
             except (OSError, ConnectionError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError, struct.error):
                 if self._writer is not None:
-                    try:
-                        self._writer.close()
-                    except Exception:
-                        pass
+                    close_quietly(self._writer)
                 self._reader = self._writer = None
         return FlushResult.RETRY
 
@@ -176,6 +174,6 @@ class PgsqlOutput(OutputPlugin):
             try:
                 self._writer.write(_msg(b"X", b""))  # Terminate
                 self._writer.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # peer gone / loop closed at exit
             self._writer = None

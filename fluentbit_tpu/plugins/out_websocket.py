@@ -19,6 +19,7 @@ from typing import List, Optional
 
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FlushResult, OutputPlugin, registry
+from ..core.upstream import close_quietly
 from .outputs_basic import format_json_lines
 
 log = logging.getLogger("flb.websocket")
@@ -158,10 +159,7 @@ class WebsocketOutput(OutputPlugin):
             except (OSError, ConnectionError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError):
                 if self._writer is not None:
-                    try:
-                        self._writer.close()
-                    except Exception:
-                        pass
+                    close_quietly(self._writer)
                 self._reader = self._writer = None
         return FlushResult.RETRY
 
@@ -170,6 +168,6 @@ class WebsocketOutput(OutputPlugin):
             try:
                 self._writer.write(ws_frame(OP_CLOSE, b""))
                 self._writer.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # peer gone / loop closed at exit
             self._writer = None

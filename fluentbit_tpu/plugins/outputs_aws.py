@@ -26,6 +26,7 @@ from ..codec.events import decode_events
 from ..core.config import ConfigMapEntry
 from ..core.fstore import FStore
 from ..core.plugin import FlushResult, OutputPlugin, registry
+from ..core.upstream import close_quietly
 from ..utils import aws as _aws
 from .outputs_basic import format_json_lines
 from .outputs_http_based import _dumps
@@ -72,10 +73,7 @@ async def _http_request(ins, host: str, port: int, method: str, path: str,
         status = int(head.split(b" ", 2)[1])
         return status, head, resp_body
     finally:
-        try:
-            writer.close()
-        except Exception:
-            pass
+        close_quietly(writer)
 
 
 @registry.register

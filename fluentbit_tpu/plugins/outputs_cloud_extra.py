@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 from ..codec.events import decode_events
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from ..core.upstream import close_quietly
 from .outputs_cloud import _GoogleOutput
 from .outputs_http_based import _HttpDeliveryOutput, _dumps
 
@@ -127,10 +128,7 @@ class VivoExporterOutput(OutputPlugin):
             except (ConnectionError, asyncio.IncompleteReadError):
                 pass
             finally:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+                close_quietly(writer)
 
         server = await asyncio.start_server(handle, self.listen, self.port)
         self.bound_port = server.sockets[0].getsockname()[1]

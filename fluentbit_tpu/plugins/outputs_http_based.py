@@ -23,6 +23,7 @@ from ..codec.events import decode_events
 from ..codec.msgpack import EventTime
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FlushResult, OutputPlugin, registry
+from ..core.upstream import close_quietly
 from ..core.record_accessor import RecordAccessor
 
 
@@ -94,10 +95,7 @@ class _HttpDeliveryOutput(OutputPlugin):
             return FlushResult.RETRY
         finally:
             if writer is not None:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+                close_quietly(writer)
         if 200 <= status < 300 or status in ok_statuses:
             return FlushResult.OK
         if status >= 500 or status in (408, 429):
@@ -186,10 +184,7 @@ class _HttpDeliveryOutput(OutputPlugin):
             return FlushResult.RETRY
         finally:
             if writer is not None:
-                try:
-                    writer.close()
-                except Exception:
-                    pass
+                close_quietly(writer)
         if 200 <= status < 300:
             return FlushResult.OK
         if status >= 500 or status in (408, 429):

@@ -30,6 +30,7 @@ from ..core.plugin import (
     registry,
 )
 from ..core.record_accessor import Template
+from ..core.upstream import close_quietly
 
 log = logging.getLogger("flb")
 
@@ -74,7 +75,10 @@ class _PipelineLogHandler(logging.Handler):
                 "logger": record.name,
             })
         except Exception:  # pragma: no cover
-            pass
+            # stdlib Handler.emit contract: a logging sink must never
+            # raise into the logging caller (here: arbitrary __str__
+            # failures via record.getMessage())
+            pass  # fbtpu-lint: allow(swallowed-error)
 
 
 @registry.register
@@ -246,10 +250,7 @@ class SyslogOutput(OutputPlugin):
                 await asyncio.wait_for(self._writer.drain(), 30)
         except (OSError, asyncio.TimeoutError):
             if self._writer is not None:
-                try:
-                    self._writer.close()  # never leak the broken socket
-                except Exception:
-                    pass
+                close_quietly(self._writer)  # never leak the broken socket
             self._writer = None
             return FlushResult.RETRY
         return FlushResult.OK

@@ -32,3 +32,15 @@ except Exception:  # pragma: no cover - jax absent: ops tests skip themselves
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # markers registered here (no pytest.ini in this repo): both stay in
+    # the default tier-1 run; the names exist so CI lanes can select or
+    # shed them without editing the suite (-m sanitizer / -m 'not ...')
+    config.addinivalue_line(
+        "markers",
+        "sanitizer: subprocess ASan/TSan builds of the native data "
+        "plane (tests/test_asan_native.py, tests/test_tsan_native.py)")
+    config.addinivalue_line(
+        "markers", "slow: long-running; tier-1 runs -m 'not slow'")

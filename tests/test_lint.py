@@ -1,0 +1,366 @@
+"""fbtpu-lint: the analyzer gates the package tree, and the analyzer
+itself is pinned by fixtures — every rule must fire on a known-bad
+snippet, stay quiet on the known-good twin, and honor the
+``# fbtpu-lint: allow(...)`` suppression path.
+
+The fixture paths matter: guarded-by findings key off the registry's
+module paths, so the bad snippets are linted *as if* they lived in
+core/engine.py etc. — a deliberately-introduced guarded-attribute
+access, an await-under-lock, or a host-sync-in-traced-code would fail
+this file exactly like it fails `python -m fluentbit_tpu.analysis`.
+"""
+
+import os
+import subprocess
+import sys
+
+from fluentbit_tpu.analysis import lint_paths, lint_source
+from fluentbit_tpu.analysis.registry import GuardEntry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "fluentbit_tpu")
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------
+# the gate: the shipped tree must be clean
+# ---------------------------------------------------------------------
+
+def test_package_tree_clean():
+    findings = lint_paths([PKG])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis", PKG],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bad = tmp_path / "fluentbit_tpu" / "plugins"
+    bad.mkdir(parents=True)
+    (bad / "x.py").write_text(
+        "try:\n    f()\nexcept Exception:\n    pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis", str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    assert "swallowed-error" in proc.stdout
+
+
+def test_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    for name in ("guarded-by", "await-in-lock", "swallowed-error"):
+        assert name in proc.stdout
+
+
+# ---------------------------------------------------------------------
+# guarded-by (lock discipline)
+# ---------------------------------------------------------------------
+
+BAD_GUARDED = """
+class Engine:
+    def park(self, chunks):
+        self._backlog.extend(chunks)
+"""
+
+GOOD_GUARDED = """
+class Engine:
+    def park(self, chunks):
+        with self._ingest_lock:
+            self._backlog.extend(chunks)
+"""
+
+
+def test_guarded_attr_fires_off_lock():
+    got = lint_source(BAD_GUARDED, "fluentbit_tpu/core/engine.py")
+    assert rules(got) == ["guarded-by"]
+    assert "_ingest_lock" in got[0].message
+
+
+def test_guarded_attr_quiet_under_lock():
+    assert lint_source(GOOD_GUARDED, "fluentbit_tpu/core/engine.py") == []
+
+
+def test_guarded_attr_suppression():
+    src = BAD_GUARDED.replace(
+        "self._backlog.extend(chunks)",
+        "self._backlog.extend(chunks)  # fbtpu-lint: allow(guarded-by)")
+    assert lint_source(src, "fluentbit_tpu/core/engine.py") == []
+
+
+def test_guarded_attr_init_exempt_and_alias():
+    src = """
+class Engine:
+    def __init__(self):
+        self._backlog = []
+
+    def drain(self, ins, parallel):
+        lock = ins.ingest_lock if parallel else self._ingest_lock
+        with lock:
+            self._backlog.append(1)
+"""
+    assert lint_source(src, "fluentbit_tpu/core/engine.py") == []
+
+
+def test_guarded_closure_under_lock_still_flagged():
+    # a closure born inside the lock runs later, without it
+    src = """
+class Engine:
+    def sched(self):
+        with self._ingest_lock:
+            def later():
+                self._backlog.append(1)
+        return later
+"""
+    got = lint_source(src, "fluentbit_tpu/core/engine.py")
+    assert rules(got) == ["guarded-by"]
+
+
+def test_alias_is_function_scoped():
+    # an alias minted in one function must not legitimize `with lock:`
+    # in a sibling that bound the same NAME to a different lock
+    src = """
+class Engine:
+    def a(self):
+        lock = self._ingest_lock
+        with lock:
+            self._backlog.append(1)
+
+    def b(self):
+        lock = self._other_mutex
+        with lock:
+            self._task_map.clear()
+"""
+    got = lint_source(src, "fluentbit_tpu/core/engine.py")
+    assert rules(got) == ["guarded-by"]
+    assert len(got) == 1 and "_task_map" in got[0].message  # b() only
+
+
+def test_lambda_under_lock_still_flagged():
+    # a lambda born under the lock runs later, without it
+    src = """
+class Engine:
+    def sched(self):
+        with self._ingest_lock:
+            cb = lambda: self._task_map.pop(1, None)
+        return cb
+"""
+    got = lint_source(src, "fluentbit_tpu/core/engine.py")
+    assert rules(got) == ["guarded-by"]
+
+
+def test_cli_bad_path_fails_loudly():
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis",
+         "fluentbit_tpu/core/engine.pyy"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "not a directory or .py file" in proc.stderr
+
+
+def test_guarded_global_and_writes_only():
+    guards = (GuardEntry("mod.py", "_lock", ("_state",),
+                         writes_only=True, kind="global"),)
+    bad = "def f():\n    global _state\n    _state = 'x'\n"
+    good = ("import threading\n_lock = threading.Lock()\n_state = None\n"
+            "def probe():\n    return _state\n"
+            "def set_it(v):\n    global _state\n"
+            "    with _lock:\n        _state = v\n")
+    assert rules(lint_source(bad, "mod.py", guards)) == ["guarded-by"]
+    assert lint_source(good, "mod.py", guards) == []
+
+
+# ---------------------------------------------------------------------
+# await-in-lock
+# ---------------------------------------------------------------------
+
+BAD_AWAIT = """
+import asyncio
+class E:
+    async def main(self):
+        with self._ingest_lock:
+            await asyncio.sleep(0.1)
+"""
+
+GOOD_AWAIT = """
+import asyncio
+class E:
+    async def main(self):
+        with self._ingest_lock:
+            x = 1
+        await asyncio.sleep(0.1)
+        async with self._aio_lock:
+            await asyncio.sleep(0.1)
+"""
+
+
+def test_await_under_threading_lock_fires():
+    got = lint_source(BAD_AWAIT, "fluentbit_tpu/core/engine.py")
+    assert rules(got) == ["await-in-lock"]
+
+
+def test_await_outside_lock_and_async_with_quiet():
+    assert lint_source(GOOD_AWAIT, "fluentbit_tpu/core/engine.py") == []
+
+
+def test_await_in_nested_def_not_attributed_to_outer_lock():
+    src = """
+import asyncio
+class E:
+    def make(self):
+        with self._ingest_lock:
+            async def later():
+                await asyncio.sleep(0)
+        return later
+"""
+    assert lint_source(src, "fluentbit_tpu/core/engine.py") == []
+
+
+# ---------------------------------------------------------------------
+# jax purity / retrace
+# ---------------------------------------------------------------------
+
+BAD_HOST_SYNC = """
+import jax
+import numpy as np
+
+@jax.jit
+def kernel(batch):
+    host = np.asarray(batch)
+    return batch + host.sum()
+"""
+
+BAD_TRACED_CHAIN = """
+import jax
+from jax import lax
+
+class P:
+    def _materialize(self):
+        impl = self._assoc if self.kernel else self._scan
+        self._jit = jax.jit(impl)
+
+    def _scan(self, batch, lengths):
+        def step(s, c):
+            print("tracing")
+            return s, None
+        out, _ = lax.scan(step, batch, lengths)
+        return out.block_until_ready()
+
+    def _assoc(self, batch, lengths):
+        if batch.shape[0] > 128:
+            return batch
+        return lengths
+"""
+
+GOOD_KERNEL = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+@jax.jit
+def kernel(batch, lengths):
+    pad = jnp.arange(batch.shape[1]) >= lengths[:, None]
+    cls = jnp.where(pad, 0, batch)
+
+    def step(s, c):
+        return s + c.sum(), None
+
+    out, _ = lax.scan(step, jnp.zeros(()), cls.T)
+    return out
+
+
+def host_wrapper(batch, lengths):
+    import numpy as np
+    return np.asarray(kernel(batch, lengths))
+"""
+
+
+def test_host_sync_in_jitted_fn_fires():
+    got = lint_source(BAD_HOST_SYNC, "fluentbit_tpu/ops/fixture.py")
+    assert rules(got) == ["jax-host-sync"]
+
+
+def test_traced_chain_through_alias_scan_and_shape_branch():
+    got = lint_source(BAD_TRACED_CHAIN, "fluentbit_tpu/ops/fixture.py")
+    assert rules(got) == ["jax-host-sync", "jax-retrace",
+                          "jax-side-effect"]
+
+
+def test_pure_kernel_quiet_and_host_wrapper_untraced():
+    # np.asarray is fine OUTSIDE traced code (host_wrapper)
+    assert lint_source(GOOD_KERNEL, "fluentbit_tpu/ops/fixture.py") == []
+
+
+def test_purity_suppression():
+    src = BAD_HOST_SYNC.replace(
+        "host = np.asarray(batch)",
+        "host = np.asarray(batch)  # fbtpu-lint: allow(jax-host-sync)")
+    assert lint_source(src, "fluentbit_tpu/ops/fixture.py") == []
+
+
+# ---------------------------------------------------------------------
+# swallowed-error
+# ---------------------------------------------------------------------
+
+BAD_SWALLOW = """
+def flush(x):
+    try:
+        send(x)
+    except Exception:
+        pass
+"""
+
+
+def test_broad_swallow_fires_on_data_path():
+    got = lint_source(BAD_SWALLOW, "fluentbit_tpu/plugins/out_x.py")
+    assert rules(got) == ["swallowed-error"]
+
+
+def test_narrow_or_observable_handlers_quiet():
+    src = """
+def flush(x, m):
+    try:
+        send(x)
+    except OSError:
+        pass
+    try:
+        send(x)
+    except Exception:
+        m.inc(1)
+"""
+    assert lint_source(src, "fluentbit_tpu/plugins/out_x.py") == []
+
+
+def test_swallow_off_data_path_quiet():
+    assert lint_source(BAD_SWALLOW, "fluentbit_tpu/luart/interp.py") == []
+
+
+def test_swallow_suppression_on_pass_line():
+    src = BAD_SWALLOW.replace(
+        "        pass",
+        "        pass  # fbtpu-lint: allow(swallowed-error)")
+    assert lint_source(src, "fluentbit_tpu/plugins/out_x.py") == []
+
+
+def test_bare_and_tuple_broad_excepts_fire():
+    src = """
+def a(x):
+    try:
+        go(x)
+    except:
+        pass
+
+def b(x):
+    try:
+        go(x)
+    except (ValueError, Exception):
+        pass
+"""
+    got = lint_source(src, "fluentbit_tpu/core/x.py")
+    assert len(got) == 2 and rules(got) == ["swallowed-error"]

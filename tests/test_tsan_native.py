@@ -1,0 +1,125 @@
+"""ThreadSanitizer pass over the C++ data plane.
+
+The ASan precedent (tests/test_asan_native.py) made memory safety a
+repeatable suite check; this does the same for data races. The native
+worker pool (fbtpu_native.cpp WorkPool: condvar handoff, generation
+counter, slice fan-out) and the thread_local arenas are exactly the kind
+of code where a refactor ships a silent race — so build fbtpu_native
+with -fsanitize=thread, force the pool on (FBTPU_THREADS_NO_HW_CAP
+lifts the single-core clamp), and drive threaded staging + fused-filter
+pool dispatch + the scanner trio concurrently from several Python
+threads (ctypes releases the GIL, so the C side really runs in
+parallel). Any TSan report fails the run.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.sanitizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = r"""
+import threading
+import sys
+sys.path.insert(0, %(repo)r)
+import fluentbit_tpu.native as native
+native._SO = %(so)r
+native._tried = False
+native._lib = None
+import os
+os.environ.pop("FBTPU_NO_NATIVE", None)
+from fluentbit_tpu.codec.events import encode_event
+from fluentbit_tpu.regex.dfa import compile_dfa
+
+assert native.available(), "tsan .so failed to load"
+
+# >=4096 records so grep_filter's phase-2 fan-out and stage_field_mt
+# both take the pool path (their serial-small-batch cutoffs)
+N = 5000
+buf = bytearray()
+for i in range(N):
+    body = {"log": ("GET /x " if i %% 3 else "POST /y ") + "a" * (i %% 57)}
+    buf += encode_event(body, float(i))
+raw = bytes(buf)
+
+apache2 = (
+    r'^(?P<host>[^ ]*) [^ ]* [^ ]* \[[^\]]*\] "[^"]*" [^ ]* [^ ]*$'
+    .replace("?P<host>", "?<host>"))
+tables = native.GrepFilterTables(
+    [(b"log", compile_dfa("GET"), False),
+     (b"log", compile_dfa(apache2), True)], "legacy")
+
+THREADS = 4
+ITERS = 6
+start = threading.Barrier(THREADS)
+errors = []
+
+
+def worker(idx):
+    try:
+        start.wait(timeout=30)
+        for _ in range(ITERS):
+            got = native.grep_filter(raw, tables, n_hint=N)
+            assert got is not None and got[0] == N, got
+            st = native.stage_field(raw, b"log", 96, n_hint=N)
+            assert st is not None and st[3] == N, st
+            assert native.count_records(raw) == N
+            offs = native.scan_offsets(raw)
+            assert offs is not None and len(offs) == N + 1
+    except Exception as e:  # surface into the main thread's exit code
+        errors.append(repr(e))
+
+
+threads = [threading.Thread(target=worker, args=(i,))
+           for i in range(THREADS)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=300)
+assert not errors, errors
+assert not any(t.is_alive() for t in threads), "worker hung"
+print("TSAN_DRIVER_OK")
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="linux toolchain")
+def test_native_data_plane_under_tsan(tmp_path):
+    libtsan = subprocess.run(
+        ["g++", "-print-file-name=libtsan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libtsan or not os.path.exists(libtsan):
+        pytest.skip("libtsan unavailable")
+    so = str(tmp_path / "fbtpu_tsan.so")
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-fPIC", "-shared", "-std=c++17",
+         "-pthread", "-fsanitize=thread",
+         os.path.join(REPO, "native", "fbtpu_native.cpp"), "-o", so],
+        capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip(f"tsan build failed: {build.stderr[-400:]}")
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": libtsan,
+        # halt_on_error: the FIRST race report kills the driver (rc 99)
+        # instead of scrolling past; history_size up so both stacks of a
+        # report survive the ring buffer
+        "TSAN_OPTIONS": "halt_on_error=1 exitcode=99 history_size=4",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        # force the pool on even on single-core CI, and pin its width
+        "FBTPU_THREADS_NO_HW_CAP": "1",
+        "FBTPU_DFA_THREADS": "4",
+        "FBTPU_STAGE_THREADS": "4",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", DRIVER % {"repo": REPO, "so": so}],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, (
+        f"thread sanitizer report (rc={proc.returncode}):\n"
+        f"{proc.stdout[-1000:]}\n{proc.stderr[-3000:]}")
+    assert "TSAN_DRIVER_OK" in proc.stdout
+    assert "WARNING: ThreadSanitizer" not in proc.stderr
