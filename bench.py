@@ -228,9 +228,11 @@ def measure_multi_input(raw_chunks, n_inputs: int,
 
 
 def measure_secondary(seconds: float = 1.5) -> dict:
-    """BASELINE configs 2-3: NDJSON → filter_parser json, and an
-    8-rule filter_rewrite_tag chain — the non-grep filter stages'
-    single-core throughput."""
+    """BASELINE configs 2-4: NDJSON → filter_parser json, an 8-rule
+    filter_rewrite_tag chain, and a log_to_metrics counter — the
+    non-grep filter stages' single-core throughput, each with its
+    per-chunk p50 so the batched fast path shows up in the breakdown
+    (BENCH_r06 comparison point: only grep reported p50 before)."""
     import json as _json
     import random
 
@@ -239,6 +241,22 @@ def measure_secondary(seconds: float = 1.5) -> dict:
 
     rng = random.Random(7)
     n = 4096
+
+    def run_stage(fn, secs=seconds):
+        """Drive ``fn`` (one chunk append + drains) for ``secs``;
+        returns (lines_per_sec, p50_chunk_ms)."""
+        t_loop = time.perf_counter()
+        t_end = t_loop + secs
+        times = []
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t_loop
+        lps = round(len(times) * n / dt) if dt else 0
+        p50 = round(sorted(times)[len(times) // 2] * 1e3, 3) \
+            if times else None
+        return lps, p50
     json_buf = bytearray()
     for i in range(n):
         line = _json.dumps({"level": rng.choice(["info", "warn", "err"]),
@@ -258,14 +276,13 @@ def measure_secondary(seconds: float = 1.5) -> dict:
         x.plugin.init(x, e)
     e.input_log_append(ins, "b", json_buf)
     ins.pool.drain()
-    t0 = time.perf_counter()
-    lines = 0
-    while time.perf_counter() - t0 < seconds:
+
+    def parser_chunk():
         e.input_log_append(ins, "b", json_buf)
         ins.pool.drain()
-        lines += n
-    out["parser_json_lines_per_sec"] = round(
-        lines / (time.perf_counter() - t0))
+
+    (out["parser_json_lines_per_sec"],
+     out["parser_json_p50_chunk_ms"]) = run_stage(parser_chunk)
 
     e2 = Engine()
     rt = e2.filter("rewrite_tag")
@@ -284,18 +301,17 @@ def measure_secondary(seconds: float = 1.5) -> dict:
     e2.input_log_append(ins2, "b", rt_buf)
     ins2.pool.drain()
     emitter_ins.pool.drain()
-    t0 = time.perf_counter()
-    lines = 0
-    while time.perf_counter() - t0 < seconds:
+
+    def rt_chunk():
         e2.input_log_append(ins2, "b", rt_buf)
         ins2.pool.drain()
         # drain the emitter too: a saturated (never-drained) emitter
         # would flip every add_record into the backpressure-reject
         # path and measure the wrong regime
         emitter_ins.pool.drain()
-        lines += n
-    out["rewrite_tag_lines_per_sec"] = round(
-        lines / (time.perf_counter() - t0))
+
+    (out["rewrite_tag_lines_per_sec"],
+     out["rewrite_tag_p50_chunk_ms"]) = run_stage(rt_chunk)
 
     # BASELINE config 4 shape: log_to_metrics counter over matching
     # records (the firehose → metrics stage, CPU path)
@@ -317,16 +333,15 @@ def measure_secondary(seconds: float = 1.5) -> dict:
         for i in range(n))
     lm_emitter = getattr(e3.filters[0].plugin, "emitter", None)
     e3.input_log_append(ins3, "b", lm_buf)
-    t0 = time.perf_counter()
-    lines = 0
-    while time.perf_counter() - t0 < seconds:
+
+    def lm_chunk():
         e3.input_log_append(ins3, "b", lm_buf)
         ins3.pool.drain()
         if lm_emitter is not None:
             lm_emitter.instance.pool.drain()
-        lines += n
-    out["log_to_metrics_lines_per_sec"] = round(
-        lines / (time.perf_counter() - t0))
+
+    (out["log_to_metrics_lines_per_sec"],
+     out["log_to_metrics_p50_chunk_ms"]) = run_stage(lm_chunk)
     return out
 
 

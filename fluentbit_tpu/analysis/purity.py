@@ -30,6 +30,14 @@ Rules emitted:
   or references a traced parameter bare (tracer boolification —
   ``TracerBoolConversionError`` at run time).
 
+Batched filter entry points: defs named ``process_batch`` (the engine's
+whole-chunk filter hook) are additionally checked for the retrace
+hazard even though they are not traced themselves — a Python branch on
+an array ``.shape``/``.size``/``.ndim`` inside one re-specializes every
+kernel it feeds per distinct shape, which is exactly the compile-storm
+the traced rule exists for. Host syncs are legal there (it IS host
+code), so only the shape-branch rule applies.
+
 Shape-derived *locals* (``pad = G2 * m - Lk``) branching is deliberately
 NOT flagged: bucketed shapes make those branches trace-stable by design
 here, and chasing derivation would drown the signal in noise.
@@ -48,6 +56,10 @@ __all__ = ["JaxPurityRules"]
 _TRACERS = {"jit", "pmap", "vmap", "shard_map", "checkpoint", "remat",
             "scan", "fori_loop", "while_loop", "cond", "named_call",
             "custom_jvp", "custom_vjp"}
+
+#: batched filter entry points — shape-branch (retrace) checked even
+#: though untraced (see module docstring)
+_BATCH_ENTRIES = {"process_batch"}
 
 _NP_SYNCS = {"asarray", "array", "frombuffer", "copy"}
 _ATTR_SYNCS = {"block_until_ready", "item", "tolist", "device_get"}
@@ -79,7 +91,8 @@ class JaxPurityRules(Rule):
                    "jit- or scan-traced code")
 
     def check(self, module: Module) -> List[Finding]:
-        if "jax" not in module.source:
+        if "jax" not in module.source \
+                and not any(e in module.source for e in _BATCH_ENTRIES):
             return []
         tree = module.tree
 
@@ -144,6 +157,14 @@ class JaxPurityRules(Rule):
         for name in traced:
             for d in defs.get(name, ()):
                 findings.extend(self._check_traced(module, d))
+        # batched filter entry points: retrace (shape-branch) rule only
+        # — they are host code feeding jit'd kernels, so host syncs are
+        # fine but per-shape Python branches re-specialize downstream
+        for name in _BATCH_ENTRIES:
+            if name in traced:
+                continue  # already fully checked above
+            for d in defs.get(name, ()):
+                findings.extend(self._check_batch_entry(module, d))
         # a def can be reached under several names; dedup by location
         seen: Set[tuple] = set()
         out = []
@@ -153,6 +174,36 @@ class JaxPurityRules(Rule):
                 seen.add(key)
                 out.append(f)
         out.sort(key=lambda f: (f.line, f.col))
+        return out
+
+    def _check_batch_entry(self, module: Module, fn) -> List[Finding]:
+        """Retrace-only pass over a ``process_batch`` def: flag
+        ``if``/``while`` tests touching array ``.shape``/``.size``/
+        ``.ndim`` — each distinct shape re-specializes the kernels the
+        batch feeds (bucket shapes upstream: ops.batch.bucket_size)."""
+        out: List[Finding] = []
+        where = f"batched entry ({fn.name})"
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(child, (ast.If, ast.While)):
+                    for sub in ast.walk(child.test):
+                        if isinstance(sub, ast.Attribute) \
+                                and sub.attr in _SHAPE_ATTRS:
+                            self._emit(
+                                module, child, "jax-retrace",
+                                f"Python branch on `.{sub.attr}` in "
+                                f"{where}: re-specializes the "
+                                f"downstream kernel per distinct shape "
+                                f"— bucket shapes upstream "
+                                f"(ops.batch.bucket_size)", out)
+                            break
+                walk(child)
+
+        walk(fn)
         return out
 
     # -- per-function checks ------------------------------------------

@@ -1,0 +1,98 @@
+"""Batched filter dispatch support — the chunk view filters see on the
+raw fast path, plus the double-buffered staging pipeline.
+
+``RawChunk`` wraps one append's encoded bytes as they move through a
+chain of batch-capable filters (``FilterPlugin.process_batch``): the
+record count one stage discovers travels to the next as its walk hint
+(skipping the counting pre-pass), and ``src`` carries the appending
+input instance so filters with a hidden emitter (rewrite_tag) can
+recognise their own re-entered records without touching the
+engine-global ``_ingest_src`` (which the parallel raw path must not
+share across inputs).
+
+``double_buffered`` is the depth-2 dispatch pipeline of the engine's
+batched filter path: host msgpack extraction (staging) of segment N+1
+overlaps the in-flight device kernel of segment N, and each result is
+forced one segment behind its dispatch. On a real accelerator the
+overlap hides the host staging walk behind the DFA scan; on the CPU
+backend it degrades to the sequential order at no extra cost.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+__all__ = ["RawChunk", "double_buffered", "segment_bounds"]
+
+
+class RawChunk:
+    """One append's raw chunk bytes on the batched filter chain.
+
+    data    : bytes (memoryviews from a previous filter's arena are
+              materialized on first use)
+    tag     : the append's routing tag
+    n       : record count, or None until a stage discovers it
+    src     : the appending InputInstance (emitter re-entry guard)
+    engine  : the owning engine (metrics, emitter access)
+    """
+
+    __slots__ = ("data", "tag", "n", "src", "engine")
+
+    def __init__(self, data, tag: str, n: Optional[int] = None,
+                 src=None, engine=None):
+        self.data = data
+        self.tag = tag
+        self.n = n
+        self.src = src
+        self.engine = engine
+
+    def replace(self, data, n: Optional[int]) -> None:
+        """Swap in a filter's output (count may be unknown again)."""
+        self.data = data
+        self.n = n
+
+    def as_bytes(self) -> bytes:
+        """The chunk as ``bytes`` (ctypes-callable); materializes a
+        previous stage's arena view exactly once."""
+        if not isinstance(self.data, bytes):
+            self.data = bytes(self.data)
+        return self.data
+
+
+def segment_bounds(n: int, seg_records: int) -> List[tuple]:
+    """Split ``n`` records into [start, end) segments of at most
+    ``seg_records`` (the double-buffer grain)."""
+    if seg_records <= 0 or n <= seg_records:
+        return [(0, n)]
+    return [(s, min(s + seg_records, n))
+            for s in range(0, n, seg_records)]
+
+
+def double_buffered(stage_iter: Iterable[Any],
+                    dispatch: Callable[[Any], Any],
+                    collect: Optional[Callable[[Any], Any]] = None) -> List[Any]:
+    """Depth-2 staging/kernel pipeline.
+
+    ``stage_iter`` performs the host-side extraction work lazily (each
+    ``__next__`` stages one segment); ``dispatch`` launches the device
+    kernel for a staged segment and must return without forcing the
+    result (jax dispatch is asynchronous); ``collect`` forces a
+    dispatched result (default ``np.asarray``). The loop dispatches
+    segment i, stages segment i+1 while i's kernel is in flight, then
+    forces i — so host extraction and device execution overlap with at
+    most two segments alive.
+    """
+    import numpy as np
+
+    if collect is None:
+        collect = np.asarray
+    out: List[Any] = []
+    pending = None
+    for staged in stage_iter:
+        cur = dispatch(staged)
+        if pending is not None:
+            out.append(collect(pending))
+        pending = cur
+    if pending is not None:
+        out.append(collect(pending))
+    return out

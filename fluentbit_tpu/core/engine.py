@@ -656,6 +656,7 @@ class Engine:
             and self._trace_ctx(ins) is None
             and all(
                 getattr(f.plugin, "can_filter_raw", lambda: False)()
+                or f.plugin.can_process_batch()
                 for f in matching
             )
         )
@@ -818,21 +819,67 @@ class Engine:
         decode path (native unavailable / a filter declined)."""
         from ..codec import events as _events
 
+        from .chunk_batch import RawChunk
+
         in_bytes = len(data)
         # n may stay None until the FIRST raw filter discovers it (the
         # fused grep walk returns the record count as a third element),
         # skipping the counting pre-pass on the hot path entirely
         n = n_records
+        # one chunk view travels the whole chain: the record count one
+        # filter discovers is reused as the next one's n_hint
+        chunk = RawChunk(data, tag, n, src=ins, engine=self)
         deltas = []  # metric updates deferred until the chain commits:
-        for f in matching:  # a later decline re-runs the decode path,
-            prev = data     # which must not double-count earlier drops
+        committed = False  # True once a stateful hook's effects are out
+        for fi, f in enumerate(matching):
+            prev = data     # a later decline re-runs the decode path,
+            got = None      # which must not double-count earlier drops
+            plugin = f.plugin
             try:
-                got = f.plugin.filter_raw(data, tag, self, n_records=n)
+                if plugin.can_process_batch():
+                    if chunk.data is not data:
+                        chunk.replace(data, n)
+                    else:
+                        chunk.n = n
+                    if getattr(plugin, "stateful_batch", False):
+                        # marked BEFORE the call: a hook raising after
+                        # partial emits must not trigger a full decode
+                        # re-run (the tail continuation re-runs only
+                        # THIS filter onward — strictly fewer doubled
+                        # effects than restarting the chain; a clean
+                        # decline costs nothing extra since the tail
+                        # is bit-exact with the decode path)
+                        committed = True
+                    got = plugin.process_batch(chunk)
+                if got is None and getattr(
+                        plugin, "can_filter_raw", None) is not None \
+                        and plugin.can_filter_raw():
+                    got = plugin.filter_raw(data, tag, self, n_records=n)
             except Exception:
                 log.exception("filter %s raw path failed", f.display_name)
-                return None
+                got = None
             if got is None:
-                return None  # filter declined: decode path handles it
+                if not committed:
+                    return None  # pure prefix: decode path re-runs it
+                # an upstream stateful filter already emitted records /
+                # bumped metrics — re-running the whole chain on the
+                # decode path would double those side effects. Finish
+                # the REMAINING filters per-record on the current bytes
+                # instead (same code the decode path runs: bit-exact).
+                tail = self._raw_tail_decoded(data, tag, matching[fi:],
+                                              ins)
+                if tail is None:
+                    break  # undecodable mid-chain output: append as-is
+                n2, data, n_in = tail
+                if n_records is None and not deltas:
+                    # the first matching filter declined before any
+                    # count was discovered: the tail's decode IS the
+                    # append's input count (m_in_records accounting)
+                    n_records = n_in
+                # the tail's per-filter drop/add metrics were counted
+                # inside _run_filters — no deltas entry here
+                n = n2
+                break
             if len(got) == 3:
                 n2, data, n_in = got
                 if n is None:
@@ -842,7 +889,17 @@ class Engine:
                 if n is None:  # filter didn't count: count its input
                     n = _events.fast_count_records(prev)
                     if n is None:
-                        return None
+                        if not committed:
+                            return None
+                        # committed effects forbid a decode re-run and
+                        # the input count is unrecoverable: skip this
+                        # filter's drop/add delta (its output count n2
+                        # is still exact)
+                        log.warning(
+                            "filter %s output uncountable after a "
+                            "committed batch stage; its filter metrics "
+                            "delta is skipped", f.display_name)
+                        n = n2
             deltas.append((f.display_name, n, n2))
             n = n2
             if n == 0:
@@ -867,6 +924,41 @@ class Engine:
             if self.storage is not None and ins.storage_type == "filesystem":
                 self.storage.write_through(chunk, data)
         return n
+
+    def _raw_tail_decoded(self, data, tag: str, remaining, ins):
+        """Finish a raw chain per-record after a mid-chain decline once
+        an earlier stateful filter's side effects (emitter re-emits,
+        metric bumps) are already visible — re-running the whole chain
+        on the decode path would double them. Runs exactly the decode
+        path's filter code on the remaining filters only, with
+        ``_ingest_src`` pointing at the appending input so own-emitter
+        re-entry guards (rewrite_tag, multiline) fire exactly as they
+        do on the decode path. Returns (n_out, data_out, n_in) or None
+        when the current bytes do not decode (a filter contract
+        violation: the append then lands as-is rather than losing the
+        chunk)."""
+        try:
+            events = decode_events(bytes(data))
+        except Exception:
+            log.exception("raw-chain tail decode failed; remaining "
+                          "filters skipped for this append")
+            return None
+        n_in = len(events)
+        # stateful chains always run under the global ingest lock
+        # (stateful filters are never thread_safe_raw), so the RLock
+        # re-enters; the save/restore mirrors input_log_append's
+        with self._ingest_lock:
+            prev_src = self._ingest_src
+            self._ingest_src = ins
+            try:
+                events = self._run_filters(events, tag, None,
+                                           filters=remaining)
+            finally:
+                self._ingest_src = prev_src
+        out = bytearray()
+        for ev in events:
+            out += ev.raw if ev.raw is not None else reencode_event(ev)
+        return (len(events), bytes(out), n_in)
 
     def _run_log_processors(self, procs, events, tag: str):
         """Processor pipeline with per-unit conditions
@@ -932,11 +1024,15 @@ class Engine:
         return out, sum(count_spans(p) for p in Unpacker(out))
 
     def _run_filters(self, events: List[LogEvent], tag: str,
-                     trace_ctx: Optional[dict] = None) -> List[LogEvent]:
+                     trace_ctx: Optional[dict] = None,
+                     filters: Optional[List[FilterInstance]] = None
+                     ) -> List[LogEvent]:
         """flb_filter_do equivalent (src/flb_filter.c:119-330), with the
         chunk-trace per-filter stamps (flb_chunk_trace_filter hooks,
-        src/flb_filter.c:248,312) when a tap is active."""
-        for f in self.filters:
+        src/flb_filter.c:248,312) when a tap is active. ``filters``
+        restricts the pass to a sub-chain (the raw path's decoded-tail
+        continuation)."""
+        for f in (self.filters if filters is None else filters):
             if not events:
                 break
             if not f.route.matches(tag):

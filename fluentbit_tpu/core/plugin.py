@@ -93,10 +93,34 @@ class FilterPlugin(Plugin):
     with NOTOUCH). Byte-level identity for untouched records is preserved
     because events carry their raw spans (event.raw) and the chunk writer
     re-uses them verbatim.
+
+    Batched fast path: a filter may additionally advertise
+    ``can_process_batch()`` and implement ``process_batch(chunk)`` over a
+    :class:`~fluentbit_tpu.core.chunk_batch.RawChunk` — the engine then
+    routes whole appends through it on the raw ingest path (no Python
+    decode), exactly like filter_grep's ``filter_raw``. The hook returns
+    ``(n_records_out, data_out)`` or ``(n_out, data_out, n_in)`` (when
+    the batch pass discovered the input record count), or None to
+    decline — the engine then falls back to the bit-exact per-record
+    path, so exotic option combinations cost nothing but the fallback.
     """
+
+    #: True when the raw/batched path is pure (immutable config, no
+    #: cross-record state): the engine may then run the chain for
+    #: multiple inputs in parallel under per-input locks only
+    thread_safe_raw: bool = False
 
     def filter(self, events: list, tag: str, engine) -> tuple:
         return (FilterResult.NOTOUCH, events)
+
+    def can_process_batch(self) -> bool:
+        """True when ``process_batch`` can serve this instance's
+        configuration (checked per append; cheap)."""
+        return False
+
+    def process_batch(self, chunk) -> Optional[tuple]:
+        """Whole-chunk batched execution; None declines to per-record."""
+        return None
 
 
 class OutputPlugin(Plugin):

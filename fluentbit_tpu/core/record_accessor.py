@@ -159,6 +159,14 @@ class Template:
         if pos < len(text):
             self._parts.append(("lit", text[pos:]))
 
+    @property
+    def static_for_tag(self) -> bool:
+        """True when rendering depends on the tag alone (no record
+        fields, no regex captures) — the batched rewrite_tag path
+        renders such templates once per (rule, chunk) instead of once
+        per record."""
+        return all(k in ("lit", "tag", "tagpart") for k, _ in self._parts)
+
     def render(
         self,
         record: Optional[dict] = None,

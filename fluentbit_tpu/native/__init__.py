@@ -350,6 +350,26 @@ class GrepTables:
                           else np.zeros(1, dtype=np.uint32))
         self.aoffs = np.asarray(aoffs, dtype=np.int64)
 
+    def thread_copy(self) -> "GrepTables":
+        """A private copy of the packed arrays for one worker thread.
+
+        The tables are read-only so sharing is CORRECT — but with
+        several inputs ingesting concurrently every walker hammers the
+        same physical arrays, and on small hosts the shared hot lines
+        serialize in the cache hierarchy (BENCH_r05: inputs4 at 0.92×
+        of inputs1). Each ingest thread matching through its own copy
+        keeps the walk NUMA/cache-local; the copy is a few hundred KB,
+        made once per (thread, filter)."""
+        new = self.__class__.__new__(self.__class__)
+        slots = []
+        for klass in type(self).__mro__:
+            slots.extend(getattr(klass, "__slots__", ()))
+        for slot in slots:
+            v = getattr(self, slot)
+            setattr(new, slot,
+                    v.copy() if isinstance(v, np.ndarray) else v)
+        return new
+
 
 def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
                ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:

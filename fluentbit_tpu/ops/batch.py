@@ -68,9 +68,35 @@ def assemble(
     return Batch(batch, lengths, overflow, n)
 
 
-def bucket_size(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)) -> int:
-    """Round a batch size up to a small set of jit-stable shapes."""
+#: cap on bucket * max_len padding (bytes) — the top bucket (65536)
+#: times a long-syslog row width (64 KiB max_len) would allocate 4 GiB
+#: of mostly-pad staging per batch
+_PAD_BYTE_BUDGET = 256 * 1024 * 1024
+
+
+def bucket_size(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536),
+                max_len: Optional[int] = None,
+                byte_budget: int = _PAD_BYTE_BUDGET) -> int:
+    """Round a batch size up to a small set of jit-stable shapes.
+
+    ``max_len`` (the per-row byte width the caller will allocate)
+    clamps the rounding: the padded ``bucket * max_len`` staging matrix
+    must stay inside ``byte_budget``. The smallest bucket ≥ n is also
+    the cheapest one that fits n, so when IT overflows the budget no
+    bucket can serve — long-record configurations then take minimal
+    64-record-granularity padding instead of overflowing the pad
+    allocation (regression test: tests/test_batch_filters.py; the
+    shapes become chunk-size-dependent there, which is the acceptable
+    cost of not allocating gigabytes of pad)."""
+    pick = None
     for b in buckets:
         if n <= b:
-            return b
-    return ((n + buckets[-1] - 1) // buckets[-1]) * buckets[-1]
+            pick = b
+            break
+    if pick is None:
+        pick = ((n + buckets[-1] - 1) // buckets[-1]) * buckets[-1]
+    if max_len and pick * max_len > byte_budget:
+        # minimal jit-stable padding (the n records must stage
+        # regardless of what they cost)
+        pick = ((n + 63) // 64) * 64
+    return pick

@@ -8,15 +8,30 @@ DFA (fluentbit_tpu.regex.dfa) runs over a ``[B, L] uint8`` batch as a
     state[b] = trans[state[b], class(byte[b, t])]        t = 0..L
 
 - Multi-rule: R DFAs run in one kernel over ``[R, B, L]`` (each grep rule
-  may address a different record field, hence per-rule batches).
+  may address a different record field, hence per-rule batches). All R
+  transition tables are fused into ONE flat gather per scan step
+  (``trans_flat[R, max_flat]`` + per-rule radix), so the step cost does
+  not grow a kernel launch per rule.
 - k-byte super-steps: transition tables are pre-composed to ``C^k``
   columns (T2[s, c1*C+c2] = T[T[s,c1],c2]), cutting sequential scan steps
   by k at the cost of a larger (still VMEM-resident) table. k is chosen
   so the table stays under a size budget.
+- Multi-stride symbol packing: for even k the per-byte class gathers are
+  themselves fused two bytes at a time through a per-rule byte-PAIR
+  class table (``pair_maps[R, 65536] = class(b0)*C + class(b1)``),
+  halving the gather count of the super-symbol prepass — the same
+  pair-table trick the native twin uses (native/fbtpu_native.cpp
+  dfa_prepass_block).
 - Padding positions map to the EOL symbol class, which is absorbing after
   the first step — fixed shapes stay exact, no masking in the inner loop.
 - matched == (final_state == ACC): single comparison at scan end, no
   per-position accept reduction.
+- Kernel selection: ``kernel="auto"`` (default) picks scan vs assoc per
+  program shape at trace time — the sequential scan on host-CPU backends
+  (where the log2-depth compose tree's S× extra work is pure overhead:
+  BENCH_r05 measured it 300× slower there), the parallel-in-time assoc
+  kernel on real accelerators when the state count is small enough for
+  the extra parallel work to ride otherwise-idle vector lanes.
 
 This module works on any JAX backend (tests force a CPU mesh); on TPU the
 gathers vectorize across the batch dimension.
@@ -45,9 +60,15 @@ from ..regex.dfa import ACC, DFA, EOL
 _TABLE_BUDGET = 4 * 1024 * 1024
 
 
+#: byte-pair class tables cost R * 65536 * 4 bytes; skip beyond this
+_PAIR_MAP_MAX_RULES = 32
+
+
 def choose_k(n_states: int, n_classes: int, budget: int = _TABLE_BUDGET) -> int:
+    """Largest stride whose composed table fits the budget (strides up
+    to 6 — small alphabets with few states compose deep)."""
     k = 1
-    while k < 4:
+    while k < 6:
         cols = n_classes ** (k + 1)
         if n_states * cols * 4 > budget:
             break
@@ -81,12 +102,15 @@ class GrepProgram:
         # time function composition (segments scanned as transition
         # FUNCTIONS over all states, then a log2-depth tree of
         # compositions) — sequential depth m + log2(Lk/m) instead of
-        # Lk, trading S× more parallel work the TPU's lanes absorb
+        # Lk, trading S× more parallel work the TPU's lanes absorb;
+        # "auto" = resolved per program shape + attached platform at
+        # trace time (_resolve_kernel)
         import os as _os
         self.kernel = (kernel or
-                       _os.environ.get("FBTPU_GREP_KERNEL", "scan"))
-        if self.kernel not in ("scan", "assoc"):
+                       _os.environ.get("FBTPU_GREP_KERNEL", "auto"))
+        if self.kernel not in ("scan", "assoc", "auto"):
             raise ValueError(f"unknown grep kernel {self.kernel!r}")
+        self.kernel_resolved: Optional[str] = None
         self.segment = max(2, int(segment))
         R = len(self.dfas)
 
@@ -115,10 +139,39 @@ class GrepProgram:
             "starts": np.asarray([d.start for d in self.dfas],
                                  dtype=np.int32),
         }
+        # even strides classify through a byte-PAIR table: one gather
+        # yields class(b0)*C + class(b1), halving the symbol-prep
+        # gathers (the fused multi-stride packing)
+        if self.k % 2 == 0 and R <= _PAIR_MAP_MAX_RULES:
+            pair_maps = np.zeros((R, 65536), dtype=np.int32)
+            w = np.arange(65536, dtype=np.int64)
+            for r, d in enumerate(self.dfas):
+                cm = d.class_map[:256].astype(np.int64)
+                pair_maps[r] = (cm[w & 255] * d.n_classes
+                                + cm[w >> 8]).astype(np.int32)
+            self._np["pair_maps"] = pair_maps
+        else:
+            self._np["pair_maps"] = None
         self.max_states = max(d.n_states for d in self.dfas)
         self._jit = None
         self._mat_lock = threading.Lock()
         self._sharded_cache: dict = {}
+
+    def _resolve_kernel(self) -> str:
+        """Scan-vs-assoc per program shape, decided at trace time (the
+        attached platform is known by then). The scan kernel's Lk
+        serialized gathers are cheap on a host CPU where the assoc
+        tree's S× parallel work is pure overhead (BENCH_r05: 300×
+        slower there); assoc pays off only when idle vector lanes
+        absorb that work — a real accelerator and a small state count."""
+        if self.kernel != "auto":
+            return self.kernel
+        from . import device
+
+        plat = device.platform()
+        if plat in (None, "cpu"):
+            return "scan"
+        return "assoc" if self.max_states <= 64 else "scan"
 
     def _materialize(self) -> None:
         """Transfer tables to the attached backend + build the jit."""
@@ -132,7 +185,11 @@ class GrepProgram:
             self.class_maps = jnp.asarray(t["class_maps"])
             self.eol_cls = jnp.asarray(t["eol_cls"])
             self.starts = jnp.asarray(t["starts"])
-            impl = (self._match_assoc_impl if self.kernel == "assoc"
+            self.pair_maps = (jnp.asarray(t["pair_maps"])
+                              if t["pair_maps"] is not None else None)
+            self.kernel_resolved = self._resolve_kernel()
+            impl = (self._match_assoc_impl
+                    if self.kernel_resolved == "assoc"
                     else self._match_impl)
             self._impl = impl
             self._jit = jax.jit(impl)
@@ -157,6 +214,8 @@ class GrepProgram:
     def _super_symbols(self, batch: "jnp.ndarray",
                        lengths: "jnp.ndarray") -> "jnp.ndarray":
         """bytes → per-rule k-byte super-symbols: [R, B, Lk]."""
+        if self.pair_maps is not None:
+            return self._super_symbols_pairs(batch, lengths)
         R, B, L = batch.shape
         k = self.k
         # byte → class, per rule
@@ -176,6 +235,57 @@ class GrepProgram:
         comb = cls[..., 0]
         for j in range(1, k):
             comb = comb * self.C[:, None, None] + cls[..., j]
+        return comb
+
+    def _super_symbols_pairs(self, batch: "jnp.ndarray",
+                             lengths: "jnp.ndarray") -> "jnp.ndarray":
+        """Even-stride symbol packing through the byte-pair class
+        tables: one [R, 65536] gather per TWO bytes instead of one
+        class gather per byte, then k/2 pair-symbols combine at radix
+        C². Pad fix-up happens in pair space — fully-padded pairs
+        become the absorbing EOL pair, and the single possibly-mixed
+        pair at an odd length boundary is patched from the last valid
+        byte's class. Bit-identical to the per-byte path
+        (differentially tested in tests/test_ops_grep.py)."""
+        R, B, L = batch.shape
+        k = self.k
+        if L % 2:
+            batch = jnp.concatenate(
+                [batch, jnp.zeros((R, B, 1), dtype=batch.dtype)], axis=2)
+            L += 1
+        idx = (batch[..., 0::2].astype(jnp.int32)
+               + 256 * batch[..., 1::2].astype(jnp.int32))  # [R,B,L2]
+        pcls = jax.vmap(lambda pm, ix: pm[ix])(self.pair_maps, idx)
+        L2 = L // 2
+        t2 = jnp.arange(L2, dtype=jnp.int32) * 2
+        eol_pair = self.eol_cls * self.C + self.eol_cls  # [R]
+        # boundary pair (first byte valid, second padded):
+        # class(last byte) * C + eol — one [R, B] gather, broadcast
+        # into the single position it can occupy
+        last_idx = jnp.clip(lengths - 1, 0)[..., None]       # [R,B,1]
+        last_b = jnp.take_along_axis(batch, last_idx, axis=2)
+        last_cls = jax.vmap(lambda cm, bt: cm[bt])(self.class_maps,
+                                                   last_b)  # [R,B,1]
+        mixed = (last_cls * self.C[:, None, None]
+                 + self.eol_cls[:, None, None])
+        pcls = jnp.where(t2[None, None, :] + 1 == lengths[:, :, None],
+                         mixed, pcls)
+        pcls = jnp.where(t2[None, None, :] >= lengths[:, :, None],
+                         eol_pair[:, None, None], pcls)
+        # append EOL-pair block: >=1 full EOL super-symbol and rounds
+        # L2 to a multiple of k/2 (same arithmetic as the byte path —
+        # EOL is absorbing, extra tail symbols are no-ops)
+        k2 = k // 2
+        extra = (k2 - (L2 % k2)) % k2 + k2
+        pcls = jnp.concatenate(
+            [pcls, jnp.broadcast_to(eol_pair[:, None, None],
+                                    (R, B, extra))], axis=2)
+        Lk = pcls.shape[2] // k2
+        pcls = pcls.reshape(R, B, Lk, k2)
+        C2 = self.C * self.C
+        comb = pcls[..., 0]
+        for j in range(1, k2):
+            comb = comb * C2[:, None, None] + pcls[..., j]
         return comb
 
     def _match_impl(self, batch: "jnp.ndarray", lengths: "jnp.ndarray"):
@@ -262,9 +372,12 @@ class GrepProgram:
         # to the batch, mirroring _match_impl's state0 trick
         return (final + 0 * lengths == ACC) & (lengths >= 0)
 
-    def match(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-        """Run the kernel; returns bool [R, B] (numpy). Blocks up to the
-        attach-wait deadline if the backend isn't up yet."""
+    def dispatch(self, batch: np.ndarray, lengths: np.ndarray):
+        """Launch the kernel WITHOUT forcing the result (jax dispatch
+        is asynchronous) — the launch half of the double-buffered
+        staging pipeline (core.chunk_batch.double_buffered): the caller
+        stages the next segment while this one's kernel is in flight,
+        then forces with np.asarray one segment behind."""
         if self._jit is None:
             from . import device
 
@@ -273,8 +386,12 @@ class GrepProgram:
                     f"device backend not attached: {device.status()}"
                 )
             self._materialize()
-        out = self._jit(jnp.asarray(batch), jnp.asarray(lengths))
-        return np.asarray(out)
+        return self._jit(jnp.asarray(batch), jnp.asarray(lengths))
+
+    def match(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Run the kernel; returns bool [R, B] (numpy). Blocks up to the
+        attach-wait deadline if the backend isn't up yet."""
+        return np.asarray(self.dispatch(batch, lengths))
 
     # -- multi-device (SPMD over a 1-D device mesh) --
 

@@ -33,6 +33,63 @@ LEGACY, AND, OR = "legacy", "AND", "OR"
 _LEN_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
 
 
+class _RawDecline(Exception):
+    """Internal: a staging stage inside the pipelined raw path cannot
+    serve this chunk — unwind and decline to the decode path."""
+
+
+class ShardedTimings:
+    """Per-thread timing shards for the raw path's hot-loop accounting.
+
+    The previous shared dict + lock serialized every ingest worker on
+    one mutex several times per chunk (the BENCH_r05 multi-input
+    regression's lock half); adds now go to an uncontended thread-local
+    shard and reads sum across shards. The mapping interface
+    (iteration / item get / item set) keeps bench.py's reset-and-read
+    usage working: item reads return the cross-shard sum, item writes
+    are the RESET hook (bench zeroes between warmup and measurement)
+    and store the value into every shard — meaningful for zero only.
+    """
+
+    _KEYS = ("extract_s", "kernel_s", "compact_s", "records")
+
+    def __init__(self):
+        import threading
+
+        self._tls = threading.local()
+        self._shards: list = []
+        self._reg_lock = threading.Lock()  # shard registration (cold)
+
+    def _shard(self) -> dict:
+        d = getattr(self._tls, "d", None)
+        if d is None:
+            d = {k: 0 for k in self._KEYS}
+            with self._reg_lock:
+                self._shards.append(d)
+            self._tls.d = d
+        return d
+
+    def add(self, key: str, value) -> None:
+        self._shard()[key] += value
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __contains__(self, key) -> bool:
+        return key in self._KEYS
+
+    def __getitem__(self, key):
+        with self._reg_lock:
+            shards = list(self._shards)
+        return sum(d[key] for d in shards)
+
+    def __setitem__(self, key, value) -> None:
+        with self._reg_lock:
+            shards = list(self._shards)
+        for d in shards:
+            d[key] = value
+
+
 def _len_bucket(n: int, cap: int) -> int:
     """Round a max value length up to a small bucket set (jit-stable
     shapes) without exceeding the configured cap."""
@@ -84,6 +141,26 @@ def legacy_keep(rules, body: dict) -> bool:
         if not rule.is_exclude:
             return False
     return True
+
+
+def legacy_keep_mask(rules, mask: np.ndarray) -> np.ndarray:
+    """Vectorized ``legacy_keep`` over a per-rule match matrix
+    ``mask[R, B]`` → ``keep[B]`` (grep.c:167-194 first-rule-decides as
+    vector ops). Shared by filter_grep's device/native verdicts and
+    filter_log_to_metrics' batched pre-filter."""
+    B = mask.shape[1]
+    keep = np.ones(B, dtype=bool)
+    undecided = np.ones(B, dtype=bool)
+    for r, rule in enumerate(rules):
+        m = mask[r]
+        if rule.is_exclude:
+            keep &= ~(undecided & m)  # Exclude-hit → drop
+            undecided &= ~m
+        else:
+            # a Regex rule decides every still-undecided record
+            keep = np.where(undecided, m, keep)
+            break
+    return keep
 
 
 def parse_grep_rules(properties) -> List[Rule]:
@@ -153,9 +230,10 @@ class GrepFilter(FilterPlugin):
         self._program = None
         self._native_tables = None
         self._native_filter = None
-        self.raw_timings = {"extract_s": 0.0, "kernel_s": 0.0,
-                            "compact_s": 0.0, "records": 0}
-        self._tm_lock = threading.Lock()
+        self.raw_timings = ShardedTimings()
+        # per-worker copies of the read-only native tables (multi-input
+        # scaling: no cross-thread sharing of the hot arrays)
+        self._tls_tables = threading.local()
         if self.tpu_enable and self.rules and all(r.dfa is not None for r in self.rules):
             try:
                 from ..ops import device
@@ -218,20 +296,8 @@ class GrepFilter(FilterPlugin):
     def keep_mask(self, mask: np.ndarray) -> np.ndarray:
         """mask[R, B] per-rule match matrix → keep[B], same semantics as
         keep_record (grep.c verdict logic applied as vector ops)."""
-        B = mask.shape[1]
         if self.op == LEGACY:
-            keep = np.ones(B, dtype=bool)
-            undecided = np.ones(B, dtype=bool)
-            for r, rule in enumerate(self.rules):
-                m = mask[r]
-                if rule.is_exclude:
-                    keep &= ~(undecided & m)  # Exclude-hit → drop
-                    undecided &= ~m
-                else:
-                    # a Regex rule decides every still-undecided record
-                    keep = np.where(undecided, m, keep)
-                    break
-            return keep
+            return legacy_keep_mask(self.rules, mask)
         found = mask.any(axis=0) if self.op == OR else mask.all(axis=0)
         # AND/OR rules are all the same kind (enforced in init)
         return ~found if self.rules[0].is_exclude else found
@@ -248,8 +314,8 @@ class GrepFilter(FilterPlugin):
         by_path: dict = {}
         for r, rule in enumerate(self.rules):
             by_path.setdefault(rule.ra.pattern, (rule.ra, []))[1].append(r)
-        Bp = bucket_size(B)
         L = self.tpu_max_record_len
+        Bp = bucket_size(B, max_len=L)
         values: List[Optional[List[Optional[bytes]]]] = [None] * R
         batches = [None] * R
         for ra, idxs in by_path.values():
@@ -319,12 +385,10 @@ class GrepFilter(FilterPlugin):
 
         from .. import native
         from ..ops import device
-        from ..ops.batch import bucket_size
 
         if not native.available():
             return None
         tm = self.raw_timings
-        tm_lock = self._tm_lock
         # platform check FIRST: on a CPU-backend host try_ready() would
         # needlessly materialize the jax program that will never run
         use_native = self._native_tables is not None and (
@@ -337,90 +401,33 @@ class GrepFilter(FilterPlugin):
             # record count, so the triple return lets the engine skip
             # its counting pre-pass entirely.
             t0 = _time.perf_counter()
-            got = native.grep_filter(data, self._native_filter,
-                                     n_hint=n_records)
+            got = native.grep_filter(
+                data, self._local_tables("_native_filter"),
+                n_hint=n_records)
             if got is None:
                 return None
             n, n_keep, out = got
-            with tm_lock:
-                tm["kernel_s"] += _time.perf_counter() - t0
-                tm["records"] += n
+            tm.add("kernel_s", _time.perf_counter() - t0)
+            tm.add("records", n)
             return (n_keep, out, n)
         if use_native:
             t0 = _time.perf_counter()
             got = native.grep_match(
-                data, self._native_tables, n_hint=n_records
+                data, self._local_tables("_native_tables"),
+                n_hint=n_records
             )
             if got is None:
                 return None
             mask, offsets, n = got
-            with tm_lock:
-                tm["kernel_s"] += _time.perf_counter() - t0
+            tm.add("kernel_s", _time.perf_counter() - t0)
         else:
             if n_records is not None and n_records < self.tpu_batch_records:
                 return None  # small batches: decode path is cheaper
-            by_key: dict = {}
-            for r, rule in enumerate(self.rules):
-                by_key.setdefault(rule.ra.head.encode("utf-8"), []).append(r)
-            staged = {}
-            offsets = None
-            n = None
-            t0 = _time.perf_counter()
-            for key, idxs in by_key.items():
-                got = native.stage_field(
-                    data, key, self.tpu_max_record_len, None,
-                    n_hint=n_records
-                )
-                if got is None:
-                    return None
-                batch, lengths, offs, count = got
-                if n is None:
-                    n, offsets = count, offs
-                if len(by_key) > 1:
-                    # stage_field returns views of a per-thread arena
-                    # that the NEXT call overwrites — multi-key rule
-                    # sets must copy each key's staging out first
-                    batch, lengths = batch.copy(), lengths.copy()
-                staged[key] = (batch, lengths)
-            if n is None or n < self.tpu_batch_records:
-                return None  # small batches: decode path is cheaper
-            Bp = bucket_size(n)
-            R = len(self.rules)
-            # scan-length bucketing: the DFA scan is sequential in L, so
-            # clamp to the longest staged value (rounded to a small bucket
-            # set for jit shape stability) instead of always
-            # tpu_max_record_len
-            max_staged = max(
-                (int(ln.max()) if ln.size else 0)
-                for _, ln in staged.values()
-            )
-            L = _len_bucket(max(max_staged, 1), self.tpu_max_record_len)
-            batch = np.zeros((R, Bp, L), dtype=np.uint8)
-            lengths = np.full((R, Bp), -1, dtype=np.int32)
-            for key, idxs in by_key.items():
-                b, ln = staged[key]
-                for r in idxs:
-                    batch[r, :n] = b[:, :L]
-                    lengths[r, :n] = ln
-            with tm_lock:
-                tm["extract_s"] += _time.perf_counter() - t0
-            t0 = _time.perf_counter()
-            mask = np.array(self._program.match(batch, lengths)[:, :n])
-            with tm_lock:
-                tm["kernel_s"] += _time.perf_counter() - t0
-            # overflow rows (-2): decode just those records on the CPU
-            overflow_rows = np.unique(np.nonzero(lengths[:, :n] == -2)[1])
-            if overflow_rows.size:
-                from ..codec.events import decode_events
-
-                for b_idx in overflow_rows:
-                    span = bytes(data[offsets[b_idx]: offsets[b_idx + 1]])
-                    ev = decode_events(span)[0]
-                    for r, rule in enumerate(self.rules):
-                        if lengths[r, b_idx] == -2:
-                            mask[r, b_idx] = rule.match(ev.body)
-        with tm_lock:
-            tm["records"] += n
+            got = self._jax_match_raw(data, n_records)
+            if got is None:
+                return None
+            mask, offsets, n = got
+        tm.add("records", n)
         keep = self.keep_mask(mask)
         n_keep = int(keep.sum())
         if n_keep == n:
@@ -429,8 +436,7 @@ class GrepFilter(FilterPlugin):
             return (0, b"")
         t0 = _time.perf_counter()
         compacted = native.compact(data, offsets[: n + 1], keep)
-        with tm_lock:
-            tm["compact_s"] += _time.perf_counter() - t0
+        tm.add("compact_s", _time.perf_counter() - t0)
         if compacted is not None:
             return (n_keep, compacted)
         parts = [
@@ -438,3 +444,141 @@ class GrepFilter(FilterPlugin):
             for i in np.nonzero(keep)[0]
         ]
         return (n_keep, b"".join(parts))
+
+    def _local_tables(self, attr: str):
+        """This thread's private copy of a packed native table set (the
+        multi-input scaling fix: concurrent ingest workers each walk
+        their own arrays instead of hammering one shared set)."""
+        tls = self._tls_tables
+        t = getattr(tls, attr, None)
+        if t is None:
+            t = getattr(self, attr).thread_copy()
+            setattr(tls, attr, t)
+        return t
+
+    def _jax_match_raw(self, data, n_records):
+        """Device-kernel raw matching with double-buffered staging.
+
+        The chunk's records split into fixed-size segments; host
+        msgpack extraction (native.stage_field over the segment's byte
+        span) of segment N+1 runs while segment N's kernel is in
+        flight (jax async dispatch — core.chunk_batch.double_buffered),
+        and each mask is forced one segment behind. On a real
+        accelerator the staging walk hides behind the DFA scan; single-
+        segment chunks degrade to the stage-then-match order.
+        Returns (mask[R, n], offsets[n+1], n) or None to decline."""
+        import os as _os
+        import time as _time
+
+        from .. import native
+        from ..core.chunk_batch import double_buffered, segment_bounds
+        from ..ops.batch import bucket_size
+
+        tm = self.raw_timings
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        # default matches a bucket_size rung exactly: a full segment
+        # stages with ZERO pad rows (8192 would round up to the 16384
+        # bucket and double every segment's staging + kernel work)
+        seg = int(_os.environ.get("FBTPU_SEGMENT_RECORDS", "4096"))
+        n = n_records
+        offsets = None
+        if n is None or n > seg:
+            # segmentation (or an unknown count) needs the boundary
+            # table up front; single-segment chunks with a known count
+            # skip this walk and take the offsets the first
+            # stage_field call discovers anyway
+            offsets = native.scan_offsets(data)
+            if offsets is None:
+                return None
+            n = len(offsets) - 1
+        if n < self.tpu_batch_records:
+            return None  # small batches: decline BEFORE staging/kernel
+        by_key: dict = {}
+        for r, rule in enumerate(self.rules):
+            by_key.setdefault(rule.ra.head.encode("utf-8"), []).append(r)
+        R = len(self.rules)
+        Lmax = self.tpu_max_record_len
+        bounds = segment_bounds(n, seg)
+        multi = len(bounds) > 1
+        extract_s = [0.0]
+        lens_parts: list = []
+        cnts: list = []
+        offs_box = [offsets]  # filled by staging when not pre-scanned
+
+        def stages():
+            for s, e in bounds:
+                t0 = _time.perf_counter()
+                cnt = e - s
+                span = data if offs_box[0] is None \
+                    else data[offs_box[0][s]: offs_box[0][e]]
+                staged = {}
+                max_staged = 1
+                for key in by_key:
+                    got = native.stage_field(span, key, Lmax, None,
+                                             n_hint=cnt)
+                    if got is None:
+                        raise _RawDecline
+                    b, ln, offs, count = got
+                    if count != cnt:
+                        raise _RawDecline
+                    if offs_box[0] is None:
+                        # single-segment: the staging walk's boundary
+                        # table serves overflow decode + compaction
+                        # (same values whichever key discovered them)
+                        offs_box[0] = offs
+                    if len(by_key) > 1:
+                        # stage_field returns views of a per-thread
+                        # arena the NEXT call overwrites — multi-key
+                        # rule sets copy each key's staging out first
+                        b, ln = b.copy(), ln.copy()
+                    staged[key] = (b, ln)
+                    mx = int(ln[:cnt].max()) if cnt else 0
+                    max_staged = max(max_staged, mx)
+                # scan-length bucketing: the DFA scan is sequential in
+                # L, so clamp to the longest staged value (rounded to a
+                # small bucket set for jit shape stability)
+                L = _len_bucket(max_staged, Lmax)
+                # segment-uniform batch shape: one compile covers every
+                # full segment of the chunk stream
+                Bp = bucket_size(seg if multi else cnt, max_len=L)
+                batch = np.zeros((R, Bp, L), dtype=np.uint8)
+                lengths = np.full((R, Bp), -1, dtype=np.int32)
+                for key, idxs in by_key.items():
+                    b, ln = staged[key]
+                    for r in idxs:
+                        batch[r, :cnt] = b[:cnt, :L]
+                        lengths[r, :cnt] = ln[:cnt]
+                extract_s[0] += _time.perf_counter() - t0
+                yield batch, lengths, cnt
+
+        def dispatch(item):
+            batch, lengths, cnt = item
+            lens_parts.append(lengths[:, :cnt])
+            cnts.append(cnt)
+            return self._program.dispatch(batch, lengths)
+
+        t_all = _time.perf_counter()
+        try:
+            masks = double_buffered(stages(), dispatch)
+        except _RawDecline:
+            return None
+        wall = _time.perf_counter() - t_all
+        tm.add("extract_s", extract_s[0])
+        tm.add("kernel_s", max(wall - extract_s[0], 0.0))
+        offsets = offs_box[0]
+        mask = np.concatenate(
+            [np.asarray(m)[:, :c] for m, c in zip(masks, cnts)], axis=1)
+        lengths = np.concatenate(lens_parts, axis=1)
+        # overflow rows (-2): decode just those records on the CPU
+        overflow_rows = np.unique(np.nonzero(lengths == -2)[1])
+        if len(overflow_rows):
+            from ..codec.events import decode_events
+
+            for b_idx in overflow_rows:
+                span = bytes(data[offsets[b_idx]: offsets[b_idx + 1]])
+                ev = decode_events(span)[0]
+                for r, rule in enumerate(self.rules):
+                    if lengths[r, b_idx] == -2:
+                        mask[r, b_idx] = rule.match(ev.body)
+        return mask, offsets, n

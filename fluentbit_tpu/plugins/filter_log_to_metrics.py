@@ -49,6 +49,10 @@ def _stringify(v) -> str:
 class LogToMetricsFilter(FilterPlugin):
     name = "log_to_metrics"
     description = "generate metrics from log records"
+    # process_batch bumps counters and emits snapshots: once it has
+    # run, the engine must not restart the raw chain from scratch
+    # (decoded-tail continuation instead — engine._ingest_raw)
+    stateful_batch = True
     config_map = [
         ConfigMapEntry("regex", "slist", multiple=True, slist_max_split=1),
         ConfigMapEntry("exclude", "slist", multiple=True, slist_max_split=1),
@@ -159,6 +163,33 @@ class LogToMetricsFilter(FilterPlugin):
                                 width=self.sketch_width)
             self._freq_candidates: Dict[bytes, None] = {}
 
+        # batched raw path (process_batch): counter mode whose labels
+        # are all static vectorizes as one native DFA pass over chunk
+        # bytes + a single batched inc — no Python decode. The ≥1 keep
+        # rule requirement makes non-map bodies consistently excluded
+        # on both paths (they can never match, and the first Regex rule
+        # then decides False — same verdict the dict-body check gives).
+        self._batch_tables = None
+        if (
+            self.mode == "counter"
+            and not self._label_ras
+            and not self.kubernetes_mode
+            and self.rules
+            and any(not r.is_exclude for r in self.rules)
+            and all(r.dfa is not None and not r.ra.parts
+                    for r in self.rules)
+        ):
+            from .. import native as _native
+
+            if _native.available():
+                try:
+                    self._batch_tables = _native.GrepTables(
+                        [(r.ra.head.encode("utf-8"), r.dfa)
+                         for r in self.rules]
+                    )
+                except Exception:
+                    self._batch_tables = None
+
         self.emitter = None
         self._dirty = False
         self._interval = 0.0
@@ -217,6 +248,33 @@ class LogToMetricsFilter(FilterPlugin):
         return _stringify(v).encode("utf-8") if not isinstance(v, str) \
             else v.encode("utf-8")
 
+    # -- batched raw-chunk execution (engine process_batch hook) --
+
+    def can_process_batch(self) -> bool:
+        return self._batch_tables is not None
+
+    def process_batch(self, chunk):
+        from .. import native
+        from .filter_grep import legacy_keep_mask
+
+        data = chunk.as_bytes()
+        got = native.grep_match(data, self._batch_tables, n_hint=chunk.n)
+        if got is None:
+            return None
+        mask, _offsets, n = got
+        count = int(legacy_keep_mask(self.rules, mask).sum()) if n else 0
+        if count:
+            # one batched inc == n per-record incs on the same (static)
+            # label set; the snapshot emits once per append, exactly
+            # like the per-record path
+            self.metric.inc(count, tuple(self._static_labels))
+            self._dirty = True
+            if self.emitter is not None and self._interval <= 0:
+                self._emit_snapshot()
+        if self.discard_logs:
+            return (0, b"", n)
+        return (n, data, n)
+
     # -- the filter --
 
     def filter(self, events: list, tag: str, engine) -> tuple:
@@ -266,7 +324,8 @@ class LogToMetricsFilter(FilterPlugin):
         from ..ops.batch import assemble, bucket_size
 
         return assemble(values, self.tpu_max_record_len,
-                        bucket_size(len(values)))
+                        bucket_size(len(values),
+                                    max_len=self.tpu_max_record_len))
 
     def _update_hll(self, selected: list) -> None:
         vals = [self._value_bytes(ev.body) for ev in selected]

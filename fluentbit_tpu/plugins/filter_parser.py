@@ -21,6 +21,20 @@ Device path: with a single DFA-expressible regex parser and a large
 append, the match decision runs vectorized on device
 (fluentbit_tpu.ops.grep) and capture extraction runs only for matching
 records (match-then-extract two-pass).
+
+Batched fast path (``process_batch``): on the engine's raw ingest path
+whole chunks bypass per-record Python entirely —
+
+- json parser (plain key, defaults): the fbtpu_codec C extension
+  transcodes each record's JSON field straight to msgpack
+  (``parser_json_batch``), byte-exact with json.loads → pack_event;
+- regex parser: the native one-pass DFA (fluentbit_tpu.native) computes
+  the match mask off chunk bytes and capture extraction runs only for
+  matching records.
+
+Exotic options (reserve_data, preserve_key, time_format, record-
+accessor keys, multiple parsers, types) decline to the per-record path
+— identical output either way, just slower.
 """
 
 from __future__ import annotations
@@ -45,6 +59,10 @@ def _to_str(v) -> Optional[str]:
 class ParserFilter(FilterPlugin):
     name = "parser"
     description = "parse a field with a named parser"
+    # the batched path is pure (parsers immutable after init, no
+    # cross-record state): chains of these may ingest in parallel
+    # under per-input locks
+    thread_safe_raw = True
     config_map = [
         ConfigMapEntry("key_name", "str", desc="field to parse"),
         ConfigMapEntry("parser", "str", multiple=True,
@@ -93,6 +111,40 @@ class ParserFilter(FilterPlugin):
             except Exception:
                 self._prefilter = None
 
+        # batched raw-path mode (process_batch): "json" = whole-chunk C
+        # transcode, "regex" = native DFA mask + captures for matches
+        # only. Option combinations outside these shapes keep the
+        # per-record path (bit-exact, just slower).
+        self._batch_mode = None
+        self._batch_key = None
+        self._batch_tables = None
+        p0 = self.parsers[0]
+        if self.ra is None and len(self.parsers) == 1 and self.key_name:
+            key = self.key_name.encode("utf-8")
+            if (
+                p0.fmt == "json"
+                and p0.time_format is None
+                and not self.reserve_data
+                and not self.preserve_key
+            ):
+                from ..codec import _native_codec
+
+                mod = _native_codec.load()
+                if mod is not None and hasattr(mod, "parser_json_batch"):
+                    self._batch_mode = "json"
+                    self._batch_key = key
+            elif p0.fmt == "regex" and p0.regex.dfa is not None:
+                from .. import native as _native
+
+                if _native.available():
+                    try:
+                        self._batch_tables = _native.GrepTables(
+                            [(key, p0.regex.dfa)])
+                        self._batch_mode = "regex"
+                        self._batch_key = key
+                    except Exception:
+                        self._batch_tables = None
+
     # -- per-record semantics --
 
     def _get_value(self, body: dict) -> Optional[str]:
@@ -135,7 +187,9 @@ class ParserFilter(FilterPlugin):
         vals = [
             v.encode("utf-8") if isinstance(v, str) else None for v in values
         ]
-        staged = assemble(vals, self.tpu_max_record_len, bucket_size(len(vals)))
+        staged = assemble(
+            vals, self.tpu_max_record_len,
+            bucket_size(len(vals), max_len=self.tpu_max_record_len))
         batch = np.stack([staged.batch])
         lengths = np.stack([staged.lengths])
         mask = np.array(self._prefilter.match(batch, lengths)[0, : len(vals)])
@@ -143,6 +197,82 @@ class ParserFilter(FilterPlugin):
         for i in staged.overflow:
             mask[i] = rx.match(vals[i])
         return mask
+
+    # -- batched raw-chunk execution (engine process_batch hook) --
+
+    def can_process_batch(self) -> bool:
+        return self._batch_mode is not None
+
+    def process_batch(self, chunk):
+        if self._batch_mode == "json":
+            return self._process_batch_json(chunk)
+        return self._process_batch_regex(chunk)
+
+    def _process_batch_json(self, chunk):
+        """Whole-chunk JSON→msgpack transcode in C — byte-exact with
+        json.loads → dict → pack_event per record (differentially
+        fuzzed; tests/test_batch_filters.py). FallbackError means some
+        record is outside the fast set (legacy framing, bin values,
+        bigints, invalid UTF-8): decline and let the per-record path
+        produce the identical-or-defined behavior."""
+        from ..codec import _native_codec
+
+        mod = _native_codec.load()
+        if mod is None:
+            return None
+        data = chunk.as_bytes()
+        try:
+            out, n, parsed = mod.parser_json_batch(data, self._batch_key)
+        except mod.FallbackError:
+            return None
+        if parsed == 0:
+            return (n, data, n)  # nothing parseable: zero-copy
+        return (n, out, n)
+
+    def _process_batch_regex(self, chunk):
+        """Native one-pass DFA mask over chunk bytes; the regex (with
+        captures) runs only for records the mask admits — mask-false
+        records skip the Python regex entirely (the DFA is the
+        bit-exact twin of the fallback engine, same contract as
+        filter_grep's raw path)."""
+        from .. import native
+        from ..codec.events import decode_events, reencode_event
+
+        data = chunk.as_bytes()
+        got = native.grep_match(data, self._batch_tables, n_hint=chunk.n)
+        if got is None:
+            return None
+        mask, _offsets, n = got
+        row = mask[0]
+        try:
+            events = decode_events(data)
+        except ValueError:
+            return None
+        if len(events) != n:
+            return None  # native/codec walk disagreement: decline
+        out = bytearray()
+        modified = False
+        for i, ev in enumerate(events):
+            v = None
+            body = ev.body
+            if isinstance(body, dict):
+                raw_v = body.get(self.key_name)
+                if isinstance(raw_v, bytes):
+                    # bytes values never stage into the native mask —
+                    # they decode (errors="replace") and always parse
+                    v = raw_v.decode("utf-8", "replace")
+                elif isinstance(raw_v, str) and row[i]:
+                    v = raw_v
+            new_ev = self._apply(ev, v) if v is not None else None
+            if new_ev is None:
+                out += ev.raw if ev.raw is not None \
+                    else reencode_event(ev)
+            else:
+                out += reencode_event(new_ev)
+                modified = True
+        if not modified:
+            return (n, data, n)
+        return (n, bytes(out), n)
 
     def filter(self, events: list, tag: str, engine) -> tuple:
         values = [

@@ -13,6 +13,16 @@ Device path: when every rule regex compiles to a DFA and the append is
 large, the per-rule match matrix runs vectorized on device
 (fluentbit_tpu.ops.grep); capture extraction + tag composition run on
 the CPU only for the first matching rule of each matched record.
+
+Batched fast path (``process_batch``): on the engine's raw ingest path
+the per-rule match matrix comes from the native one-pass DFA straight
+off chunk bytes — no Python decode at all. Records whose winning rule
+has a tag-static template (no ``$0..$9`` captures, no record fields)
+group into per-tag span gathers (native compact) and re-emit in one
+emitter append per tag; only records whose template needs captures or
+record fields decode individually. The own-emitter re-entry guard uses
+the chunk's source input, so re-emitted records pass through untouched
+at chunk granularity.
 """
 
 from __future__ import annotations
@@ -50,6 +60,10 @@ class RewriteRule:
 class RewriteTagFilter(FilterPlugin):
     name = "rewrite_tag"
     description = "re-tag records by regex and re-emit through the pipeline"
+    # process_batch re-emits through the hidden emitter: once it has
+    # run, the engine must not restart the raw chain from scratch
+    # (decoded-tail continuation instead — engine._ingest_raw)
+    stateful_batch = True
     config_map = [
         ConfigMapEntry("rule", "slist", multiple=True, slist_max_split=3,
                        desc="<$key> <regex> <new_tag> <keep>"),
@@ -97,6 +111,26 @@ class RewriteTagFilter(FilterPlugin):
                 self._program.try_ready()
             except Exception:
                 self._program = None
+        # batched raw path: native per-rule DFA matrix off chunk bytes
+        # (simple top-level keys only); rules with tag-static templates
+        # render once per chunk, the rest decode per matched record
+        self._batch_tables = None
+        self._batch_static = [r.template.static_for_tag
+                              for r in self.rules]
+        if self.emitter is not None and all(
+            r.regex.dfa is not None and not r.ra.parts
+            for r in self.rules
+        ):
+            from .. import native as _native
+
+            if _native.available():
+                try:
+                    self._batch_tables = _native.GrepTables(
+                        [(r.ra.head.encode("utf-8"), r.regex.dfa)
+                         for r in self.rules]
+                    )
+                except Exception:
+                    self._batch_tables = None
 
     # -- matching --
 
@@ -116,7 +150,7 @@ class RewriteTagFilter(FilterPlugin):
 
         R = len(self.rules)
         B = len(values[0])
-        Bp = bucket_size(B)
+        Bp = bucket_size(B, max_len=self.tpu_max_record_len)
         staged = [
             assemble(
                 [v.encode("utf-8") if v is not None else None
@@ -159,6 +193,133 @@ class RewriteTagFilter(FilterPlugin):
         new_tag = rule.template.render(record=ev.body, tag=tag,
                                        captures=captures)
         return new_tag or None
+
+    # -- batched raw-chunk execution (engine process_batch hook) --
+
+    def can_process_batch(self) -> bool:
+        return self._batch_tables is not None
+
+    def process_batch(self, chunk):
+        from .. import native
+        from ..codec.events import decode_events, fast_count_records
+
+        # own-emitter re-entry passes through untouched at chunk
+        # granularity (the i_ins == ctx->ins_emitter recursion guard)
+        if chunk.src is not None and chunk.src is self.emitter.instance:
+            n = chunk.n
+            if n is None:
+                n = fast_count_records(chunk.as_bytes())
+                if n is None:
+                    return None
+            return (n, chunk.data, n)
+        tag = chunk.tag
+        data = chunk.as_bytes()
+        got = native.grep_match(data, self._batch_tables, n_hint=chunk.n)
+        if got is None:
+            return None
+        mask, offsets, n = got
+        if n == 0:
+            return (0, data, 0)
+        any_match = mask.any(axis=0)
+        if not any_match.any():
+            return (n, data, n)
+        # first matching rule per record (process_record's break)
+        first = np.where(any_match, mask.argmax(axis=0), -1)
+        keep = np.ones(n, dtype=bool)
+        # new_tag → {"mask": members, "drop": non-keep members,
+        #            "first": first contributing record index}
+        # — groups re-emit in first-seen order, matching the per-record
+        # path's pending-dict insertion order
+        groups: dict = {}
+
+        def group(new_tag, b):
+            ent = groups.get(new_tag)
+            if ent is None:
+                ent = groups[new_tag] = {
+                    "mask": np.zeros(n, dtype=bool),
+                    "drop": np.zeros(n, dtype=bool),
+                    "first": b,
+                }
+            ent["first"] = min(ent["first"], b)
+            return ent
+
+        need_record: list = []
+        for r, rule in enumerate(self.rules):
+            idx = np.nonzero(first == r)[0]
+            if len(idx) == 0:
+                continue
+            if not self._batch_static[r]:
+                need_record.extend(int(b) for b in idx)
+                continue
+            new_tag = rule.template.render(tag=tag)
+            if not new_tag:
+                continue  # untranslatable tag: keep the original
+            ent = group(new_tag, int(idx[0]))
+            ent["mask"][idx] = True
+            if not rule.keep:
+                ent["drop"][idx] = True
+        # records whose winning rule needs captures or record fields:
+        # decode just those spans and run the per-record rule walk
+        for b in need_record:
+            span = bytes(data[offsets[b]: offsets[b + 1]])
+            try:
+                ev = decode_events(span)[0]
+            except (ValueError, IndexError):
+                return None
+            rule = captures = None
+            for r, rl in enumerate(self.rules):
+                if not mask[r, b]:
+                    continue
+                v = _to_text(rl.ra.get(ev.body)) \
+                    if isinstance(ev.body, dict) else None
+                if v is None:
+                    continue
+                captures = rl.regex.search_captures(v)
+                if captures is not None:
+                    rule = rl
+                    break
+            if rule is None:
+                continue
+            new_tag = self._render_tag(ev, rule, captures, tag)
+            if new_tag is None:
+                continue
+            ent = group(new_tag, b)
+            ent["mask"][b] = True
+            if not rule.keep:
+                ent["drop"][b] = True
+        emitted = 0
+        for new_tag, ent in sorted(groups.items(),
+                                   key=lambda kv: kv[1]["first"]):
+            m = ent["mask"]
+            count = int(m.sum())
+            payload = native.compact(data, offsets, m)
+            if payload is None:
+                payload = b"".join(
+                    data[offsets[i]: offsets[i + 1]]
+                    for i in np.nonzero(m)[0]
+                )
+            if self.emitter.add_record(new_tag, payload, count) < 0:
+                # backpressure: keep the originals (reference keeps the
+                # record when in_emitter refuses it) — drop flags for
+                # this group are simply never applied
+                continue
+            emitted += count
+            keep &= ~ent["drop"]
+        if emitted and chunk.engine is not None:
+            chunk.engine.m_filter_emit.inc(
+                emitted, (self.instance.display_name,))
+        n_keep = int(keep.sum())
+        if n_keep == n:
+            return (n, data, n)
+        if n_keep == 0:
+            return (0, b"", n)
+        out = native.compact(data, offsets, keep)
+        if out is None:
+            out = b"".join(
+                data[offsets[i]: offsets[i + 1]]
+                for i in np.nonzero(keep)[0]
+            )
+        return (n_keep, out, n)
 
     def filter(self, events: list, tag: str, engine) -> tuple:
         # records re-entering from our OWN emitter are never re-matched

@@ -304,6 +304,55 @@ def test_purity_suppression():
     assert lint_source(src, "fluentbit_tpu/ops/fixture.py") == []
 
 
+# batched filter entry points (process_batch): the retrace rule fires
+# on shape branches even though the def itself is not traced — a shape
+# branch there re-specializes every kernel the batch feeds
+
+BAD_PROCESS_BATCH = """
+import numpy as np
+
+class F:
+    def process_batch(self, chunk):
+        staged = self._stage(chunk)
+        if staged.shape[0] > 128:
+            return self._kernel_big(staged)
+        return self._kernel_small(staged)
+"""
+
+GOOD_PROCESS_BATCH = """
+import numpy as np
+
+class F:
+    def process_batch(self, chunk):
+        staged = self._stage(chunk)           # bucketed upstream
+        host = np.asarray(staged)             # host sync is legal here
+        if chunk.n is None:
+            return None
+        return self._kernel(host)
+"""
+
+
+def test_process_batch_shape_branch_fires():
+    got = lint_source(BAD_PROCESS_BATCH,
+                      "fluentbit_tpu/plugins/filter_x.py")
+    assert rules(got) == ["jax-retrace"]
+    assert "process_batch" in got[0].message
+
+
+def test_process_batch_host_code_quiet():
+    # host syncs and branches on plain ints stay legal in batched
+    # entries — only array-shape branches re-specialize kernels
+    assert lint_source(GOOD_PROCESS_BATCH,
+                       "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+def test_process_batch_suppression():
+    src = BAD_PROCESS_BATCH.replace(
+        "if staged.shape[0] > 128:",
+        "if staged.shape[0] > 128:  # fbtpu-lint: allow(jax-retrace)")
+    assert lint_source(src, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
 # ---------------------------------------------------------------------
 # swallowed-error
 # ---------------------------------------------------------------------
