@@ -8,7 +8,7 @@ host sync added to a traced kernel. This package makes those invariants
 machine-checked, the same way ``tests/test_asan_native.py`` made the
 memory-safety invariant repeatable.
 
-Three rule families (see ANALYSIS.md for the full contract):
+Six rule families (see ANALYSIS.md for the full contract):
 
 - **lock discipline** (`guarded-by`, `await-in-lock`): a declarative
   guarded-by registry (`analysis.registry.GUARDS`) names, per module,
@@ -24,6 +24,27 @@ Three rule families (see ANALYSIS.md for the full contract):
 - **silent failures** (`swallowed-error`): ``except Exception: pass`` on
   data-path modules hides real errors; narrow the type, count it in a
   metric, or justify the swallow with an explicit suppression.
+- **batch exactness** (`batch-decline-after-commit`,
+  `batch-commit-replay`, `batch-stateful-unmarked`,
+  `batch-no-fallback`, `batch-unordered-emit`): interprocedural
+  dataflow over every ``FilterPlugin.process_batch`` verifying the
+  batched fast path's contracts — declines dominated by zero committed
+  side effects, guarded emits, a reachable per-record fallback, and
+  first-seen emission order (analysis.batch).
+- **decline-path swallows** (`decline-swallow`): broad excepts whose
+  body only declines a fast path (None assignment / return None)
+  without logging — silent permanent fallback (analysis.decline).
+- **dtype narrowing** (`dtype-narrowing`): int64→int32 truncation in
+  offset/index math — astype/array/cumsum with a narrow dtype on
+  offset-flavored values (analysis.dtype).
+
+The native C/C++ data plane has its own gate (analysis.native_gate):
+clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
+static analyzer, and a libclang-based checker for the codec's
+invariants (container emission balance, bounds-guarded cursor reads,
+error-path frees). ``python -m fluentbit_tpu.analysis --all`` runs
+everything; C sources take the same ``fbtpu-lint: allow(...)``
+suppressions in ``/* */`` or ``//`` comments.
 
 Suppressions: a ``# fbtpu-lint: allow(<rule>[, <rule>...])`` comment on
 the flagged line (or the line above) silences that rule there. Every
@@ -58,9 +79,19 @@ class Finding:
     col: int
     rule: str
     message: str
+    #: "error" fails the gate outright; "warning" fails too unless
+    #: baselined (see __main__ --baseline) — the split exists so CI can
+    #: diff legacy debt instead of flag-daying it
+    severity: str = "error"
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.severity}: {self.message}")
+
+    def baseline_key(self) -> tuple:
+        """Line/col-insensitive identity for --baseline diffs (a pure
+        reformat must not churn the baseline)."""
+        return (self.path, self.rule, self.message)
 
 
 class Module:
@@ -93,6 +124,7 @@ class Rule:
 
     name = ""
     description = ""
+    severity = "error"
 
     def check(self, module: Module) -> List[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -104,10 +136,13 @@ class Rule:
         if module.allowed(self.name, line, extra_lines):
             return None
         return Finding(module.path, line, getattr(node, "col_offset", 0),
-                       self.name, message)
+                       self.name, message, self.severity)
 
 
 def _build_rules(guards=None) -> List[Rule]:
+    from .batch import BatchExactnessRules
+    from .decline import DeclineSwallowRule
+    from .dtype import DtypeNarrowingRule
     from .locks import AwaitUnderLockRule, GuardedByRule
     from .purity import JaxPurityRules
     from .silent import SwallowedErrorRule
@@ -117,6 +152,9 @@ def _build_rules(guards=None) -> List[Rule]:
         AwaitUnderLockRule(),
         JaxPurityRules(),
         SwallowedErrorRule(),
+        BatchExactnessRules(),
+        DeclineSwallowRule(),
+        DtypeNarrowingRule(),
     ]
 
 

@@ -1,8 +1,26 @@
-"""CLI: ``python -m fluentbit_tpu.analysis [paths...]``.
+"""CLI: ``python -m fluentbit_tpu.analysis [options] [paths...]``.
 
-Exit status 0 = clean, 1 = findings (or unparseable files). With no
-paths, lints the installed ``fluentbit_tpu`` package tree — the same
-invocation ``tests/test_lint.py`` gates every PR with.
+Exit status 0 = clean, 1 = findings (or unparseable files), 2 = usage
+error. With no paths, lints the installed ``fluentbit_tpu`` package
+tree — the invocation ``tests/test_lint.py`` gates every PR with.
+
+Modes:
+
+- (default)           Python rule packs over the tree/paths
+- ``--native``        native C gate only (clang-tidy profile +
+                      gcc -fanalyzer + codec invariant checker)
+- ``--all``           both — the full PR gate
+- ``--json``          machine-readable findings (incl. severity)
+- ``--baseline F``    subtract the findings recorded in F (CI diffs
+                      new findings instead of failing on legacy debt);
+                      exit 0 when nothing NEW
+- ``--write-baseline F``  snapshot current findings into F and exit 0
+
+Baseline entries match on (path, rule, message) — line-insensitive, so
+reformatting never churns the file. Every suppression in code uses
+``# fbtpu-lint: allow(<rule>)`` (``/* fbtpu-lint: allow(...) */`` in C)
+with an inline justification; the baseline is for inherited debt, the
+suppression for reviewed exceptions.
 """
 
 from __future__ import annotations
@@ -12,44 +30,136 @@ import json
 import os
 import sys
 
-from . import RULES, lint_paths
+from . import RULES, Finding, lint_paths
+
+
+def _load_baseline(path: str):
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    keys = set()
+    for d in data.get("findings", []):
+        keys.add((d["path"], d["rule"], d["message"]))
+    return keys
+
+
+def _write_baseline(path: str, findings) -> None:
+    payload = {
+        "version": 1,
+        "findings": [
+            {"path": f.path, "rule": f.rule, "message": f.message,
+             "severity": f.severity}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m fluentbit_tpu.analysis",
-        description="fbtpu-lint: concurrency + JAX-purity + "
-                    "silent-failure analysis (see ANALYSIS.md)")
+        description="fbtpu-lint: concurrency + JAX-purity + batch-"
+                    "exactness + silent-failure analysis, and the "
+                    "native C static-analysis gate (see ANALYSIS.md)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the "
                          "fluentbit_tpu package)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings")
+    ap.add_argument("--all", action="store_true", dest="run_all",
+                    help="Python rules AND the native C gate")
+    ap.add_argument("--native", action="store_true", dest="native_only",
+                    help="native C gate only")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore the native gate's result cache")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="subtract findings recorded in FILE; exit 0 "
+                         "when nothing new")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="snapshot current findings into FILE, exit 0")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule set and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
+        from .batch import BatchExactnessRules
+        from .native_gate import NATIVE_RULES
+
         for r in RULES:
-            print(f"{r.name}: {r.description}")
+            if isinstance(r, BatchExactnessRules):
+                for n in r.RULE_NAMES:
+                    print(f"{n}: (batch-exactness pack) {r.description}")
+            elif r.name == "jax-purity":
+                for n in ("jax-host-sync", "jax-side-effect",
+                          "jax-retrace"):
+                    print(f"{n}: (jax-purity pack) {r.description}")
+            else:
+                print(f"{r.name}: {r.description}")
+        for n in NATIVE_RULES:
+            print(f"{n}: native C gate (analysis.native_gate; "
+                  f"--all/--native)")
         return 0
 
-    paths = args.paths or [
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    ]
-    try:
-        findings = lint_paths(paths)
-    except FileNotFoundError as e:
-        print(e, file=sys.stderr)
-        return 2
+    findings: list = []
+    notes: list = []
+
+    if not args.native_only:
+        paths = args.paths or [
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ]
+        try:
+            findings.extend(lint_paths(paths))
+        except FileNotFoundError as e:
+            print(e, file=sys.stderr)
+            return 2
+
+    if args.run_all or args.native_only:
+        from .native_gate import run_native_gate
+
+        nf, notes = run_native_gate(cache=not args.no_cache)
+        findings.extend(nf)
+
+    if args.write_baseline:
+        _write_baseline(args.write_baseline, findings)
+        print(f"fbtpu-lint: baseline of {len(findings)} finding(s) "
+              f"written to {args.write_baseline}")
+        return 0
+
+    baselined = 0
+    if args.baseline:
+        try:
+            keys = _load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"fbtpu-lint: unreadable baseline "
+                  f"{args.baseline!r}: {e}", file=sys.stderr)
+            return 2
+        kept = []
+        for f in findings:
+            if f.baseline_key() in keys:
+                baselined += 1
+            else:
+                kept.append(f)
+        findings = kept
+
     if args.as_json:
-        print(json.dumps([f.__dict__ for f in findings], indent=2))
+        if args.run_all or args.native_only:
+            # the native gate's notes travel with the findings: a
+            # machine consumer must be able to tell "analyzed clean"
+            # from "every layer skipped" (never a silent green)
+            print(json.dumps(
+                {"findings": [f.__dict__ for f in findings],
+                 "notes": notes}, indent=2))
+        else:
+            print(json.dumps([f.__dict__ for f in findings], indent=2))
     else:
+        for n in notes:
+            print(f"# {n}")
         for f in findings:
             print(f.render())
         n = len(findings)
-        print(f"fbtpu-lint: {n} finding{'s' if n != 1 else ''} in "
-              f"{', '.join(paths)}")
+        tail = f" ({baselined} baselined)" if baselined else ""
+        print(f"fbtpu-lint: {n} finding{'s' if n != 1 else ''}{tail}")
     return 1 if findings else 0
 
 

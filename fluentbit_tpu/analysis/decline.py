@@ -1,0 +1,86 @@
+"""Decline-path exception-swallowing rule.
+
+``decline-swallow``: a broad ``except Exception`` (or bare ``except`` /
+``BaseException``) whose whole body is a silent *decline* — assigning
+``None`` to a fast-path handle, ``return None``, ``continue`` — on a
+data-path module. These are one notch above ``swallowed-error``'s
+pass-only bodies: the code LOOKS like it handles the failure (the
+fallback engages), but a real bug in the fast path (a typo in the
+native table builder, a refactor that changed an argument type) now
+manifests only as a silent, permanent performance cliff or a
+per-record fallback that hides the defect forever. The decline is
+fine; the silence is not. Narrow the exception to the expected decline
+type (``FallbackError``, ``ValueError``), log the surprise, or justify
+with ``# fbtpu-lint: allow(decline-swallow)``.
+
+Pass-only bodies are ``swallowed-error``'s territory and are excluded
+here so one site never double-reports.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, Module, Rule
+from .silent import DATA_PATH_PREFIXES, _is_broad
+
+__all__ = ["DeclineSwallowRule"]
+
+
+def _is_decline_only(body: List[ast.stmt]) -> bool:
+    """True when the handler only declines: None-assignments, bare/None
+    returns, continue/break — and does nothing observable."""
+    saw_decline = False
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # docstring / ellipsis
+        if isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, ast.Constant) \
+                and stmt.value.value is None:
+            saw_decline = True
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or (isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is None)):
+            saw_decline = True
+            continue
+        if isinstance(stmt, (ast.Continue, ast.Break)):
+            saw_decline = True
+            continue
+        return False  # anything else (a log call, a raise) = observable
+    return saw_decline
+
+
+class DeclineSwallowRule(Rule):
+    name = "decline-swallow"
+    description = ("broad `except` whose body only declines (None "
+                   "assignment / return None) on a data-path module — "
+                   "silent fast-path loss hides real bugs")
+    severity = "warning"
+
+    def check(self, module: Module) -> List[Finding]:
+        if not any(p in module.path for p in DATA_PATH_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type) or not _is_decline_only(node.body):
+                continue
+            shown = (ast.unparse(node.type) if node.type is not None
+                     else "bare")
+            f = self.finding(
+                module, node,
+                f"broad `except {shown}` silently declines a fast "
+                f"path: a real bug here becomes an invisible permanent "
+                f"fallback — narrow the type to the expected decline "
+                f"(FallbackError/ValueError), or log the surprise",
+                extra_lines=tuple(s.lineno for s in node.body[:1]))
+            if f is not None:
+                out.append(f)
+        return out

@@ -1,0 +1,142 @@
+"""Numpy/JAX dtype-narrowing rule.
+
+``dtype-narrowing``: int64→int32 (or narrower) conversions applied to
+byte-offset / position / cumulative-sum math on data-path modules.
+Chunk byte offsets, span starts, and prefix sums over record lengths
+are the quantities that actually cross 2 GiB in a production pipeline;
+``.astype(np.int32)`` on them truncates SILENTLY (numpy wraps, no
+error) and the verdict/index math downstream then gathers the wrong
+spans — the worst kind of exactness bug because small test corpora
+never trip it.
+
+Flagged:
+
+- ``<expr>.astype(int32-ish)`` / ``np.array(<expr>, dtype=int32-ish)``
+  / ``np.asarray(<expr>, dtype=int32-ish)`` where ``<expr>`` references
+  offset-flavored names (``offset``/``offsets``/``pos``/``position``/
+  ``span``/``spans``/``cursor``);
+- ``np.cumsum(..., dtype=int32-ish)`` / ``<expr>.cumsum(dtype=...)``
+  unconditionally — a cumulative sum with a narrowed accumulator is
+  offset math by construction.
+
+Bounded quantities (verdict masks, per-record lengths capped by
+``tpu_max_record_len``, DFA state ids) stay legal: the rule keys off
+the *names* feeding the conversion, not the dtype alone. Suppress a
+deliberate narrow with ``# fbtpu-lint: allow(dtype-narrowing)`` and a
+justification (e.g. a bounded domain proof).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import Finding, Module, Rule
+from .silent import DATA_PATH_PREFIXES
+
+__all__ = ["DtypeNarrowingRule"]
+
+#: dtypes narrower than the int64 the offset math is computed in
+_NARROW = {"int32", "uint32", "int16", "uint16", "int8", "uint8"}
+
+#: name fragments that mark a value as byte-offset / position math
+_OFFSETY = ("offset", "position", "span", "cursor")
+_OFFSETY_EXACT = {"pos", "off", "offs", "starts", "ends"}
+
+
+def _dtype_name(node: ast.AST) -> Optional[str]:
+    """``np.int32`` / ``jnp.uint16`` / ``"int32"`` → the dtype name."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _names(expr: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def _offsety(names: Set[str]) -> Optional[str]:
+    for n in names:
+        low = n.lower()
+        if low in _OFFSETY_EXACT or any(f in low for f in _OFFSETY):
+            return n
+    return None
+
+
+def _narrow_dtype_arg(call: ast.Call) -> Optional[str]:
+    """The narrow dtype a call requests, via keyword or sole arg."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            d = _dtype_name(kw.value)
+            if d in _NARROW:
+                return d
+    return None
+
+
+class DtypeNarrowingRule(Rule):
+    name = "dtype-narrowing"
+    description = ("int64→int32 truncation in offset/index math "
+                   "(astype/array/cumsum with a narrow dtype on "
+                   "offset-flavored values)")
+    severity = "warning"
+
+    def check(self, module: Module) -> List[Finding]:
+        if not any(p in module.path for p in DATA_PATH_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = None
+            if isinstance(node.func, ast.Attribute):
+                t = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                t = node.func.id
+            f = None
+            if t == "astype" and isinstance(node.func, ast.Attribute):
+                d = None
+                if node.args:
+                    d = _dtype_name(node.args[0])
+                d = d if d in _NARROW else _narrow_dtype_arg(node)
+                if d is not None:
+                    src = _offsety(_names(node.func.value))
+                    if src is not None:
+                        f = self.finding(
+                            module, node,
+                            f"`.astype({d})` on offset-flavored value "
+                            f"`{src}`: byte offsets cross int32 past "
+                            f"2 GiB and numpy truncates silently — "
+                            f"keep offset math in int64")
+            elif t in ("array", "asarray"):
+                d = _narrow_dtype_arg(node)
+                if d is not None and node.args:
+                    src = _offsety(_names(node.args[0]))
+                    if src is not None:
+                        f = self.finding(
+                            module, node,
+                            f"`{t}(..., dtype={d})` on offset-flavored "
+                            f"value `{src}` truncates silently past "
+                            f"2 GiB — keep offset math in int64")
+            elif t == "cumsum":
+                d = _narrow_dtype_arg(node)
+                if d is not None:
+                    f = self.finding(
+                        module, node,
+                        f"`cumsum(dtype={d})`: a prefix sum with a "
+                        f"narrowed accumulator is offset math by "
+                        f"construction and wraps silently past 2 GiB — "
+                        f"accumulate in int64")
+            if f is not None:
+                out.append(f)
+        out.sort(key=lambda x: (x.line, x.col))
+        return out

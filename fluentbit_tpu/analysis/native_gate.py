@@ -1,0 +1,543 @@
+"""Static-analysis gate for the native C/C++ data plane.
+
+The codec extension (native/fbtpu_codec.c, ~1.4k LoC of hand-rolled
+msgpack/JSON byte walking) and the ctypes data plane
+(native/fbtpu_native.cpp) had ZERO static checking — exactly the code
+whose bug classes (out-of-bounds cursor reads over hostile bytes,
+container headers whose declared lengths drift from what gets emitted,
+error paths leaking allocations) the sanitizer tests only catch when a
+test vector happens to hit them. This module runs three layers, each
+degrading to a note (never a silent pass) when its tool is missing:
+
+1. **clang-tidy** with the repo profile (``.clang-tidy`` at the root):
+   the bugprone-*/clang-analyzer-* checks tuned for this codebase.
+2. **gcc -fanalyzer** (the GCC static analyzer): interprocedural
+   path-sensitive malloc/leak/null/overflow analysis. Always available
+   where the native build itself is (same gcc).
+3. **codec invariant checker** (Python over ``clang.cindex``): the
+   repo-specific contracts no generic tool knows —
+
+   - ``codec-balance``: every msgpack container header emitted with a
+     literal fixmap/fixarray byte must be balanced by exactly the
+     declared number of element emissions (``pack_obj``/header calls)
+     in straight-line emitter functions;
+   - ``codec-bounds``: every function advancing/dereferencing a reader
+     cursor (``r->p`` / ``t->p``) must bounds-check (a ``need()`` call
+     or an ``end`` comparison), and every raw ``memcpy``/``memmove``
+     into the writer buffer must be dominated by ``wr_reserve``;
+   - ``codec-leak``: a function that ``PyMem_Malloc``s must free on its
+     error paths (function-level heuristic: an alloc with no
+     ``PyMem_Free``/``free`` anywhere in the function).
+
+Suppressions use the same syntax as the Python side, in C comments on
+the flagged line or the line above::
+
+    static uint64_t rd_be(rd *r, int n) { /* fbtpu-lint: allow(codec-bounds) */
+
+Results are cached under ``native/build/analysis-cache/`` keyed by the
+source digest + tool identity, so the test gate pays the (~25 s g++
+analyzer) cost once per source change, not per run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import sysconfig
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import Finding
+
+__all__ = [
+    "native_sources", "run_native_gate", "run_gcc_analyzer",
+    "run_clang_tidy", "run_codec_checker", "check_codec_file",
+    "NATIVE_RULES",
+]
+
+NATIVE_RULES = ("clang-tidy", "gcc-analyzer", "codec-balance",
+                "codec-bounds", "codec-leak")
+
+_DIAG_RE = re.compile(
+    r"^(?P<path>[^:\s][^:]*):(?P<line>\d+):(?P<col>\d+):\s+"
+    r"(?P<sev>warning|error):\s+(?P<msg>.*?)"
+    r"(?:\s+\[(?P<opt>[-\w.,=+]+)\])?$")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def native_sources(root: Optional[str] = None) -> List[Tuple[str, str]]:
+    """→ [(path, lang)] for the native data plane."""
+    root = root or repo_root()
+    out = []
+    for name, lang in (("fbtpu_codec.c", "c"), ("fbtpu_native.cpp", "c++")):
+        p = os.path.join(root, "native", name)
+        if os.path.exists(p):
+            out.append((p, lang))
+    return out
+
+
+def _py_include() -> Optional[str]:
+    inc = sysconfig.get_paths().get("include")
+    if inc and os.path.exists(os.path.join(inc, "Python.h")):
+        return inc
+    return None
+
+
+def _gcc_builtin_include() -> Optional[str]:
+    """GCC's builtin headers (stddef.h/limits.h) — libclang ships
+    without its own resource dir in this environment, and GCC's set
+    parses fine for analysis purposes."""
+    try:
+        out = subprocess.run(["gcc", "-print-file-name=include"],
+                             capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    path = out.stdout.strip()
+    return path if path and os.path.isdir(path) else None
+
+
+# ---------------------------------------------------------------------
+# C-side suppressions + result cache
+# ---------------------------------------------------------------------
+
+#: the Python side's allow() syntax, minus the `#` (C comments)
+_C_ALLOW_RE = re.compile(r"fbtpu-lint:\s*allow\(([^)]*)\)")
+
+
+def _c_allowed(lines: Sequence[str], rule: str, line: int) -> bool:
+    """``fbtpu-lint: allow(<rule>)`` in a comment on the flagged line or
+    the line above (C twin of Module.allowed)."""
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(lines):
+            m = _C_ALLOW_RE.search(lines[ln - 1])
+            if m:
+                names = {p.strip() for p in m.group(1).split(",")}
+                if rule in names or "*" in names:
+                    return True
+    return False
+
+
+def _filter_allowed(findings: List[Finding],
+                    src_lines: Dict[str, List[str]]) -> List[Finding]:
+    out = []
+    for f in findings:
+        lines = src_lines.get(f.path)
+        if lines is not None and _c_allowed(lines, f.rule, f.line):
+            continue
+        out.append(f)
+    return out
+
+
+def _cache_dir(root: str) -> str:
+    return os.path.join(root, "native", "build", "analysis-cache")
+
+
+def _cache_load(root: str, name: str, digest: str) -> Optional[list]:
+    try:
+        with open(os.path.join(_cache_dir(root), name + ".json")) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if data.get("digest") != digest:
+        return None
+    return data.get("findings", [])
+
+
+def _cache_store(root: str, name: str, digest: str,
+                 findings: List[Finding]) -> None:
+    try:
+        os.makedirs(_cache_dir(root), exist_ok=True)
+        with open(os.path.join(_cache_dir(root), name + ".json"),
+                  "w") as fh:
+            json.dump({"digest": digest,
+                       "findings": [f.__dict__ for f in findings]}, fh)
+    except OSError:
+        pass  # cache is an optimization; the gate re-runs without it
+
+
+def _digest(parts: Sequence[str]) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p.encode("utf-8", "replace"))
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def _read_lines(paths: Sequence[str], root: str) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8", errors="replace") as fh:
+                out[_rel(root, p)] = fh.read().splitlines()
+        except OSError:
+            pass
+    return out
+
+
+# ---------------------------------------------------------------------
+# layer 1: clang-tidy (repo profile in .clang-tidy)
+# ---------------------------------------------------------------------
+
+def run_clang_tidy(root: Optional[str] = None, cache: bool = True
+                   ) -> Tuple[List[Finding], List[str]]:
+    root = root or repo_root()
+    notes: List[str] = []
+    tidy = shutil.which("clang-tidy")
+    if tidy is None:
+        return [], ["clang-tidy: not installed — layer skipped "
+                    "(install clang-tidy to enable the profile in "
+                    ".clang-tidy)"]
+    inc = _py_include()
+    findings: List[Finding] = []
+    try:
+        with open(os.path.join(root, ".clang-tidy"), encoding="utf-8",
+                  errors="replace") as fh:
+            profile = fh.read()
+    except OSError:
+        profile = ""
+    for src, lang in native_sources(root):
+        base_args = ["-I", inc] if inc else []
+        if lang == "c++":
+            base_args += ["-std=c++17", "-pthread"]
+        # the profile is an input too: editing .clang-tidy must miss
+        # the cache, or a new check silently never runs
+        digest = _digest([open(src, encoding="utf-8",
+                               errors="replace").read(),
+                          " ".join(base_args), profile, "tidy-v1"])
+        name = "tidy-" + os.path.basename(src)
+        if cache:
+            hit = _cache_load(root, name, digest)
+            if hit is not None:
+                findings.extend(Finding(**d) for d in hit)
+                notes.append(f"clang-tidy: {os.path.basename(src)} "
+                             f"(cached)")
+                continue
+        try:
+            proc = subprocess.run(
+                [tidy, "--quiet", src, "--"] + base_args,
+                capture_output=True, text=True, timeout=600, cwd=root)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            notes.append(f"clang-tidy: failed on {src}: {e}")
+            continue
+        got = _parse_diags(proc.stdout + proc.stderr, root,
+                           rule="clang-tidy")
+        got = [f for f in got if f.path.startswith("native/")]
+        _cache_store(root, name, digest, got)
+        findings.extend(got)
+        notes.append(f"clang-tidy: {os.path.basename(src)} analyzed")
+    src_lines = _read_lines([s for s, _l in native_sources(root)], root)
+    return _filter_allowed(findings, src_lines), notes
+
+
+def _parse_diags(text: str, root: str, rule: str,
+                 only_analyzer: bool = False) -> List[Finding]:
+    out: List[Finding] = []
+    seen = set()
+    for line in text.splitlines():
+        m = _DIAG_RE.match(line.strip())
+        if not m:
+            continue
+        opt = m.group("opt") or ""
+        if only_analyzer and not opt.startswith("-Wanalyzer"):
+            continue
+        path = m.group("path")
+        if not os.path.isabs(path):
+            path = os.path.join(root, path)
+        rel = _rel(root, path)
+        msg = m.group("msg")
+        if opt:
+            msg = f"{msg} [{opt}]"
+        sev = "error" if m.group("sev") == "error" else "warning"
+        key = (rel, int(m.group("line")), msg)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(Finding(rel, int(m.group("line")),
+                           int(m.group("col")), rule, msg, sev))
+    return out
+
+
+# ---------------------------------------------------------------------
+# layer 2: gcc -fanalyzer
+# ---------------------------------------------------------------------
+
+def run_gcc_analyzer(root: Optional[str] = None, cache: bool = True,
+                     sources: Optional[List[Tuple[str, str]]] = None
+                     ) -> Tuple[List[Finding], List[str]]:
+    root = root or repo_root()
+    notes: List[str] = []
+    findings: List[Finding] = []
+    inc = _py_include()
+    srcs = sources if sources is not None else native_sources(root)
+    for src, lang in srcs:
+        cc = shutil.which("g++" if lang == "c++" else "gcc")
+        if cc is None:
+            notes.append(f"gcc-analyzer: no compiler for {src} — skipped")
+            continue
+        args = [cc, "-fanalyzer", "-O0", "-c"]
+        if inc:
+            args += ["-I", inc]
+        if lang == "c++":
+            args += ["-std=c++17", "-pthread"]
+        digest = _digest([open(src, encoding="utf-8",
+                               errors="replace").read(),
+                          " ".join(args), "fanalyzer-v1"])
+        name = "fanalyzer-" + os.path.basename(src)
+        if cache and sources is None:
+            hit = _cache_load(root, name, digest)
+            if hit is not None:
+                findings.extend(Finding(**d) for d in hit)
+                notes.append(f"gcc-analyzer: {os.path.basename(src)} "
+                             f"(cached)")
+                continue
+        with tempfile.TemporaryDirectory() as td:
+            obj = os.path.join(td, "out.o")
+            try:
+                proc = subprocess.run(args + [src, "-o", obj],
+                                      capture_output=True, text=True,
+                                      timeout=600, cwd=root)
+            except (OSError, subprocess.TimeoutExpired) as e:
+                notes.append(f"gcc-analyzer: failed on {src}: {e}")
+                continue
+        got = _parse_diags(proc.stderr, root, rule="gcc-analyzer",
+                           only_analyzer=True)
+        if proc.returncode != 0 and not got:
+            notes.append(f"gcc-analyzer: compile failed for {src}: "
+                         f"{proc.stderr[-300:]}")
+            continue
+        if cache and sources is None:
+            _cache_store(root, name, digest, got)
+        findings.extend(got)
+        notes.append(f"gcc-analyzer: {os.path.basename(src)} analyzed")
+    src_lines = _read_lines([s for s, _l in srcs], root)
+    return _filter_allowed(findings, src_lines), notes
+
+
+# ---------------------------------------------------------------------
+# layer 3: codec invariant checker (clang.cindex)
+# ---------------------------------------------------------------------
+
+#: emitter functions whose calls form the msgpack output stream
+_EMITTERS = {"wr_u8", "wr_be", "wr_bytes", "pack_obj", "pack_header"}
+#: emitters encoding exactly one complete msgpack value per call
+_VALUE_EMITTERS = {"pack_obj"}
+
+
+def _load_cindex():
+    try:
+        import clang.cindex as ci
+        ci.Index.create()  # probes libclang itself
+        return ci
+    except Exception:
+        return None
+
+
+def check_codec_file(path: str, root: Optional[str] = None,
+                     extra_args: Sequence[str] = ()
+                     ) -> Tuple[List[Finding], List[str]]:
+    """Run the codec invariant checks over one C file. Separated from
+    the gate wrapper so fixture tests can feed known-bad snippets."""
+    root = root or repo_root()
+    ci = _load_cindex()
+    if ci is None:
+        return [], ["codec-checker: clang.cindex/libclang unavailable "
+                    "— layer skipped"]
+    args: List[str] = list(extra_args)
+    inc = _py_include()
+    if inc:
+        args += ["-I", inc]
+    gccinc = _gcc_builtin_include()
+    if gccinc:
+        args += ["-isystem", gccinc]
+    try:
+        tu = ci.Index.create().parse(path, args=args)
+    except Exception as e:
+        return [], [f"codec-checker: parse failed for {path}: {e}"]
+    errs = [d for d in tu.diagnostics
+            if d.severity >= ci.Diagnostic.Error]
+    if errs:
+        return [], [f"codec-checker: {len(errs)} parse errors in "
+                    f"{path} (first: {errs[0]}) — layer skipped"]
+    rel = _rel(root, path) if os.path.isabs(path) else path
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        lines = []
+    findings: List[Finding] = []
+
+    def emit(rule: str, line: int, col: int, msg: str) -> None:
+        if not _c_allowed(lines, rule, line):
+            findings.append(Finding(rel, line, col, rule, msg, "error"))
+
+    main_file = os.path.basename(path)
+    for fn in tu.cursor.get_children():
+        if fn.kind != ci.CursorKind.FUNCTION_DECL or not fn.is_definition():
+            continue
+        if not fn.location.file or \
+                os.path.basename(fn.location.file.name) != main_file:
+            continue
+        toks = [t.spelling for t in fn.get_tokens()]
+        _check_bounds(ci, fn, toks, emit)
+        _check_leak(ci, fn, toks, emit)
+        _check_balance(ci, fn, emit)
+    return findings, [f"codec-checker: {os.path.basename(path)} analyzed"]
+
+
+def _check_bounds(ci, fn, toks: List[str], emit) -> None:
+    """Cursor derefs need a need()/end guard; raw buffer copies need a
+    wr_reserve in the same function."""
+    has_cursor = any(a == "->" and b == "p"
+                     for a, b in zip(toks, toks[1:]))
+    if has_cursor and "need" not in toks and "end" not in toks:
+        emit("codec-bounds", fn.location.line, fn.location.column,
+             f"`{fn.spelling}` advances/dereferences a reader cursor "
+             f"(`->p`) with no `need()` call or `end` comparison in "
+             f"scope — a torn buffer reads past the end")
+    copies = {"memcpy", "memmove"} & set(toks)
+    # the WRITER buffer specifically (`w->buf`), not stack locals that
+    # happen to be named buf — those carry their own sizeof guards
+    touches_writer = any(a == "->" and b == "buf"
+                         for a, b in zip(toks, toks[1:]))
+    if copies and touches_writer and "wr_reserve" not in toks \
+            and fn.spelling != "wr_reserve":
+        emit("codec-bounds", fn.location.line, fn.location.column,
+             f"`{fn.spelling}` copies into the writer buffer without a "
+             f"`wr_reserve` bound in the same function — the write can "
+             f"land past the allocation")
+
+
+def _check_leak(ci, fn, toks: List[str], emit) -> None:
+    allocs = {"PyMem_Malloc", "malloc", "calloc"} & set(toks)
+    if not allocs:
+        return
+    if "PyMem_Free" in toks or "free" in toks:
+        return
+    emit("codec-leak", fn.location.line, fn.location.column,
+         f"`{fn.spelling}` allocates ({'/'.join(sorted(allocs))}) but "
+         f"never frees in any path of this function — error returns "
+         f"leak the buffer")
+
+
+def _container_slots(v: int) -> Optional[int]:
+    """fixmap/fixarray header byte → element emissions it declares."""
+    if 0x80 <= v <= 0x8F:
+        return 2 * (v & 0x0F)  # map: key+value per pair
+    if 0x90 <= v <= 0x9F:
+        return v & 0x0F
+    return None
+
+
+def _int_literal(ci, node) -> Optional[int]:
+    for t in node.get_tokens():
+        s = t.spelling
+        try:
+            return int(s, 0)
+        except ValueError:
+            continue
+    return None
+
+
+def _check_balance(ci, fn, emit) -> None:
+    """Straight-line container emission balance: headers written with a
+    literal fixmap/fixarray byte must be matched by exactly the declared
+    number of value emissions. Functions with loops/switches (data-
+    dependent emission counts) are out of scope by design."""
+    loops = {ci.CursorKind.FOR_STMT, ci.CursorKind.WHILE_STMT,
+             ci.CursorKind.DO_STMT, ci.CursorKind.SWITCH_STMT}
+    calls = []
+    for n in fn.walk_preorder():
+        if n.kind in loops:
+            return
+        if n.kind == ci.CursorKind.CALL_EXPR and n.spelling in _EMITTERS:
+            calls.append(n)
+    if not calls:
+        return
+    seq = []  # ("container", slots, node) | ("value", node)
+    for c in calls:
+        if c.spelling == "wr_u8":
+            args = list(c.get_arguments())
+            v = _int_literal(ci, args[1]) if len(args) > 1 else None
+            if v is None:
+                return  # computed byte: not statically checkable
+            slots = _container_slots(v)
+            if slots is not None:
+                seq.append(("container", slots, c))
+            else:
+                seq.append(("value", 0, c))
+        elif c.spelling in _VALUE_EMITTERS:
+            seq.append(("value", 0, c))
+        else:
+            return  # wr_be/wr_bytes build multi-call scalars: skip fn
+    if not any(kind == "container" for kind, _s, _c in seq):
+        return
+    stack: List[int] = []
+
+    def consume():
+        while stack and stack[-1] == 0:
+            stack.pop()
+        if stack:
+            stack[-1] -= 1
+
+    for kind, slots, _node in seq:
+        consume()
+        if kind == "container":
+            stack.append(slots)
+    while stack and stack[-1] == 0:
+        stack.pop()
+    if stack:
+        emit("codec-balance", fn.location.line, fn.location.column,
+             f"`{fn.spelling}` emits a container header declaring more "
+             f"elements than the function packs ({stack[-1]} slot(s) "
+             f"unfilled) — decoders read the next record's bytes as "
+             f"this container's tail")
+
+
+def run_codec_checker(root: Optional[str] = None, cache: bool = True
+                      ) -> Tuple[List[Finding], List[str]]:
+    root = root or repo_root()
+    src = os.path.join(root, "native", "fbtpu_codec.c")
+    if not os.path.exists(src):
+        return [], ["codec-checker: native/fbtpu_codec.c missing"]
+    digest = _digest([open(src, encoding="utf-8",
+                           errors="replace").read(), "codec-v1"])
+    if cache:
+        hit = _cache_load(root, "codec-checker", digest)
+        if hit is not None:
+            return [Finding(**d) for d in hit], ["codec-checker: cached"]
+    findings, notes = check_codec_file(src, root)
+    if not any("skipped" in n or "failed" in n for n in notes):
+        _cache_store(root, "codec-checker", digest, findings)
+    return findings, notes
+
+
+# ---------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------
+
+def run_native_gate(root: Optional[str] = None, cache: bool = True
+                    ) -> Tuple[List[Finding], List[str]]:
+    """All three layers; findings sorted, notes say what actually ran
+    (a missing tool is a visible note, never a silent green)."""
+    root = root or repo_root()
+    findings: List[Finding] = []
+    notes: List[str] = []
+    for runner in (run_clang_tidy, run_gcc_analyzer, run_codec_checker):
+        got, ns = runner(root, cache=cache)
+        findings.extend(got)
+        notes.extend(ns)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, notes

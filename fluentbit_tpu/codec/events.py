@@ -198,5 +198,10 @@ def fast_count_records(buf: bytes):
         return n
     try:
         return count_records(buf)
-    except Exception:
+    except (ValueError, RecursionError):
+        # ValueError = malformed msgpack, RecursionError = hostile
+        # nesting: both mean "not countable", the caller's decode path
+        # decides. Anything ELSE is a real bug and must surface —
+        # a broad swallow here once hid a transcoder regression as a
+        # permanent silent fallback (fbtpu-lint decline-swallow).
         return None

@@ -16,6 +16,21 @@ overlaps the in-flight device kernel of segment N, and each result is
 forced one segment behind its dispatch. On a real accelerator the
 overlap hides the host staging walk behind the DFA scan; on the CPU
 backend it degrades to the sequential order at no extra cost.
+
+The hook contract (machine-checked by fbtpu-lint's batch-exactness
+pack, ``fluentbit_tpu.analysis.batch`` — see ANALYSIS.md):
+
+- ``None`` (or any raise) from ``process_batch`` DECLINES the chunk:
+  the engine re-runs the chain per-record from this filter onward, so
+  a decline must be dominated by ZERO committed side effects (counter
+  incs, emitter appends, tag rewrites) — commit last, or guard the
+  committing call and succeed;
+- a hook that commits side effects declares ``stateful_batch = True``
+  on its class, which switches a downstream decline from a full-chain
+  restart to the decoded-tail continuation;
+- span-gather re-emits preserve FIRST-SEEN record order (the
+  per-record path's pending-dict insertion order): group by first
+  contributing record index, never iterate a set.
 """
 
 from __future__ import annotations

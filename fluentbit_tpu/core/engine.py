@@ -127,6 +127,14 @@ class Engine:
                                        "Records dropped by filter", ("name",))
         self.m_filter_emit = m.counter("fluentbit", "filter", "emit_records_total",
                                        "Records re-emitted by filter", ("name",))
+        # batched fast-path declines (north-star addition): the
+        # exactness contract says a decline is invisible in OUTPUT —
+        # this counter makes it visible in OPS, so a config change that
+        # silently demotes a hot chain to per-record shows up on a dash
+        self.m_filter_batch_decline = m.counter(
+            "fluentbit", "filter", "batch_declines_total",
+            "Batched fast-path declines to the per-record path",
+            ("name",))
         self.m_out_proc_records = m.counter("fluentbit", "output", "proc_records_total",
                                             "Records delivered", ("name",))
         self.m_out_proc_bytes = m.counter("fluentbit", "output", "proc_bytes_total",
@@ -859,6 +867,7 @@ class Engine:
                 log.exception("filter %s raw path failed", f.display_name)
                 got = None
             if got is None:
+                self.m_filter_batch_decline.inc(1, (f.display_name,))
                 if not committed:
                     return None  # pure prefix: decode path re-runs it
                 # an upstream stateful filter already emitted records /

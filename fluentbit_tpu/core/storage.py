@@ -248,6 +248,10 @@ class Storage:
                         self._read_chunk_file(os.path.join(self.dlq_dir, name))
                     )
                 except Exception:
+                    # a corrupt DLQ file must not hide silently — the
+                    # quarantine exists so operators can inspect it
+                    log.warning("unreadable DLQ chunk %s skipped",
+                                name, exc_info=True)
                     continue
         return out
 

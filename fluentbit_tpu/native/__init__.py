@@ -341,6 +341,9 @@ class GrepTables:
         self.cmap2_cat = (np.concatenate(cmap2_parts) if cmap2_parts
                           else np.zeros(1, dtype=np.uint16))
         self.cm2offs = np.asarray(cm2offs, dtype=np.int64)
+        # DFA start-STATE ids (bounded by the state count, < 2^15), not
+        # byte offsets; the C ABI takes int32 here
+        # fbtpu-lint: allow(dtype-narrowing)
         self.starts = np.asarray(starts, dtype=np.int32)
         self.ncls = np.asarray(ncls, dtype=np.int32)
         self.btrans_cat = (np.concatenate(btrans_parts) if btrans_parts

@@ -19,6 +19,7 @@ Surviving records are re-emitted byte-identical (raw span reuse).
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -27,6 +28,8 @@ from ..core.config import ConfigMapEntry
 from ..core.plugin import FilterPlugin, FilterResult, registry
 from ..core.record_accessor import RecordAccessor
 from ..regex import FlbRegex
+
+log = logging.getLogger("flb")
 
 LEGACY, AND, OR = "legacy", "AND", "OR"
 
@@ -245,6 +248,8 @@ class GrepFilter(FilterPlugin):
                 device.wait()  # bounded (FBTPU_ATTACH_WAIT_S, default 2s)
                 self._program.try_ready()
             except Exception:
+                log.debug("grep device program unavailable; host path "
+                          "serves", exc_info=True)
                 self._program = None
             # host-side twin: one-pass C++ field-extract + DFA over
             # chunk bytes (simple top-level keys only). Serves the raw
@@ -259,6 +264,8 @@ class GrepFilter(FilterPlugin):
                          for r in self.rules]
                     )
                 except Exception:
+                    log.warning("grep native table build failed; raw "
+                                "staging path disabled", exc_info=True)
                     self._native_tables = None
                 # fused single-pass variant (extract + accel DFA +
                 # verdict + compaction in one native call)
@@ -269,6 +276,8 @@ class GrepFilter(FilterPlugin):
                         op=self.op,
                     )
                 except Exception:
+                    log.warning("grep fused filter table build failed; "
+                                "fused raw path disabled", exc_info=True)
                     self._native_filter = None
 
     # -- verdicts (bit-exact vs grep.c) --

@@ -23,6 +23,7 @@ pmax/psum over ICI.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional
 
 from ..codec.chunk import EVENT_TYPE_METRICS
@@ -32,6 +33,8 @@ from ..core.metrics import MetricsRegistry
 from ..core.plugin import FilterPlugin, FilterResult, registry
 from ..core.record_accessor import RecordAccessor
 from .filter_grep import legacy_keep, parse_grep_rules
+
+log = logging.getLogger("flb")
 
 K8S_LABELS = ("namespace_name", "pod_name", "container_name",
               "docker_id", "pod_id")
@@ -188,6 +191,9 @@ class LogToMetricsFilter(FilterPlugin):
                          for r in self.rules]
                     )
                 except Exception:
+                    log.warning(
+                        "log_to_metrics native table build failed; "
+                        "batched fast path disabled", exc_info=True)
                     self._batch_tables = None
 
         self.emitter = None
@@ -270,7 +276,18 @@ class LogToMetricsFilter(FilterPlugin):
             self.metric.inc(count, tuple(self._static_labels))
             self._dirty = True
             if self.emitter is not None and self._interval <= 0:
-                self._emit_snapshot()
+                try:
+                    self._emit_snapshot()
+                except Exception:
+                    # the inc above is already committed: a raise here
+                    # would decline the batch and the decoded-tail
+                    # rerun would inc AGAIN for the same records —
+                    # degrade to a deferred snapshot (_dirty stays set)
+                    # to keep counter effects exactly-once
+                    # (fbtpu-lint batch-commit-replay)
+                    log.exception(
+                        "log_to_metrics snapshot emit failed; "
+                        "snapshot deferred")
         if self.discard_logs:
             return (0, b"", n)
         return (n, data, n)

@@ -39,12 +39,16 @@ accessor keys, multiple parsers, types) decline to the per-record path
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional
 
 from ..codec.events import LogEvent
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FilterPlugin, FilterResult, registry
 from ..core.record_accessor import RecordAccessor
+
+
+log = logging.getLogger("flb")
 
 
 def _to_str(v) -> Optional[str]:
@@ -109,6 +113,8 @@ class ParserFilter(FilterPlugin):
                 device.wait()  # bounded; CPU path serves until attached
                 self._prefilter.try_ready()
             except Exception:
+                log.debug("parser device prefilter unavailable; "
+                          "host path serves", exc_info=True)
                 self._prefilter = None
 
         # batched raw-path mode (process_batch): "json" = whole-chunk C
@@ -143,6 +149,9 @@ class ParserFilter(FilterPlugin):
                         self._batch_mode = "regex"
                         self._batch_key = key
                     except Exception:
+                        log.warning(
+                            "parser native table build failed; batched "
+                            "regex fast path disabled", exc_info=True)
                         self._batch_tables = None
 
     # -- per-record semantics --

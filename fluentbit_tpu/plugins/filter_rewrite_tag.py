@@ -27,6 +27,7 @@ at chunk granularity.
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional
 
 import numpy as np
@@ -36,6 +37,9 @@ from ..core.config import ConfigMapEntry, parse_bool
 from ..core.plugin import FilterPlugin, FilterResult, registry
 from ..core.record_accessor import RecordAccessor, Template
 from ..regex import FlbRegex
+
+
+log = logging.getLogger("flb")
 
 
 def _to_text(v) -> Optional[str]:
@@ -110,6 +114,8 @@ class RewriteTagFilter(FilterPlugin):
                 device.wait()  # bounded; CPU path serves until attached
                 self._program.try_ready()
             except Exception:
+                log.debug("rewrite_tag device program unavailable; "
+                          "host path serves", exc_info=True)
                 self._program = None
         # batched raw path: native per-rule DFA matrix off chunk bytes
         # (simple top-level keys only); rules with tag-static templates
@@ -130,6 +136,9 @@ class RewriteTagFilter(FilterPlugin):
                          for r in self.rules]
                     )
                 except Exception:
+                    log.warning(
+                        "rewrite_tag native table build failed; "
+                        "batched fast path disabled", exc_info=True)
                     self._batch_tables = None
 
     # -- matching --
@@ -298,7 +307,18 @@ class RewriteTagFilter(FilterPlugin):
                     data[offsets[i]: offsets[i + 1]]
                     for i in np.nonzero(m)[0]
                 )
-            if self.emitter.add_record(new_tag, payload, count) < 0:
+            try:
+                rc = self.emitter.add_record(new_tag, payload, count)
+            except Exception:
+                # earlier groups are already committed: letting this
+                # raise would decline the batch and the decoded-tail
+                # rerun would re-emit them a second time — degrade a
+                # failed group to the backpressure outcome instead
+                # (originals kept; fbtpu-lint batch-commit-replay)
+                log.exception("rewrite_tag emitter append failed for "
+                              "tag %r; originals kept", new_tag)
+                rc = -1
+            if rc < 0:
                 # backpressure: keep the originals (reference keeps the
                 # record when in_emitter refuses it) — drop flags for
                 # this group are simply never applied

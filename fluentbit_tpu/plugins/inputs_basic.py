@@ -8,6 +8,7 @@ plugins/in_head, plugins/in_exec, plugins/in_stdin.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import random as _random
 import subprocess
@@ -17,6 +18,8 @@ from ..codec.events import encode_event, now_event_time
 from ..codec.msgpack import EventTime
 from ..core.config import ConfigMapEntry
 from ..core.plugin import InputPlugin, registry
+
+log = logging.getLogger("flb")
 
 
 @registry.register
@@ -299,6 +302,10 @@ class ExecInput(InputPlugin):
                 self.command, shell=True, capture_output=True, timeout=30
             ).stdout.decode("utf-8", "replace")
         except Exception:
+            # a dead collect tick is recoverable, an invisible one is
+            # not: surface why the command produced nothing
+            log.warning("in_exec command failed: %r", self.command,
+                        exc_info=True)
             return
         records = [{self.key: line} for line in out.splitlines() if line]
         if records:

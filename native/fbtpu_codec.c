@@ -52,7 +52,10 @@ static int need(rd *r, Py_ssize_t n) {
     return 0;
 }
 
-static uint64_t rd_be(rd *r, int n) { /* caller already need()ed */
+/* every caller need()s before calling — hoisting the check here would
+ * double it on the hottest decode path
+ * fbtpu-lint: allow(codec-bounds) */
+static uint64_t rd_be(rd *r, int n) {
     uint64_t v = 0;
     for (int i = 0; i < n; i++) v = (v << 8) | r->p[i];
     r->p += n;

@@ -1,0 +1,323 @@
+"""Exactly-once side effects on the batched filter chain.
+
+The batch-exactness analyzer (fluentbit_tpu.analysis.batch) encodes the
+contract statically; these tests pin it dynamically — the ISSUE 3
+satellite: interleave DECLINING stages (parser json over corpora with
+bin-typed values, outside the C transcode set) with COMMITTING stages
+(log_to_metrics counter incs + snapshot emits, rewrite_tag re-emits)
+in randomized orders and corpora, and require counters/emits to fire
+exactly once whether the chain runs batched, per-record, or batched-
+then-declined mid-chain (the decoded-tail continuation).
+
+Also here: the regression tests for the two bugs the analyzer
+surfaced — a snapshot-emit raise after the committed counter inc
+double-counting via the decoded-tail rerun (filter_log_to_metrics),
+and a mid-loop emitter raise replaying already-emitted groups
+(filter_rewrite_tag) — plus the decline-swallow fixes (native table
+build failures now logged, fast_count_records narrowed).
+"""
+
+import logging
+import random
+
+import pytest
+
+from fluentbit_tpu.codec.events import encode_event
+from fluentbit_tpu.codec.msgpack import Unpacker
+from fluentbit_tpu.core.engine import Engine
+
+
+def _disable_batch(engine):
+    for f in engine.filters:
+        f.plugin.can_process_batch = lambda: False
+
+
+def _drain(ins):
+    return b"".join(bytes(c.buf) for c in ins.pool.drain())
+
+
+def _strip_ts(payload):
+    out = []
+    for obj in Unpacker(payload):
+        obj["meta"]["ts"] = 0
+        for m in obj["metrics"]:
+            m["ts"] = 0
+        out.append(obj)
+    return out
+
+
+def _build_chain(order):
+    """Engine with a [committing, declining] chain in the given order:
+    log_to_metrics (stateful counter + snapshot emit) and parser json
+    (declines the batch when a record's log value is bin-typed)."""
+    e = Engine()
+    e.parser("jp", format="json")
+    for kind in order:
+        if kind == "metrics":
+            lm = e.filter("log_to_metrics")
+            lm.set("regex", "log ERROR")
+            lm.set("metric_mode", "counter")
+            lm.set("metric_name", "errors")
+            lm.set("metric_description", "t")
+            lm.set("tag", "metrics")
+        elif kind == "parser":
+            pf = e.filter("parser")
+            pf.set("key_name", "log")
+            pf.set("parser", "jp")
+        else:  # rewrite_tag
+            rt = e.filter("rewrite_tag")
+            rt.set("rule", "$route ^go moved.out false")
+    ins = e.input("dummy")
+    for x in e.inputs + e.filters:
+        x.configure()
+        x.plugin.init(x, e)
+    return e, ins
+
+
+def _corpus(rng, n):
+    """Records mixing counter hits, JSON parses, re-tag routes, and —
+    randomly — bin-typed log values that force the C transcoder to
+    decline mid-chain."""
+    recs = []
+    for i in range(n):
+        doc = '{"v": %d, "sev": "ERROR"}' % i if rng.random() < 0.5 \
+            else "ERROR plain %d" % i
+        body = {"log": doc.encode() if rng.random() < 0.15 else doc}
+        if rng.random() < 0.3:
+            body["route"] = "go"
+        recs.append(encode_event(body, float(i)))
+    return b"".join(recs)
+
+
+def _run(order, buf, disable):
+    e, ins = _build_chain(order)
+    if disable:
+        _disable_batch(e)
+    emitters = [
+        (f.display_name, f.plugin.emitter.instance)
+        for f in e.filters if getattr(f.plugin, "emitter", None) is not None
+    ]
+    n = e.input_log_append(ins, "t", buf)
+    kept = _drain(ins)
+    traffic = []
+    counters = []
+    for name, em in emitters:
+        for c in em.pool.drain():
+            payload = bytes(c.buf)
+            if c.event_type == "metrics":
+                traffic.append((name, c.tag, _strip_ts(payload), c.records))
+            else:
+                traffic.append((name, c.tag, payload, c.records))
+    for f in e.filters:
+        cmt = getattr(f.plugin, "cmt", None)
+        if cmt is not None:
+            counters.append([
+                (m.fqname, sorted(m.samples())) for m in cmt.metrics()
+            ])
+    return n, kept, traffic, counters
+
+
+ORDERS = (
+    ("metrics", "parser"),
+    ("parser", "metrics"),
+    ("metrics", "rewrite", "parser"),
+    ("rewrite", "metrics", "parser"),
+    ("parser", "rewrite", "metrics"),
+)
+
+
+def test_property_decline_commit_interleavings_exactly_once():
+    """Randomized corpora × chain orders: batched output, emitter
+    traffic, and final counter state must equal the per-record path's
+    bit-for-bit — including when a stateful stage committed before a
+    later stage declined (the decoded-tail continuation)."""
+    rng = random.Random(11)
+    for trial in range(12):
+        order = ORDERS[trial % len(ORDERS)]
+        buf = _corpus(rng, rng.randrange(40, 160))
+        batched = _run(order, buf, disable=False)
+        per_record = _run(order, buf, disable=True)
+        assert batched == per_record, (trial, order)
+
+
+def test_counter_after_decline_counts_exactly_once():
+    """The specific double-count shape: log_to_metrics incs (batched),
+    then parser declines on a bin value — the tail rerun must NOT inc
+    again. Counted against the known ERROR population of the corpus."""
+    recs = []
+    expect = 0
+    for i in range(64):
+        doc = '{"v": %d}' % i
+        body = {"log": doc.encode() if i % 8 == 0 else "ERROR %d" % i}
+        if i % 8 != 0:
+            expect += 1
+        recs.append(encode_event(body, float(i)))
+    buf = b"".join(recs)
+    # bin-typed values are excluded by the ≥1-keep-rule contract on
+    # both paths (non-matching), so only the str ERROR records count
+    e, ins = _build_chain(("metrics", "parser"))
+    lm = e.filters[0].plugin
+    assert lm.can_process_batch()
+    n = e.input_log_append(ins, "t", buf)
+    assert n == 64
+    assert lm.metric.get(()) == expect
+
+
+def test_snapshot_emit_raise_after_inc_does_not_double_count():
+    """Regression (fbtpu-lint batch-commit-replay, filter_log_to_
+    metrics): a raise from the snapshot emit AFTER the committed inc
+    used to decline the batch, and the decoded-tail rerun inc'd the
+    same records a second time."""
+    buf = b"".join(encode_event({"log": "ERROR %d" % i}, float(i))
+                   for i in range(32))
+    e, ins = _build_chain(("metrics",))
+    lm = e.filters[0].plugin
+    assert lm.can_process_batch()
+
+    def boom(*a, **k):
+        raise RuntimeError("emitter down")
+
+    lm.emitter.add_event = boom
+    n = e.input_log_append(ins, "t", buf)
+    assert n == 32
+    assert lm.metric.get(()) == 32  # exactly once, not 64
+    assert lm._dirty  # snapshot deferred, not lost
+
+
+def test_rewrite_emitter_raise_mid_groups_keeps_exactly_once(caplog):
+    """Regression (fbtpu-lint batch-commit-replay, filter_rewrite_tag):
+    a raise on the SECOND group's append used to propagate, decline the
+    batch, and re-emit the first group's records on the rerun. Now the
+    failed group degrades to the backpressure outcome (originals kept)
+    and committed groups stay single-shot."""
+    rules = ["$log ^alpha routed.alpha false",
+             "$log ^beta routed.beta false"]
+    e = Engine()
+    rt = e.filter("rewrite_tag")
+    for r in rules:
+        rt.set("rule", r)
+    ins = e.input("dummy")
+    for x in e.inputs + e.filters:
+        x.configure()
+        x.plugin.init(x, e)
+    plugin = e.filters[0].plugin
+    assert plugin.can_process_batch()
+    em = plugin.emitter
+    real_add = em.add_record
+
+    def flaky(tag, data, count):
+        if tag == "routed.beta":
+            raise RuntimeError("emitter down")
+        return real_add(tag, data, count)
+
+    em.add_record = flaky
+    buf = b"".join(
+        encode_event({"log": ("alpha %d" if i % 2 else "beta %d") % i},
+                     float(i))
+        for i in range(32))
+    with caplog.at_level(logging.ERROR, logger="flb"):
+        n = e.input_log_append(ins, "t", buf)
+    chunks = em.instance.pool.drain()
+    emitted = {(c.tag, c.records) for c in chunks}
+    assert emitted == {("routed.alpha", 16)}  # once, not twice
+    # beta originals kept (backpressure semantics), alphas dropped
+    assert n == 16
+    assert any("emitter append failed" in r.message for r in caplog.records)
+
+
+def test_native_table_build_failure_logs_and_declines(caplog, monkeypatch):
+    """decline-swallow fix: a native table builder raising is no longer
+    an invisible permanent fallback — it logs, and the filter serves
+    the per-record path."""
+    import fluentbit_tpu.native as native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    class Boom:
+        def __init__(self, *a, **k):
+            raise RuntimeError("table builder bug")
+
+    monkeypatch.setattr(native, "GrepTables", Boom)
+    e = Engine()
+    e.parser("rp", format="regex", regex=r"^(?<w>ERROR) (?<n>\d+)$")
+    pf = e.filter("parser")
+    pf.set("key_name", "log")
+    pf.set("parser", "rp")
+    ins = e.input("dummy")
+    with caplog.at_level(logging.WARNING, logger="flb"):
+        for x in e.inputs + e.filters:
+            x.configure()
+            x.plugin.init(x, e)
+    plugin = e.filters[0].plugin
+    assert not plugin.can_process_batch()
+    assert any("native table build failed" in r.message
+               for r in caplog.records)
+    # the per-record path still parses
+    buf = encode_event({"log": "ERROR 7"}, 1.0)
+    n = e.input_log_append(ins, "t", buf)
+    assert n == 1
+    out = _drain(ins)
+    from fluentbit_tpu.codec.events import decode_events
+
+    assert decode_events(out)[0].body == {"w": "ERROR", "n": "7"}
+
+
+def test_fast_count_records_narrowed_decline():
+    """decline-swallow fix: fast_count_records still maps malformed /
+    hostile-nesting buffers to None, but an unexpected bug now
+    propagates instead of hiding as a silent fallback."""
+    from fluentbit_tpu.codec import events as ev
+
+    assert ev.fast_count_records(
+        encode_event({"a": 1}, 1.0) + encode_event({"b": 2}, 2.0)) == 2
+    # deep hostile nesting: None (not a crash) even without the native
+    # scanner
+    import fluentbit_tpu.native as native
+
+    real = native.count_records
+    try:
+        native.count_records = lambda buf: None
+        deep = b"\x91" * 5000 + b"\x90"
+        assert ev.fast_count_records(deep) is None
+        assert ev.fast_count_records(b"\xc1\xc1\xc1") is None
+
+        def raising(buf):
+            raise TypeError("real bug")
+
+        real_count = ev.count_records
+        try:
+            ev.count_records = raising
+            with pytest.raises(TypeError):
+                ev.fast_count_records(b"\x90")
+        finally:
+            ev.count_records = real_count
+    finally:
+        native.count_records = real
+
+
+def test_engine_batch_decline_metric():
+    """The new fluentbit_filter_batch_declines_total counter makes the
+    invisible (bit-exact) decline visible in ops."""
+    recs = []
+    for i in range(16):
+        doc = '{"v": %d}' % i
+        # one bin value forces the whole-chunk transcoder to decline
+        recs.append(encode_event(
+            {"log": doc.encode() if i == 3 else doc}, float(i)))
+    buf = b"".join(recs)
+    e = Engine()
+    e.parser("jp", format="json")
+    pf = e.filter("parser")
+    pf.set("key_name", "log")
+    pf.set("parser", "jp")
+    ins = e.input("dummy")
+    for x in e.inputs + e.filters:
+        x.configure()
+        x.plugin.init(x, e)
+    if not e.filters[0].plugin.can_process_batch():
+        pytest.skip("native codec unavailable")
+    name = e.filters[0].display_name
+    n = e.input_log_append(ins, "t", buf)
+    assert n == 16
+    assert e.m_filter_batch_decline.get((name,)) == 1

@@ -55,7 +55,11 @@ def test_list_rules():
         [sys.executable, "-m", "fluentbit_tpu.analysis", "--list-rules"],
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0
-    for name in ("guarded-by", "await-in-lock", "swallowed-error"):
+    for name in ("guarded-by", "await-in-lock", "swallowed-error",
+                 "batch-decline-after-commit", "batch-commit-replay",
+                 "batch-no-fallback", "batch-unordered-emit",
+                 "decline-swallow", "dtype-narrowing",
+                 "codec-balance", "codec-bounds", "codec-leak"):
         assert name in proc.stdout
 
 
@@ -413,3 +417,324 @@ def b(x):
 """
     got = lint_source(src, "fluentbit_tpu/core/x.py")
     assert len(got) == 2 and rules(got) == ["swallowed-error"]
+
+
+# ---------------------------------------------------------------------
+# batch exactness (process_batch contract dataflow)
+# ---------------------------------------------------------------------
+
+BAD_DECLINE_AFTER_COMMIT = """
+class F:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        n = chunk.n
+        self.metric.inc(n, ())
+        if n > 100:
+            return None
+        return (n, chunk.data, n)
+"""
+
+GOOD_DECLINE_BEFORE_COMMIT = """
+class F:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        n = chunk.n
+        if n is None:
+            return None
+        self.metric.inc(n, ())
+        return (n, chunk.data, n)
+"""
+
+
+def test_decline_after_commit_fires():
+    got = lint_source(BAD_DECLINE_AFTER_COMMIT,
+                      "fluentbit_tpu/plugins/filter_x.py")
+    assert "batch-decline-after-commit" in rules(got)
+
+
+def test_decline_before_commit_quiet():
+    assert lint_source(GOOD_DECLINE_BEFORE_COMMIT,
+                       "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+def test_decline_after_commit_interprocedural():
+    # the commit hides inside a self-method, the decline in a tail call
+    src = """
+class F:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def _bump(self, n):
+        self.metric.inc(n, ())
+
+    def _finish(self, chunk):
+        if chunk.n is None:
+            return None
+        return (chunk.n, chunk.data, chunk.n)
+
+    def process_batch(self, chunk):
+        self._bump(chunk.n)
+        return self._finish(chunk)
+"""
+    got = lint_source(src, "fluentbit_tpu/plugins/filter_x.py")
+    assert "batch-decline-after-commit" in rules(got)
+
+
+def test_fallback_error_raise_after_commit_fires():
+    src = BAD_DECLINE_AFTER_COMMIT.replace(
+        "return None", "raise FallbackError('decline')")
+    got = lint_source(src, "fluentbit_tpu/plugins/filter_x.py")
+    assert "batch-decline-after-commit" in rules(got)
+
+
+def test_tail_call_decline_before_commit_quiet():
+    # the GOOD pattern refactored into a helper: the tail callee
+    # declines BEFORE committing — must not be double-inlined into a
+    # false decline-after-commit
+    src = """
+class F:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def _impl(self, chunk):
+        if chunk.n is None:
+            return None
+        self.metric.inc(chunk.n, ())
+        return (chunk.n, chunk.data, chunk.n)
+
+    def process_batch(self, chunk):
+        return self._impl(chunk)
+"""
+    assert lint_source(src, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+BAD_COMMIT_REPLAY = """
+class F:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        if chunk.n is None:
+            return None
+        for tag, payload in chunk.groups:
+            self.emitter.add_record(tag, payload, 1)
+        return (chunk.n, chunk.data, chunk.n)
+"""
+
+
+def test_unguarded_emit_loop_replay_fires():
+    # iteration N+1's add_record raising replays iteration N's emit
+    got = lint_source(BAD_COMMIT_REPLAY,
+                      "fluentbit_tpu/plugins/filter_x.py")
+    assert "batch-commit-replay" in rules(got)
+
+
+def test_guarded_emit_loop_quiet():
+    src = BAD_COMMIT_REPLAY.replace(
+        "            self.emitter.add_record(tag, payload, 1)",
+        "            try:\n"
+        "                self.emitter.add_record(tag, payload, 1)\n"
+        "            except Exception:\n"
+        "                log.exception('append failed')")
+    assert lint_source(src, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+def test_stateful_unmarked_fires():
+    src = BAD_COMMIT_REPLAY.replace("    stateful_batch = True\n", "")
+    got = lint_source(src, "fluentbit_tpu/plugins/filter_x.py")
+    assert "batch-stateful-unmarked" in rules(got)
+
+
+def test_no_fallback_fires_only_with_can_process_batch():
+    src = """
+class F:
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        return (chunk.n, chunk.data, chunk.n)
+"""
+    got = lint_source(src, "fluentbit_tpu/plugins/filter_x.py")
+    assert rules(got) == ["batch-no-fallback"]
+    # without the advertisement the hook is inert: no contract to break
+    src2 = src.replace("    def can_process_batch(self):\n"
+                       "        return True\n\n", "")
+    assert lint_source(src2, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+def test_unordered_emit_fires_and_sorted_groups_quiet():
+    bad = """
+class F:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        if chunk.n is None:
+            return None
+        for tag in set(chunk.tags):
+            try:
+                self.emitter.add_record(tag, b"", 1)
+            except Exception:
+                log.exception("x")
+        return (chunk.n, chunk.data, chunk.n)
+"""
+    got = lint_source(bad, "fluentbit_tpu/plugins/filter_x.py")
+    assert "batch-unordered-emit" in rules(got)
+    good = bad.replace(
+        "set(chunk.tags)",
+        "sorted(groups.items(), key=lambda kv: kv[1]['first'])")
+    assert lint_source(good, "fluentbit_tpu/plugins/filter_x.py") == []
+    # output-buffer concatenation over a set is flagged too...
+    concat = """
+class F:
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        if chunk.n is None:
+            return None
+        out = bytearray()
+        for tag in set(chunk.tags):
+            out += chunk.spans[tag]
+        return (chunk.n, bytes(out), chunk.n)
+"""
+    got = lint_source(concat, "fluentbit_tpu/plugins/filter_x.py")
+    assert "batch-unordered-emit" in rules(got)
+    # ...but an order-INDEPENDENT reduction over a set is not
+    reduction = concat.replace(
+        "        out = bytearray()\n", "        total = 0\n").replace(
+        "            out += chunk.spans[tag]",
+        "            total += chunk.counts[tag]").replace(
+        "        return (chunk.n, bytes(out), chunk.n)",
+        "        return (chunk.n, chunk.data, chunk.n)")
+    assert lint_source(reduction,
+                       "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+def test_batch_rule_suppression():
+    src = BAD_DECLINE_AFTER_COMMIT.replace(
+        "            return None",
+        "            return None  "
+        "# fbtpu-lint: allow(batch-decline-after-commit)")
+    assert lint_source(src, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+# ---------------------------------------------------------------------
+# decline-swallow
+# ---------------------------------------------------------------------
+
+BAD_DECLINE_SWALLOW = """
+class F:
+    def init(self):
+        try:
+            self._tables = build()
+        except Exception:
+            self._tables = None
+"""
+
+
+def test_decline_swallow_fires_on_data_path():
+    got = lint_source(BAD_DECLINE_SWALLOW,
+                      "fluentbit_tpu/plugins/filter_x.py")
+    assert rules(got) == ["decline-swallow"]
+    assert got[0].severity == "warning"
+
+
+def test_decline_swallow_quiet_when_logged_or_narrow():
+    logged = BAD_DECLINE_SWALLOW.replace(
+        "            self._tables = None",
+        "            log.warning('fast path disabled', exc_info=True)\n"
+        "            self._tables = None")
+    assert lint_source(logged, "fluentbit_tpu/plugins/filter_x.py") == []
+    narrow = BAD_DECLINE_SWALLOW.replace("except Exception:",
+                                         "except ValueError:")
+    assert lint_source(narrow, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+def test_decline_swallow_off_data_path_quiet():
+    assert lint_source(BAD_DECLINE_SWALLOW,
+                       "fluentbit_tpu/luart/interp.py") == []
+
+
+def test_decline_swallow_does_not_double_report_pass_bodies():
+    # pass-only bodies stay swallowed-error territory
+    got = lint_source(BAD_SWALLOW, "fluentbit_tpu/plugins/out_x.py")
+    assert rules(got) == ["swallowed-error"]
+
+
+# ---------------------------------------------------------------------
+# dtype-narrowing
+# ---------------------------------------------------------------------
+
+def test_dtype_narrowing_fires_on_offsets():
+    src = """
+import numpy as np
+
+def pack(offsets, lens):
+    a = np.asarray(offsets, dtype=np.int32)
+    b = offsets.astype(np.int32)
+    c = np.cumsum(lens, dtype=np.int32)
+    return a, b, c
+"""
+    got = lint_source(src, "fluentbit_tpu/plugins/filter_x.py")
+    assert rules(got) == ["dtype-narrowing"] and len(got) == 3
+
+
+def test_dtype_narrowing_quiet_on_bounded_values():
+    src = """
+import numpy as np
+
+def pack(offsets, verdict, class_map):
+    a = np.asarray(offsets, dtype=np.int64)   # wide is fine
+    b = class_map.astype(np.int32)            # bounded domain
+    c = verdict.astype(np.uint8)              # not offset-flavored
+    return a, b, c
+"""
+    assert lint_source(src, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+def test_dtype_narrowing_suppression():
+    src = """
+import numpy as np
+
+def pack(offsets):
+    # fbtpu-lint: allow(dtype-narrowing)
+    return np.asarray(offsets, dtype=np.int32)
+"""
+    assert lint_source(src, "fluentbit_tpu/plugins/filter_x.py") == []
+
+
+# ---------------------------------------------------------------------
+# severity + JSON plumbing
+# ---------------------------------------------------------------------
+
+def test_findings_carry_severity_and_json_mode(tmp_path):
+    bad = tmp_path / "fluentbit_tpu" / "plugins"
+    bad.mkdir(parents=True)
+    (bad / "x.py").write_text(BAD_DECLINE_SWALLOW)
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis", "--json",
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    import json as _json
+
+    data = _json.loads(proc.stdout)
+    assert data and data[0]["rule"] == "decline-swallow"
+    assert data[0]["severity"] == "warning"

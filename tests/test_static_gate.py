@@ -1,0 +1,242 @@
+"""The full static-analysis gate: ``--all`` must run clean on the
+shipped tree, and each native-side layer is pinned by fixtures the same
+way test_lint.py pins the Python rules — every codec invariant check
+must fire on a known-bad C snippet, stay quiet on the good twin, and
+honor the C-comment ``fbtpu-lint: allow(...)`` suppression. Layers
+whose tool is missing must SKIP here (and emit a note in the gate),
+never silently pass.
+
+Build caching: the gcc -fanalyzer pass over fbtpu_native.cpp costs
+~25 s cold; results are cached under native/build/analysis-cache keyed
+by source digest, so this gate stays cheap in tier-1 after the first
+run on a given source state.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fluentbit_tpu.analysis import native_gate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cindex_available():
+    return native_gate._load_cindex() is not None
+
+
+# ---------------------------------------------------------------------
+# the gate: the shipped tree (Python + native) must be clean
+# ---------------------------------------------------------------------
+
+def test_full_gate_clean_and_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis", "--all",
+         "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["findings"] == []
+    # a machine consumer can tell "analyzed clean" from "nothing ran":
+    # every layer leaves a note even in JSON mode
+    joined = "\n".join(data["notes"])
+    assert "clang-tidy" in joined and "codec-checker" in joined
+
+
+def test_native_gate_layers_report_notes():
+    findings, notes = native_gate.run_native_gate()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # every layer leaves a visible trace: analyzed, cached, or an
+    # explicit skip note — a missing tool must never be a silent green
+    joined = "\n".join(notes)
+    assert "clang-tidy" in joined
+    assert "gcc-analyzer" in joined or "no compiler" in joined
+    assert "codec-checker" in joined
+
+
+def test_native_gate_cache_round_trip():
+    # second run must serve the codec checker from the digest cache
+    f1, _ = native_gate.run_codec_checker(cache=True)
+    f2, notes = native_gate.run_codec_checker(cache=True)
+    assert [f.__dict__ for f in f1] == [f.__dict__ for f in f2]
+    assert any("cached" in n for n in notes)
+    cache = os.path.join(REPO, "native", "build", "analysis-cache",
+                         "codec-checker.json")
+    assert os.path.exists(cache)
+
+
+# ---------------------------------------------------------------------
+# codec invariant fixtures (clang.cindex layer)
+# ---------------------------------------------------------------------
+
+BAD_BALANCE = r"""
+typedef struct { unsigned char *buf; long len, cap; } wr;
+int wr_reserve(wr *w, long extra);
+int wr_u8(wr *w, unsigned char b);
+int pack_obj(wr *w, void *obj);
+
+int pack_pair(wr *w, void *a, void *b) {
+    if (wr_u8(w, 0x93) < 0) return -1;   /* declares THREE elements */
+    if (pack_obj(w, a) < 0) return -1;
+    if (pack_obj(w, b) < 0) return -1;   /* ...but packs two */
+    return 0;
+}
+"""
+
+GOOD_BALANCE = BAD_BALANCE.replace("0x93", "0x92").replace(
+    "/* declares THREE elements */", "")
+
+BAD_BOUNDS = r"""
+typedef struct { const unsigned char *p, *end; } rd;
+
+unsigned read_two(rd *r) {            /* no need()/end check at all */
+    unsigned v = r->p[0];
+    v = (v << 8) | r->p[1];
+    r->p += 2;
+    return v;
+}
+"""
+
+GOOD_BOUNDS = r"""
+typedef struct { const unsigned char *p, *end; } rd;
+
+unsigned read_two(rd *r) {
+    if (r->end - r->p < 2) return 0;
+    unsigned v = r->p[0];
+    v = (v << 8) | r->p[1];
+    r->p += 2;
+    return v;
+}
+"""
+
+BAD_LEAK = r"""
+typedef long Py_ssize_t;
+void *PyMem_Malloc(Py_ssize_t n);
+void PyMem_Free(void *p);
+int use(void *p);
+
+int convert(Py_ssize_t n) {
+    void *tmp = PyMem_Malloc(n);
+    if (!tmp) return -3;
+    if (use(tmp) < 0) return -1;      /* error path leaks tmp */
+    return 0;
+}
+"""
+
+GOOD_LEAK = BAD_LEAK.replace(
+    "    if (use(tmp) < 0) return -1;      /* error path leaks tmp */",
+    "    if (use(tmp) < 0) { PyMem_Free(tmp); return -1; }\n"
+    "    PyMem_Free(tmp);")
+
+
+def _check_snippet(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(src)
+    findings, notes = native_gate.check_codec_file(str(p))
+    assert not any("skipped" in n for n in notes), notes
+    return findings
+
+
+@pytest.mark.skipif(not _cindex_available(), reason="libclang missing")
+def test_codec_balance_fixture(tmp_path):
+    got = _check_snippet(tmp_path, "bad_balance.c", BAD_BALANCE)
+    assert [f.rule for f in got] == ["codec-balance"]
+    assert _check_snippet(tmp_path, "good_balance.c", GOOD_BALANCE) == []
+
+
+@pytest.mark.skipif(not _cindex_available(), reason="libclang missing")
+def test_codec_bounds_fixture(tmp_path):
+    got = _check_snippet(tmp_path, "bad_bounds.c", BAD_BOUNDS)
+    assert [f.rule for f in got] == ["codec-bounds"]
+    assert _check_snippet(tmp_path, "good_bounds.c", GOOD_BOUNDS) == []
+
+
+@pytest.mark.skipif(not _cindex_available(), reason="libclang missing")
+def test_codec_leak_fixture(tmp_path):
+    got = _check_snippet(tmp_path, "bad_leak.c", BAD_LEAK)
+    assert [f.rule for f in got] == ["codec-leak"]
+    assert _check_snippet(tmp_path, "good_leak.c", GOOD_LEAK) == []
+
+
+@pytest.mark.skipif(not _cindex_available(), reason="libclang missing")
+def test_codec_c_comment_suppression(tmp_path):
+    src = BAD_BOUNDS.replace(
+        "unsigned read_two(rd *r) {            "
+        "/* no need()/end check at all */",
+        "/* fbtpu-lint: allow(codec-bounds) */\n"
+        "unsigned read_two(rd *r) {")
+    assert _check_snippet(tmp_path, "allowed.c", src) == []
+
+
+# ---------------------------------------------------------------------
+# gcc -fanalyzer layer
+# ---------------------------------------------------------------------
+
+def test_gcc_analyzer_detects_a_leak(tmp_path):
+    import shutil
+
+    if shutil.which("gcc") is None:
+        pytest.skip("gcc missing")
+    src = tmp_path / "leak.c"
+    src.write_text(
+        "#include <stdlib.h>\n"
+        "int f(int n) {\n"
+        "    int *p = malloc(n);\n"
+        "    if (n < 0) return -1;\n"
+        "    p[0] = 1; free(p); return 0;\n"
+        "}\n")
+    findings, notes = native_gate.run_gcc_analyzer(
+        root=str(tmp_path), cache=False, sources=[(str(src), "c")])
+    assert any("-Wanalyzer-malloc-leak" in f.message for f in findings), \
+        (findings, notes)
+
+
+# ---------------------------------------------------------------------
+# --baseline / --write-baseline (CI diffs instead of flag days)
+# ---------------------------------------------------------------------
+
+def test_baseline_mode_subtracts_legacy_debt(tmp_path):
+    bad = tmp_path / "fluentbit_tpu" / "plugins"
+    bad.mkdir(parents=True)
+    (bad / "legacy.py").write_text(
+        "class F:\n"
+        "    def init(self):\n"
+        "        try:\n"
+        "            self._t = build()\n"
+        "        except Exception:\n"
+        "            self._t = None\n")
+    base = tmp_path / "baseline.json"
+    # snapshot the legacy debt
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis",
+         "--write-baseline", str(base), str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(base.read_text())
+    assert data["version"] == 1 and len(data["findings"]) == 1
+    # same tree + baseline → clean exit, finding reported as baselined
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis",
+         "--baseline", str(base), str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "1 baselined" in proc.stdout
+    # NEW debt is not grandfathered: add a second bad file → exit 1,
+    # only the new finding listed
+    (bad / "fresh.py").write_text(
+        "def f(x):\n"
+        "    try:\n"
+        "        return go(x)\n"
+        "    except Exception:\n"
+        "        return None\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluentbit_tpu.analysis",
+         "--baseline", str(base), str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    assert "fresh.py" in proc.stdout
+    assert "legacy.py" not in proc.stdout.replace(
+        str(bad), "")  # path echo in header aside, no legacy finding

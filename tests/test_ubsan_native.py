@@ -1,0 +1,150 @@
+"""UndefinedBehaviorSanitizer pass over the native data plane.
+
+ASan/TSan cover memory safety and races; this lane isolates UB —
+signed overflow in offset math, misaligned loads in the byte-pair
+staging, shift overflows in the msgpack width packing, invalid bool
+loads — with ``-fsanitize=undefined`` alone and
+``-fno-sanitize-recover`` so the FIRST report aborts the driver (an
+ASan+UBSan combined build, as in test_asan_native.py, keeps UBSan in
+recovering mode and a report there only prints). Drives the scanner
+trio + fused filter over byte soup AND the whole-chunk JSON transcoder
+(``parser_json_batch``), which the ASan driver predates.
+
+Shares the ``sanitizer`` marker (tests/conftest.py) with the other
+lanes: ``-m sanitizer`` selects, ``-m 'not sanitizer'`` sheds.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.sanitizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = r"""
+import os, random, sys
+sys.path.insert(0, %(repo)r)
+import fluentbit_tpu.native as native
+native._SO = %(so)r
+native._tried = False
+native._lib = None
+os.environ.pop("FBTPU_NO_NATIVE", None)
+from fluentbit_tpu.codec.events import encode_event
+from fluentbit_tpu.regex.dfa import compile_dfa
+
+assert native.available(), "ubsan .so failed to load"
+tables = native.GrepFilterTables(
+    [(b"log", compile_dfa("GET|time?out"), False)], "legacy")
+rng = random.Random(23)
+for n in (1, 3, 16, 257, 4097):
+    buf = bytearray()
+    for i in range(n):
+        buf += encode_event(
+            {"log": ("GET /x " if i %% 2 else "zzz ") + "a" * (i %% 97)},
+            float(i))
+    raw = bytes(buf)
+    assert native.grep_filter(raw, tables) is not None
+    native.stage_field(raw, b"log", 96, n_hint=n)
+    native.count_records(raw)
+    native.scan_offsets(raw)
+    for _ in range(15):
+        mut = bytearray(raw)
+        for _ in range(rng.randrange(1, 8)):
+            mut[rng.randrange(len(mut))] = rng.randrange(256)
+        cut = bytes(mut[: rng.randrange(1, len(mut) + 1)])
+        native.grep_filter(cut, tables)
+        native.stage_field(cut, b"log", 64)
+        native.count_records(cut)
+        native.scan_offsets(cut)
+
+# --- codec extension: decode/pack + the JSON transcoder ---
+import fluentbit_tpu.codec._native_codec as nc
+nc._SO = %(codec_so)r
+nc._mod, nc._tried = None, False
+mod = nc.load()
+assert mod is not None, "ubsan codec extension failed to load"
+from fluentbit_tpu.codec.msgpack import EventTime
+
+docs = [
+    '{"a": 1, "wide": 5000000000, "neg": -2147483649}',
+    '{"f": 1e308, "tiny": -1e-308, "nan": NaN, "inf": -Infinity}',
+    '{"esc": "\\u00e9\\ud834\\udd1e\\n", "nest": {"x": [1, 2.5]}}',
+    '{"dup": 1, "dup": {"last": true}}',
+    'not json', '[]', '{}',
+]
+good = b"".join(
+    encode_event({"log": docs[i %% len(docs)], "n": i},
+                 EventTime(1700000000 + i, 7) if i %% 2 else float(i))
+    for i in range(256))
+out, n, parsed = mod.parser_json_batch(good, b"log")
+assert n == 256 and parsed > 0, (n, parsed)
+assert mod.decode_events(out)
+for _ in range(200):
+    mut = bytearray(good)
+    for _ in range(rng.randrange(1, 10)):
+        mut[rng.randrange(len(mut))] = rng.randrange(256)
+    cut = bytes(mut[: rng.randrange(1, len(mut) + 1)])
+    for fn in (lambda b: mod.parser_json_batch(b, b"log"),
+               mod.decode_events):
+        try:
+            fn(cut)
+        except ValueError:
+            pass  # malformed/declined is fine; UB is not
+for _ in range(60):
+    body = {"s": "y" * rng.randrange(300), "l": [1, {"k": (2, 3)}],
+            "i": rng.randrange(-2**63, 2**64 - 1)}
+    mod.pack_event(EventTime(1, 2), {}, body)
+print("UBSAN_DRIVER_OK")
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="linux toolchain")
+def test_native_data_plane_under_ubsan(tmp_path):
+    libubsan = subprocess.run(
+        ["g++", "-print-file-name=libubsan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libubsan or not os.path.exists(libubsan):
+        pytest.skip("libubsan unavailable")
+    so = str(tmp_path / "fbtpu_ubsan.so")
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-fPIC", "-shared", "-std=c++17",
+         "-pthread", "-fsanitize=undefined",
+         "-fno-sanitize-recover=undefined",
+         os.path.join(REPO, "native", "fbtpu_native.cpp"), "-o", so],
+        capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip(f"ubsan build failed: {build.stderr[-400:]}")
+    import sysconfig
+
+    include = sysconfig.get_paths().get("include")
+    codec_so = str(tmp_path / "fbtpu_codec_ubsan.so")
+    cbuild = subprocess.run(
+        ["gcc", "-O1", "-g", "-fPIC", "-shared",
+         "-fsanitize=undefined", "-fno-sanitize-recover=undefined",
+         "-I", include or ".",
+         os.path.join(REPO, "native", "fbtpu_codec.c"),
+         "-o", codec_so],
+        capture_output=True, text=True, timeout=300)
+    if cbuild.returncode != 0:
+        pytest.skip(f"ubsan codec build failed: {cbuild.stderr[-400:]}")
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": libubsan,
+        "UBSAN_OPTIONS": "print_stacktrace=1:halt_on_error=1",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "FBTPU_THREADS_NO_HW_CAP": "1",
+        "FBTPU_DFA_THREADS": "2",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         DRIVER % {"repo": REPO, "so": so, "codec_so": codec_so}],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, (
+        f"ubsan report (rc={proc.returncode}):\n"
+        f"{proc.stdout[-1000:]}\n{proc.stderr[-3000:]}")
+    assert "UBSAN_DRIVER_OK" in proc.stdout
+    assert "runtime error:" not in proc.stderr
