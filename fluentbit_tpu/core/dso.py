@@ -66,6 +66,90 @@ def plugin_stem(path: str) -> str:
     return stem
 
 
+def elf_has_export(path: str, names) -> Optional[bool]:
+    """Probe the ELF dynamic symbol table for any of ``names`` WITHOUT
+    loading the object — dlopen runs static initializers/constructors,
+    and the 'rejected objects must never be mapped' invariant says a
+    malformed plugin's code must never execute. Returns True/False, or
+    None when the file is not parseable as ELF (non-ELF platforms fall
+    back to dlopen-and-check)."""
+    import struct as _s
+
+    want = {n.encode() if isinstance(n, str) else n for n in names}
+    try:
+        with open(path, "rb") as f:
+            ident = f.read(16)
+            if len(ident) < 16 or ident[:4] != b"\x7fELF":
+                return None
+            is64 = ident[4] == 2
+            end = "<" if ident[5] == 1 else ">"
+            if is64:
+                f.seek(40)
+                (shoff,) = _s.unpack(end + "Q", f.read(8))
+                f.seek(58)
+                shentsize, shnum = _s.unpack(end + "HH", f.read(4))
+            else:
+                f.seek(32)
+                (shoff,) = _s.unpack(end + "I", f.read(4))
+                f.seek(46)
+                shentsize, shnum = _s.unpack(end + "HH", f.read(4))
+            if not shoff or not shnum or shnum > 65535:
+                return None
+            sections = []
+            for i in range(shnum):
+                f.seek(shoff + i * shentsize)
+                hdr = f.read(shentsize)
+                if is64:
+                    typ, = _s.unpack_from(end + "I", hdr, 4)
+                    link, = _s.unpack_from(end + "I", hdr, 40)
+                    off, size = _s.unpack_from(end + "QQ", hdr, 24)
+                    entsize, = _s.unpack_from(end + "Q", hdr, 56)
+                else:
+                    typ, = _s.unpack_from(end + "I", hdr, 4)
+                    off, size = _s.unpack_from(end + "II", hdr, 16)
+                    link, = _s.unpack_from(end + "I", hdr, 24)
+                    entsize, = _s.unpack_from(end + "I", hdr, 36)
+                sections.append((typ, off, size, link, entsize))
+            for typ, off, size, link, entsize in sections:
+                if typ != 11:  # SHT_DYNSYM
+                    continue
+                if link >= len(sections) or not entsize:
+                    return None
+                _t, stroff, strsize, _l, _e = sections[link]
+                f.seek(stroff)
+                strtab = f.read(strsize)
+                f.seek(off)
+                syms = f.read(size)
+                shndx_off = 6 if is64 else 14
+                for so in range(0, len(syms) - entsize + 1, entsize):
+                    (name_off,) = _s.unpack_from(end + "I", syms, so)
+                    if not name_off or name_off >= len(strtab):
+                        continue
+                    # an UNDEFINED entry (st_shndx == SHN_UNDEF) is an
+                    # import, not an export: an object that merely
+                    # REFERENCES FLBPluginRegister must not pass
+                    (shndx,) = _s.unpack_from(end + "H", syms,
+                                              so + shndx_off)
+                    if shndx == 0:
+                        continue
+                    nul = strtab.find(b"\x00", name_off)
+                    if strtab[name_off:nul] in want:
+                        return True
+                return False
+            return None  # stripped of dynsym: undecidable
+    except (OSError, _s.error):
+        return None
+
+
+def _probe_exports(path: str, names, kind: str) -> None:
+    """Reject (pre-dlopen) an object that exports none of ``names``."""
+    if elf_has_export(path, names) is False:
+        raise ValueError(
+            f"cannot load {kind} {path!r}: registration structure is "
+            f"missing ({' / '.join(sorted(str(n) for n in names))}) — "
+            f"rejected before mapping; constructors never ran")
+
+
 def _props_json(instance) -> bytes:
     props = {}
     for _lk, key, value in instance.properties._items:
@@ -88,6 +172,10 @@ def load_dso_plugin(path: str, registry=None):
         # Go-proxy-contract object, whose name comes from the plugin
         # itself (FLBPluginRegister), not the file
         return load_proxy_plugin(path, registry)
+    # probe the export table BEFORE dlopen: a rejected object's static
+    # initializers must never run (ADVICE.md: the invariant regressed
+    # when the proxy fallback made every stem loadable)
+    _probe_exports(path, {symbol, "FLBPluginRegister"}, "plugin")
     try:
         dso = ctypes.CDLL(os.path.abspath(path))
     except OSError as e:
@@ -264,13 +352,15 @@ _LOG_CHECK_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
 
 
 class _FlbApi(ctypes.Structure):
-    """struct flb_api — field ORDER is the ABI (flb_api.c:29-54,
-    metrics accessors included as the reference builds them in)."""
+    """struct flb_api — field ORDER is the ABI. The layout follows
+    include/fluent-bit/flb_api.h (NOT flb_api.c's assignment order):
+    the header appends custom_get_property/custom_log_check at the END
+    'to preserve ABI', so a cgo-built fluent-bit-go plugin compiled
+    against the header indexes slots 2-6 as the cmt/log entries."""
 
     _fields_ = [
         ("output_get_property", _GET_PROP_FN),
         ("input_get_property", _GET_PROP_FN),
-        ("custom_get_property", _GET_PROP_FN),
         ("output_get_cmt_instance",
          ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_void_p)),
         ("input_get_cmt_instance",
@@ -278,6 +368,7 @@ class _FlbApi(ctypes.Structure):
         ("log_print", ctypes.c_void_p),  # variadic: not bridged
         ("input_log_check", _LOG_CHECK_FN),
         ("output_log_check", _LOG_CHECK_FN),
+        ("custom_get_property", _GET_PROP_FN),
         ("custom_log_check", _LOG_CHECK_FN),
     ]
 
@@ -362,9 +453,19 @@ def _make_api() -> _FlbApi:
     api.input_get_property = get_prop
     api.custom_get_property = get_prop
     api.log_print = None
-    api.input_log_check = _LOG_CHECK_FN(lambda _i, _l: 0)
-    api.output_log_check = _LOG_CHECK_FN(lambda _i, _l: 0)
-    api.custom_log_check = _LOG_CHECK_FN(lambda _i, _l: 0)
+    # FBTPU_DSO_API_PROBE=1 makes the three log_check slots return
+    # distinct per-kind values (1/2/3) so the ABI tests can PROVE a
+    # call reached its exact slot — an order regression hands back a
+    # neighbouring entry. Production keeps the quiet 0 for all kinds
+    # (log_check is a boolean gate; a nonzero stub would flood plugins
+    # that log whenever their level "passes").
+    probe = os.environ.get("FBTPU_DSO_API_PROBE") == "1"
+    api.input_log_check = _LOG_CHECK_FN(
+        lambda _i, _l: 1 if probe else 0)
+    api.output_log_check = _LOG_CHECK_FN(
+        lambda _i, _l: 2 if probe else 0)
+    api.custom_log_check = _LOG_CHECK_FN(
+        lambda _i, _l: 3 if probe else 0)
     # pin the closures with the struct
     api._refs = (get_prop, api.input_log_check, api.output_log_check,
                  api.custom_log_check)
@@ -387,6 +488,9 @@ def load_proxy_plugin(path: str, registry=None):
     from .plugin import registry as default_registry
 
     reg = registry if registry is not None else default_registry
+    # pre-dlopen probe: an object without the registration export is
+    # rejected before any of its code can run
+    _probe_exports(path, {"FLBPluginRegister"}, "proxy plugin")
     try:
         dso = ctypes.CDLL(os.path.abspath(path))
     except OSError as e:

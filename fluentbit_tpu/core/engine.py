@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .. import failpoints as _fp
 from ..codec.chunk import Chunk, EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES
 from ..codec.events import LogEvent, decode_events, reencode_event
 from .config import ServiceConfig
@@ -158,6 +159,13 @@ class Engine:
         self.m_memrb_dropped_bytes = m.counter(
             "fluentbit", "input", "memrb_dropped_bytes_total",
             "Bytes evicted by memrb ring buffer", ("name",))
+        # fault-injection observability: every armed failpoint that
+        # actually fires shows up here, so a soak run (or a forgotten
+        # armed site in staging) is visible on the same dashboards as
+        # the errors it provokes
+        self.m_failpoint_triggered = m.counter(
+            "fluentbit", "", "failpoint_triggered_total",
+            "Faults triggered by the failpoint plane", ("name",))
 
     # ------------------------------------------------------------------
     # configuration
@@ -400,6 +408,9 @@ class Engine:
                 out.worker_pool = OutputWorkerPool(
                     out.display_name, out.workers, out.plugin)
         self.started_at = time.time()
+        # failpoint trigger → metric bridge (unarmed plane: the listener
+        # list is only walked when a fault actually fires)
+        _fp.add_listener(self._on_failpoint_trigger)
         self._stopping = False
         self._stop_event.clear()
         self._thread = threading.Thread(target=self._run, name="flb-engine", daemon=True)
@@ -574,8 +585,16 @@ class Engine:
                 ins.plugin.exit()
             except Exception:
                 log.exception("%s exit failed", ins.display_name)
-        if self.storage is not None:
-            self.storage.close()
+        try:
+            if self.storage is not None:
+                self.storage.close()
+        finally:
+            # always release the module-global listener: a teardown
+            # error must not pin this engine (and its metrics) forever
+            _fp.remove_listener(self._on_failpoint_trigger)
+
+    def _on_failpoint_trigger(self, name: str, _action: str) -> None:
+        self.m_failpoint_triggered.inc(1, (name,))
 
     @property
     def running(self) -> bool:
@@ -1112,6 +1131,24 @@ class Engine:
                         )
                     if drained_ok:
                         ins.set_paused(False)
+        if _fp.ACTIVE and chunks:
+            # between finalize and task spawn: a crash here leaves every
+            # drained chunk finalized-but-undelivered on disk — the
+            # strictest recovery case (all bytes + CRCs present, zero
+            # delivery acks)
+            try:
+                _fp.fire("engine.flush_dispatch")
+            except _fp.FailpointError:
+                # injected non-crash dispatch failure: this cycle is
+                # aborted, but the chunks were already drained from
+                # their pools — park them for the next cycle instead of
+                # letting the error kill the engine loop (panic keeps
+                # its bug semantics and propagates)
+                log.warning("flush dispatch failed (injected); %d "
+                            "chunk(s) re-queued", len(chunks))
+                with self._ingest_lock:
+                    self._backlog.extend(c for _i, c in chunks)
+                return
         for ci, (ins, chunk) in enumerate(chunks):
             if chunk.routes_mask:
                 # conditionally-split chunk: the ingest-time bitmask IS
@@ -1247,6 +1284,8 @@ class Engine:
             if self.storage is not None and \
                     not self.storage.is_tracked(task.chunk):
                 try:
+                    if _fp.ACTIVE:
+                        _fp.fire("engine.shutdown_quarantine")
                     self.storage.quarantine(task.chunk)
                 except Exception:
                     log.exception("shutdown quarantine failed")
@@ -1341,6 +1380,18 @@ class Engine:
         pending retry records are quarantined like any undelivered
         route."""
         key = (task.chunk.id, out.name)
+        if _fp.ACTIVE:
+            try:
+                # retry infrastructure failure: the chunk's retry cannot
+                # be scheduled — account it like a shutdown-dropped
+                # retry (quarantine + drop metrics), never silently leak
+                # the task-map slot
+                _fp.fire("engine.retry_schedule")
+            except _fp.FailpointError:
+                log.warning("retry scheduling failed (injected); "
+                            "dropping retry for %s", out.display_name)
+                self._drop_retry(task, out)
+                return
 
         def _fire():
             from .bucket_queue import PRIORITY_TOP
@@ -1376,6 +1427,8 @@ class Engine:
         if self.storage is not None and \
                 not self.storage.is_tracked(task.chunk):
             try:
+                if _fp.ACTIVE:
+                    _fp.fire("engine.shutdown_quarantine")
                 self.storage.quarantine(task.chunk)
             except Exception:
                 log.exception("retry quarantine failed")

@@ -17,6 +17,9 @@ Endpoints:
   POST /api/v2/reload          trigger hot reload (requires the host
                                process to wire engine.reload_callback,
                                e.g. the CLI's SIGHUP path)
+  GET    /api/v1/failpoints          armed failpoints + trigger counts
+  POST   /api/v1/failpoints/<name>   arm ({"spec": "..."} or raw spec)
+  DELETE /api/v1/failpoints[/<name>] disarm one / all (FAULTS.md)
 """
 
 from __future__ import annotations
@@ -83,6 +86,8 @@ class AdminServer:
         e = self.engine
         if path.startswith("/api/v1/trace"):
             return self._route_trace(method, path, req_body)
+        if path.startswith("/api/v1/failpoints"):
+            return self._route_failpoints(method, path, req_body)
         if path == "/":
             return 200, json.dumps(
                 {"fluentbit_tpu": {"version": _version(),
@@ -135,6 +140,55 @@ class AdminServer:
                 {"hot_reload_count": e.reload_count}
             ).encode(), "application/json"
         return 404, b"not found\n", "text/plain"
+
+    def _route_failpoints(self, method: str, path: str, req_body: bytes):
+        """Fault-injection control (mirrors the chunk-trace tap):
+        GET /api/v1/failpoints — armed sites + counters;
+        POST /api/v1/failpoints/<name> — arm with the body's spec
+        ({"spec": "..."} JSON or a raw DSL string);
+        DELETE /api/v1/failpoints[/<name>] — disarm one or all."""
+        from .. import failpoints as fp
+
+        parts = [p for p in path.split("/") if p]
+        name = parts[3] if len(parts) > 3 else None
+        if method == "GET":
+            return 200, json.dumps({
+                "failpoints": fp.snapshot(),
+                "sites": list(fp.SITES),
+                "http_control": fp.http_control_enabled(),
+            }).encode(), "application/json"
+        if not fp.http_control_enabled():
+            # the admin port doubles as the metrics endpoint and often
+            # listens on 0.0.0.0 — arming faults (crash = SIGKILL) over
+            # it requires the launch-time opt-in
+            return 403, (b'{"error": "failpoint mutation disabled; '
+                         b'launch with FBTPU_FAILPOINTS_HTTP=1"}\n'), \
+                "application/json"
+        if method == "POST":
+            if name is None:
+                return 400, b'{"error": "failpoint name required"}\n', \
+                    "application/json"
+            spec = req_body.decode("utf-8", "replace").strip()
+            try:
+                obj = json.loads(spec)
+                if isinstance(obj, dict):
+                    spec = str(obj.get("spec", ""))
+            except ValueError:
+                pass  # raw DSL body
+            try:
+                fp.enable(name, spec)
+            except ValueError as e:
+                return 400, json.dumps({"error": str(e)}).encode(), \
+                    "application/json"
+            return 200, b'{"status": "ok"}\n', "application/json"
+        if method == "DELETE":
+            if name is None:
+                fp.reset()
+                return 200, b'{"status": "ok"}\n', "application/json"
+            if fp.disable(name):
+                return 200, b'{"status": "ok"}\n', "application/json"
+            return 404, b'{"error": "not armed"}\n', "application/json"
+        return 400, b"", "application/json"
 
     def _route_trace(self, method: str, path: str, req_body: bytes):
         """Chunk-trace control (src/http_server/api/v1/trace.c):

@@ -19,6 +19,8 @@ import logging
 import threading
 from typing import List, Optional
 
+from .. import failpoints as _fp
+
 log = logging.getLogger("flb.output_thread")
 
 
@@ -72,6 +74,12 @@ class OutputWorkerPool:
     def submit(self, coro) -> "asyncio.Future":
         """Run the coroutine on the next worker loop (round-robin);
         returns an awaitable for the CALLING loop."""
+        if _fp.ACTIVE:
+            try:
+                _fp.fire("output.worker_flush")
+            except BaseException:
+                coro.close()  # never leak a never-awaited coroutine
+                raise
         loop = self._loops[next(self._rr) % len(self._loops)]
         cf = asyncio.run_coroutine_threadsafe(coro, loop)
         return asyncio.wrap_future(cf)

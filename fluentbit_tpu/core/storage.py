@@ -21,8 +21,9 @@ routes field — still load with tag routing; route NAMES, not bit
 positions, so conditional routing survives output reordering). state
 0 = open (crc not yet valid, a crash left
 it un-finalized — payload is still recovered), 1 = finalized (crc32 of
-the payload must match; mismatch → the file is renamed ``.corrupt`` and
-skipped, mirroring chunkio's checksum failure handling).
+the payload must match; mismatch → the file is quarantined into
+``dlq/<name>.corrupt`` and skipped, so operators find every rejected
+payload — hard-errored chunks and corruption alike — in one place).
 """
 
 from __future__ import annotations
@@ -41,6 +42,7 @@ from ..codec.chunk import (
     EVENT_TYPE_PROFILES,
     EVENT_TYPE_TRACES,
 )
+from .. import failpoints as _fp
 
 log = logging.getLogger("flb.storage")
 
@@ -96,6 +98,12 @@ class Storage:
 
     def write_through(self, chunk: Chunk, data: bytes) -> None:
         """Persist an append immediately (crash-safe up to this write)."""
+        if _fp.ACTIVE:
+            # partial(n): torn write — persist only the first n bytes of
+            # this append (recovery truncates at the last full record)
+            d = _fp.fire("storage.append")
+            if d is not None and d[0] == "partial":
+                data = data[: d[1]]
         entry = self._files.get(chunk.id)
         if entry is None:
             path = self._chunk_path(chunk)
@@ -110,6 +118,10 @@ class Storage:
             entry = self._files[chunk.id]
         f = entry[0]
         f.write(data)
+        if _fp.ACTIVE:
+            # a crash here loses the buffered (written-but-unflushed)
+            # append — the exact window write-through exists to bound
+            _fp.fire("storage.flush")
         f.flush()
 
     def finalize(self, chunk: Chunk) -> None:
@@ -117,6 +129,10 @@ class Storage:
         entry = self._files.get(chunk.id)
         if entry is None or entry[0] is None:
             return
+        if _fp.ACTIVE:
+            # a crash here leaves the chunk state=open on disk: recovery
+            # must still replay the full payload (un-finalized contract)
+            _fp.fire("storage.finalize")
         f, path = entry
         crc = zlib.crc32(chunk.get_bytes()) & 0xFFFFFFFF if self.checksum else 0
         f.flush()
@@ -189,6 +205,11 @@ class Storage:
             tag = f.read(tag_len).decode("utf-8")
             payload = f.read()
         if state == STATE_FINAL and self.checksum and crc:
+            if _fp.ACTIVE:
+                # return(err) forces the corrupt-chunk path for a chunk
+                # whose bytes are actually fine (quarantine plumbing
+                # can be exercised without hand-flipping file bytes)
+                _fp.fire("storage.crc_verify")
             if zlib.crc32(payload) & 0xFFFFFFFF != crc:
                 raise ValueError("crc mismatch")
         from ..codec.msgpack import Unpacker
@@ -211,7 +232,12 @@ class Storage:
 
     def scan_backlog(self) -> List[Chunk]:
         """Recover chunks left on disk by a previous run; corrupt files
-        are renamed ``.corrupt`` and skipped."""
+        are quarantined into the DLQ directory (``<name>.corrupt``) so
+        operators find every rejected payload in one place."""
+        if _fp.ACTIVE:
+            # crash here = dying mid-recovery: the NEXT restart must
+            # still recover everything (recovery is idempotent)
+            _fp.fire("storage.backlog_load")
         out: List[Chunk] = []
         for dirpath, _dirs, files in os.walk(self.streams_dir):
             for name in sorted(files):
@@ -221,11 +247,14 @@ class Storage:
                 try:
                     chunk = self._read_chunk_file(path)
                 except Exception as e:
-                    log.warning("storage: corrupt chunk %s (%s)", path, e)
+                    log.warning("storage: corrupt chunk %s (%s) "
+                                "quarantined to DLQ", path, e)
                     try:
-                        os.rename(path, path + ".corrupt")
+                        os.rename(path, os.path.join(
+                            self.dlq_dir, name + ".corrupt"))
                     except OSError:
-                        pass
+                        log.exception("storage: cannot quarantine %s",
+                                      path)
                     continue
                 if chunk.records == 0:
                     try:

@@ -66,8 +66,13 @@ async def open_connection(ins, host: str, port: int, timeout=None):
     TTL-cached resolver (core.upstream.resolve, the c-ares role)."""
     import asyncio
 
+    from .. import failpoints as _fp
     from .upstream import invalidate_dns, resolve
 
+    if _fp.ACTIVE:
+        # FailpointError is an OSError: every caller's dial-failure
+        # handling (pool drop, node cooloff, RETRY) engages as-is
+        _fp.fire("upstream.connect")
     ctx = client_context(ins)
     try:
         addrs = await resolve(host, port)

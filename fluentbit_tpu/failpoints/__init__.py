@@ -1,0 +1,342 @@
+"""fbtpu-failpoints — deterministic fault-injection plane.
+
+Modeled on etcd's gofail / tikv's fail-rs: the data plane carries named
+*failpoints* — storage appends, flush dispatch, retry scheduling,
+upstream I/O, the native-codec decline path, device attach — and each
+can be armed at runtime with a small action DSL. Unarmed, the whole
+plane costs one module-level boolean check per site (``ACTIVE``); the
+hot path is untouched and bit-exact.
+
+DSL (one spec per failpoint)::
+
+    spec   := term ( "->" term )*        terms consumed left to right
+    term   := [pct "%"] [cnt "*"] action [ "(" arg ")" ]
+    action := off | return | delay | partial | panic | crash
+
+- ``off``          no-op (with ``cnt*`` it skips the first cnt hits)
+- ``return(err)``  raise :class:`FailpointError` (an ``OSError``
+  subclass, so existing socket/file error handling — retries, pool
+  drops, backoff — engages exactly as for a real fault)
+- ``delay(ms)``    sleep ``ms`` milliseconds, then continue
+- ``partial(n)``   hand the site a ``("partial", n)`` directive — write
+  sites truncate the operation's payload to ``n`` bytes (a torn write)
+- ``panic``        raise ``RuntimeError`` (a plugin bug, not an I/O
+  error: broad except-and-log paths engage, retries do not)
+- ``crash``        kill the process immediately (SIGKILL semantics —
+  no atexit, no flush, no drain; the crash-recovery soak harness's
+  primitive)
+
+A term with ``cnt*`` fires at most ``cnt`` times, then control moves to
+the next term: ``2*off->1*crash`` crashes on the third hit. A term with
+``pct%`` fires with that probability per hit, drawn from a
+*deterministic per-site RNG* seeded from ``FBTPU_FAILPOINTS_SEED`` and
+the failpoint name — identical runs replay identical fault schedules.
+
+Control surfaces (mirroring the chunk-trace tap):
+
+- env: ``FBTPU_FAILPOINTS="storage.append=2*off->1*crash;upstream.send=25%return(reset)"``
+- programmatic: :func:`enable` / :func:`disable` / :func:`reset` /
+  :func:`snapshot`
+- HTTP: ``GET/POST/DELETE /api/v1/failpoints[/<name>]`` on the admin
+  server
+
+Every trigger is observable: the engine exports
+``fluentbit_failpoint_triggered_total{name}`` via a listener hook
+(:func:`add_listener`), and :func:`snapshot` reports per-site
+evaluated/triggered counts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import re
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("flb.failpoints")
+
+#: The one hot-path cost of the plane: sites check ``failpoints.ACTIVE``
+#: before calling :func:`fire`. False whenever no failpoint is armed.
+ACTIVE = False
+
+ENV_VAR = "FBTPU_FAILPOINTS"
+SEED_VAR = "FBTPU_FAILPOINTS_SEED"
+HTTP_VAR = "FBTPU_FAILPOINTS_HTTP"
+
+#: Documented injection sites (the inventory FAULTS.md describes).
+#: :func:`fire` accepts any name — tests may add ad-hoc sites (the soak
+#: sink's ``soak.deliver``) — but these are the ones threaded through
+#: the shipped data plane.
+SITES: Tuple[str, ...] = (
+    "storage.append",            # Storage.write_through, before the write
+    "storage.flush",             # Storage.write_through, write buffered / not yet flushed
+    "storage.finalize",          # Storage.finalize, before the CRC stamp
+    "storage.crc_verify",        # Storage._read_chunk_file, before the CRC check
+    "storage.backlog_load",      # Storage.scan_backlog, before the walk
+    "engine.flush_dispatch",     # Engine.flush_all, chunks finalized, tasks not yet spawned
+    "engine.retry_schedule",     # Engine._schedule_retry, before the timer registers
+    "engine.shutdown_quarantine",  # Engine._flush_one / _drop_retry, before quarantine
+    "upstream.connect",          # tls.open_connection, before the dial
+    "upstream.send",             # outputs_aws._http_request, before the request write
+    "upstream.recv",             # outputs_aws._http_request, before the response read
+    "output.worker_flush",       # OutputWorkerPool.submit, before the handoff
+    "codec.fallback",            # filter_parser batched JSON path: forced decline
+    "device.attach",             # ops.device._attach_worker, before backend init
+    "s3.upload_part",            # outputs_aws._mp_upload_part (RETRY repro site)
+    "s3.complete",               # outputs_aws._mp_complete
+)
+
+
+class FailpointError(OSError):
+    """The injected failure for ``return(err)`` terms.
+
+    Subclasses ``OSError`` deliberately: I/O sites funnel it through
+    their real error handling (connection-retry, pool-drop, RETRY
+    backoff) instead of needing failpoint-aware except clauses.
+    """
+
+
+_ACTIONS = ("off", "return", "delay", "partial", "panic", "crash")
+
+_TERM_RE = re.compile(
+    r"^(?:(?P<pct>\d+(?:\.\d+)?)%)?"
+    r"(?:(?P<cnt>\d+)\*)?"
+    r"(?P<action>[a-z]+)"
+    r"(?:\((?P<arg>[^)]*)\))?$")
+
+
+class _Term:
+    __slots__ = ("pct", "limit", "action", "arg", "fired")
+
+    def __init__(self, pct: Optional[float], limit: Optional[int],
+                 action: str, arg: str):
+        self.pct = pct        # None = always
+        self.limit = limit    # None = unlimited (terminal term)
+        self.action = action
+        self.arg = arg
+        self.fired = 0
+
+
+def parse_spec(spec: str) -> List[_Term]:
+    """Parse a DSL spec into terms; raises ``ValueError`` on bad input
+    (the admin endpoint surfaces the message as a 400)."""
+    terms: List[_Term] = []
+    text = spec.strip()
+    if not text:
+        raise ValueError("empty failpoint spec")
+    for part in text.split("->"):
+        m = _TERM_RE.match(part.strip())
+        if m is None:
+            raise ValueError(f"bad failpoint term {part.strip()!r}")
+        action = m.group("action")
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"unknown failpoint action {action!r} "
+                f"(one of {', '.join(_ACTIONS)})")
+        pct = float(m.group("pct")) if m.group("pct") else None
+        cnt = int(m.group("cnt")) if m.group("cnt") else None
+        arg = m.group("arg") or ""
+        if action == "delay":
+            float(arg or "0")  # validate now, not at fire time
+        elif action == "partial":
+            int(arg or "0")
+        terms.append(_Term(pct, cnt, action, arg))
+    return terms
+
+
+class Failpoint:
+    """One armed site: its parsed terms + deterministic RNG + stats."""
+
+    __slots__ = ("name", "spec", "terms", "rng", "evaluated", "triggered")
+
+    def __init__(self, name: str, spec: str, seed: int):
+        self.name = name
+        self.spec = spec
+        self.terms = parse_spec(spec)
+        # per-site stream: the schedule at one site never shifts when
+        # another site is armed or fires (gofail's determinism contract)
+        self.rng = random.Random(f"{seed}:{name}")
+        self.evaluated = 0
+        self.triggered = 0
+
+
+_lock = threading.Lock()
+_registry: Dict[str, Failpoint] = {}
+_listeners: List[Callable[[str, str], None]] = []
+
+
+def _seed() -> int:
+    try:
+        return int(os.environ.get(SEED_VAR, "0"))
+    except ValueError:
+        return 0
+
+
+def _refresh_active() -> None:
+    global ACTIVE
+    ACTIVE = bool(_registry)
+
+
+def enable(name: str, spec: str) -> Failpoint:
+    """Arm (or re-arm, resetting counts) a failpoint."""
+    fp = Failpoint(name, spec, _seed())
+    with _lock:
+        _registry[name] = fp
+        _refresh_active()
+    log.warning("failpoint armed: %s = %s", name, spec)
+    return fp
+
+
+def disable(name: str) -> bool:
+    """Disarm one failpoint; True when it was armed."""
+    with _lock:
+        found = _registry.pop(name, None) is not None
+        _refresh_active()
+    return found
+
+
+def reset() -> None:
+    """Disarm everything (tests call this between cases)."""
+    with _lock:
+        _registry.clear()
+        _refresh_active()
+
+
+def snapshot() -> Dict[str, dict]:
+    """Per-site spec + counters (the admin GET body)."""
+    with _lock:
+        return {
+            name: {"spec": fp.spec, "evaluated": fp.evaluated,
+                   "triggered": fp.triggered}
+            for name, fp in _registry.items()
+        }
+
+
+def http_control_enabled() -> bool:
+    """Whether the admin server may ARM/DISARM failpoints over HTTP.
+
+    The admin port is routinely exposed for Prometheus scraping; an
+    always-on arm surface would be a remote kill switch (``crash`` is
+    SIGKILL). Mutation therefore requires a launch-time opt-in —
+    ``FBTPU_FAILPOINTS_HTTP=1`` (gofail's GOFAIL_HTTP stance) or a
+    process that already opted into fault injection via
+    ``FBTPU_FAILPOINTS``. GET stays available: reading counters is
+    harmless and belongs on dashboards.
+    """
+    flag = os.environ.get(HTTP_VAR, "").lower()
+    if flag in ("1", "on", "true", "yes"):
+        return True
+    if flag in ("0", "off", "false", "no"):
+        return False  # explicit opt-OUT wins even when env-armed
+    return bool(os.environ.get(ENV_VAR))
+
+
+def add_listener(cb: Callable[[str, str], None]) -> None:
+    """Register a trigger hook ``cb(name, action)`` — the engine wires
+    its ``fluentbit_failpoint_triggered_total`` counter here."""
+    with _lock:
+        if cb not in _listeners:
+            _listeners.append(cb)
+
+
+def remove_listener(cb: Callable[[str, str], None]) -> None:
+    with _lock:
+        if cb in _listeners:
+            _listeners.remove(cb)
+
+
+def load_env(env: Optional[str] = None) -> int:
+    """Arm failpoints from ``FBTPU_FAILPOINTS`` (``name=spec`` pairs,
+    ``;``-separated). Returns how many were armed; bad entries log and
+    are skipped (a fat-fingered env var must not take the pipeline
+    down — fault injection is opt-in chaos, not a config gate)."""
+    text = os.environ.get(ENV_VAR, "") if env is None else env
+    n = 0
+    for pair in text.split(";"):
+        pair = pair.strip()
+        if not pair:
+            continue
+        name, sep, spec = pair.partition("=")
+        if not sep or not name.strip():
+            log.error("failpoints: bad env entry %r (want name=spec)", pair)
+            continue
+        try:
+            enable(name.strip(), spec)
+            n += 1
+        except ValueError as e:
+            log.error("failpoints: bad spec for %s: %s", name.strip(), e)
+    return n
+
+
+def _crash() -> None:
+    # SIGKILL semantics: no atexit, no buffered-file flush, no grace —
+    # exactly what the soak harness needs a crash point to mean
+    try:
+        os.kill(os.getpid(), signal.SIGKILL)
+    except OSError:  # platforms without SIGKILL delivery to self
+        pass
+    os._exit(137)
+
+
+def fire(name: str) -> Optional[Tuple[str, int]]:
+    """Evaluate the failpoint at site ``name``.
+
+    Returns ``None`` (not armed / term not taken / no-op action), or a
+    site-interpreted directive tuple — currently only
+    ``("partial", n)``. Raises :class:`FailpointError` for ``return``,
+    ``RuntimeError`` for ``panic``; ``crash`` does not return.
+
+    Sites guard the call with ``if failpoints.ACTIVE:`` so an unarmed
+    plane costs one module-attribute read.
+    """
+    with _lock:
+        fp = _registry.get(name)
+        if fp is None:
+            return None
+        fp.evaluated += 1
+        term = None
+        for t in fp.terms:
+            if t.limit is None or t.fired < t.limit:
+                term = t
+                break
+        if term is None:
+            return None
+        if term.pct is not None and fp.rng.uniform(0, 100) >= term.pct:
+            return None  # probability gate: count not consumed
+        term.fired += 1
+        action, arg = term.action, term.arg
+        if action == "off":
+            return None
+        fp.triggered += 1
+        listeners = list(_listeners)
+    # action side effects run OUTSIDE the lock (delay sleeps; crash
+    # never returns; listeners may take their own locks)
+    for cb in listeners:
+        try:
+            cb(name, action)
+        except Exception:
+            log.exception("failpoint listener failed")
+    log.warning("failpoint triggered: %s -> %s(%s)", name, action, arg)
+    if action == "return":
+        raise FailpointError(f"failpoint {name}: injected error"
+                             + (f" ({arg})" if arg else ""))
+    if action == "delay":
+        time.sleep(float(arg or "0") / 1000.0)
+        return None
+    if action == "partial":
+        return ("partial", int(arg or "0"))
+    if action == "panic":
+        raise RuntimeError(f"failpoint {name}: injected panic")
+    if action == "crash":
+        _crash()
+    return None
+
+
+# arm from the environment at import: subprocess harnesses (the soak
+# children) configure the whole plane before the engine exists
+if os.environ.get(ENV_VAR):
+    load_env()

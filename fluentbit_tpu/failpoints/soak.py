@@ -1,0 +1,281 @@
+"""Crash-recovery soak harness — the failpoint plane's proving ground.
+
+A *scenario* runs the pipeline in a child process with filesystem
+storage and a failpoint armed to ``crash`` (SIGKILL semantics — no
+drain, no atexit) at a chosen data-plane site mid-ingest, then restarts
+over the same storage root and lets recovery deliver the backlog. The
+parent asserts the durability contract:
+
+1. every record whose ingest **ack** was observed (the child acks a
+   sequence number only after ``push`` returned, i.e. after the
+   write-through landed) is delivered at least once across all runs —
+   except records the scenario *declares* lossy (a torn/unflushed final
+   write: the write-through contract is "a crash loses at most the
+   last partial write");
+2. un-finalized chunks recover to the last full write, finalized chunks
+   recover completely;
+3. corruption injected into an on-disk chunk is quarantined to the DLQ
+   (never delivered, never silently dropped);
+4. delivery is at-least-once with duplicates bounded by the redelivery
+   window: a sequence delivered more than once must have been on disk
+   at crash time (run-1 delivery whose chunk file outlived the crash),
+   and no sequence is delivered more than ``1 + restarts +
+   declared_retries`` times.
+
+Child protocol (this module run with ``python -m
+fluentbit_tpu.failpoints.soak``): failpoints arrive via
+``FBTPU_FAILPOINTS`` (armed at import, before the engine exists);
+``ingested.log`` records acks, ``delivered.log`` records deliveries —
+both fsync'd per line so they survive the SIGKILL. The delivery sink
+honors a ``soak.deliver`` failpoint so retry/backoff scenarios can be
+driven from the same DSL.
+
+Used by ``tests/test_failpoints.py``: a short deterministic matrix in
+tier-1 and the full matrix behind the ``soak``/``slow`` markers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import FailpointError, fire
+
+DELIVERED_LOG = "delivered.log"
+INGESTED_LOG = "ingested.log"
+STORAGE_DIR = "storage"
+
+
+def _append_line(path: str, text: str) -> None:
+    """Append one line and force it to disk — the soak logs are the
+    ground truth the parent audits after a SIGKILL, so a buffered line
+    would make the contract check lie."""
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(text + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _register_sink():
+    """Register the soak delivery sink (idempotent per process)."""
+    from ..codec.events import decode_events
+    from ..core.config import ConfigMapEntry
+    from ..core.plugin import FlushResult, OutputPlugin, registry
+
+    if "soak_sink" in registry.outputs:
+        return
+
+    @registry.register
+    class SoakSink(OutputPlugin):
+        """Delivery ledger: one fsync'd line per delivered record."""
+
+        name = "soak_sink"
+        description = "crash-recovery soak delivery ledger"
+        config_map = [
+            ConfigMapEntry("path", "str"),
+            ConfigMapEntry("run_id", "str", default="0"),
+        ]
+
+        async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+            from .. import failpoints as _fp
+
+            if _fp.ACTIVE:
+                try:
+                    fire("soak.deliver")
+                except FailpointError:
+                    return FlushResult.RETRY
+            seqs = [ev.body.get("seq") for ev in decode_events(data)]
+            # one line per flush keeps the ledger append atomic enough
+            # for line-based parsing after a mid-write SIGKILL
+            _append_line(self.path, json.dumps(
+                {"run": self.run_id, "tag": tag, "seqs": seqs}))
+            return FlushResult.OK
+
+
+def child_main(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for one child run (ingest or recover)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="fbtpu-soak-child")
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--mode", choices=("ingest", "recover"),
+                    default="ingest")
+    ap.add_argument("--records", type=int, default=20)
+    ap.add_argument("--tags", type=int, default=1,
+                    help="round-robin records over N tags (N chunks)")
+    ap.add_argument("--flush", default="200ms")
+    ap.add_argument("--run-id", default="0")
+    ap.add_argument("--final-flush", action="store_true",
+                    help="call flush_now after the last push (drives "
+                    "drain-time failpoints deterministically)")
+    ap.add_argument("--settle", type=float, default=2.0,
+                    help="recover mode: seconds to wait for redelivery")
+    args = ap.parse_args(argv)
+
+    import fluentbit_tpu as flb
+
+    _register_sink()
+    os.makedirs(args.workdir, exist_ok=True)
+    delivered = os.path.join(args.workdir, DELIVERED_LOG)
+    ingested = os.path.join(args.workdir, INGESTED_LOG)
+
+    ctx = flb.create(flush=args.flush, grace="2", **{
+        "storage.path": os.path.join(args.workdir, STORAGE_DIR),
+        "storage.checksum": "on",
+        "scheduler.base": "0.05", "scheduler.cap": "0.1",
+    })
+    in_ffd = [
+        ctx.input("lib", tag=f"soak.{i}", **{"storage.type": "filesystem"})
+        for i in range(max(1, args.tags))
+    ]
+    ctx.output("soak_sink", match="soak.*", path=delivered,
+               run_id=args.run_id)
+    ctx.start()
+    try:
+        if args.mode == "ingest":
+            for seq in range(args.records):
+                ffd = in_ffd[seq % len(in_ffd)]
+                ctx.push(ffd, json.dumps({"seq": seq}))
+                # ack AFTER push returned: the write-through is on disk
+                _append_line(ingested, str(seq))
+            if args.final_flush:
+                ctx.flush_now()
+        else:  # recover: the backlog re-dispatches on the flush timer
+            deadline = time.time() + args.settle
+            e = ctx.engine
+            while time.time() < deadline:
+                if not e._backlog and not e._task_map \
+                        and not e._pending_flushes \
+                        and not e._pending_retries:
+                    break
+                time.sleep(0.05)
+    finally:
+        ctx.stop()
+    return 0
+
+
+# ---------------------------------------------------------------- parent
+
+
+class SoakOutcome:
+    """What one scenario produced, parsed back from the soak logs."""
+
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        self.acked: List[int] = []
+        self.deliveries: Dict[str, List[int]] = {}  # run id → seqs
+        self.exit_codes: List[int] = []
+        ing = os.path.join(workdir, INGESTED_LOG)
+        if os.path.exists(ing):
+            with open(ing, encoding="utf-8") as f:
+                self.acked = [int(s) for s in f.read().split()]
+        dlv = os.path.join(workdir, DELIVERED_LOG)
+        if os.path.exists(dlv):
+            with open(dlv, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        continue  # torn final line from a mid-write kill
+                    self.deliveries.setdefault(str(obj["run"]), []).extend(
+                        s for s in obj["seqs"] if s is not None)
+
+    def delivered_all(self) -> List[int]:
+        return [s for seqs in self.deliveries.values() for s in seqs]
+
+    def dlq_files(self) -> List[str]:
+        d = os.path.join(self.workdir, STORAGE_DIR, "dlq")
+        return sorted(os.listdir(d)) if os.path.isdir(d) else []
+
+    def stream_files(self) -> List[str]:
+        out = []
+        root = os.path.join(self.workdir, STORAGE_DIR, "streams")
+        for dirpath, _dirs, files in os.walk(root):
+            out.extend(os.path.join(dirpath, n) for n in files)
+        return sorted(out)
+
+
+def run_child(workdir: str, mode: str, *, failpoints: str = "",
+              seed: int = 0, records: int = 20, tags: int = 1,
+              flush: str = "200ms", run_id: str = "0",
+              final_flush: bool = False, settle: float = 2.0,
+              timeout: float = 60.0) -> int:
+    """Spawn one child run; returns its exit code (negative = signal,
+    matching ``subprocess`` convention — a crash failpoint shows up as
+    ``-SIGKILL``)."""
+    env = dict(os.environ)
+    env["FBTPU_FAILPOINTS"] = failpoints
+    env["FBTPU_FAILPOINTS_SEED"] = str(seed)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-m", "fluentbit_tpu.failpoints.soak",
+           "--workdir", workdir, "--mode", mode,
+           "--records", str(records), "--tags", str(tags),
+           "--flush", flush, "--run-id", run_id,
+           "--settle", str(settle)]
+    if final_flush:
+        cmd.append("--final-flush")
+    proc = subprocess.run(cmd, env=env, timeout=timeout,
+                          capture_output=True, text=True,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__)))))
+    if proc.returncode not in (0, -9, 137):
+        raise RuntimeError(
+            f"soak child ({mode}) exited {proc.returncode}:\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return proc.returncode
+
+
+def verify_contract(outcome: SoakOutcome, *, restarts: int,
+                    allowed_missing: Sequence[int] = (),
+                    quarantined: Sequence[int] = (),
+                    declared_retries: int = 0) -> None:
+    """Assert the durability contract over a finished scenario.
+
+    ``allowed_missing``: seqs the scenario declares lossy (the torn /
+    unflushed final write). ``quarantined``: seqs whose chunk the
+    harness corrupted on disk — they must NOT be delivered and their
+    chunk must be in the DLQ.
+    """
+    delivered = outcome.delivered_all()
+    got = set(delivered)
+    acked = set(outcome.acked)
+    missing = acked - got
+    illegal_missing = missing - set(allowed_missing) - set(quarantined)
+    assert not illegal_missing, (
+        f"acked records lost across crash/recovery: "
+        f"{sorted(illegal_missing)} (acked={len(acked)}, "
+        f"delivered={len(got)}, dlq={outcome.dlq_files()})")
+    for s in quarantined:
+        assert s not in got, f"corrupted seq {s} must not be delivered"
+    if quarantined:
+        assert outcome.dlq_files(), "corruption must land in the DLQ"
+    # at-least-once, duplicates bounded to the redelivery window
+    bound = 1 + restarts + declared_retries
+    counts: Dict[int, int] = {}
+    for s in delivered:
+        counts[s] = counts.get(s, 0) + 1
+    over = {s: c for s, c in counts.items() if c > bound}
+    assert not over, f"deliveries beyond the redelivery window: {over}"
+    dup_seqs = {s for s, c in counts.items() if c > 1}
+    # a duplicate must be explained by redelivery: the seq was delivered
+    # by an earlier run AND its chunk file outlived the crash (so a
+    # later run replayed it) — i.e. it appears in 2+ distinct runs or
+    # was retried within one run (declared_retries > 0)
+    if dup_seqs and not declared_retries:
+        per_run = [set(v) for v in outcome.deliveries.values()]
+        for s in dup_seqs:
+            in_runs = sum(1 for seqs in per_run if s in seqs)
+            assert in_runs >= 2, (
+                f"seq {s} duplicated within a single run with no "
+                f"declared retries")
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    sys.exit(child_main())

@@ -51,6 +51,12 @@ def _attach_worker() -> None:
     global _state, _error, _attach_seconds, _platform
     t0 = time.time()
     try:
+        from .. import failpoints as _fp
+
+        if _fp.ACTIVE:
+            # delay(ms) simulates the minutes-long axon attach stall;
+            # return(err) pins the CPU fallback path (state=failed)
+            _fp.fire("device.attach")
         import jax
         import jax.numpy as jnp
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
+from .. import failpoints as _fp
 from ..codec.events import LogEvent
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FilterPlugin, FilterResult, registry
@@ -226,6 +227,14 @@ class ParserFilter(FilterPlugin):
         produce the identical-or-defined behavior."""
         from ..codec import _native_codec
 
+        if _fp.ACTIVE:
+            try:
+                _fp.fire("codec.fallback")
+            except _fp.FailpointError:
+                # forced decline: the per-record path takes over — the
+                # contract says output stays bit-exact and the decline
+                # shows in fluentbit_filter_batch_declines_total
+                return None
         mod = _native_codec.load()
         if mod is None:
             return None

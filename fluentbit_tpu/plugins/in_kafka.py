@@ -170,6 +170,12 @@ class KafkaInput(InputPlugin):
         self._generation = -1
         self._assignment = {}
         self._offsets = {}
+        # OFFSET_OUT_OF_RANGE markers must not survive a rebalance:
+        # another member may have committed a VALID offset since, and a
+        # stale marker would bypass OffsetFetch on reassignment and
+        # reset the partition to latest/earliest (skipping or
+        # duplicating records — ADVICE.md low)
+        self._oor.clear()
         # fresh session: a stale pre-outage timestamp would turn the
         # FIRST transient heartbeat failure after rejoin into another
         # full reset (rebalance churn on flaky networks)

@@ -22,6 +22,7 @@ import asyncio
 import time
 from typing import Dict, List, Optional, Tuple
 
+from .. import failpoints as _fp
 from ..codec.events import decode_events
 from ..core.config import ConfigMapEntry
 from ..core.fstore import FStore
@@ -61,8 +62,17 @@ async def _http_request(ins, host: str, port: int, method: str, path: str,
         lines = [f"{method} {path} HTTP/1.1", f"Host: {host}:{port}",
                  f"Content-Length: {len(body)}", "Connection: close"]
         lines += [f"{k}: {v}" for k, v in headers.items()]
+        if _fp.ACTIVE:
+            # FailpointError is an OSError: callers' except clauses map
+            # it to RETRY exactly like a real peer reset mid-request
+            _fp.fire("upstream.send")
         writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
         await asyncio.wait_for(writer.drain(), timeout)
+        if _fp.ACTIVE:
+            # the nastiest window: the request was SENT (the server may
+            # have acted on it) but the response is lost — redelivery
+            # after this fault is where duplication bugs live
+            _fp.fire("upstream.recv")
         data = b""
         while True:
             chunk = await asyncio.wait_for(reader.read(65536), timeout)
@@ -111,6 +121,22 @@ class S3Output(OutputPlugin):
                     "s3: upload_chunk_size cannot exceed total_file_size")
         self._fstore = FStore(self.store_dir)
         self._stream = self._fstore.stream(f"s3-{instance.name}")
+        # staging idempotence across RETRY redelivery (ADVICE.md): the
+        # engine redelivers the SAME chunk bytes after a failed part
+        # upload / complete. A per-tag sidecar in its OWN stream
+        # carries {digest: staged-at} for every staged-but-unacked
+        # chunk: a map, not one marker (other chunks for the tag may
+        # flush while one is backing off); PERSISTED (a
+        # filesystem-storage chunk redelivered after a crash/restart
+        # must still dedup); and OUTSIDE the staging file's meta (a
+        # completed upload deletes the staging file, but a RETRY-parked
+        # chunk whose bytes rode that object must still dedup when its
+        # retry lands). Entries are removed when their flush resolves,
+        # and expire after the engine's worst-case retry window so a
+        # chunk dropped without a final flush call can never swallow a
+        # later byte-identical chunk.
+        self._staged_stream = self._fstore.stream(
+            f"s3-{instance.name}-staged")
         self._opened: Dict[str, float] = {}  # tag → first-append time
         # staging + part sequencing is read-modify-write around an
         # await: concurrent flushes for one tag must serialize or parts
@@ -198,6 +224,11 @@ class S3Output(OutputPlugin):
                               payload: bytes) -> Optional[str]:
         """UploadPart (s3_multipart.c:685: PUT ?partNumber=N&uploadId=);
         returns the part's ETag."""
+        if _fp.ACTIVE:
+            try:
+                _fp.fire("s3.upload_part")
+            except _fp.FailpointError:
+                return None  # part upload failed → flush returns RETRY
         status, head, _body = await self._s3_call(
             "PUT", key, f"?partNumber={n}&uploadId={upload_id}", payload)
         if not 200 <= status < 300:
@@ -216,6 +247,13 @@ class S3Output(OutputPlugin):
                            parts: List[dict]) -> bool:
         """CompleteMultipartUpload (s3_multipart.c:405: POST ?uploadId=
         with the part manifest)."""
+        if _fp.ACTIVE:
+            try:
+                _fp.fire("s3.complete")
+            except _fp.FailpointError:
+                # parts uploaded, completion lost: redelivery follows —
+                # the ADVICE.md duplication window in its pure form
+                return False
         xml = ["<CompleteMultipartUpload>"]
         for p in parts:
             xml.append(
@@ -227,6 +265,37 @@ class S3Output(OutputPlugin):
             "".join(xml).encode())
         # a 200 body may still carry <Error> (S3 completes lazily)
         return 200 <= status < 300 and b"<Error>" not in body
+
+    def _staged_ttl(self, engine) -> Optional[float]:
+        """Upper bound on how long the engine can still redeliver one
+        chunk: the summed worst-case capped backoff over the retry
+        budget (x2 + slack for scheduling). None with unlimited
+        retries — redelivery can then come arbitrarily late, and the
+        engine never drops the chunk short of shutdown."""
+        svc = getattr(engine, "service", None)
+        if svc is None:
+            return 600.0
+        limit = self.instance.retry_limit
+        if limit is None:
+            limit = svc.retry_limit
+        if limit == -1:
+            return None
+        total = 0.0
+        for k in range(1, max(1, int(limit)) + 1):
+            total += min(svc.scheduler_cap,
+                         svc.scheduler_base * (2 ** k)) + 1.0
+        return total * 2 + 60.0
+
+    def _persist_staged(self, fname: str, sf, staged):
+        """Write the staged-digest map's sidecar (delete it when the
+        map empties); returns the current sidecar file or None."""
+        if staged:
+            sf = sf or self._staged_stream.create(fname)
+            sf.set_meta(staged)
+            return sf
+        if sf is not None:
+            sf.delete()
+        return None
 
     def _mp_state(self, f) -> dict:
         st = f.meta()
@@ -285,11 +354,39 @@ class S3Output(OutputPlugin):
         staged bytes becomes an UploadPart immediately."""
         from urllib.parse import quote as _q
 
+        import hashlib
+
         lock = self._tag_locks.setdefault(tag, asyncio.Lock())
         async with lock:
             fname = _q(tag, safe="")  # reversible: no cross-tag collisions
             f = self._stream.get(fname) or self._stream.create(fname)
-            f.append(format_json_lines(data).encode() + b"\n")
+            digest = hashlib.sha256(data).hexdigest()
+            sf = self._staged_stream.get(fname)
+            staged = dict(sf.meta()) if sf is not None else {}
+            staged_orig = dict(staged)
+            ttl = self._staged_ttl(engine)
+            now = time.time()  # wall clock: must survive a restart
+            if ttl is not None and staged:
+                staged = {d: ts for d, ts in staged.items()
+                          if now - ts <= ttl}  # redelivery window over
+            if digest not in staged:
+                f.append(format_json_lines(data).encode() + b"\n")
+                staged[digest] = now
+            # else: RETRY redelivery (same process or post-restart) of
+            # a chunk whose bytes are already staged — or already
+            # uploaded, whether the object is still open or was since
+            # completed — re-appending would duplicate the records.
+            # Known tradeoff: identity is CONTENT (the flush ABI
+            # carries no chunk id), so a genuinely new chunk that is
+            # byte-identical — same records AND same event timestamps —
+            # to one still parked in RETRY dedups against it; an
+            # unbounded duplication bug is traded for that corner.
+            if staged != staged_orig:
+                # persist BEFORE the awaited upload: a crash during the
+                # network call must not leave appended bytes with an
+                # unrecorded digest (restart redelivery would re-append)
+                sf = self._persist_staged(fname, sf, staged)
+                staged_orig = dict(staged)
             self._opened.setdefault(tag, time.monotonic())
             timed_out = (time.monotonic() - self._opened[tag]
                          >= self.upload_timeout)
@@ -300,16 +397,26 @@ class S3Output(OutputPlugin):
                 final = (uploaded + f.size >= self.total_file_size
                          or timed_out)
                 if final or f.size >= self.upload_chunk_size:
-                    return await self._mp_flush_part(f, tag, final)
-                return FlushResult.OK
-            due = f.size >= self.total_file_size or timed_out
-            if not due:
-                return FlushResult.OK
-            payload = f.content()
-            res = await self._upload(tag, payload)
-            if res == FlushResult.OK:
-                f.delete()
-                self._opened.pop(tag, None)
+                    res = await self._mp_flush_part(f, tag, final)
+                else:
+                    res = FlushResult.OK
+            else:
+                due = f.size >= self.total_file_size or timed_out
+                if due:
+                    payload = f.content()
+                    res = await self._upload(tag, payload)
+                    if res == FlushResult.OK:
+                        f.delete()
+                        self._opened.pop(tag, None)
+                else:
+                    res = FlushResult.OK
+            if res != FlushResult.RETRY:
+                # OK (acked — no redelivery coming) or ERROR (dropped —
+                # no redelivery either): a future byte-identical chunk
+                # is a NEW chunk and must stage
+                staged.pop(digest, None)
+            if staged != staged_orig:
+                sf = self._persist_staged(fname, sf, staged)
             return res
 
     def drain(self, engine) -> None:

@@ -19,15 +19,18 @@ struct flb_plugin_proxy_def {
     int event_type;
 };
 
+/* include/fluent-bit/flb_api.h layout — the custom_* entries sit at
+ * the END ("to preserve ABI"); indexing them anywhere else misroutes
+ * every slot after output/input_get_property. */
 struct flb_api {
     char *(*output_get_property)(char *, void *);
     char *(*input_get_property)(char *, void *);
-    char *(*custom_get_property)(char *, void *);
     void *(*output_get_cmt_instance)(void *);
     void *(*input_get_cmt_instance)(void *);
     void *log_print;
     int (*input_log_check)(void *, int);
     int (*output_log_check)(void *, int);
+    char *(*custom_get_property)(char *, void *);
     int (*custom_log_check)(void *, int);
 };
 
@@ -50,6 +53,8 @@ struct flbgo_output_plugin {
 #define FLB_RETRY 2
 
 static char out_path[1024];
+static char banner[256];
+static int banner_logcheck = -1;
 
 int FLBPluginRegister(struct flb_plugin_proxy_def *def)
 {
@@ -65,10 +70,20 @@ int FLBPluginRegister(struct flb_plugin_proxy_def *def)
 int FLBPluginInit(struct flbgo_output_plugin *p)
 {
     char *v = p->api->output_get_property((char *) "path", p->o_ins);
+    char *b;
     if (v == NULL || v[0] == '\0') {
         return FLB_ERROR;
     }
     snprintf(out_path, sizeof(out_path), "%s", v);
+    /* exercise NON-slot-0 api entries: custom_get_property lives in the
+     * LAST pointer slots — a host whose table diverges from flb_api.h
+     * (the assignment-order bug) hands back an int-returning function
+     * here and the banner comes out garbage/crash */
+    b = p->api->custom_get_property((char *) "banner", p->o_ins);
+    if (b != NULL) {
+        snprintf(banner, sizeof(banner), "%s", b);
+        banner_logcheck = p->api->output_log_check(p->o_ins, 3);
+    }
     return FLB_OK;
 }
 
@@ -77,6 +92,10 @@ int FLBPluginFlush(const void *data, size_t size, const char *tag)
     FILE *f = fopen(out_path, "ab");
     if (f == NULL) {
         return FLB_RETRY;
+    }
+    if (banner[0] != '\0') {
+        fprintf(f, "banner=%s logcheck=%d\n", banner, banner_logcheck);
+        banner[0] = '\0';
     }
     fprintf(f, "tag=%s size=%zu\n", tag, size);
     fwrite(data, 1, size, f);

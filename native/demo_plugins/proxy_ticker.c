@@ -15,7 +15,19 @@ struct flb_plugin_proxy_def {
     int event_type;
 };
 
-struct flb_api;
+/* include/fluent-bit/flb_api.h layout — custom_* entries LAST (same
+ * contract proxy_counter.c documents; both demos pin the host table) */
+struct flb_api {
+    char *(*output_get_property)(char *, void *);
+    char *(*input_get_property)(char *, void *);
+    void *(*output_get_cmt_instance)(void *);
+    void *(*input_get_cmt_instance)(void *);
+    void *log_print;
+    int (*input_log_check)(void *, int);
+    int (*output_log_check)(void *, int);
+    char *(*custom_get_property)(char *, void *);
+    int (*custom_log_check)(void *, int);
+};
 
 struct flbgo_input_plugin {
     char *name;
@@ -35,6 +47,7 @@ struct flbgo_input_plugin {
 
 static int g_ticks = 0;
 static int g_cleanups = 0;
+static int g_logcheck = -1;
 
 int FLBPluginRegister(struct flb_plugin_proxy_def *def)
 {
@@ -49,7 +62,14 @@ int FLBPluginRegister(struct flb_plugin_proxy_def *def)
 
 int FLBPluginInit(struct flbgo_input_plugin *p)
 {
-    (void) p;
+    /* exercise mid-table api slots (input_get_property = slot 1,
+     * input_log_check = slot 5 in the header layout): a shifted table
+     * would hand back the wrong function kinds here */
+    char *start = p->api->input_get_property((char *) "start", p->i_ins);
+    if (start != NULL && start[0] != '\0') {
+        g_ticks = atoi(start);
+    }
+    g_logcheck = p->api->input_log_check(p->i_ins, 3);
     return 1;
 }
 
@@ -91,6 +111,7 @@ int FLBPluginInputCleanupCallback(void *data)
 /* test hooks */
 int demo_ticks(void) { return g_ticks; }
 int demo_cleanups(void) { return g_cleanups; }
+int demo_logcheck(void) { return g_logcheck; }
 
 int FLBPluginExit(void)
 {

@@ -44,3 +44,8 @@ def pytest_configure(config):
         "plane (tests/test_asan_native.py, tests/test_tsan_native.py)")
     config.addinivalue_line(
         "markers", "slow: long-running; tier-1 runs -m 'not slow'")
+    config.addinivalue_line(
+        "markers",
+        "soak: crash-recovery soak matrix (tests/test_failpoints.py) — "
+        "subprocess SIGKILL/restart cycles; the full matrix is also "
+        "marked slow so tier-1 keeps only the short deterministic slice")

@@ -172,3 +172,75 @@ pipeline:
         proc.terminate()
         proc.wait(timeout=15)
     assert sink.exists() and sink.read_text().startswith("y ")
+
+
+def test_rejected_object_never_mapped(tmp_path):
+    """ADVICE.md: objects without a registration export must be
+    rejected BEFORE dlopen — their constructors must never run. The
+    probe reads the ELF dynsym instead of loading the object."""
+    import subprocess
+    import sys
+
+    marker = tmp_path / "ctor_ran"
+    src = tmp_path / "evil.c"
+    src.write_text(
+        '#include <stdio.h>\n'
+        '__attribute__((constructor)) static void boom(void) {\n'
+        f'    FILE *f = fopen("{marker}", "w");\n'
+        '    if (f) { fputs("ran", f); fclose(f); }\n'
+        '}\n'
+        'int some_unrelated_export(void) { return 1; }\n')
+    so = tmp_path / "evil.so"
+    subprocess.run(["gcc", "-shared", "-fPIC", "-o", str(so), str(src)],
+                   check=True, capture_output=True)
+    with pytest.raises(ValueError, match="never ran"):
+        load_dso_plugin(str(so))
+    assert not marker.exists(), \
+        "rejected object's constructor executed (it was dlopen'd)"
+    # same invariant for a misnamed in-house object
+    so2 = tmp_path / "out_evil.so"
+    import shutil
+
+    shutil.copy(str(so), str(so2))
+    with pytest.raises(ValueError, match="registration structure"):
+        load_dso_plugin(str(so2))
+    assert not marker.exists()
+
+
+def test_elf_probe_finds_real_exports(tmp_path, demo_so):
+    from fluentbit_tpu.core.dso import elf_has_export
+
+    assert elf_has_export(demo_so["out"], {"out_demo_plugin"}) is True
+    assert elf_has_export(demo_so["out"], {"FLBPluginRegister"}) is False
+    # non-ELF input → undecidable (falls back to dlopen-and-check)
+    txt = tmp_path / "not_elf.so"
+    txt.write_bytes(b"definitely not an object file")
+    assert elf_has_export(str(txt), {"x"}) is None
+
+
+def test_probe_rejects_undefined_reference(tmp_path):
+    """An object that merely REFERENCES FLBPluginRegister (undefined
+    import in .dynsym) must still be rejected pre-dlopen — only a
+    DEFINED export passes the probe."""
+    import subprocess
+
+    marker = tmp_path / "ref_ctor_ran"
+    src = tmp_path / "ref.c"
+    src.write_text(
+        '#include <stdio.h>\n'
+        'extern int FLBPluginRegister(void *);\n'
+        '__attribute__((constructor)) static void boom(void) {\n'
+        f'    FILE *f = fopen("{marker}", "w");\n'
+        '    if (f) { fputs("ran", f); fclose(f); }\n'
+        '}\n'
+        'int call_it(void *d) { return FLBPluginRegister(d); }\n')
+    so = tmp_path / "ref.so"
+    subprocess.run(["gcc", "-shared", "-fPIC", "-o", str(so), str(src)],
+                   check=True, capture_output=True)
+    from fluentbit_tpu.core.dso import elf_has_export
+
+    assert elf_has_export(str(so), {"FLBPluginRegister"}) is False
+    assert elf_has_export(str(so), {"call_it"}) is True
+    with pytest.raises(ValueError, match="never ran"):
+        load_dso_plugin(str(so))
+    assert not marker.exists()

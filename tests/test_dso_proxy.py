@@ -101,3 +101,51 @@ def test_input_collect_and_cleanup(proxy_so):
     dso = ctypes.CDLL(proxy_so["in"])
     assert dso.demo_cleanups() == dso.demo_ticks()
     assert dso.demo_ticks() >= 1
+
+
+def test_api_table_matches_flb_api_header_layout(proxy_so, tmp_path,
+                                                 monkeypatch):
+    """ADVICE.md (high): struct flb_api's custom_* entries sit at the
+    END (flb_api.h 'preserve ABI' comment). The demo output reads a
+    property through custom_get_property (last pointer block) and calls
+    output_log_check (slot 6) — a host table in flb_api.c assignment
+    order hands back the wrong slots and this fails loudly."""
+    monkeypatch.setenv("FBTPU_DSO_API_PROBE", "1")
+    load_dso_plugin(proxy_so["out"])
+    sink = tmp_path / "abi_sink.bin"
+    ctx = flb.create(flush="50ms", grace="2")
+    in_ffd = ctx.input("lib", tag="abi")
+    ctx.output("gocounter", match="*", path=str(sink), banner="hdr-order")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"k": 1}')
+        ctx.flush_now()
+        deadline = time.time() + 5
+        while time.time() < deadline and not sink.exists():
+            time.sleep(0.05)
+    finally:
+        ctx.stop()
+    blob = sink.read_bytes()
+    # banner via custom_get_property; logcheck=2 is output_log_check's
+    # distinct host-side return — input_log_check (the neighbouring
+    # slot in the buggy layout) returns 1, custom_log_check 3
+    assert blob.startswith(b"banner=hdr-order logcheck=2\n"), blob[:80]
+
+
+def test_input_api_entries_mid_table(proxy_so, monkeypatch):
+    """goticker reads `start` via input_get_property (slot 1) and calls
+    input_log_check (slot 5): both must hit their exact slots."""
+    import ctypes
+
+    monkeypatch.setenv("FBTPU_DSO_API_PROBE", "1")
+    load_proxy_plugin(proxy_so["in"])
+    from fluentbit_tpu.core.plugin import registry as reg
+
+    ins = reg.create_input("goticker")
+    ins.set("start", "41")
+    ins.configure()
+    ins.plugin.init(ins, None)
+    dso = ctypes.CDLL(proxy_so["in"])
+    assert dso.demo_ticks() == 41      # input_get_property("start")
+    assert dso.demo_logcheck() == 1    # input_log_check's distinct value
+    ins.plugin.exit()

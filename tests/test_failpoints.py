@@ -1,0 +1,534 @@
+"""fbtpu-failpoints: DSL + registry semantics, hot-path zero-overhead
+guard, bit-exactness under forced declines, admin API control surface,
+and the crash-recovery soak matrix (short deterministic slice in
+tier-1; the full matrix rides the ``soak``/``slow`` markers).
+
+The durability contract under test is FAULTS.md's: finalized chunks
+recover completely, un-finalized chunks recover to the last full
+write, injected corruption quarantines to the DLQ, and delivery is
+at-least-once with duplicates bounded to the redelivery window.
+"""
+
+import asyncio
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu import failpoints
+from fluentbit_tpu.failpoints import soak
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+# ---------------------------------------------------------------- DSL
+
+
+def test_spec_count_chaining():
+    failpoints.enable("t.x", "2*off->1*return(boom)")
+    assert failpoints.fire("t.x") is None
+    assert failpoints.fire("t.x") is None
+    with pytest.raises(failpoints.FailpointError, match="boom"):
+        failpoints.fire("t.x")
+    assert failpoints.fire("t.x") is None  # terms exhausted
+    snap = failpoints.snapshot()["t.x"]
+    assert snap["evaluated"] == 4 and snap["triggered"] == 1
+
+
+def test_injected_error_is_oserror():
+    """return(err) must flow the data plane's real I/O error handling."""
+    failpoints.enable("t.o", "return")
+    with pytest.raises(OSError):
+        failpoints.fire("t.o")
+
+
+def test_partial_directive_and_delay():
+    failpoints.enable("t.p", "partial(6)")
+    assert failpoints.fire("t.p") == ("partial", 6)
+    failpoints.enable("t.d", "delay(1)")
+    t0 = time.perf_counter()
+    assert failpoints.fire("t.d") is None
+    assert time.perf_counter() - t0 >= 0.001
+
+
+def test_panic_action():
+    failpoints.enable("t.k", "panic")
+    with pytest.raises(RuntimeError, match="injected panic"):
+        failpoints.fire("t.k")
+
+
+def test_pct_deterministic_per_seed(monkeypatch):
+    monkeypatch.setenv(failpoints.SEED_VAR, "1234")
+
+    def draw():
+        failpoints.enable("t.r", "50%return")
+        out = []
+        for _ in range(32):
+            try:
+                failpoints.fire("t.r")
+                out.append(0)
+            except failpoints.FailpointError:
+                out.append(1)
+        return out
+
+    a, b = draw(), draw()
+    assert a == b, "same seed must replay the same fault schedule"
+    assert 0 < sum(a) < 32
+    monkeypatch.setenv(failpoints.SEED_VAR, "99")
+    assert draw() != a, "a different seed must shift the schedule"
+
+
+def test_bad_specs_rejected():
+    for bad in ("", "explode", "return(x", "12%%off", "x*off"):
+        with pytest.raises(ValueError):
+            failpoints.parse_spec(bad)
+
+
+def test_env_loading(monkeypatch):
+    n = failpoints.load_env(
+        "storage.append=1*crash; upstream.send=25%return(reset);; bad")
+    assert n == 2
+    snap = failpoints.snapshot()
+    assert snap["storage.append"]["spec"] == "1*crash"
+    assert snap["upstream.send"]["spec"] == "25%return(reset)"
+
+
+def test_listener_bridge():
+    got = []
+    cb = lambda name, action: got.append((name, action))  # noqa: E731
+    failpoints.add_listener(cb)
+    try:
+        failpoints.enable("t.l", "1*off->delay(0)")
+        failpoints.fire("t.l")   # off: not a trigger
+        failpoints.fire("t.l")
+    finally:
+        failpoints.remove_listener(cb)
+    assert got == [("t.l", "delay")]
+
+
+# ------------------------------------------------- hot-path guarantees
+
+
+def test_disabled_plane_adds_no_work(monkeypatch, tmp_path):
+    """FBTPU_FAILPOINTS unset → every site's `if ACTIVE` gate is False
+    and fire() is never reached, even across a full filesystem-storage
+    ingest + flush + recovery cycle."""
+    calls = []
+    monkeypatch.setattr(failpoints, "fire",
+                        lambda name: calls.append(name))
+    assert not failpoints.ACTIVE
+    ctx = flb.create(flush="50ms", grace="1",
+                     **{"storage.path": str(tmp_path / "st")})
+    in_ffd = ctx.input("lib", tag="t", **{"storage.type": "filesystem"})
+    ctx.output("null", match="t")
+    ctx.start()
+    try:
+        for i in range(50):
+            ctx.push(in_ffd, json.dumps({"seq": i}))
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    assert calls == [], f"failpoint plane did work while disarmed: {calls}"
+
+
+def test_bitexact_under_forced_decline():
+    """An armed codec.fallback (forced batched-JSON decline) must be
+    invisible in OUTPUT — byte-identical chunks — and visible in OPS
+    (the decline + trigger counters)."""
+    from fluentbit_tpu.core.engine import Engine
+
+    buf = b"".join(
+        __import__("fluentbit_tpu.codec.events", fromlist=["encode_event"])
+        .encode_event({"log": json.dumps({"k": i, "s": "x" * (i % 7)})},
+                      1700000000.0 + i)
+        for i in range(64)
+    )
+
+    def run(arm: bool):
+        e = Engine()
+        e.parser("p0", format="json")
+        f = e.filter("parser")
+        f.set("key_name", "log")
+        f.set("parser", "p0")
+        ins = e.input("dummy")
+        for x in e.inputs + e.filters:
+            x.configure()
+            x.plugin.init(x, e)
+        if arm:
+            failpoints.enable("codec.fallback", "return")
+        e.input_log_append(ins, "t", buf)
+        out = b"".join(bytes(c.buf) for c in ins.pool.drain())
+        declines = e.m_filter_batch_decline.get(
+            (e.filters[0].display_name,))
+        return out, declines, e
+
+    clean, _d0, _ = run(arm=False)
+    failpoints.reset()
+    forced, d1, e = run(arm=True)
+    assert clean == forced, "forced decline changed chunk bytes"
+    assert d1 >= 1, "forced decline must surface in the decline counter"
+    assert failpoints.snapshot()["codec.fallback"]["triggered"] >= 1
+
+
+# ------------------------------------------------------ site behavior
+
+
+def test_storage_crc_verify_fault_quarantines(tmp_path):
+    """An injected CRC failure sends a (bit-perfect) finalized chunk
+    down the corrupt path: quarantined into the DLQ dir, not loaded."""
+    from fluentbit_tpu.codec.chunk import Chunk
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.core.storage import Storage
+
+    st = Storage(str(tmp_path), checksum=True)
+    c = Chunk("t", in_name="i")
+    data = encode_event({"x": 1}, 1.0)
+    c.append(data, 1)
+    st.write_through(c, data)
+    st.finalize(c)
+    failpoints.enable("storage.crc_verify", "return(bitrot)")
+    got = Storage(str(tmp_path), checksum=True).scan_backlog()
+    assert got == []
+    assert glob.glob(str(tmp_path / "dlq" / "*.corrupt"))
+
+
+def test_upstream_connect_fault():
+    from fluentbit_tpu.core.tls import open_connection
+
+    failpoints.enable("upstream.connect", "return(refused)")
+    with pytest.raises(OSError, match="refused"):
+        asyncio.run(open_connection(None, "127.0.0.1", 1))
+
+
+def test_worker_pool_submit_fault():
+    from fluentbit_tpu.core.output_thread import OutputWorkerPool
+
+    pool = OutputWorkerPool("fp-test", 1)
+    try:
+        async def noop():
+            return 7
+
+        failpoints.enable("output.worker_flush", "1*return(worker)")
+        with pytest.raises(OSError, match="worker"):
+            pool.submit(noop())
+
+        async def check():
+            return await pool.submit(noop())
+
+        loop = asyncio.new_event_loop()
+        try:
+            assert loop.run_until_complete(check()) == 7
+        finally:
+            loop.close()
+    finally:
+        pool.stop()
+
+
+def test_retry_schedule_fault_accounts_drop(tmp_path):
+    """An injected retry-scheduling failure must account the chunk like
+    a shutdown-dropped retry (DLQ + drop metrics), never leak the
+    task-map slot."""
+    ctx = flb.create(flush="50ms", grace="1",
+                     **{"storage.path": str(tmp_path / "st")})
+    in_ffd = ctx.input("lib", tag="t", **{"storage.type": "filesystem"})
+    ctx.output("retry", match="t")  # always returns RETRY
+    failpoints.enable("engine.retry_schedule", "return")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, json.dumps({"x": 1}))
+        deadline = time.time() + 5
+        while time.time() < deadline and ctx.engine._task_map:
+            time.sleep(0.05)
+        assert not ctx.engine._task_map, "task-map slot leaked"
+        assert not ctx.engine._pending_retries
+    finally:
+        ctx.stop()
+
+
+def test_device_attach_fault_pins_cpu_path():
+    """Armed device.attach=return → attach fails fast (before the jax
+    import) and the CPU fallback pins. Subprocess: device state is a
+    process-global singleton."""
+    code = (
+        "from fluentbit_tpu.ops import device\n"
+        "assert not device.wait(5)\n"
+        "assert device.failed(), device.status()\n"
+    )
+    env = dict(os.environ, FBTPU_FAILPOINTS="device.attach=return",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, cwd=REPO,
+                          timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
+# ------------------------------------------------------ admin surface
+
+
+def _http(port, method, path, body=b""):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    req = (f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+           f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+           ).encode() + body
+    s.sendall(req)
+    data = b""
+    while True:
+        b = s.recv(65536)
+        if not b:
+            break
+        data += b
+    s.close()
+    head, _, rbody = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), rbody
+
+
+@pytest.fixture
+def admin_ctx(tmp_path, monkeypatch):
+    monkeypatch.setenv(failpoints.HTTP_VAR, "1")  # opt in to HTTP arming
+    ctx = flb.create(flush="50ms", grace="1", http_server="on",
+                     http_port="0",
+                     **{"storage.path": str(tmp_path / "st")})
+    in_ffd = ctx.input("lib", tag="t", **{"storage.type": "filesystem"})
+    ctx.output("null", match="*")
+    ctx.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        srv = ctx.engine.admin_server
+        if srv is not None and srv.bound_port:
+            break
+        time.sleep(0.02)
+    yield ctx, ctx.engine.admin_server.bound_port, in_ffd
+    ctx.stop()
+
+
+def test_admin_failpoints_roundtrip(admin_ctx):
+    ctx, port, in_ffd = admin_ctx
+    status, body = _http(port, "GET", "/api/v1/failpoints")
+    assert status == 200
+    obj = json.loads(body)
+    assert obj["failpoints"] == {}
+    assert "storage.append" in obj["sites"]
+
+    # arm via JSON body, observe a trigger, then the metric, then disarm
+    status, _ = _http(port, "POST", "/api/v1/failpoints/storage.append",
+                      json.dumps({"spec": "1*return(adm)"}).encode())
+    assert status == 200
+    with pytest.raises(OSError, match="adm"):
+        ctx.push(in_ffd, '{"x": 1}')
+    status, body = _http(port, "GET", "/api/v1/failpoints")
+    snap = json.loads(body)["failpoints"]["storage.append"]
+    assert snap["triggered"] == 1
+    status, body = _http(port, "GET", "/api/v1/metrics/prometheus")
+    assert (b'fluentbit_failpoint_triggered_total{name="storage.append"}'
+            in body)
+
+    # raw-DSL body + bad spec → 400
+    status, _ = _http(port, "POST", "/api/v1/failpoints/upstream.send",
+                      b"25%return(reset)")
+    assert status == 200
+    status, body = _http(port, "POST", "/api/v1/failpoints/x",
+                         b"not-an-action")
+    assert status == 400
+
+    status, _ = _http(port, "DELETE", "/api/v1/failpoints/upstream.send")
+    assert status == 200
+    status, _ = _http(port, "DELETE", "/api/v1/failpoints/upstream.send")
+    assert status == 404
+    status, _ = _http(port, "DELETE", "/api/v1/failpoints")
+    assert status == 200
+    assert json.loads(_http(port, "GET",
+                            "/api/v1/failpoints")[1])["failpoints"] == {}
+    # disarmed again: ingest flows
+    assert ctx.push(in_ffd, '{"x": 2}') >= 0
+
+
+def test_admin_failpoints_mutation_gated(admin_ctx, monkeypatch):
+    """Without the launch-time opt-in the admin port must never be a
+    kill switch: GET stays readable, POST/DELETE are 403."""
+    _ctx, port, _in_ffd = admin_ctx
+    monkeypatch.delenv(failpoints.HTTP_VAR, raising=False)
+    monkeypatch.delenv(failpoints.ENV_VAR, raising=False)
+    status, body = _http(port, "GET", "/api/v1/failpoints")
+    assert status == 200
+    assert json.loads(body)["http_control"] is False
+    status, _ = _http(port, "POST", "/api/v1/failpoints/storage.append",
+                      b"crash")
+    assert status == 403
+    status, _ = _http(port, "DELETE", "/api/v1/failpoints")
+    assert status == 403
+    assert failpoints.snapshot() == {}
+
+
+# ------------------------------------------------------- soak matrix
+
+
+def _corrupt_one_chunk(outcome):
+    """Flip a payload byte in one on-disk chunk; returns the seqs that
+    chunk carried (decoded BEFORE corruption)."""
+    from fluentbit_tpu.core.storage import Storage
+
+    files = [p for p in outcome.stream_files() if p.endswith(".flb")]
+    assert files, "scenario expected chunks on disk at crash"
+    path = files[0]
+    st = Storage.__new__(Storage)
+    st.checksum = True
+    chunk = st._read_chunk_file(path)
+    seqs = [ev.body["seq"] for ev in chunk.decode()]
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+    return seqs
+
+
+def test_soak_crash_mid_append(tmp_path):
+    """SIGKILL on the 13th storage append: every acked record recovers
+    from the un-finalized chunk and delivers exactly once."""
+    d = str(tmp_path)
+    rc = soak.run_child(d, "ingest", records=30, run_id="1",
+                        failpoints="storage.append=12*off->1*crash")
+    assert rc in (-9, 137)
+    assert soak.run_child(d, "recover", run_id="2") == 0
+    outcome = soak.SoakOutcome(d)
+    assert len(outcome.acked) == 12
+    soak.verify_contract(outcome, restarts=1)
+    assert not outcome.stream_files(), "delivered chunks must be deleted"
+
+
+def test_soak_crash_unflushed_write(tmp_path):
+    """SIGKILL between write() and flush(): the buffered append is the
+    only loss (write-through contract: at most the last write)."""
+    d = str(tmp_path)
+    rc = soak.run_child(d, "ingest", records=30, run_id="1",
+                        failpoints="storage.flush=10*off->1*crash")
+    assert rc in (-9, 137)
+    assert soak.run_child(d, "recover", run_id="2") == 0
+    outcome = soak.SoakOutcome(d)
+    assert len(outcome.acked) == 10  # the 11th push died mid-call
+    soak.verify_contract(outcome, restarts=1)
+
+
+def test_soak_crash_at_dispatch_with_corruption(tmp_path):
+    """SIGKILL after finalize, before any delivery; then one chunk is
+    corrupted on disk. Recovery delivers every other chunk and
+    quarantines the corrupt one to the DLQ."""
+    d = str(tmp_path)
+    rc = soak.run_child(d, "ingest", records=24, tags=3, flush="5s",
+                        final_flush=True, run_id="1",
+                        failpoints="engine.flush_dispatch=1*crash")
+    assert rc in (-9, 137)
+    outcome = soak.SoakOutcome(d)
+    assert len(outcome.acked) == 24
+    assert not outcome.delivered_all(), "crash preceded any delivery"
+    bad_seqs = _corrupt_one_chunk(outcome)
+    assert bad_seqs
+    assert soak.run_child(d, "recover", run_id="2") == 0
+    outcome = soak.SoakOutcome(d)
+    soak.verify_contract(outcome, restarts=1, quarantined=bad_seqs)
+    assert any(n.endswith(".corrupt") for n in outcome.dlq_files())
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+class TestSoakFullMatrix:
+    """The long matrix: remaining crash sites + torn writes + retry
+    interleavings. Each case is one ingest-crash + recovery cycle over
+    a fresh workdir."""
+
+    def test_crash_at_finalize(self, tmp_path):
+        d = str(tmp_path)
+        rc = soak.run_child(d, "ingest", records=20, flush="5s",
+                            final_flush=True, run_id="1",
+                            failpoints="storage.finalize=1*crash")
+        assert rc in (-9, 137)
+        assert soak.run_child(d, "recover", run_id="2") == 0
+        outcome = soak.SoakOutcome(d)
+        assert len(outcome.acked) == 20
+        soak.verify_contract(outcome, restarts=1)
+
+    def test_crash_scheduling_retry(self, tmp_path):
+        """Sink declines (RETRY) until the crash lands in the retry
+        scheduler; recovery redelivers from disk."""
+        d = str(tmp_path)
+        rc = soak.run_child(
+            d, "ingest", records=20, flush="5s", final_flush=True,
+            run_id="1",
+            failpoints="soak.deliver=return;engine.retry_schedule=1*crash")
+        assert rc in (-9, 137)
+        assert soak.run_child(d, "recover", run_id="2") == 0
+        outcome = soak.SoakOutcome(d)
+        assert len(outcome.acked) == 20
+        soak.verify_contract(outcome, restarts=1, declared_retries=1)
+
+    def test_crash_during_backlog_recovery(self, tmp_path):
+        """Dying mid-recovery must be recoverable: recovery is
+        idempotent over the same storage root."""
+        d = str(tmp_path)
+        rc = soak.run_child(d, "ingest", records=16, run_id="1",
+                            failpoints="storage.append=8*off->1*crash")
+        assert rc in (-9, 137)
+        rc = soak.run_child(d, "recover", run_id="2",
+                            failpoints="storage.backlog_load=1*crash")
+        assert rc in (-9, 137)
+        assert soak.run_child(d, "recover", run_id="3") == 0
+        outcome = soak.SoakOutcome(d)
+        assert len(outcome.acked) == 8
+        soak.verify_contract(outcome, restarts=2)
+
+    def test_torn_write_then_crash(self, tmp_path):
+        """partial(6) tears one append mid-record; the next append
+        crashes. Recovery truncates at the last full record: only the
+        torn seq may be lost."""
+        d = str(tmp_path)
+        rc = soak.run_child(
+            d, "ingest", records=30, flush="5s", run_id="1",
+            failpoints="storage.append=10*off->1*partial(6)->1*crash")
+        assert rc in (-9, 137)
+        assert soak.run_child(d, "recover", run_id="2") == 0
+        outcome = soak.SoakOutcome(d)
+        # seq 10's append was torn but its push returned (acked);
+        # seq 11's append crashed (never acked)
+        assert len(outcome.acked) == 11
+        soak.verify_contract(outcome, restarts=1, allowed_missing=[10])
+        delivered = set(outcome.delivered_all())
+        assert 10 not in delivered, "torn record must not survive"
+
+    def test_crash_after_partial_delivery_duplicates_bounded(
+            self, tmp_path):
+        """Crash while half the chunks have delivered: redelivery may
+        duplicate, but only within the declared window."""
+        d = str(tmp_path)
+        rc = soak.run_child(
+            d, "ingest", records=40, tags=4, flush="100ms", run_id="1",
+            failpoints="storage.append=35*off->1*crash")
+        assert rc in (-9, 137)
+        assert soak.run_child(d, "recover", run_id="2") == 0
+        soak.verify_contract(soak.SoakOutcome(d), restarts=1)
+
+
+def test_http_control_explicit_opt_out(monkeypatch):
+    """FBTPU_FAILPOINTS_HTTP=0 must keep the admin surface read-only
+    even when the process is env-armed via FBTPU_FAILPOINTS."""
+    monkeypatch.setenv(failpoints.ENV_VAR, "upstream.send=1%return")
+    monkeypatch.delenv(failpoints.HTTP_VAR, raising=False)
+    assert failpoints.http_control_enabled()  # armed process defaults on
+    monkeypatch.setenv(failpoints.HTTP_VAR, "0")
+    assert not failpoints.http_control_enabled()
+    monkeypatch.setenv(failpoints.HTTP_VAR, "off")
+    assert not failpoints.http_control_enabled()
+    monkeypatch.setenv(failpoints.HTTP_VAR, "1")
+    assert failpoints.http_control_enabled()

@@ -698,3 +698,52 @@ def test_in_kafka_oor_partitions_bypass_offset_fetch():
     # the OOR partition is cleared and queued for a prompt commit
     assert ("t", 0) not in p._oor
     assert p._uncommitted
+
+def test_in_kafka_group_reset_clears_oor_markers():
+    """ADVICE.md (low): a rebalance (group reset) must clear
+    OFFSET_OUT_OF_RANGE markers — another member may have committed a
+    valid offset since, so post-rebalance resolution for the partition
+    must go through OffsetFetch again, not be reset to latest."""
+    import asyncio
+    import struct
+
+    from fluentbit_tpu.core.plugin import registry
+    from fluentbit_tpu.utils import kafka_protocol as kp
+
+    ins = registry.create_input("kafka")
+    ins.set("brokers", "127.0.0.1:19092")
+    ins.set("topics", "t")
+    ins.set("group_id", "g")
+    ins.configure()
+    ins.plugin.init(ins, None)
+    p = ins.plugin
+    p._oor = {("t", 0)}
+    p._reset_group()
+    assert p._oor == set(), "rebalance must drop stale OOR markers"
+
+    # post-rebalance resolution uses OffsetFetch for the formerly-OOR
+    # partition (the other member's committed offset wins)
+    p._assignment = {"t": [0]}
+    p._coordinator = ("127.0.0.1", 19092)
+    calls = []
+
+    def s(x):
+        b = x.encode()
+        return struct.pack(">h", len(b)) + b
+
+    async def fake_rpc_to(addr, api, ver, payload):
+        calls.append(api)
+        assert api == kp.API_OFFSET_FETCH
+        return (struct.pack(">i", 1) + s("t") + struct.pack(">i", 1)
+                + struct.pack(">iq", 0, 555) + s("")
+                + struct.pack(">h", 0))
+
+    async def fake_rpc(api, ver, payload):
+        raise AssertionError(
+            f"must not fall back to ListOffsets (api={api})")
+
+    p._rpc_to = fake_rpc_to
+    p._rpc = fake_rpc
+    asyncio.run(p._resolve_group_offsets())
+    assert calls == [kp.API_OFFSET_FETCH]
+    assert p._offsets[("t", 0)] == 555

@@ -4,6 +4,7 @@ resume from fstore metadata, and drain completion (reference
 plugins/out_s3/s3.c:82-123, s3_multipart.c)."""
 
 import json
+import os
 import re
 import socket
 import threading
@@ -237,3 +238,220 @@ def test_multipart_restart_resumes_upload(tmp_path, monkeypatch):
         seen += [json.loads(l)["i"]
                  for l in body.decode().strip().splitlines()]
     assert seen == [0, 1, 2, 99]
+
+
+def test_multipart_retry_redelivery_no_duplicate_staging(tmp_path,
+                                                         monkeypatch):
+    """ADVICE.md (medium): flush staged the chunk, the part upload
+    failed (failpoint on the part-upload site), the engine redelivered
+    the same chunk — staging must be idempotent: every record appears
+    exactly once across the uploaded parts."""
+    from fluentbit_tpu import failpoints
+
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AK")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "SK")
+    failpoints.reset()
+    failpoints.enable("s3.upload_part", "1*return(part-lost)")
+    stub = S3Stub()
+    ctx = flb.create(flush="50ms", grace="3")
+    ctx.service_set(**{"scheduler.base": "0.05", "scheduler.cap": "0.1"})
+    in_ffd = ctx.input("lib", tag="app")
+    ctx.output("s3", match="app", bucket="logs",
+               endpoint=f"127.0.0.1:{stub.port}",
+               use_put_object="off",
+               upload_chunk_size="64", total_file_size="100M",
+               store_dir=str(tmp_path / "st4"),
+               s3_key_format="/mp/$TAG/obj")
+    ctx.start()
+    try:
+        # one chunk big enough to trip upload_chunk_size on its flush
+        for i in range(3):
+            ctx.push(in_ffd, json.dumps({"i": i, "pad": "q" * 30}))
+        ctx.flush_now()
+        deadline = time.time() + 8
+        while time.time() < deadline and not stub.by_kind()[1]:
+            time.sleep(0.05)
+        time.sleep(0.3)
+    finally:
+        ctx.stop()
+        failpoints.reset()
+    stub.close()
+    _creates, parts, _completes = stub.by_kind()
+    assert parts, "the retried flush must eventually upload the part"
+    seen = []
+    for _, _, body in parts:
+        seen += [json.loads(l)["i"]
+                 for l in body.decode().strip().splitlines()]
+    assert seen == list(range(3)), (
+        f"RETRY redelivery duplicated staged records: {seen}")
+
+
+def test_multipart_interleaved_chunk_then_retry_dedup(tmp_path,
+                                                      monkeypatch):
+    """A second chunk for the same tag flushing WHILE the first is in
+    RETRY backoff must not defeat staging idempotence: the first
+    chunk's redelivery still dedups (per-tag digest SET, not a single
+    last-staged marker)."""
+    from fluentbit_tpu import failpoints
+
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AK")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "SK")
+    failpoints.reset()
+    # first part-upload attempt fails; every later one succeeds
+    failpoints.enable("s3.upload_part", "1*return(part-lost)")
+    stub = S3Stub()
+    ctx = flb.create(flush="40ms", grace="3")
+    # slow retry: chunk B flushes (and uploads) while A is backing off
+    ctx.service_set(**{"scheduler.base": "0.5", "scheduler.cap": "0.6"})
+    in_ffd = ctx.input("lib", tag="app")
+    ctx.output("s3", match="app", bucket="logs",
+               endpoint=f"127.0.0.1:{stub.port}",
+               use_put_object="off",
+               upload_chunk_size="64", total_file_size="100M",
+               store_dir=str(tmp_path / "st5"),
+               s3_key_format="/mp/$TAG/obj")
+    ctx.start()
+    try:
+        for i in range(3):  # chunk A: staged, part upload fails → RETRY
+            ctx.push(in_ffd, json.dumps({"i": i, "pad": "a" * 30}))
+        ctx.flush_now()
+        time.sleep(0.15)  # A now parked in backoff
+        for i in range(3, 6):  # chunk B: flushes while A backs off
+            ctx.push(in_ffd, json.dumps({"i": i, "pad": "b" * 30}))
+        ctx.flush_now()
+        deadline = time.time() + 8
+        while time.time() < deadline and len(stub.by_kind()[1]) < 1:
+            time.sleep(0.05)
+        time.sleep(1.2)  # let A's retry fire and settle
+    finally:
+        ctx.stop()
+        failpoints.reset()
+    stub.close()
+    _creates, parts, _completes = stub.by_kind()
+    seen = []
+    for _, _, body in parts:
+        seen += [json.loads(l)["i"]
+                 for l in body.decode().strip().splitlines()]
+    assert sorted(seen) == list(range(6)), (
+        f"interleaved flush defeated staging idempotence: {sorted(seen)}")
+
+
+def test_multipart_restart_redelivery_no_duplicate_staging(tmp_path,
+                                                           monkeypatch):
+    """The staged-digest map is persisted in the staging file's fstore
+    meta: a filesystem-storage chunk redelivered after a hard restart
+    must still dedup against the surviving staging file (in-memory
+    tracking alone would resurrect the duplication across a crash)."""
+    from fluentbit_tpu import failpoints
+
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AK")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "SK")
+    failpoints.reset()
+    stub = S3Stub()
+    store = tmp_path / "st6"
+
+    def make_ctx():
+        c = flb.create(flush="50ms", grace="2",
+                       **{"storage.path": str(tmp_path / "chunks")})
+        c.service_set(**{"scheduler.base": "30", "scheduler.cap": "30"})
+        ffd = c.input("lib", tag="app", **{"storage.type": "filesystem"})
+        c.output("s3", match="app", bucket="logs",
+                 endpoint=f"127.0.0.1:{stub.port}",
+                 use_put_object="off", retry_limit="5",
+                 upload_chunk_size="64", total_file_size="100M",
+                 store_dir=str(store), s3_key_format="/mp/$TAG/obj")
+        return c, ffd
+
+    # phase 1: part upload fails after staging; hard-stop mid-backoff
+    failpoints.enable("s3.upload_part", "return(down)")
+    ctx, in_ffd = make_ctx()
+    ctx.start()
+    try:
+        for i in range(3):
+            ctx.push(in_ffd, json.dumps({"i": i, "pad": "r" * 30}))
+        ctx.flush_now()  # stages + fails the part → RETRY parked 30 s
+        ctx.engine.outputs[0].plugin.drain = lambda engine: None
+    finally:
+        ctx.engine.request_stop()
+        ctx.stop()
+    failpoints.reset()
+    assert not stub.by_kind()[1], "phase 1 must not upload any part"
+
+    # phase 2: restart recovers the chunk from disk and redelivers it
+    ctx2, _ = make_ctx()
+    ctx2.start()
+    try:
+        deadline = time.time() + 8
+        while time.time() < deadline and not stub.by_kind()[1]:
+            time.sleep(0.05)
+        time.sleep(0.3)
+    finally:
+        ctx2.stop()
+    stub.close()
+    _creates, parts, _completes = stub.by_kind()
+    assert parts, "restart redelivery must upload the staged part"
+    seen = []
+    for _, _, body in parts:
+        seen += [json.loads(l)["i"]
+                 for l in body.decode().strip().splitlines()]
+    assert sorted(seen) == list(range(3)), (
+        f"restart redelivery duplicated staged records: {sorted(seen)}")
+
+
+def test_multipart_completed_object_then_retry_dedup(tmp_path,
+                                                     monkeypatch):
+    """A RETRY-parked chunk whose staged bytes were swept into an
+    object that since COMPLETED (staging file deleted) must still
+    dedup when its retry lands: the digest map lives in its own
+    per-tag sidecar, not the staging file's meta."""
+    from fluentbit_tpu import failpoints
+
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AK")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "SK")
+    failpoints.reset()
+    failpoints.enable("s3.upload_part", "1*return(part-lost)")
+    stub = S3Stub()
+    store = tmp_path / "st7"
+    ctx = flb.create(flush="40ms", grace="3")
+    ctx.service_set(**{"scheduler.base": "0.3", "scheduler.cap": "0.4"})
+    in_ffd = ctx.input("lib", tag="app")
+    # A (~165B) trips upload_chunk_size=64 and FAILS → RETRY; B's later
+    # flush pushes the staged total past total_file_size=190 → final
+    # part (carrying A+B) + complete + staging-file delete — all while
+    # A is still parked in backoff
+    ctx.output("s3", match="app", bucket="logs",
+               endpoint=f"127.0.0.1:{stub.port}",
+               use_put_object="off",
+               upload_chunk_size="64", total_file_size="190",
+               store_dir=str(store), s3_key_format="/mp/$TAG/obj")
+    ctx.start()
+    try:
+        for i in range(3):  # chunk A
+            ctx.push(in_ffd, json.dumps({"i": i, "pad": "c" * 30}))
+        ctx.flush_now()
+        time.sleep(0.1)
+        ctx.push(in_ffd, json.dumps({"i": 3, "pad": "d" * 30}))  # chunk B
+        ctx.flush_now()
+        deadline = time.time() + 8
+        while time.time() < deadline and not stub.by_kind()[2]:
+            time.sleep(0.05)
+        time.sleep(1.2)  # A's retry fires into the post-complete world
+    finally:
+        ctx.stop()
+        failpoints.reset()
+    stub.close()
+    _creates, parts, completes = stub.by_kind()
+    assert completes, "the object must have completed"
+    seen = []
+    for _, _, body in parts:
+        seen += [json.loads(l)["i"]
+                 for l in body.decode().strip().splitlines()]
+    assert sorted(seen) == list(range(4)), (
+        f"retry after object completion duplicated records: {sorted(seen)}")
+    # nothing left staged: A's redelivery deduped instead of re-staging
+    leftover = [f for f in os.listdir(store / "s3-s3.0") if
+                not f.endswith(".meta")] if (store / "s3-s3.0").exists() \
+        else []
+    for name in leftover:
+        assert os.path.getsize(store / "s3-s3.0" / name) == 0, (
+            f"records re-staged after dedup should not exist: {name}")

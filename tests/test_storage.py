@@ -67,7 +67,10 @@ def test_corrupt_crc_skipped_and_renamed(tmp_path):
         f.write(bytes([last[0] ^ 0xFF]))  # flip payload byte
     got = Storage(str(tmp_path), checksum=True).scan_backlog()
     assert got == []
-    assert glob.glob(str(tmp_path / "streams" / "*" / "*.corrupt"))
+    # corrupt chunks quarantine into the DLQ dir (FAULTS.md contract):
+    # operators find every rejected payload in one place
+    assert glob.glob(str(tmp_path / "dlq" / "*.corrupt"))
+    assert not glob.glob(str(tmp_path / "streams" / "*" / "*.corrupt"))
 
 
 def test_delete_removes_file(tmp_path):
