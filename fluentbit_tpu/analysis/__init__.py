@@ -37,6 +37,11 @@ Six rule families (see ANALYSIS.md for the full contract):
 - **dtype narrowing** (`dtype-narrowing`): int64→int32 truncation in
   offset/index math — astype/array/cumsum with a narrow dtype on
   offset-flavored values (analysis.dtype).
+- **flush-path deadlines** (`await-no-deadline`): raw socket/upstream
+  awaits inside output flush paths with no ``asyncio.wait_for``/
+  ``guard.io_deadline`` bound, and ``open_connection`` dials without a
+  ``timeout=`` — the hung-peer shape the fbtpu-guard plane contains
+  (analysis.deadline).
 
 The native C/C++ data plane has its own gate (analysis.native_gate):
 clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
@@ -141,6 +146,7 @@ class Rule:
 
 def _build_rules(guards=None) -> List[Rule]:
     from .batch import BatchExactnessRules
+    from .deadline import AwaitNoDeadlineRule
     from .decline import DeclineSwallowRule
     from .dtype import DtypeNarrowingRule
     from .locks import AwaitUnderLockRule, GuardedByRule
@@ -155,6 +161,7 @@ def _build_rules(guards=None) -> List[Rule]:
         BatchExactnessRules(),
         DeclineSwallowRule(),
         DtypeNarrowingRule(),
+        AwaitNoDeadlineRule(),
     ]
 
 

@@ -1,0 +1,149 @@
+"""Flush-path I/O deadline rule.
+
+``await-no-deadline``: a raw socket/upstream ``await`` inside an output
+flush path with no deadline. A hung peer then parks the flush coroutine
+— and its task-map slot — forever: exactly the head-of-line failure the
+fbtpu-guard plane (core/guard.py) exists to contain. The engine-level
+flush deadline is the backstop, not an excuse: a local bound fails the
+ONE sick await with a ``TimeoutError`` the plugin's own error handling
+turns into a clean RETRY, instead of soft-killing the whole attempt.
+
+Scope (deliberately lexical — no call-graph chasing): ``async`` methods
+of classes that look like output plugins (a base mentioning
+``OutputPlugin``, or a class name ending in ``Output``), plus
+module-level ``async def flush``/``_flush*`` functions, on data-path
+modules. Flagged awaits:
+
+- stream/socket primitives — ``drain``, ``read``, ``readexactly``,
+  ``readuntil``, ``readline``, ``sendall``, ``recv``, ``getaddrinfo`` —
+  awaited directly (wrap in ``asyncio.wait_for(...)`` or
+  ``guard.io_deadline(...)``);
+- ``open_connection(...)`` without a ``timeout=`` argument (the helper
+  bounds the whole multi-address dial when one is passed).
+
+Helper calls (``self._connect()``) are not flagged — the rule fires
+where the raw primitive is awaited, which is also where the wrapper
+belongs. Suppress deliberate unbounded awaits (a long-poll reader, a
+server-push loop) with ``# fbtpu-lint: allow(await-no-deadline)`` and a
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from . import Finding, Module, Rule
+from .silent import DATA_PATH_PREFIXES
+
+__all__ = ["AwaitNoDeadlineRule"]
+
+#: Raw awaitable I/O primitives (terminal callee names).
+IO_CALLS: Set[str] = {
+    "drain", "read", "readexactly", "readuntil", "readline",
+    "sendall", "recv", "getaddrinfo",
+}
+
+#: Dial helpers that take (and internally honor) a ``timeout=`` kwarg.
+CONNECT_CALLS: Set[str] = {"open_connection"}
+
+#: Deadline wrappers: an await of one of these is already bounded.
+WRAPPERS: Set[str] = {"wait_for", "io_deadline"}
+
+
+def _callee_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _has_timeout_arg(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+def _looks_like_output_class(cls: ast.ClassDef) -> bool:
+    if cls.name.endswith("Output"):
+        return True
+    for base in cls.bases:
+        try:
+            if "OutputPlugin" in ast.unparse(base):
+                return True
+        except Exception:
+            continue
+    return False
+
+
+class AwaitNoDeadlineRule(Rule):
+    name = "await-no-deadline"
+    description = ("raw socket/upstream await in an output flush path "
+                   "with no deadline — a hung peer parks the flush "
+                   "(and its task-map slot) forever")
+    severity = "warning"
+
+    def check(self, module: Module) -> List[Finding]:
+        if not any(p in module.path for p in DATA_PATH_PREFIXES):
+            return []
+        out: List[Finding] = []
+        seen: Set[int] = set()  # nested class/function double-walk guard
+        for node in ast.walk(module.tree):
+            scan = None
+            if isinstance(node, ast.ClassDef) and \
+                    _looks_like_output_class(node):
+                scan = node
+            elif isinstance(node, ast.AsyncFunctionDef) and (
+                    node.name == "flush"
+                    or node.name.startswith("_flush")):
+                scan = node
+            if scan is None:
+                continue
+            for fn in ast.walk(scan):
+                if not isinstance(fn, ast.AsyncFunctionDef) or \
+                        id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                out.extend(self._scan_function(module, fn))
+        return out
+
+    def _scan_function(self, module: Module,
+                       fn: ast.AsyncFunctionDef) -> List[Finding]:
+        out: List[Finding] = []
+        # walk WITHOUT descending into nested defs: a nested async def
+        # is scanned as its own function (never double-reported), a
+        # nested sync def/lambda has no awaits
+        stack = list(fn.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if not isinstance(sub, ast.Await) or \
+                    not isinstance(sub.value, ast.Call):
+                continue
+            name = _callee_name(sub.value)
+            if name in WRAPPERS:
+                continue  # the wrapper IS the deadline
+            msg = None
+            if name in IO_CALLS:
+                msg = (f"`await {name}(...)` in a flush path has no "
+                       f"deadline — a hung peer parks this flush (and "
+                       f"its task-map slot) until the guard soft-kill; "
+                       f"wrap it in `asyncio.wait_for(...)` or "
+                       f"`guard.io_deadline(...)`")
+            elif name in CONNECT_CALLS and \
+                    not _has_timeout_arg(sub.value):
+                msg = (f"`await {name}(...)` without `timeout=` — the "
+                       f"dial is unbounded; pass a connect timeout")
+            if msg is None:
+                continue
+            f = self.finding(module, sub, msg)
+            if f is not None:
+                out.append(f)
+        return out

@@ -80,6 +80,30 @@ GUARDS: Tuple[GuardEntry, ...] = (
         writes_only=True,
         note="same flag, defining module (InputInstance.set_paused)",
     ),
+    # -- fbtpu-guard: flights/breakers/shed touched from the engine
+    #    loop, flush_now callers, and sync-fallback flushes --
+    GuardEntry(
+        "fluentbit_tpu/core/guard.py", "_lock",
+        ("_flights", "_abandoned", "_shed"),
+        note="guard plane state: the watchdog (engine loop or a "
+             "flush_now caller thread) races flush done-callbacks and "
+             "result recording",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/guard.py", "_lock",
+        ("_breakers", "_unhealthy"), writes_only=True,
+        note="breaker map + not-closed count: the dispatch hot path's "
+             "health probe (maybe_shed's early-out) reads lock-free "
+             "by design (benign staleness of one flush cycle); "
+             "mutation serializes",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/guard.py", "_ingest_lock",
+        ("_task_map", "_backlog"),
+        note="engine ingest-path state read/written by the guard "
+             "(occupancy, shed readmission): same discipline as "
+             "core/engine.py's own entry",
+    ),
     # -- metrics: counters incremented from every thread family --
     GuardEntry(
         "fluentbit_tpu/core/metrics.py", "_lock",

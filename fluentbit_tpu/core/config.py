@@ -202,6 +202,7 @@ CORE_INSTANCE_KEYS = {
     "http2",  # HTTP-based outputs: prior-knowledge h2c delivery
     "proxy",  # HTTP-based outputs: http:// forward proxy
     "route_condition",  # ingest-time conditional routing (outputs)
+    "flush_timeout",  # fbtpu-guard per-output flush deadline (outputs)
     "net.keepalive", "net.keepalive_idle_timeout",
     "net.keepalive_max_recycle", "net.max_worker_connections",
 }
@@ -228,6 +229,19 @@ class ServiceConfig:
     storage_checksum: bool = False
     storage_backlog_mem_limit: int = 5 * 1024 * 1024
     storage_max_chunks_up: int = 128  # pause threshold (flb_storage)
+    # fbtpu-guard (core/guard.py — no reference equivalent): flush
+    # deadlines, per-output circuit breakers, watchdog + load shedding
+    guard_enable: bool = True
+    guard_flush_timeout: float = 0.0     # 0 = off → soft-kill at 2×grace
+    guard_breaker_failures: int = 5      # consecutive failures to open
+    guard_breaker_error_rate: float = 0.5  # windowed failure fraction
+    guard_breaker_window: int = 20       # outcomes in the rate window
+    guard_breaker_cooldown: float = 5.0  # open → half-open delay
+    guard_breaker_probes: int = 1        # half-open successes to close
+    guard_shed_watermark: float = 0.8    # task-map occupancy fraction
+    guard_stall_after: float = 30.0      # heartbeat age → "stalled"
+    guard_leak_grace: float = 5.0        # soft-kill → leaked-thread count
+    guard_worker_start_timeout: float = 10.0  # worker pool startup bound
     # TPU execution options (new — no reference equivalent)
     tpu_enable: bool = True
     tpu_batch_records: int = 8192
@@ -253,6 +267,18 @@ class ServiceConfig:
         "storage.checksum": ("storage_checksum", parse_bool),
         "storage.backlog.mem_limit": ("storage_backlog_mem_limit", parse_size),
         "storage.max_chunks_up": ("storage_max_chunks_up", int),
+        "guard.enable": ("guard_enable", parse_bool),
+        "guard.flush_timeout": ("guard_flush_timeout", parse_time),
+        "guard.breaker_failures": ("guard_breaker_failures", int),
+        "guard.breaker_error_rate": ("guard_breaker_error_rate", float),
+        "guard.breaker_window": ("guard_breaker_window", int),
+        "guard.breaker_cooldown": ("guard_breaker_cooldown", parse_time),
+        "guard.breaker_probes": ("guard_breaker_probes", int),
+        "guard.shed_watermark": ("guard_shed_watermark", float),
+        "guard.stall_after": ("guard_stall_after", parse_time),
+        "guard.leak_grace": ("guard_leak_grace", parse_time),
+        "guard.worker_start_timeout":
+            ("guard_worker_start_timeout", parse_time),
         "tpu.enable": ("tpu_enable", parse_bool),
         "tpu.batch_records": ("tpu_batch_records", int),
         "tpu.max_record_len": ("tpu_max_record_len", int),

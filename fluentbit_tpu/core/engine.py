@@ -111,6 +111,13 @@ class Engine:
         self.reload_callback = None  # wired by the CLI for /api/v2/reload
 
         self._init_metrics()
+        # fbtpu-guard: flush deadlines, per-output breakers, watchdog +
+        # load shedding (core/guard.py). Touches flush paths only —
+        # the per-record ingest hot path has no guard code, and the
+        # periodic checks ride flush_all's existing timer.
+        from .guard import Guard
+
+        self.guard = Guard(self)
 
     # ------------------------------------------------------------------
     # metrics (names mirror the reference's fluentbit_* families)
@@ -405,9 +412,23 @@ class Engine:
         for out in self.outputs:
             if out.workers > 0 and out.worker_pool is None \
                     and not out.plugin.synchronous:
-                out.worker_pool = OutputWorkerPool(
-                    out.display_name, out.workers, out.plugin)
+                pool = OutputWorkerPool(
+                    out.display_name, out.workers, out.plugin,
+                    start_timeout=self.service.guard_worker_start_timeout)
+                if pool.failed:
+                    # a worker that never starts must not leave submit()
+                    # targeting a dead loop: fail the output over to
+                    # inline flushes on the engine loop
+                    log.error(
+                        "output %s: worker pool startup failed — "
+                        "failing over to inline flush", out.display_name)
+                    self.guard.m_worker_start_fail.inc(
+                        1, (out.display_name,))
+                    pool.stop()
+                else:
+                    out.worker_pool = pool
         self.started_at = time.time()
+        self.guard.heartbeat = time.time()
         # failpoint trigger → metric bridge (unarmed plane: the listener
         # list is only walked when a fault actually fires)
         _fp.add_listener(self._on_failpoint_trigger)
@@ -497,6 +518,9 @@ class Engine:
                         self.sp.drain()
                     except Exception:
                         log.exception("stream processor drain failed")
+            # shed chunks re-enter the backlog so the shutdown drain
+            # (and its quarantine accounting) sees them
+            self.guard.readmit_all()
             self.flush_all()
             await asyncio.sleep(0.05)  # let queued _create callbacks run
             deadline = time.time() + self.service.grace
@@ -575,6 +599,10 @@ class Engine:
             return
         self._stopping = True
         self._thread.join(timeout=self.service.grace + 10)
+        if self._thread.is_alive():
+            # a silently-swallowed join timeout leaves a wedged engine
+            # undiagnosable: say so, and dump every thread's stack
+            self._dump_stuck_shutdown()
         self._thread = None
         for out in self.outputs:
             if out.worker_pool is not None:
@@ -592,6 +620,23 @@ class Engine:
             # always release the module-global listener: a teardown
             # error must not pin this engine (and its metrics) forever
             _fp.remove_listener(self._on_failpoint_trigger)
+
+    def _dump_stuck_shutdown(self) -> None:
+        """The engine thread outlived grace+10s at stop(): log it and
+        dump all thread stacks via faulthandler so a wedged shutdown
+        (a flush stuck in C code, a deadlocked lock) is diagnosable
+        from the crash report instead of a silent hang."""
+        import faulthandler
+        import sys
+
+        log.warning(
+            "engine thread did not exit within %.1fs at stop() — "
+            "shutdown is stuck; dumping all thread stacks to stderr",
+            self.service.grace + 10)
+        try:
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            log.exception("thread stack dump failed")
 
     def _on_failpoint_trigger(self, name: str, _action: str) -> None:
         self.m_failpoint_triggered.inc(1, (name,))
@@ -1101,6 +1146,10 @@ class Engine:
         """Drain ready chunks into tasks and start per-route flushes."""
         if self.started_at:
             self.m_uptime.set(time.time() - self.started_at)
+        # guard watchdog rides this (the housekeeping timer): heartbeat,
+        # flush-deadline scan, occupancy gauges, shed/readmit — never a
+        # per-record cost (core/guard.py)
+        self.guard.housekeeping()
         with self._ingest_lock:
             chunks: List[tuple] = []
             if self._backlog:  # recovered chunks re-dispatch first
@@ -1176,6 +1225,12 @@ class Engine:
                 if self.storage is not None:
                     self.storage.delete(chunk)
                 continue
+            # load shedding (fbtpu-guard): above the occupancy
+            # watermark, a chunk whose EVERY route is behind an open
+            # breaker is spilled instead of taking a task slot — the
+            # slots stay available for healthy routes
+            if self.guard.maybe_shed(chunk, routes):
+                continue
             # bounded task id map (flb_task_map_get_task_id,
             # src/flb_task.c:542): when every slot is in use the chunk
             # stays in its pool and is re-dispatched next flush cycle —
@@ -1244,6 +1299,18 @@ class Engine:
                      priority: Optional[int] = None) -> None:
         from .bucket_queue import PRIORITY_FLUSH
 
+        if self.loop is not None and self.running:
+            # per-output circuit breaker (fbtpu-guard): while open,
+            # dispatch short-circuits to an immediately scheduled retry
+            # — no coroutine, no connection, no flush-semaphore slot.
+            # Deliberately NOT counted against retry_limit: the breaker
+            # is suppressing attempts, not failing them, and must never
+            # turn a sick-but-recoverable route into dropped chunks.
+            delay = self.guard.short_circuit_delay(out)
+            if delay is not None:
+                self.guard.m_short_circuit.inc(1, (out.display_name,))
+                self._schedule_retry(task, out, delay)
+                return
         coro = self._flush_one(task, out)
         if self.loop is None or not self.running:
             # synchronous fallback (engine not started: unit tests)
@@ -1251,6 +1318,7 @@ class Engine:
             return
         def _create():
             fut = asyncio.ensure_future(coro)
+            self.guard.track(task, out, fut)
             self._pending_flushes.add(fut)
             fut.add_done_callback(self._pending_flushes.discard)
         try:
@@ -1277,6 +1345,17 @@ class Engine:
         try:
             await self._flush_body(task, out)
         except asyncio.CancelledError:
+            if self.guard.consume_timeout(task, out):
+                # guard soft-kill (flush deadline expired), NOT a
+                # shutdown cancel: the slot's attempt is reclaimed and
+                # the chunk re-enters the retry scheduler as a normal
+                # RETRY (it counts against retry_limit, so a
+                # permanently hung route still drains to the DLQ)
+                delay = self._handle_flush_result(task, out,
+                                                  FlushResult.RETRY)
+                if delay is not None:
+                    self._schedule_retry(task, out, delay)
+                return
             # engine stopping with this route undelivered (parked on the
             # semaphore, mid-flush, or in backoff): a memory chunk would
             # be silently lost — quarantine when storage is on.
@@ -1328,6 +1407,19 @@ class Engine:
             sem = out.flush_semaphore
             if sem is not None:
                 await sem.acquire()
+            # the deadline clock starts HERE, once the attempt actually
+            # executes: time parked in the flush-semaphore queue behind
+            # a saturated-but-healthy output must not count (the slot
+            # HOLDER's deadline runs, so a hung holder still frees the
+            # queue), and the guard-tracked record is exposed to the
+            # flush via the cooperative-cancel contextvar
+            rec = self.guard.flight(task, out)
+            if rec is not None:
+                from . import guard as _guard
+
+                rec.started = time.time()
+                rec.begun = True
+                _guard.CANCEL_EVENT.set(rec.cancel_event)
             try:
                 # test formatter hook (src/flb_engine_dispatch.c:101-137)
                 if out.test_formatter is not None:
@@ -1339,12 +1431,25 @@ class Engine:
                         result = FlushResult.ERROR
                 else:
                     try:
+                        if _fp.ACTIVE:
+                            # hung/failing-destination faults: an ASYNC
+                            # site, so delay()/hang() suspends only this
+                            # flush (cancellable by the guard deadline),
+                            # never the engine loop. The instance-scoped
+                            # name lets one output hang while siblings
+                            # flow (FAULTS.md).
+                            await _fp.fire_async("output.flush")
+                            await _fp.fire_async(
+                                "output.flush." + out.display_name)
                         if out.worker_pool is not None:
                             # run the plugin's flush on a worker thread
                             # loop (flb_output_thread.c round-robin);
                             # result/retry handling stays here
+                            if rec is not None:
+                                rec.worker = True
                             result = await out.worker_pool.submit(
-                                out.plugin.flush(data, chunk.tag, self))
+                                self._worker_flush(out.plugin, data,
+                                                   chunk.tag, rec))
                         else:
                             result = await out.plugin.flush(
                                 data, chunk.tag, self)
@@ -1371,6 +1476,22 @@ class Engine:
         while delay is not None:
             await asyncio.sleep(delay)
             delay = await attempt()
+
+    async def _worker_flush(self, plugin, data: bytes, tag: str, rec):
+        """Worker-pool submission wrapper: re-exposes the guard's
+        cooperative cancel flag on the worker loop (contextvars do not
+        cross ``run_coroutine_threadsafe``) and marks completion, so
+        the watchdog can tell a soft-kill that landed late from a
+        worker thread wedged in sync code (the leaked-thread counter)."""
+        if rec is not None:
+            from . import guard as _guard
+
+            _guard.CANCEL_EVENT.set(rec.cancel_event)
+        try:
+            return await plugin.flush(data, tag, self)
+        finally:
+            if rec is not None:
+                rec.worker_done = True
 
     def _schedule_retry(self, task: Task, out: OutputInstance,
                         delay: float) -> None:
@@ -1441,6 +1562,7 @@ class Engine:
         name = out.display_name
         chunk = task.chunk
         if result == FlushResult.OK:
+            self.guard.on_result(out, ok=True)  # breaker: close/hold
             self.m_out_proc_records.inc(chunk.records, (name,))
             self.m_out_proc_bytes.inc(chunk.size, (name,))
             self.m_latency.observe(time.time() - chunk.created, (name,))
@@ -1452,12 +1574,14 @@ class Engine:
             task.retries[out.name] = attempts
             limit = out.retry_limit if out.retry_limit is not None else self.service.retry_limit
             if limit == -1 or attempts <= limit:
+                self.guard.on_result(out, ok=False)
                 self.m_out_retries.inc(1, (name,))
                 return backoff_full_jitter(
                     self.service.scheduler_base, self.service.scheduler_cap, attempts
                 )
             self.m_out_retries_failed.inc(1, (name,))
         # ERROR or retries exhausted → drop (+ DLQ quarantine when storage on)
+        self.guard.on_result(out, ok=False)  # breaker: count the failure
         self.m_out_errors.inc(1, (name,))
         self.m_out_dropped.inc(chunk.records, (name,))
         if self.storage is not None:

@@ -1,0 +1,723 @@
+"""fbtpu-guard — flush deadlines, per-output circuit breakers, engine
+watchdog, graceful load shedding.
+
+The pipeline is only as available as its slowest output: a hung flush
+coroutine holds its task-map slot forever (``core/engine.py`` task map,
+2048 slots), so one stuck destination eventually stalls dispatch for
+*every* route — the head-of-line failure the failpoint plane (FAULTS.md)
+can inject but nothing previously survived. This module is the survival
+layer; the engine owns one :class:`Guard` and calls into it from the
+flush paths only — the per-record ingest hot path has ZERO guard code,
+and every periodic check rides the existing flush/housekeeping timer.
+
+Three mechanisms (FAULTS.md "fbtpu-guard" section has the contract):
+
+- **flush deadlines** — every tracked flush attempt (inline coroutine
+  or worker-pool submission) carries a deadline: per-output
+  ``flush_timeout``, else service ``guard.flush_timeout``, else
+  ``2 × grace``. The watchdog soft-kills expired attempts: the asyncio
+  future is cancelled (worker submissions additionally get a
+  cooperative cancel flag — :func:`cancel_requested` — and are hard
+  abandoned if the worker thread is wedged in sync code, counted in
+  ``fluentbit_guard_abandoned_flushes_total``), the task slot's attempt
+  is reclaimed, and the chunk re-enters the retry scheduler as a normal
+  RETRY.
+
+- **per-output circuit breakers** — a closed → open → half-open state
+  machine fed by flush outcomes (OK closes/holds, ERROR/RETRY/timeout
+  counts against consecutive-failure and windowed error-rate
+  thresholds). While open, dispatch short-circuits to an immediately
+  scheduled retry: no coroutine, no connection, no flush-semaphore
+  slot is burned. After the cooldown, half-open admits exactly ONE
+  probe flush; its outcome closes the breaker or re-opens it with a
+  fresh cooldown (hysteresis). The same :class:`CircuitBreaker` backs
+  ``UpstreamHA`` node health in ``core/upstream.py`` (`mark_down` =
+  record_failure, `mark_up` = reset, `pick()` filters on
+  ``available()``).
+
+- **watchdog + load shedding** — the housekeeping pass (rides
+  ``flush_all``'s timer) stamps a heartbeat, exports
+  ``fluentbit_guard_*`` gauges (task-map occupancy + high-water,
+  retry backlog, in-flight flushes, heartbeat age), scans deadlines,
+  and — above ``guard.shed_watermark`` task-map occupancy — spills
+  chunks whose every route sits behind an open breaker back to
+  filesystem storage (memory chunks are written through first when
+  storage is configured) instead of letting them queue for slots.
+  Shed chunks re-enter the backlog as soon as any of their routes'
+  breakers can take a probe, so delivery stays at-least-once; shedding
+  resets the chunk's retry count (it re-enters as a fresh dispatch).
+
+``/api/v1/health`` surfaces the verdict (``ok|degraded|stalled``; see
+``core/http_server.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("flb.guard")
+
+# ---------------------------------------------------------------------------
+# cooperative cancellation + bounded I/O awaits
+# ---------------------------------------------------------------------------
+
+#: Set for the duration of a guarded flush (task-local): plugins doing
+#: long synchronous work on a worker loop can poll
+#: :func:`cancel_requested` to honor a soft-kill the event loop cannot
+#: deliver as a CancelledError.
+CANCEL_EVENT: "contextvars.ContextVar[Optional[threading.Event]]" = \
+    contextvars.ContextVar("fbtpu_guard_cancel", default=None)
+
+
+def cancel_requested() -> bool:
+    """True when the guard has soft-killed the current flush attempt
+    (cooperative worker-thread cancellation; see Guard.housekeeping)."""
+    ev = CANCEL_EVENT.get()
+    return ev is not None and ev.is_set()
+
+
+#: Default bound for one socket await inside a flush path (the
+#: ``await-no-deadline`` lint's escape hatch — ANALYSIS.md).
+DEFAULT_IO_TIMEOUT = 30.0
+
+
+async def io_deadline(awaitable, timeout: float = DEFAULT_IO_TIMEOUT):
+    """Bound one I/O await with a deadline, raising the *builtin*
+    ``TimeoutError`` — an ``OSError`` subclass, so the caller's existing
+    socket error handling (reconnect, RETRY, pool drop) engages without
+    failpoint/guard-aware except clauses. (``asyncio.TimeoutError`` is
+    NOT an ``OSError`` before Python 3.11, hence the translation.)"""
+    try:
+        return await asyncio.wait_for(awaitable, timeout)
+    except asyncio.TimeoutError:
+        raise TimeoutError(
+            f"I/O deadline ({timeout:g}s) expired") from None
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+#: Gauge encoding, severity-ordered for dashboards.
+STATE_CLOSED, STATE_HALF_OPEN, STATE_OPEN = 0, 1, 2
+_STATE_NAMES = {STATE_CLOSED: "closed", STATE_HALF_OPEN: "half-open",
+                STATE_OPEN: "open"}
+
+
+class CircuitBreaker:
+    """Closed → open → half-open state machine over flush outcomes.
+
+    - CLOSED: everything flows; ``failures`` consecutive failures OR a
+      full ``window`` of outcomes at ≥ ``error_rate`` opens it.
+    - OPEN: :meth:`allow` refuses (callers short-circuit) until
+      ``cooldown`` elapses, then transitions to HALF_OPEN and admits
+      the caller as the probe.
+    - HALF_OPEN: exactly one probe in flight; ``probes`` successes
+      close, any failure re-opens with a fresh cooldown (hysteresis).
+
+    ``available()`` is the non-consuming view used by HA ``pick()`` and
+    the shedding pass: True whenever a request COULD be admitted.
+    Thread-safe; transition callbacks fire outside the lock.
+    """
+
+    def __init__(self, name: str, failures: int = 5,
+                 error_rate: float = 0.5, window: int = 20,
+                 cooldown: float = 5.0, probes: int = 1,
+                 on_transition: Optional[Callable] = None,
+                 clock=time.monotonic):
+        self.name = name
+        self.failures = max(1, int(failures))
+        self.error_rate = float(error_rate)
+        self.window = max(1, int(window))
+        self.cooldown = float(cooldown)
+        self.probes = max(1, int(probes))
+        self.on_transition = on_transition
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._consecutive = 0
+        self._outcomes: deque = deque(maxlen=self.window)
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
+        self._probe_ok = 0
+
+    # -- internal (call with self._lock held) --------------------------
+
+    def _transition(self, new: int) -> Optional[Tuple[str, str]]:
+        old = self._state
+        if old == new:
+            return None
+        self._state = new
+        if new == STATE_OPEN:
+            self._opened_at = self.clock()
+            self._probe_inflight = False
+            self._probe_ok = 0
+        elif new == STATE_CLOSED:
+            self._consecutive = 0
+            self._outcomes.clear()
+            self._probe_inflight = False
+            self._probe_ok = 0
+        return (_STATE_NAMES[old], _STATE_NAMES[new])
+
+    def _notify(self, change: Optional[Tuple[str, str]]) -> None:
+        if change is None or self.on_transition is None:
+            return
+        try:
+            self.on_transition(self.name, change[0], change[1])
+        except Exception:
+            log.exception("breaker transition hook failed")
+
+    def _probe_ttl(self) -> float:
+        # a probe whose flush vanished (loop torn down mid-spawn) must
+        # not wedge recovery forever; the flush-deadline guard resolves
+        # probes long before this in a running engine
+        return max(60.0, 4.0 * self.cooldown)
+
+    def _trip_check(self) -> Optional[Tuple[str, str]]:
+        if self._consecutive >= self.failures:
+            return self._transition(STATE_OPEN)
+        if len(self._outcomes) == self.window:
+            rate = self._outcomes.count(False) / self.window
+            if rate >= self.error_rate:
+                return self._transition(STATE_OPEN)
+        return None
+
+    # -- state ----------------------------------------------------------
+
+    def state_name(self) -> str:
+        with self._lock:
+            return _STATE_NAMES[self._state]
+
+    def state_code(self) -> int:
+        with self._lock:
+            return self._state
+
+    def is_closed(self) -> bool:
+        with self._lock:
+            return self._state == STATE_CLOSED
+
+    # -- admission -------------------------------------------------------
+
+    def allow(self) -> bool:
+        """Admit one request. In HALF_OPEN this CONSUMES the probe slot:
+        the first caller after cooldown proceeds, everyone else keeps
+        short-circuiting until the probe's outcome is recorded."""
+        change = None
+        with self._lock:
+            now = self.clock()
+            if self._state == STATE_OPEN:
+                if now - self._opened_at < self.cooldown:
+                    return False
+                change = self._transition(STATE_HALF_OPEN)
+            if self._state == STATE_HALF_OPEN:
+                if self._probe_inflight and \
+                        now - self._probe_started > self._probe_ttl():
+                    self._probe_inflight = False  # lost probe: re-admit
+                if self._probe_inflight:
+                    admitted = False
+                else:
+                    self._probe_inflight = True
+                    self._probe_started = now
+                    admitted = True
+            else:
+                admitted = True
+        self._notify(change)
+        return admitted
+
+    def available(self) -> bool:
+        """Non-consuming admission view (HA ``pick``, shedding): True
+        when a request could be admitted right now."""
+        with self._lock:
+            if self._state == STATE_CLOSED:
+                return True
+            if self._state == STATE_HALF_OPEN:
+                return True
+            return self.clock() - self._opened_at >= self.cooldown
+
+    def retry_delay(self) -> float:
+        """Seconds until the next admission opportunity (the breaker
+        short-circuit's scheduled-retry delay), floored so retry timers
+        never busy-spin."""
+        with self._lock:
+            if self._state == STATE_OPEN:
+                remaining = self.cooldown - (self.clock() - self._opened_at)
+            else:
+                remaining = min(1.0, self.cooldown / 4.0)
+            return max(0.05, remaining)
+
+    # -- outcomes -------------------------------------------------------
+
+    def record_ok(self) -> None:
+        change = None
+        with self._lock:
+            if self._state == STATE_HALF_OPEN:
+                self._probe_inflight = False
+                self._probe_ok += 1
+                if self._probe_ok >= self.probes:
+                    change = self._transition(STATE_CLOSED)
+            elif self._state == STATE_CLOSED:
+                self._consecutive = 0
+                self._outcomes.append(True)
+            else:
+                # late success of a flush that was in flight when the
+                # breaker opened: evidence, not recovery — the probe
+                # path owns the close decision
+                self._outcomes.append(True)
+        self._notify(change)
+
+    def record_failure(self) -> None:
+        change = None
+        with self._lock:
+            if self._state == STATE_HALF_OPEN:
+                change = self._transition(STATE_OPEN)
+            elif self._state == STATE_CLOSED:
+                self._consecutive += 1
+                self._outcomes.append(False)
+                change = self._trip_check()
+            else:
+                # already OPEN: a failure re-arms the cooldown — a
+                # cooled-down-but-still-sick destination (an HA node
+                # re-picked via available(), a straggler flush) must
+                # not be re-admitted on a lapsed timer
+                self._opened_at = self.clock()
+                self._outcomes.append(False)
+        self._notify(change)
+
+    def reset(self) -> None:
+        """Force CLOSED (HA ``mark_up``: the caller has independent
+        evidence the destination is healthy)."""
+        with self._lock:
+            change = self._transition(STATE_CLOSED)
+        self._notify(change)
+
+
+# ---------------------------------------------------------------------------
+# the engine-side guard
+# ---------------------------------------------------------------------------
+
+
+class FlightRecord:
+    """One in-flight flush attempt under deadline watch."""
+
+    __slots__ = ("key", "task", "out_name", "started", "begun",
+                 "deadline", "fut", "cancel_event", "worker",
+                 "worker_done", "timed_out", "consumed", "abandoned_at")
+
+    def __init__(self, key, task, out_name: str, deadline: float, fut):
+        self.key = key
+        self.task = task
+        self.out_name = out_name
+        self.started = time.time()
+        # the deadline clock only runs once the attempt actually
+        # executes (the engine re-stamps `started` and sets `begun`
+        # after the flush-semaphore acquire): an attempt parked in the
+        # queue behind a saturated-but-healthy output is not hung —
+        # the slot HOLDER's deadline is what frees the queue
+        self.begun = False
+        self.deadline = deadline
+        self.fut = fut
+        self.cancel_event = threading.Event()
+        self.worker = False
+        self.worker_done = False
+        self.timed_out = False
+        self.consumed = False
+        self.abandoned_at = 0.0
+
+
+class Guard:
+    """Per-engine guard plane. Created with the engine; inert (cheap
+    early-outs, no threads, no timers of its own) until flushes flow.
+
+    Concurrency: ``_flights``/``_abandoned``/``_shed``/``_breakers``
+    are touched from the engine loop (housekeeping, flush results),
+    ``flush_now`` callers, and — for results — sync-fallback flushes on
+    arbitrary threads; all access holds ``_lock``. Task-map reads hold
+    the engine's ``_ingest_lock`` (same discipline as the engine
+    itself); the pending-retry reclaim pass runs only on the engine
+    loop, where those records live.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._flights: Dict[tuple, FlightRecord] = {}
+        self._abandoned: List[FlightRecord] = []
+        self._shed: List = []  # chunks parked off the dispatch path
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        # count of breakers not in CLOSED (maintained on transitions):
+        # the dispatch loop's shed check reads it lock-free, so the
+        # all-healthy steady state pays zero lock round-trips per chunk
+        self._unhealthy = 0
+        self.heartbeat = time.time()
+
+        m = engine.metrics
+        self.m_timeouts = m.counter(
+            "fluentbit", "guard", "flush_timeouts_total",
+            "Flush attempts soft-killed past their deadline", ("name",))
+        self.m_abandoned = m.counter(
+            "fluentbit", "guard", "abandoned_flushes_total",
+            "Worker-thread flushes hard-abandoned (leaked) after a "
+            "soft-kill could not land", ("name",))
+        self.m_short_circuit = m.counter(
+            "fluentbit", "guard", "short_circuits_total",
+            "Dispatches short-circuited to a scheduled retry by an "
+            "open breaker", ("name",))
+        self.m_shed = m.counter(
+            "fluentbit", "guard", "shed_chunks_total",
+            "Chunks spilled off the dispatch path for open-breaker "
+            "routes", ("name",))
+        self.m_breaker_state = m.gauge(
+            "fluentbit", "guard", "breaker_state",
+            "Per-output breaker state (0 closed, 1 half-open, 2 open)",
+            ("name",))
+        self.m_transitions = m.counter(
+            "fluentbit", "guard", "breaker_transitions_total",
+            "Breaker state transitions", ("name", "state"))
+        self.m_occupancy = m.gauge(
+            "fluentbit", "guard", "task_map_occupancy",
+            "Task-map slots in use")
+        self.m_highwater = m.gauge(
+            "fluentbit", "guard", "task_map_highwater",
+            "Task-map occupancy high-water mark")
+        self.m_retry_backlog = m.gauge(
+            "fluentbit", "guard", "retry_backlog",
+            "Pending retry timers")
+        self.m_inflight = m.gauge(
+            "fluentbit", "guard", "inflight_flushes",
+            "Flush attempts currently tracked by the guard")
+        self.m_heartbeat_age = m.gauge(
+            "fluentbit", "guard", "heartbeat_age_seconds",
+            "Age of the last housekeeping pass at the time it ran")
+        self.m_worker_start_fail = m.counter(
+            "fluentbit", "guard", "worker_start_failures_total",
+            "Output worker pools that failed to start (failed over to "
+            "inline flush)", ("name",))
+
+    # -- config (read live: service keys may be set up to start()) -----
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.engine.service.guard_enable)
+
+    def deadline_for(self, out) -> float:
+        ft = getattr(out, "flush_timeout", None)
+        if ft:
+            return ft
+        svc = self.engine.service
+        if svc.guard_flush_timeout:
+            return svc.guard_flush_timeout
+        return 2.0 * svc.grace
+
+    # -- breakers -------------------------------------------------------
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(name)
+            if br is None:
+                svc = self.engine.service
+                br = CircuitBreaker(
+                    name,
+                    failures=svc.guard_breaker_failures,
+                    error_rate=svc.guard_breaker_error_rate,
+                    window=svc.guard_breaker_window,
+                    cooldown=svc.guard_breaker_cooldown,
+                    probes=svc.guard_breaker_probes,
+                    on_transition=self._on_transition,
+                )
+                self._breakers[name] = br
+        return br
+
+    def _on_transition(self, name: str, old: str, new: str) -> None:
+        code = {v: k for k, v in _STATE_NAMES.items()}[new]
+        self.m_breaker_state.set(code, (name,))
+        self.m_transitions.inc(1, (name, new))
+        with self._lock:
+            if old == "closed" and new != "closed":
+                self._unhealthy += 1
+            elif old != "closed" and new == "closed":
+                self._unhealthy -= 1
+        level = logging.WARNING if new != "closed" else logging.INFO
+        log.log(level, "guard: breaker %s: %s -> %s", name, old, new)
+
+    def short_circuit_delay(self, out) -> Optional[float]:
+        """None → dispatch may proceed (closed, or this caller IS the
+        half-open probe). A delay → the breaker is open: schedule a
+        retry for then instead of flushing."""
+        if not self.enabled:
+            return None
+        br = self.breaker(out.display_name)
+        if br.allow():
+            return None
+        return br.retry_delay()
+
+    def on_result(self, out, ok: bool) -> None:
+        """Feed one flush outcome (OK vs ERROR/RETRY/timeout) to the
+        output's breaker."""
+        if not self.enabled:
+            return
+        br = self.breaker(out.display_name)
+        if ok:
+            br.record_ok()
+        else:
+            br.record_failure()
+
+    # -- flight tracking ------------------------------------------------
+
+    def track(self, task, out, fut) -> Optional[FlightRecord]:
+        if not self.enabled:
+            return None
+        key = (task.id, out.name)
+        rec = FlightRecord(key, task, out.display_name,
+                           self.deadline_for(out), fut)
+        with self._lock:
+            self._flights[key] = rec
+        fut.add_done_callback(lambda _f, k=key: self._untrack(k))
+        return rec
+
+    def _untrack(self, key) -> None:
+        with self._lock:
+            self._flights.pop(key, None)
+
+    def flight(self, task, out) -> Optional[FlightRecord]:
+        with self._lock:
+            return self._flights.get((task.id, out.name))
+
+    def consume_timeout(self, task, out) -> bool:
+        """True exactly once for a flush the watchdog soft-killed: the
+        engine's CancelledError handler uses this to tell a guard
+        deadline from a shutdown cancel."""
+        with self._lock:
+            rec = self._flights.get((task.id, out.name))
+            if rec is not None and rec.timed_out and not rec.consumed:
+                rec.consumed = True
+                return True
+        return False
+
+    # -- watchdog (rides flush_all's timer) -----------------------------
+
+    def housekeeping(self) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        engine = self.engine
+        try:
+            on_loop = asyncio.get_running_loop() is engine.loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            # the heartbeat certifies the ENGINE LOOP is alive — a
+            # flush_now() caller thread running this pass must not
+            # stamp it, or a wedged loop would never read "stalled"
+            self.m_heartbeat_age.set(now - self.heartbeat)
+            self.heartbeat = now
+        with engine._ingest_lock:
+            occupancy = len(engine._task_map)
+        self.m_occupancy.set(occupancy)
+        self.m_highwater.set_max(occupancy)
+        self.m_retry_backlog.set(len(engine._pending_retries))
+
+        # deadline scan: soft-kill expired attempts
+        expired: List[FlightRecord] = []
+        with self._lock:
+            self.m_inflight.set(len(self._flights))
+            for rec in self._flights.values():
+                if rec.begun and not rec.timed_out \
+                        and now - rec.started >= rec.deadline:
+                    rec.timed_out = True
+                    expired.append(rec)
+        for rec in expired:
+            self.m_timeouts.inc(1, (rec.out_name,))
+            log.warning(
+                "guard: flush to %s exceeded its %.1fs deadline — "
+                "soft-killing; chunk re-enters the retry scheduler",
+                rec.out_name, rec.deadline)
+            rec.cancel_event.set()  # cooperative worker-side flag
+            loop = engine.loop
+            if loop is not None:
+                try:
+                    loop.call_soon_threadsafe(rec.fut.cancel)
+                except RuntimeError:
+                    pass  # loop torn down: stop-path accounting owns it
+            if rec.worker:
+                rec.abandoned_at = now
+                with self._lock:
+                    self._abandoned.append(rec)
+
+        # leaked-thread scan: worker flushes whose soft-kill never
+        # landed (thread wedged in sync code) are counted once
+        leaked: List[FlightRecord] = []
+        grace = engine.service.guard_leak_grace
+        with self._lock:
+            keep = []
+            for rec in self._abandoned:
+                if rec.worker_done:
+                    continue  # cancel landed late: recovered
+                if now - rec.abandoned_at >= grace:
+                    leaked.append(rec)
+                else:
+                    keep.append(rec)
+            self._abandoned = keep
+        for rec in leaked:
+            self.m_abandoned.inc(1, (rec.out_name,))
+            log.error(
+                "guard: worker flush to %s ignored its soft-kill for "
+                "%.1fs — hard-abandoning (thread leaked until it "
+                "returns)", rec.out_name, grace)
+
+        self._shed_pass(now, occupancy, on_loop)
+
+    # -- load shedding --------------------------------------------------
+
+    def _watermark_slots(self) -> int:
+        svc = self.engine.service
+        return int(svc.guard_shed_watermark * svc.task_map_size)
+
+    def _route_breakers(self, names) -> List[Optional[CircuitBreaker]]:
+        with self._lock:
+            return [self._breakers.get(n) for n in names]
+
+    def maybe_shed(self, chunk, routes) -> bool:
+        """Dispatch-path shedding: above the occupancy watermark, a
+        chunk whose EVERY route sits behind an open (and not yet
+        probe-ready) breaker is spilled instead of taking a task slot."""
+        if not self.enabled or not routes:
+            return False
+        if not self._unhealthy:
+            # lock-free health probe: shedding needs every route's
+            # breaker open, impossible while all breakers are closed —
+            # the all-healthy dispatch loop pays zero lock round-trips
+            return False
+        engine = self.engine
+        with engine._ingest_lock:
+            occupancy = len(engine._task_map)
+        if occupancy < self._watermark_slots():
+            return False
+        names = [o.display_name for o in routes]
+        brs = self._route_breakers(names)
+        if any(br is None or br.available() for br in brs):
+            return False
+        self._shed_chunk(chunk, names)
+        return True
+
+    def _shed_chunk(self, chunk, route_names) -> None:
+        # persisted route restriction: on readmission the chunk must
+        # only go to the routes it was shed FROM (a sibling route that
+        # already delivered must not see duplicates). The conditional-
+        # routing bitmask must be cleared too — dispatch resolves
+        # routes_mask FIRST, and it still names the delivered siblings
+        chunk.route_names = tuple(route_names)
+        chunk.routes_mask = 0
+        storage = self.engine.storage
+        if storage is not None and not storage.is_tracked(chunk):
+            try:  # durability: a memory chunk spills to disk
+                data = chunk.get_bytes()
+                storage.write_through(chunk, data)
+                storage.finalize(chunk)
+            except Exception:
+                log.exception("guard: shed write-through failed; chunk "
+                              "parked in memory only")
+        with self._lock:
+            self._shed.append(chunk)
+        for name in route_names:
+            self.m_shed.inc(1, (name,))
+        log.warning("guard: shed chunk %s (routes %s) — open breaker + "
+                    "task-map pressure", chunk.tag, ",".join(route_names))
+
+    def _shed_pass(self, now: float, occupancy: int,
+                   on_loop: bool) -> None:
+        """Readmit recovered shed chunks; above the watermark, reclaim
+        task slots held by retry timers for open-breaker routes."""
+        engine = self.engine
+        # readmission: any route able to take a probe → back to backlog
+        with self._lock:
+            shed = list(self._shed)
+        if shed:
+            readmit = []
+            for chunk in shed:
+                brs = self._route_breakers(chunk.route_names or ())
+                if any(br is None or br.available() for br in brs):
+                    readmit.append(chunk)
+            if readmit:
+                with self._lock:
+                    self._shed = [c for c in self._shed
+                                  if c not in readmit]
+                with engine._ingest_lock:
+                    engine._backlog.extend(readmit)
+                log.info("guard: readmitted %d shed chunk(s)",
+                         len(readmit))
+        # retry-slot reclaim: engine-loop only (pending-retry records
+        # are loop-owned)
+        if not on_loop or occupancy < self._watermark_slots():
+            return
+        for key, (task, out, handle) in list(
+                engine._pending_retries.items()):
+            if task.users != 1:
+                continue  # sibling routes still own the slot
+            brs = self._route_breakers([out.display_name])
+            if brs[0] is None or brs[0].available():
+                continue
+            handle.cancel()
+            engine._pending_retries.pop(key, None)
+            self._shed_chunk(task.chunk, [out.display_name])
+            engine._task_unref(task)
+
+    def readmit_all(self) -> None:
+        """Stop path: everything shed re-enters the backlog so the
+        shutdown drain (and its quarantine accounting) sees it."""
+        with self._lock:
+            shed, self._shed = self._shed, []
+        if shed:
+            with self.engine._ingest_lock:
+                self.engine._backlog.extend(shed)
+
+    def shed_count(self) -> int:
+        with self._lock:
+            return len(self._shed)
+
+    # -- health ---------------------------------------------------------
+
+    def health(self) -> dict:
+        """The ``/api/v1/health`` readiness verdict: ``ok`` (everything
+        closed, loop beating), ``degraded`` (any breaker not closed,
+        chunks shed, or task-map pressure — healthy routes still flow),
+        ``stalled`` (the housekeeping heartbeat is older than
+        ``guard.stall_after``: the engine loop is wedged or starved).
+        Heartbeat age is computed at call time, so a wedged flush timer
+        is visible even while the admin server still answers."""
+        engine = self.engine
+        if not self.enabled:
+            return {"status": "ok", "guard": "disabled"}
+        now = time.time()
+        with self._lock:
+            breakers = {name: _STATE_NAMES[br.state_code()]
+                        for name, br in self._breakers.items()}
+            shed = len(self._shed)
+            inflight = len(self._flights)
+        with engine._ingest_lock:
+            occupancy = len(engine._task_map)
+        svc = engine.service
+        running = engine.running
+        hb_age = max(0.0, now - self.heartbeat) if running else 0.0
+        verdict = "ok"
+        if (any(s != "closed" for s in breakers.values()) or shed
+                or occupancy >= self._watermark_slots()):
+            verdict = "degraded"
+        if running and hb_age > max(svc.guard_stall_after,
+                                    5.0 * svc.flush):
+            verdict = "stalled"
+        return {
+            "status": verdict,
+            "heartbeat_age": round(hb_age, 3),
+            "task_map": {"occupancy": occupancy,
+                         "size": svc.task_map_size},
+            "inflight_flushes": inflight,
+            "shed_chunks": shed,
+            "breakers": breakers,
+        }

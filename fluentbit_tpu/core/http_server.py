@@ -7,7 +7,23 @@ flb_engine_start in the reference, src/flb_engine.c:1074-1080).
 
 Endpoints:
   GET  /                       banner (name/version)
-  GET  /api/v1/health          liveness ("ok")
+  GET  /api/v1/health          readiness verdict (fbtpu-guard,
+                               core/guard.py). Healthy → 200 "ok"
+                               (text, reference-compatible). Otherwise
+                               a JSON body {"status": ..., "breakers":
+                               {output: closed|half-open|open}, ...}:
+                               - "degraded" (200): some breaker is not
+                                 closed, chunks are shed, or the task
+                                 map is past the shed watermark —
+                                 healthy routes still flow;
+                               - "stalled" (503): the housekeeping
+                                 heartbeat is older than
+                                 guard.stall_after — the engine loop
+                                 is wedged or starved, readiness
+                                 checks should fail the instance.
+  GET  /api/v1/health/guard    the same verdict, always as JSON (for
+                               dashboards that want breaker state while
+                               the verdict is still "ok")
   GET  /api/v1/metrics         internal metrics as JSON
   GET  /api/v1/metrics/prometheus   Prometheus text exposition
   GET  /api/v1/uptime          uptime seconds
@@ -94,7 +110,14 @@ class AdminServer:
                                    "edition": "tpu-native"}}
             ).encode(), "application/json"
         if path == "/api/v1/health":
-            return 200, b"ok\n", "text/plain"
+            h = e.guard.health()
+            if h["status"] == "ok":
+                return 200, b"ok\n", "text/plain"
+            code = 503 if h["status"] == "stalled" else 200
+            return code, json.dumps(h).encode(), "application/json"
+        if path == "/api/v1/health/guard":
+            return 200, json.dumps(e.guard.health()).encode(), \
+                "application/json"
         if path == "/api/v1/metrics/prometheus":
             return 200, e.metrics.to_prometheus().encode(), \
                 "text/plain; version=0.0.4"

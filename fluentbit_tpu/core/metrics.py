@@ -88,6 +88,14 @@ class Gauge(_Metric):
     def dec(self, value: float = 1.0, labels: Sequence[str] = ()) -> None:
         self.inc(-value, labels)
 
+    def set_max(self, value: float, labels: Sequence[str] = ()) -> None:
+        """High-water semantics: keep the largest value ever set (the
+        guard's task-map occupancy high-water mark)."""
+        k = self._key(labels)
+        with self._lock:
+            if value > self._values.get(k, float("-inf")):
+                self._values[k] = float(value)
+
     def get(self, labels: Sequence[str] = ()) -> float:
         k = self._key(labels)
         with self._lock:

@@ -25,9 +25,16 @@ log = logging.getLogger("flb.output_thread")
 
 
 class OutputWorkerPool:
-    def __init__(self, name: str, workers: int, plugin=None):
+    def __init__(self, name: str, workers: int, plugin=None,
+                 start_timeout: float = 10.0):
         self.name = name
         self.plugin = plugin
+        #: True when the workers never reached the ready barrier: the
+        #: pool's loops are dead or missing, so the engine must fail the
+        #: output over to inline flushes instead of letting submit()
+        #: silently target a loop that will never run anything
+        self.failed = False
+        self._start_timeout = start_timeout
         self._loops: List[asyncio.AbstractEventLoop] = []
         self._threads: List[threading.Thread] = []
         self._rr = itertools.cycle(range(workers))
@@ -38,12 +45,31 @@ class OutputWorkerPool:
                                  name=f"flb-out-{name}-w{i}")
             t.start()
             self._threads.append(t)
-        ready.wait(timeout=10)
+        try:
+            ready.wait(timeout=start_timeout)
+        except threading.BrokenBarrierError:
+            self.failed = True
+            log.error(
+                "output %s: %d worker thread(s) did not start within "
+                "%.1fs — pool unusable, caller must fall back to "
+                "inline flush", name, workers, start_timeout)
 
     def _worker(self, index: int, ready: threading.Barrier) -> None:
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         self._loops.append(loop)
+        if _fp.ACTIVE:
+            try:
+                # models a wedged/failed worker start (a hung
+                # worker_init, a thread that dies before serving):
+                # delay()/hang() stalls the ready barrier past the
+                # startup timeout; return() kills this worker outright
+                _fp.fire("output.worker_start")
+            except OSError:
+                log.error("%s worker %d start failed (injected)",
+                          self.name, index)
+                ready.abort()  # fail startup NOW, not at the timeout
+                return
         # cb_worker_init hook (flb_output_thread.c:249)
         init = getattr(self.plugin, "worker_init", None)
         if init is not None:
@@ -52,7 +78,10 @@ class OutputWorkerPool:
             except Exception:
                 log.exception("%s worker_init failed", self.name)
         try:
-            ready.wait(timeout=10)
+            # same bound as the constructor's wait: a fast worker must
+            # not break the barrier under a slower sibling that the
+            # configured guard.worker_start_timeout still allows
+            ready.wait(timeout=self._start_timeout)
         except threading.BrokenBarrierError:
             pass
         try:
@@ -74,11 +103,16 @@ class OutputWorkerPool:
     def submit(self, coro) -> "asyncio.Future":
         """Run the coroutine on the next worker loop (round-robin);
         returns an awaitable for the CALLING loop."""
+        if self.failed or not self._loops:
+            coro.close()  # never leak a never-awaited coroutine
+            raise RuntimeError(
+                f"output {self.name}: worker pool never started "
+                f"(submit would target a dead loop)")
         if _fp.ACTIVE:
             try:
                 _fp.fire("output.worker_flush")
             except BaseException:
-                coro.close()  # never leak a never-awaited coroutine
+                coro.close()
                 raise
         loop = self._loops[next(self._rr) % len(self._loops)]
         cf = asyncio.run_coroutine_threadsafe(coro, loop)

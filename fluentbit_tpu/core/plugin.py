@@ -258,6 +258,9 @@ class OutputInstance(Instance):
     def __init__(self, plugin: OutputPlugin):
         super().__init__(plugin, "output")
         self.retry_limit: Optional[int] = None  # None → service default
+        # fbtpu-guard per-output flush deadline (None → service
+        # guard.flush_timeout → 2×grace; core/guard.py)
+        self.flush_timeout: Optional[float] = None
         self.workers: int = 0
         self.processors: List = []
         # flush-concurrency bound, built at configure():
@@ -325,6 +328,10 @@ class OutputInstance(Instance):
                     cred.encode()).decode()
             else:
                 self.proxy_auth = None
+        ft = self.properties.get("flush_timeout")
+        if ft is not None:
+            from .config import parse_time
+            self.flush_timeout = parse_time(ft)
         rl = self.properties.get("retry_limit")
         if rl is not None:
             if str(rl).lower() in ("no_limits", "false", "no_retries_forever", "unlimited"):

@@ -15,7 +15,20 @@ from typing import Optional
 
 def backoff_full_jitter(base: float, cap: float, attempt: int,
                         rng: Optional[random.Random] = None) -> float:
-    """Delay in seconds for retry number ``attempt`` (1-based)."""
+    """Delay in seconds for retry number ``attempt`` (1-based).
+
+    Two invariants, pinned by the seeded property suite in
+    ``tests/test_guard.py`` because fbtpu-guard leans on them —
+    breaker-driven retry storms are only bounded if they hold:
+
+    - **never before base+1**: the delay is at least
+      ``min(base, exp) + 1`` (the reference draws from [base, exp]
+      then adds one second), so a timed-out/short-circuited flush can
+      never hot-loop its re-dispatch;
+    - **monotone cap**: the draw's envelope ``min(cap, base·2^n)`` is
+      nondecreasing in the attempt number and the delay never exceeds
+      ``cap + 1``.
+    """
     attempt = max(1, attempt)
     exp = min(cap, base * (2 ** attempt))
     r = rng or random

@@ -180,7 +180,7 @@ class Upstream:
 
 class UpstreamNode:
     __slots__ = ("name", "host", "port", "weight", "properties",
-                 "down_until")
+                 "breaker")
 
     def __init__(self, name: str, host: str, port: int,
                  weight: int = 1, properties=None):
@@ -189,30 +189,42 @@ class UpstreamNode:
         self.port = port
         self.weight = max(1, int(weight))
         self.properties = properties or {}
-        self.down_until = 0.0
+        # node health IS a circuit breaker (fbtpu-guard): one failure
+        # opens it for the HA set's retry_window, ``available()``
+        # re-admits it for a probe, an explicit mark_up closes it —
+        # the same state machine that guards whole outputs in
+        # core/guard.py, so node and output health read identically
+        # on dashboards and in /api/v1/health
+        from .guard import CircuitBreaker
+
+        self.breaker = CircuitBreaker(name, failures=1, cooldown=10.0)
 
 
 class UpstreamHA:
     """Weighted node set with failover (flb_upstream_ha.c).
 
-    ``pick()`` is smooth weighted round-robin over healthy nodes;
-    ``mark_down(node)`` cools a failing node off for ``retry_window``
-    seconds. When every node is down, picks proceed anyway (the caller
-    surfaces the delivery error — parity with the reference, which
-    never blackholes silently)."""
+    ``pick()`` is smooth weighted round-robin over healthy nodes —
+    healthy meaning the node's breaker would admit a request
+    (closed, or cooled down enough for a probe); ``mark_down(node)``
+    records a failure (one failure opens the node's breaker for
+    ``retry_window`` seconds), ``mark_up(node)`` force-closes it.
+    When every node is down, picks proceed anyway (the caller surfaces
+    the delivery error — parity with the reference, which never
+    blackholes silently)."""
 
     def __init__(self, name: str, nodes: List[UpstreamNode],
                  retry_window: float = 10.0):
         self.name = name
         self.nodes = nodes
         self.retry_window = retry_window
+        for n in nodes:
+            n.breaker.cooldown = retry_window
         self._current = {n.name: 0 for n in nodes}
 
     def pick(self) -> Optional[UpstreamNode]:
         if not self.nodes:
             return None
-        now = time.time()
-        candidates = [n for n in self.nodes if n.down_until <= now]
+        candidates = [n for n in self.nodes if n.breaker.available()]
         if not candidates:
             candidates = self.nodes  # all down: let the caller fail
         total = sum(n.weight for n in candidates)
@@ -226,10 +238,10 @@ class UpstreamHA:
         return best
 
     def mark_down(self, node: UpstreamNode) -> None:
-        node.down_until = time.time() + self.retry_window
+        node.breaker.record_failure()
 
     def mark_up(self, node: UpstreamNode) -> None:
-        node.down_until = 0.0
+        node.breaker.reset()
 
 
 def parse_upstream_file(path: str) -> UpstreamHA:

@@ -18,6 +18,10 @@ DSL (one spec per failpoint)::
   subclass, so existing socket/file error handling — retries, pool
   drops, backoff — engages exactly as for a real fault)
 - ``delay(ms)``    sleep ``ms`` milliseconds, then continue
+- ``hang(ms)``     alias for ``delay`` with a 10-minute default — the
+  hung-peer shape the fbtpu-guard deadline/breaker plane is built to
+  survive; at :func:`fire_async` sites the sleep is an
+  ``asyncio.sleep`` (one hung coroutine, not a stalled loop)
 - ``partial(n)``   hand the site a ``("partial", n)`` directive — write
   sites truncate the operation's payload to ``n`` bytes (a torn write)
 - ``panic``        raise ``RuntimeError`` (a plugin bug, not an I/O
@@ -83,7 +87,12 @@ SITES: Tuple[str, ...] = (
     "upstream.connect",          # tls.open_connection, before the dial
     "upstream.send",             # outputs_aws._http_request, before the request write
     "upstream.recv",             # outputs_aws._http_request, before the response read
+    "output.flush",              # Engine._flush_body, before the plugin flush (async
+                                 # site; the instance-scoped variant
+                                 # "output.flush.<output>" fires right after it, so
+                                 # one output can be hung while its siblings flow)
     "output.worker_flush",       # OutputWorkerPool.submit, before the handoff
+    "output.worker_start",       # OutputWorkerPool._worker, before the ready barrier
     "codec.fallback",            # filter_parser batched JSON path: forced decline
     "device.attach",             # ops.device._attach_worker, before backend init
     "s3.upload_part",            # outputs_aws._mp_upload_part (RETRY repro site)
@@ -100,7 +109,11 @@ class FailpointError(OSError):
     """
 
 
-_ACTIONS = ("off", "return", "delay", "partial", "panic", "crash")
+_ACTIONS = ("off", "return", "delay", "hang", "partial", "panic", "crash")
+
+#: ``hang`` with no argument sleeps this long — "forever" on test
+#: timescales, finite so an abandoned arm cannot wedge a process for real
+HANG_DEFAULT_MS = 600000.0
 
 _TERM_RE = re.compile(
     r"^(?:(?P<pct>\d+(?:\.\d+)?)%)?"
@@ -140,7 +153,7 @@ def parse_spec(spec: str) -> List[_Term]:
         pct = float(m.group("pct")) if m.group("pct") else None
         cnt = int(m.group("cnt")) if m.group("cnt") else None
         arg = m.group("arg") or ""
-        if action == "delay":
+        if action in ("delay", "hang"):
             float(arg or "0")  # validate now, not at fire time
         elif action == "partial":
             int(arg or "0")
@@ -282,17 +295,11 @@ def _crash() -> None:
     os._exit(137)
 
 
-def fire(name: str) -> Optional[Tuple[str, int]]:
-    """Evaluate the failpoint at site ``name``.
-
-    Returns ``None`` (not armed / term not taken / no-op action), or a
-    site-interpreted directive tuple — currently only
-    ``("partial", n)``. Raises :class:`FailpointError` for ``return``,
-    ``RuntimeError`` for ``panic``; ``crash`` does not return.
-
-    Sites guard the call with ``if failpoints.ACTIVE:`` so an unarmed
-    plane costs one module-attribute read.
-    """
+def _decide(name: str) -> Optional[Tuple[str, str]]:
+    """Registry bookkeeping for one site hit: consume the current term,
+    fire the listeners + trigger log, and return ``(action, arg)`` for
+    the caller to apply — or ``None`` when nothing triggers. All side
+    effects (sleeps, raises, crash) happen OUTSIDE the registry lock."""
     with _lock:
         fp = _registry.get(name)
         if fp is None:
@@ -313,20 +320,26 @@ def fire(name: str) -> Optional[Tuple[str, int]]:
             return None
         fp.triggered += 1
         listeners = list(_listeners)
-    # action side effects run OUTSIDE the lock (delay sleeps; crash
-    # never returns; listeners may take their own locks)
     for cb in listeners:
         try:
             cb(name, action)
         except Exception:
             log.exception("failpoint listener failed")
     log.warning("failpoint triggered: %s -> %s(%s)", name, action, arg)
+    return (action, arg)
+
+
+def _hang_ms(action: str, arg: str) -> float:
+    if action == "hang":
+        return float(arg) if arg else HANG_DEFAULT_MS
+    return float(arg or "0")
+
+
+def _apply(name: str, action: str, arg: str) -> Optional[Tuple[str, int]]:
+    """The non-sleeping action side effects shared by fire/fire_async."""
     if action == "return":
         raise FailpointError(f"failpoint {name}: injected error"
                              + (f" ({arg})" if arg else ""))
-    if action == "delay":
-        time.sleep(float(arg or "0") / 1000.0)
-        return None
     if action == "partial":
         return ("partial", int(arg or "0"))
     if action == "panic":
@@ -334,6 +347,46 @@ def fire(name: str) -> Optional[Tuple[str, int]]:
     if action == "crash":
         _crash()
     return None
+
+
+def fire(name: str) -> Optional[Tuple[str, int]]:
+    """Evaluate the failpoint at site ``name``.
+
+    Returns ``None`` (not armed / term not taken / no-op action), or a
+    site-interpreted directive tuple — currently only
+    ``("partial", n)``. Raises :class:`FailpointError` for ``return``,
+    ``RuntimeError`` for ``panic``; ``crash`` does not return;
+    ``delay``/``hang`` block the calling thread.
+
+    Sites guard the call with ``if failpoints.ACTIVE:`` so an unarmed
+    plane costs one module-attribute read.
+    """
+    decided = _decide(name)
+    if decided is None:
+        return None
+    action, arg = decided
+    if action in ("delay", "hang"):
+        time.sleep(_hang_ms(action, arg) / 1000.0)
+        return None
+    return _apply(name, action, arg)
+
+
+async def fire_async(name: str) -> Optional[Tuple[str, int]]:
+    """:func:`fire` for coroutine sites: ``delay``/``hang`` become an
+    ``asyncio.sleep``, so the fault suspends ONE coroutine (a hung
+    flush) instead of stalling the whole event loop — and stays
+    cancellable by the fbtpu-guard deadline watchdog. Every other
+    action behaves exactly like :func:`fire`."""
+    decided = _decide(name)
+    if decided is None:
+        return None
+    action, arg = decided
+    if action in ("delay", "hang"):
+        import asyncio
+
+        await asyncio.sleep(_hang_ms(action, arg) / 1000.0)
+        return None
+    return _apply(name, action, arg)
 
 
 # arm from the environment at import: subprocess harnesses (the soak

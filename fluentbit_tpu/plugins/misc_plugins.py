@@ -17,6 +17,7 @@ from typing import Dict
 
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
+from ..core.guard import io_deadline
 from ..core.plugin import (
     FlushResult,
     InputPlugin,
@@ -59,7 +60,7 @@ class NatsOutput(OutputPlugin):
         if not info.startswith(b"INFO"):
             raise ConnectionError("nats: expected INFO")
         self._writer.write(b'CONNECT {"verbose":false}\r\n')
-        await self._writer.drain()
+        await io_deadline(self._writer.drain(), 10)
 
     async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
         async with self._lock:
@@ -78,7 +79,7 @@ class NatsOutput(OutputPlugin):
                 raise ConnectionError("nats: peer closed")
             if line.startswith(b"PING"):
                 self._writer.write(b"PONG\r\n")
-                await self._writer.drain()
+                await io_deadline(self._writer.drain(), 10)
             elif line.startswith(b"-ERR"):
                 raise ConnectionError(
                     f"nats: {line.decode(errors='replace').strip()}"

@@ -29,6 +29,7 @@ from typing import Optional
 from ..codec.events import encode_event
 from ..codec.msgpack import EventTime, OutOfData, Unpacker, packb
 from ..core.config import ConfigMapEntry
+from ..core.guard import io_deadline
 from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
 from ..core.upstream import close_quietly
 
@@ -230,7 +231,7 @@ class ForwardOutput(OutputPlugin):
             salt + hostname.encode() + nonce + self.shared_key.encode()
         ).hexdigest()
         self._writer.write(packb(["PING", hostname, salt, digest, "", ""]))
-        await self._writer.drain()
+        await io_deadline(self._writer.drain(), 10)
         pong = await self._read_msg(u)
         if not (isinstance(pong, list) and len(pong) >= 2 and pong[0] == "PONG"
                 and pong[1]):
@@ -241,7 +242,7 @@ class ForwardOutput(OutputPlugin):
             try:
                 return u.unpack()
             except OutOfData:
-                data = await self._reader.read(65536)
+                data = await io_deadline(self._reader.read(65536))
                 if not data:
                     raise ConnectionError("forward: peer closed")
                 u.feed(data)
@@ -281,7 +282,7 @@ class ForwardOutput(OutputPlugin):
                 chunk_id = os.urandom(16).hex()
                 option["chunk"] = chunk_id
             self._writer.write(packb([tag, blob, option]))
-            await self._writer.drain()
+            await io_deadline(self._writer.drain())
             if chunk_id is not None:
                 u = Unpacker()
                 try:

@@ -16,6 +16,7 @@ from typing import Optional
 
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
+from ..core.guard import io_deadline
 from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
 from .outputs_basic import format_json_lines
 
@@ -170,7 +171,7 @@ class _SocketOutput(OutputPlugin):
         from ..core.tls import open_connection
 
         reader, writer = await open_connection(
-            self.instance, self.host, self.port
+            self.instance, self.host, self.port, timeout=10
         )
         self._reader = reader
         self._writer = writer
@@ -189,7 +190,7 @@ class _SocketOutput(OutputPlugin):
         try:
             writer = await self._connect()
             writer.write(self._format(data))
-            await writer.drain()
+            await io_deadline(writer.drain())
         except OSError:
             self._writer = None
             return FlushResult.RETRY

@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 
 from ..codec.events import decode_events
 from ..core.config import ConfigMapEntry
+from ..core.guard import io_deadline
 from ..core.plugin import FlushResult, OutputPlugin, registry
 from ..core.upstream import close_quietly
 from .outputs_http_based import _json_default
@@ -78,7 +79,7 @@ class PgsqlOutput(OutputPlugin):
             _cstr("database") + _cstr(self.database) + b"\x00"
         payload = struct.pack("!I", 196608) + params  # protocol 3.0
         self._writer.write(struct.pack("!I", len(payload) + 4) + payload)
-        await self._writer.drain()
+        await io_deadline(self._writer.drain(), 10.0)
         while True:
             tag, body = await asyncio.wait_for(
                 _read_msg(self._reader), 10.0)
@@ -89,7 +90,7 @@ class PgsqlOutput(OutputPlugin):
                 if code == 3:  # cleartext password
                     self._writer.write(_msg(
                         b"p", _cstr(self.password or "")))
-                    await self._writer.drain()
+                    await io_deadline(self._writer.drain(), 10.0)
                     continue
                 if code == 5:  # MD5: md5(md5(pw + user) + salt)
                     salt = body[4:8]
@@ -99,7 +100,7 @@ class PgsqlOutput(OutputPlugin):
                     outer = hashlib.md5(
                         inner.encode() + salt).hexdigest()
                     self._writer.write(_msg(b"p", _cstr("md5" + outer)))
-                    await self._writer.drain()
+                    await io_deadline(self._writer.drain(), 10.0)
                     continue
                 raise ConnectionError(f"unsupported auth method {code}")
             if tag == b"E":
@@ -111,7 +112,7 @@ class PgsqlOutput(OutputPlugin):
 
     async def _query(self, sql: str) -> None:
         self._writer.write(_msg(b"Q", _cstr(sql)))
-        await self._writer.drain()
+        await io_deadline(self._writer.drain())
         error = None
         while True:
             tag, body = await asyncio.wait_for(

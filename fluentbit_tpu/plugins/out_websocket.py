@@ -18,6 +18,7 @@ import struct
 from typing import List, Optional
 
 from ..core.config import ConfigMapEntry
+from ..core.guard import io_deadline
 from ..core.plugin import FlushResult, OutputPlugin, registry
 from ..core.upstream import close_quietly
 from .outputs_basic import format_json_lines
@@ -88,7 +89,7 @@ class WebsocketOutput(OutputPlugin):
             f"Sec-WebSocket-Key: {key}\r\n"
             "Sec-WebSocket-Version: 13\r\n\r\n"
         ).encode())
-        await writer.drain()
+        await io_deadline(writer.drain(), 10.0)
         status = await asyncio.wait_for(reader.readline(), 10.0)
         if b" 101 " not in status:
             writer.close()
@@ -135,14 +136,17 @@ class WebsocketOutput(OutputPlugin):
             n = head[1] & 0x7F
             if n == 126:
                 n = struct.unpack(
-                    "!H", await self._reader.readexactly(2))[0]
+                    "!H", await io_deadline(
+                        self._reader.readexactly(2), 10.0))[0]
             elif n == 127:
                 n = struct.unpack(
-                    "!Q", await self._reader.readexactly(8))[0]
-            payload = await self._reader.readexactly(n) if n else b""
+                    "!Q", await io_deadline(
+                        self._reader.readexactly(8), 10.0))[0]
+            payload = await io_deadline(
+                self._reader.readexactly(n), 10.0) if n else b""
             if opcode == OP_PING:
                 self._writer.write(ws_frame(OP_PONG, payload))
-                await self._writer.drain()
+                await io_deadline(self._writer.drain(), 10.0)
             elif opcode == OP_CLOSE:
                 raise ConnectionError("server sent Close")
 

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 from ..codec.events import decode_events
 from ..core.config import ConfigMapEntry
+from ..core.guard import io_deadline
 from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
 from ..core.upstream import close_quietly
 from .outputs_cloud import _GoogleOutput
@@ -124,8 +125,10 @@ class VivoExporterOutput(OutputPlugin):
                     writer.write(http_response(
                         200 if items is not None else 404, body,
                         "application/x-ndjson"))
-                    await writer.drain()
-            except (ConnectionError, asyncio.IncompleteReadError):
+                    await io_deadline(writer.drain(), 10.0)
+            except (OSError, asyncio.IncompleteReadError):
+                # OSError covers both peer resets and io_deadline's
+                # TimeoutError (a stalled scraper): drop the connection
                 pass
             finally:
                 close_quietly(writer)

@@ -520,6 +520,73 @@ class TestSoakFullMatrix:
         soak.verify_contract(soak.SoakOutcome(d), restarts=1)
 
 
+def test_hung_output_breaker_isolates_and_recovers():
+    """The fbtpu-guard soak scenario: one output's flushes hang (the
+    new ``hang`` action on the instance-scoped ``output.flush.<name>``
+    site). Required behavior: (a) the sibling route's delivery stays
+    bit-exact and unstalled with bounded task-map occupancy, (b) the
+    hung output's breaker opens, then recovers through a half-open
+    probe once the failpoint disarms, (c) every acked chunk for the
+    sick route is delivered at-least-once after recovery."""
+    from fluentbit_tpu.codec.events import decode_events
+
+    healthy, sick = [], []
+    ctx = flb.create(flush="50ms", grace="1", **{
+        "scheduler.base": "0.05", "scheduler.cap": "0.1",
+        "guard.breaker_failures": "2", "guard.breaker_cooldown": "0.3",
+    })
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.output("lib", match="t", alias="healthy",
+               callback=lambda d, t: healthy.extend(
+                   ev.body["seq"] for ev in decode_events(d)))
+    ctx.output("lib", match="t", alias="sick", flush_timeout="0.2s",
+               retry_limit="no_limits",
+               callback=lambda d, t: sick.extend(
+                   ev.body["seq"] for ev in decode_events(d)))
+    failpoints.enable("output.flush.sick", "hang(30000)")
+    n = 8
+    ctx.start()
+    try:
+        for seq in range(n):
+            ctx.push(in_ffd, json.dumps({"seq": seq}))
+            time.sleep(0.06)  # separate chunks → separate flushes
+        # (a) the healthy route is untouched by the sibling's hang:
+        # complete, in order, promptly
+        deadline = time.time() + 4
+        while len(healthy) < n and time.time() < deadline:
+            time.sleep(0.02)
+        assert healthy == list(range(n)), \
+            f"healthy route stalled or reordered: {healthy}"
+        with ctx.engine._ingest_lock:
+            occupancy = len(ctx.engine._task_map)
+        assert occupancy <= n, f"task map not bounded: {occupancy}"
+        # (b) the sick route's breaker opened
+        g = ctx.engine.guard
+        deadline = time.time() + 4
+        while g.breaker("sick").state_name() != "open" \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        assert g.breaker("sick").state_name() == "open"
+        assert g.m_timeouts.get(("sick",)) >= 2
+        assert not sick, "hung output must not have delivered"
+
+        failpoints.reset()  # destination recovers
+        # (c) at-least-once for every acked chunk on the sick route
+        deadline = time.time() + 10
+        while set(sick) != set(range(n)) and time.time() < deadline:
+            time.sleep(0.05)
+        assert set(sick) == set(range(n)), \
+            f"sick route lost chunks after recovery: {sorted(set(sick))}"
+        deadline = time.time() + 5
+        while g.breaker("sick").state_name() != "closed" \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        assert g.breaker("sick").state_name() == "closed", \
+            "breaker must close after a successful half-open probe"
+    finally:
+        ctx.stop()
+
+
 def test_http_control_explicit_opt_out(monkeypatch):
     """FBTPU_FAILPOINTS_HTTP=0 must keep the admin surface read-only
     even when the process is env-armed via FBTPU_FAILPOINTS."""

@@ -1,0 +1,605 @@
+"""fbtpu-guard: flush deadlines, per-output circuit breakers, watchdog
++ load shedding (core/guard.py), plus the satellite hardening — worker
+pool startup failover, stuck-shutdown stack dumps, the
+``/api/v1/health`` readiness verdict, and the seeded backoff-jitter
+property suite.
+
+The fast breaker state-machine suite runs on a fake clock; the engine
+integration cases use sub-second deadlines/cooldowns so the whole file
+stays tier-1 friendly.
+"""
+
+import asyncio
+import json
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu import failpoints
+from fluentbit_tpu.codec.events import decode_events
+from fluentbit_tpu.core.guard import (CircuitBreaker, Guard, cancel_requested,
+                                      io_deadline)
+from fluentbit_tpu.core.plugin import FlushResult, OutputPlugin, registry
+from fluentbit_tpu.core.scheduler import backoff_full_jitter
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def wait_for(cond, timeout=8.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f"condition not met within {timeout}s")
+
+
+# ---------------------------------------------------------------------
+# CircuitBreaker state machine (fake clock: deterministic + instant)
+# ---------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(**kw):
+    clock = _Clock()
+    transitions = []
+    br = CircuitBreaker(
+        "out", on_transition=lambda n, o, new: transitions.append((o, new)),
+        clock=clock, **kw)
+    return br, clock, transitions
+
+
+def test_breaker_opens_on_consecutive_failures():
+    br, _clock, transitions = _breaker(failures=3, cooldown=5.0)
+    for _ in range(2):
+        br.record_failure()
+    assert br.state_name() == "closed" and br.allow()
+    br.record_failure()
+    assert br.state_name() == "open"
+    assert not br.allow() and not br.available()
+    assert transitions == [("closed", "open")]
+
+
+def test_breaker_opens_on_windowed_error_rate():
+    br, _clock, _t = _breaker(failures=100, error_rate=0.5, window=10)
+    # alternate: never 100 consecutive, but 50% of the window fails
+    for i in range(10):
+        (br.record_failure if i % 2 else br.record_ok)()
+    assert br.state_name() == "open"
+
+
+def test_breaker_ok_resets_consecutive_count():
+    br, _c, _t = _breaker(failures=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_ok()
+    br.record_failure()
+    br.record_failure()
+    assert br.state_name() == "closed"
+
+
+def test_breaker_half_open_single_probe_and_recovery():
+    br, clock, transitions = _breaker(failures=1, cooldown=5.0)
+    br.record_failure()
+    assert br.state_name() == "open"
+    clock.t = 4.9
+    assert not br.allow()
+    clock.t = 5.1
+    assert br.available()          # non-consuming view
+    assert br.allow()              # THE probe
+    assert br.state_name() == "half-open"
+    assert not br.allow(), "half-open admits exactly one probe"
+    br.record_ok()
+    assert br.state_name() == "closed"
+    assert transitions == [("closed", "open"), ("open", "half-open"),
+                           ("half-open", "closed")]
+
+
+def test_breaker_probe_failure_reopens_with_fresh_cooldown():
+    br, clock, _t = _breaker(failures=1, cooldown=5.0)
+    br.record_failure()
+    clock.t = 6.0
+    assert br.allow()
+    br.record_failure()            # probe failed: hysteresis
+    assert br.state_name() == "open"
+    clock.t = 10.0                 # 4s into the FRESH cooldown
+    assert not br.allow()
+    clock.t = 11.1
+    assert br.allow()
+
+
+def test_breaker_failure_while_open_rearms_cooldown():
+    """An HA node re-picked after its cooldown but still failing must
+    not be re-admitted on the lapsed timer (mark_down while open)."""
+    br, clock, _t = _breaker(failures=1, cooldown=5.0)
+    br.record_failure()
+    clock.t = 6.0
+    assert br.available()
+    br.record_failure()            # still sick
+    assert not br.available()
+    clock.t = 10.0
+    assert not br.available()      # cooldown re-armed at t=6
+    clock.t = 11.1
+    assert br.available()
+
+
+def test_breaker_probes_threshold_and_reset():
+    br, clock, _t = _breaker(failures=1, cooldown=1.0, probes=2)
+    br.record_failure()
+    clock.t = 1.5
+    assert br.allow()
+    br.record_ok()
+    assert br.state_name() == "half-open", "needs 2 probe successes"
+    assert br.allow()
+    br.record_ok()
+    assert br.state_name() == "closed"
+    br.record_failure()
+    assert br.state_name() == "open"
+    br.reset()                     # HA mark_up semantics
+    assert br.state_name() == "closed" and br.allow()
+
+
+# ---------------------------------------------------------------------
+# scheduler backoff: seeded jitter property suite (satellite)
+# ---------------------------------------------------------------------
+
+
+def test_backoff_full_jitter_seeded_properties():
+    """Monotone cap + the never-before-base+1 invariant, over seeded
+    draws — breaker-driven retry storms are provably bounded."""
+    rng = random.Random(1234)
+    for base, cap in [(0.05, 0.1), (5.0, 2000.0), (1.0, 1.0),
+                      (2.0, 1000.0), (10.0, 5.0)]:
+        for attempt in range(1, 48):
+            exp = min(cap, base * (2 ** attempt))
+            d = backoff_full_jitter(base, cap, attempt, rng)
+            # never fires before min(base, cap)+1 (the reference adds
+            # one second after drawing from [base, exp])
+            assert d >= min(base, exp) + 1.0 - 1e-9, (base, cap, attempt)
+            # capped: the draw's upper bound is min(cap, base*2^n)
+            assert d <= cap + 1.0 + 1e-9, (base, cap, attempt)
+        # the envelope itself is monotone in the attempt number
+        exps = [min(cap, base * (2 ** a)) for a in range(1, 48)]
+        assert exps == sorted(exps)
+    # same seed → same schedule (determinism for soak replays)
+    a = [backoff_full_jitter(5, 2000, k, random.Random(7))
+         for k in range(1, 24)]
+    b = [backoff_full_jitter(5, 2000, k, random.Random(7))
+         for k in range(1, 24)]
+    assert a == b
+
+
+# ---------------------------------------------------------------------
+# unarmed/disabled guard overhead: zero per-record work at ingest
+# ---------------------------------------------------------------------
+
+
+def test_guard_no_work_on_ingest_hot_path(monkeypatch):
+    """Guard checks ride the housekeeping timer and the flush paths —
+    the per-record ingest path must never touch the guard."""
+    from fluentbit_tpu.core.engine import Engine
+
+    calls = []
+    for name in ("housekeeping", "maybe_shed", "track",
+                 "short_circuit_delay", "on_result", "breaker",
+                 "flight", "consume_timeout"):
+        real = getattr(Guard, name)
+        monkeypatch.setattr(
+            Guard, name,
+            (lambda real_fn, nm: lambda self, *a, **kw: (
+                calls.append(nm), real_fn(self, *a, **kw))[1])(real, name))
+
+    e = Engine()
+    ins = e.input("dummy")
+    for x in e.inputs:
+        x.configure()
+        x.plugin.init(x, e)
+    from fluentbit_tpu.codec.events import encode_event
+
+    for i in range(50):
+        e.input_log_append(ins, "t", encode_event({"seq": i}, 1.0 + i))
+    assert calls == [], f"guard touched on the ingest hot path: {calls}"
+
+
+def test_guard_disabled_is_inert():
+    ctx = flb.create(flush="50ms", grace="1", **{"guard.enable": "off"})
+    got = []
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"x": 1}')
+        wait_for(lambda: got)
+    finally:
+        ctx.stop()
+    g = ctx.engine.guard
+    assert g._breakers == {} and g._flights == {}
+    assert g.health() == {"status": "ok", "guard": "disabled"}
+
+
+# ---------------------------------------------------------------------
+# flush deadlines: soft-kill → RETRY; leaked worker threads
+# ---------------------------------------------------------------------
+
+
+def _register_test_outputs():
+    from fluentbit_tpu.core.config import ConfigMapEntry
+
+    if "guard_hang" in registry.outputs:
+        return
+
+    @registry.register
+    class GuardHangOutput(OutputPlugin):
+        """Hangs (async) for the first `hang_n` flushes, then delivers."""
+
+        name = "guard_hang"
+        config_map = [ConfigMapEntry("hang_n", "int", default=1)]
+
+        def init(self, instance, engine) -> None:
+            self.calls = 0
+            self.delivered = []
+
+        async def flush(self, data, tag, engine):
+            self.calls += 1
+            if self.calls <= self.hang_n:
+                await asyncio.sleep(60)
+            self.delivered.extend(
+                ev.body["seq"] for ev in decode_events(data))
+            return FlushResult.OK
+
+    @registry.register
+    class GuardBlockOutput(OutputPlugin):
+        """Blocks its worker thread in SYNC code once (a wedged flush
+        the event loop cannot cancel), then delivers; also exercises
+        the cooperative cancel flag."""
+
+        name = "guard_block"
+        config_map = [ConfigMapEntry("block_s", "double", default=1.0)]
+
+        def init(self, instance, engine) -> None:
+            self.calls = 0
+            self.delivered = []
+            self.saw_cancel_flag = False
+
+        async def flush(self, data, tag, engine):
+            self.calls += 1
+            if self.calls == 1:
+                time.sleep(self.block_s)
+                # the soft-kill could not land as a CancelledError
+                # while we were in sync code — but the cooperative
+                # flag is visible here
+                self.saw_cancel_flag = cancel_requested()
+            self.delivered.extend(
+                ev.body["seq"] for ev in decode_events(data))
+            return FlushResult.OK
+
+    @registry.register
+    class GuardFlakyOutput(OutputPlugin):
+        """RETRY until .ok is flipped, then delivers."""
+
+        name = "guard_flaky"
+
+        def init(self, instance, engine) -> None:
+            self.calls = 0
+            self.ok = False
+            self.delivered = []
+
+        async def flush(self, data, tag, engine):
+            self.calls += 1
+            if not self.ok:
+                return FlushResult.RETRY
+            self.delivered.extend(
+                ev.body["seq"] for ev in decode_events(data))
+            return FlushResult.OK
+
+
+_register_test_outputs()
+
+
+def test_flush_deadline_soft_kills_and_requeues_as_retry():
+    ctx = flb.create(flush="50ms", grace="1", **{
+        "scheduler.base": "0.05", "scheduler.cap": "0.1",
+        "guard.breaker_failures": "50",  # deadline path, not the breaker
+    })
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.output("guard_hang", match="t", alias="hang", hang_n="1",
+               flush_timeout="0.2s", retry_limit="no_limits")
+    plugin = ctx.engine.outputs[0].plugin
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"seq": 1}')
+        wait_for(lambda: plugin.delivered == [1], timeout=6)
+        g = ctx.engine.guard
+        assert g.m_timeouts.get(("hang",)) >= 1
+        # the slot was reclaimed: task map drains once delivered
+        wait_for(lambda: not ctx.engine._task_map, timeout=4)
+        # the soft-kill was accounted as a normal RETRY
+        assert ctx.engine.m_out_retries.get(("hang",)) >= 1
+    finally:
+        ctx.stop()
+
+
+def test_worker_flush_hard_abandon_counts_leaked_thread():
+    ctx = flb.create(flush="50ms", grace="2", **{
+        "scheduler.base": "0.05", "scheduler.cap": "0.1",
+        "guard.leak_grace": "0.1",
+        "guard.breaker_failures": "50",
+    })
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.output("guard_block", match="t", alias="blocky", workers="1",
+               block_s="1.0", flush_timeout="0.2s",
+               retry_limit="no_limits")
+    plugin = ctx.engine.outputs[0].plugin
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"seq": 9}')
+        g = ctx.engine.guard
+        # the wedged worker ignores its soft-kill → hard abandon
+        wait_for(lambda: g.m_abandoned.get(("blocky",)) >= 1, timeout=4)
+        wait_for(lambda: 9 in plugin.delivered, timeout=6)
+        assert plugin.saw_cancel_flag, \
+            "cooperative cancel flag not visible to the wedged worker"
+    finally:
+        ctx.stop()
+
+
+# ---------------------------------------------------------------------
+# breaker integration: open → short-circuit → probe → recovery
+# ---------------------------------------------------------------------
+
+
+def test_breaker_short_circuits_and_recovers_via_probe():
+    # retry timers fire at backoff+1s (the reference's jitter floor),
+    # so a 2s cooldown guarantees the first post-open retry lands
+    # INSIDE the open window and must short-circuit
+    ctx = flb.create(flush="50ms", grace="1", **{
+        "scheduler.base": "0.05", "scheduler.cap": "0.1",
+        "guard.breaker_failures": "2", "guard.breaker_cooldown": "2",
+    })
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.output("guard_flaky", match="t", alias="flaky",
+               retry_limit="no_limits")
+    plugin = ctx.engine.outputs[0].plugin
+    ctx.start()
+    try:
+        g = ctx.engine.guard
+        ctx.push(in_ffd, '{"seq": 5}')
+        wait_for(lambda: g.breaker("flaky").state_name() == "open",
+                 timeout=5)
+        calls_at_open = plugin.calls
+        # while open, dispatch short-circuits: scheduled retries, no
+        # flush attempts (no probe before the 2s cooldown)
+        wait_for(lambda: g.m_short_circuit.get(("flaky",)) >= 1,
+                 timeout=4)
+        assert plugin.calls == calls_at_open, \
+            "open breaker must not burn flush attempts"
+        plugin.ok = True  # destination recovers
+        wait_for(lambda: plugin.delivered == [5], timeout=8)
+        wait_for(lambda: g.breaker("flaky").state_name() == "closed",
+                 timeout=5)
+        assert g.m_transitions.get(("flaky", "open")) >= 1
+        assert g.m_transitions.get(("flaky", "closed")) >= 1
+    finally:
+        ctx.stop()
+
+
+# ---------------------------------------------------------------------
+# load shedding: open-breaker chunks spill, readmit on recovery
+# ---------------------------------------------------------------------
+
+
+def test_dispatch_sheds_open_breaker_routes_and_readmits():
+    ctx = flb.create(flush="50ms", grace="1", **{
+        "task_map_size": "4", "guard.shed_watermark": "0.5",
+        "guard.breaker_failures": "1", "guard.breaker_cooldown": "30",
+        "scheduler.base": "0.05", "scheduler.cap": "0.1",
+    })
+    got = []
+    in_ffds = [ctx.input("lib", tag=f"t{i}") for i in range(4)]
+    ctx.output("lib", match="t*", alias="sink",
+               callback=lambda d, t: got.extend(
+                   ev.body["seq"] for ev in decode_events(d)))
+    ctx.start()
+    try:
+        g = ctx.engine.guard
+        # force the sink's breaker open (cooldown 30s: stays open)
+        g.breaker("sink").record_failure()
+        assert g.breaker("sink").state_name() == "open"
+        for i, ffd in enumerate(in_ffds):
+            ctx.push(ffd, json.dumps({"seq": i}))
+        # watermark = 2 of 4 slots: the first chunks park as
+        # short-circuited retries, the rest shed; the watchdog then
+        # reclaims the retry-held slots too
+        wait_for(lambda: g.shed_count() >= 3, timeout=4)
+        wait_for(lambda: sum(g.m_shed.get((n,))
+                             for n in ("sink",)) >= 3, timeout=2)
+        assert not got, "open breaker must not deliver"
+        # recovery: close the breaker → shed chunks readmit + deliver
+        g.breaker("sink").reset()
+        wait_for(lambda: sorted(got) == [0, 1, 2, 3], timeout=6)
+        wait_for(lambda: not ctx.engine._task_map, timeout=4)
+        assert g.shed_count() == 0
+    finally:
+        ctx.stop()
+
+
+# ---------------------------------------------------------------------
+# watchdog health verdict + admin endpoint
+# ---------------------------------------------------------------------
+
+
+def _http_get(port, path):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+              f"Connection: close\r\n\r\n".encode())
+    data = b""
+    while True:
+        b = s.recv(65536)
+        if not b:
+            break
+        data += b
+    s.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), body
+
+
+def test_health_verdicts_ok_degraded_stalled():
+    # flush=10s: housekeeping will not refresh the heartbeat mid-test
+    ctx = flb.create(flush="10s", grace="1", http_server="on",
+                     http_port="0")
+    ctx.input("lib", tag="t")
+    ctx.output("lib", match="t", callback=lambda d, t: None)
+    ctx.start()
+    try:
+        port = wait_for(
+            lambda: ctx.engine.admin_server
+            and ctx.engine.admin_server.bound_port)
+        status, body = _http_get(port, "/api/v1/health")
+        assert (status, body) == (200, b"ok\n")
+        status, body = _http_get(port, "/api/v1/health/guard")
+        assert status == 200
+        obj = json.loads(body)
+        assert obj["status"] == "ok" and obj["breakers"] == {}
+        assert obj["task_map"]["size"] == 2048
+
+        # degraded: a breaker left closed state
+        g = ctx.engine.guard
+        for _ in range(ctx.engine.service.guard_breaker_failures):
+            g.breaker("sick.0").record_failure()
+        status, body = _http_get(port, "/api/v1/health")
+        assert status == 200
+        obj = json.loads(body)
+        assert obj["status"] == "degraded"
+        assert obj["breakers"]["sick.0"] == "open"
+
+        # stalled: heartbeat far older than guard.stall_after
+        g.breaker("sick.0").reset()
+        g.heartbeat = time.time() - 100
+        status, body = _http_get(port, "/api/v1/health")
+        assert status == 503
+        assert json.loads(body)["status"] == "stalled"
+        g.heartbeat = time.time()
+    finally:
+        ctx.stop()
+
+
+def test_deadline_resolution_order():
+    ctx = flb.create(grace="3", **{"guard.flush_timeout": "7s"})
+    out_ffd = ctx.output("lib", callback=lambda d, t: None)
+    out = ctx.engine.outputs[0]
+    out.set("flush_timeout", "2s")
+    out.configure()
+    g = ctx.engine.guard
+    assert g.deadline_for(out) == 2.0          # per-output wins
+    out.flush_timeout = None
+    assert g.deadline_for(out) == 7.0          # service-level next
+    ctx.engine.service.guard_flush_timeout = 0.0
+    assert g.deadline_for(out) == 6.0          # 2 × grace default
+
+
+# ---------------------------------------------------------------------
+# satellite: worker pool startup failure → inline failover
+# ---------------------------------------------------------------------
+
+
+def test_worker_start_timeout_fails_over_to_inline_flush():
+    failpoints.enable("output.worker_start", "delay(1000)")
+    got = []
+    ctx = flb.create(flush="50ms", grace="1",
+                     **{"guard.worker_start_timeout": "0.3s"})
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.output("lib", match="t", alias="w", workers="1",
+               callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        failpoints.reset()
+        out = ctx.engine.outputs[0]
+        assert out.worker_pool is None, \
+            "a pool whose workers never started must not be installed"
+        assert ctx.engine.guard.m_worker_start_fail.get(("w",)) == 1
+        ctx.push(in_ffd, '{"seq": 3}')
+        wait_for(lambda: got)  # delivery fell over to inline flushes
+        bodies = [ev.body for d in got for ev in decode_events(d)]
+        assert {"seq": 3} in bodies
+    finally:
+        ctx.stop()
+
+
+def test_worker_start_injected_death_aborts_fast():
+    from fluentbit_tpu.core.output_thread import OutputWorkerPool
+
+    failpoints.enable("output.worker_start", "return(dead)")
+    t0 = time.time()
+    pool = OutputWorkerPool("dead-test", 1, None, start_timeout=10.0)
+    try:
+        assert pool.failed
+        assert time.time() - t0 < 5, "abort must beat the timeout"
+        with pytest.raises(RuntimeError, match="never started"):
+            async def noop():
+                return 1
+
+            pool.submit(noop())
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------
+# satellite: stuck shutdown dumps thread stacks
+# ---------------------------------------------------------------------
+
+
+def test_stuck_shutdown_warns_and_dumps_stacks(caplog, capfd):
+    import logging
+
+    from fluentbit_tpu.core.engine import Engine
+
+    class _WedgedThread:
+        def join(self, timeout=None):
+            pass  # "times out": returns with the thread still alive
+
+        def is_alive(self):
+            return True
+
+    e = Engine()
+    e._thread = _WedgedThread()
+    with caplog.at_level(logging.WARNING, logger="flb.engine"):
+        e.stop()
+    assert any("shutdown is stuck" in r.message for r in caplog.records)
+    err = capfd.readouterr().err
+    assert "Current thread" in err or "Thread" in err, \
+        "faulthandler stack dump missing from stderr"
+    assert e._thread is None
+
+
+# ---------------------------------------------------------------------
+# io_deadline helper (the await-no-deadline escape hatch)
+# ---------------------------------------------------------------------
+
+
+def test_io_deadline_raises_oserror_compatible_timeout():
+    async def run():
+        with pytest.raises(OSError):
+            await io_deadline(asyncio.sleep(5), 0.01)
+        return await io_deadline(_value(), 1.0)
+
+    async def _value():
+        return 42
+
+    assert asyncio.run(run()) == 42

@@ -59,6 +59,7 @@ def test_list_rules():
                  "batch-decline-after-commit", "batch-commit-replay",
                  "batch-no-fallback", "batch-unordered-emit",
                  "decline-swallow", "dtype-narrowing",
+                 "await-no-deadline",
                  "codec-balance", "codec-bounds", "codec-leak"):
         assert name in proc.stdout
 
@@ -676,6 +677,80 @@ def test_decline_swallow_does_not_double_report_pass_bodies():
     # pass-only bodies stay swallowed-error territory
     got = lint_source(BAD_SWALLOW, "fluentbit_tpu/plugins/out_x.py")
     assert rules(got) == ["swallowed-error"]
+
+
+# ---------------------------------------------------------------------
+# await-no-deadline (flush-path I/O deadlines)
+# ---------------------------------------------------------------------
+
+BAD_NO_DEADLINE = """
+class FooOutput(OutputPlugin):
+    async def _connect(self):
+        self._reader, self._writer = await open_connection(
+            self.instance, self.host, self.port)
+
+    async def flush(self, data, tag, engine):
+        self._writer.write(data)
+        await self._writer.drain()
+        return FlushResult.OK
+"""
+
+GOOD_DEADLINE = """
+class FooOutput(OutputPlugin):
+    async def _connect(self):
+        self._reader, self._writer = await open_connection(
+            self.instance, self.host, self.port, timeout=10)
+
+    async def flush(self, data, tag, engine):
+        self._writer.write(data)
+        await io_deadline(self._writer.drain())
+        line = await asyncio.wait_for(self._reader.readline(), 5.0)
+        return FlushResult.OK
+"""
+
+
+def test_await_no_deadline_fires_on_raw_flush_io():
+    got = lint_source(BAD_NO_DEADLINE, "fluentbit_tpu/plugins/out_x.py")
+    assert rules(got) == ["await-no-deadline"]
+    assert len(got) == 2  # unbounded dial + raw drain
+    assert all(f.severity == "warning" for f in got)
+    assert "task-map slot" in got[1].message
+
+
+def test_await_no_deadline_quiet_when_wrapped():
+    assert lint_source(GOOD_DEADLINE,
+                       "fluentbit_tpu/plugins/out_x.py") == []
+
+
+def test_await_no_deadline_scope_and_suppression():
+    # off the data path → quiet
+    assert lint_source(BAD_NO_DEADLINE,
+                       "fluentbit_tpu/luart/interp.py") == []
+    # a non-output class's reader loop → out of scope (functions NAMED
+    # flush/_flush* stay in scope wherever they live)
+    reader = BAD_NO_DEADLINE.replace(
+        "class FooOutput(OutputPlugin):", "class FooReader:").replace(
+        "async def flush(self, data, tag, engine):",
+        "async def serve(self, data, tag, engine):")
+    assert lint_source(reader, "fluentbit_tpu/plugins/in_x.py") == []
+    # a justified unbounded await (long-poll reader) → suppressible
+    src = BAD_NO_DEADLINE.replace(
+        "        await self._writer.drain()",
+        "        # server-push loop: unbounded by design\n"
+        "        await self._writer.drain()"
+        "  # fbtpu-lint: allow(await-no-deadline)")
+    got = lint_source(src, "fluentbit_tpu/plugins/out_x.py")
+    assert [f.rule for f in got] == ["await-no-deadline"]  # dial only
+
+
+def test_await_no_deadline_module_level_flush_helpers():
+    src = """
+async def _flush_stream(writer, data):
+    writer.write(data)
+    await writer.drain()
+"""
+    got = lint_source(src, "fluentbit_tpu/plugins/out_y.py")
+    assert rules(got) == ["await-no-deadline"]
 
 
 # ---------------------------------------------------------------------

@@ -40,6 +40,33 @@ def test_ha_failover_and_recovery():
     assert ha.pick() is not None
 
 
+def test_ha_flapping_nodes_readmitted_with_weights():
+    """Nodes flapping unhealthy→healthy: smooth weighted round-robin
+    must re-admit recovered nodes with their weights intact, whether
+    recovery is explicit (mark_up) or cooldown-driven."""
+    a = UpstreamNode("a", "h1", 1, weight=3)
+    b = UpstreamNode("b", "h2", 2, weight=1)
+    ha = UpstreamHA("up", [a, b], retry_window=0.05)
+    for _cycle in range(3):
+        ha.mark_down(a)
+        assert {ha.pick().name for _ in range(4)} == {"b"}
+        time.sleep(0.08)  # cooldown lapses: a is probe-ready again
+        picks = [ha.pick().name for _ in range(8)]
+        assert picks.count("a") >= 5, picks  # weight 3:1 re-applies
+        assert picks.count("b") >= 1, picks
+        ha.mark_up(a)  # explicit recovery closes the node's breaker
+        assert a.breaker.state_name() == "closed"
+    # a node that keeps failing past its cooldown stays excluded: the
+    # re-failure re-arms the window (no lapsed-timer re-admission)
+    ha.mark_down(b)
+    time.sleep(0.08)
+    ha.mark_down(b)  # probe failed again
+    assert {ha.pick().name for _ in range(4)} == {"a"}
+    # every node down: picks still proceed (caller surfaces the error)
+    ha.mark_down(a)
+    assert ha.pick() is not None
+
+
 def test_parse_upstream_file(tmp_path):
     p = tmp_path / "up.conf"
     p.write_text(
