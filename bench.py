@@ -345,6 +345,108 @@ def measure_secondary(seconds: float = 1.5) -> dict:
     return out
 
 
+def measure_flux(seconds: float = 1.5) -> dict:
+    """fbtpu-flux stage (FLUX.md): sketch-update ingest rate through
+    the batched flux filter — the single-sketch shape is the
+    ≥ log_to_metrics comparison point (PERF.md ~12M lines/s) — plus the
+    per-tenant windowed shape, the query-snapshot read p50 (what a SQL
+    window tick costs), and the simulated-mesh sharded update rate."""
+    import random
+
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.core.engine import Engine
+
+    out = {}
+    rng = random.Random(11)
+    n = CHUNK_RECORDS
+    buf = bytearray()
+    tenants = ["acme", "globex", "initech", "umbrella"]
+    for i in range(n):
+        buf += encode_event(
+            {"tenant": rng.choice(tenants),
+             "user": "u%06d" % rng.randrange(1_000_000),
+             "size": rng.randrange(4096)}, float(i))
+    buf = bytes(buf)
+
+    def build(props):
+        e = Engine()
+        f = e.filter("flux")
+        for k, v in props.items():
+            f.set(k, v)
+        ins = e.input("dummy")
+        for x in e.inputs + e.filters:
+            x.configure()
+            x.plugin.init(x, e)
+        return e, ins, e.filters[0].plugin
+
+    def rate(e, ins):
+        e.input_log_append(ins, "b", buf)  # warm
+        ins.pool.drain()
+        t0 = time.perf_counter()
+        lines = 0
+        while time.perf_counter() - t0 < seconds:
+            e.input_log_append(ins, "b", buf)
+            ins.pool.drain()
+            lines += n
+        return round(lines / (time.perf_counter() - t0))
+
+    # max_field_len is an exactness parameter (values past it leave
+    # the sketch); 64 covers this corpus's ids with margin and keeps
+    # the staging matrix cache-resident — the same per-stage tuning
+    # the grep stage applies to its own staging width
+    e1, ins1, _ = build({"distinct_field": "user",
+                         "max_field_len": "64",
+                         "export_interval_sec": "3600"})
+    out["flux_single_sketch_lines_per_sec"] = rate(e1, ins1)
+
+    e2, ins2, plug2 = build({
+        "group_by": "tenant", "distinct_field": "user",
+        "aggregate_field": "size", "topk_field": "user",
+        "window": "tumbling 60", "max_field_len": "64",
+        "export_interval_sec": "3600",
+    })
+    out["flux_per_tenant_lines_per_sec"] = rate(e2, ins2)
+
+    # query-snapshot read: what one SQL window tick / metrics export
+    # costs against the live per-tenant state
+    times = []
+    for _ in range(40):
+        t1 = time.perf_counter()
+        for key, g in plug2.state.live_groups():
+            for h in g.hlls.values():
+                h.estimate()
+            plug2.state.topk(key)
+        times.append(time.perf_counter() - t1)
+    out["flux_query_snapshot_p50_ms"] = round(
+        sorted(times)[len(times) // 2] * 1e3, 3)
+
+    # simulated-mesh lane: sharded HLL update (psum/pmax tree) over the
+    # virtual device mesh — the cross-chip merge exercised in tier-1
+    try:
+        from fluentbit_tpu.flux import kernels as fk
+        from fluentbit_tpu.ops.batch import assemble
+        from fluentbit_tpu.ops.sketch import HyperLogLog, sharded_hll_update
+
+        mesh = fk.flux_mesh()
+        out["flux_mesh_devices"] = mesh.devices.size if mesh else 1
+        if mesh is not None:
+            vals = [("u%06d" % rng.randrange(1_000_000)).encode()
+                    for _ in range(n)]
+            b = assemble(vals, 64, n)
+            hll = HyperLogLog(p=12)
+            sharded_hll_update(hll, mesh, b.batch, b.lengths)  # compile
+            t0 = time.perf_counter()
+            reps = 0
+            while time.perf_counter() - t0 < 1.0:
+                sharded_hll_update(hll, mesh, b.batch, b.lengths)
+                reps += 1
+            out["flux_mesh_update_lines_per_sec"] = round(
+                reps * n / (time.perf_counter() - t0))
+    except Exception as ex:
+        out["flux_mesh_error"] = repr(ex)
+    return out
+
+
 def check_bit_exact(raw_chunks) -> bool:
     """Device/native raw path vs the pure-Python verdict chain."""
     ok = True
@@ -541,6 +643,14 @@ def child_main(mode: str) -> None:
     _progress(stage=f"{mode}:import")
     if mode == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # first-class simulated-mesh lane: the flux stage measures the
+        # cross-chip (psum/pmax) merge on 8 virtual CPU devices, same
+        # as tier-1 (tests/conftest.py)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         try:
             import jax
 
@@ -573,18 +683,46 @@ def child_main(mode: str) -> None:
     if mode == "cpu":
         ok = device.wait(30.0)
     else:
-        # attempt the attach for the FULL deadline regardless of the
-        # terminal probe (round-4 lesson: giving up at 180 s of
-        # connection-refused meant the 300/600/900 s stack dumps never
-        # fired, so no round ever captured where a real attach blocks).
-        # The watchdog thread keeps heartbeating probe state + stacks;
-        # 90 s of margin lets the post-attach measurements land before
-        # the parent's deadline kill.
-        wait_until = time.time() + max(deadline - 90.0, 60.0)
+        # FAIL FAST when the attach provably cannot succeed: rounds 3-5
+        # each burned ~1400 s of heartbeats against a refused terminal
+        # (BENCH_r05) and learned nothing new after the first probe.
+        # With the terminal refused/unreachable the PJRT plugin's
+        # backoff loop never returns, so wait one short window (long
+        # enough to catch a terminal that starts late), capture ONE
+        # stack dump as the block-point record, and report the probe +
+        # platform discovery as the diagnosable reason. A probe that
+        # says the terminal is LISTENING still gets the full deadline
+        # (the round-4 lesson about premature give-up only applies
+        # when an attach is actually possible). BENCH_DEVICE_WAIT_FULL=1
+        # restores the old always-full-deadline behavior.
+        fail_fast = (
+            terminal is not None
+            and not terminal.startswith("open")
+            and not os.environ.get("BENCH_DEVICE_WAIT_FULL")
+        )
+        if fail_fast:
+            wait_until = time.time() + float(
+                os.environ.get("BENCH_DEVICE_FAILFAST_S", "60"))
+        else:
+            # 90 s of margin lets the post-attach measurements land
+            # before the parent's deadline kill
+            wait_until = time.time() + max(deadline - 90.0, 60.0)
         while True:
             ok = device.wait(30.0)
             if ok or device.failed() or time.time() >= wait_until:
                 break
+        if not ok and fail_fast:
+            import faulthandler
+            import tempfile
+
+            try:
+                with tempfile.TemporaryFile(mode="w+") as f:
+                    faulthandler.dump_traceback(file=f)
+                    f.seek(0)
+                    _progress(stage="device:failfast_stacks",
+                              stacks=f.read()[-3000:])
+            except Exception as e:
+                _progress(stage="device:failfast_stacks", error=repr(e))
     st = device.status()
     _progress(stage=f"{mode}:attached", ok=ok, **st)
     result = {
@@ -598,6 +736,15 @@ def child_main(mode: str) -> None:
         result["terminal_8083"] = terminal
         if not ok:
             result["attach_diagnosis"] = _attach_diagnosis(terminal)
+            # the diagnosable record the fail-fast path promises: the
+            # captured exception (or still-blocked attach state) plus
+            # the PJRT platform discovery, IN the result json — not
+            # just the progress stream
+            result["attach_state"] = st.get("state")
+            # the SAME predicate that chose the wait window above —
+            # the report must never drift from the behavior
+            result["attach_fail_fast"] = fail_fast
+            result["platform_report"] = _pjrt_discovery()
 
     def run_kernel_only():
         _progress(stage=f"{mode}:kernel_only")
@@ -642,6 +789,11 @@ def child_main(mode: str) -> None:
             result["secondary"] = measure_secondary()
         except Exception as e:
             result["secondary"] = {"error": repr(e)}
+        _progress(stage="cpu:flux")
+        try:
+            result["flux"] = measure_flux()
+        except Exception as e:
+            result["flux"] = {"error": repr(e)}
     if ok and mode == "cpu":
         run_kernel_only()
     from fluentbit_tpu import native
@@ -796,6 +948,7 @@ def final_line(cpu, dev, dev_err, extras):
         "multi_input": (best or {}).get("multi_input"),
         "native_staging": bool((best or {}).get("native_staging", False)),
         "secondary": (cpu or {}).get("secondary"),
+        "flux": (cpu or {}).get("flux"),
         "host_cpus": os.cpu_count(),
         "chunk_records": CHUNK_RECORDS,
         "wall_seconds": round(time.time() - _T0, 1),
@@ -842,7 +995,9 @@ def main():
         if dev_err and "deadline" in dev_err:
             extras["device_init_timeout_s"] = dev_deadline
         if dev is not None:
-            for k in ("terminal_8083", "attach_diagnosis", "attach_error"):
+            for k in ("terminal_8083", "attach_diagnosis", "attach_error",
+                      "attach_state", "attach_fail_fast",
+                      "platform_report"):
                 if dev.get(k):
                     extras[k] = dev[k]
         if dev is not None and dev.get("platform") == "cpu":

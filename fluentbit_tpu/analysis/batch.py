@@ -14,8 +14,12 @@ forward dataflow over every ``process_batch`` implementation and the
 - ``batch-decline-after-commit``: an explicit decline site (``return
   None`` / bare ``return`` / ``raise FallbackError``) reachable after a
   committed side effect (metric ``inc``/``observe``, emitter
-  ``add_record``/``add_event``). The decoded-tail rerun replays the
-  commit — counters double-count, emits duplicate.
+  ``add_record``/``add_event``, flux-state
+  ``absorb_batch``/``absorb_events`` — the fbtpu-flux surface: an
+  absorbed batch is observable in every later window emission, so a
+  rerun absorbs the same records twice). The decoded-tail rerun
+  replays the commit — counters double-count, emits duplicate,
+  windows double-aggregate.
 - ``batch-commit-replay``: an emitter append (``add_record``/
   ``add_event``) after an earlier commit with no enclosing
   ``try``/``except``. The call raising IS an implicit decline, with the
@@ -57,6 +61,12 @@ __all__ = ["BatchExactnessRules"]
 _METRIC_COMMITS = {"inc", "observe"}
 #: emitter-append terminals: records re-entering the pipeline
 _EMIT_COMMITS = {"add_record", "add_event"}
+#: flux-state commit terminals (fbtpu-flux): absorbing a batch into
+#: per-tenant sketch/window state is observable in every later window
+#: emission and metric export — a decline after it makes the decoded
+#: rerun absorb the same records twice (double-counted windows,
+#: inflated sketches). Same contract as the metric commits, new surface.
+_FLUX_COMMITS = {"absorb_batch", "absorb_events"}
 #: unordered-iterable constructor terminals (np.unique SORTS, which is
 #: just as order-destroying as a set walk)
 _UNORDERED = {"set", "frozenset", "unique"}
@@ -224,7 +234,10 @@ class _ClassScan:
                                f"degrade like backpressure")
                 state.committed = True
                 self.any_commit = True
-            elif t in _METRIC_COMMITS:
+            elif t in _METRIC_COMMITS or t in _FLUX_COMMITS:
+                # flux absorbs are idempotent-or-guarded by the same
+                # rule metric incs are: committed state the decoded
+                # rerun would replay
                 state.committed = True
                 self.any_commit = True
             elif t == "set" and isinstance(call.func, ast.Attribute) \

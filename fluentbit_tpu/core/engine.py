@@ -198,7 +198,16 @@ class Engine:
         self._number_instance(ins, self.filters)
         for k, v in props.items():
             ins.set(k, v)
-        self.filters.append(ins)
+        # hidden flux-SQL filters stand in for the stream processor,
+        # which runs POST-filter at ingest — user filters registered
+        # later (config files apply [STREAM_TASK] before [FILTER])
+        # must still run BEFORE them or flux would aggregate records
+        # the chain was about to drop/rewrite
+        pos = len(self.filters)
+        while pos > 0 and getattr(self.filters[pos - 1],
+                                  "_flux_sql_hidden", False):
+            pos -= 1
+        self.filters.insert(pos, ins)
         return ins
 
     def output(self, name: str, **props) -> OutputInstance:
@@ -244,16 +253,37 @@ class Engine:
         self.ml_parsers[name] = p
         return p
 
-    def sp_task(self, sql: str):
+    def sp_task(self, sql: str, allow_flux: bool = True):
         """Register a stream-processor query (flb_sp_create task;
         [STREAM_TASK] Exec). The SP runs synchronously post-filter at
         ingest (src/flb_input_chunk.c:3155) and its window timer rides a
-        collector on the SP emitter."""
+        collector on the SP emitter.
+
+        Sketch-eligible queries transparently resolve against the flux
+        plane (fbtpu-flux): a hidden ``flux`` filter maintains the
+        aggregation state inside the (batched) filter pass, the task
+        reads windows from it, and the raw ingest fast path stays on
+        for the query's tag. ``allow_flux=False`` pins the exact
+        per-event evaluation (the differential harness's twin), as does
+        ``WITH (flux='off')`` per query or FBTPU_FLUX_SQL=off globally.
+        """
+        import os as _os
+
         from ..stream_processor import StreamProcessor
 
         if self.sp is None:
             self.sp = StreamProcessor(self)
         task = self.sp.create_task(sql)
+        if allow_flux and _os.environ.get(
+                "FBTPU_FLUX_SQL", "on").lower() not in ("0", "off"):
+            from ..flux.query import attach_flux
+
+            try:
+                attach_flux(self, task)
+            except Exception:
+                log.exception(
+                    "flux attach failed; query %r stays on the exact "
+                    "evaluation path", sql)
         # window timer: piggyback a collector on the SP emitter input
         if self.sp._emitter is None:
             ins = self.hidden_input(
@@ -711,11 +741,15 @@ class Engine:
         # independent tags; reference threaded inputs + per-input chunk
         # maps, src/flb_input_thread.c:225).
         matching = [f for f in self.filters if f.route.matches(tag)]
+        # flux-backed tasks don't need decoded events — their hidden
+        # flux filter (in `matching`) absorbs on the raw chain, so they
+        # must not force the decode path (that is the whole point)
         sp_active = (
             self.sp is not None
             and self.sp.tasks
             and ins is not self.sp.emitter_instance
-            and any(t.matches(tag) for t in self.sp.tasks)
+            and any(t.matches(tag) and t.flux is None
+                    for t in self.sp.tasks)
         )
         cond_routing = any(
             o.route_condition is not None and o.route.matches(tag)

@@ -56,6 +56,20 @@ class _Metric:
         with self._lock:
             self._values.clear()
 
+    def remove_matching(self, label_key: str, value: str) -> None:
+        """Drop only the labeled series where ``label_key`` equals
+        ``value`` — the wholesale-refresh primitive for metric families
+        SHARED by several publishers (the flux exporters all write
+        ``fluentbit_flux_*`` in the engine registry; one instance's
+        stale-series refresh must not clobber its siblings')."""
+        with self._lock:
+            try:
+                i = self.label_keys.index(label_key)
+            except ValueError:
+                return
+            for k in [k for k in self._values if k[i] == value]:
+                del self._values[k]
+
 
 class Counter(_Metric):
     kind = "counter"

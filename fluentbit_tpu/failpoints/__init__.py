@@ -95,6 +95,8 @@ SITES: Tuple[str, ...] = (
     "output.worker_start",       # OutputWorkerPool._worker, before the ready barrier
     "codec.fallback",            # filter_parser batched JSON path: forced decline
     "device.attach",             # ops.device._attach_worker, before backend init
+    "flux.snapshot",             # FluxState.persist, tmp written+fsynced, before
+                                 # the atomic rename (crash → old file intact)
     "s3.upload_part",            # outputs_aws._mp_upload_part (RETRY repro site)
     "s3.complete",               # outputs_aws._mp_complete
 )

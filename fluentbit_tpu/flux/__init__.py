@@ -1,0 +1,31 @@
+"""fbtpu-flux — the device-resident streaming analytics plane.
+
+Unifies the streaming and analytical planes per FluxSieve (PAPERS.md,
+2603.04937): per-tenant observability — unique users (HLL), hot keys
+(count-min top-k), windowed error rates (count/sum/min/max/avg) — is
+computed INSIDE the filter pass at ingest rate, on device-resident
+state merged across chips with psum/pmax trees, instead of in a
+downstream warehouse.
+
+Layout:
+
+- ``state``    — :class:`FluxState`: per-group sketches + window panes,
+  snapshot/restore, the batched/per-record bit-identical absorb core;
+- ``kernels``  — segment scatter-add count kernel + the mesh
+  (``shard_map``/psum) lane, host twins bit-identical;
+- ``plugin``   — ``filter_flux``: the stateful ``process_batch`` hook
+  riding the native column stagers;
+- ``query``    — sketch-eligibility + :class:`FluxBinding` for
+  stream-processor SQL (``COUNT(DISTINCT ...)`` et al.);
+- ``exporter`` — ``fluentbit_flux_*`` metrics families.
+
+See FLUX.md for architecture, the exactness model, SQL eligibility
+rules, and error bounds of the approximate path.
+"""
+
+from .state import FluxSpec, FluxState, WindowSpec  # noqa: F401
+from .exporter import FluxExporter  # noqa: F401
+from .query import FluxBinding, attach_flux, eligible  # noqa: F401
+
+__all__ = ["FluxSpec", "FluxState", "WindowSpec", "FluxExporter",
+           "FluxBinding", "attach_flux", "eligible"]

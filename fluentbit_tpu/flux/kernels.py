@@ -1,0 +1,154 @@
+"""Device kernels for the flux plane — segment reductions + mesh merge.
+
+The window-aggregate counterpart of ``ops/sketch.py``: per-batch group
+counts run as a scatter-add kernel over the segment-id column, and the
+multi-chip merge is ``lax.psum`` over the mesh axis (integer counter sum
+IS the union, the same exactness argument as the count-min merge).
+Counts are integers end to end, so the device/mesh result is
+bit-identical to the host ``np.bincount`` twin — which is what lets the
+simulated-mesh lane assert equality in tier-1 on every PR.
+
+Float sums/mins/maxs deliberately do NOT run here: the exact Python
+evaluation path accumulates IEEE doubles in record order, and the CPU
+jax backend is float32 without ``jax_enable_x64`` — flux keeps those
+host-side (``flux/state.py``) so sketch-eligible SQL stays bit-exact.
+See FLUX.md "exactness model".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+
+    HAVE_JAX = True
+except Exception:  # pragma: no cover - jax absent: host twins only
+    HAVE_JAX = False
+
+__all__ = ["flux_mesh", "segment_counts", "sharded_segment_counts",
+           "host_segment_counts"]
+
+#: compiled-kernel caches, keyed by padded segment count (and mesh
+#: structure for the sharded variant) — a fresh jit per call would
+#: recompile every batch
+_jit_cache: dict = {}
+_shard_cache: dict = {}
+
+
+def _pad_segments(n_seg: int) -> int:
+    """Round the segment-table size to a power of two so jit sees a
+    small set of stable shapes (same motivation as ops.batch.bucket_size).
+    Host-only: n_seg is always a Python int computed BEFORE tracing (it
+    becomes the jit-static output shape), never a tracer."""
+    n = 8
+    while n < n_seg:  # fbtpu-lint: allow(jax-retrace) host-side shape prep
+        n *= 2
+    return n
+
+
+def flux_mesh(n_devices: Optional[int] = None):
+    """A 1-D mesh over the available devices (axis ``flux``).  Under the
+    simulated-mesh lane (``XLA_FLAGS=--xla_force_host_platform_device_count=8``,
+    the tier-1 default — tests/conftest.py) this is 8 virtual CPU
+    devices; on real hardware it is the attached chips.  Returns None
+    when jax is unavailable or only one device exists (the mesh path
+    would be pure overhead)."""
+    if not HAVE_JAX:
+        return None
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(devs), ("flux",))
+
+
+def host_segment_counts(seg: np.ndarray, valid: np.ndarray,
+                        n_seg: int) -> np.ndarray:
+    """Host twin: rows-per-segment over valid rows (int64 → int32-safe
+    counts; a chunk has < 2^31 rows by construction)."""
+    if n_seg <= 0:
+        return np.zeros((0,), dtype=np.int32)
+    return np.bincount(
+        seg[valid.astype(bool)], minlength=n_seg
+    ).astype(np.int32)[:n_seg]
+
+
+def _counts_impl(seg, valid, n_pad):
+    out = jnp.zeros((n_pad,), dtype=jnp.int32)
+    return out.at[seg].add(valid.astype(jnp.int32))
+
+
+def segment_counts(seg: np.ndarray, valid: np.ndarray,
+                   n_seg: int) -> np.ndarray:
+    """Device scatter-add group counts — bit-identical to
+    :func:`host_segment_counts` (integers)."""
+    if not HAVE_JAX:
+        return host_segment_counts(seg, valid, n_seg)
+    n_pad = _pad_segments(n_seg)
+    fn = _jit_cache.get(n_pad)
+    if fn is None:
+        fn = _jit_cache[n_pad] = jax.jit(
+            lambda s, v: _counts_impl(s, v, n_pad)
+        )
+    got = np.asarray(fn(jnp.asarray(seg.astype(np.int32)),
+                        jnp.asarray(valid.astype(np.int32))))
+    return got[:n_seg]
+
+
+def _mesh_key(mesh) -> tuple:
+    # structural key, not id(): equal meshes share a compiled step
+    # (same rationale as ops.sketch._mesh_key)
+    return (tuple(mesh.axis_names),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def sharded_segment_counts(mesh, seg: np.ndarray, valid: np.ndarray,
+                           n_seg: int) -> np.ndarray:
+    """Group counts over a mesh: the batch axis is sharded across
+    devices, each device scatter-adds its shard locally, and the merge
+    is ``lax.psum`` over the mesh axis — the psum-style tree reduction
+    of the flux contract.  Bit-identical to the host twin (integer
+    counters)."""
+    if not HAVE_JAX or mesh is None:
+        return host_segment_counts(seg, valid, n_seg)
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.device import shard_map_fn
+
+    shard_map = shard_map_fn()
+
+    n_dev = mesh.devices.size
+    B = seg.shape[0]
+    Bp = ((B + n_dev - 1) // n_dev) * n_dev
+    seg32 = seg.astype(np.int32)
+    valid32 = valid.astype(np.int32)
+    if Bp != B:  # pad rows are invalid → contribute zero everywhere
+        seg32 = np.concatenate(
+            [seg32, np.zeros((Bp - B,), dtype=np.int32)])
+        valid32 = np.concatenate(
+            [valid32, np.zeros((Bp - B,), dtype=np.int32)])
+    n_pad = _pad_segments(n_seg)
+    key = (_mesh_key(mesh), n_pad)
+    fn = _shard_cache.get(key)
+    if fn is None:
+        axis = mesh.axis_names[0]
+
+        def step(s, v):
+            local = _counts_impl(s, v, n_pad)
+            return lax.psum(local, axis_name=axis)
+
+        fn = _shard_cache[key] = jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(),
+        ))
+    got = np.asarray(fn(jnp.asarray(seg32), jnp.asarray(valid32)))
+    return got[:n_seg]

@@ -1,0 +1,965 @@
+"""Device-resident per-tenant flux state — sketches + window aggregates.
+
+One :class:`FluxState` is the analytics state behind one flux consumer
+(a configured ``filter_flux`` instance, or one sketch-eligible
+stream-processor query).  Per group key (the tenant/tag labels) it
+maintains:
+
+- **HLL cardinality** per distinct-column (``ops.sketch.HyperLogLog``,
+  registers device-resident once the backend attaches; cross-chip merge
+  is ``lax.pmax`` via ``sharded_hll_update``),
+- **count-min top-k** over a state-wide CMS keyed by composite
+  ``group␟value`` bytes with a bounded per-group candidate set
+  (``sharded_cms_update`` psum merge on a mesh),
+- **window aggregates** — count/sum/min/max/avg per numeric column over
+  tumbling or hopping windows.  Counts run through the segment
+  scatter-add kernel (psum-merged on the mesh lane, integer-exact);
+  float sums/mins/maxs accumulate host-side in IEEE doubles.
+
+Exactness model (the differential-test contract, FLUX.md):
+
+- the batched absorb (:meth:`absorb_batch`, fed by the native column
+  stagers) and the per-record twin (:meth:`absorb_events`) are
+  **bit-identical** — same grouping, same float addition ORDER (the
+  running sum is threaded through ``np.bincount``'s sequential
+  accumulation, continuing from the pane's stored sum exactly like the
+  Python evaluation path's ``sums[n] += v``), same min/max
+  representative selection (first row attaining the extremum);
+- count/sum/min/max/avg therefore reproduce
+  ``stream_processor._Agg`` bit-for-bit for map-bodied records;
+- ``COUNT(DISTINCT k)`` is approximate with the standard HLL error
+  (σ ≈ 1.04/√(2^p)); top-k estimates carry the count-min
+  over-estimation bound (ε ≈ e/width with prob 1-δ, δ = e^-depth).
+
+Windowing matches ``stream_processor.SPTask.tick`` in processing-time
+mode (whole-period boundary advance, hopping pane ring of
+``round(size/advance)`` panes, drain-on-shutdown).  Event-time tumbling
+mode (per-record path only) assigns records to ``floor(ts/size)``
+windows, closes on watermark advance, and counts late records instead
+of corrupting closed panes.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import failpoints as _fp
+from ..ops.batch import assemble, bucket_size
+from ..ops.sketch import (
+    CountMin,
+    HyperLogLog,
+    sharded_cms_update,
+    sharded_hll_update,
+)
+from . import kernels
+
+__all__ = ["WindowSpec", "FluxSpec", "FluxState", "SNAPSHOT_VERSION"]
+
+SNAPSHOT_VERSION = 1
+
+#: composite separator for top-k keys: group fields join with \x1e,
+#: group|value with \x1f (both outside normal label alphabets)
+_FIELD_SEP = b"\x1e"
+_VALUE_SEP = b"\x1f"
+
+#: cap on distinct group keys tracked for top-k candidates (LRU-ish;
+#: the CMS itself is fixed-size — only the nomination sets need a bound)
+_MAX_CANDIDATE_GROUPS = 4096
+
+
+class WindowSpec:
+    """Window shape: ``None`` kind = unwindowed running state."""
+
+    __slots__ = ("kind", "size", "advance")
+
+    def __init__(self, kind: Optional[str] = None, size: float = 0.0,
+                 advance: Optional[float] = None):
+        if kind not in (None, "tumbling", "hopping"):
+            raise ValueError(f"unknown window kind {kind!r}")
+        if kind is not None and size <= 0:
+            raise ValueError("window size must be positive")
+        self.kind = kind
+        self.size = float(size)
+        self.advance = float(advance) if advance else self.size
+
+    @classmethod
+    def parse(cls, text: Optional[str]) -> "WindowSpec":
+        """``"tumbling 60"`` | ``"hopping 60 10"`` | ``"none"``/empty."""
+        if not text or str(text).strip().lower() in ("none", "off"):
+            return cls(None)
+        parts = str(text).split()
+        kind = parts[0].lower()
+        size = float(parts[1]) if len(parts) > 1 else 0.0
+        advance = float(parts[2]) if len(parts) > 2 else None
+        return cls(kind, size, advance)
+
+    @property
+    def n_panes(self) -> int:
+        if self.kind != "hopping":
+            return 1
+        return max(1, int(round(self.size / self.advance)))
+
+
+class FluxSpec:
+    """Immutable shape of one flux state."""
+
+    __slots__ = ("name", "group_by", "distinct", "numeric", "topk_field",
+                 "topk", "window", "hll_p", "cms_depth", "cms_width",
+                 "max_len", "event_time", "mesh")
+
+    def __init__(self, name: str,
+                 group_by: Sequence[str] = (),
+                 distinct: Sequence[str] = (),
+                 numeric: Sequence[str] = (),
+                 topk_field: Optional[str] = None,
+                 topk: int = 10,
+                 window: Optional[WindowSpec] = None,
+                 hll_p: int = 12,
+                 cms_depth: int = 4,
+                 cms_width: int = 16384,
+                 max_len: int = 256,
+                 event_time: bool = False,
+                 mesh: bool = False):
+        self.name = name
+        self.group_by = tuple(group_by)
+        self.distinct = tuple(distinct)
+        self.numeric = tuple(numeric)
+        self.topk_field = topk_field
+        self.topk = int(topk)
+        self.window = window or WindowSpec(None)
+        self.hll_p = int(hll_p)
+        self.cms_depth = int(cms_depth)
+        self.cms_width = int(cms_width)
+        self.max_len = int(max_len)
+        self.event_time = bool(event_time)
+        self.mesh = bool(mesh)
+        if self.event_time and self.window.kind != "tumbling":
+            # fail at CONFIG time: event-time assignment divides by the
+            # window size, so a missing/hopping window must not surface
+            # as a per-append crash later
+            raise ValueError(
+                "event-time windows require a tumbling window "
+                "(hopping panes are processing-time; see FLUX.md)")
+
+    def shape(self) -> dict:
+        """Structural identity for snapshot compatibility checks.
+        MUST include the sketch geometry: restoring p=12 registers into
+        a p=14 state would hand the C HLL kernel a 4× undersized buffer
+        (out-of-bounds write), and a changed CMS width silently hashes
+        into the wrong columns. max_len is an exactness parameter too
+        (it decides which values leave the sketch)."""
+        return {
+            "group_by": self.group_by,
+            "distinct": self.distinct,
+            "numeric": self.numeric,
+            "topk_field": self.topk_field,
+            "event_time": self.event_time,
+            "window": (self.window.kind, self.window.size,
+                       self.window.advance),
+            "hll_p": self.hll_p,
+            "cms_depth": self.cms_depth,
+            "cms_width": self.cms_width,
+            "max_len": self.max_len,
+        }
+
+    @property
+    def string_fields(self) -> Tuple[str, ...]:
+        """Columns staged as string bytes, in staging order."""
+        out: List[str] = list(self.group_by)
+        for f in self.distinct:
+            if f not in out:
+                out.append(f)
+        if self.topk_field and self.topk_field not in out:
+            out.append(self.topk_field)
+        return tuple(out)
+
+
+class _ColStat:
+    """Per-(group, numeric column) running aggregate — the flux twin of
+    one column's slice of ``stream_processor._Agg``."""
+
+    __slots__ = ("has", "sum", "min", "max", "min_int", "max_int")
+
+    def __init__(self):
+        self.has = False
+        self.sum = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        # representative int-ness: the exact path returns the ORIGINAL
+        # min/max value (int stays int); kind 1 rows reconstruct as int
+        self.min_int = False
+        self.max_int = False
+
+    def merge(self, other: "_ColStat") -> None:
+        if not other.has:
+            return
+        if not self.has:
+            self.has = True
+            # 0.0 + s: same float sequence as _Agg.merge's
+            # ``sums.get(n, 0.0) + v``
+            self.sum = 0.0 + other.sum
+            self.min, self.min_int = other.min, other.min_int
+            self.max, self.max_int = other.max, other.max_int
+            return
+        self.sum = self.sum + other.sum
+        if other.min < self.min:
+            self.min, self.min_int = other.min, other.min_int
+        if other.max > self.max:
+            self.max, self.max_int = other.max, other.max_int
+
+    def min_value(self):
+        if not self.has:
+            return None
+        return int(self.min) if self.min_int else self.min
+
+    def max_value(self):
+        if not self.has:
+            return None
+        return int(self.max) if self.max_int else self.max
+
+
+class _FluxGroup:
+    """One group key's accumulators inside one window pane."""
+
+    __slots__ = ("count", "cols", "hlls")
+
+    def __init__(self, spec: FluxSpec):
+        self.count = 0
+        self.cols: Dict[str, _ColStat] = {f: _ColStat()
+                                          for f in spec.numeric}
+        self.hlls: Dict[str, HyperLogLog] = {
+            f: HyperLogLog(p=spec.hll_p) for f in spec.distinct
+        }
+
+    def merge(self, other: "_FluxGroup") -> None:
+        self.count += other.count
+        for f, st in other.cols.items():
+            self.cols[f].merge(st)
+        for f, h in other.hlls.items():
+            self.hlls[f].merge_registers(
+                h.registers if isinstance(h.registers, np.ndarray)
+                else np.asarray(h.registers))
+
+
+def _seq_sum(start: float, values: np.ndarray) -> float:
+    """``((start + v0) + v1) + ...`` with C-double sequential adds —
+    np.bincount accumulates its weights in input order, which is
+    exactly the Python evaluation path's running ``+=``."""
+    w = np.concatenate([np.asarray([start], dtype=np.float64),
+                        values.astype(np.float64, copy=False)])
+    return float(np.bincount(np.zeros(w.size, dtype=np.intp),
+                             weights=w, minlength=1)[0])
+
+
+class FluxState:
+    """Mutable analytics state (see module docstring).  All mutation
+    happens under the engine's ingest lock — the flux filter is not
+    ``thread_safe_raw`` and the SP window tick runs under the same
+    lock, so no locking lives here."""
+
+    def __init__(self, spec: FluxSpec, now=None):
+        self.spec = spec
+        self._now = now or time.time
+        self._mesh = kernels.flux_mesh() if spec.mesh else None
+        # processing-time pane machinery (SPTask twin)
+        self._groups: Dict[tuple, _FluxGroup] = {}
+        self._panes: List[Dict[tuple, _FluxGroup]] = []
+        self._window_start = self._now()
+        # event-time machinery (tumbling only, per-record path)
+        self._event_windows: Dict[int, Dict[tuple, _FluxGroup]] = {}
+        self._watermark: Optional[float] = None
+        self._pending_closed: List[Tuple[float,
+                                         List[Tuple[tuple, _FluxGroup]]]] = []
+        # state-lifetime top-k: one CMS + bounded per-group candidates
+        self.cms: Optional[CountMin] = None
+        self._candidates: Dict[tuple, Dict[bytes, None]] = {}
+        if spec.topk_field:
+            self.cms = CountMin(depth=spec.cms_depth,
+                                width=spec.cms_width)
+        # counters (exported as fluentbit_flux_*)
+        self.records_total = 0
+        self.late_records_total = 0
+        self.window_emits_total = 0
+        self.batches_total = 0
+
+    # ------------------------------------------------------------ absorb
+
+    def absorb_batch(self, n: int,
+                     strcols: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                     numcols: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                     ) -> int:
+        """Absorb one staged chunk (processing-time mode).
+
+        strcols  : field → (batch u8 [n, L], lengths i32 [n]); lengths
+                   < 0 = missing/non-string/oversize
+        numcols  : field → (values f64 [n], kinds u8 [n]); kind 0 =
+                   missing/non-numeric, 1 = integer, 2 = float
+
+        EVERY record counts — the codec coerces non-map bodies to empty
+        dicts at decode (codec.events._to_event), so the Python
+        evaluation path counts them with all columns missing, and the
+        batched path must do exactly the same (the native stagers
+        return missing for non-map rows already).
+        """
+        if self.spec.event_time:
+            raise RuntimeError("event-time state has no batched path")
+        self.batches_total += 1
+        if n <= 0:
+            return 0
+        spec = self.spec
+        if not spec.group_by and not spec.numeric \
+                and not spec.topk_field and spec.distinct \
+                and self._mesh is None:
+            # single-sketch ingest-rate shape (the bench gate): one
+            # global group, straight into the register update
+            g = self._groups.get(())
+            if g is None:
+                g = self._groups[()] = _FluxGroup(spec)
+            g.count += n
+            for f in spec.distinct:
+                b, ln = strcols[f]
+                self._hll_absorb(g.hlls[f], b, ln)
+            self.records_total += n
+            return n
+        self._absorb_rows(self._groups, n, strcols, numcols)
+        self.records_total += n
+        return n
+
+    def absorb_events(self, events: list) -> int:
+        """Per-record twin of :meth:`absorb_batch` — converts decoded
+        events to the same column layout and runs the same math, so the
+        two paths are bit-identical."""
+        n = len(events)
+        if n == 0:
+            return 0
+        # the decode-side coercion: non-dict bodies become empty maps
+        # (all columns missing, row still counts) — parity with both
+        # the codec's _to_event and the native stagers' non-map rows
+        bodies = [ev.body if isinstance(ev.body, dict) else {}
+                  for ev in events]
+        strcols = {
+            f: self._str_column(bodies, f)
+            for f in self.spec.string_fields
+        }
+        numcols = {
+            f: self._num_column(bodies, f) for f in self.spec.numeric
+        }
+        self.batches_total += 1
+        if self.spec.event_time:
+            ts = np.asarray([ev.ts_float for ev in events],
+                            dtype=np.float64)
+            absorbed = self._absorb_event_time(ts, strcols, numcols)
+        else:
+            self._absorb_rows(self._groups, n, strcols, numcols)
+            absorbed = n
+        self.records_total += absorbed
+        return absorbed
+
+    def _str_column(self, bodies: List[dict], field: str):
+        vals: List[Optional[bytes]] = []
+        for b in bodies:
+            v = b.get(field)
+            if isinstance(v, str):
+                vb = v.encode("utf-8")
+                # oversize → missing, exactly like the stager's -2 rows
+                vals.append(vb if len(vb) <= self.spec.max_len else None)
+            else:
+                vals.append(None)
+        batch = assemble(vals, self.spec.max_len)
+        ln = batch.lengths.copy()
+        ln[ln == -2] = -1  # collapse oversize into plain missing
+        return batch.batch, ln
+
+    def _num_column(self, bodies: List[dict], field: str):
+        vals = np.zeros((len(bodies),), dtype=np.float64)
+        kinds = np.zeros((len(bodies),), dtype=np.uint8)
+        for i, b in enumerate(bodies):
+            v = b.get(field)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            vals[i] = float(v)
+            kinds[i] = 1 if isinstance(v, int) else 2
+        return vals, kinds
+
+    # -- grouping ------------------------------------------------------
+
+    def _group_rows(self, n_rows: int, strcols
+                    ) -> Tuple[np.ndarray, List[tuple]]:
+        """Segment ids (first-seen order) + group key tuples."""
+        gb = self.spec.group_by
+        if not gb:
+            return np.zeros((n_rows,), dtype=np.int64), [()]
+        mats = []
+        for f in gb:
+            b, ln = strcols[f]
+            L = b.shape[1]
+            ln2 = np.where(ln < 0, np.int32(-1), ln)
+            bz = np.ascontiguousarray(b, dtype=np.uint8).copy()
+            # zero pad bytes so the void view compares by value; the
+            # length column disambiguates embedded-NUL prefixes
+            mask = np.arange(L)[None, :] >= np.clip(ln2, 0, None)[:, None]
+            bz[mask] = 0
+            mats.append(bz)
+            mats.append(ln2.astype("<i4").view(np.uint8).reshape(-1, 4))
+        keyed = np.ascontiguousarray(np.concatenate(mats, axis=1))
+        void = keyed.view(f"V{keyed.shape[1]}").reshape(-1)
+        _, first_idx, inv = np.unique(void, return_index=True,
+                                      return_inverse=True)
+        order = np.argsort(first_idx, kind="stable")
+        remap = np.empty(order.size, dtype=np.int64)
+        remap[order] = np.arange(order.size)
+        seg = remap[np.asarray(inv).reshape(-1)]
+        keys: List[tuple] = []
+        for j in order:
+            row = int(first_idx[j])
+            key = []
+            for f in gb:
+                b, ln = strcols[f]
+                lni = int(ln[row])
+                key.append(b[row, :lni].tobytes() if lni >= 0 else None)
+            keys.append(tuple(key))
+        return seg, keys
+
+    # -- the shared core ----------------------------------------------
+
+    def _absorb_rows(self, pane: Dict[tuple, _FluxGroup], n_rows: int,
+                     strcols, numcols) -> None:
+        seg, keys = self._group_rows(n_rows, strcols)
+        n_groups = len(keys)
+        if self._mesh is not None:
+            ones = np.ones((seg.shape[0],), dtype=np.int32)
+            counts = kernels.sharded_segment_counts(
+                self._mesh, seg, ones, n_groups)
+        elif n_groups == 1:
+            counts = np.asarray([n_rows], dtype=np.int32)
+        else:
+            ones = np.ones((seg.shape[0],), dtype=np.int32)
+            counts = kernels.host_segment_counts(seg, ones, n_groups)
+        single = n_groups == 1
+        if not single:
+            # one stable sort instead of a per-group full-batch scan
+            # (O(N log N), not O(groups × rows) — GROUP BY a
+            # high-cardinality key must not go quadratic inside the
+            # ingest lock); stability keeps each group's row indices
+            # ascending, which the sequential-sum exactness needs
+            order = np.argsort(seg, kind="stable")
+            bounds = np.searchsorted(seg[order],
+                                     np.arange(n_groups + 1))
+        for gid, key in enumerate(keys):
+            g = pane.get(key)
+            if g is None:
+                g = pane[key] = _FluxGroup(self.spec)
+            g.count += int(counts[gid])
+            gidx = None if single else order[bounds[gid]:bounds[gid + 1]]
+            for f in self.spec.numeric:
+                vals, kinds = numcols[f]
+                if gidx is not None:
+                    vals, kinds = vals[gidx], kinds[gidx]
+                self._update_col(g.cols[f], vals, kinds)
+            for f in self.spec.distinct:
+                b, ln = strcols[f]
+                if gidx is not None:
+                    b, ln = self._pad_rows(b[gidx], ln[gidx])
+                self._hll_absorb(g.hlls[f], b, ln)
+            if self.spec.topk_field:
+                b, ln = strcols[self.spec.topk_field]
+                if gidx is not None:
+                    b, ln = b[gidx], ln[gidx]
+                self._topk_absorb(key, b, ln)
+
+    def _pad_rows(self, b: np.ndarray, ln: np.ndarray):
+        """Pad a per-group slice to a bucketed row count (missing-row
+        padding, a no-op in every kernel) when the update will hit a
+        jitted path — variable per-group shapes would otherwise compile
+        a fresh XLA program per distinct group size inside the ingest
+        lock (the same motivation as _topk_absorb's bucket padding).
+        The host C twin takes any shape; skip the copy there."""
+        if self._mesh is None and not self._use_device():
+            return b, ln
+        Bp = bucket_size(b.shape[0], max_len=b.shape[1] or 1)
+        if Bp <= b.shape[0]:
+            return b, ln
+        return (
+            np.concatenate(
+                [b, np.zeros((Bp - b.shape[0], b.shape[1]),
+                             dtype=b.dtype)]),
+            np.concatenate(
+                [ln, np.full((Bp - ln.shape[0],), -1, dtype=ln.dtype)]),
+        )
+
+    @staticmethod
+    def _update_col(st: _ColStat, vals: np.ndarray,
+                    kinds: np.ndarray) -> None:
+        valid = kinds > 0
+        if not valid.any():
+            return
+        vv = vals[valid]
+        kk = kinds[valid]
+        if np.isnan(vv).any():
+            # NaN ordering is path-dependent under vectorized min/max;
+            # run the exact per-value semantics instead (rare)
+            for v, k in zip(vv.tolist(), kk.tolist()):
+                is_int = k == 1
+                if not st.has:
+                    st.has = True
+                    st.sum = 0.0 + v
+                    st.min, st.min_int = v, is_int
+                    st.max, st.max_int = v, is_int
+                    continue
+                st.sum = st.sum + v
+                if v < st.min:
+                    st.min, st.min_int = v, is_int
+                if v > st.max:
+                    st.max, st.max_int = v, is_int
+            return
+        start = st.sum if st.has else 0.0
+        new_sum = _seq_sum(start, vv)
+        gmin = float(vv.min())
+        gmax = float(vv.max())
+        min_int = bool(kk[int(np.argmax(vv == gmin))] == 1)
+        max_int = bool(kk[int(np.argmax(vv == gmax))] == 1)
+        if not st.has:
+            st.has = True
+            st.min, st.min_int = gmin, min_int
+            st.max, st.max_int = gmax, max_int
+        else:
+            if gmin < st.min:
+                st.min, st.min_int = gmin, min_int
+            if gmax > st.max:
+                st.max, st.max_int = gmax, max_int
+        st.sum = new_sum
+
+    def _use_device(self) -> bool:
+        from ..ops import device
+
+        return device.ready() and device.platform() not in (None, "cpu")
+
+    def _hll_absorb(self, hll: HyperLogLog, batch: np.ndarray,
+                    lengths: np.ndarray) -> None:
+        if self._mesh is not None:
+            sharded_hll_update(hll, self._mesh, batch, lengths)
+        elif self._use_device():
+            hll.update(batch, lengths)
+        else:
+            # attached backend IS the host CPU (or none): the C twin
+            # beats the jit round trip and is bit-identical
+            hll.host_update(batch, lengths)
+
+    def _topk_absorb(self, key: tuple, batch: np.ndarray,
+                     lengths: np.ndarray) -> None:
+        prefix = self._group_prefix(key)
+        W = self.spec.max_len
+        valid = np.nonzero(lengths >= 0)[0]
+        if valid.size == 0:
+            return
+        plen = len(prefix)
+        if plen > W:
+            # the group prefix alone exceeds the composite width: no
+            # value can fit, and the broadcast below would raise AFTER
+            # earlier groups committed (a partial absorb = the
+            # batch-exactness violation). Skip identically on both
+            # paths — this group simply has no top-k.
+            return
+        comp = np.zeros((valid.size, W), dtype=np.uint8)
+        comp_len = np.full((valid.size,), -1, dtype=np.int32)
+        if plen:
+            comp[:, :plen] = np.frombuffer(prefix, dtype=np.uint8)
+        vl = lengths[valid]
+        fits = plen + vl <= W
+        span = min(W - plen, batch.shape[1])
+        comp[:, plen:plen + span] = batch[valid, :span]
+        # oversize composites are excluded on BOTH paths (comp_len -1)
+        comp_len[fits] = (plen + vl[fits]).astype(np.int32)
+        # zero pad bytes past each composite's length (the batch slice
+        # above copied arena garbage); candidate extraction below walks
+        # by length so only the staged device batch needs the zeroing
+        pad = np.arange(W)[None, :] >= np.clip(comp_len, 0, None)[:, None]
+        comp[pad] = 0
+        Bp = bucket_size(valid.size, max_len=W)
+        if Bp > valid.size:
+            comp = np.concatenate(
+                [comp, np.zeros((Bp - valid.size, W), dtype=np.uint8)])
+            comp_len = np.concatenate(
+                [comp_len, np.full((Bp - valid.size,), -1,
+                                   dtype=np.int32)])
+        if self._mesh is not None:
+            sharded_cms_update(self.cms, self._mesh, comp, comp_len)
+        elif self._use_device():
+            self.cms.update(comp, comp_len)
+        else:
+            self.cms.host_update(comp, comp_len)
+        # candidate set: a BOUNDED sample of this chunk's values (the
+        # CMS holds the counts; candidates only nominate keys for the
+        # top-k read). Stride-sampling rows instead of uniquing the
+        # whole chunk caps per-chunk work at O(limit) — hot keys appear
+        # in most chunks, so they enter the set with high probability,
+        # and the estimates themselves always come from the sketch.
+        cand = self._candidates.pop(key, None)
+        if cand is None:
+            cand = {}
+        # re-insert at the END: the candidate-group map is bounded
+        # LRU-ish (hot groups stay, historical group keys age out) —
+        # per-group panes clear on window rollover but top-k is
+        # state-lifetime, so without this a high-cardinality GROUP BY
+        # grows candidate memory and exporter-refresh cost forever
+        self._candidates[key] = cand
+        if len(self._candidates) > _MAX_CANDIDATE_GROUPS:
+            for stale in list(self._candidates)[
+                    : len(self._candidates) - _MAX_CANDIDATE_GROUPS]:
+                del self._candidates[stale]
+        ok = np.nonzero(comp_len[:valid.size] >= 0)[0]
+        limit = max(64, 8 * self.spec.topk)
+        if ok.size > limit:
+            ok = ok[:: max(1, int(ok.size) // limit)][:limit]
+        lens = comp_len[ok].tolist()
+        for i, clen in zip(ok.tolist(), lens):
+            vb = comp[i, plen:clen].tobytes()
+            cand.pop(vb, None)
+            cand[vb] = None
+        if len(cand) > limit:
+            for k in list(cand)[: len(cand) - limit]:
+                del cand[k]
+
+    def _group_prefix(self, key: tuple) -> bytes:
+        if not key:
+            return b""
+        return _FIELD_SEP.join(
+            b"\x00" if part is None else part for part in key
+        ) + _VALUE_SEP
+
+    # -- event-time (per-record path only) ----------------------------
+
+    def _absorb_event_time(self, ts: np.ndarray, strcols,
+                           numcols) -> int:
+        size = self.spec.window.size
+        wid = np.floor(ts / size).astype(np.int64)
+        wm = self._watermark
+        absorbed = 0
+        min_open = None
+        if wm is not None:
+            min_open = int(math.floor(wm / size))
+        uniq, first_idx = np.unique(wid, return_index=True)
+        for j in np.argsort(first_idx, kind="stable"):
+            w = int(uniq[j])
+            rows = np.nonzero(wid == w)[0]
+            if min_open is not None and w < min_open:
+                self.late_records_total += int(rows.size)
+                continue
+            pane = self._event_windows.get(w)
+            if pane is None:
+                pane = self._event_windows[w] = {}
+            sc = {f: (b[rows], ln[rows]) for f, (b, ln) in strcols.items()}
+            nc = {f: (v[rows], k[rows]) for f, (v, k) in numcols.items()}
+            self._absorb_rows(pane, int(rows.size), sc, nc)
+            absorbed += int(rows.size)
+        new_wm = float(ts.max())
+        if wm is None or new_wm > wm:
+            self._watermark = new_wm
+        self._close_event_windows()
+        return absorbed
+
+    def _close_event_windows(self) -> None:
+        if self._watermark is None:
+            return
+        size = self.spec.window.size
+        done = int(math.floor(self._watermark / size))
+        for w in sorted(k for k in self._event_windows if k < done):
+            pane = self._event_windows.pop(w)
+            if pane:
+                self._pending_closed.append(
+                    ((w + 1) * size, list(pane.items())))
+                self.window_emits_total += 1
+
+    # ------------------------------------------------------------ window
+
+    def tick(self, now: Optional[float] = None
+             ) -> List[Tuple[tuple, _FluxGroup]]:
+        """Close expired windows; returns the closed window's groups in
+        first-seen order (empty list = nothing to emit).  Mirrors
+        ``SPTask.tick`` arithmetic exactly in processing-time mode."""
+        w = self.spec.window
+        if self.spec.event_time:
+            out: List[Tuple[tuple, _FluxGroup]] = []
+            for _, items in self._pending_closed:
+                out.extend(items)
+            self._pending_closed = []
+            return out
+        if w.kind is None:
+            return []
+        now = self._now() if now is None else now
+        if w.kind == "tumbling":
+            if now - self._window_start < w.size:
+                return []
+            self._window_start += w.size * (
+                (now - self._window_start) // w.size)
+            closed = list(self._groups.items())
+            self._groups = {}
+            if closed:
+                self.window_emits_total += 1
+            return closed
+        # hopping
+        if now - self._window_start < w.advance:
+            return []
+        self._window_start += w.advance * (
+            (now - self._window_start) // w.advance)
+        self._panes.append(self._groups)
+        self._groups = {}
+        self._panes = self._panes[-w.n_panes:]
+        merged: Dict[tuple, _FluxGroup] = {}
+        for pane in self._panes:
+            for key, g in pane.items():
+                m = merged.get(key)
+                if m is None:
+                    m = merged[key] = _FluxGroup(self.spec)
+                m.merge(g)
+        out = list(merged.items())
+        if out:
+            self.window_emits_total += 1
+        return out
+
+    def drain(self) -> List[Tuple[tuple, _FluxGroup]]:
+        """Shutdown: whatever the open window(s) accumulated (SPTask
+        drain semantics for processing-time; all open event windows)."""
+        if self.spec.event_time:
+            for w in sorted(self._event_windows):
+                pane = self._event_windows.pop(w)
+                if pane:
+                    self._pending_closed.append(
+                        ((w + 1) * self.spec.window.size,
+                         list(pane.items())))
+            return self.tick()
+        if self.spec.window.kind is None:
+            return list(self._groups.items())
+        for pane in self._panes:
+            for key, g in pane.items():
+                cur = self._groups.get(key)
+                if cur is None:
+                    self._groups[key] = g
+                else:
+                    cur.merge(g)
+        self._panes = []
+        closed = list(self._groups.items())
+        self._groups = {}
+        return closed
+
+    def live_groups(self) -> List[Tuple[tuple, _FluxGroup]]:
+        """The OPEN pane's groups (metrics exporter reads; does not
+        disturb window accounting)."""
+        if self.spec.event_time:
+            merged: Dict[tuple, _FluxGroup] = {}
+            for w in sorted(self._event_windows):
+                for key, g in self._event_windows[w].items():
+                    m = merged.get(key)
+                    if m is None:
+                        m = merged[key] = _FluxGroup(self.spec)
+                    m.merge(g)
+            return list(merged.items())
+        return list(self._groups.items())
+
+    # ------------------------------------------------------------- top-k
+
+    def topk(self, key: tuple) -> List[Tuple[int, bytes]]:
+        """Current hottest values for one group: (estimate, value),
+        highest first — CMS point queries over the candidate set, one
+        device→host table copy for the whole set."""
+        if self.cms is None:
+            return []
+        cand = list(self._candidates.get(key, ()))
+        if not cand:
+            return []
+        prefix = self._group_prefix(key)
+        ests = self.cms.query_many([prefix + v for v in cand])
+        top = sorted(zip(ests, cand),
+                     key=lambda t: (-t[0], t[1]))[: self.spec.topk]
+        return [(int(e), v) for e, v in top]
+
+    # ------------------------------------------------------ snapshot/restore
+
+    def snapshot(self) -> dict:
+        """Read-only structural snapshot (window accounting untouched —
+        rollover under a concurrent snapshot stays correct)."""
+
+        def enc_pane(pane):
+            out = []
+            for key, g in pane.items():
+                out.append({
+                    "key": key,
+                    "count": g.count,
+                    "cols": {
+                        f: (st.has, st.sum, st.min, st.max,
+                            st.min_int, st.max_int)
+                        for f, st in g.cols.items()
+                    },
+                    "hlls": {
+                        f: np.asarray(h.registers).copy()
+                        for f, h in g.hlls.items()
+                    },
+                })
+            return out
+
+        snap = {
+            "version": SNAPSHOT_VERSION,
+            "name": self.spec.name,
+            "shape": self.spec.shape(),
+            "window_start": self._window_start,
+            "groups": enc_pane(self._groups),
+            "panes": [enc_pane(p) for p in self._panes],
+            "event_windows": {
+                w: enc_pane(p) for w, p in self._event_windows.items()
+            },
+            "watermark": self._watermark,
+            "cms": (np.asarray(self.cms.table).copy()
+                    if self.cms is not None else None),
+            "candidates": {k: list(v) for k, v in
+                           self._candidates.items()},
+            "counters": (self.records_total, self.late_records_total,
+                         self.window_emits_total, self.batches_total),
+        }
+        return snap
+
+    def restore(self, snap: dict) -> None:
+        if snap.get("version") != SNAPSHOT_VERSION:
+            raise ValueError(
+                f"flux snapshot version {snap.get('version')!r} "
+                f"unsupported (want {SNAPSHOT_VERSION})")
+        # a snapshot persisted under a different config must not
+        # silently restore: group keys would have the wrong arity and
+        # columns/sketches would misalign (window rows with missing or
+        # shifted labels) — reject and let the caller start fresh
+        if snap.get("name") != self.spec.name:
+            raise ValueError(
+                f"flux snapshot belongs to state "
+                f"{snap.get('name')!r}, not {self.spec.name!r}")
+        if snap.get("shape") != self.spec.shape():
+            raise ValueError(
+                f"flux snapshot shape {snap.get('shape')!r} does not "
+                f"match this state's spec {self.spec.shape()!r}")
+
+        def dec_pane(items):
+            pane: Dict[tuple, _FluxGroup] = {}
+            for it in items:
+                g = _FluxGroup(self.spec)
+                g.count = it["count"]
+                for f, (has, s, mn, mx, mni, mxi) in it["cols"].items():
+                    if f in g.cols:
+                        st = g.cols[f]
+                        st.has, st.sum = has, s
+                        st.min, st.max = mn, mx
+                        st.min_int, st.max_int = mni, mxi
+                for f, regs in it["hlls"].items():
+                    if f in g.hlls:
+                        arr = np.asarray(regs).astype(np.int32).copy()
+                        # belt-and-braces behind the shape() check: a
+                        # wrong-sized register array would be an
+                        # out-of-bounds write in the C kernel
+                        if arr.shape != (g.hlls[f].m,):
+                            raise ValueError(
+                                f"flux snapshot HLL register shape "
+                                f"{arr.shape} != ({g.hlls[f].m},)")
+                        g.hlls[f].registers = arr
+                pane[it["key"]] = g
+            return pane
+
+        # decode EVERYTHING into locals before touching self: a decode
+        # failure mid-way must leave the state exactly as it was (the
+        # old-or-new recovery contract; load() falls back to fresh)
+        groups = dec_pane(snap["groups"])
+        panes = [dec_pane(p) for p in snap["panes"]]
+        event_windows = {
+            w: dec_pane(p) for w, p in snap["event_windows"].items()
+        }
+        cms_table = None
+        if self.cms is not None and snap.get("cms") is not None:
+            cms_table = np.asarray(snap["cms"]).astype(
+                np.asarray(self.cms.table).dtype).copy()
+            want = (self.cms.depth, self.cms.width)
+            if cms_table.shape != want:
+                raise ValueError(
+                    f"flux snapshot CMS table shape {cms_table.shape} "
+                    f"!= {want}")
+        candidates = {
+            k: {v: None for v in vs}
+            for k, vs in snap.get("candidates", {}).items()
+        }
+        (records, late, emits, batches) = snap["counters"]
+        self._groups = groups
+        self._panes = panes
+        self._event_windows = event_windows
+        self._watermark = snap["watermark"]
+        self._window_start = snap["window_start"]
+        if cms_table is not None:
+            self.cms.table = cms_table
+        self._candidates = candidates
+        self.records_total = records
+        self.late_records_total = late
+        self.window_emits_total = emits
+        self.batches_total = batches
+
+    def persist(self, path: str) -> None:
+        """Atomic snapshot write: tmp + fsync + rename — a crash at the
+        armed ``flux.snapshot`` failpoint leaves the previous file
+        intact (old-or-new, never torn)."""
+        self.write_snapshot(self.snapshot(), path)
+
+    @staticmethod
+    def write_snapshot(snap: dict, path: str) -> None:
+        """Write an already-built snapshot dict (see :meth:`persist`).
+        Split out so callers holding the engine ingest lock can build
+        the (read-only, in-memory) snapshot under the lock and do the
+        pickle/write/fsync OUTSIDE it — a slow disk must not stall
+        every input's append."""
+        payload = pickle.dumps(snap, protocol=4)
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".flux-snap-", dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            if _fp.ACTIVE:
+                _fp.fire("flux.snapshot")
+            os.replace(tmp, path)
+        finally:
+            try:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            except OSError:
+                pass  # best-effort tmp cleanup; the snapshot landed
+
+    def load(self, path: str) -> bool:
+        """Restore from a persisted snapshot; False = no/corrupt file
+        (fresh state — the recovery contract is old-or-new)."""
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            return False
+        try:
+            snap = pickle.loads(payload)
+        except Exception:
+            # numpy/format upgrades surface as AttributeError /
+            # ImportError / TypeError from array reconstruction — the
+            # recovery contract is "unusable snapshot → fresh state",
+            # never "pipeline fails to start"
+            import logging
+
+            logging.getLogger("flb.flux").warning(
+                "flux snapshot %s undecodable; starting fresh", path,
+                exc_info=True)
+            return False
+        try:
+            self.restore(snap)
+        except (KeyError, ValueError, TypeError):
+            import logging
+
+            logging.getLogger("flb.flux").warning(
+                "flux snapshot %s unusable; starting fresh", path)
+            return False
+        return True

@@ -87,6 +87,37 @@ def _load():
                 ctypes.c_longlong, ctypes.c_longlong,
                 ctypes.POINTER(ctypes.c_longlong), ctypes.c_int32,
             ]
+        # fbtpu-flux entry points (absent in a stale prebuilt .so:
+        # callers then stay on their Python/device paths)
+        f64_fn = getattr(lib, "fbtpu_stage_field_f64", None)
+        if f64_fn is not None:
+            f64_fn.restype = ctypes.c_longlong
+            f64_fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong),
+            ]
+        hll_fn = getattr(lib, "fbtpu_hll_update", None)
+        if hll_fn is not None:
+            hll_fn.restype = None
+            hll_fn.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+        cms_fn = getattr(lib, "fbtpu_cms_update", None)
+        if cms_fn is not None:
+            cms_fn.restype = ctypes.c_longlong
+            cms_fn.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_int32,
+            ]
         lib.fbtpu_compact.restype = ctypes.c_longlong
         lib.fbtpu_compact.argtypes = [
             ctypes.c_char_p, ctypes.c_longlong,
@@ -567,3 +598,86 @@ def stage_field(
     if n < B:
         lengths[n:B] = -1  # pad rows (jit shape stability) stay "missing"
     return batch[:B], lengths[:B], offsets[: n + 1], n
+
+
+def stage_field_f64(
+    buf: bytes, key: bytes, n_hint: Optional[int] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+    """Stage one top-level NUMERIC field straight from chunk bytes:
+    (values[B] f64, kinds[B] u8, n_records). kinds: 0 = missing/
+    non-numeric (strings are non-numeric — the exact aggregate rule),
+    1 = msgpack integer, 2 = msgpack float. Freshly allocated arrays
+    (no arena: flux window state holds onto per-chunk columns)."""
+    lib = _load()
+    if lib is None or getattr(lib, "fbtpu_stage_field_f64", None) is None:
+        return None
+    est = n_hint if n_hint is not None else count_records(buf)
+    if est is None:
+        return None
+    values = np.zeros((max(est, 1),), dtype=np.float64)
+    kinds = np.zeros((max(est, 1),), dtype=np.uint8)
+    n = lib.fbtpu_stage_field_f64(
+        buf, len(buf), key, len(key),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        est, None,
+    )
+    if n < 0:
+        return None
+    n = int(n)
+    return values[:n], kinds[:n], n
+
+
+def has_flux_stagers() -> bool:
+    """True when the loaded .so exports the flux entry points (a stale
+    prebuilt library may predate them — callers should then skip the
+    batched flux path once instead of probing per chunk)."""
+    lib = _load()
+    return lib is not None and \
+        getattr(lib, "fbtpu_stage_field_f64", None) is not None
+
+
+def hll_update(registers: np.ndarray, batch: np.ndarray,
+               lengths: np.ndarray, p: int) -> bool:
+    """C twin of the device HLL register update over a staged [B, L]
+    batch — bit-identical to HyperLogLog.add_cpu row by row. Mutates
+    ``registers`` (int32 [2^p]) in place; False = native unavailable."""
+    lib = _load()
+    if lib is None or getattr(lib, "fbtpu_hll_update", None) is None:
+        return False
+    batch = np.ascontiguousarray(batch, dtype=np.uint8)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    B, L = batch.shape
+    lib.fbtpu_hll_update(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        B, L, int(p),
+        registers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return True
+
+
+def cms_update(table: np.ndarray, batch: np.ndarray,
+               lengths: np.ndarray) -> bool:
+    """C twin of the device count-min scatter-add (weight 1 per valid
+    row). Mutates ``table`` ([d, w] int32/int64) in place."""
+    lib = _load()
+    if lib is None or getattr(lib, "fbtpu_cms_update", None) is None:
+        return False
+    if table.dtype == np.int32:
+        elem = 4
+    elif table.dtype == np.int64:
+        elem = 8
+    else:
+        return False
+    batch = np.ascontiguousarray(batch, dtype=np.uint8)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    B, L = batch.shape
+    depth, width = table.shape
+    rc = lib.fbtpu_cms_update(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        B, L, int(depth), int(width),
+        table.ctypes.data_as(ctypes.c_void_p), elem,
+    )
+    return rc == 0

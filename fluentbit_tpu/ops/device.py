@@ -115,6 +115,23 @@ def platform() -> Optional[str]:
     return _platform
 
 
+def shard_map_fn():
+    """Version-tolerant ``shard_map`` import: top-level in newer jax,
+    ``jax.experimental.shard_map`` on 0.4.x.  Every SPMD builder (grep,
+    sketches, flux kernels) routes through here so the simulated-mesh
+    lane runs on whichever jax the image ships — the bare
+    ``from jax import shard_map`` was exactly why the sharded tests sat
+    in the pre-existing-failure bucket on 0.4.37."""
+    try:
+        from jax import shard_map  # type: ignore[attr-defined]
+
+        return shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
 def status() -> dict:
     return {
         "state": _state,

@@ -406,8 +406,11 @@ class GrepProgram:
         counts[R])`` with ``B`` divisible by the mesh size; ``counts`` is
         the global (all-device) per-rule match total.
         """
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from .device import shard_map_fn
+
+        shard_map = shard_map_fn()
 
         if self._jit is None:
             from . import device

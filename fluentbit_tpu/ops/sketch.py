@@ -121,12 +121,28 @@ class HyperLogLog:
 
     def update(self, batch: np.ndarray, lengths: np.ndarray) -> None:
         """Absorb a staged [B, L] batch (rows with length<0 ignored).
-        Falls back to the bit-identical host loop while the device
-        backend is still attaching."""
+        Falls back to the bit-identical host twins while the device
+        backend is still attaching — the C batch kernel when the native
+        plane is loaded (fbtpu_hll_update; the flux ingest-rate path),
+        else the Python per-row loop."""
         if self._ensure_device():
             self.registers = self._update(
                 self.registers, jnp.asarray(batch), jnp.asarray(lengths)
             )
+            return
+        self.host_update(batch, lengths)
+
+    def host_update(self, batch: np.ndarray, lengths: np.ndarray) -> None:
+        """Host-pinned batch update — never touches the device backend.
+        The C batch kernel (fbtpu_hll_update) when the native plane is
+        loaded and the registers are still host-side, else the
+        bit-identical Python per-row loop. The flux plane uses this
+        directly when the attached backend IS the host CPU (the jit
+        round trip loses to the C walk there)."""
+        from .. import native as _native
+
+        if isinstance(self.registers, np.ndarray) and _native.hll_update(
+                self.registers, batch, lengths, self.p):
             return
         for i in range(batch.shape[0]):
             ln = int(lengths[i])
@@ -219,6 +235,7 @@ class CountMin:
     def update(self, batch: np.ndarray, lengths: np.ndarray,
                weights: Optional[np.ndarray] = None) -> None:
         B = batch.shape[0]
+        unit_weights = weights is None
         if weights is None:
             weights = np.ones((B,), dtype=np.int32)
         if self._ensure_device():
@@ -227,6 +244,21 @@ class CountMin:
                 jnp.asarray(weights),
             )
             return
+        self.host_update(batch, lengths, weights if not unit_weights
+                         else None)
+
+    def host_update(self, batch: np.ndarray, lengths: np.ndarray,
+                    weights: Optional[np.ndarray] = None) -> None:
+        """Host-pinned batch update (see HyperLogLog.host_update): the C
+        batch twin for the weight-1 shape, else the Python loop."""
+        from .. import native as _native
+
+        if weights is None and isinstance(self.table, np.ndarray) \
+                and _native.cms_update(self.table, batch, lengths):
+            return
+        B = batch.shape[0]
+        if weights is None:
+            weights = np.ones((B,), dtype=np.int32)
         for i in range(B):
             ln = int(lengths[i])
             if ln >= 0:
@@ -321,8 +353,11 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
                        lengths: np.ndarray) -> None:
     """Update over a mesh: each device absorbs its batch shard into a
     local register set, merged with lax.pmax (union of HLLs)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .device import shard_map_fn
+
+    shard_map = shard_map_fn()
 
     axis = mesh.axis_names[0]
     if not hll._ensure_device(wait=True):
@@ -355,8 +390,11 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
 def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
                        lengths: np.ndarray) -> None:
     """Count-min over a mesh: local scatter-adds, psum merge."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .device import shard_map_fn
+
+    shard_map = shard_map_fn()
 
     axis = mesh.axis_names[0]
     if not cms._ensure_device(wait=True):

@@ -53,3 +53,4 @@ from . import in_exec_wasi  # noqa: F401
 from . import filter_tensorflow  # noqa: F401
 from . import in_systemd  # noqa: F401
 from . import gated  # noqa: F401
+from ..flux import plugin as _flux_plugin  # noqa: F401  (filter "flux")

@@ -51,7 +51,7 @@ KEYWORDS = {
     "create", "stream", "snapshot", "flush", "with", "as", "select",
     "from", "where", "window", "tumbling", "hopping", "advance", "by",
     "second", "minute", "hour", "group", "and", "or", "not", "is",
-    "null", "tag", "limit",
+    "null", "tag", "limit", "distinct",
 }
 
 AGG_FUNCS = ("avg", "sum", "count", "min", "max", "timeseries_forecast")
@@ -98,6 +98,8 @@ class SelectKey:
     def out_name(self) -> str:
         if self.alias:
             return self.alias
+        if self.func == "count_distinct":
+            return f"COUNT(DISTINCT {self.name})"
         if self.func:
             return f"{self.func.upper()}({self.name or '*'})"
         return self.name or "*"
@@ -249,6 +251,12 @@ class _Parser:
             func = name.lower()
             if self.accept("op", "*"):
                 arg = None
+            elif func == "count" and self.accept("kw", "distinct"):
+                # COUNT(DISTINCT key) — the cardinality aggregate the
+                # flux plane answers with an HLL (exact evaluation
+                # keeps a per-group value set)
+                func = "count_distinct"
+                arg = self.expect("id")
             else:
                 arg = self.expect("id")
             horizon = 0
@@ -417,7 +425,7 @@ def eval_value(node, body: dict, ts: float):
 class _Agg:
     """Accumulator for one group (flb_sp_aggregate_func.c semantics)."""
 
-    __slots__ = ("count", "sums", "mins", "maxs", "series")
+    __slots__ = ("count", "sums", "mins", "maxs", "series", "distincts")
 
     def __init__(self):
         self.count = 0
@@ -425,6 +433,9 @@ class _Agg:
         self.mins: Dict[str, Any] = {}
         self.maxs: Dict[str, Any] = {}
         self.series: Dict[str, List[Tuple[float, float]]] = {}
+        # COUNT(DISTINCT key): exact per-group value sets — the
+        # reference semantics the flux HLL approximates
+        self.distincts: Dict[str, set] = {}
 
     def merge(self, other: "_Agg") -> None:
         """Union of two accumulators (hopping-window pane merge)."""
@@ -439,6 +450,8 @@ class _Agg:
                 self.maxs[n] = v
         for n, s in other.series.items():
             self.series.setdefault(n, []).extend(s)
+        for n, s in other.distincts.items():
+            self.distincts.setdefault(n, set()).update(s)
 
     def add(self, body: dict, ts: float, keys: List[SelectKey]) -> None:
         self.count += 1
@@ -448,6 +461,13 @@ class _Agg:
                 continue
             n = k.name
             v = _get_key(body, n)
+            if k.func == "count_distinct":
+                if v is not None:
+                    try:
+                        self.distincts.setdefault(n, set()).add(v)
+                    except TypeError:
+                        pass  # unhashable (list/dict) values don't count
+                continue
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
             if n not in seen:
@@ -464,6 +484,8 @@ class _Agg:
         n = key.name
         if key.func == "count":
             return self.count
+        if key.func == "count_distinct":
+            return len(self.distincts.get(n, ()))
         if key.func == "sum":
             return self.sums.get(n, 0.0)
         if key.func == "avg":
@@ -532,6 +554,11 @@ class SPTask:
         # FLUSH SNAPSHOT looks its CREATE twin up through this hook
         # (flb_sp_snapshot_flush walks sp->tasks the same way)
         self.find_snapshot = lambda name: None
+        # sketch-eligible queries resolve against flux state instead of
+        # the per-event evaluation below (flux.query.attach_flux flips
+        # this to a FluxBinding): the hidden flux filter absorbs the
+        # records inside the filter pass, this task just reads windows
+        self.flux = None
 
     def matches(self, tag: str, stream_name: Optional[str] = None) -> bool:
         if self.query.source_type == "tag":
@@ -560,6 +587,11 @@ class SPTask:
 
     def process(self, events: list, tag: str) -> None:
         q = self.query
+        if self.flux is not None:
+            # flux-backed: state was already updated inside the filter
+            # chain (batched or per-record twin) — aggregating here
+            # again would double-count
+            return
         if q.kind == "snapshot":
             # WHERE and the SELECT projection apply to what gets
             # buffered, same as any other query kind
@@ -641,6 +673,11 @@ class SPTask:
         pane closes and the emission aggregates the union of the last
         ``size/advance`` panes (a true sliding window over panes)."""
         q = self.query
+        if self.flux is not None:
+            rows = self.flux.rows_on_tick(self._now())
+            if rows:
+                self.emit(self.out_tag, rows)
+            return
         if q.window is None or not q.has_aggregates:
             return
         kind, size, advance = q.window
@@ -676,6 +713,11 @@ class SPTask:
 
     def drain(self) -> None:
         """Shutdown: emit whatever the open window accumulated."""
+        if self.flux is not None:
+            rows = self.flux.rows_on_drain()
+            if rows:
+                self.emit(self.out_tag, rows)
+            return
         if self.query.window is not None and self.query.has_aggregates:
             for pane in self._panes:
                 for gkey, agg in pane.items():

@@ -432,6 +432,199 @@ long long fbtpu_stage_field(const uint8_t *buf, long long buflen,
 }
 
 // ---------------------------------------------------------------------
+// Numeric column staging (fbtpu-flux): each record's top-level NUMERIC
+// field `key` → out[i] double + kinds[i] (0 missing/non-numeric,
+// 1 integer, 2 float). msgpack bools are NOT numeric (mirrors the
+// Python aggregate rule `isinstance(v, (int, float)) and not bool`,
+// stream_processor._Agg.add); strings are NOT parsed — the exact
+// Python evaluation path skips numeric-looking strings, and the flux
+// plane must stay bit-identical to it. int64/uint64 → double uses the
+// same IEEE round-to-nearest Python's float(int) applies.
+// ---------------------------------------------------------------------
+
+static inline int read_numeric(const uint8_t *p, const uint8_t *end,
+                               double *out) {
+    if (p >= end) return 0;
+    uint8_t b = *p++;
+    if (b <= 0x7f) { *out = (double)b; return 1; }            // pos fixint
+    if (b >= 0xe0) { *out = (double)(int8_t)b; return 1; }    // neg fixint
+    switch (b) {
+    case 0xcc: if (p + 1 > end) return 0;
+        *out = (double)p[0]; return 1;
+    case 0xcd: if (p + 2 > end) return 0;
+        *out = (double)(((uint32_t)p[0] << 8) | p[1]); return 1;
+    case 0xce: if (p + 4 > end) return 0;
+        *out = (double)(((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+                        | ((uint32_t)p[2] << 8) | p[3]);
+        return 1;
+    case 0xcf: {
+        if (p + 8 > end) return 0;
+        uint64_t v = 0;
+        for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+        *out = (double)v;
+        return 1;
+    }
+    case 0xd0: if (p + 1 > end) return 0;
+        *out = (double)(int8_t)p[0]; return 1;
+    case 0xd1: if (p + 2 > end) return 0;
+        *out = (double)(int16_t)(((uint16_t)p[0] << 8) | p[1]); return 1;
+    case 0xd2: if (p + 4 > end) return 0;
+        *out = (double)(int32_t)(((uint32_t)p[0] << 24)
+                                 | ((uint32_t)p[1] << 16)
+                                 | ((uint32_t)p[2] << 8) | p[3]);
+        return 1;
+    case 0xd3: {
+        if (p + 8 > end) return 0;
+        uint64_t v = 0;
+        for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+        *out = (double)(int64_t)v;
+        return 1;
+    }
+    case 0xca: {
+        if (p + 4 > end) return 0;
+        uint32_t v = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+                   | ((uint32_t)p[2] << 8) | p[3];
+        float f;
+        memcpy(&f, &v, 4);
+        *out = (double)f;
+        return 2;
+    }
+    case 0xcb: {
+        if (p + 8 > end) return 0;
+        uint64_t v = 0;
+        for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+        double d;
+        memcpy(&d, &v, 8);
+        *out = d;
+        return 2;
+    }
+    }
+    return 0;
+}
+
+long long fbtpu_stage_field_f64(const uint8_t *buf, long long buflen,
+                                const uint8_t *key, long long keylen,
+                                double *out, uint8_t *kinds,
+                                long long max_records, long long *offsets) {
+    const uint8_t *p = buf, *end = buf + buflen;
+    long long rec = 0;
+    while (p < end) {
+        if (rec >= max_records) return -2;
+        if (offsets) offsets[rec] = p - buf;
+        const uint8_t *rec_start = p;
+        out[rec] = 0.0;
+        kinds[rec] = 0;
+        uint32_t outer;
+        const uint8_t *q = read_array_hdr(rec_start, end, &outer);
+        const uint8_t *rec_end = nullptr;
+        if (q && outer >= 2) {
+            const uint8_t *body = skip_obj(q, end, 0);
+            if (body) {
+                uint32_t pairs;
+                const uint8_t *kv = read_map_hdr(body, end, &pairs);
+                if (kv) {
+                    // scan ALL pairs: duplicate keys keep the LAST
+                    // occurrence, same as the dict decode / stage_field
+                    for (uint32_t i = 0; i < pairs && kv; i++) {
+                        uint32_t klen;
+                        const uint8_t *kstr = read_str_hdr(kv, end, &klen);
+                        const uint8_t *val;
+                        bool match = false;
+                        if (kstr) {
+                            val = kstr + klen;
+                            if (val > end) { kv = nullptr; break; }
+                            match = ((long long)klen == keylen &&
+                                     memcmp(kstr, key, klen) == 0);
+                        } else {
+                            val = skip_obj(kv, end, 0);
+                            if (!val) { kv = nullptr; break; }
+                        }
+                        if (match) {
+                            double v;
+                            int kind = read_numeric(val, end, &v);
+                            if (kind) {
+                                out[rec] = v;
+                                kinds[rec] = (uint8_t)kind;
+                            } else {
+                                kinds[rec] = 0;  // last occurrence rules
+                            }
+                        }
+                        kv = skip_obj(val, end, 0);
+                    }
+                    if (kv && outer == 2) rec_end = kv;
+                }
+            }
+        }
+        p = rec_end ? rec_end : skip_obj(rec_start, end, 0);
+        if (!p) return -1;
+        rec++;
+    }
+    if (offsets) offsets[rec] = buflen;
+    return rec;
+}
+
+// ---------------------------------------------------------------------
+// Host-pinned sketch updates (fbtpu-flux): the bit-identical C twins of
+// the device HLL/count-min kernels (fluentbit_tpu/ops/sketch.py), used
+// while the backend is still attaching (or pinned to CPU). Hash is
+// finalized FNV-1a 32 + murmur3 fmix32, exactly _hash32_cpu.
+// ---------------------------------------------------------------------
+
+static inline uint32_t fnv1a_mix32(const uint8_t *v, int32_t len) {
+    uint32_t h = 0x811C9DC5u;
+    for (int32_t i = 0; i < len; i++)
+        h = (h ^ v[i]) * 0x01000193u;
+    h ^= h >> 16; h *= 0x85EBCA6Bu;
+    h ^= h >> 13; h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    return h;
+}
+
+// rows with lengths[i] < 0 are skipped (missing/overflow markers)
+void fbtpu_hll_update(const uint8_t *batch, const int32_t *lengths,
+                      long long B, long long L, int32_t p,
+                      int32_t *registers) {
+    int32_t max_rank = 32 - p + 1;
+    for (long long i = 0; i < B; i++) {
+        int32_t len = lengths[i];
+        if (len < 0) continue;
+        uint32_t h = fnv1a_mix32(batch + i * L, len);
+        uint32_t idx = h >> (32 - p);
+        uint32_t rest = (uint32_t)(h << p);
+        int32_t nlz = rest ? __builtin_clz(rest) : 32;
+        int32_t rank = nlz + 1 < max_rank ? nlz + 1 : max_rank;
+        if (rank > registers[idx]) registers[idx] = rank;
+    }
+}
+
+// table is [depth, width] of elem_size-byte signed counters (4 or 8 —
+// CountMin keys its dtype off jax_enable_x64); weight 1 per valid row.
+long long fbtpu_cms_update(const uint8_t *batch, const int32_t *lengths,
+                           long long B, long long L, int32_t depth,
+                           int32_t width, void *table, int32_t elem_size) {
+    if (elem_size != 4 && elem_size != 8) return -1;
+    for (long long i = 0; i < B; i++) {
+        int32_t len = lengths[i];
+        if (len < 0) continue;
+        uint32_t h1 = fnv1a_mix32(batch + i * L, len);
+        uint32_t h2 = h1;
+        h2 ^= h2 >> 16; h2 *= 0x85EBCA6Bu;
+        h2 ^= h2 >> 13; h2 *= 0xC2B2AE35u;
+        h2 ^= h2 >> 16;
+        h2 |= 1u;
+        for (int32_t r = 0; r < depth; r++) {
+            uint32_t col = (uint32_t)(h1 + (uint32_t)r * h2)
+                           % (uint32_t)width;
+            if (elem_size == 4)
+                ((int32_t *)table)[(long long)r * width + col] += 1;
+            else
+                ((int64_t *)table)[(long long)r * width + col] += 1;
+        }
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------
 // Threaded staging: phase 1 is the serial boundary walk (record i+1's
 // start depends on record i's end — inherently sequential, but it only
 // skips headers), phase 2 fans the per-record field extraction +

@@ -49,3 +49,9 @@ def pytest_configure(config):
         "soak: crash-recovery soak matrix (tests/test_failpoints.py) — "
         "subprocess SIGKILL/restart cycles; the full matrix is also "
         "marked slow so tier-1 keeps only the short deterministic slice")
+    config.addinivalue_line(
+        "markers",
+        "mesh: simulated-mesh lane (8 virtual CPU devices via "
+        "--xla_force_host_platform_device_count, set above) — the fast "
+        "flux/sharding subset runs unmarked in tier-1; the full mesh "
+        "matrix is additionally marked slow")

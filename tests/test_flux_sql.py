@@ -1,0 +1,360 @@
+"""Sketch-eligible stream-processor SQL ↔ flux plane.
+
+The acceptance contract: sketch-eligible queries return results
+bit-identical (exact aggregates — COUNT/SUM/MIN/MAX/AVG, including
+Python number types) or within documented HLL error bounds
+(COUNT(DISTINCT ...)) versus the existing exact Python evaluation
+path, over randomized workloads; ineligible shapes fall back to the
+exact path untouched; and the raw (no-decode) ingest fast path stays
+ON for flux-backed tags.
+"""
+
+import json
+import math
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import fluentbit_tpu  # noqa: F401
+from fluentbit_tpu.codec.events import encode_event
+from fluentbit_tpu.core.engine import Engine
+from fluentbit_tpu.flux.query import eligible
+from fluentbit_tpu.stream_processor import parse_sql
+
+SQL_WINDOWED = (
+    "CREATE STREAM s WITH (tag='out') AS "
+    "SELECT tenant, COUNT(*), COUNT(DISTINCT user) AS uniq, "
+    "SUM(size) AS sz, MIN(size), MAX(size), AVG(size) "
+    "FROM TAG:'app.*' WINDOW TUMBLING (60 SECOND) GROUP BY tenant;"
+)
+
+
+# ------------------------------------------------------------- grammar
+
+def test_count_distinct_parses():
+    q = parse_sql("SELECT COUNT(DISTINCT user) FROM TAG:'x';")
+    k = q.keys[0]
+    assert k.func == "count_distinct" and k.name == "user"
+    assert k.out_name == "COUNT(DISTINCT user)"
+    assert q.has_aggregates
+
+
+def test_count_distinct_exact_evaluation():
+    """The exact path (no flux) counts a per-group value set."""
+    e = Engine()
+    task = e.sp_task("CREATE STREAM s WITH (tag='o') AS "
+                     "SELECT COUNT(DISTINCT u) AS c FROM TAG:'t';",
+                     allow_flux=False)
+    out = []
+    task.emit = lambda tag, rows: out.append(rows)
+    from fluentbit_tpu.codec.events import decode_events
+
+    buf = b"".join(encode_event({"u": f"x{i % 3}"}, 1.0)
+                   for i in range(10))
+    task.process(decode_events(buf), "t")
+    assert out[0][0]["c"] == 3
+
+
+# --------------------------------------------------------- eligibility
+
+ELIGIBILITY = [
+    (SQL_WINDOWED, True),
+    # no window → exact path
+    ("CREATE STREAM s AS SELECT COUNT(*) FROM TAG:'a' GROUP BY t;",
+     False),
+    # WHERE → exact path
+    ("CREATE STREAM s AS SELECT COUNT(*) FROM TAG:'a' "
+     "WHERE x = 1 WINDOW TUMBLING (5 SECOND);", False),
+    # forecast needs the raw series
+    ("CREATE STREAM s AS SELECT TIMESERIES_FORECAST(v, 10) "
+     "FROM TAG:'a' WINDOW TUMBLING (5 SECOND);", False),
+    # stream source → exact path
+    ("CREATE STREAM s AS SELECT COUNT(*) FROM STREAM:base "
+     "WINDOW TUMBLING (5 SECOND);", False),
+    # per-query opt-out
+    ("CREATE STREAM s WITH (flux='off') AS SELECT COUNT(*) "
+     "FROM TAG:'a' WINDOW TUMBLING (5 SECOND);", False),
+    # projection-only (no aggregates) → exact path
+    ("CREATE STREAM s AS SELECT a, b FROM TAG:'a' "
+     "WINDOW TUMBLING (5 SECOND);", False),
+    # hopping windows are eligible
+    ("CREATE STREAM s AS SELECT COUNT(*) FROM TAG:'a' "
+     "WINDOW HOPPING (10 SECOND, ADVANCE BY 2 SECOND);", True),
+    # dotted (nested-accessor) fields resolve through nested maps on
+    # the exact path only — flux stagers see literal top-level keys,
+    # so these shapes must stay exact (silently-wrong otherwise)
+    ("CREATE STREAM s AS SELECT AVG(http.status) FROM TAG:'a' "
+     "WINDOW TUMBLING (5 SECOND);", False),
+    ("CREATE STREAM s AS SELECT COUNT(*) FROM TAG:'a' "
+     "WINDOW TUMBLING (5 SECOND) GROUP BY k8s.pod;", False),
+]
+
+
+@pytest.mark.parametrize("sql,want", ELIGIBILITY)
+def test_eligibility_matrix(sql, want):
+    assert eligible(parse_sql(sql)) is want
+
+
+def test_ineligible_query_stays_exact():
+    e = Engine()
+    task = e.sp_task("CREATE STREAM s AS SELECT COUNT(*) FROM TAG:'a' "
+                     "WHERE x = 1 WINDOW TUMBLING (5 SECOND);")
+    assert task.flux is None
+    assert not any(f.plugin.name == "flux" for f in e.filters)
+
+
+def test_eligible_query_gets_flux_and_hidden_filter():
+    e = Engine()
+    task = e.sp_task(SQL_WINDOWED)
+    assert task.flux is not None
+    hidden = [f for f in e.filters if f.plugin.name == "flux"]
+    assert len(hidden) == 1
+    assert hidden[0].route.matches("app.x")
+    assert not hidden[0].route.matches("db.y")
+
+
+# ------------------------------------------------------- differential
+
+def make_engine(sql, allow_flux, mesh=False):
+    t = [1000.0]
+    e = Engine()
+    task = e.sp_task(sql, allow_flux=allow_flux)
+    task._now = lambda: t[0]
+    task._window_start = 1000.0
+    if task.flux is not None:
+        st = task.flux.state
+        st._now = task._now
+        st._window_start = 1000.0
+        if mesh:
+            from fluentbit_tpu.flux import kernels
+
+            st._mesh = kernels.flux_mesh()
+    out = []
+    task.emit = lambda tag, rows: out.append((tag, rows))
+    ins = e.input("dummy")
+    for x in e.inputs + e.filters:
+        x.configure()
+        x.plugin.init(x, e)
+    return e, ins, task, out, t
+
+
+def same_value(a, b) -> bool:
+    """Bit-identity for row values: types match and values are equal —
+    with NaN == NaN (both paths legitimately produce NaN when a window
+    sums +inf and -inf; that IS agreement)."""
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, float) and math.isnan(a):
+        return isinstance(b, float) and math.isnan(b)
+    return a == b
+
+
+def corpus(rng, n):
+    buf = bytearray()
+    for i in range(n):
+        body = {}
+        if rng.random() > 0.05:
+            body["tenant"] = rng.choice(["acme", "globex", "init"])
+        if rng.random() > 0.05:
+            body["user"] = f"u{rng.randrange(60)}"
+        r = rng.random()
+        if r < 0.3:
+            body["size"] = rng.randrange(-10**12, 10**12)
+        elif r < 0.6:
+            body["size"] = rng.uniform(-1e6, 1e6)
+        elif r < 0.7:
+            body["size"] = rng.choice(
+                [float("inf"), -float("inf"), 0.0, -0.0, True, None,
+                 "123", [1]])
+        buf += encode_event(body, 1000.0 + i)
+    return bytes(buf)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_differential_exact_bit_identical_hll_bounded(seed):
+    rng1, rng2 = random.Random(seed), random.Random(seed)
+    e1, ins1, t1, out1, clk1 = make_engine(SQL_WINDOWED, True)
+    e2, ins2, t2, out2, clk2 = make_engine(SQL_WINDOWED, False)
+    assert t1.flux is not None and t2.flux is None
+    for step in range(4):
+        raw = corpus(rng1, 250)
+        assert corpus(rng2, 250) == raw
+        e1.input_log_append(ins1, "app.x", raw)
+        e2.input_log_append(ins2, "app.x", raw)
+        clk1[0] = clk2[0] = 1000.0 + 61 * (step + 1)
+        t1.tick()
+        t2.tick()
+    assert len(out1) == len(out2) > 0
+    for (tag1, rows1), (tag2, rows2) in zip(out1, out2):
+        assert tag1 == tag2 and len(rows1) == len(rows2)
+        for r1, r2 in zip(rows1, rows2):
+            assert list(r1.keys()) == list(r2.keys())
+            for k in r2:
+                if k == "uniq":
+                    exact = r2[k]
+                    est = r1[k]
+                    # p=12 HLL: σ ≈ 1.04/√4096 ≈ 1.6%; 5σ + small-n
+                    # slack is far beyond any observable deviation
+                    bound = max(3.0, 0.10 * exact)
+                    assert abs(est - exact) <= bound, (k, est, exact)
+                else:
+                    assert same_value(r1[k], r2[k]), (k, r1[k], r2[k])
+
+
+def test_differential_survives_decline_to_per_record():
+    """Forcing the flux hook to decline (per-record twin) must not
+    change a single emitted byte."""
+    rng = random.Random(77)
+    raws = [corpus(rng, 150) for _ in range(3)]
+
+    def run(force_decline):
+        e, ins, task, out, clk = make_engine(SQL_WINDOWED, True)
+        if force_decline:
+            for f in e.filters:
+                if f.plugin.name == "flux":
+                    f.plugin._batch_ok = False
+        for i, raw in enumerate(raws):
+            e.input_log_append(ins, "app.x", raw)
+            clk[0] = 1000.0 + 61 * (i + 1)
+            task.tick()
+        return out
+
+    a, b = run(False), run(True)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_filters_registered_after_sp_task_run_before_flux():
+    """Config files apply [STREAM_TASK] before [FILTER]: a user filter
+    registered AFTER the query must still run before the hidden flux
+    filter (the SP aggregates POST-filter), so records the chain drops
+    never reach flux state."""
+    e, ins, task, out, clk = make_engine(SQL_WINDOWED, True)
+    g = e.filter("grep")            # registered AFTER sp_task
+    g.set("exclude", "user ^drop")
+    g.configure()
+    g.plugin.init(g, e)
+    assert [f.plugin.name for f in e.filters] == ["grep", "flux"]
+    raw = b"".join(encode_event(
+        {"tenant": "a", "user": ("drop" if i % 2 else "keep"),
+         "size": 1}, 1000.0) for i in range(20))
+    e.input_log_append(ins, "app.x", raw)
+    clk[0] = 1061.0
+    task.tick()
+    # exact-path twin: same order, same verdict
+    e2, ins2, task2, out2, clk2 = make_engine(SQL_WINDOWED, False)
+    g2 = e2.filter("grep")
+    g2.set("exclude", "user ^drop")
+    g2.configure()
+    g2.plugin.init(g2, e2)
+    e2.input_log_append(ins2, "app.x", raw)
+    clk2[0] = 1061.0
+    task2.tick()
+    assert out[0][1][0]["COUNT(*)"] == 10
+    assert out[0][1][0]["COUNT(*)"] == out2[0][1][0]["COUNT(*)"]
+
+
+# ----------------------------------------------------- raw path stays on
+
+def test_raw_fast_path_stays_on_for_flux_backed_tag():
+    """The whole point: a flux-backed query must NOT force the decode
+    path. The raw chain handles the append (no batch declines) and the
+    window still aggregates."""
+    e, ins, task, out, clk = make_engine(SQL_WINDOWED, True)
+    raw = b"".join(encode_event(
+        {"tenant": "a", "user": f"u{i}", "size": i}, 1000.0)
+        for i in range(50))
+    n = e.input_log_append(ins, "app.x", raw)
+    assert n == 50
+    assert sum(v for _, v in e.m_filter_batch_decline.samples()) == 0
+    clk[0] = 1061.0
+    task.tick()
+    assert out and out[0][1][0]["COUNT(*)"] == 50
+
+
+def test_exact_sp_still_forces_decode_path():
+    """Non-flux tasks keep the pre-existing behavior (sp_active)."""
+    e, ins, task, out, clk = make_engine(
+        "CREATE STREAM s AS SELECT COUNT(*) FROM TAG:'app.*' "
+        "WHERE tenant = 'a' WINDOW TUMBLING (60 SECOND);", True)
+    assert task.flux is None
+    raw = b"".join(encode_event({"tenant": "a"}, 1000.0)
+                   for i in range(10))
+    e.input_log_append(ins, "app.x", raw)
+    clk[0] = 1061.0
+    task.tick()
+    assert out[0][1][0]["COUNT(*)"] == 10
+
+
+def test_drain_emits_open_flux_window():
+    e, ins, task, out, clk = make_engine(SQL_WINDOWED, True)
+    raw = b"".join(encode_event(
+        {"tenant": "a", "user": "u", "size": 1}, 1000.0)
+        for _ in range(5))
+    e.input_log_append(ins, "app.x", raw)
+    task.drain()
+    assert out and out[0][1][0]["COUNT(*)"] == 5
+
+
+# -------------------------------------------------------------- mesh
+
+@pytest.mark.mesh
+def test_sql_on_simulated_mesh_bit_identical():
+    """The tier-1 mesh acceptance: the same differential with the flux
+    state sharded across the simulated 8-device mesh."""
+    if len(jax.devices()) < 8:
+        pytest.skip("need the simulated 8-device mesh")
+    rng1, rng2 = random.Random(21), random.Random(21)
+    e1, ins1, t1, out1, clk1 = make_engine(SQL_WINDOWED, True,
+                                           mesh=True)
+    assert t1.flux.state._mesh is not None
+    e2, ins2, t2, out2, clk2 = make_engine(SQL_WINDOWED, False)
+    raw = corpus(rng1, 200)
+    assert corpus(rng2, 200) == raw
+    e1.input_log_append(ins1, "app.x", raw)
+    e2.input_log_append(ins2, "app.x", raw)
+    clk1[0] = clk2[0] = 1061.0
+    t1.tick()
+    t2.tick()
+    (tag1, rows1), (tag2, rows2) = out1[0], out2[0]
+    for r1, r2 in zip(rows1, rows2):
+        for k in r2:
+            if k == "uniq":
+                assert abs(r1[k] - r2[k]) <= max(3.0, 0.10 * r2[k])
+            else:
+                assert same_value(r1[k], r2[k]), (k, r1[k], r2[k])
+
+
+@pytest.mark.mesh
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_sql_mesh_matrix_slow(seed):
+    """Full mesh matrix (slow lane): more seeds, hopping windows."""
+    if len(jax.devices()) < 8:
+        pytest.skip("need the simulated 8-device mesh")
+    sql = ("CREATE STREAM s AS SELECT tenant, COUNT(*), "
+           "SUM(size) AS sz, COUNT(DISTINCT user) AS uniq "
+           "FROM TAG:'app.*' "
+           "WINDOW HOPPING (60 SECOND, ADVANCE BY 20 SECOND) "
+           "GROUP BY tenant;")
+    rng1, rng2 = random.Random(seed), random.Random(seed)
+    e1, ins1, t1, out1, clk1 = make_engine(sql, True, mesh=True)
+    e2, ins2, t2, out2, clk2 = make_engine(sql, False)
+    for step in range(5):
+        raw = corpus(rng1, 120)
+        assert corpus(rng2, 120) == raw
+        e1.input_log_append(ins1, "app.x", raw)
+        e2.input_log_append(ins2, "app.x", raw)
+        clk1[0] = clk2[0] = 1000.0 + 21 * (step + 1)
+        t1.tick()
+        t2.tick()
+    assert len(out1) == len(out2) > 0
+    for (_, rows1), (_, rows2) in zip(out1, out2):
+        for r1, r2 in zip(rows1, rows2):
+            for k in r2:
+                if k == "uniq":
+                    assert abs(r1[k] - r2[k]) <= max(3.0, 0.10 * r2[k])
+                else:
+                    assert same_value(r1[k], r2[k]), (k, r1[k], r2[k])

@@ -813,3 +813,79 @@ def test_findings_carry_severity_and_json_mode(tmp_path):
     data = _json.loads(proc.stdout)
     assert data and data[0]["rule"] == "decline-swallow"
     assert data[0]["severity"] == "warning"
+
+
+# ---------------------------------------------------------------------
+# batch-exactness: the fbtpu-flux commit surface (absorb_batch /
+# absorb_events are state commits — a decline after them makes the
+# decoded rerun double-aggregate the same records)
+# ---------------------------------------------------------------------
+
+BAD_FLUX_DECLINE_AFTER_ABSORB = """
+class FluxLike:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        data = chunk.as_bytes()
+        self.state.absorb_batch(chunk.n, self.mm, {}, {})
+        cols = stage(data)
+        if cols is None:
+            return None
+        return (chunk.n, data, chunk.n)
+"""
+
+GOOD_FLUX_COMMIT_LAST = """
+class FluxLike:
+    stateful_batch = True
+
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        data = chunk.as_bytes()
+        cols = stage(data)
+        if cols is None:
+            return None
+        self.state.absorb_batch(chunk.n, self.mm, cols, {})
+        return (chunk.n, data, chunk.n)
+"""
+
+BAD_FLUX_UNMARKED_STATEFUL = """
+class FluxLike:
+    def can_process_batch(self):
+        return True
+
+    def process_batch(self, chunk):
+        cols = stage(chunk.as_bytes())
+        if cols is None:
+            return None
+        self.state.absorb_events(cols)
+        return (chunk.n, chunk.data, chunk.n)
+"""
+
+
+def test_flux_absorb_is_a_commit():
+    got = lint_source(BAD_FLUX_DECLINE_AFTER_ABSORB,
+                      "fluentbit_tpu/flux/fixture.py")
+    assert "batch-decline-after-commit" in rules(got)
+
+
+def test_flux_commit_last_quiet():
+    assert lint_source(GOOD_FLUX_COMMIT_LAST,
+                       "fluentbit_tpu/flux/fixture.py") == []
+
+
+def test_flux_unmarked_stateful_fires():
+    got = lint_source(BAD_FLUX_UNMARKED_STATEFUL,
+                      "fluentbit_tpu/flux/fixture.py")
+    assert "batch-stateful-unmarked" in rules(got)
+
+
+def test_shipped_flux_plugin_passes_the_gate():
+    # the real filter_flux must satisfy its own contract
+    import fluentbit_tpu.flux.plugin as fp
+
+    assert lint_paths([fp.__file__]) == []
