@@ -42,6 +42,11 @@ Six rule families (see ANALYSIS.md for the full contract):
   ``guard.io_deadline`` bound, and ``open_connection`` dials without a
   ``timeout=`` — the hung-peer shape the fbtpu-guard plane contains
   (analysis.deadline).
+- **metered ingest** (`qos-unmetered-ingest`): any public ingest entry
+  point in ``core/`` from which a chunk-pool append is reachable must
+  also reach the fbtpu-qos tenant admission call (``qos.admit``) —
+  an unmetered path silently bypasses every tenant quota
+  (analysis.qos).
 
 The native C/C++ data plane has its own gate (analysis.native_gate):
 clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
@@ -151,6 +156,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .dtype import DtypeNarrowingRule
     from .locks import AwaitUnderLockRule, GuardedByRule
     from .purity import JaxPurityRules
+    from .qos import UnmeteredIngestRule
     from .silent import SwallowedErrorRule
 
     return [
@@ -162,6 +168,7 @@ def _build_rules(guards=None) -> List[Rule]:
         DeclineSwallowRule(),
         DtypeNarrowingRule(),
         AwaitNoDeadlineRule(),
+        UnmeteredIngestRule(),
     ]
 
 

@@ -1,0 +1,161 @@
+"""qos-unmetered-ingest rule.
+
+fbtpu-qos (core/qos.py) meters every ingest entry point against the
+tenant token bucket: ``Engine.input_log_append`` and
+``input_event_append`` call ``self.qos.admit(...)`` before any work.
+The whole multi-tenant isolation contract rests on that invariant — an
+ingest path added later that appends straight into a chunk pool would
+silently bypass quotas, and nothing at runtime would notice (the
+records flow fine; only the flooding tenant's neighbors pay).
+
+``qos-unmetered-ingest`` makes the invariant machine-checked: in
+``fluentbit_tpu/core/`` modules, every PUBLIC function from which a
+``<x>.pool.append(...)`` call is reachable (directly or through
+same-module helpers — the engine's ``_log_append_decoded`` /
+``_ingest_raw`` shape) must also reach a ``*.qos.admit(...)`` call.
+Private helpers are not flagged on their own: they are only reachable
+through an admitted entry point, which is exactly what the closure
+check verifies. Reachability is a same-module call-name closure (the
+same intentionally-lexical altitude as the guarded-by rule): calls are
+matched by simple name, so ``self._helper()`` and ``helper()`` both
+resolve to local definitions of that name.
+
+Suppress with ``# fbtpu-lint: allow(qos-unmetered-ingest)`` on the
+entry point's ``def`` line (or the offending append line) with a
+justification — e.g. an internal replay path whose records were
+already admitted once.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from . import Finding, Module, Rule
+
+__all__ = ["UnmeteredIngestRule"]
+
+#: Only engine-level modules host ingest entry points; plugins ingest
+#: through Engine.input_*_append, which is already metered.
+SCOPE = "fluentbit_tpu/core/"
+
+
+def _chain_names(node) -> Set[str]:
+    out: Set[str] = set()
+    while isinstance(node, ast.Attribute):
+        out.add(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    return out
+
+
+def _is_pool_append(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "append"
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "pool")
+
+
+def _is_admit(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "admit"
+            and "qos" in _chain_names(f.value))
+
+
+class _FnInfo:
+    __slots__ = ("node", "appends", "admits", "calls")
+
+    def __init__(self, node):
+        self.node = node
+        self.appends: List[ast.Call] = []
+        self.admits = False
+        self.calls: Set[str] = set()
+
+
+def _analyze(fn) -> _FnInfo:
+    """Collect one function's pool appends, admit calls, and the simple
+    names it calls. Nested closures count toward the enclosing
+    function (the engine schedules its ``_create``-style closures from
+    the same logical path)."""
+    info = _FnInfo(fn)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_pool_append(node):
+            info.appends.append(node)
+        elif _is_admit(node):
+            info.admits = True
+        f = node.func
+        if isinstance(f, ast.Name):
+            info.calls.add(f.id)
+        elif isinstance(f, ast.Attribute):
+            info.calls.add(f.attr)
+    return info
+
+
+class UnmeteredIngestRule(Rule):
+    name = "qos-unmetered-ingest"
+    description = ("public ingest entry point reaches a chunk-pool "
+                   "append without passing tenant admission "
+                   "(qos.admit) — quotas are bypassed")
+
+    def check(self, module: Module) -> List[Finding]:
+        if SCOPE not in module.path:
+            return []
+        by_name: Dict[str, List[_FnInfo]] = {}
+        infos: List[_FnInfo] = []
+        nested: Set[ast.AST] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _analyze(node)
+                infos.append(info)
+                by_name.setdefault(node.name, []).append(info)
+                # closures stay in the call graph (their appends count
+                # against the enclosing caller via closure()) but are
+                # never entry points themselves: the admit call lives
+                # in the public function that contains them
+                for sub in ast.walk(node):
+                    if sub is not node and isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        nested.add(sub)
+
+        def closure(start: _FnInfo) -> Tuple[List[ast.Call], bool]:
+            """(reachable pool appends, admit reachable) over the
+            same-module call-name graph."""
+            appends: List[ast.Call] = list(start.appends)
+            admits = start.admits
+            seen: Set[str] = {start.node.name}
+            frontier = set(start.calls)
+            while frontier:
+                name = frontier.pop()
+                if name in seen:
+                    continue
+                seen.add(name)
+                for callee in by_name.get(name, ()):
+                    appends.extend(callee.appends)
+                    admits = admits or callee.admits
+                    frontier.update(callee.calls)
+            return appends, admits
+
+        out: List[Finding] = []
+        for info in infos:
+            name = info.node.name
+            if name.startswith("_"):
+                continue  # helpers are covered via their public callers
+            if info.node in nested:
+                continue  # closures are reached via their container
+            appends, admits = closure(info)
+            if not appends or admits:
+                continue
+            f = self.finding(
+                module, info.node,
+                f"ingest entry point {name!r} reaches a chunk-pool "
+                f"append (line "
+                f"{', '.join(str(a.lineno) for a in appends[:3])}) "
+                f"without a tenant-admission qos.admit(...) call — "
+                f"every ingest path must be metered (core/qos.py)",
+                extra_lines=tuple(a.lineno for a in appends))
+            if f is not None:
+                out.append(f)
+        return out

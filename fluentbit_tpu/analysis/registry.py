@@ -104,6 +104,26 @@ GUARDS: Tuple[GuardEntry, ...] = (
              "(occupancy, shed readmission): same discipline as "
              "core/engine.py's own entry",
     ),
+    # -- fbtpu-qos: tenant registry + fair dispatch queue --
+    GuardEntry(
+        "fluentbit_tpu/core/qos.py", "_lock",
+        ("_tenants", "_queue"),
+        note="qos plane state: ingest threads resolve tenants while "
+             "the engine loop / flush_now callers pop the fair queue "
+             "and reload transactions re-declare contracts",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/qos.py", "_ingest_lock",
+        ("_backlog", "_task_map"),
+        note="engine ingest-path state written by the reload "
+             "generation swap (removed-input drain, list swap): same "
+             "discipline as core/engine.py's own entry",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/qos.py", "ingest_lock", ("pool",),
+        note="per-input chunk pools drained by the reload swap race "
+             "parallel raw-path appends without the input's lock",
+    ),
     # -- metrics: counters incremented from every thread family --
     GuardEntry(
         "fluentbit_tpu/core/metrics.py", "_lock",

@@ -47,6 +47,8 @@ class Chunk:
         "routes_mask",
         "route_names",
         "in_name",
+        "qos_tenant",
+        "priority",
     )
 
     def __init__(self, tag: str, event_type: str = EVENT_TYPE_LOGS, in_name: str = ""):
@@ -68,6 +70,12 @@ class Chunk:
         # are meaningless across a config change/restart)
         self.route_names = None
         self.in_name = in_name
+        # fbtpu-qos stamps (core/qos.py): tenant + priority class are
+        # assigned at first dispatch enqueue and survive shed/readmit
+        # cycles; priority additionally survives a restart (storage
+        # persists it in the header pad byte)
+        self.qos_tenant = None
+        self.priority = None
 
     @property
     def size(self) -> int:
@@ -174,6 +182,21 @@ class ChunkPool:
                 self.total_bytes -= c.size
                 dropped.append(c)
         return dropped
+
+    def rotate_conditional(self) -> None:
+        """Close every ACTIVE conditionally-routed chunk (hot reload:
+        the outputs list is about to change, and the active map keys
+        on the ingest-time routes_mask — a post-swap append computing
+        the same mask value against the NEW outputs must not merge
+        into a chunk whose persisted route_names still name the old
+        generation). Closed chunks flush under their stamped names;
+        fresh appends open fresh chunks with fresh names."""
+        for key in [k for k, c in self._active.items()
+                    if c.routes_mask]:
+            c = self._active.pop(key)
+            if c.records > 0:
+                c.locked = True
+                self._ready.append(c)
 
     def drain(self) -> List[Chunk]:
         """Take all flushable chunks (locked + currently active non-empty)."""

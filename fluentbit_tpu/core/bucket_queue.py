@@ -1,4 +1,5 @@
-"""Priority bucket queue + engine event priorities.
+"""Priority bucket queue + engine event priorities + the
+deficit-weighted-round-robin fair layer (fbtpu-qos).
 
 Reference: include/fluent-bit/flb_bucket_queue.h (N FIFO buckets, min
 priority served first) and flb_engine_macros.h:60-79 — 8 priorities,
@@ -7,18 +8,31 @@ The engine enqueues its ready callbacks here and drains in priority
 order, so a retry timer firing during a flush burst jumps the line the
 same way the reference's bucket queue serves FLB_ENGINE_PRIORITY_CB_SCHED
 events before FLB_ENGINE_PRIORITY_FLUSH ones.
+
+:class:`DeficitFairQueue` extends the same priority-bucket shape with a
+per-bucket DWRR ring over tenant flows (Shreedhar & Varghese DRR):
+strict priority across classes, weighted fairness within a class. The
+engine's chunk dispatch drains through it (core/qos.py) so a flooding
+tenant saturates only its own weight share of dispatch slots. The
+reference has no equivalent — flb_engine_dispatch walks inputs in
+configuration order, which is exactly the starvation fbtpu-qos removes.
 """
 
 from __future__ import annotations
 
-from collections import deque
-from typing import Any, Iterator, List
+from collections import OrderedDict, deque
+from typing import Any, Iterator, List, Optional, Tuple
 
 PRIORITY_COUNT = 8
 PRIORITY_TOP = 0                      # scheduler / timers / shutdown
 PRIORITY_NETWORK = 1
 PRIORITY_FLUSH = PRIORITY_NETWORK + 1
 PRIORITY_DEFAULT = PRIORITY_COUNT - 1
+
+#: QoS priority classes (0 = highest). Same width as the engine's
+#: event priorities so one mental model covers both; the default class
+#: a tenant lands in is configuration (`qos.default_priority`).
+QOS_CLASS_COUNT = PRIORITY_COUNT
 
 
 class BucketQueue:
@@ -49,6 +63,146 @@ class BucketQueue:
     def drain(self) -> Iterator[Any]:
         while self._size:
             yield self.pop()
+
+    def __len__(self) -> int:
+        return self._size
+
+    def __bool__(self) -> bool:
+        return self._size > 0
+
+
+class _Flow:
+    """One tenant's FIFO within a priority bucket + its DWRR state."""
+
+    __slots__ = ("name", "weight", "deficit", "items", "cost")
+
+    def __init__(self, name: str, weight: float):
+        self.name = name
+        self.weight = weight
+        self.deficit = 0.0
+        self.items: deque = deque()  # (cost, item)
+        self.cost = 0.0              # queued bytes (gauge feed)
+
+
+class DeficitFairQueue:
+    """Deficit-weighted round-robin over per-tenant flows inside
+    priority buckets.
+
+    - **strict priority across classes**: :meth:`pop` always serves the
+      lowest-numbered non-empty class; a class drains completely before
+      the next is touched (the shed-by-priority contract's dispatch
+      twin).
+    - **DWRR within a class**: each backlogged flow accumulates
+      ``quantum × weight`` deficit per round-robin visit and may send
+      while its head cost fits the deficit. Standard DRR bound: over
+      any backlogged window of R rounds a flow sends at most
+      ``R·quantum·weight + max_cost`` — never more than one max-cost
+      item over its weight share per round (pinned by the property
+      test in tests/test_qos.py).
+    - **starvation floor**: effective weight is
+      ``max(weight, weight_floor)``, so a zero-weight tenant still
+      accumulates deficit and drains at the floor rate instead of
+      starving forever.
+
+    Deficits persist while a flow is backlogged and reset when it goes
+    idle (DRR's anti-burst rule: an idle flow cannot bank credit).
+    Not thread-safe — the owner (core/qos.py) serializes access.
+    """
+
+    def __init__(self, quantum: float, weight_floor: float = 0.05,
+                 classes: int = QOS_CLASS_COUNT):
+        # every chunk costs >= 1, so a non-positive quantum would add
+        # zero deficit per visit and spin pop_ex forever
+        self.quantum = max(1.0, float(quantum))
+        self.weight_floor = max(1e-6, float(weight_floor))
+        self.classes = classes
+        # class → OrderedDict[name, _Flow]: the OrderedDict IS the
+        # round-robin ring (popped flows re-append on re-arrival)
+        self._rings: List["OrderedDict[str, _Flow]"] = [
+            OrderedDict() for _ in range(classes)
+        ]
+        # per-class: has the ring's HEAD flow received its one
+        # per-visit quantum grant yet? (DRR grants once per visit; a
+        # flow serves until its deficit runs dry, then the pointer
+        # advances — without this flag a flow whose quantum covers its
+        # head cost would re-grant itself forever and monopolize)
+        self._granted: List[bool] = [False] * classes
+        self._size = 0
+
+    def _clamp(self, cls: int) -> int:
+        return min(max(int(cls), 0), self.classes - 1)
+
+    def push(self, cls: int, tenant: str, weight: float, cost: float,
+             item: Any) -> None:
+        ring = self._rings[self._clamp(cls)]
+        flow = ring.get(tenant)
+        if flow is None:
+            flow = _Flow(tenant, weight)
+            ring[tenant] = flow
+        flow.weight = weight  # weights may be re-declared live (reload)
+        flow.items.append((max(0.0, float(cost)), item))
+        flow.cost += max(0.0, float(cost))
+        self._size += 1
+
+    def pop(self) -> Optional[Any]:
+        """Serve one item in strict-priority + DWRR order; None when
+        empty."""
+        got = self.pop_ex()
+        return got[1] if got is not None else None
+
+    def pop_ex(self) -> Optional[Tuple[str, Any]]:
+        """:meth:`pop` + the serving tenant name (metrics feed)."""
+        for cls, ring in enumerate(self._rings):
+            if not ring:
+                continue
+            # starvation-free: every visit adds quantum·max(weight,
+            # floor) > 0 deficit, so any head item is eventually
+            # affordable after finitely many rotations
+            while True:
+                name, flow = next(iter(ring.items()))
+                if not self._granted[cls]:
+                    # arrival at this flow: its one per-visit grant
+                    flow.deficit += self.quantum * max(flow.weight,
+                                                       self.weight_floor)
+                    self._granted[cls] = True
+                cost, item = flow.items[0]
+                if flow.deficit < cost:
+                    # deficit exhausted for this visit: the pointer
+                    # advances; the flow carries its remaining deficit
+                    # into the next round
+                    ring.move_to_end(name)
+                    self._granted[cls] = False
+                    continue
+                flow.items.popleft()
+                flow.deficit -= cost
+                flow.cost -= cost
+                self._size -= 1
+                if not flow.items:
+                    # idle flows bank no credit (DRR's anti-burst rule)
+                    flow.deficit = 0.0
+                    del ring[name]
+                    self._granted[cls] = False
+                return (name, item)
+        return None
+
+    def drain(self) -> List[Any]:
+        """Take everything in priority+fair order (task-map-full
+        parking, shutdown readmission)."""
+        out = []
+        while True:
+            got = self.pop()
+            if got is None:
+                return out
+            out.append(got)
+
+    def pending(self) -> "OrderedDict[Tuple[int, str], Tuple[int, float]]":
+        """(class, tenant) → (queued items, queued cost) snapshot."""
+        out: "OrderedDict[Tuple[int, str], Tuple[int, float]]" = \
+            OrderedDict()
+        for cls, ring in enumerate(self._rings):
+            for name, flow in ring.items():
+                out[(cls, name)] = (len(flow.items), flow.cost)
+        return out
 
     def __len__(self) -> int:
         return self._size

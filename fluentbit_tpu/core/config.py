@@ -203,6 +203,9 @@ CORE_INSTANCE_KEYS = {
     "proxy",  # HTTP-based outputs: http:// forward proxy
     "route_condition",  # ingest-time conditional routing (outputs)
     "flush_timeout",  # fbtpu-guard per-output flush deadline (outputs)
+    # fbtpu-qos tenant membership + contract (inputs; core/qos.py)
+    "tenant", "tenant.weight", "tenant.priority", "tenant.rate",
+    "tenant.burst", "tenant.overflow",
     "net.keepalive", "net.keepalive_idle_timeout",
     "net.keepalive_max_recycle", "net.max_worker_connections",
 }
@@ -242,6 +245,18 @@ class ServiceConfig:
     guard_stall_after: float = 30.0      # heartbeat age → "stalled"
     guard_leak_grace: float = 5.0        # soft-kill → leaked-thread count
     guard_worker_start_timeout: float = 10.0  # worker pool startup bound
+    # fbtpu-qos (core/qos.py — no reference equivalent). qos_enable
+    # gates ADMISSION QUOTAS only (QOS.md): fair dispatch runs
+    # regardless (bit-compatible FIFO with a single default tenant)
+    # and shed-by-priority keys off tenants spanning >1 class
+    qos_enable: bool = True
+    qos_quantum: int = 2 * 1024 * 1024   # DWRR bytes/round per weight
+    qos_weight_floor: float = 0.05       # zero-weight starvation floor
+    qos_default_weight: float = 1.0      # tenants that declare none
+    qos_default_priority: int = 4        # 0 = highest of 8 classes
+    qos_cycle_budget: int = 0            # bytes dispatched per flush
+    #                                      cycle (0 = unlimited)
+    qos_shed_hysteresis: float = 0.75    # readmit below thr × this
     # TPU execution options (new — no reference equivalent)
     tpu_enable: bool = True
     tpu_batch_records: int = 8192
@@ -279,6 +294,13 @@ class ServiceConfig:
         "guard.leak_grace": ("guard_leak_grace", parse_time),
         "guard.worker_start_timeout":
             ("guard_worker_start_timeout", parse_time),
+        "qos.enable": ("qos_enable", parse_bool),
+        "qos.quantum": ("qos_quantum", parse_size),
+        "qos.weight_floor": ("qos_weight_floor", float),
+        "qos.default_weight": ("qos_default_weight", float),
+        "qos.default_priority": ("qos_default_priority", int),
+        "qos.cycle_budget": ("qos_cycle_budget", parse_size),
+        "qos.shed_hysteresis": ("qos_shed_hysteresis", float),
         "tpu.enable": ("tpu_enable", parse_bool),
         "tpu.batch_records": ("tpu_batch_records", int),
         "tpu.max_record_len": ("tpu_max_record_len", int),

@@ -42,6 +42,12 @@ from .scheduler import backoff_full_jitter
 
 log = logging.getLogger("flb.engine")
 
+# _dispatch_chunk outcomes: PARKED must stay falsy (callers gate the
+# park-and-break path on `not rc`)
+PARKED = 0      # task map full — chunk goes back to the backlog
+DISPATCHED = 1  # task spawned, a task-map slot was consumed
+ABSORBED = 2    # handled without a slot (guard-shed / no live routes)
+
 _task_ids = itertools.count(1)
 
 
@@ -107,6 +113,26 @@ class Engine:
         self._notification_subs: List = []
         self.started_at: float = 0.0
         self.reload_count = 0
+        # configuration generation (fbtpu-qos): bumped by every
+        # ReloadTxn.commit in the same ingest-lock critical section
+        # that swaps the instance lists, so generation / reload_count /
+        # list contents always read consistently
+        self.generation = 0
+        # outputs removed by hot reload: their in-flight tasks hold
+        # direct references and finish normally; stop() reaps their
+        # worker pools and runs their exit callbacks
+        self._retired_outputs: List[OutputInstance] = []
+        # canonical names freed by hot-reload removals (and trace-tap
+        # teardown), per instance kind: numbering must never hand a
+        # fresh instance a dead one's name — a guard-shed chunk's
+        # persisted route_names or a dashboard's metric series would
+        # silently re-bind to the unrelated newcomer
+        self._retired_names: Dict[str, set] = {}
+        # serializes whole hot-reload transactions (core/qos.py
+        # ReloadTxn.commit): two concurrent commits would each write
+        # back instance lists derived from their own pre-build
+        # snapshot, silently dropping the other's changes
+        self._reload_lock = threading.Lock()
         self.admin_server = None
         self.reload_callback = None  # wired by the CLI for /api/v2/reload
 
@@ -118,6 +144,12 @@ class Engine:
         from .guard import Guard
 
         self.guard = Guard(self)
+        # fbtpu-qos: tenant admission, weighted-fair dispatch, hot
+        # reload (core/qos.py). Ingest pays one tenant lookup + counter
+        # per append; dispatch order comes from the fair queue.
+        from .qos import Qos
+
+        self.qos = Qos(self)
 
     # ------------------------------------------------------------------
     # metrics (names mirror the reference's fluentbit_* families)
@@ -179,25 +211,49 @@ class Engine:
     # ------------------------------------------------------------------
 
     def _number_instance(self, ins, peers) -> None:
+        # count-of-peers matches the reference's append-only numbering,
+        # but a hot reload can REMOVE lib.0 while lib.1 survives — a
+        # later add would count one peer and collide on lib.1. Bump
+        # past taken names (never reuse a retired name: a fresh
+        # instance must not inherit a dead one's metric series)
         n = sum(1 for p in peers if p.plugin.name == ins.plugin.name)
+        taken = {p.name for p in peers} \
+            | self._retired_names.get(type(ins).__name__, set())
+        while f"{ins.plugin.name}.{n}" in taken:
+            n += 1
         ins.name = f"{ins.plugin.name}.{n}"
         pool = getattr(ins, "pool", None)
         if pool is not None:
             pool.in_name = ins.name
 
-    def input(self, name: str, **props) -> InputInstance:
-        ins = self.registry.create_input(name)
-        self._number_instance(ins, self.inputs)
+    def _make_instance(self, create, name: str, props, peers):
+        """create + number + set props — shared by the config-time
+        builders and the hot-reload build phase (core/qos.py) so the
+        construction sequence cannot drift between them."""
+        ins = create(name)
+        self._number_instance(ins, peers)
         for k, v in props.items():
             ins.set(k, v)
+        return ins
+
+    def _init_instance(self, ins) -> None:
+        """configure + plugin.init + mark initialized — THE live-init
+        sequence. start(), hidden_input and hot-reload builds all go
+        through here: a future post-init step added in one place
+        cannot silently skip the others."""
+        ins.configure()
+        ins.plugin.init(ins, self)
+        ins._initialized = True
+
+    def input(self, name: str, **props) -> InputInstance:
+        ins = self._make_instance(self.registry.create_input, name,
+                                  props, self.inputs)
         self.inputs.append(ins)
         return ins
 
     def filter(self, name: str, **props) -> FilterInstance:
-        ins = self.registry.create_filter(name)
-        self._number_instance(ins, self.filters)
-        for k, v in props.items():
-            ins.set(k, v)
+        ins = self._make_instance(self.registry.create_filter, name,
+                                  props, self.filters)
         # hidden flux-SQL filters stand in for the stream processor,
         # which runs POST-filter at ingest — user filters registered
         # later (config files apply [STREAM_TASK] before [FILTER])
@@ -211,10 +267,8 @@ class Engine:
         return ins
 
     def output(self, name: str, **props) -> OutputInstance:
-        ins = self.registry.create_output(name)
-        self._number_instance(ins, self.outputs)
-        for k, v in props.items():
-            ins.set(k, v)
+        ins = self._make_instance(self.registry.create_output, name,
+                                  props, self.outputs)
         self.outputs.append(ins)
         return ins
 
@@ -321,7 +375,7 @@ class Engine:
         if target.name in self.traces:  # canonical key: dedup aliases
             return True
         emitter = self.hidden_input(
-            "emitter", alias=f"trace_emitter_{target.name}"
+            "emitter", owner=target, alias=f"trace_emitter_{target.name}"
         )
         self.traces[target.name] = {
             "input": target,
@@ -343,11 +397,14 @@ class Engine:
         if ctx is None:
             return False
         # drop the hidden emitter too — repeated enable/disable cycles
-        # must not accumulate dead inputs
-        try:
-            self.inputs.remove(ctx["emitter_instance"])
-        except ValueError:
-            pass
+        # must not accumulate dead inputs (COW swap: concurrent
+        # iterators keep their snapshot)
+        with self._ingest_lock:
+            self.inputs = [i for i in self.inputs
+                           if i is not ctx["emitter_instance"]]
+            emitter_ins = ctx["emitter_instance"]
+            self._retired_names.setdefault(
+                type(emitter_ins).__name__, set()).add(emitter_ins.name)
         return True
 
     def _trace_ctx(self, ins) -> Optional[dict]:
@@ -370,37 +427,68 @@ class Engine:
             log.exception("chunk trace emit failed")
 
     def ensure_collector(self, ins: InputInstance) -> None:
-        """Schedule a collector for an input created after start()
-        (startup normally does this in _main)."""
+        """Schedule a collector for an input created after start() —
+        the SAME dispatch as _main's startup pass: threaded interval
+        collectors get their own OS thread (a blocking collect() must
+        not stall the flush loop), loop collectors an asyncio task,
+        and push servers (server_task_needed) their listener task —
+        otherwise a hot-reload-added tcp/http input would never start
+        listening."""
         if not self.running or self.loop is None:
+            return
+        plugin = ins.plugin
+        if plugin.collect_interval is not None and ins.threaded:
+            if getattr(ins, "collector_thread", None) is None:
+                ins.collector_thread = threading.Thread(
+                    target=self._collector_thread, args=(ins,),
+                    daemon=True,
+                    name=f"flb-in-{ins.display_name}",
+                )
+                ins.collector_thread.start()
             return
 
         def _create():
-            if ins.collector_task is None and \
-                    ins.plugin.collect_interval is not None:
+            if ins.collector_task is not None:
+                return
+            if plugin.collect_interval is not None:
                 ins.collector_task = asyncio.ensure_future(
-                    self._collector(ins)
-                )
+                    self._collector(ins))
+            elif getattr(plugin, "server_task_needed", False):
+                ins.collector_task = asyncio.ensure_future(
+                    plugin.start_server(self))
 
         try:
             self.loop.call_soon_threadsafe(_create)
         except RuntimeError:
             pass
 
-    def hidden_input(self, name: str, **props) -> InputInstance:
+    def hidden_input(self, name: str, owner=None,
+                     **props) -> InputInstance:
         """Create + immediately initialize an internal input instance —
         the hidden ``emitter`` pattern used by rewrite_tag /
         log_to_metrics / chunk traces (reference
         plugins/filter_rewrite_tag/rewrite_tag.c:245-260). Safe to call
-        from a plugin's init while the engine is starting."""
-        ins = self.registry.create_input(name)
-        self._number_instance(ins, self.inputs)
-        for k, v in props.items():
-            ins.set(k, v)
-        self.inputs.append(ins)
-        ins.configure()
-        ins.plugin.init(ins, self)
-        ins._initialized = True
+        from a plugin's init while the engine is starting.
+
+        ``owner`` ties the hidden input's lifecycle to the instance
+        whose init created it: when a hot reload removes/replaces that
+        owner, the emitter is unlinked with it (core/qos.py ReloadTxn)
+        instead of leaking one orphaned input per reload."""
+        ins = self._make_instance(self.registry.create_input, name,
+                                  props, self.inputs)
+        ins._hidden_owner = owner
+        # internal replay is never re-metered (core/qos.py admit):
+        # these bytes passed tenant admission at their ORIGINAL ingest
+        # point, and the re-emit callers (rewrite_tag / multiline /
+        # trace taps) are fire-and-forget — a DEFER here would silently
+        # drop already-admitted data while counting it "deferred"
+        ins.qos_exempt = True
+        # COW list swap: hidden inputs appear at RUNTIME (sp emitters,
+        # trace taps, rewrite_tag emitters during a hot reload's build
+        # phase) while other threads iterate snapshot references
+        with self._ingest_lock:
+            self.inputs = self.inputs + [ins]
+        self._init_instance(ins)
         return ins
 
     # ------------------------------------------------------------------
@@ -426,37 +514,23 @@ class Engine:
         for ins in self.customs:
             if getattr(ins, "_initialized", False):
                 continue
-            ins.configure()
-            ins.plugin.init(ins, self)
-            ins._initialized = True
+            self._init_instance(ins)
         for ins in self.inputs + self.filters + self.outputs:
             if getattr(ins, "_initialized", False):
                 continue  # hidden inputs are initialized at creation
-            ins.configure()
-            ins.plugin.init(ins, self)
+            self._init_instance(ins)
+        # fbtpu-qos: register every tenant contract EAGERLY, in config
+        # order ("last declaration wins") — lazy first-append
+        # registration would let input A flood unmetered before
+        # sibling input B (carrying the shared tenant's rate) ever
+        # ingests
+        for ins in self.inputs:
+            self.qos.tenant_for_input(ins)
         # output worker thread pools (flb_output_thread_pool_create,
         # src/flb_output_thread.c:472): flush callbacks leave the
         # engine loop when `workers` is set
-        from .output_thread import OutputWorkerPool
-
         for out in self.outputs:
-            if out.workers > 0 and out.worker_pool is None \
-                    and not out.plugin.synchronous:
-                pool = OutputWorkerPool(
-                    out.display_name, out.workers, out.plugin,
-                    start_timeout=self.service.guard_worker_start_timeout)
-                if pool.failed:
-                    # a worker that never starts must not leave submit()
-                    # targeting a dead loop: fail the output over to
-                    # inline flushes on the engine loop
-                    log.error(
-                        "output %s: worker pool startup failed — "
-                        "failing over to inline flush", out.display_name)
-                    self.guard.m_worker_start_fail.inc(
-                        1, (out.display_name,))
-                    pool.stop()
-                else:
-                    out.worker_pool = pool
+            self._ensure_worker_pool(out)
         self.started_at = time.time()
         self.guard.heartbeat = time.time()
         # failpoint trigger → metric bridge (unarmed plane: the listener
@@ -468,6 +542,41 @@ class Engine:
         self._thread.start()
         if not self._started.wait(timeout=10):
             raise RuntimeError("engine failed to start")
+
+    def _ensure_worker_pool(self, out: OutputInstance) -> None:
+        """Build the output's worker pool when configured (start() and
+        hot-reload-added outputs share this path)."""
+        from .output_thread import OutputWorkerPool
+
+        if out.workers <= 0 or out.worker_pool is not None \
+                or out.plugin.synchronous:
+            return
+        pool = OutputWorkerPool(
+            out.display_name, out.workers, out.plugin,
+            start_timeout=self.service.guard_worker_start_timeout)
+        if pool.failed:
+            # a worker that never starts must not leave submit()
+            # targeting a dead loop: fail the output over to
+            # inline flushes on the engine loop
+            log.error(
+                "output %s: worker pool startup failed — "
+                "failing over to inline flush", out.display_name)
+            self.guard.m_worker_start_fail.inc(
+                1, (out.display_name,))
+            pool.stop()
+        else:
+            out.worker_pool = pool
+
+    def reload_txn(self):
+        """Open a hot-reload transaction (fbtpu-qos, core/qos.py):
+        stage add/remove/replace of inputs, filters, outputs and
+        parsers, then ``commit()`` swaps the configuration atomically
+        behind a generation bump — without dropping in-flight chunks.
+        Embedders wire ``self.reload_callback`` to a function that
+        builds and commits one of these for POST /api/v2/reload."""
+        from .qos import ReloadTxn
+
+        return ReloadTxn(self)
 
     def _run(self) -> None:
         self.loop = asyncio.new_event_loop()
@@ -592,7 +701,9 @@ class Engine:
     async def _collector(self, ins: InputInstance) -> None:
         """Interval collector (flb_input_set_collector_time)."""
         interval = ins.plugin.collect_interval or 1.0
-        while True:
+        # hot reload removes inputs mid-run: the flag stops collection
+        # even when the cancel races a collect in flight
+        while not ins.removed:
             try:
                 if not ins.paused:
                     ins.plugin.collect(self)
@@ -607,7 +718,7 @@ class Engine:
         encoding — runs off the engine loop so slow inputs never stall
         flushes, and independent inputs collect in parallel."""
         interval = ins.plugin.collect_interval or 1.0
-        while not self._stopping:
+        while not self._stopping and not ins.removed:
             try:
                 if not ins.paused:
                     ins.plugin.collect(self)
@@ -615,6 +726,21 @@ class Engine:
                 log.exception("input %s collect failed", ins.display_name)
             if self._stop_event.wait(interval):  # instant stop wakeup
                 break
+        if ins.removed:
+            # hot reload removed this input: this thread owns the
+            # plugin's I/O, so exiting HERE guarantees no collect() is
+            # in flight when files/sockets close (ReloadTxn skips the
+            # inline exit while this thread is alive or this flag is
+            # set — flag BEFORE exit so the reload's liveness check
+            # can never observe dead-thread-and-unset-flag after we
+            # exited). Engine stop leaves removed=False and keeps the
+            # stop()-path exit.
+            ins._exited_by_collector = True
+            try:
+                ins.plugin.exit()
+            except Exception:
+                log.exception("removed input %s exit failed",
+                              ins.display_name)
 
     def request_stop(self) -> None:
         """Ask the engine loop to shut down gracefully (the in-pipeline
@@ -628,17 +754,33 @@ class Engine:
         if self._thread is None:
             return
         self._stopping = True
+        # barrier: an in-flight hot-reload commit (HTTP thread) may be
+        # about to retire outputs — wait for it to finish so its
+        # retired list is visible to the reap below; commits arriving
+        # AFTER this point see _stopping under the same lock and
+        # refuse (core/qos.py ReloadTxn.commit), so none can slip in
+        # behind the reap and leak un-exited pools
+        with self._reload_lock:
+            pass
         self._thread.join(timeout=self.service.grace + 10)
         if self._thread.is_alive():
             # a silently-swallowed join timeout leaves a wedged engine
             # undiagnosable: say so, and dump every thread's stack
             self._dump_stuck_shutdown()
         self._thread = None
-        for out in self.outputs:
+        # hot-reload-retired outputs kept their pools alive for
+        # in-flight flushes; the drain above has settled them. Swap
+        # under the lock: a reload commit on another thread extends
+        # this list under _ingest_lock, and an unlocked swap racing it
+        # would strand its outputs on a list nobody reaps
+        with self._ingest_lock:
+            retired, self._retired_outputs = self._retired_outputs, []
+        for out in self.outputs + retired:
             if out.worker_pool is not None:
                 out.worker_pool.stop()
                 out.worker_pool = None
-        for ins in self.inputs + self.filters + self.outputs + self.customs:
+        for ins in self.inputs + self.filters + self.outputs \
+                + retired + self.customs:
             try:
                 ins.plugin.exit()
             except Exception:
@@ -693,6 +835,49 @@ class Engine:
         """
         tag = tag or ins.tag or ins.plugin.name
 
+        # backpressure FIRST (mem_buf_limit, src/flb_input.c:157,740-746;
+        # storage.pause_on_chunks_overlimit, :169) — pool counters are
+        # snapshotted under the input's lock (parallel raw-path appends
+        # mutate them concurrently); the pause flip itself is atomic in
+        # set_paused. Runs before tenant admission so a rejected append
+        # does NOT charge the tenant's token bucket: the caller retries
+        # the same bytes, and charging every retry would drain quota on
+        # data that was never ingested
+        with ins.ingest_lock:
+            over = ins.storage_type != "memrb" and ((
+                ins.mem_buf_limit
+                and ins.pool.pending_bytes >= ins.mem_buf_limit
+            ) or (
+                getattr(ins, "pause_on_chunks_overlimit", False)
+                and ins.pool.pending_chunks
+                >= self.service.storage_max_chunks_up
+            ))
+        if over:
+            ins.set_paused(True)
+            return -1
+
+        # fbtpu-qos tenant admission (core/qos.py): every ingest entry
+        # point meters the append against its tenant's token bucket
+        # BEFORE any decode/filter work — over quota, DEFER (1) is the
+        # reference's backpressure verdict (-1, caller retries) and
+        # SHED (2) drops the append with per-tenant accounting
+        verdict = self.qos.admit(ins, len(data))
+        if verdict:
+            if verdict == 1:
+                # DEFER uses the SAME pause contract as mem_buf_limit:
+                # collector/server inputs ignore -1 and have already
+                # consumed their source, so without the pause every
+                # over-quota read would be silently dropped while
+                # counted "deferred". Paused collectors stop consuming;
+                # housekeeping resumes once the bucket can admit this
+                # append's size again (resuming on a single token
+                # would churn: consume → defer-drop → re-pause)
+                ins._qos_defer_cost = len(data)
+                ins.paused_by_qos = True
+                ins.set_paused(True)
+                return -1
+            return 0
+
         # memrb storage: a ring buffer — over the limit, the OLDEST
         # buffered chunks are evicted with drop metrics instead of
         # pausing the input (src/flb_input_chunk.c:2936-2966)
@@ -710,24 +895,6 @@ class Engine:
                     1, (ins.display_name,))
                 self.m_memrb_dropped_bytes.inc(
                     c.size, (ins.display_name,))
-
-        # backpressure (mem_buf_limit, src/flb_input.c:157,740-746;
-        # storage.pause_on_chunks_overlimit, :169) — pool counters are
-        # snapshotted under the input's lock (parallel raw-path appends
-        # mutate them concurrently); the pause flip itself is atomic in
-        # set_paused
-        with ins.ingest_lock:
-            over = ins.storage_type != "memrb" and ((
-                ins.mem_buf_limit
-                and ins.pool.pending_bytes >= ins.mem_buf_limit
-            ) or (
-                getattr(ins, "pause_on_chunks_overlimit", False)
-                and ins.pool.pending_chunks
-                >= self.service.storage_max_chunks_up
-            ))
-        if over:
-            ins.set_paused(True)
-            return -1
 
         # ---- raw fast path (VERDICT r1: no decode-per-append) ----
         # When nothing on the chain needs decoded events — no
@@ -796,6 +963,11 @@ class Engine:
     def _log_append_decoded(self, ins, tag, data, n_records, cond_routing):
         """The decode branch of input_log_append (runs under the global
         ingest lock, with _ingest_src already pointing at ``ins``)."""
+        if ins.removed:
+            # hot reload unlinked this input (see _ingest_raw): refuse
+            # so the caller never acks into the orphaned pool
+            self.qos.refund(ins, len(data))
+            return 0
         events = decode_events(data)
         if n_records is None:
             n_records = len(events)
@@ -900,8 +1072,18 @@ class Engine:
         """Non-log telemetry append (metrics/traces/profiles): no filter
         chain (reference typed appends, src/flb_input_metric.c etc.)."""
         tag = tag or ins.tag or ins.plugin.name
-        self.m_in_records.inc(n_records, (ins.display_name,))
-        self.m_in_bytes.inc(len(data), (ins.display_name,))
+        in_bytes = len(data)  # pre-processor size: what admit charged
+        # same tenant admission contract as input_log_append
+        verdict = self.qos.admit(ins, in_bytes)
+        if verdict:
+            if verdict == 1:
+                # DEFER pauses (see input_log_append): fire-and-forget
+                # typed appenders must stop consuming until refill
+                ins._qos_defer_cost = in_bytes
+                ins.paused_by_qos = True
+                ins.set_paused(True)
+                return -1
+            return 0
         with self._ingest_lock:
             # input-side metrics/traces processors (flb_processor_run on
             # the typed append path)
@@ -911,9 +1093,23 @@ class Engine:
                 data, n_records = self._run_traces_processors(
                     ins.processors, data, tag, n_records)
                 if not data:
-                    # all spans buffered (tail sampling) or dropped
+                    # all spans buffered (tail sampling) or dropped —
+                    # consumed, so counted as ingested
+                    self.m_in_records.inc(n_records, (ins.display_name,))
+                    self.m_in_bytes.inc(in_bytes, (ins.display_name,))
                     return n_records
             with ins.ingest_lock:
+                if ins.removed:
+                    # hot reload unlinked this input: its pool was
+                    # drained and will never be visited again — refuse
+                    # (un-acked) instead of appending into the orphan
+                    self.qos.refund(ins, in_bytes)
+                    return 0
+                # counted only once the append actually lands (a
+                # removed-input refusal retried by the caller must not
+                # double-count)
+                self.m_in_records.inc(n_records, (ins.display_name,))
+                self.m_in_bytes.inc(in_bytes, (ins.display_name,))
                 chunk = ins.pool.append(tag, data, n_records, event_type)
                 if self.storage is not None and ins.storage_type == "filesystem":
                     self.storage.write_through(chunk, data)
@@ -927,6 +1123,14 @@ class Engine:
 
         from .chunk_batch import RawChunk
 
+        if ins.removed:
+            # hot reload unlinked this input while we waited on the
+            # ingest lock: its pool is drained and orphaned — refuse
+            # (0 ingested, so the caller never acks). ReloadTxn sets
+            # the flag under BOTH locks, so whichever lock this path
+            # holds serializes against the swap.
+            self.qos.refund(ins, len(data))
+            return 0
         in_bytes = len(data)
         # n may stay None until the FIRST raw filter discovers it (the
         # fused grep walk returns the record count as a third element),
@@ -1182,8 +1386,12 @@ class Engine:
             self.m_uptime.set(time.time() - self.started_at)
         # guard watchdog rides this (the housekeeping timer): heartbeat,
         # flush-deadline scan, occupancy gauges, shed/readmit — never a
-        # per-record cost (core/guard.py)
+        # per-record cost (core/guard.py); qos queue gauges ride the
+        # same timer
         self.guard.housekeeping()
+        self.qos.update_gauges()
+        self.qos.resume_paused(self.inputs)
+        self._reap_retired_outputs()
         with self._ingest_lock:
             chunks: List[tuple] = []
             if self._backlog:  # recovered chunks re-dispatch first
@@ -1201,7 +1409,12 @@ class Engine:
                     chunks.append((ins, chunk))
                 # resume paused inputs once the buffer drains (pool
                 # counters read under the input's lock; flip is atomic)
-                if ins.paused:
+                # — but NOT quota pauses: the pool draining says
+                # nothing about the token bucket, and resuming early
+                # would let the collector consume reads the very next
+                # DEFER drops (Qos.resume_paused owns that resume)
+                if ins.paused and not getattr(ins, "paused_by_qos",
+                                              False):
                     with ins.ingest_lock:
                         drained_ok = (
                             not ins.mem_buf_limit
@@ -1232,71 +1445,156 @@ class Engine:
                 with self._ingest_lock:
                     self._backlog.extend(c for _i, c in chunks)
                 return
-        for ci, (ins, chunk) in enumerate(chunks):
-            if chunk.routes_mask:
-                # conditionally-split chunk: the ingest-time bitmask IS
-                # the route set (tag matching already folded in)
-                routes = [
-                    o for i, o in enumerate(self.outputs)
-                    if (chunk.routes_mask >> i) & 1
-                    and chunk.event_type in o.plugin.event_types
-                ]
-            elif chunk.route_names is not None:
-                # recovered from disk: resolve by output NAME (bit
-                # positions do not survive a config change)
-                routes = [
-                    o for o in self.outputs
-                    if o.display_name in chunk.route_names
-                    and chunk.event_type in o.plugin.event_types
-                ]
-            else:
-                routes = [
-                    o for o in self.outputs
-                    if o.route.matches(chunk.tag)
-                    and chunk.event_type in o.plugin.event_types
-                ]
-            if not routes:
-                if self.storage is not None:
-                    self.storage.delete(chunk)
-                continue
-            # load shedding (fbtpu-guard): above the occupancy
-            # watermark, a chunk whose EVERY route is behind an open
-            # breaker is spilled instead of taking a task slot — the
-            # slots stay available for healthy routes
-            if self.guard.maybe_shed(chunk, routes):
-                continue
-            # bounded task id map (flb_task_map_get_task_id,
-            # src/flb_task.c:542): when every slot is in use the chunk
-            # stays in its pool and is re-dispatched next flush cycle —
-            # the reference's "task_id exhausted" stance. The map is
-            # mutated here (engine loop or flush_now's caller thread)
-            # and in _task_unref (loop callbacks, sync-fallback flush on
-            # any thread) — both hold the ingest lock.
-            task = None
-            with self._ingest_lock:
-                if len(self._task_map) >= self.service.task_map_size:
-                    now = time.time()
-                    if now - self._task_map_warned > 5.0:
-                        self._task_map_warned = now
-                        log.warning(
-                            "task map full (%d tasks in flight) — chunk "
-                            "dispatch paused until slots free",
-                            len(self._task_map))
-                    # chunks were already drained from their pools: park
-                    # them on the backlog so the next cycle re-dispatches
-                    self._backlog.extend(c for _i, c in chunks[ci:])
-                else:
-                    task = Task(chunk, routes)
-                    # fully referenced BEFORE the first spawn: a route
-                    # completing synchronously must not see users hit 0
-                    # (and free the slot / delete the chunk) while its
-                    # siblings are still being spawned
-                    task.users = len(routes)
-                    self._task_map[task.id] = task
-            if task is None:
+        # fbtpu-qos weighted-fair dispatch (core/qos.py): ready chunks
+        # drain through per-tenant bucket queues — strict priority
+        # across classes, deficit-weighted round robin across tenants
+        # within a class — instead of input configuration order. When
+        # dispatch capacity is scarce (task map near full, or a
+        # qos.cycle_budget set), the scarce slots are allocated by
+        # weight, so one flooding tenant saturates only its own share.
+        qos = self.qos
+        for ins, chunk in chunks:
+            qos.enqueue(ins, chunk)
+        budget = self.service.qos_cycle_budget
+        spent = 0
+        while True:
+            chunk = qos.pop_ready()
+            if chunk is None:
                 break
-            for out in routes:
-                self._spawn_flush(task, out)
+            rc = self._dispatch_chunk(chunk)
+            if not rc:
+                # task map full: park this chunk and everything still
+                # queued on the backlog for the next cycle (drain pops
+                # in scheduler order, so fairness order is preserved)
+                leftovers = [chunk] + qos.drain_pending()
+                with self._ingest_lock:
+                    self._backlog.extend(leftovers)
+                break
+            if rc != DISPATCHED:
+                # absorbed without a task slot (guard-shed / no live
+                # routes): neither a "dispatch" for the metrics/lag
+                # histogram nor a charge against the cycle budget —
+                # a burst of shed chunks must not exhaust the budget
+                # healthy chunks were going to use
+                continue
+            qos.note_dispatched(chunk)
+            spent += chunk.size or 1
+            if budget and spent >= budget:
+                # per-cycle dispatch budget exhausted: the remainder
+                # waits its fair turn next cycle
+                leftovers = qos.drain_pending()
+                if leftovers:
+                    with self._ingest_lock:
+                        self._backlog.extend(leftovers)
+                break
+
+    def _reap_retired_outputs(self) -> None:
+        """Free hot-reload-removed outputs once their in-flight
+        flushes settle (rides the housekeeping timer). A retired
+        output no task routes to will never be flushed again — the
+        reload cleared it from every route — so its worker-pool
+        threads and plugin state can go NOW: a long-running daemon
+        doing periodic reloads must not accumulate one idle pool per
+        removal until engine.stop()."""
+        if not self._retired_outputs:
+            return
+        with self._ingest_lock:
+            busy = {id(o) for task in self._task_map.values()
+                    for o in task.routes}
+            ready = [o for o in self._retired_outputs
+                     if id(o) not in busy]
+            if not ready:
+                return
+            gone = {id(o) for o in ready}
+            self._retired_outputs = [o for o in self._retired_outputs
+                                     if id(o) not in gone]
+        for out in ready:
+            if out.worker_pool is not None:
+                out.worker_pool.stop()
+                out.worker_pool = None
+            try:
+                out.plugin.exit()
+            except Exception:
+                log.exception("retired output %s exit failed",
+                              out.display_name)
+
+    def _dispatch_chunk(self, chunk) -> int:
+        """Resolve routes and spawn one task for a ready chunk (the
+        per-chunk tail of the reference's flb_engine_dispatch).
+        Returns PARKED (falsy) only when the task map is full — the
+        caller then parks the chunk (and the rest of the fair queue)
+        for the next cycle; DISPATCHED when a task slot was consumed;
+        ABSORBED when the chunk was handled without a slot (guard-shed
+        spill or no live routes), which must count against neither the
+        qos dispatch metrics nor the cycle budget."""
+        if chunk.route_names is not None:
+            # resolve by output NAME whenever names exist (stamped at
+            # conditional-split ingest, on shed, and on disk recovery):
+            # bit positions index a SPECIFIC outputs list, and a hot
+            # reload can swap that list while this chunk sits in
+            # flush_all's in-flight window — after the pool/backlog
+            # mask-clearing pass can no longer reach it. Names survive
+            # any reorder; the mask is only a fast path for chunks
+            # that never got names
+            routes = [
+                o for o in self.outputs
+                if o.display_name in chunk.route_names
+                and chunk.event_type in o.plugin.event_types
+            ]
+        elif chunk.routes_mask:
+            # conditionally-split chunk: the ingest-time bitmask IS
+            # the route set (tag matching already folded in)
+            routes = [
+                o for i, o in enumerate(self.outputs)
+                if (chunk.routes_mask >> i) & 1
+                and chunk.event_type in o.plugin.event_types
+            ]
+        else:
+            routes = [
+                o for o in self.outputs
+                if o.route.matches(chunk.tag)
+                and chunk.event_type in o.plugin.event_types
+            ]
+        if not routes:
+            if self.storage is not None:
+                self.storage.delete(chunk)
+            return ABSORBED
+        # load shedding (fbtpu-guard): above the occupancy watermark,
+        # chunks spill to filesystem storage in priority order — the
+        # lowest class first — and chunks whose EVERY route is behind
+        # an open breaker spill regardless of class
+        if self.guard.maybe_shed(chunk, routes):
+            return ABSORBED
+        # bounded task id map (flb_task_map_get_task_id,
+        # src/flb_task.c:542): when every slot is in use the chunk
+        # stays parked and is re-dispatched next flush cycle — the
+        # reference's "task_id exhausted" stance. The map is mutated
+        # here (engine loop or flush_now's caller thread) and in
+        # _task_unref (loop callbacks, sync-fallback flush on any
+        # thread) — both hold the ingest lock.
+        task = None
+        with self._ingest_lock:
+            if len(self._task_map) >= self.service.task_map_size:
+                now = time.time()
+                if now - self._task_map_warned > 5.0:
+                    self._task_map_warned = now
+                    log.warning(
+                        "task map full (%d tasks in flight) — chunk "
+                        "dispatch paused until slots free",
+                        len(self._task_map))
+            else:
+                task = Task(chunk, routes)
+                # fully referenced BEFORE the first spawn: a route
+                # completing synchronously must not see users hit 0
+                # (and free the slot / delete the chunk) while its
+                # siblings are still being spawned
+                task.users = len(routes)
+                self._task_map[task.id] = task
+        if task is None:
+            return PARKED
+        for out in routes:
+            self._spawn_flush(task, out)
+        return DISPATCHED
 
     def _task_unref(self, task: Task) -> bool:
         """flb_task_users_dec: the id-map slot frees when the last

@@ -35,17 +35,27 @@ Three mechanisms (FAULTS.md "fbtpu-guard" section has the contract):
   record_failure, `mark_up` = reset, `pick()` filters on
   ``available()``).
 
-- **watchdog + load shedding** — the housekeeping pass (rides
+- **watchdog + graded load shedding** — the housekeeping pass (rides
   ``flush_all``'s timer) stamps a heartbeat, exports
   ``fluentbit_guard_*`` gauges (task-map occupancy + high-water,
   retry backlog, in-flight flushes, heartbeat age), scans deadlines,
-  and — above ``guard.shed_watermark`` task-map occupancy — spills
-  chunks whose every route sits behind an open breaker back to
-  filesystem storage (memory chunks are written through first when
-  storage is configured) instead of letting them queue for slots.
-  Shed chunks re-enter the backlog as soon as any of their routes'
-  breakers can take a probe, so delivery stays at-least-once; shedding
-  resets the chunk's retry count (it re-enters as a fresh dispatch).
+  and spills chunks off the dispatch path by **priority class**
+  (fbtpu-qos, QOS.md): each of the 8 classes has its own occupancy
+  watermark — the lowest class sheds right at ``guard.shed_watermark``
+  and each higher class only at proportionally higher occupancy, so
+  the highest class effectively never sheds and its flush latency is
+  unaffected by pressure. Chunks whose every route sits behind an
+  open breaker additionally shed at the base watermark regardless of
+  class (the original fbtpu-guard rule). Spilled memory chunks are
+  written through to filesystem storage first when configured.
+  Readmission is graded too: breaker-shed chunks return when any
+  route can take a probe, pressure-shed chunks when occupancy falls
+  back below ``qos.shed_hysteresis ×`` their class watermark — and
+  the readmit batch re-enters the backlog **highest priority first**
+  (it previously re-entered in FIFO shed order), so recovery
+  bandwidth goes to the classes that matter. Delivery stays
+  at-least-once; shedding resets the chunk's retry count (it
+  re-enters as a fresh dispatch).
 
 ``/api/v1/health`` surfaces the verdict (``ok|degraded|stalled``; see
 ``core/http_server.py``).
@@ -579,39 +589,80 @@ class Guard:
         svc = self.engine.service
         return int(svc.guard_shed_watermark * svc.task_map_size)
 
+    def _class_watermark_slots(self, priority) -> int:
+        """Shed-by-priority (fbtpu-qos): each of the 8 classes gets its
+        own occupancy watermark, graded linearly from the base
+        watermark (lowest class: sheds first) up toward a full task
+        map (class 0: effectively never sheds), so pressure spills the
+        classes that hurt least and the highest class's flush latency
+        stays flat."""
+        from .bucket_queue import QOS_CLASS_COUNT
+
+        svc = self.engine.service
+        if priority is None:
+            priority = svc.qos_default_priority
+        priority = min(max(int(priority), 0), QOS_CLASS_COUNT - 1)
+        base = svc.guard_shed_watermark
+        frac = base + (1.0 - base) * (
+            QOS_CLASS_COUNT - 1 - priority) / QOS_CLASS_COUNT
+        # floor of one slot: a degenerate task map must never compute a
+        # zero watermark and shed everything at occupancy zero
+        return max(1, int(frac * svc.task_map_size))
+
     def _route_breakers(self, names) -> List[Optional[CircuitBreaker]]:
         with self._lock:
             return [self._breakers.get(n) for n in names]
 
     def maybe_shed(self, chunk, routes) -> bool:
-        """Dispatch-path shedding: above the occupancy watermark, a
-        chunk whose EVERY route sits behind an open (and not yet
-        probe-ready) breaker is spilled instead of taking a task slot."""
+        """Dispatch-path shedding, graded by priority class. Above the
+        chunk's CLASS watermark it spills regardless of route health
+        (shed-by-priority); above the BASE watermark a chunk whose
+        EVERY route sits behind an open (and not yet probe-ready)
+        breaker spills regardless of class (the original rule)."""
         if not self.enabled or not routes:
             return False
-        if not self._unhealthy:
-            # lock-free health probe: shedding needs every route's
-            # breaker open, impossible while all breakers are closed —
-            # the all-healthy dispatch loop pays zero lock round-trips
-            return False
         engine = self.engine
-        with engine._ingest_lock:
-            occupancy = len(engine._task_map)
-        if occupancy < self._watermark_slots():
+        if not self._unhealthy and not engine.qos.graded():
+            # lock-free health probe: with every breaker closed and a
+            # single priority class nothing can shed — the all-healthy
+            # dispatch loop pays zero lock round-trips here
             return False
+        # relaxed read: len() of a dict is atomic in CPython and the
+        # value is stale the instant any lock is released anyway — a
+        # per-chunk engine-lock round-trip here would put dispatch in
+        # contention with every ingest thread just to move the shed
+        # threshold by at most one in-flight chunk
+        # fbtpu-lint: allow(guarded-by) atomic len() threshold probe
+        occupancy = len(engine._task_map)
+        if occupancy < self._watermark_slots():
+            return False  # below the base watermark nothing ever sheds
         names = [o.display_name for o in routes]
+        # shed-by-priority only engages when tenants actually span
+        # several classes — a single-class pipeline keeps the original
+        # park-on-backlog backpressure (shedding a class below itself
+        # would just add spill churn)
+        if engine.qos.graded() and \
+                occupancy >= self._class_watermark_slots(chunk.priority):
+            self._shed_chunk(chunk, names, reason="pressure")
+            return True
+        if not self._unhealthy:
+            # lock-free health probe: breaker-shedding needs every
+            # route's breaker open, impossible while all are closed
+            return False
         brs = self._route_breakers(names)
         if any(br is None or br.available() for br in brs):
             return False
-        self._shed_chunk(chunk, names)
+        self._shed_chunk(chunk, names, reason="breaker")
         return True
 
-    def _shed_chunk(self, chunk, route_names) -> None:
+    def _shed_chunk(self, chunk, route_names,
+                    reason: str = "breaker") -> None:
         # persisted route restriction: on readmission the chunk must
         # only go to the routes it was shed FROM (a sibling route that
-        # already delivered must not see duplicates). The conditional-
-        # routing bitmask must be cleared too — dispatch resolves
-        # routes_mask FIRST, and it still names the delivered siblings
+        # already delivered must not see duplicates). Dispatch resolves
+        # route NAMES first, so the restricted set wins; the stale
+        # bitmask (which still indexes the delivered siblings) is
+        # cleared for hygiene
         chunk.route_names = tuple(route_names)
         chunk.routes_mask = 0
         storage = self.engine.storage
@@ -624,34 +675,63 @@ class Guard:
                 log.exception("guard: shed write-through failed; chunk "
                               "parked in memory only")
         with self._lock:
-            self._shed.append(chunk)
+            self._shed.append((chunk, reason))
         for name in route_names:
             self.m_shed.inc(1, (name,))
-        log.warning("guard: shed chunk %s (routes %s) — open breaker + "
-                    "task-map pressure", chunk.tag, ",".join(route_names))
+        if reason == "pressure":
+            self.engine.qos.m_priority_shed.inc(
+                1, (chunk.qos_tenant or "default",))
+        log.warning(
+            "guard: shed chunk %s class=%s (routes %s) — %s",
+            chunk.tag, chunk.priority, ",".join(route_names),
+            "task-map pressure (shed-by-priority)"
+            if reason == "pressure" else "open breaker + task-map "
+            "pressure")
 
     def _shed_pass(self, now: float, occupancy: int,
                    on_loop: bool) -> None:
-        """Readmit recovered shed chunks; above the watermark, reclaim
-        task slots held by retry timers for open-breaker routes."""
+        """Readmit recovered shed chunks — HIGHEST priority first;
+        above the watermark, reclaim task slots held by retry timers
+        for open-breaker routes."""
         engine = self.engine
-        # readmission: any route able to take a probe → back to backlog
+        svc = engine.service
         with self._lock:
             shed = list(self._shed)
         if shed:
             readmit = []
-            for chunk in shed:
+            for entry in shed:
+                chunk, reason = entry
+                if reason == "pressure":
+                    # hysteresis: only readmit once occupancy fell
+                    # comfortably below the chunk's class watermark —
+                    # and count the chunks already being readmitted
+                    # this pass, so one pass cannot blow back through
+                    # the watermark it is honoring
+                    thr = self._class_watermark_slots(chunk.priority) \
+                        * svc.qos_shed_hysteresis
+                    if occupancy + len(readmit) < thr:
+                        readmit.append(entry)
+                    continue
                 brs = self._route_breakers(chunk.route_names or ())
                 if any(br is None or br.available() for br in brs):
-                    readmit.append(chunk)
+                    readmit.append(entry)
             if readmit:
+                # probe-ready chunks re-enter HIGHEST class first (the
+                # previous FIFO readmission handed recovery bandwidth
+                # to whatever happened to shed first, regardless of
+                # route priority); ties keep shed order (stable sort)
+                readmit.sort(
+                    key=lambda e: e[0].priority
+                    if e[0].priority is not None
+                    else svc.qos_default_priority)
                 with self._lock:
-                    self._shed = [c for c in self._shed
-                                  if c not in readmit]
+                    gone = {id(e) for e in readmit}
+                    self._shed = [e for e in self._shed
+                                  if id(e) not in gone]
                 with engine._ingest_lock:
-                    engine._backlog.extend(readmit)
-                log.info("guard: readmitted %d shed chunk(s)",
-                         len(readmit))
+                    engine._backlog.extend(c for c, _r in readmit)
+                log.info("guard: readmitted %d shed chunk(s) in "
+                         "priority order", len(readmit))
         # retry-slot reclaim: engine-loop only (pending-retry records
         # are loop-owned)
         if not on_loop or occupancy < self._watermark_slots():
@@ -670,12 +750,17 @@ class Guard:
 
     def readmit_all(self) -> None:
         """Stop path: everything shed re-enters the backlog so the
-        shutdown drain (and its quarantine accounting) sees it."""
+        shutdown drain (and its quarantine accounting) sees it —
+        highest priority first, same contract as the live readmission
+        pass."""
         with self._lock:
             shed, self._shed = self._shed, []
         if shed:
+            dflt = self.engine.service.qos_default_priority
+            shed.sort(key=lambda e: e[0].priority
+                      if e[0].priority is not None else dflt)
             with self.engine._ingest_lock:
-                self.engine._backlog.extend(shed)
+                self.engine._backlog.extend(c for c, _r in shed)
 
     def shed_count(self) -> int:
         with self._lock:
@@ -720,4 +805,7 @@ class Guard:
             "inflight_flushes": inflight,
             "shed_chunks": shed,
             "breakers": breakers,
+            # fbtpu-qos per-tenant state (QOS.md): generation + each
+            # tenant's contract, admission counters and queue depth
+            "qos": engine.qos.snapshot(),
         }

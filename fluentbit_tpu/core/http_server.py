@@ -24,6 +24,11 @@ Endpoints:
   GET  /api/v1/health/guard    the same verdict, always as JSON (for
                                dashboards that want breaker state while
                                the verdict is still "ok")
+  GET  /api/v1/qos             fbtpu-qos per-tenant state (QOS.md):
+                               reload generation + each tenant's
+                               weight/priority/quota, admission
+                               counters and fair-queue depth (the same
+                               block rides /api/v1/health's JSON body)
   GET  /api/v1/metrics         internal metrics as JSON
   GET  /api/v1/metrics/prometheus   Prometheus text exposition
   GET  /api/v1/uptime          uptime seconds
@@ -117,6 +122,9 @@ class AdminServer:
             return code, json.dumps(h).encode(), "application/json"
         if path == "/api/v1/health/guard":
             return 200, json.dumps(e.guard.health()).encode(), \
+                "application/json"
+        if path == "/api/v1/qos":
+            return 200, json.dumps(e.qos.snapshot()).encode(), \
                 "application/json"
         if path == "/api/v1/metrics/prometheus":
             return 200, e.metrics.to_prometheus().encode(), \

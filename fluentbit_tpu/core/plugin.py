@@ -201,6 +201,12 @@ class InputInstance(Instance):
         self.collector_task = None
         self.threaded = False  # run the collector on its own OS thread
         self.collector_thread = None
+        self.removed = False  # set by hot reload: collectors stop
+        self.paused_by_qos = False  # quota DEFER pause (engine resume)
+        # fbtpu-qos tenant membership (core/qos.py): resolved lazily
+        # and cached as _qos_tenant on first admission
+        self.tenant_name: Optional[str] = None
+        self.tenant_params: dict = {}
         # serializes this input's pool: every append/drain of this
         # input's chunks holds it, so raw-path ingest can run WITHOUT
         # the engine-global lock when the filter chain allows (reference:
@@ -247,6 +253,32 @@ class InputInstance(Instance):
         # runs on a dedicated OS thread; the append path stays
         # thread-safe via the engine's ingest locking
         self.threaded = parse_bool(self.properties.get("threaded", False))
+        # fbtpu-qos tenant declaration (QOS.md): `tenant <name>` joins
+        # the input to a tenant; tenant.* keys declare that tenant's
+        # contract (last declaration wins, so one input can carry the
+        # contract for a tenant several inputs share)
+        self.tenant_name = self.properties.get("tenant")
+        params: dict = {}
+        w = self.properties.get("tenant.weight")
+        if w is not None:
+            params["weight"] = float(w)
+        pr = self.properties.get("tenant.priority")
+        if pr is not None:
+            params["priority"] = int(pr)
+        rate = self.properties.get("tenant.rate")
+        if rate is not None:
+            params["rate"] = float(parse_size(rate))  # bytes/second
+        burst = self.properties.get("tenant.burst")
+        if burst is not None:
+            params["burst"] = float(parse_size(burst))
+        ovf = self.properties.get("tenant.overflow")
+        if ovf is not None:
+            ovf = str(ovf).lower()
+            if ovf not in ("defer", "shed"):
+                raise ValueError(
+                    f"tenant.overflow must be defer|shed, got {ovf!r}")
+            params["overflow"] = ovf
+        self.tenant_params = params
 
 
 class FilterInstance(Instance):

@@ -1,0 +1,892 @@
+"""fbtpu-qos — multi-tenant weighted-fair ingest, graded shedding
+support, and hot config reload (QOS.md has the operator contract).
+
+The paper's target is one agent serving traffic from millions of
+users; the engine previously had exactly one isolation primitive — the
+all-or-nothing ``mem_buf_limit`` pause — and exactly one shedding mode
+(fbtpu-guard's shed-all above a single watermark). One flooding input
+could starve every other tag's dispatch and any config change required
+a restart that dropped in-flight chunks. This module is the graded
+control plane on top:
+
+- **tenants** — every input (and therefore every chunk) belongs to a
+  tenant: a name + DWRR ``weight`` + priority ``class`` (0 = highest)
+  + optional ingest quota (token bucket, bytes/second). Inputs declare
+  membership with the ``tenant`` / ``tenant.*`` instance keys; inputs
+  that declare nothing share the ``default`` tenant with service-level
+  defaults, and the whole plane then degenerates to one FIFO flow —
+  i.e. the unconfigured pipeline behaves exactly as before.
+
+- **ingest admission** — ``Engine.input_log_append`` /
+  ``input_event_append`` call :meth:`Qos.admit` before any work. Over
+  quota, the append is *deferred* (returns -1, the reference's
+  backpressure verdict — callers retry) or *shed* (dropped, counted)
+  per the tenant's ``tenant.overflow`` policy. The fbtpu-lint rule
+  ``qos-unmetered-ingest`` (analysis/qos.py) flags any new ingest
+  entry point that bypasses this call.
+
+- **weighted-fair dispatch** — ``Engine.flush_all`` drains ready
+  chunks through a :class:`~.bucket_queue.DeficitFairQueue`: strict
+  priority across classes, deficit-weighted round robin across tenants
+  within a class. When dispatch capacity is scarce (task map near
+  full, or ``qos.cycle_budget`` set), the scarce slots are allocated
+  by weight instead of input order — a flooding tenant saturates only
+  its own share.
+
+- **hot config reload** — :class:`ReloadTxn` adds/removes/replaces
+  inputs, filters, outputs and parsers behind a *generation swap*: new
+  instances are built and initialized (including native DFA /
+  ``GrepTables`` recompilation) entirely off-line, then the engine's
+  instance lists — treated as copy-on-write everywhere — are swapped
+  by reference under the ingest lock in one critical section that also
+  bumps ``engine.generation`` / ``engine.reload_count``. In-flight
+  chunks are never dropped: removed inputs' pending chunks drain into
+  the dispatch backlog, and in-flight flushes hold direct references
+  to their (possibly removed) outputs until they settle.
+
+Shed-by-priority lives in ``core/guard.py`` (the guard owns the
+watermark machinery); it reads the chunk priorities this plane stamps.
+``fluentbit_qos_*`` metric families and the ``/api/v1/health`` tenant
+block are documented in QOS.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import failpoints as _fp
+from .bucket_queue import QOS_CLASS_COUNT, DeficitFairQueue
+from .scheduler import TokenBucket
+
+log = logging.getLogger("flb.qos")
+
+#: Admission verdicts (:meth:`Qos.admit`).
+ADMIT, DEFER, SHED = 0, 1, 2
+
+#: Name of the tenant inputs fall into when they declare none.
+DEFAULT_TENANT = "default"
+
+
+class Tenant:
+    """One tenant's QoS contract: fair-share weight, priority class,
+    optional ingest quota, overflow policy."""
+
+    __slots__ = ("name", "weight", "priority", "bucket", "overflow",
+                 "rate", "burst")
+
+    def __init__(self, name: str, weight: float, priority: int,
+                 rate: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 overflow: str = "defer", clock=time.monotonic):
+        self.name = name
+        self.weight = float(weight)
+        self.priority = min(max(int(priority), 0), QOS_CLASS_COUNT - 1)
+        self.rate = rate
+        self.burst = burst
+        self.overflow = overflow
+        self.bucket = (TokenBucket(rate, burst, clock=clock)
+                       if rate else None)
+
+
+class Qos:
+    """Per-engine QoS plane. Created with the engine (like the guard);
+    one ``default`` tenant exists from the start, so the unconfigured
+    steady state is a dict hit + one counter per append.
+
+    Concurrency: ``_tenants`` and ``_queue`` are touched from ingest
+    threads (admission / tenant resolution), the engine loop and
+    ``flush_now`` caller threads (dispatch), and reload transactions;
+    all access holds ``_lock``. Chunk stamping (``qos_tenant`` /
+    ``priority``) happens before the chunk is shared with dispatch.
+    """
+
+    def __init__(self, engine, clock=time.monotonic):
+        self.engine = engine
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, Tenant] = {}
+        # True once tenants span MORE than one priority class: the
+        # guard's shed-by-priority pass only engages then — a
+        # single-class pipeline keeps the original park-on-backlog
+        # behavior (shedding one class below itself is meaningless).
+        # Read lock-free on the dispatch path (benign staleness of one
+        # flush cycle); recomputed under _lock on tenant changes.
+        self._graded = False
+        svc = engine.service
+        self._queue = DeficitFairQueue(
+            quantum=float(svc.qos_quantum),
+            weight_floor=svc.qos_weight_floor)
+
+        m = engine.metrics
+        self.m_admitted = m.counter(
+            "fluentbit", "qos", "admitted_bytes_total",
+            "Bytes admitted past tenant quota", ("tenant",))
+        self.m_deferred = m.counter(
+            "fluentbit", "qos", "deferred_total",
+            "Appends deferred (backpressured) by tenant quota",
+            ("tenant",))
+        self.m_shed_in = m.counter(
+            "fluentbit", "qos", "shed_bytes_total",
+            "Bytes shed at ingest by tenant overflow policy", ("tenant",))
+        self.m_dispatched = m.counter(
+            "fluentbit", "qos", "dispatched_chunks_total",
+            "Chunks dispatched through the fair scheduler", ("tenant",))
+        self.m_queue_chunks = m.gauge(
+            "fluentbit", "qos", "queue_chunks",
+            "Chunks waiting in the fair dispatch queue", ("tenant",))
+        self.m_queue_bytes = m.gauge(
+            "fluentbit", "qos", "queue_bytes",
+            "Bytes waiting in the fair dispatch queue", ("tenant",))
+        self.m_lag = m.histogram(
+            "fluentbit", "qos", "scheduler_lag_seconds",
+            "Chunk create → fair-scheduler dispatch latency", ("tenant",))
+        self.m_priority_shed = m.counter(
+            "fluentbit", "qos", "priority_shed_chunks_total",
+            "Chunks spilled by shed-by-priority pressure", ("tenant",))
+        self.m_generation = m.gauge(
+            "fluentbit", "qos", "reload_generation",
+            "Current hot-reload configuration generation")
+        self.m_reloads = m.counter(
+            "fluentbit", "qos", "reloads_total",
+            "Committed hot-reload generation swaps")
+
+    # -- config ---------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.engine.service.qos_enable)
+
+    def tenant(self, name: str, **params) -> Tenant:
+        """Get-or-create a tenant; explicit ``params`` override the
+        stored contract (last declaration wins — a reload re-declaring
+        a tenant's weight takes effect on the next dispatch round)."""
+        svc = self.engine.service
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                t = Tenant(
+                    name,
+                    weight=params.get("weight",
+                                      svc.qos_default_weight),
+                    priority=params.get("priority",
+                                        svc.qos_default_priority),
+                    rate=params.get("rate"),
+                    burst=params.get("burst"),
+                    overflow=params.get("overflow", "defer"),
+                    clock=self.clock)
+                self._tenants[name] = t
+                self._graded = len({x.priority for x in
+                                    self._tenants.values()}) > 1
+                return t
+        # update outside the dict-creation critical section: Tenant
+        # field writes are atomic assignments and torn combinations
+        # only ever mix two declared-valid configs for one cycle
+        if "weight" in params:
+            t.weight = float(params["weight"])
+        if "priority" in params:
+            t.priority = min(max(int(params["priority"]), 0),
+                             QOS_CLASS_COUNT - 1)
+        if "overflow" in params:
+            t.overflow = params["overflow"]
+        if ("rate" in params or "burst" in params) and (
+                params.get("rate", t.rate) != t.rate
+                or params.get("burst", t.burst) != t.burst):
+            # absent keys mean "no change" (same as weight/priority
+            # above) — a re-declaration that only tightens the burst
+            # must rebuild the bucket too, and one that only moves the
+            # rate keeps the declared burst
+            t.rate = params.get("rate", t.rate)
+            t.burst = params.get("burst", t.burst)
+            t.bucket = (TokenBucket(t.rate, t.burst, clock=self.clock)
+                        if t.rate else None)
+        if "priority" in params:
+            with self._lock:
+                self._graded = len({x.priority for x in
+                                    self._tenants.values()}) > 1
+        return t
+
+    def graded(self) -> bool:
+        """True when tenants span more than one priority class — the
+        precondition for shed-by-priority (guard.maybe_shed)."""
+        return self._graded
+
+    def tenant_for_input(self, ins) -> Tenant:
+        """Resolve (and cache on the instance) the input's tenant."""
+        t = getattr(ins, "_qos_tenant", None)
+        if t is None:
+            name = getattr(ins, "tenant_name", None) or DEFAULT_TENANT
+            params = getattr(ins, "tenant_params", None) or {}
+            t = self.tenant(name, **params)
+            ins._qos_tenant = t
+        return t
+
+    # -- ingest admission ----------------------------------------------
+
+    def admit(self, ins, n_bytes: int) -> int:
+        """Meter one append against the input's tenant quota. Returns
+        :data:`ADMIT`, :data:`DEFER` (caller returns -1: the
+        reference's backpressure verdict) or :data:`SHED` (the append
+        is dropped and counted)."""
+        if getattr(ins, "qos_exempt", False):
+            # hidden emitter inputs (engine.hidden_input): the bytes
+            # were metered once at the original ingest point — replay
+            # hops must neither charge the quota a second time nor
+            # DEFER (their fire-and-forget callers would drop the
+            # already-admitted record)
+            return ADMIT
+        t = self.tenant_for_input(ins)
+        if _fp.ACTIVE:
+            _fp.fire("qos.admit")
+        if t.bucket is None or not self.enabled:
+            self.m_admitted.inc(n_bytes, (t.name,))
+            return ADMIT
+        if t.bucket.try_take(n_bytes):
+            self.m_admitted.inc(n_bytes, (t.name,))
+            return ADMIT
+        if t.overflow == "shed":
+            self.m_shed_in.inc(n_bytes, (t.name,))
+            return SHED
+        self.m_deferred.inc(1, (t.name,))
+        return DEFER
+
+    def resume_paused(self, inputs) -> None:
+        """Un-pause inputs paused by quota DEFER once their tenant's
+        bucket can admit an append the size of the one that deferred
+        (rides the housekeeping timer — the quota twin of the
+        mem_buf_limit drained-pool resume). Resuming on a single
+        token would churn: the resumed collector consumes a read the
+        very next DEFER drops."""
+        svc = self.engine.service
+        for ins in inputs:
+            if getattr(ins, "paused_by_qos", False) and \
+                    self.defer_hint(
+                        ins, getattr(ins, "_qos_defer_cost", 1) or 1
+                    ) <= 0.0:
+                # the bucket says go, but the resume must also honor
+                # the buffer watermarks the drain-path resume checks
+                # (engine.flush_all): un-pausing over mem_buf_limit
+                # would hand the collector one read the next append's
+                # backpressure check rejects — and that path skips
+                # quota pauses, so nobody else would resume this input
+                with ins.ingest_lock:
+                    buf_ok = (
+                        not ins.mem_buf_limit
+                        or ins.pool.pending_bytes < ins.mem_buf_limit
+                    ) and (
+                        not getattr(ins, "pause_on_chunks_overlimit",
+                                    False)
+                        or ins.pool.pending_chunks
+                        < svc.storage_max_chunks_up
+                    )
+                if buf_ok:
+                    ins.paused_by_qos = False
+                    ins.set_paused(False)
+
+    def refund(self, ins, n_bytes: int) -> None:
+        """Return an admitted take that never landed (the append was
+        refused after admission — removed-input race). The bucket gets
+        its tokens back; the admitted-bytes counter keeps the tiny
+        monotonic skew (Prometheus counters never decrement)."""
+        t = self.tenant_for_input(ins)
+        if t.bucket is not None and self.enabled:
+            t.bucket.give_back(n_bytes)
+
+    def defer_hint(self, ins, n_bytes: int) -> float:
+        """Seconds until a deferred append of ``n_bytes`` could be
+        admitted (pacing hint for callers that want to sleep instead of
+        spin)."""
+        t = self.tenant_for_input(ins)
+        if t.bucket is None:
+            return 0.0
+        return t.bucket.delay_for(n_bytes)
+
+    # -- fair dispatch (driven by Engine.flush_all) ---------------------
+
+    def enqueue(self, ins, chunk) -> None:
+        """Stamp the chunk's tenant/priority and queue it for fair
+        dispatch. ``ins`` may be None (backlog / recovered / readmitted
+        chunks) — the stamp already on the chunk wins, so a chunk keeps
+        its class across shed/readmit/restart cycles."""
+        name = chunk.qos_tenant
+        if name is None and ins is not None:
+            # instance-cached resolve: one lookup, not a name round-
+            # trip back through the locked tenant() update path
+            t = self.tenant_for_input(ins)
+        else:
+            t = self.tenant(name if name is not None
+                            else DEFAULT_TENANT)
+        chunk.qos_tenant = t.name
+        if chunk.priority is None:
+            chunk.priority = t.priority
+        with self._lock:
+            self._queue.push(chunk.priority, t.name, t.weight,
+                             float(chunk.size or 1), chunk)
+
+    def pop_ready(self):
+        """Next chunk in strict-priority + DWRR order, or None.
+
+        Pure queue pop — dispatch accounting happens in
+        ``note_dispatched`` once the caller KNOWS the chunk got a task
+        slot, so a task-map-full repark doesn't double-count the same
+        chunk (and pollute the lag histogram) every cycle it waits."""
+        with self._lock:
+            got = self._queue.pop_ex()
+        if got is None:
+            return None
+        _name, chunk = got
+        return chunk
+
+    def note_dispatched(self, chunk) -> None:
+        """Count one successful dispatch (called by flush_all after
+        ``_dispatch_chunk`` accepted the chunk)."""
+        name = chunk.qos_tenant or DEFAULT_TENANT
+        self.m_dispatched.inc(1, (name,))
+        self.m_lag.observe(max(0.0, time.time() - chunk.created), (name,))
+
+    def drain_pending(self) -> List[Any]:
+        """Take every queued chunk (task-map-full parking: the caller
+        re-parks them on the engine backlog, preserving fair order)."""
+        with self._lock:
+            return self._queue.drain()
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def update_gauges(self) -> None:
+        """Refresh the per-tenant queue gauges (rides the guard's
+        housekeeping timer — never a per-chunk cost)."""
+        with self._lock:
+            pending = self._queue.pending()
+            names = list(self._tenants)
+        depth: Dict[str, Tuple[int, float]] = {}
+        for (_cls, name), (n, cost) in pending.items():
+            d = depth.get(name, (0, 0.0))
+            depth[name] = (d[0] + n, d[1] + cost)
+        for name in names:
+            n, cost = depth.get(name, (0, 0.0))
+            self.m_queue_chunks.set(n, (name,))
+            self.m_queue_bytes.set(cost, (name,))
+
+    def reap_tenants(self) -> None:
+        """Drop tenants no live input references (reload commit calls
+        this post-swap). A daemon cycling per-customer tenant names
+        through periodic reloads must not accumulate one Tenant —
+        plus per-tick gauge work in update_gauges/snapshot — per name
+        ever declared. Tenants with chunks still in the fair queue are
+        kept; a reaped tenant whose stamped chunks later readmit from
+        the backlog is re-created on demand at enqueue (the chunk
+        carries its priority stamp; the weight reverts to the default
+        until an input re-declares the contract)."""
+        live = {DEFAULT_TENANT}
+        for ins in self.engine.inputs:
+            live.add(getattr(ins, "tenant_name", None) or DEFAULT_TENANT)
+        with self._lock:
+            queued = {name for (_cls, name) in self._queue.pending()}
+            dead = [n for n in self._tenants
+                    if n not in live and n not in queued]
+            for n in dead:
+                del self._tenants[n]
+            if dead:
+                self._graded = len({x.priority for x in
+                                    self._tenants.values()}) > 1
+        for n in dead:
+            # stop publishing depth for a gone tenant (its last value
+            # would otherwise linger in the registry forever)
+            self.m_queue_chunks.set(0, (n,))
+            self.m_queue_bytes.set(0, (n,))
+
+    # -- observability ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Per-tenant state for ``/api/v1/health`` + ``/api/v1/qos``."""
+        with self._lock:
+            tenants = list(self._tenants.values())
+            pending = self._queue.pending()
+        depth: Dict[str, int] = {}
+        for (_cls, name), (n, _cost) in pending.items():
+            depth[name] = depth.get(name, 0) + n
+        out = {}
+        for t in tenants:
+            out[t.name] = {
+                "weight": t.weight,
+                "priority": t.priority,
+                "rate": t.rate,
+                "overflow": t.overflow,
+                "queued_chunks": depth.get(t.name, 0),
+                "admitted_bytes": self.m_admitted.get((t.name,)),
+                "deferred": self.m_deferred.get((t.name,)),
+                "shed_bytes": self.m_shed_in.get((t.name,)),
+            }
+        return {
+            "generation": self.engine.generation,
+            "tenants": out,
+        }
+
+
+# ---------------------------------------------------------------------------
+# hot config reload — the generation swap
+# ---------------------------------------------------------------------------
+
+
+class ReloadTxn:
+    """One atomic configuration change against a RUNNING engine.
+
+    Usage (also wired to ``engine.reload_callback`` by embedders)::
+
+        txn = engine.reload_txn()
+        txn.add_output("stdout", match="aux.*")
+        txn.replace_filter("grep.0")       # recompile DFA/GrepTables
+        txn.remove_input("tail.1")
+        gen = txn.commit()
+
+    ``commit()`` builds + initializes every new instance **off-line**
+    (this is where grep/parser DFA tables and native ``GrepTables``
+    compile — in-flight appends keep using the old objects), then swaps
+    the engine's instance lists *by reference* in one ingest-lock
+    critical section. The lists are copy-on-write everywhere in the
+    engine, so a concurrent append/flush iterating a snapshot reference
+    can never observe a torn (half-swapped) configuration; the same
+    critical section bumps ``engine.generation`` and
+    ``engine.reload_count``, making both atomic with respect to the
+    housekeeping timer. In-flight chunks survive: removed inputs'
+    pending chunks drain into the dispatch backlog before their
+    collectors stop, and removed outputs retire only after their
+    in-flight flushes settle (``engine.stop`` reaps their worker
+    pools).
+
+    A transaction is single-use; ``commit`` raises on a second call.
+    The ``engine.reload_commit`` failpoint fires after the build phase
+    and before the swap — the crash window where every new table exists
+    but the old generation is still live.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._add_inputs: List = []
+        self._add_filters: List = []
+        self._add_outputs: List = []
+        self._remove: Dict[str, set] = {
+            "input": set(), "filter": set(), "output": set()}
+        self._replace_filters: List[Tuple[str, str, dict]] = []
+        self._add_parsers: List[Tuple[str, dict]] = []
+        self._remove_parsers: set = set()
+        self._committed = False
+
+    # -- staging ---------------------------------------------------------
+
+    def add_input(self, name: str, **props):
+        self._add_inputs.append((name, props))
+        return self
+
+    def add_filter(self, name: str, **props):
+        self._add_filters.append((name, props))
+        return self
+
+    def add_output(self, name: str, **props):
+        self._add_outputs.append((name, props))
+        return self
+
+    def remove_input(self, name: str):
+        self._remove["input"].add(name)
+        return self
+
+    def remove_filter(self, name: str):
+        self._remove["filter"].add(name)
+        return self
+
+    def remove_output(self, name: str):
+        self._remove["output"].add(name)
+        return self
+
+    def replace_filter(self, target: str, name: Optional[str] = None,
+                       **props):
+        """Swap ``target`` (display name) for a freshly built instance
+        — with no ``props``, the SAME configuration is recompiled (the
+        DFA-recompile-mid-stream shape); the new instance takes the
+        old one's chain position."""
+        self._replace_filters.append((target, name or "", props))
+        return self
+
+    def add_parser(self, name: str, **props):
+        self._add_parsers.append((name, props))
+        return self
+
+    def remove_parser(self, name: str):
+        self._remove_parsers.add(name)
+        return self
+
+    # -- commit ----------------------------------------------------------
+
+    @staticmethod
+    def _matches(ins, name: str) -> bool:
+        return name in (ins.name, ins.display_name)
+
+    def _resolve_removals(self, current, kind: str):
+        removed = []
+        for name in self._remove[kind]:
+            hit = [i for i in current if self._matches(i, name)]
+            if not hit:
+                raise ValueError(
+                    f"reload: unknown {kind} instance {name!r}")
+            removed.extend(hit)
+        return removed
+
+    def commit(self) -> int:
+        if self._committed:
+            raise RuntimeError("reload transaction already committed")
+        self._committed = True
+        engine = self.engine
+        # one transaction at a time: the swap writes back keep+new
+        # lists derived from this commit's snapshot, so a concurrent
+        # commit's changes would be silently lost — only the snapshot
+        # taken INSIDE the lock is guaranteed current
+        with engine._reload_lock:
+            # checked under the lock: engine.stop() sets _stopping and
+            # then takes this lock as a barrier, so a commit either
+            # completes before stop's retired-output reap or refuses
+            # here — never lands retirements on a stopping OR stopped
+            # engine (stop() already exited every instance; a commit
+            # after it would double-exit removed plugins and strand
+            # retirements no housekeeping will ever reap). start()
+            # resets the flag, so a restarted engine reloads normally
+            if engine._stopping:
+                raise RuntimeError("reload: engine is stopping")
+            return self._commit_locked(engine)
+
+    def _commit_locked(self, engine) -> int:
+        # snapshot references: COW discipline means these lists never
+        # mutate under us even while ingest/dispatch keeps running
+        cur_inputs = engine.inputs
+        cur_filters = engine.filters
+        cur_outputs = engine.outputs
+
+        rm_inputs = self._resolve_removals(cur_inputs, "input")
+        rm_filters = self._resolve_removals(cur_filters, "filter")
+        rm_outputs = self._resolve_removals(cur_outputs, "output")
+        # retire removed names BEFORE the build phase numbers the new
+        # instances: a same-transaction remove+add of one plugin must
+        # not hand the newcomer the dead instance's name (persisted
+        # route_names / metric series would re-bind to it). Recording
+        # early is safe across an abort — a spuriously retired name
+        # only makes numbering skip it, never collide
+        for ins in rm_inputs + rm_filters + rm_outputs:
+            engine._retired_names.setdefault(
+                type(ins).__name__, set()).add(ins.name)
+        replaced_ids: set = set()
+        for target, _n, _p in self._replace_filters:
+            hit = [f for f in cur_filters if self._matches(f, target)]
+            if not hit:
+                raise ValueError(
+                    f"reload: unknown filter instance {target!r}")
+            if any(f in rm_filters for f in hit):
+                raise ValueError(
+                    f"reload: filter {target!r} is both removed and "
+                    "replaced in the same transaction")
+            # two replaces of one slot would silently drop the first
+            # built twin un-exited (its hidden emitter leaks) and exit
+            # the old instance twice
+            ids = {id(f) for f in hit}
+            if ids & replaced_ids:
+                raise ValueError(
+                    f"reload: filter {target!r} replaced twice in the "
+                    "same transaction")
+            replaced_ids |= ids
+
+        # ---- build phase (off-line: the expensive part) ----
+        # parsers first: a new filter may resolve a new parser at init.
+        # The dict swap is an atomic reference assignment and filters
+        # resolve parser objects at init (the old generation keeps its
+        # own references) — but a LATER build failure must not leave
+        # the new parser dict live while everything else stays on the
+        # old generation, so the whole phase unwinds on error below.
+        # same contract as _resolve_removals: a typo'd parser name must
+        # abort the transaction, not silently leave the parser live
+        unknown_parsers = self._remove_parsers - set(engine.parsers)
+        if unknown_parsers:
+            raise ValueError(
+                f"reload: unknown parser(s) {sorted(unknown_parsers)}")
+        old_parsers = engine.parsers
+        new_parsers = {k: v for k, v in engine.parsers.items()
+                       if k not in self._remove_parsers}
+        from ..parsers import create_parser
+
+        for name, props in self._add_parsers:
+            p = create_parser(name, **props)
+            new_parsers[name] = p
+        engine.parsers = new_parsers
+
+        built: List = []  # every new instance, for unwind on failure
+
+        def build(kind, create, staged, peers):
+            out = []
+            for name, props in staged:
+                ins = engine._make_instance(create, name, props,
+                                            peers + out)
+                engine._init_instance(ins)
+                out.append(ins)
+                built.append(ins)
+            return out
+
+        keep_inputs = [i for i in cur_inputs if i not in rm_inputs]
+        keep_filters = [f for f in cur_filters if f not in rm_filters]
+        keep_outputs = [o for o in cur_outputs if o not in rm_outputs]
+
+        try:
+            new_inputs = build("input", engine.registry.create_input,
+                               self._add_inputs, keep_inputs)
+            new_outputs = build("output", engine.registry.create_output,
+                                self._add_outputs, keep_outputs)
+
+            # filter replacements: build the twin, remember the slot
+            replacements: Dict[int, Any] = {}
+            swapped_out: List = []
+            for target, name, props in self._replace_filters:
+                idx, old = next(
+                    (i, f) for i, f in enumerate(keep_filters)
+                    if self._matches(f, target))
+                plugin_name = name or old.plugin.name
+                ins = engine.registry.create_filter(plugin_name)
+                # the replacement KEEPS the old instance's identity
+                # (name / alias): metrics series and route continuity
+                # survive the recompile
+                ins.name = old.name
+                built.append(ins)
+                # the properties ITEM LIST, not a dict: repeated keys
+                # (a grep filter's several Regex rules) and declaration
+                # order are semantic
+                items = list(props.items()) if props \
+                    else old.properties.items()
+                for k, v in items:
+                    ins.set(k, v)
+                engine._init_instance(ins)
+                replacements[idx] = ins
+                swapped_out.append(old)
+            next_filters = [replacements.get(i, f)
+                            for i, f in enumerate(keep_filters)]
+            add_filters = build("filter", engine.registry.create_filter,
+                                self._add_filters, next_filters)
+            if _fp.ACTIVE:
+                # crash window: every new table compiled, old
+                # generation still live — recovery must come up on the
+                # OLD config. An injected (non-crash) error aborts
+                # through the same unwind as a build failure
+                _fp.fire("engine.reload_commit")
+        except BaseException:
+            # abort with the OLD generation fully intact: un-swap the
+            # parser dict and tear down whatever was already built —
+            # nothing new is reachable from the engine yet, EXCEPT
+            # hidden emitters the built filters' inits registered
+            # (engine.hidden_input COW-appends them): unlink those too
+            engine.parsers = old_parsers
+            built_ids = {id(b) for b in built}
+            orphans = [i for i in engine.inputs
+                       if getattr(i, "_hidden_owner", None) is not None
+                       and id(i._hidden_owner) in built_ids]
+            if orphans:
+                with engine._ingest_lock:
+                    engine.inputs = [i for i in engine.inputs
+                                     if i not in orphans]
+            for ins in built + orphans:
+                if getattr(ins, "_initialized", False):
+                    try:
+                        ins.plugin.exit()
+                    except Exception:
+                        log.exception(
+                            "reload abort: built instance %s exit "
+                            "failed", ins.display_name)
+            raise
+        # added filters keep engine.filter()'s ordering contract: user
+        # filters run BEFORE hidden flux-SQL filters
+        pos = len(next_filters)
+        while pos > 0 and getattr(next_filters[pos - 1],
+                                  "_flux_sql_hidden", False):
+            pos -= 1
+        next_filters[pos:pos] = add_filters
+
+        # ---- swap phase (one critical section) ----
+        # hidden emitters ride their owner's lifecycle: a removed or
+        # replaced filter's (or removed input's) emitter must unlink
+        # with it, or every reload leaks one initialized input whose
+        # dead pool flush_all would drain forever. Emitters created by
+        # the build phase belong to NEW owners and are untouched.
+        dead_owners = {id(x) for x in rm_inputs + rm_filters
+                       + swapped_out}
+        orphan_emitters = [
+            i for i in engine.inputs
+            if getattr(i, "_hidden_owner", None) is not None
+            and id(i._hidden_owner) in dead_owners]
+        rm_inputs = rm_inputs + orphan_emitters
+
+        # new inputs' tenant contracts register BEFORE the swap makes
+        # them ingestable (same eager rule as engine.start: a flood
+        # must never beat its own quota declaration)
+        for ins in new_inputs:
+            engine.qos.tenant_for_input(ins)
+
+        drained = []
+        with engine._ingest_lock:
+            for ins in rm_inputs:
+                with ins.ingest_lock:
+                    # flag BEFORE draining, under the input's own lock:
+                    # a parallel-raw append blocked on ingest_lock
+                    # otherwise lands in the pool right after the drain
+                    # and is acked into an orphaned pool flush_all will
+                    # never visit again. Append paths re-check
+                    # ins.removed under this lock and refuse (the
+                    # caller sees 0 ingested — un-acked, so
+                    # at-least-once holds)
+                    ins.removed = True
+                    pool_chunks = ins.pool.drain()
+                t = engine.qos.tenant_for_input(ins)
+                for chunk in pool_chunks:
+                    # keep the removed input's tenant identity: these
+                    # chunks re-enter dispatch via the backlog, where
+                    # enqueue(None, ...) has no input to resolve from
+                    # — without the stamp a top-priority tenant's
+                    # in-flight data would be re-classed to the
+                    # default tenant (and its shed watermark) exactly
+                    # during the reload
+                    if chunk.qos_tenant is None:
+                        chunk.qos_tenant = t.name
+                    if chunk.priority is None:
+                        chunk.priority = t.priority
+                    if engine.storage is not None and \
+                            ins.storage_type == "filesystem":
+                        try:
+                            engine.storage.finalize(chunk)
+                        except Exception:
+                            # disk full / storage fault mid-swap: the
+                            # swap section has no abort path (inputs
+                            # are already flagged removed), so a
+                            # finalize error must not wedge a half-
+                            # committed generation. The chunk still
+                            # reaches the backlog in memory — delivery
+                            # proceeds; only crash-recovery durability
+                            # for THIS chunk is degraded
+                            log.exception(
+                                "reload: finalize of drained chunk "
+                                "from %s failed; chunk kept in-memory",
+                                ins.display_name)
+                drained.extend(pool_chunks)
+            engine._backlog.extend(drained)
+            # re-resolve against the LIVE list: the build phase's
+            # plugin inits may have appended hidden emitter inputs
+            # (rewrite_tag / log_to_metrics pattern) that must survive
+            live_inputs = [i for i in engine.inputs
+                           if i not in rm_inputs]
+            # conditional-routing bitmasks index the OLD outputs list.
+            # Dispatch resolves persisted route NAMES first, so the
+            # chunks themselves are reload-proof — but the pool's
+            # active map KEYS on the mask value, so a post-swap append
+            # computing the same mask against the NEW outputs would
+            # merge into an old-generation chunk and inherit its stale
+            # names. Rotate those chunks closed; fresh appends open
+            # fresh chunks with names from the new list.
+            # only when the outputs list actually changes: a parser- or
+            # filter-only reload leaves every mask valid, and rotating
+            # anyway would fragment in-progress conditional chunks on
+            # each DFA recompile
+            if rm_outputs or new_outputs:
+                for src in live_inputs:
+                    with src.ingest_lock:
+                        src.pool.rotate_conditional()
+            engine.inputs = live_inputs + new_inputs
+            engine.filters = next_filters
+            engine.outputs = keep_outputs + new_outputs
+            engine.generation += 1
+            engine.reload_count += 1
+            gen = engine.generation
+            # rm_* names were retired before the build phase (so a
+            # same-transaction add can't take them); the orphan
+            # emitters discovered since retire here. Replacements are
+            # NOT retired — the twin keeps the name by design
+            for ins in orphan_emitters:
+                engine._retired_names.setdefault(
+                    type(ins).__name__, set()).add(ins.name)
+
+        # ---- post-swap (old generation unreachable for new work;
+        # ins.removed was already flagged inside the swap section) ----
+        # chunk-trace taps hold their target instance (and its pool)
+        # alive through engine.traces; a stale entry also blocks
+        # re-enabling the trace on a same-named replacement input
+        for ins in rm_inputs:
+            ctx = engine.traces.get(ins.name)
+            if ctx is not None and ctx["input"] is ins:
+                engine.traces.pop(ins.name, None)
+        for ins in rm_inputs:
+            thread = getattr(ins, "collector_thread", None)
+            if thread is not None and (
+                    thread.is_alive()
+                    or getattr(ins, "_exited_by_collector", False)):
+                # the collector thread owns the plugin's I/O: it sees
+                # ins.removed at its next tick, unwinds, and calls
+                # plugin.exit() itself — exiting here would close
+                # files/sockets under an in-flight collect(), and the
+                # flag covers the race where it already exited between
+                # the swap and this check (a dead thread with the flag
+                # unset means the engine stopped it pre-removal:
+                # nothing is in flight, inline exit is safe and the
+                # only exit this input will get)
+                continue
+            task = ins.collector_task
+            if task is not None and engine.loop is not None \
+                    and not engine.loop.is_closed():
+                # cancel on the loop and exit only AFTER the task has
+                # unwound (done callback runs on the loop thread), so
+                # exit() never races a collect/server coroutine
+                def _exit_done(_t, _ins=ins):
+                    try:
+                        _ins.plugin.exit()
+                    except Exception:
+                        log.exception("removed input %s exit failed",
+                                      _ins.display_name)
+
+                def _cancel(_t=task, _cb=_exit_done):
+                    _t.add_done_callback(_cb)
+                    _t.cancel()
+
+                try:
+                    engine.loop.call_soon_threadsafe(_cancel)
+                    continue
+                except RuntimeError:
+                    pass  # loop already shut down: nothing in flight
+            try:
+                ins.plugin.exit()
+            except Exception:
+                log.exception("removed input %s exit failed",
+                              ins.display_name)
+        for f in rm_filters + swapped_out:
+            try:
+                f.plugin.exit()
+            except Exception:
+                log.exception("removed filter %s exit failed",
+                              f.display_name)
+        # removed outputs: in-flight tasks hold direct references and
+        # finish normally; pools are reaped by housekeeping (or stop()).
+        # Under _ingest_lock: _reap_retired_outputs does a read-filter-
+        # replace of this list under the same lock, and an unlocked
+        # extend racing that replace would vanish — the output would
+        # then never be reaped, not even at stop()
+        with engine._ingest_lock:
+            engine._retired_outputs.extend(rm_outputs)
+        for ins in new_inputs:
+            engine.ensure_collector(ins)
+        if engine.running:
+            for out in new_outputs:
+                engine._ensure_worker_pool(out)
+
+        qos = engine.qos
+        qos.reap_tenants()
+        qos.m_generation.set(gen)
+        qos.m_reloads.inc(1)
+        log.info(
+            "qos: reload generation %d committed (+%d/-%d inputs, "
+            "+%d/-%d/%d~ filters, +%d/-%d outputs)", gen,
+            len(new_inputs), len(rm_inputs), len(add_filters),
+            len(rm_filters), len(self._replace_filters),
+            len(new_outputs), len(rm_outputs))
+        return gen

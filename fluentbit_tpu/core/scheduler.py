@@ -1,16 +1,92 @@
-"""Retry scheduler — capped full-jitter exponential backoff.
+"""Retry scheduler — capped full-jitter exponential backoff, plus the
+token-bucket pacing primitive the QoS plane meters ingest with.
 
 Reference: src/flb_scheduler.c:253-300 (backoff_full_jitter; random
 seconds in [base, min(cap, base * 2^attempt)] plus one), base
 FLB_SCHED_BASE=5s and cap FLB_SCHED_CAP=2000s
 (include/fluent-bit/flb_scheduler.h:29-30). Timers are asyncio-based
-rather than timerfd.
+rather than timerfd. The token bucket has no reference equivalent —
+the reference's only ingest throttle is the all-or-nothing
+mem_buf_limit pause; fbtpu-qos (core/qos.py) needs graded per-tenant
+admission instead.
 """
 
 from __future__ import annotations
 
 import random
+import threading
+import time
 from typing import Optional
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second refill up to
+    ``burst`` capacity; :meth:`try_take` admits a cost or refuses
+    without blocking. Thread-safe (ingest calls arrive from collector
+    threads, library pushes, and server inputs concurrently); the
+    clock is injectable so quota behavior is testable on a fake clock
+    without sleeping.
+    """
+
+    __slots__ = ("rate", "capacity", "tokens", "updated", "clock",
+                 "_lock")
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock=time.monotonic):
+        self.rate = float(rate)
+        # default burst: one second of rate — a tenant that was idle
+        # can absorb exactly one quota-second instantaneously
+        self.capacity = float(burst if burst is not None else rate)
+        self.tokens = self.capacity
+        self.clock = clock
+        self.updated = clock()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        if now > self.updated:
+            self.tokens = min(self.capacity,
+                              self.tokens + (now - self.updated) * self.rate)
+        self.updated = now
+
+    def try_take(self, cost: float) -> bool:
+        """Admit ``cost`` tokens now, or refuse (no partial take).
+
+        A cost larger than the burst capacity is admitted once the
+        bucket is as full as it can get, charging the FULL cost (the
+        balance goes negative and later admissions wait out the debt).
+        Without the debt rule an oversized append could never be
+        admitted at all — deferred forever against a hint that keeps
+        promising a finite wait (``delay_for`` clamps to capacity, so
+        both sides use the same admit threshold). Long-run rate is
+        unaffected: debt repays at exactly ``rate``.
+        """
+        with self._lock:
+            self._refill(self.clock())
+            if self.tokens >= min(cost, self.capacity):
+                self.tokens -= cost
+                return True
+            return False
+
+    def give_back(self, cost: float) -> None:
+        """Return tokens from an admitted take whose append was then
+        refused (e.g. the input vanished in a hot reload between
+        admission and the locked pool write) — the caller never acked,
+        so the tenant must not stay charged for bytes never ingested."""
+        with self._lock:
+            self.tokens = min(self.capacity, self.tokens + cost)
+
+    def delay_for(self, cost: float) -> float:
+        """Seconds until ``cost`` tokens will be available (0 when they
+        already are) — the defer hint admission hands back so callers
+        can pace retries instead of hot-looping."""
+        with self._lock:
+            self._refill(self.clock())
+            missing = min(cost, self.capacity) - self.tokens
+            if missing <= 0:
+                return 0.0
+            if self.rate <= 0:
+                return float("inf")
+            return missing / self.rate
 
 
 def backoff_full_jitter(base: float, cap: float, attempt: int,

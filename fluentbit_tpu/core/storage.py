@@ -73,6 +73,17 @@ def _mask_bytes(chunk) -> bytes:
     return _RLEN.pack(len(blob)) + blob
 
 
+def _prio_byte(chunk) -> int:
+    """QoS priority class in the v2 header's (previously unused) pad
+    byte — 0 = unstamped, n+1 = class n — so a spilled/recovered chunk
+    keeps its shed-by-priority class across a restart (old files read
+    back as unstamped; old readers ignore the byte)."""
+    prio = getattr(chunk, "priority", None)
+    if prio is None:
+        return 0
+    return (int(prio) + 1) & 0xFF
+
+
 class Storage:
     """Filesystem backend for chunk persistence + DLQ."""
 
@@ -111,7 +122,8 @@ class Storage:
             tag = chunk.tag.encode("utf-8")
             f.write(_HEAD.pack(MAGIC, VERSION,
                                _TYPE_CODES.get(chunk.event_type, 0),
-                               STATE_OPEN, 0, 0, len(tag)))
+                               STATE_OPEN, _prio_byte(chunk), 0,
+                               len(tag)))
             f.write(_mask_bytes(chunk))
             f.write(tag)
             self._files[chunk.id] = (f, path)
@@ -140,7 +152,8 @@ class Storage:
         tag = chunk.tag.encode("utf-8")
         f.write(_HEAD.pack(MAGIC, VERSION,
                            _TYPE_CODES.get(chunk.event_type, 0),
-                           STATE_FINAL, 0, crc, len(tag)))
+                           STATE_FINAL, _prio_byte(chunk), crc,
+                           len(tag)))
         f.write(_mask_bytes(chunk))
         f.close()
         self._files[chunk.id] = (None, path)
@@ -180,7 +193,8 @@ class Storage:
         with open(path, "wb") as f:
             f.write(_HEAD.pack(MAGIC, VERSION,
                                _TYPE_CODES.get(chunk.event_type, 0),
-                               STATE_FINAL, 0, crc, len(tag)))
+                               STATE_FINAL, _prio_byte(chunk), crc,
+                               len(tag)))
             f.write(_mask_bytes(chunk))
             f.write(tag)
             f.write(payload)
@@ -193,7 +207,8 @@ class Storage:
             head = f.read(_HEAD.size)
             if len(head) < _HEAD.size:
                 raise ValueError("truncated header")
-            magic, ver, tcode, state, _, crc, tag_len = _HEAD.unpack(head)
+            magic, ver, tcode, state, prio, crc, tag_len = \
+                _HEAD.unpack(head)
             if magic != MAGIC or ver not in (1, VERSION):
                 raise ValueError("bad magic/version")
             route_names = None
@@ -228,6 +243,9 @@ class Storage:
         chunk.records = records
         chunk.locked = True
         chunk.route_names = route_names
+        # QoS class survives a restart (shed-by-priority + readmission
+        # order stay correct for recovered spill); 0 = unstamped
+        chunk.priority = prio - 1 if prio else None
         return chunk
 
     def scan_backlog(self) -> List[Chunk]:

@@ -84,6 +84,9 @@ SITES: Tuple[str, ...] = (
     "engine.flush_dispatch",     # Engine.flush_all, chunks finalized, tasks not yet spawned
     "engine.retry_schedule",     # Engine._schedule_retry, before the timer registers
     "engine.shutdown_quarantine",  # Engine._flush_one / _drop_retry, before quarantine
+    "engine.reload_commit",      # ReloadTxn.commit: new tables built, old
+                                 # generation still live (crash → old config)
+    "qos.admit",                 # Qos.admit, before the token-bucket take
     "upstream.connect",          # tls.open_connection, before the dial
     "upstream.send",             # outputs_aws._http_request, before the request write
     "upstream.recv",             # outputs_aws._http_request, before the response read

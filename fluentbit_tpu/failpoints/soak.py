@@ -30,6 +30,15 @@ both fsync'd per line so they survive the SIGKILL. The delivery sink
 honors a ``soak.deliver`` failpoint so retry/backoff scenarios can be
 driven from the same DSL.
 
+fbtpu-qos extensions (QOS.md): ``--reloads N`` performs N hot-reload
+generation swaps *while ingesting* — each replaces the grep filter
+in-place (a full native DFA/GrepTables recompile mid-stream) and
+toggles an auxiliary output add/remove — so reload-under-load soaks to
+the same acked ⊆ delivered contract. ``--flood-rate BYTES/S`` puts
+input 0 on a quota'd tenant (``t0``); pushes its token bucket defers
+return -1 and are deliberately NOT acked, so the contract audits that
+quota-deferral never loses an *admitted* record.
+
 Used by ``tests/test_failpoints.py``: a short deterministic matrix in
 tier-1 and the full matrix behind the ``soak``/``slow`` markers.
 """
@@ -114,6 +123,12 @@ def child_main(argv: Optional[Sequence[str]] = None) -> int:
                     "drain-time failpoints deterministically)")
     ap.add_argument("--settle", type=float, default=2.0,
                     help="recover mode: seconds to wait for redelivery")
+    ap.add_argument("--reloads", type=int, default=0,
+                    help="hot-reload generation swaps spread across the "
+                    "ingest (grep DFA recompile + aux output toggle)")
+    ap.add_argument("--flood-rate", default="",
+                    help="bytes/sec quota for input 0's tenant; "
+                    "deferred pushes are not acked")
     args = ap.parse_args(argv)
 
     import fluentbit_tpu as flb
@@ -128,20 +143,56 @@ def child_main(argv: Optional[Sequence[str]] = None) -> int:
         "storage.checksum": "on",
         "scheduler.base": "0.05", "scheduler.cap": "0.1",
     })
-    in_ffd = [
-        ctx.input("lib", tag=f"soak.{i}", **{"storage.type": "filesystem"})
-        for i in range(max(1, args.tags))
-    ]
+    in_ffd = []
+    for i in range(max(1, args.tags)):
+        props = {"storage.type": "filesystem"}
+        if args.flood_rate:
+            # per-input tenants; input 0 is the quota'd (flooding) one
+            props["tenant"] = f"t{i}"
+            if i == 0:
+                props["tenant.rate"] = args.flood_rate
+                props["tenant.overflow"] = "defer"
+        in_ffd.append(ctx.input("lib", tag=f"soak.{i}", **props))
+    if args.reloads:
+        # a real DFA-backed filter so each reload's replace_filter is a
+        # full native table recompile (the rule keeps every record:
+        # Exclude on a field the records don't carry)
+        ctx.filter("grep", match="soak.*", exclude="log ZZZNOPE")
     ctx.output("soak_sink", match="soak.*", path=delivered,
                run_id=args.run_id)
     ctx.start()
     try:
         if args.mode == "ingest":
+            reload_every = (max(1, args.records // (args.reloads + 1))
+                            if args.reloads else 0)
+            done_reloads = 0
             for seq in range(args.records):
                 ffd = in_ffd[seq % len(in_ffd)]
-                ctx.push(ffd, json.dumps({"seq": seq}))
-                # ack AFTER push returned: the write-through is on disk
-                _append_line(ingested, str(seq))
+                got = ctx.push(ffd, json.dumps({"seq": seq}))
+                if got:
+                    # ack AFTER push returned: the write-through is on
+                    # disk (quota-deferred/shed pushes are never acked)
+                    _append_line(ingested, str(seq))
+                # the reload trigger is independent of this push's
+                # admission verdict — a deferred push at the boundary
+                # must not silently skip a generation swap
+                if reload_every and done_reloads < args.reloads \
+                        and seq and seq % reload_every == 0:
+                    txn = ctx.engine.reload_txn()
+                    txn.replace_filter("grep.0")  # DFA recompile
+                    if done_reloads % 2 == 0:
+                        txn.add_output("null", match="aux.*")
+                    else:
+                        # resolve the live instance name: numbering
+                        # never recycles a retired name, so the null
+                        # output added two reloads ago is null.N, not
+                        # a fixed null.0
+                        victim = next(
+                            o.name for o in ctx.engine.outputs
+                            if o.plugin.name == "null")
+                        txn.remove_output(victim)
+                    txn.commit()
+                    done_reloads += 1
             if args.final_flush:
                 ctx.flush_now()
         else:  # recover: the backlog re-dispatches on the flush timer
@@ -206,6 +257,7 @@ def run_child(workdir: str, mode: str, *, failpoints: str = "",
               seed: int = 0, records: int = 20, tags: int = 1,
               flush: str = "200ms", run_id: str = "0",
               final_flush: bool = False, settle: float = 2.0,
+              reloads: int = 0, flood_rate: str = "",
               timeout: float = 60.0) -> int:
     """Spawn one child run; returns its exit code (negative = signal,
     matching ``subprocess`` convention — a crash failpoint shows up as
@@ -219,6 +271,10 @@ def run_child(workdir: str, mode: str, *, failpoints: str = "",
            "--records", str(records), "--tags", str(tags),
            "--flush", flush, "--run-id", run_id,
            "--settle", str(settle)]
+    if reloads:
+        cmd += ["--reloads", str(reloads)]
+    if flood_rate:
+        cmd += ["--flood-rate", flood_rate]
     if final_flush:
         cmd.append("--final-flush")
     proc = subprocess.run(cmd, env=env, timeout=timeout,

@@ -146,7 +146,7 @@ class FluxFilter(FilterPlugin):
             ename = self.emitter_name or \
                 f"emitter_for_{instance.display_name}"
             ins = engine.hidden_input(
-                "emitter", alias=ename,
+                "emitter", owner=instance, alias=ename,
                 mem_buf_limit=self.emitter_mem_buf_limit,
             )
             self._emitter = ins.plugin

@@ -202,7 +202,7 @@ class LogToMetricsFilter(FilterPlugin):
         if engine is not None:
             name = self.emitter_name or f"emitter_for_{instance.display_name}"
             ins = engine.hidden_input(
-                "emitter", alias=name,
+                "emitter", owner=instance, alias=name,
                 mem_buf_limit=self.emitter_mem_buf_limit,
             )
             self.emitter = ins.plugin

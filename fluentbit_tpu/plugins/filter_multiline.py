@@ -48,7 +48,7 @@ class MultilineFilter(FilterPlugin):
                           lambda *_: None, self.flush_ms)
             name = self.emitter_name or f"emitter_for_{instance.display_name}"
             ins = engine.hidden_input(
-                "emitter", alias=name,
+                "emitter", owner=instance, alias=name,
                 mem_buf_limit=self.emitter_mem_buf_limit,
             )
             self.emitter = ins.plugin

@@ -93,6 +93,7 @@ class RewriteTagFilter(FilterPlugin):
             name = self.emitter_name or f"emitter_for_{instance.display_name}"
             ins = engine.hidden_input(
                 "emitter",
+                owner=instance,
                 alias=name,
                 mem_buf_limit=self.emitter_mem_buf_limit,
                 **{"storage.type": self.emitter_storage_type},

@@ -365,7 +365,8 @@ class SamplingProcessor(ProcessorPlugin):
         FLB_SCHED_TIMER_CB_PERM of sampling_tail.c:860) and re-injects
         sampled traces with no processors attached."""
         ins = engine.hidden_input(
-            "emitter", alias=f"emitter_for_{self.instance.name}")
+            "emitter", owner=self.instance,
+            alias=f"emitter_for_{self.instance.name}")
         self._emitter = ins
         proc = self
 

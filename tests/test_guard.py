@@ -603,3 +603,28 @@ def test_io_deadline_raises_oserror_compatible_timeout():
         return 42
 
     assert asyncio.run(run()) == 42
+
+
+def test_shed_readmission_is_priority_ordered():
+    """Regression (fbtpu-qos satellite): probe-ready shed chunks used
+    to readmit in FIFO shed order regardless of priority; they must
+    re-enter the backlog highest-priority-first so recovery bandwidth
+    goes to the classes that matter."""
+    from fluentbit_tpu.codec.chunk import Chunk
+    from fluentbit_tpu.codec.events import encode_event
+
+    ctx = flb.create()
+    e = ctx.engine
+    g = e.guard
+    # breaker-shed entries in deliberately unsorted FIFO shed order; no
+    # breaker exists for the route, which counts as probe-ready
+    for prio in (5, 0, 7, 2):
+        c = Chunk("t")
+        c.append(encode_event({"p": prio}, None), 1)
+        c.priority = prio
+        c.route_names = ("out.0",)
+        with g._lock:
+            g._shed.append((c, "breaker"))
+    g._shed_pass(time.time(), occupancy=0, on_loop=False)
+    assert g.shed_count() == 0
+    assert [c.priority for c in e._backlog] == [0, 2, 5, 7]

@@ -889,3 +889,120 @@ def test_shipped_flux_plugin_passes_the_gate():
     import fluentbit_tpu.flux.plugin as fp
 
     assert lint_paths([fp.__file__]) == []
+
+
+# ---------------------------------------------------------------------
+# qos-unmetered-ingest (fbtpu-qos metered-ingest invariant)
+# ---------------------------------------------------------------------
+
+_QOS_PATH = "fluentbit_tpu/core/ingest_fixture.py"
+
+BAD_UNMETERED = """
+class Engine:
+    def ingest_fast(self, ins, tag, data):
+        with ins.ingest_lock:
+            return ins.pool.append(tag, data, 1)
+"""
+
+GOOD_METERED = """
+class Engine:
+    def ingest_fast(self, ins, tag, data):
+        if self.qos.admit(ins, len(data)):
+            return -1
+        with ins.ingest_lock:
+            return ins.pool.append(tag, data, 1)
+"""
+
+
+def test_unmetered_ingest_fires():
+    got = lint_source(BAD_UNMETERED, _QOS_PATH)
+    assert "qos-unmetered-ingest" in rules(got)
+
+
+def test_metered_ingest_quiet():
+    assert lint_source(GOOD_METERED, _QOS_PATH) == []
+
+
+BAD_UNMETERED_INTERPROC = """
+class Engine:
+    def ingest_fast(self, ins, tag, data):
+        return self._write(ins, tag, data)
+
+    def _write(self, ins, tag, data):
+        with ins.ingest_lock:
+            return ins.pool.append(tag, data, 1)
+"""
+
+GOOD_METERED_INTERPROC = """
+class Engine:
+    def ingest_fast(self, ins, tag, data):
+        if self.qos.admit(ins, len(data)):
+            return -1
+        return self._write(ins, tag, data)
+
+    def _write(self, ins, tag, data):
+        with ins.ingest_lock:
+            return ins.pool.append(tag, data, 1)
+"""
+
+
+def test_unmetered_ingest_interprocedural():
+    got = lint_source(BAD_UNMETERED_INTERPROC, _QOS_PATH)
+    assert [f.rule for f in got] == ["qos-unmetered-ingest"]
+    # the finding lands on the PUBLIC entry point, not the helper
+    assert got[0].line == 3
+    assert lint_source(GOOD_METERED_INTERPROC, _QOS_PATH) == []
+
+
+def test_unmetered_ingest_private_only_quiet():
+    # a private helper with no public caller is reachable only through
+    # an admitted entry point in some other module — not flagged here
+    helper_only = """
+class Engine:
+    def _write(self, ins, tag, data):
+        with ins.ingest_lock:
+            return ins.pool.append(tag, data, 1)
+"""
+    assert lint_source(helper_only, _QOS_PATH) == []
+
+
+def test_unmetered_ingest_scope_and_suppression():
+    # plugins ingest through Engine.input_*_append (already metered):
+    # out of scope
+    assert lint_source(BAD_UNMETERED,
+                       "fluentbit_tpu/plugins/fixture.py") == []
+    suppressed = BAD_UNMETERED.replace(
+        "def ingest_fast(self, ins, tag, data):",
+        "def ingest_fast(self, ins, tag, data):  "
+        "# fbtpu-lint: allow(qos-unmetered-ingest) replay path, "
+        "admitted at first ingest")
+    assert lint_source(suppressed, _QOS_PATH) == []
+
+
+def test_shipped_engine_ingest_is_metered():
+    # the real entry points must keep calling qos.admit — deleting the
+    # admission from input_log_append would fail THIS, not just the
+    # behavior suite
+    import fluentbit_tpu.core.engine as eng
+
+    assert "qos-unmetered-ingest" not in rules(lint_paths([eng.__file__]))
+
+
+NESTED_CLOSURE_METERED = """
+class Engine:
+    def ingest_batched(self, ins, tag, data):
+        if self.qos.admit(ins, len(data)):
+            return -1
+        def flush(chunk):
+            return ins.pool.append(tag, data, 1)
+        return flush(data)
+"""
+
+
+def test_qos_rule_ignores_nested_closures():
+    """A non-underscore closure inside a metered public function must
+    not be flagged as its own unmetered entry point — the admit call
+    lives in its container."""
+    got = lint_source(NESTED_CLOSURE_METERED, _QOS_PATH)
+    assert "qos-unmetered-ingest" not in rules(got), [
+        f.message for f in got]

@@ -1,0 +1,1395 @@
+"""fbtpu-qos: multi-tenant weighted-fair ingest, graded shedding, hot
+config reload (core/qos.py + core/bucket_queue.py DeficitFairQueue +
+guard shed-by-priority — QOS.md has the contract).
+
+The fairness/quota/shed suites run on fake clocks and hand-driven
+flush cycles (no wall-clock dependence); the reload suites exercise a
+live engine; the soak cases ride the PR-4 failpoint harness
+(fluentbit_tpu.failpoints.soak) to the same acked ⊆ delivered
+at-least-once contract.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu import failpoints
+from fluentbit_tpu.codec.chunk import Chunk
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.core.bucket_queue import DeficitFairQueue
+from fluentbit_tpu.core.scheduler import TokenBucket
+from fluentbit_tpu.failpoints import soak
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _init_pipeline(engine):
+    """Configure + init instances without starting the engine thread
+    (the sync-dispatch harness: flush_all then runs flushes inline)."""
+    for ins in engine.inputs + engine.filters + engine.outputs:
+        if not getattr(ins, "_initialized", False):
+            ins.configure()
+            ins.plugin.init(ins, engine)
+            ins._initialized = True
+
+
+def wait_for(cond, timeout=8.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f"condition not met within {timeout}s")
+
+
+# ---------------------------------------------------------------------
+# TokenBucket (fake clock)
+# ---------------------------------------------------------------------
+
+
+def test_token_bucket_refill_and_burst_cap():
+    clk = _Clock()
+    tb = TokenBucket(rate=100.0, burst=50.0, clock=clk)
+    assert tb.try_take(50)          # full burst available at t0
+    assert not tb.try_take(1)       # drained
+    clk.t = 0.25                    # +25 tokens
+    assert tb.try_take(25)
+    assert not tb.try_take(1)
+    clk.t = 10.0                    # refill clamps at burst, not rate×t
+    assert tb.try_take(50)
+    assert not tb.try_take(1)
+
+
+def test_token_bucket_delay_hint():
+    clk = _Clock()
+    tb = TokenBucket(rate=10.0, burst=10.0, clock=clk)
+    assert tb.delay_for(5) == 0.0
+    assert tb.try_take(10)
+    assert tb.delay_for(5) == pytest.approx(0.5)
+    # a cost above capacity is clamped: the hint is "when the bucket is
+    # as full as it can get", never infinity for a finite rate
+    assert tb.delay_for(100) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------
+# DeficitFairQueue: strict priority, DWRR weight shares, floor
+# ---------------------------------------------------------------------
+
+
+def test_fair_queue_strict_priority_across_classes():
+    q = DeficitFairQueue(quantum=100)
+    q.push(5, "low", 1.0, 10, "l1")
+    q.push(0, "hi", 1.0, 10, "h1")
+    q.push(5, "low", 1.0, 10, "l2")
+    q.push(0, "hi", 1.0, 10, "h2")
+    assert q.drain() == ["h1", "h2", "l1", "l2"]
+    assert len(q) == 0 and not q
+
+
+def test_fair_queue_dwrr_weight_share_property():
+    """The ISSUE-pinned DWRR bound: while both flows are backlogged, a
+    tenant never exceeds its weight share by more than one max-cost
+    item per round. With equal item costs == quantum·w_B the service
+    pattern is exact: 3×A then 1×B, so |served_A − 3·served_B| ≤ 3 at
+    every prefix."""
+    q = DeficitFairQueue(quantum=100)
+    for k in range(60):
+        q.push(0, "A", 3.0, 100, ("A", k))
+        q.push(0, "B", 1.0, 100, ("B", k))
+    served = {"A": 0, "B": 0}
+    while served["A"] < 60 and served["B"] < 60:
+        name, _item = q.pop_ex()
+        served[name] += 1
+        assert abs(served["A"] - 3 * served["B"]) <= 3, served
+    # A (3× weight) exhausts first; B drains the tail
+    assert served["A"] == 60
+    rest = q.drain()
+    assert len(rest) == 60 - served["B"]
+
+
+def test_fair_queue_dwrr_random_costs_bounded_discrepancy():
+    """Same property under variable costs: normalized service
+    discrepancy |S_A/w_A − S_B/w_B| stays within one quantum plus one
+    max-cost-per-unit-weight — the classic DRR fairness bound."""
+    import random
+
+    rng = random.Random(7)
+    q = DeficitFairQueue(quantum=1000)
+    costs = {"A": [], "B": []}
+    for k in range(200):
+        for name in ("A", "B"):
+            c = rng.randint(100, 1500)
+            costs[name].append(c)
+            q.push(0, name, {"A": 2.0, "B": 1.0}[name], c, (name, k))
+    served = {"A": 0.0, "B": 0.0}
+    n = {"A": 0, "B": 0}
+    max_cost = 1500
+    while n["A"] < 200 and n["B"] < 200:
+        name, (who, idx) = q.pop_ex()
+        served[name] += costs[who][idx]
+        n[name] += 1
+        disc = abs(served["A"] / 2.0 - served["B"] / 1.0)
+        assert disc <= 1000 + 2 * max_cost, (disc, n)
+
+
+def test_fair_queue_zero_weight_floor_prevents_starvation():
+    """A zero-weight tenant still drains at the floor rate: with
+    floor=0.05 and quantum=100 it accumulates 5/visit, so its
+    100-cost item pops after ~20 rounds — never starves."""
+    q = DeficitFairQueue(quantum=100, weight_floor=0.05)
+    q.push(0, "Z", 0.0, 100, "starved?")
+    for k in range(80):
+        q.push(0, "A", 1.0, 100, ("A", k))
+    order = []
+    while True:
+        got = q.pop_ex()
+        if got is None:
+            break
+        order.append(got[0])
+    z_at = order.index("Z")
+    assert z_at < 40, f"zero-weight flow served too late: {z_at}"
+    assert order.count("Z") == 1 and order.count("A") == 80
+
+
+# ---------------------------------------------------------------------
+# ingest admission: per-tenant quotas on a fake clock
+# ---------------------------------------------------------------------
+
+
+def test_tenant_quota_defers_and_recovers_on_refill():
+    ctx = flb.create(flush="100")
+    clk = _Clock()
+    ctx.engine.qos.clock = clk  # tenants are created lazily: this
+    #                             clock backs every token bucket
+    in_ffd = ctx.input("lib", tag="t", tenant="quotaed",
+                       **{"tenant.rate": "200", "tenant.burst": "200"})
+    ctx.output("null", match="t")
+    _init_pipeline(ctx.engine)
+    ins = ctx._handles[in_ffd]
+    rec = json.dumps({"x": "y" * 40})  # ~60 encoded bytes
+
+    admitted = deferred = 0
+    for _ in range(10):
+        if ctx.push(in_ffd, rec) > 0:
+            admitted += 1
+        else:
+            deferred += 1
+    assert 0 < admitted < 10      # burst admits some, quota defers rest
+    assert deferred == 10 - admitted
+    q = ctx.engine.qos
+    assert q.m_deferred.get(("quotaed",)) == deferred
+    assert q.m_admitted.get(("quotaed",)) > 0
+    hint = q.defer_hint(ins, 60)
+    assert hint > 0
+    clk.t += 2.0                  # refill: 400 bytes of tokens → capped
+    assert ctx.push(in_ffd, rec) > 0
+
+
+def test_tenant_quota_shed_policy_drops_and_counts():
+    ctx = flb.create(flush="100")
+    clk = _Clock()
+    ctx.engine.qos.clock = clk
+    in_ffd = ctx.input("lib", tag="t", tenant="shedder", **{
+        "tenant.rate": "100", "tenant.burst": "100",
+        "tenant.overflow": "shed"})
+    ctx.output("null", match="t")
+    _init_pipeline(ctx.engine)
+    rec = json.dumps({"x": "y" * 60})
+    results = [ctx.push(in_ffd, rec) for _ in range(5)]
+    assert results[0] > 0
+    assert any(r == 0 for r in results[1:])  # shed: dropped, not -1
+    assert ctx.engine.qos.m_shed_in.get(("shedder",)) > 0
+    assert ctx.engine.qos.m_deferred.get(("shedder",)) == 0
+
+
+# ---------------------------------------------------------------------
+# weighted-fair dispatch: deterministic noisy-neighbor cycles
+# ---------------------------------------------------------------------
+
+
+def _run_dispatch_cycles(flood: bool, cycles: int = 8):
+    """Hand-driven flush cycles, engine never started (sync inline
+    flushes): tenant A floods 10× the victims' volume; the per-cycle
+    dispatch budget makes slots scarce, and DWRR hands them out by
+    weight. Per-push unique tags → one chunk per record, so dispatch
+    granularity is real."""
+    ctx = flb.create(flush="1000", **{
+        "qos.cycle_budget": "1200", "qos.quantum": "400"})
+    e = ctx.engine
+    ffd = {}
+    for name, weight in (("A", "1"), ("B", "1"), ("C", "2")):
+        ffd[name] = ctx.input(
+            "lib", tag=name.lower(), tenant=name,
+            **{"tenant.weight": weight})
+    delivered = {"A": [], "B": [], "C": []}
+
+    def cb_for(name):
+        return lambda d, t: delivered[name].extend(
+            ev.body["seq"] for ev in decode_events(d))
+
+    for name in ("A", "B", "C"):
+        ctx.output("lib", match=f"{name.lower()}.*",
+                   callback=cb_for(name))
+    _init_pipeline(e)
+    pushed = {"A": 0, "B": 0, "C": 0}
+    seq = 0
+
+    def push(name, k):
+        nonlocal seq
+        ins = ctx._handles[ffd[name]]
+        data = encode_event({"seq": seq, "pad": "x" * 48}, None)
+        # unique tag per record: one chunk per push
+        got = e.input_log_append(ins, f"{name.lower()}.{seq}", data, 1)
+        assert got == 1
+        pushed[name] += 1
+        seq += 1
+
+    for _cycle in range(cycles):
+        if flood:
+            for k in range(20):   # 10× the victims' per-cycle volume
+                push("A", k)
+        for k in range(2):
+            push("B", k)
+        for k in range(2):
+            push("C", k)
+        e.flush_all()
+    # drain cycles with no new ingest (victims must already be done)
+    return pushed, delivered, e
+
+
+def test_noisy_neighbor_victims_keep_throughput():
+    """Acceptance: with one tenant flooding at 10× the others' volume
+    against a fixed per-cycle dispatch budget, the non-flooding
+    tenants' delivered throughput stays within 20% of their isolated
+    baseline — and nothing admitted is ever lost."""
+    _p0, base, _e0 = _run_dispatch_cycles(flood=False)
+    pushed, flooded, e = _run_dispatch_cycles(flood=True)
+    for victim in ("B", "C"):
+        b, f = len(base[victim]), len(flooded[victim])
+        assert f >= 0.8 * b, (victim, b, f)
+    # the flood is bounded: its backlog parks instead of monopolizing
+    assert len(flooded["A"]) < pushed["A"]
+    assert e._backlog or e.qos.pending_count() == 0
+    # at-least-once for the flood too: draining the backlog with no new
+    # ingest delivers every admitted record
+    for _ in range(200):
+        if not e._backlog:
+            break
+        e.flush_all()
+    assert sorted(flooded["A"]) == sorted(set(flooded["A"]))
+    assert len(flooded["A"]) == pushed["A"]
+
+
+def test_fair_dispatch_is_fifo_for_single_tenant():
+    """Unconfigured pipelines degenerate to one flow: dispatch order
+    stays strict FIFO (bit-compatible with the pre-qos engine)."""
+    ctx = flb.create(flush="1000")
+    e = ctx.engine
+    in_ffd = ctx.input("lib", tag="t")
+    got = []
+    ctx.output("lib", match="t.*",
+               callback=lambda d, t: got.extend(
+                   ev.body["seq"] for ev in decode_events(d)))
+    _init_pipeline(e)
+    ins = ctx._handles[in_ffd]
+    for k in range(12):
+        e.input_log_append(ins, f"t.{k}", encode_event({"seq": k}, None),
+                           1)
+    e.flush_all()
+    assert got == list(range(12))
+
+
+# ---------------------------------------------------------------------
+# shed-by-priority (fake occupancy, no wall clock)
+# ---------------------------------------------------------------------
+
+
+def _graded_engine(task_map_size=8, watermark="0.5"):
+    ctx = flb.create(**{"guard.shed_watermark": watermark})
+    e = ctx.engine
+    e.service.task_map_size = task_map_size
+    # two declared classes → shed-by-priority engages
+    e.qos.tenant("hi", priority=0)
+    e.qos.tenant("lo", priority=7)
+    ctx.output("null", match="*")
+    _init_pipeline(e)
+    return ctx, e
+
+
+def _chunk(priority, tenant, tag="t"):
+    c = Chunk(tag)
+    c.append(encode_event({"p": priority}, None), 1)
+    c.priority = priority
+    c.qos_tenant = tenant
+    return c
+
+
+def test_shed_by_priority_low_class_spills_first():
+    """Acceptance: above the watermark the lowest class spills to
+    storage/parking while the highest class keeps dispatching — its
+    flush path (and therefore p50 latency) is untouched."""
+    ctx, e = _graded_engine()
+    routes = [e.outputs[0]]
+    for k in range(4):  # occupancy = base watermark (0.5 × 8)
+        e._task_map[-k - 1] = object()
+    lo, hi = _chunk(7, "lo"), _chunk(0, "hi")
+    assert e.guard.maybe_shed(lo, routes) is True
+    assert e.guard.maybe_shed(hi, routes) is False
+    assert e.guard.shed_count() == 1
+    assert e.qos.m_priority_shed.get(("lo",)) == 1
+    # mid class: watermark grades linearly between the extremes
+    mid = _chunk(4, "hi")
+    assert e.guard.maybe_shed(mid, routes) is False  # 4 < mid threshold
+    for k in range(2):
+        e._task_map[-10 - k] = object()              # occupancy 6
+    assert e.guard.maybe_shed(mid, routes) is True
+
+
+def test_shed_by_priority_needs_multiple_classes():
+    """Single-class pipelines keep the original park-on-backlog
+    behavior: shedding one class below itself is meaningless."""
+    ctx = flb.create(**{"guard.shed_watermark": "0.5"})
+    e = ctx.engine
+    e.service.task_map_size = 4
+    ctx.output("null", match="*")
+    _init_pipeline(e)
+    for k in range(4):
+        e._task_map[-k - 1] = object()
+    assert e.guard.maybe_shed(_chunk(7, "only"), [e.outputs[0]]) is False
+
+
+def test_priority_shed_readmits_with_hysteresis_highest_first():
+    ctx, e = _graded_engine()
+    routes = [e.outputs[0]]
+    for k in range(8):
+        e._task_map[-k - 1] = object()
+    entries = [_chunk(7, "lo"), _chunk(5, "lo"), _chunk(0, "hi"),
+               _chunk(2, "hi")]
+    for c in entries:
+        assert e.guard.maybe_shed(c, routes) is True
+    assert e.guard.shed_count() == 4
+    # still saturated: hysteresis refuses readmission
+    e.guard._shed_pass(time.time(), occupancy=8, on_loop=False)
+    assert e.guard.shed_count() == 4 and not e._backlog
+    # pressure cleared → everything readmits, HIGHEST priority first
+    e._task_map.clear()
+    e.guard._shed_pass(time.time(), occupancy=0, on_loop=False)
+    assert e.guard.shed_count() == 0
+    assert [c.priority for c in e._backlog] == [0, 2, 5, 7]
+
+
+# ---------------------------------------------------------------------
+# hot reload: bit-exactness across the generation boundary
+# ---------------------------------------------------------------------
+
+
+def _grep_stream(reload_mid: bool) -> bytes:
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("grep", match="t", regex="log ^keep")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for k in range(40):
+            word = "keep" if k % 3 else "drop"
+            # explicit [ts, record] pairs: the byte stream must be
+            # deterministic across the two runs being compared
+            ctx.push(in_ffd, json.dumps(
+                [k, {"log": f"{word}-{k}", "k": k}]))
+            if k == 19:
+                ctx.flush_now()
+                if reload_mid:
+                    txn = ctx.engine.reload_txn()
+                    txn.replace_filter("grep.0")  # full DFA recompile
+                    assert txn.commit() == 1
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    return b"".join(got)
+
+
+def test_reload_grep_dfa_recompile_is_bit_exact():
+    """Satellite: recompile the grep DFA/GrepTables mid-stream; records
+    spanning the generation boundary must match the single-config
+    output byte-for-byte."""
+    assert _grep_stream(False) == _grep_stream(True)
+
+
+def _parser_stream(reload_mid: bool) -> bytes:
+    ctx = flb.create(flush="40ms", grace="1")
+    ctx.parser("re1", Format="regex",
+               Regex=r"^(?<word>[a-z]+) (?<num>\d+)$")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("parser", match="t", key_name="log", parser="re1",
+               reserve_data="true")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for k in range(30):
+            ctx.push(in_ffd, json.dumps([k, {"log": f"word {k}",
+                                             "k": k}]))
+            if k == 14:
+                ctx.flush_now()
+                if reload_mid:
+                    txn = ctx.engine.reload_txn()
+                    # re-register the parser AND recompile the filter
+                    txn.add_parser("re1", Format="regex",
+                                   Regex=r"^(?<word>[a-z]+) (?<num>\d+)$")
+                    txn.replace_filter("parser.0")
+                    assert txn.commit() == 1
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    return b"".join(got)
+
+
+def test_reload_parser_recompile_is_bit_exact():
+    assert _parser_stream(False) == _parser_stream(True)
+
+
+def test_reload_keeps_batched_fast_path_engaged():
+    """The generation swap must not demote the batched/raw fast path:
+    zero batch declines across the reload."""
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("grep", match="t", exclude="log ZZZNOPE")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for k in range(30):
+            ctx.push(in_ffd, json.dumps({"log": f"line {k}"}))
+            if k == 14:
+                txn = ctx.engine.reload_txn()
+                txn.replace_filter("grep.0")
+                txn.commit()
+        ctx.flush_now()
+    finally:
+        ctx.stop()
+    assert sum(len(decode_events(d)) for d in got) == 30
+    assert ctx.engine.m_filter_batch_decline.get(("grep.0",)) == 0
+
+
+# ---------------------------------------------------------------------
+# hot reload: add/remove without dropping in-flight chunks
+# ---------------------------------------------------------------------
+
+
+def test_reload_add_remove_input_output_no_drops():
+    ctx = flb.create(flush="40ms", grace="1")
+    in_a = ctx.input("lib", tag="a")
+    got = {"a": [], "b": []}
+    ctx.output("lib", match="a",
+               callback=lambda d, t: got["a"].append(d))
+    ctx.start()
+    try:
+        ctx.push(in_a, json.dumps({"seq": 0}))
+        # pending (unflushed) chunk in input a's pool — the removal
+        # below must drain it into the backlog, not drop it
+        txn = ctx.engine.reload_txn()
+        txn.add_input("lib", tag="b")
+        txn.add_output("lib", match="b",
+                       callback=lambda d, t: got["b"].append(d))
+        txn.remove_input("lib.0")
+        gen = txn.commit()
+        assert gen == 1
+        assert ctx.engine.reload_count == 1
+        ins_a = ctx._handles[in_a]
+        assert ins_a.removed and ins_a not in ctx.engine.inputs
+        # the new input is live: push through the engine directly
+        ins_b = next(i for i in ctx.engine.inputs if i.tag == "b")
+        ctx.engine.input_log_append(ins_b, "b",
+                                    encode_event({"seq": 1}, None), 1)
+        ctx.flush_now()
+        wait_for(lambda: got["a"] and got["b"])
+    finally:
+        ctx.stop()
+    assert decode_events(got["a"][0])[0].body == {"seq": 0}
+    assert decode_events(got["b"][0])[0].body == {"seq": 1}
+
+
+def test_reload_abort_on_failpoint_keeps_old_generation():
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        failpoints.enable("engine.reload_commit", "1*return(abort)")
+        txn = ctx.engine.reload_txn()
+        txn.add_output("null", match="aux.*")
+        with pytest.raises(failpoints.FailpointError):
+            txn.commit()
+        assert ctx.engine.generation == 0
+        assert ctx.engine.reload_count == 0
+        assert len(ctx.engine.outputs) == 1  # swap never happened
+        ctx.push(in_ffd, json.dumps({"seq": 0}))
+        ctx.flush_now()
+        wait_for(lambda: got)
+    finally:
+        ctx.stop()
+
+
+def test_reload_atomic_under_concurrent_ingest_and_flush():
+    """Satellite: generation/reload_count and the instance lists swap
+    atomically w.r.t. the housekeeping timer — hammer reloads against
+    live ingest + the flush timer and audit zero lost records."""
+    ctx = flb.create(flush="15ms", grace="2")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("grep", match="t", exclude="log ZZZNOPE")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    pushed = []
+    stop = threading.Event()
+
+    def ingest():
+        k = 0
+        while not stop.is_set():
+            if ctx.push(in_ffd, json.dumps({"seq": k})) > 0:
+                pushed.append(k)
+            k += 1
+            time.sleep(0.002)
+
+    t = threading.Thread(target=ingest)
+    t.start()
+    try:
+        reloads = 10
+        for r in range(reloads):
+            txn = ctx.engine.reload_txn()
+            txn.replace_filter("grep.0")
+            if r % 2 == 0:
+                txn.add_output("null", match="aux.*")
+            else:
+                # numbering never recycles: the null output added in
+                # the previous round is null.N, not a fixed null.0
+                victim = next(o.name for o in ctx.engine.outputs
+                              if o.plugin.name == "null")
+                txn.remove_output(victim)
+            txn.commit()
+            time.sleep(0.02)
+        stop.set()
+        t.join()
+        ctx.flush_now()
+        wait_for(lambda: sum(len(decode_events(d)) for d in got)
+                 >= len(pushed))
+    finally:
+        stop.set()
+        t.join(timeout=1)
+        ctx.stop()
+    assert ctx.engine.reload_count == reloads
+    assert ctx.engine.generation == reloads
+    seqs = [ev.body["seq"] for d in got for ev in decode_events(d)]
+    assert sorted(seqs) == sorted(pushed)  # zero drops, zero dupes
+    assert ctx.engine.m_filter_batch_decline.get(("grep.0",)) == 0
+
+
+# ---------------------------------------------------------------------
+# observability: health + /api/v1/qos
+# ---------------------------------------------------------------------
+
+
+def test_health_and_qos_endpoint_expose_tenants_and_generation():
+    ctx = flb.create(flush="100")
+    ctx.input("lib", tag="t", tenant="acme",
+              **{"tenant.weight": "2", "tenant.priority": "1",
+                 "tenant.rate": "1M"})
+    ctx.output("null", match="t")
+    _init_pipeline(ctx.engine)
+    in_ins = ctx.engine.inputs[0]
+    ctx.engine.input_log_append(in_ins, "t",
+                                encode_event({"x": 1}, None), 1)
+    h = ctx.engine.guard.health()
+    assert h["qos"]["generation"] == 0
+    acme = h["qos"]["tenants"]["acme"]
+    assert acme["weight"] == 2.0 and acme["priority"] == 1
+    assert acme["admitted_bytes"] > 0
+    from fluentbit_tpu.core.http_server import AdminServer
+
+    status, body, ctype = AdminServer(ctx.engine)._route(
+        "GET", "/api/v1/qos")
+    assert status == 200
+    obj = json.loads(body)
+    assert "acme" in obj["tenants"] and obj["generation"] == 0
+
+
+# ---------------------------------------------------------------------
+# soak: reload-under-load + crash-at-commit (the PR-4 harness)
+# ---------------------------------------------------------------------
+
+
+def test_soak_reload_under_load_with_retry_faults(tmp_path):
+    """Acceptance: N hot reloads (DFA recompile + output add/remove)
+    while ingesting with armed failpoints — zero dropped in-flight
+    chunks, at-least-once contract holds."""
+    d = str(tmp_path)
+    rc = soak.run_child(d, "ingest", records=48, tags=2, flush="100ms",
+                        run_id="1", reloads=3, final_flush=True,
+                        failpoints="soak.deliver=2*return(inj)")
+    assert rc == 0
+    outcome = soak.SoakOutcome(d)
+    assert len(outcome.acked) == 48
+    soak.verify_contract(outcome, restarts=0, declared_retries=2)
+
+
+def test_soak_crash_during_reload_commit_recovers(tmp_path):
+    """SIGKILL in the reload-commit window (new tables built, old
+    generation live): every acked record recovers and delivers on the
+    old configuration."""
+    d = str(tmp_path)
+    rc = soak.run_child(d, "ingest", records=48, tags=2, flush="5s",
+                        run_id="1", reloads=2,
+                        failpoints="engine.reload_commit=1*crash")
+    assert rc in (-9, 137)
+    assert soak.run_child(d, "recover", run_id="2") == 0
+    outcome = soak.SoakOutcome(d)
+    assert outcome.acked  # crashed mid-ingest, after some acks
+    soak.verify_contract(outcome, restarts=1)
+
+
+def test_soak_flood_tenant_never_loses_admitted_records(tmp_path):
+    """A quota'd flooding tenant defers (un-acked) pushes; everything
+    that WAS admitted still meets the at-least-once contract."""
+    d = str(tmp_path)
+    rc = soak.run_child(d, "ingest", records=60, tags=3, flush="100ms",
+                        run_id="1", flood_rate="300",
+                        final_flush=True)
+    assert rc == 0
+    outcome = soak.SoakOutcome(d)
+    # input 0 (tenant t0, 300 B/s) saw ~20 of the 60 records; its
+    # quota must have deferred some, and every ack must deliver
+    assert len(outcome.acked) < 60
+    assert len(outcome.acked) >= 40  # the unquota'd tenants all landed
+    soak.verify_contract(outcome, restarts=0)
+
+
+# ---------------------------------------------------------------------
+# review regressions: oversized-cost debt, quantum floor, reload unwind
+# ---------------------------------------------------------------------
+
+
+def test_token_bucket_oversized_cost_admitted_with_debt():
+    """A cost above the burst capacity must admit once the bucket is
+    full (charging the full cost as debt), not defer forever against a
+    finite delay hint."""
+    clk = _Clock()
+    tb = TokenBucket(rate=10.0, burst=10.0, clock=clk)
+    assert tb.try_take(25)            # full bucket: oversized admits
+    assert not tb.try_take(1)         # 15 tokens of debt outstanding
+    # the hint and the admit threshold agree: 1 token needs the debt
+    # repaid first — (1 - (-15)) / 10
+    assert tb.delay_for(1) == pytest.approx(1.6)
+    clk.t = 1.6
+    assert tb.try_take(1)
+    clk.t = 10.0                      # refill clamps at burst from debt
+    assert tb.try_take(10)
+    assert not tb.try_take(1)
+
+
+def test_fair_queue_non_positive_quantum_clamped():
+    """quantum <= 0 would add zero deficit per visit and spin pop_ex
+    forever while holding the qos lock — it clamps to 1 instead."""
+    for quantum in (0, -5):
+        q = DeficitFairQueue(quantum=quantum)
+        q.push(0, "t", 1.0, 100.0, "item")
+        assert q.pop_ex() == ("t", "item")
+        assert q.pop_ex() is None
+
+
+def test_dispatched_metric_counts_once_across_repark():
+    """pop_ready must not count a chunk the caller then reparks
+    (task-map full): accounting moved to note_dispatched."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.output("null", match="*")
+    qos = ctx.engine.qos
+    c = _chunk(0, "app")
+    qos.enqueue(None, c)
+    popped = qos.pop_ready()
+    assert popped is c
+    assert qos.m_dispatched.get(("app",)) == 0  # not dispatched yet
+    qos.note_dispatched(popped)
+    assert qos.m_dispatched.get(("app",)) == 1
+
+
+def test_reload_remove_and_replace_same_filter_rejected():
+    """remove_filter + replace_filter of the same target must fail the
+    pre-validation with ValueError, not escape as StopIteration
+    mid-commit."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.filter("grep", match="t", regex="log x")
+    ctx.output("null", match="*")
+    txn = ctx.engine.reload_txn()
+    txn.remove_filter("grep.0")
+    txn.replace_filter("grep.0")
+    with pytest.raises(ValueError, match="both removed and replaced"):
+        txn.commit()
+    assert ctx.engine.generation == 0
+    assert len(ctx.engine.filters) == 1
+
+
+def test_reload_build_failure_unwinds_parser_swap():
+    """A build-phase failure (unknown plugin) must leave the OLD
+    generation fully intact — including the parser dict, which is
+    swapped early so new filters can resolve new parsers at init."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.output("null", match="*")
+    eng = ctx.engine
+    old_parsers = eng.parsers
+    txn = eng.reload_txn()
+    txn.add_parser("qos_tmp", format="json")
+    txn.add_filter("definitely_not_a_plugin")
+    with pytest.raises(Exception):
+        txn.commit()
+    assert eng.parsers is old_parsers       # un-swapped on abort
+    assert "qos_tmp" not in eng.parsers
+    assert eng.generation == 0 and eng.reload_count == 0
+
+
+def test_reload_abort_on_failpoint_unwinds_parser_swap():
+    """An injected (non-crash) reload_commit error aborts through the
+    same unwind as a build failure: parsers back on the old dict."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.output("null", match="*")
+    eng = ctx.engine
+    old_parsers = eng.parsers
+    failpoints.enable("engine.reload_commit", "1*return(abort)")
+    txn = eng.reload_txn()
+    txn.add_parser("qos_tmp", format="json")
+    with pytest.raises(failpoints.FailpointError):
+        txn.commit()
+    assert eng.parsers is old_parsers
+    assert "qos_tmp" not in eng.parsers
+    assert eng.generation == 0
+
+
+def test_removed_input_refuses_late_appends():
+    """Appends racing a removal must be refused (0 ingested, un-acked)
+    once the pool is drained — not acked into an orphaned pool that
+    flush_all never visits again (silent loss)."""
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        txn = ctx.engine.reload_txn()
+        txn.remove_input("lib.0")
+        txn.commit()
+        ins = ctx._handles[in_ffd]
+        assert ins.removed
+        data = encode_event({"seq": 99}, None)
+        assert ctx.engine.input_log_append(ins, "t", data, 1) == 0
+        assert ctx.engine.input_event_append(
+            ins, "t", data, "logs", 1) == 0
+        ctx.flush_now()
+        time.sleep(0.1)
+    finally:
+        ctx.stop()
+    assert not got  # the refused appends never surfaced downstream
+
+
+def test_dispatch_resolves_route_names_over_stale_mask():
+    """A reload can reorder the outputs list while a mask-stamped chunk
+    sits in flush_all's in-flight window (past the pool/backlog
+    mask-clearing pass): route NAMES must win over the positional
+    bitmask or the chunk misroutes / silently deletes."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.output("null", match="t")     # index 0
+    ctx.output("stdout", match="t")   # index 1
+    eng = ctx.engine
+    seen = []
+    eng.guard.maybe_shed = lambda chunk, routes: (
+        seen.append([o.display_name for o in routes]), True)[1]
+    c = _chunk(0, "app")
+    c.routes_mask = 0b01              # stale: bit 0 → null.0
+    c.route_names = ("stdout.0",)     # authoritative persisted names
+    assert eng._dispatch_chunk(c)
+    assert seen == [["stdout.0"]]
+
+
+def test_backpressure_rejection_does_not_charge_quota():
+    """mem_buf_limit backpressure (-1, caller retries the SAME bytes)
+    must be checked before tenant admission — otherwise every rejected
+    retry drains the token bucket on data that was never ingested."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("dummy", tag="t",
+              **{"tenant": "metered", "tenant.rate": "100000",
+                 "tenant.burst": "100000"})
+    ctx.output("null", match="*")
+    eng = ctx.engine
+    _init_pipeline(eng)
+    ins = eng.inputs[0]
+    ins.mem_buf_limit = 1   # any pending bytes → over
+    data = encode_event({"k": "v"}, None)
+    assert eng.input_log_append(ins, "t", data, 1) == 1  # pool empty
+    bucket = eng.qos.tenant_for_input(ins).bucket
+    before = bucket.tokens
+    assert eng.input_log_append(ins, "t", data, 1) == -1  # over limit
+    assert ins.paused
+    # the rejection happened BEFORE admission: nothing was charged
+    # (tokens only refill between the two reads)
+    assert bucket.tokens >= before
+
+
+def test_removed_input_append_refunds_quota():
+    """The removed-input refusal happens AFTER admission (the flag
+    lives under the ingest lock) — the charged tokens must come back,
+    or a reload race permanently drains the tenant's bucket."""
+    ctx = flb.create(flush="1s", grace="1")
+    in_ffd = ctx.input("lib", tag="t",
+                       **{"tenant": "m", "tenant.rate": "1",
+                          "tenant.burst": "1000"})
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        txn = ctx.engine.reload_txn()
+        txn.remove_input("lib.0")
+        txn.commit()
+        ins = ctx._handles[in_ffd]
+        bucket = ctx.engine.qos.tenant_for_input(ins).bucket
+        before = bucket.tokens
+        data = encode_event({"seq": 1}, None)
+        assert ctx.engine.input_log_append(ins, "t", data, 1) == 0
+        # charged ~len(data) then refunded (refill at 1 B/s is noise)
+        assert bucket.tokens >= before - 0.5
+    finally:
+        ctx.stop()
+
+
+def test_reload_added_server_input_starts_listening():
+    """ensure_collector must give reload-added inputs the same
+    dispatch as startup: a push-server input (tcp) gets its listener
+    task — not silently nothing."""
+    import socket
+    got = []
+    ctx = flb.create(flush="40ms", grace="1")
+    ctx.input("lib", tag="seed")
+    ctx.output("lib", match="*", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        port = 24868
+        txn = ctx.engine.reload_txn()
+        txn.add_input("tcp", tag="net", listen="127.0.0.1",
+                      port=str(port))
+        txn.commit()
+        def _send():
+            try:
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=2.0)
+            except OSError:
+                return False  # listener not up yet: keep retrying
+            s.sendall(b'{"via": "tcp"}\n')
+            s.close()
+            return True
+        wait_for(lambda: (_send() if not got else True) and got,
+                 timeout=12.0, interval=0.25)
+    finally:
+        ctx.stop()
+    assert decode_events(got[0])[0].body["via"] == "tcp"
+
+
+def test_reload_added_threaded_input_gets_thread():
+    """A reload-added threaded interval input must collect on its own
+    OS thread (a blocking collect() on the loop would stall flushes)."""
+    ctx = flb.create(flush="40ms", grace="1")
+    ctx.input("lib", tag="seed")
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        txn = ctx.engine.reload_txn()
+        txn.add_input("dummy", tag="d", rate="5", threaded="on")
+        txn.commit()
+        ins = next(i for i in ctx.engine.inputs if i.tag == "d")
+        wait_for(lambda: getattr(ins, "collector_thread", None)
+                 is not None and ins.collector_thread.is_alive())
+    finally:
+        ctx.stop()
+
+
+def test_reload_drained_chunks_keep_tenant_stamp():
+    """Chunks drained from a removed input re-enter via the backlog
+    (no input to resolve from): they must keep the removed input's
+    tenant/priority, not degrade to the default class mid-reload."""
+    ctx = flb.create(flush="10s", grace="1")  # no flush interference
+    in_ffd = ctx.input("lib", tag="t",
+                       **{"tenant": "gold", "tenant.priority": "0"})
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        assert ctx.push(in_ffd, '{"seq": 1}') == 1
+        txn = ctx.engine.reload_txn()
+        txn.remove_input("lib.0")
+        txn.commit()
+        with ctx.engine._ingest_lock:
+            backlog = list(ctx.engine._backlog)
+        assert backlog, "pending chunk should have drained to backlog"
+        assert all(c.qos_tenant == "gold" and c.priority == 0
+                   for c in backlog)
+    finally:
+        ctx.stop()
+
+
+def test_retired_output_reaped_after_inflight_settles():
+    """A hot-reload-removed output must be reaped (pool stopped,
+    plugin exited) by the housekeeping pass once no in-flight task
+    routes to it — not held until engine.stop()."""
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.output("null", match="t", workers="1")
+    ctx.start()
+    try:
+        ctx.push(in_ffd, '{"seq": 1}')
+        wait_for(lambda: got)
+        retired = ctx.engine.outputs[1]
+        assert retired.worker_pool is not None
+        txn = ctx.engine.reload_txn()
+        txn.remove_output("null.0")
+        txn.commit()
+        assert retired in ctx.engine._retired_outputs
+        ctx.push(in_ffd, '{"seq": 2}')  # drive flush cycles
+        # the reaper delists under the lock, then stops the pool
+        # outside it (pool.stop joins worker threads that may need
+        # the lock) — wait on the LAST step of that sequence
+        wait_for(lambda: retired.worker_pool is None)
+        assert retired not in ctx.engine._retired_outputs
+    finally:
+        ctx.stop()
+
+
+def test_shared_tenant_contract_registered_eagerly_at_start():
+    """Input B carries the shared tenant's rate contract: the quota
+    must bind at start(), before input A's first append — lazy
+    registration would let A flood unmetered until B ingests."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="a", **{"tenant": "shared"})
+    ctx.input("lib", tag="b",
+              **{"tenant": "shared", "tenant.rate": "1000"})
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        t = ctx.engine.qos.tenant("shared")
+        assert t.bucket is not None  # contract live before any append
+        assert t.bucket.rate == 1000.0
+    finally:
+        ctx.stop()
+
+
+def test_defer_pauses_input_and_resumes_on_refill():
+    """DEFER must use the mem_buf_limit pause contract: collector
+    inputs ignore -1 and have already consumed their source, so
+    without a pause every over-quota read is silently dropped while
+    counted 'deferred'. Housekeeping resumes once the bucket refills."""
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t",
+                       **{"tenant": "m", "tenant.rate": "60",
+                          "tenant.burst": "60"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        ins = ctx._handles[in_ffd]
+        # drain the burst, then one more append defers AND pauses
+        while ctx.push(in_ffd, '{"fill": "xxxxxxxxxxxx"}'):
+            pass
+        assert ins.paused and ins.paused_by_qos
+        # the pool-drain resume must NOT undo a quota pause while the
+        # bucket cannot admit: force ~1.3s of debt (60 B/s refill) and
+        # check the pause survives several flush cycles
+        ctx.engine.qos.tenant("m").bucket.tokens = -50.0
+        time.sleep(0.15)
+        assert ins.paused and ins.paused_by_qos
+        # the 60 B/s refill re-admits within a couple of seconds:
+        # the flush-timer housekeeping must un-pause
+        wait_for(lambda: not ins.paused)
+        assert not ins.paused_by_qos
+        assert ctx.push(in_ffd, '{"after": 1}') == 1
+    finally:
+        ctx.stop()
+
+
+def test_reload_replace_filter_does_not_leak_hidden_emitters():
+    """Each rewrite_tag replacement registers a fresh hidden emitter;
+    the swapped-out filter's old emitter must unlink with it instead
+    of accumulating one orphaned input per reload."""
+    ctx = flb.create(flush="40ms", grace="1")
+    ctx.input("lib", tag="t")
+    ctx.filter("rewrite_tag", match="t",
+               rule="$log ^(x) renamed false")
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        baseline = len(ctx.engine.inputs)
+        for _ in range(3):
+            txn = ctx.engine.reload_txn()
+            txn.replace_filter("rewrite_tag.0")
+            txn.commit()
+        assert len(ctx.engine.inputs) == baseline
+    finally:
+        ctx.stop()
+
+
+def test_concurrent_reload_commits_do_not_lose_updates():
+    """Two racing transactions must serialize: each snapshot is taken
+    under the reload lock, so neither swap drops the other's change."""
+    ctx = flb.create(flush="40ms", grace="1")
+    ctx.input("lib", tag="t")
+    ctx.output("null", match="t")
+    ctx.start()
+    try:
+        def add(match):
+            txn = ctx.engine.reload_txn()
+            txn.add_output("null", match=match)
+            txn.commit()
+        ts = [threading.Thread(target=add, args=(m,))
+              for m in ("aux.a", "aux.b")]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=10)
+        assert len(ctx.engine.outputs) == 3, \
+            [o.display_name for o in ctx.engine.outputs]
+        assert ctx.engine.reload_count == 2
+    finally:
+        ctx.stop()
+
+
+def test_tenant_redeclaration_updates_burst():
+    """tenant.burst-only changes must rebuild the bucket, and a
+    rate-only change keeps the declared burst (last declaration
+    wins, absent keys mean no change)."""
+    ctx = flb.create(flush="1s", grace="1")
+    q = ctx.engine.qos
+    q.clock = _Clock()
+    t = q.tenant("x", rate=100.0, burst=10.0)
+    assert t.bucket.capacity == 10.0
+    q.tenant("x", burst=50.0)          # burst-only re-declaration
+    assert t.bucket.capacity == 50.0 and t.bucket.rate == 100.0
+    q.tenant("x", rate=200.0)          # rate-only keeps the burst
+    assert t.bucket.rate == 200.0 and t.bucket.capacity == 50.0
+
+
+def test_pool_rotate_conditional_closes_active_mask_chunks():
+    """The active map keys on routes_mask: across a reload the same
+    mask value means a DIFFERENT route set, so rotate_conditional must
+    close active conditional chunks (they flush under their stamped
+    names) and let the next append open a fresh chunk."""
+    from fluentbit_tpu.codec.chunk import ChunkPool
+    pool = ChunkPool("in")
+    data = encode_event({"n": 1}, None)
+    c1 = pool.append("t", data, 1, routes_mask=0b10)
+    c1.route_names = ("old_out",)
+    plain = pool.append("t", data, 1)  # unconditional: untouched
+    pool.rotate_conditional()
+    c2 = pool.append("t", data, 1, routes_mask=0b10)
+    assert c2 is not c1                # fresh chunk, fresh names
+    assert c2.route_names is None
+    assert pool.append("t", data, 1) is plain  # mask-0 chunk kept
+    drained = pool.drain()
+    assert c1 in drained and c1.route_names == ("old_out",)
+
+
+def test_reload_instance_numbering_never_collides():
+    """Append-only count numbering collides after a reload removes a
+    lower-numbered sibling (remove lib.0, keep lib.1, add lib → count
+    says lib.1). New instances must bump past taken names — and never
+    reuse a retired name (fresh instance, fresh metric series)."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="a")          # lib.0
+    ctx.input("lib", tag="b")          # lib.1
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        txn = ctx.engine.reload_txn()
+        txn.remove_input("lib.0")
+        txn.add_input("lib", tag="c")
+        txn.commit()
+        names = [i.name for i in ctx.engine.inputs]
+        assert len(names) == len(set(names)), names
+        assert "lib.0" not in names    # retired name not recycled
+        added = next(i for i in ctx.engine.inputs if i.tag == "c")
+        assert added.name == "lib.2"
+        # remove the ONLY output of a plugin, then re-add the plugin:
+        # count-of-peers says null.0 again, but a guard-shed chunk may
+        # still carry route_names=("null.0",) — the newcomer must NOT
+        # inherit that name (it would receive the dead route's data)
+        txn = ctx.engine.reload_txn()
+        txn.remove_output("null.0")
+        txn.add_output("null", match="nothing")
+        txn.commit()
+        readded = next(o for o in ctx.engine.outputs
+                       if o.plugin.name == "null")
+        assert readded.name == "null.1"
+    finally:
+        ctx.stop()
+
+
+def test_reload_removed_input_drops_trace_tap():
+    """A chunk-trace tap holds its target (and the hidden trace
+    emitter) through engine.traces: removing the input via reload must
+    drop the tap and unlink the emitter, and a same-named replacement
+    must be traceable again."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="a")          # lib.0
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        eng = ctx.engine
+        baseline = len(eng.inputs)
+        assert eng.enable_trace("lib.0")
+        assert "lib.0" in eng.traces
+        assert len(eng.inputs) == baseline + 1  # hidden trace emitter
+        txn = eng.reload_txn()
+        txn.remove_input("lib.0")
+        txn.add_input("lib", tag="b")
+        txn.commit()
+        assert "lib.0" not in eng.traces
+        emitters = [i for i in eng.inputs
+                    if getattr(i, "_hidden_owner", None) is not None]
+        assert not emitters            # trace emitter unlinked
+        replacement = next(i for i in eng.inputs if i.tag == "b")
+        assert eng.enable_trace(replacement.name)
+    finally:
+        ctx.stop()
+
+
+def test_absorbed_dispatch_spends_no_metric_or_budget():
+    """Guard-shed and no-route chunks are handled without a task slot:
+    _dispatch_chunk reports ABSORBED and flush_all must charge neither
+    note_dispatched (metrics/lag) nor the qos cycle budget."""
+    from fluentbit_tpu.core.engine import ABSORBED, DISPATCHED
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("dummy", tag="t")
+    ctx.output("null", match="t")
+    eng = ctx.engine
+    for o in eng.outputs:
+        o.configure()              # build the real route (match="t")
+    # no-route: tag matches no output
+    assert eng._dispatch_chunk(_chunk(0, "app", tag="miss")) == ABSORBED
+    # guard-shed: every route sheds
+    eng.guard.maybe_shed = lambda chunk, routes: True
+    assert eng._dispatch_chunk(_chunk(0, "app")) == ABSORBED
+    eng.guard.maybe_shed = lambda chunk, routes: False
+    assert eng._dispatch_chunk(_chunk(0, "app")) == DISPATCHED
+    assert eng.qos.m_dispatched.get(("app",)) == 0  # flush_all's job
+
+
+def test_reload_remove_unknown_parser_rejected():
+    """remove_parser must fail the transaction on an unknown name,
+    matching remove_input/filter/output — a typo'd removal silently
+    leaving the parser live is a misconfiguration time bomb."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.engine.parser("p_json", Format="json")
+    txn = ctx.engine.reload_txn()
+    txn.remove_parser("p_jsn")         # typo
+    with pytest.raises(ValueError, match="unknown parser"):
+        txn.commit()
+    assert ctx.engine.reload_count == 0
+    assert "p_json" in ctx.engine.parsers
+
+
+def test_hidden_emitter_exempt_from_tenant_quota():
+    """Hidden emitter replay (rewrite_tag / multiline / trace taps) is
+    never re-metered: the bytes passed admission at the original
+    ingest point, and the fire-and-forget re-emit callers would drop
+    already-admitted data on a DEFER."""
+    ctx = flb.create(flush="1s", grace="1")
+    clk = _Clock()
+    ctx.engine.qos.clock = clk
+    # a quota on the DEFAULT tenant used to capture emitter appends
+    in_ffd = ctx.input("lib", tag="t",
+                       **{"tenant.rate": "1", "tenant.burst": "1"})
+    ctx.output("null", match="*")
+    _init_pipeline(ctx.engine)
+    emitter = ctx.engine.hidden_input("emitter", alias="replay_em")
+    assert emitter.qos_exempt
+    q = ctx.engine.qos
+    data = encode_event({"replayed": "x" * 100}, None)
+    for _ in range(5):   # far over the 1-byte default-tenant budget
+        assert ctx.engine.input_log_append(emitter, "t", data, 1) == 1
+    assert q.m_deferred.get(("default",)) == 0
+    assert not getattr(emitter, "paused_by_qos", False)
+
+
+def test_reload_reaps_unreferenced_tenants():
+    """Reload churn over per-customer tenant names must not accumulate
+    Tenant objects forever: a tenant with no live input and nothing in
+    the fair queue is reaped at commit; re-declaring it later gets a
+    fresh contract."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="keep", tenant="pinned")
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        for k in range(4):
+            txn = ctx.engine.reload_txn()
+            txn.add_input("lib", tag=f"c{k}", tenant=f"cust{k}")
+            txn.commit()
+            victim = next(i.name for i in ctx.engine.inputs
+                          if i.tag == f"c{k}")
+            txn = ctx.engine.reload_txn()
+            txn.remove_input(victim)
+            txn.commit()
+            assert f"cust{k}" not in ctx.engine.qos._tenants
+        names = set(ctx.engine.qos._tenants)
+        assert "pinned" in names      # live input's tenant survives
+        assert not any(n.startswith("cust") for n in names)
+    finally:
+        ctx.stop()
+
+
+def test_reload_replace_same_filter_twice_rejected():
+    """Two replace_filter() calls targeting one slot would orphan the
+    first built twin (never exited, its hidden emitter leaks) and
+    exit the old instance twice — the transaction must refuse."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="t")
+    ctx.filter("grep", match="t", exclude="log X")
+    ctx.output("null", match="*")
+    txn = ctx.engine.reload_txn()
+    txn.replace_filter("grep.0")
+    txn.replace_filter("grep.0")
+    with pytest.raises(ValueError, match="replaced twice"):
+        txn.commit()
+
+
+def test_reload_finalize_fault_does_not_lose_drained_chunks(tmp_path):
+    """A storage fault while finalizing a removed input's drained
+    chunks must not wedge the swap: the commit completes and the
+    chunks still deliver from the in-memory backlog."""
+    ctx = flb.create(flush="40ms", grace="1",
+                     **{"storage.path": str(tmp_path / "st")})
+    in_ffd = ctx.input("lib", tag="t", **{"storage.type": "filesystem"})
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        assert ctx.push(in_ffd, json.dumps({"seq": 0})) == 1
+        failpoints.enable("storage.finalize", "return(EIO)")
+        txn = ctx.engine.reload_txn()
+        txn.remove_input("lib.0")
+        gen = txn.commit()           # must NOT raise
+        assert gen == 1
+        failpoints.disable("storage.finalize")
+        ctx.flush_now()
+        wait_for(lambda: got)
+    finally:
+        ctx.stop()
+    assert decode_events(got[0])[0].body == {"seq": 0}
+
+
+def test_commit_refused_while_engine_stopping():
+    """A reload landing retirements behind stop()'s reap would leak
+    un-exited pools: commits on a stopping engine refuse."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="t")
+    ctx.output("null", match="*")
+    ctx.start()
+    eng = ctx.engine
+    try:
+        txn = eng.reload_txn()
+        txn.add_output("null", match="aux.*")
+        eng._stopping = True         # simulate stop() in progress
+        with pytest.raises(RuntimeError, match="stopping"):
+            txn.commit()
+    finally:
+        eng._stopping = False
+        ctx.stop()
+
+
+def test_quota_resume_honors_mem_buf_limit():
+    """resume_paused must not un-pause a quota-paused input whose pool
+    is still over mem_buf_limit: the drain-path resume skips quota
+    pauses, so an early resume here would hand the collector a read
+    the backpressure check immediately drops."""
+    ctx = flb.create(flush="1000")
+    clk = _Clock()
+    ctx.engine.qos.clock = clk
+    in_ffd = ctx.input("lib", tag="t", mem_buf_limit="150",
+                       **{"tenant.rate": "100", "tenant.burst": "100"})
+    ctx.output("null", match="t")
+    _init_pipeline(ctx.engine)
+    ins = ctx._handles[in_ffd]
+    rec = json.dumps({"x": "y" * 40})
+    while ctx.push(in_ffd, rec) > 0:   # drain quota (and fill pool)
+        pass
+    assert ins.paused_by_qos
+    clk.t += 10.0                      # bucket fully refilled
+    if ins.pool.pending_bytes < 150:   # top the pool over the limit
+        ins.pool.append("t", b"z" * (150 - ins.pool.pending_bytes), 1)
+    ctx.engine.qos.resume_paused(ctx.engine.inputs)
+    assert ins.paused                  # buffer still over: stays paused
+    with ins.ingest_lock:
+        ins.pool.drain()               # buffer clears
+    ctx.engine.qos.resume_paused(ctx.engine.inputs)
+    assert not ins.paused and not ins.paused_by_qos
+
+
+def test_commit_refused_after_engine_stopped():
+    """stop() exits every instance; a commit landing afterwards would
+    double-exit removed plugins and strand retirements nothing will
+    reap — refused until a restart resets the flag."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="t")
+    ctx.output("null", match="*")
+    ctx.start()
+    ctx.stop()
+    txn = ctx.engine.reload_txn()
+    txn.add_output("null", match="aux.*")
+    with pytest.raises(RuntimeError, match="stopping"):
+        txn.commit()
+
+
+def test_output_less_reload_does_not_rotate_conditional_chunks():
+    """A parser/filter-only reload leaves every routes_mask valid:
+    active conditional chunks must NOT be rotated closed (fragmenting
+    them on every DFA recompile)."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="t")
+    ctx.filter("grep", match="t", exclude="log X")
+    ctx.output("null", match="*")
+    ctx.start()
+    try:
+        ins = ctx.engine.inputs[0]
+        data = encode_event({"n": 1}, None)
+        with ins.ingest_lock:
+            c1 = ins.pool.append("t", data, 1, routes_mask=0b1)
+        txn = ctx.engine.reload_txn()
+        txn.replace_filter("grep.0")     # no output change
+        txn.commit()
+        with ins.ingest_lock:
+            c2 = ins.pool.append("t", data, 1, routes_mask=0b1)
+        assert c2 is c1                  # same active chunk kept open
+        txn = ctx.engine.reload_txn()
+        txn.add_output("null", match="aux.*")
+        txn.commit()                     # outputs changed: must rotate
+        with ins.ingest_lock:
+            c3 = ins.pool.append("t", data, 1, routes_mask=0b1)
+        assert c3 is not c1
+    finally:
+        ctx.stop()
