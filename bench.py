@@ -447,6 +447,151 @@ def measure_flux(seconds: float = 1.5) -> dict:
     return out
 
 
+def measure_mesh(raw_chunks, per_point_s: float = 0.6) -> dict:
+    """fbtpu-mesh stage: the explicitly partitioned pjit/shard_map grep
+    program over the device mesh. Under the CPU child this is the
+    simulated 8-virtual-device lane (the same
+    ``--xla_force_host_platform_device_count=8`` tier-1 runs on), so
+    the RESULT records partitioning/donation semantics and the
+    per-device-count scaling curve on every box — on a 1-core host the
+    virtual devices share one core, so the curve measures partitioning
+    OVERHEAD there (flat-to-slightly-down is healthy; real speedup
+    needs real chips, `mesh.simulated` says which regime produced the
+    numbers)."""
+    import numpy as np
+
+    from fluentbit_tpu import native
+    from fluentbit_tpu.ops import mesh as om
+    from fluentbit_tpu.ops.grep import program_for
+
+    out = {}
+    staged = native.stage_field(raw_chunks[0], b"log", 512,
+                                n_hint=CHUNK_RECORDS)
+    if staged is None:
+        return {"error": "native staging unavailable"}
+    batch0, lengths0, _, n = staged
+    # arena views: copy before the next stage_field call overwrites
+    b = np.stack([batch0[:n]]).copy()
+    ln = np.stack([lengths0[:n]]).copy()
+    prog = program_for((APACHE2,), 512)
+    full_mesh = om.build_mesh()
+    out["mesh"] = om.mesh_info(full_mesh)
+    if full_mesh is None:
+        out["skipped"] = "single device: no mesh to partition over"
+        return out
+    n_all = out["mesh"]["devices"]
+    out["chunk_records"] = n
+    out["donation"] = prog.donation_info(full_mesh, B=n)
+    out["per_device_batch_share"] = out["donation"][
+        "per_device_batch_share"]
+    out["variant"] = out["donation"]["variant"]
+
+    def rate(fn) -> tuple:
+        fn()  # warm + compile
+        times = []
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < per_point_s:
+            t1 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t1)
+        p50 = sorted(times)[len(times) // 2]
+        return round(len(times) * n / sum(times)), round(p50 * 1e3, 3)
+
+    curve = {}
+    sizes = [s for s in (1, 2, 4, 8) if s < n_all]
+    sizes.append(n_all)  # the full mesh is ALWAYS a point (TPU
+    # slices come in non-power shapes; the curve must end at n_all)
+    for size in sizes:
+        if size == 1:
+            r, p50 = rate(lambda: prog.match(b, ln))
+        else:
+            m = om.build_mesh(size)
+            r, p50 = rate(lambda: prog.match_mesh(m, b, ln))
+        curve[str(size)] = r
+        if size == n_all:
+            out["p50_chunk_ms"] = p50
+    out["scaling_lines_per_sec"] = curve
+    one = curve.get("1")
+    full = curve.get(str(n_all))
+    if one and full:
+        out["scaling_vs_1dev"] = round(full / one, 2)
+
+    # engine ingest boundary with the mesh lane forced (what the raw
+    # dispatch path actually does per append: threaded staging straight
+    # into the transfer matrix, sharded launch, donated buffers)
+    prev = os.environ.get("FBTPU_MESH")
+    os.environ["FBTPU_MESH"] = "1"
+    try:
+        eng, ins = build_engine(device=True)
+        eng.input_log_append(ins, "bench", raw_chunks[0])  # warm
+        ins.pool.drain()
+        t0 = time.perf_counter()
+        lines = 0
+        i = 0
+        while time.perf_counter() - t0 < 1.5:
+            eng.input_log_append(ins, "bench",
+                                 raw_chunks[i % len(raw_chunks)])
+            ins.pool.drain()
+            lines += CHUNK_RECORDS
+            i += 1
+        out["mesh_ingest_lines_per_sec"] = round(
+            lines / (time.perf_counter() - t0))
+        out["mesh_ingest_engaged"] = \
+            eng.filters[0].plugin._mesh is not None
+    finally:
+        if prev is None:
+            os.environ.pop("FBTPU_MESH", None)
+        else:
+            os.environ["FBTPU_MESH"] = prev
+    return out
+
+
+def measure_staging_mt(raw_chunks) -> dict:
+    """Multi-core staging lane (the FBTPU_STAGE_THREADS satellite):
+    single-thread vs pooled extraction rate through stage_field_into.
+    On a 1-core host the pooled walk cannot beat the serial one by
+    arithmetic — the lane then records WHY it is skipped (plus the
+    core/thread truth) instead of publishing a meaningless 1.0×, which
+    is exactly the multi_input.scaling lesson."""
+    import numpy as np
+
+    from fluentbit_tpu import native
+
+    cores = os.cpu_count() or 1
+    out = {
+        "host_cpus": cores,
+        "requested_threads": native.stage_threads(),
+        "effective_threads": native.stage_threads_effective(),
+    }
+    if cores < 2:
+        out["skipped"] = ("1-core host: pooled staging cannot exceed "
+                          "the serial rate by arithmetic")
+        return out
+    buf = raw_chunks[0]
+    batch = np.empty((CHUNK_RECORDS, 512), dtype=np.uint8)
+    lengths = np.full((CHUNK_RECORDS,), -1, dtype=np.int32)
+
+    def rate(threads) -> int:
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 1.0:
+            got = native.stage_field_into(buf, b"log", batch, lengths,
+                                          n_hint=CHUNK_RECORDS,
+                                          threads=threads)
+            if got is None:
+                return 0
+            reps += 1
+        return round(reps * CHUNK_RECORDS / (time.perf_counter() - t0))
+
+    one = rate(1)
+    pooled = rate(min(cores, 16))
+    out["threads1_lines_per_sec"] = one
+    out["pooled_lines_per_sec"] = pooled
+    out["pooled_threads"] = native.stage_threads_effective(min(cores, 16))
+    out["scaling"] = round(pooled / one, 2) if one else None
+    return out
+
+
 def check_bit_exact(raw_chunks) -> bool:
     """Device/native raw path vs the pure-Python verdict chain."""
     ok = True
@@ -780,9 +925,23 @@ def child_main(mode: str) -> None:
             "inputs1_lines_per_sec": one,
             "inputs4_lines_per_sec": four,
             "scaling": round(four / one, 2) if one else None,
+            # the denominator the scaling number must be read against:
+            # a 1-core host pins scaling ≈ 1.0 by arithmetic, not by
+            # lock contention (see module NOTE)
+            "cores": os.cpu_count(),
         }
     except Exception as e:
         result["multi_input"] = {"error": repr(e)}
+    _progress(stage=f"{mode}:mesh")
+    try:
+        result["mesh"] = measure_mesh(chunks)
+    except Exception as e:
+        result["mesh"] = {"error": repr(e)}
+    _progress(stage=f"{mode}:staging_mt")
+    try:
+        result["staging_mt"] = measure_staging_mt(chunks)
+    except Exception as e:
+        result["staging_mt"] = {"error": repr(e)}
     if mode == "cpu":
         _progress(stage="cpu:secondary")
         try:
@@ -906,6 +1065,21 @@ def drain_child(proc, deadline_at: float, tag: str):
     return sink.result, None
 
 
+def _pick_stage(dev_block, cpu_block, complete_key):
+    """Prefer the device child's stage block only when it is COMPLETE
+    (has the measurement, no error/skip) — otherwise the cpu child's
+    record wins; fall back to whichever exists."""
+    def complete(blk):
+        return (blk and not blk.get("error") and not blk.get("skipped")
+                and blk.get(complete_key) is not None)
+
+    if complete(dev_block):
+        return dev_block
+    if complete(cpu_block):
+        return cpu_block
+    return dev_block or cpu_block
+
+
 def final_line(cpu, dev, dev_err, extras):
     best = dev if (dev and dev.get("lines_per_sec")) else cpu
     dev_platform = (dev or {}).get("platform")
@@ -946,6 +1120,16 @@ def final_line(cpu, dev, dev_err, extras):
         "breakdown": (best or {}).get("breakdown"),
         "cpu_backend_lines_per_sec": (cpu or {}).get("lines_per_sec"),
         "multi_input": (best or {}).get("multi_input"),
+        # fbtpu-mesh stage: a device child that really attached chips
+        # outranks the cpu child's simulated-mesh numbers — but only
+        # with a COMPLETE block (a skipped/errored device stage must
+        # not shadow the cpu child's full donation/scaling record)
+        "mesh": _pick_stage((dev or {}).get("mesh"),
+                            (cpu or {}).get("mesh"),
+                            "scaling_lines_per_sec"),
+        "staging_mt": _pick_stage((dev or {}).get("staging_mt"),
+                                  (cpu or {}).get("staging_mt"),
+                                  "pooled_lines_per_sec"),
         "native_staging": bool((best or {}).get("native_staging", False)),
         "secondary": (cpu or {}).get("secondary"),
         "flux": (cpu or {}).get("flux"),

@@ -8,21 +8,30 @@ turns the compile cache into a compile storm.
 
 Traced-function discovery is name-based and transitive:
 
-- seeds: defs decorated with ``jit``/``pmap``/``vmap`` (incl. through
-  ``partial``), and defs referenced in the arguments of
-  ``jit``/``pmap``/``vmap``/``shard_map``/``checkpoint``/``remat``/
-  ``lax.scan``/``fori_loop``/``while_loop``/``cond`` calls —
-  single-level aliases are followed (``impl = self._a if p else self._b;
-  jax.jit(impl)`` marks both, including through attribute stores like
-  ``self._impl = impl``).
+- seeds: defs decorated with ``jit``/``pjit``/``pmap``/``vmap``/
+  ``shard_map`` (incl. through ``partial``), and defs referenced in the
+  arguments of ``jit``/``pjit``/``pmap``/``vmap``/``shard_map``/
+  ``checkpoint``/``remat``/``lax.scan``/``fori_loop``/``while_loop``/
+  ``cond`` calls — single-level aliases are followed
+  (``impl = self._a if p else self._b; jax.jit(impl)`` marks both,
+  including through attribute stores like ``self._impl = impl``).
 - propagation: a call to a module-local def (or alias) from traced code
   marks the callee; defs nested inside traced defs are traced.
+
+The ``pjit``/``shard_map`` coverage exists for the partitioned mesh
+plane (ops/mesh.py + ops/grep.py mesh matcher): the sharded hot path
+compiles ONCE per mesh and runs on every device per dispatch, so a
+host callback or shape-dependent retrace that sneaks in there costs
+n_devices× what it costs single-device.
 
 Rules emitted:
 
 - ``jax-host-sync``: ``block_until_ready``/``device_get``/``.item()``/
   ``.tolist()``/``np.asarray``/``np.array``/``np.frombuffer`` and
-  1-arg ``float()``/``int()``/``bool()`` casts inside traced code.
+  1-arg ``float()``/``int()``/``bool()`` casts inside traced code;
+  also host-callback escapes (``pure_callback``/``io_callback``/
+  ``debug_callback``/``host_callback``) — inside a pjit/shard_map
+  program each shard's step blocks on a Python round-trip.
 - ``jax-side-effect``: ``print``, ``global``/``nonlocal``, and
   attribute writes on ``self`` inside traced code.
 - ``jax-retrace``: ``if``/``while`` whose test touches ``.shape``/
@@ -53,9 +62,17 @@ from . import Finding, Module, Rule
 __all__ = ["JaxPurityRules"]
 
 #: call/decorator terminals that trace their function arguments
-_TRACERS = {"jit", "pmap", "vmap", "shard_map", "checkpoint", "remat",
-            "scan", "fori_loop", "while_loop", "cond", "named_call",
-            "custom_jvp", "custom_vjp"}
+_TRACERS = {"jit", "pjit", "pmap", "vmap", "shard_map", "checkpoint",
+            "remat", "scan", "fori_loop", "while_loop", "cond",
+            "named_call", "custom_jvp", "custom_vjp"}
+
+#: decorator terminals that make the decorated def itself traced
+_TRACER_DECOS = {"jit", "pjit", "pmap", "vmap", "shard_map"}
+
+#: host-callback escapes: legal jax, but a per-dispatch Python round
+#: trip — in a sharded program every device's step blocks on it
+_HOST_CALLBACKS = {"pure_callback", "io_callback", "debug_callback",
+                   "host_callback"}
 
 #: batched filter entry points — shape-branch (retrace) checked even
 #: though untraced (see module docstring)
@@ -123,7 +140,7 @@ class JaxPurityRules(Rule):
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
-                    if _ref_names(dec) & {"jit", "pmap", "vmap"}:
+                    if _ref_names(dec) & _TRACER_DECOS:
                         traced.add(node.name)
             elif isinstance(node, ast.Call):
                 if _terminal(node.func) in _TRACERS:
@@ -220,6 +237,19 @@ class JaxPurityRules(Rule):
         out: List[Finding] = []
         params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
                                   + fn.args.kwonlyargs)} - {"self", "cls"}
+        # dict-like params: subscripted with a string key somewhere in
+        # the body (`t["trans_flat"]`) — these are pytree containers,
+        # so `"key" in t` is static structure, not tracer data. A
+        # param never string-subscripted stays array-like and keeps
+        # the full retrace/boolification checks.
+        dict_params: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in params \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                dict_params.add(node.value.id)
         where = f"traced code ({fn.name})"
 
         def walk(node: ast.AST) -> None:
@@ -229,16 +259,27 @@ class JaxPurityRules(Rule):
                 if isinstance(child, (ast.FunctionDef,
                                       ast.AsyncFunctionDef)):
                     continue
-                self._check_node(module, child, params, where, out)
+                self._check_node(module, child, params, where, out,
+                                 dict_params)
                 walk(child)
 
         walk(fn)
         return out
 
     def _check_node(self, module: Module, node: ast.AST, params: Set[str],
-                    where: str, out: List[Finding]) -> None:
+                    where: str, out: List[Finding],
+                    dict_params: Optional[Set[str]] = None) -> None:
         if isinstance(node, ast.Call):
             t = _terminal(node.func)
+            if t in _HOST_CALLBACKS:
+                self._emit(module, node, "jax-host-sync",
+                           f"`{t}(...)` in {where}: a host-callback "
+                           f"escape blocks every device's step on a "
+                           f"Python round-trip per dispatch — keep the "
+                           f"sharded hot path callback-free (compute "
+                           f"on-device or post-process the forced "
+                           f"result)", out)
+                return
             if isinstance(node.func, ast.Attribute):
                 base = _terminal(node.func.value)
                 if t in _NP_SYNCS and base in ("np", "numpy"):
@@ -277,10 +318,30 @@ class JaxPurityRules(Rule):
                        f"stale after; thread state through the carry",
                        out)
         elif isinstance(node, (ast.If, ast.While)):
-            self._check_branch(module, node, params, where, out)
+            self._check_branch(module, node, params, where, out,
+                               dict_params or set())
 
     def _check_branch(self, module: Module, node, params: Set[str],
-                      where: str, out: List[Finding]) -> None:
+                      where: str, out: List[Finding],
+                      dict_params: Set[str] = frozenset()) -> None:
+        # pytree-structure membership is static at trace time: a kernel
+        # taking its table pytree as a DICT param branches on
+        # `"pair_maps" in t` to pick a sub-kernel — that is pytree
+        # STRUCTURE (fixed per jit cache entry), not tracer data, so it
+        # can never boolify a tracer (the partitioned mesh plane's
+        # table-pytree idiom, ops/grep.py _super_symbols). Only params
+        # the function also string-subscripts qualify: `"GET" in batch`
+        # over a traced ARRAY param still iterates the tracer and must
+        # keep firing.
+        test = node.test
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and isinstance(test.ops[0], (ast.In, ast.NotIn)) \
+                and isinstance(test.left, ast.Constant) \
+                and isinstance(test.left.value, str) \
+                and all(n.id in dict_params or n.id not in params
+                        for n in ast.walk(test)
+                        if isinstance(n, ast.Name)):
+            return
         for sub in ast.walk(node.test):
             if isinstance(sub, ast.Attribute) and sub.attr in _SHAPE_ATTRS:
                 self._emit(module, node, "jax-retrace",

@@ -85,8 +85,10 @@ def segment_bounds(n: int, seg_records: int) -> List[tuple]:
 
 def double_buffered(stage_iter: Iterable[Any],
                     dispatch: Callable[[Any], Any],
-                    collect: Optional[Callable[[Any], Any]] = None) -> List[Any]:
-    """Depth-2 staging/kernel pipeline.
+                    collect: Optional[Callable[[Any], Any]] = None,
+                    depth: int = 2) -> List[Any]:
+    """Staging/kernel pipeline with ``depth`` segments in flight
+    (default 2 — the classic double buffer).
 
     ``stage_iter`` performs the host-side extraction work lazily (each
     ``__next__`` stages one segment); ``dispatch`` launches the device
@@ -94,20 +96,26 @@ def double_buffered(stage_iter: Iterable[Any],
     result (jax dispatch is asynchronous); ``collect`` forces a
     dispatched result (default ``np.asarray``). The loop dispatches
     segment i, stages segment i+1 while i's kernel is in flight, then
-    forces i — so host extraction and device execution overlap with at
-    most two segments alive.
+    forces the oldest in-flight segment once ``depth`` are alive — so
+    host extraction and device execution overlap with at most ``depth``
+    segments live. The mesh path runs depth 2 per *sharded* launch
+    (one launch already spans every device); deeper pipelines serve
+    backends whose dispatch queue rewards more in-flight work.
     """
+    from collections import deque
+
     import numpy as np
 
     if collect is None:
         collect = np.asarray
+    if depth < 2:
+        depth = 2
     out: List[Any] = []
-    pending = None
+    pending: deque = deque()
     for staged in stage_iter:
-        cur = dispatch(staged)
-        if pending is not None:
-            out.append(collect(pending))
-        pending = cur
-    if pending is not None:
-        out.append(collect(pending))
+        pending.append(dispatch(staged))
+        if len(pending) >= depth:
+            out.append(collect(pending.popleft()))
+    while pending:
+        out.append(collect(pending.popleft()))
     return out

@@ -51,22 +51,17 @@ def _pad_segments(n_seg: int) -> int:
 
 
 def flux_mesh(n_devices: Optional[int] = None):
-    """A 1-D mesh over the available devices (axis ``flux``).  Under the
-    simulated-mesh lane (``XLA_FLAGS=--xla_force_host_platform_device_count=8``,
-    the tier-1 default — tests/conftest.py) this is 8 virtual CPU
-    devices; on real hardware it is the attached chips.  Returns None
-    when jax is unavailable or only one device exists (the mesh path
-    would be pure overhead)."""
-    if not HAVE_JAX:
-        return None
-    from jax.sharding import Mesh
+    """A 1-D mesh over the available devices (axis ``flux``) — the
+    shared constructor in ops.mesh, which also serves the grep DFA
+    plane's partitioned matcher.  Under the simulated-mesh lane
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=8``, the tier-1
+    default — tests/conftest.py) this is 8 virtual CPU devices; on real
+    hardware it is the attached chips.  Returns None when jax is
+    unavailable or only one device exists (the mesh path would be pure
+    overhead)."""
+    from ..ops.mesh import build_mesh
 
-    devs = jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    if len(devs) < 2:
-        return None
-    return Mesh(np.asarray(devs), ("flux",))
+    return build_mesh(n_devices, axis="flux")
 
 
 def host_segment_counts(seg: np.ndarray, valid: np.ndarray,
@@ -104,9 +99,10 @@ def segment_counts(seg: np.ndarray, valid: np.ndarray,
 
 def _mesh_key(mesh) -> tuple:
     # structural key, not id(): equal meshes share a compiled step
-    # (same rationale as ops.sketch._mesh_key)
-    return (tuple(mesh.axis_names),
-            tuple(d.id for d in mesh.devices.flat))
+    # (the shared helper in ops.mesh — also keys the grep/sketch caches)
+    from ..ops.mesh import mesh_key
+
+    return mesh_key(mesh)
 
 
 def sharded_segment_counts(mesh, seg: np.ndarray, valid: np.ndarray,

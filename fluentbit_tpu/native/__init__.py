@@ -87,6 +87,10 @@ def _load():
                 ctypes.c_longlong, ctypes.c_longlong,
                 ctypes.POINTER(ctypes.c_longlong), ctypes.c_int32,
             ]
+        eff_fn = getattr(lib, "fbtpu_stage_effective_threads", None)
+        if eff_fn is not None:
+            eff_fn.restype = ctypes.c_int32
+            eff_fn.argtypes = [ctypes.c_int32]
         # fbtpu-flux entry points (absent in a stale prebuilt .so:
         # callers then stay on their Python/device paths)
         f64_fn = getattr(lib, "fbtpu_stage_field_f64", None)
@@ -547,9 +551,86 @@ def _stage_threads() -> int:
     return _stage_threads_cached
 
 
+def stage_threads() -> int:
+    """Requested stager fan-out (``FBTPU_STAGE_THREADS``, default = all
+    cores). The native pool may clamp this to the hardware — see
+    :func:`stage_threads_effective`."""
+    return _stage_threads()
+
+
+def stage_threads_effective(requested: Optional[int] = None) -> Optional[int]:
+    """What the native pool will ACTUALLY fan a stage call out to after
+    its hardware/16-way caps (``fbtpu_stage_effective_threads``) — the
+    truth the bench RESULT records so a multi-core lane's scaling
+    number can be read against the real slice count. None = native
+    unavailable or an older .so without the probe."""
+    lib = _load()
+    fn = getattr(lib, "fbtpu_stage_effective_threads", None) \
+        if lib is not None else None
+    if fn is None:
+        return None
+    return int(fn(requested if requested is not None else _stage_threads()))
+
+
+def stage_field_into(
+    buf: bytes, key: bytes, out_batch: np.ndarray,
+    out_lengths: np.ndarray, n_hint: Optional[int] = None,
+    threads: Optional[int] = None,
+    offsets_out: Optional[np.ndarray] = None,
+) -> Optional[int]:
+    """Stage one top-level string field DIRECTLY into caller-provided
+    arrays — the per-device staging path of the mesh plane: the caller
+    hands one rule-row slice of its ``[R, Bp, L]`` segment matrix
+    (``out_batch`` u8 ``[B, L]`` C-contiguous, ``out_lengths`` i32
+    ``[B]``) and the extraction fans out across the native worker pool
+    (``FBTPU_STAGE_THREADS`` / ``threads``), each slice of records
+    walking lock-free into its own row range. No arena, no copy-out —
+    the staged bytes land where the device transfer reads them.
+
+    Writes rows ``[0, n)`` only (bytes past each row's length are NOT
+    zeroed; both DFA kernels mask by length); rows past ``n`` are left
+    untouched, so pre-fill ``out_lengths`` with -1 for pad rows.
+    ``offsets_out`` (i64, ≥ est+1 entries, contiguous) receives the
+    record boundary table the walk discovers anyway — callers that
+    need it (compaction, overflow decode) must NOT re-scan the buffer.
+    Returns the record count, or None (native unavailable / malformed
+    buffer / capacity exceeded / non-contiguous or mistyped target)."""
+    lib = _load()
+    if lib is None:
+        return None
+    est = n_hint if n_hint is not None else count_records(buf)
+    if est is None:
+        return None
+    B, L = out_batch.shape
+    if est > B or out_batch.dtype != np.uint8 \
+            or not out_batch.flags["C_CONTIGUOUS"] \
+            or out_lengths.dtype != np.int32 or out_lengths.shape[0] < B \
+            or not out_lengths.flags["C_CONTIGUOUS"]:
+        return None
+    if offsets_out is not None:
+        if offsets_out.dtype != np.int64 \
+                or offsets_out.shape[0] < est + 1 \
+                or not offsets_out.flags["C_CONTIGUOUS"]:
+            return None
+        offsets = offsets_out
+    else:
+        offsets = np.empty(est + 1, dtype=np.int64)
+    p_b = out_batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    p_l = out_lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    p_o = offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+    mt_fn = getattr(lib, "fbtpu_stage_field_mt", None)
+    if mt_fn is not None:
+        n = mt_fn(buf, len(buf), key, len(key), p_b, p_l, est, L, p_o,
+                  threads if threads is not None else _stage_threads())
+    else:
+        n = lib.fbtpu_stage_field(buf, len(buf), key, len(key), p_b, p_l,
+                                  est, L, p_o)
+    return None if n < 0 else int(n)
+
+
 def stage_field(
     buf: bytes, key: bytes, max_len: int, pad_to: Optional[int] = None,
-    n_hint: Optional[int] = None,
+    n_hint: Optional[int] = None, threads: Optional[int] = None,
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]:
     """Fill the staging matrix for one top-level string field straight
     from chunk bytes: (batch[B, L] u8, lengths[B] i32, offsets[n+1] i64,
@@ -588,7 +669,7 @@ def stage_field(
     mt_fn = getattr(lib, "fbtpu_stage_field_mt", None)
     if mt_fn is not None:
         n = mt_fn(buf, len(buf), key, len(key), p_b, p_l, est, max_len,
-                  p_o, _stage_threads())
+                  p_o, threads if threads is not None else _stage_threads())
     else:
         n = lib.fbtpu_stage_field(buf, len(buf), key, len(key), p_b, p_l,
                                   est, max_len, p_o)

@@ -76,7 +76,8 @@ _PAD_BYTE_BUDGET = 256 * 1024 * 1024
 
 def bucket_size(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536),
                 max_len: Optional[int] = None,
-                byte_budget: int = _PAD_BYTE_BUDGET) -> int:
+                byte_budget: int = _PAD_BYTE_BUDGET,
+                multiple_of: Optional[int] = None) -> int:
     """Round a batch size up to a small set of jit-stable shapes.
 
     ``max_len`` (the per-row byte width the caller will allocate)
@@ -87,7 +88,13 @@ def bucket_size(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)
     64-record-granularity padding instead of overflowing the pad
     allocation (regression test: tests/test_batch_filters.py; the
     shapes become chunk-size-dependent there, which is the acceptable
-    cost of not allocating gigabytes of pad)."""
+    cost of not allocating gigabytes of pad).
+
+    ``multiple_of`` additionally aligns the result to the mesh size on
+    the partitioned device path (NamedSharding requires the sharded
+    batch dimension divisible by the device count; the power-of-two
+    buckets already are for power-of-two meshes, but TPU slices come
+    in non-power shapes too)."""
     pick = None
     for b in buckets:
         if n <= b:
@@ -99,4 +106,6 @@ def bucket_size(n: int, buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)
         # minimal jit-stable padding (the n records must stage
         # regardless of what they cost)
         pick = ((n + 63) // 64) * 64
+    if multiple_of and multiple_of > 1:
+        pick = ((pick + multiple_of - 1) // multiple_of) * multiple_of
     return pick

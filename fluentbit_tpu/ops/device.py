@@ -115,6 +115,20 @@ def platform() -> Optional[str]:
     return _platform
 
 
+def device_count() -> int:
+    """Attached backend's device count; 0 until ready. Under the
+    simulated-mesh lane (``--xla_force_host_platform_device_count=8``)
+    this reports the virtual devices — the mesh planes (ops.mesh,
+    ops.grep mesh matcher, flux kernels) treat those exactly like
+    chips. Safe after ready(): the first (possibly minutes-long)
+    backend touch already happened in the attach worker."""
+    if not ready():
+        return 0
+    import jax
+
+    return len(jax.devices())
+
+
 def shard_map_fn():
     """Version-tolerant ``shard_map`` import: top-level in newer jax,
     ``jax.experimental.shard_map`` on 0.4.x.  Every SPMD builder (grep,

@@ -156,6 +156,7 @@ class GrepProgram:
         self._jit = None
         self._mat_lock = threading.Lock()
         self._sharded_cache: dict = {}
+        self._mesh_cache: dict = {}
 
     def _resolve_kernel(self) -> str:
         """Scan-vs-assoc per program shape, decided at trace time (the
@@ -174,23 +175,28 @@ class GrepProgram:
         return "assoc" if self.max_states <= 64 else "scan"
 
     def _materialize(self) -> None:
-        """Transfer tables to the attached backend + build the jit."""
+        """Transfer tables to the attached backend + build the jit.
+
+        The tables live in ONE pytree (``self._tbl``) that the kernels
+        take as an explicit first argument — the mesh matcher shards
+        that same pytree by name through the partition-rules layer
+        (ops.mesh.match_partition_rules), so the single-device and
+        partitioned programs are the same code over the same tree."""
         with self._mat_lock:
             if self._jit is not None:
                 return
             t = self._np
-            self.trans_flat = jnp.asarray(t["trans_flat"])
-            self.C = jnp.asarray(t["C"])
-            self.Ck = jnp.asarray(t["Ck"])
-            self.class_maps = jnp.asarray(t["class_maps"])
-            self.eol_cls = jnp.asarray(t["eol_cls"])
-            self.starts = jnp.asarray(t["starts"])
-            self.pair_maps = (jnp.asarray(t["pair_maps"])
-                              if t["pair_maps"] is not None else None)
+            self._tbl = {k: jnp.asarray(v) for k, v in t.items()
+                         if v is not None}
             self.kernel_resolved = self._resolve_kernel()
-            impl = (self._match_assoc_impl
+            kern = (self._match_assoc_impl
                     if self.kernel_resolved == "assoc"
                     else self._match_impl)
+            tbl = self._tbl
+
+            def impl(batch, lengths):
+                return kern(tbl, batch, lengths)
+
             self._impl = impl
             self._jit = jax.jit(impl)
             self._np = None  # tables now live on device; free host copy
@@ -211,22 +217,25 @@ class GrepProgram:
 
     # -- the kernel --
 
-    def _super_symbols(self, batch: "jnp.ndarray",
+    def _super_symbols(self, t: dict, batch: "jnp.ndarray",
                        lengths: "jnp.ndarray") -> "jnp.ndarray":
-        """bytes → per-rule k-byte super-symbols: [R, B, Lk]."""
-        if self.pair_maps is not None:
-            return self._super_symbols_pairs(batch, lengths)
+        """bytes → per-rule k-byte super-symbols: [R, B, Lk]. ``t`` is
+        the table pytree (whole under single-device jit, this device's
+        shard under the partitioned program — the kernels are uniform
+        over the leading rule axis, so both read identically)."""
+        if "pair_maps" in t:
+            return self._super_symbols_pairs(t, batch, lengths)
         R, B, L = batch.shape
         k = self.k
         # byte → class, per rule
-        cls = jax.vmap(lambda cm, bt: cm[bt])(self.class_maps, batch)  # [R,B,L] i32
+        cls = jax.vmap(lambda cm, bt: cm[bt])(t["class_maps"], batch)  # [R,B,L] i32
         pos = jnp.arange(L, dtype=jnp.int32)
         pad = pos[None, None, :] >= lengths[:, :, None]  # [R,B,L]
-        cls = jnp.where(pad, self.eol_cls[:, None, None], cls)
+        cls = jnp.where(pad, t["eol_cls"][:, None, None], cls)
         # append EOL block: guarantees >=1 EOL and rounds L to multiple of k
         extra = (k - (L % k)) % k + k
         eol_block = jnp.broadcast_to(
-            self.eol_cls[:, None, None], (R, B, extra)
+            t["eol_cls"][:, None, None], (R, B, extra)
         )
         cls = jnp.concatenate([cls, eol_block], axis=2)
         Lk = cls.shape[2] // k
@@ -234,10 +243,10 @@ class GrepProgram:
         # combine k classes into one super-symbol, per-rule radix C_r
         comb = cls[..., 0]
         for j in range(1, k):
-            comb = comb * self.C[:, None, None] + cls[..., j]
+            comb = comb * t["C"][:, None, None] + cls[..., j]
         return comb
 
-    def _super_symbols_pairs(self, batch: "jnp.ndarray",
+    def _super_symbols_pairs(self, t: dict, batch: "jnp.ndarray",
                              lengths: "jnp.ndarray") -> "jnp.ndarray":
         """Even-stride symbol packing through the byte-pair class
         tables: one [R, 65536] gather per TWO bytes instead of one
@@ -255,19 +264,19 @@ class GrepProgram:
             L += 1
         idx = (batch[..., 0::2].astype(jnp.int32)
                + 256 * batch[..., 1::2].astype(jnp.int32))  # [R,B,L2]
-        pcls = jax.vmap(lambda pm, ix: pm[ix])(self.pair_maps, idx)
+        pcls = jax.vmap(lambda pm, ix: pm[ix])(t["pair_maps"], idx)
         L2 = L // 2
         t2 = jnp.arange(L2, dtype=jnp.int32) * 2
-        eol_pair = self.eol_cls * self.C + self.eol_cls  # [R]
+        eol_pair = t["eol_cls"] * t["C"] + t["eol_cls"]  # [R]
         # boundary pair (first byte valid, second padded):
         # class(last byte) * C + eol — one [R, B] gather, broadcast
         # into the single position it can occupy
         last_idx = jnp.clip(lengths - 1, 0)[..., None]       # [R,B,1]
         last_b = jnp.take_along_axis(batch, last_idx, axis=2)
-        last_cls = jax.vmap(lambda cm, bt: cm[bt])(self.class_maps,
+        last_cls = jax.vmap(lambda cm, bt: cm[bt])(t["class_maps"],
                                                    last_b)  # [R,B,1]
-        mixed = (last_cls * self.C[:, None, None]
-                 + self.eol_cls[:, None, None])
+        mixed = (last_cls * t["C"][:, None, None]
+                 + t["eol_cls"][:, None, None])
         pcls = jnp.where(t2[None, None, :] + 1 == lengths[:, :, None],
                          mixed, pcls)
         pcls = jnp.where(t2[None, None, :] >= lengths[:, :, None],
@@ -282,31 +291,32 @@ class GrepProgram:
                                     (R, B, extra))], axis=2)
         Lk = pcls.shape[2] // k2
         pcls = pcls.reshape(R, B, Lk, k2)
-        C2 = self.C * self.C
+        C2 = t["C"] * t["C"]
         comb = pcls[..., 0]
         for j in range(1, k2):
             comb = comb * C2[:, None, None] + pcls[..., j]
         return comb
 
-    def _match_impl(self, batch: "jnp.ndarray", lengths: "jnp.ndarray"):
+    def _match_impl(self, t: dict, batch: "jnp.ndarray",
+                    lengths: "jnp.ndarray"):
         R, B, L = batch.shape
-        comb = self._super_symbols(batch, lengths)
+        comb = self._super_symbols(t, batch, lengths)
         comb_t = jnp.moveaxis(comb, 2, 0)  # [Lk, R, B]
 
         # + 0*lengths: ties the carry to the (possibly mesh-sharded) batch
         # so its varying-axes annotation matches the scan output under
         # shard_map; a no-op single-device
-        state0 = jnp.broadcast_to(self.starts[:, None], (R, B)) + 0 * lengths
+        state0 = jnp.broadcast_to(t["starts"][:, None], (R, B)) + 0 * lengths
 
         def step(state, c_t):
-            idx = state * self.Ck[:, None] + c_t
-            ns = jnp.take_along_axis(self.trans_flat, idx, axis=1)
+            idx = state * t["Ck"][:, None] + c_t
+            ns = jnp.take_along_axis(t["trans_flat"], idx, axis=1)
             return ns, None
 
         final, _ = lax.scan(step, state0, comb_t)
         return (final == ACC) & (lengths >= 0)
 
-    def _match_assoc_impl(self, batch: "jnp.ndarray",
+    def _match_assoc_impl(self, t: dict, batch: "jnp.ndarray",
                           lengths: "jnp.ndarray"):
         """Parallel-in-time DFA: the line's symbols are composed as
         transition FUNCTIONS instead of stepped as states.
@@ -323,7 +333,7 @@ class GrepProgram:
         R, B, L = batch.shape
         m = self.segment
         S = self.max_states
-        comb = self._super_symbols(batch, lengths)  # [R, B, Lk]
+        comb = self._super_symbols(t, batch, lengths)  # [R, B, Lk]
         Lk = comb.shape[2]
         G = -(-Lk // m)
         # pad the segment grid to a power of two with all-EOL segments
@@ -334,11 +344,11 @@ class GrepProgram:
         pad = G2 * m - Lk
         if pad:
             # super-symbol of k EOL classes: eol * (C^{k-1}+...+C+1)
-            radix = jnp.ones_like(self.C)
-            eol_super = jnp.zeros_like(self.eol_cls)
+            radix = jnp.ones_like(t["C"])
+            eol_super = jnp.zeros_like(t["eol_cls"])
             for _ in range(self.k):
-                eol_super = eol_super + self.eol_cls * radix
-                radix = radix * self.C
+                eol_super = eol_super + t["eol_cls"] * radix
+                radix = radix * t["C"]
             comb = jnp.concatenate(
                 [comb, jnp.broadcast_to(eol_super[:, None, None],
                                         (R, B, pad))], axis=2)
@@ -349,12 +359,12 @@ class GrepProgram:
 
         states = jnp.arange(S, dtype=jnp.int32)
         idx0 = (states[None, None, None, :]
-                * self.Ck[:, None, None, None] + comb[..., 0:1])
-        F = jax.vmap(gather_rule)(self.trans_flat, idx0)  # [R,B,G2,S]
+                * t["Ck"][:, None, None, None] + comb[..., 0:1])
+        F = jax.vmap(gather_rule)(t["trans_flat"], idx0)  # [R,B,G2,S]
 
         def seg_step(F, c_j):  # c_j: [R, B, G2]
-            idx = F * self.Ck[:, None, None, None] + c_j[..., None]
-            return jax.vmap(gather_rule)(self.trans_flat, idx), None
+            idx = F * t["Ck"][:, None, None, None] + c_j[..., None]
+            return jax.vmap(gather_rule)(t["trans_flat"], idx), None
 
         if m > 1:
             comb_j = jnp.moveaxis(comb[..., 1:], 3, 0)  # [m-1, R, B, G2]
@@ -366,7 +376,7 @@ class GrepProgram:
             F = jnp.take_along_axis(g_half, f_half, axis=3)
             g //= 2
         final_fn = F[:, :, 0, :]  # [R, B, S]: whole-line function
-        start_idx = jnp.broadcast_to(self.starts[:, None, None], (R, B, 1))
+        start_idx = jnp.broadcast_to(t["starts"][:, None, None], (R, B, 1))
         final = jnp.take_along_axis(final_fn, start_idx, axis=2)[..., 0]
         # + 0*lengths keeps the shard_map varying-axes annotation tied
         # to the batch, mirroring _match_impl's state0 trick
@@ -440,9 +450,10 @@ class GrepProgram:
     def match_sharded(self, mesh, batch: np.ndarray, lengths: np.ndarray):
         """Pad B up to the mesh size and run the SPMD matcher; returns
         (mask[R, B] numpy, counts[R] numpy, matcher-padded batch size)."""
-        n_dev = mesh.devices.size
+        from .mesh import mesh_key, pad_to_devices
+
         R, B, L = batch.shape
-        Bp = ((B + n_dev - 1) // n_dev) * n_dev
+        Bp = pad_to_devices(B, mesh.devices.size)
         if Bp != B:
             batch = np.concatenate(
                 [batch, np.zeros((R, Bp - B, L), dtype=batch.dtype)], axis=1
@@ -450,14 +461,260 @@ class GrepProgram:
             lengths = np.concatenate(
                 [lengths, np.full((R, Bp - B), -1, dtype=lengths.dtype)], axis=1
             )
-        key = (tuple(mesh.axis_names),
-               tuple(d.id for d in mesh.devices.flat))
+        key = mesh_key(mesh)
         fn = self._sharded_cache.get(key)
         if fn is None:
             fn = self.sharded_matcher(mesh, axis=mesh.axis_names[0])
             self._sharded_cache[key] = fn
         mask, counts = fn(jnp.asarray(batch), jnp.asarray(lengths))
         return np.asarray(mask)[:, :B], np.asarray(counts), Bp
+
+    # -- explicitly partitioned pjit program (the fbtpu-mesh plane) --
+
+    def mesh_variant(self, mesh) -> str:
+        """Which axis of the [R, B, L] program shards across the mesh.
+
+        ``"batch"`` (default): B splits across devices, the transition/
+        pair-class tables replicate — right whenever the tables are
+        small relative to per-device memory. ``"rules"``: for large
+        rule sets the replicated tables dominate (R × C^k rows + the
+        R × 65536 pair maps), so the RULE axis shards instead — each
+        device holds 1/n of the tables and matches the full batch
+        against its own rules. Gated on the replicated-table footprint
+        crossing ``FBTPU_MESH_TABLE_BUDGET`` (default 64 MiB) or R ≥
+        ``FBTPU_MESH_RULE_SHARD_R`` (default 64), and on R dividing the
+        mesh evenly (no rule padding — a dead-rule pad row would cost a
+        full batch scan)."""
+        import os as _os
+
+        n_dev = mesh.devices.size
+        R = len(self.dfas)
+        if R < 2 or R % n_dev != 0:
+            return "batch"
+        tbl = getattr(self, "_tbl", None)
+        if tbl is None:
+            t = self._np
+            table_bytes = sum(v.size * v.itemsize for v in t.values()
+                              if v is not None)
+        else:
+            table_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                              for v in tbl.values())
+        budget = int(_os.environ.get("FBTPU_MESH_TABLE_BUDGET",
+                                     str(64 * 1024 * 1024)))
+        min_r = int(_os.environ.get("FBTPU_MESH_RULE_SHARD_R", "64"))
+        if table_bytes * n_dev > budget or R >= min_r:
+            return "rules"
+        return "batch"
+
+    def _mesh_handle(self, mesh, donate: str = "auto",
+                     with_counts: bool = True):
+        """Build (and cache per mesh structure) the explicitly
+        partitioned matcher: a ``shard_map`` program under ``jax.jit``
+        with declarative PartitionSpecs from the partition-rules layer,
+        tables device_put once with their shardings, and staged input
+        buffers donated where (and only where) they can alias an
+        output.
+
+        ``with_counts=False`` compiles the engine-dispatch variant
+        WITHOUT the per-rule match totals: the counts are an O(R·B)
+        reduction plus (batch variant) a cross-device ``psum`` — a
+        sync point per segment launch — and the filter path never
+        reads them. Only match_mesh/bench/metrics consumers pay for
+        counts."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from . import device
+        from .device import shard_map_fn
+        from .mesh import (aliasable_donations, match_partition_rules,
+                           mesh_key)
+
+        if self._jit is None:
+            if not device.wait(60.0):
+                raise RuntimeError(
+                    f"device backend not attached: {device.status()}"
+                )
+            self._materialize()
+        key = (mesh_key(mesh), donate, with_counts)
+        h = self._mesh_cache.get(key)
+        if h is not None:
+            return h
+
+        axis = mesh.axis_names[0]
+        variant = self.mesh_variant(mesh)
+        R = len(self.dfas)
+        # the whole sharding layout of the program, in one table: the
+        # table pytree's specs by leaf name, then batch/lengths/outputs
+        if variant == "rules":
+            table_rules = (
+                (r"trans_flat|class_maps|pair_maps", P(axis, None)),
+                (r".*", P(axis)),
+            )
+            spec_b, spec_l = P(axis, None, None), P(axis, None)
+            spec_mask, spec_counts = P(axis, None), P(axis)
+        else:
+            table_rules = ((r".*", P()),)
+            spec_b, spec_l = P(None, axis, None), P(None, axis)
+            spec_mask, spec_counts = P(None, axis), P()
+        tspecs = match_partition_rules(table_rules, self._tbl)
+
+        kern = (self._match_assoc_impl
+                if self.kernel_resolved == "assoc" else self._match_impl)
+
+        def step(t, batch, lengths):
+            mask = kern(t, batch, lengths)
+            # i32 mask (not bool): exactly matches the donated lengths
+            # buffer's sharded aval, so XLA aliases the verdict into
+            # the staging buffer instead of allocating a new one
+            if not with_counts:
+                return mask.astype(jnp.int32)
+            counts = jnp.sum(mask.astype(jnp.int32), axis=1)
+            if variant == "batch":
+                # global per-rule totals over ICI; the rules variant
+                # already sees the full batch per shard
+                counts = lax.psum(counts, axis_name=axis)
+            return mask.astype(jnp.int32), counts
+
+        shard_map = shard_map_fn()
+        out_specs = (spec_mask, spec_counts) if with_counts else spec_mask
+        sm = shard_map(step, mesh=mesh,
+                       in_specs=(tspecs, spec_b, spec_l),
+                       out_specs=out_specs)
+        tsh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), tspecs)
+        sh_b = NamedSharding(mesh, spec_b)
+        sh_l = NamedSharding(mesh, spec_l)
+        out_sh = (NamedSharding(mesh, spec_mask),
+                  NamedSharding(mesh, spec_counts)) if with_counts \
+            else NamedSharding(mesh, spec_mask)
+
+        # donation: arg 1 (batch) and arg 2 (lengths) are per-segment
+        # staging buffers; donate exactly the subset whose sharded
+        # (shape, dtype) matches an output — jax silently falls back to
+        # a copy (plus a warning) for anything else, which the mesh
+        # bench must never report as donated. Shapes vary per call, so
+        # the donate set is computed from dtypes on a canonical shape:
+        # lengths i32 [R, B] ↔ mask i32 [R, B] always aliases; batch
+        # u8 [R, B, L] never has an aliasable output.
+        Bc = mesh.devices.size * 8  # canonical shape for the aval match
+        Lc = self.max_len
+        donate_idx: tuple = ()
+        if donate != "off":
+            outs = [((R, Bc), np.int32, spec_mask)]
+            if with_counts:
+                outs.append(((R,), np.int32, spec_counts))
+            cand = aliasable_donations(
+                mesh,
+                in_specs=[
+                    ((R, Bc, Lc), np.uint8, spec_b, True),
+                    ((R, Bc), np.int32, spec_l, True),
+                ],
+                out_specs=outs,
+            )
+            if donate == "all":
+                cand = [0, 1]
+            donate_idx = tuple(i + 1 for i in cand)  # tables are arg 0
+
+        fn = jax.jit(sm, in_shardings=(tsh, sh_b, sh_l),
+                     out_shardings=out_sh, donate_argnums=donate_idx)
+        tables_dev = jax.device_put(self._tbl, tsh)
+        h = _MeshHandle(fn, tables_dev, sh_b, sh_l, variant,
+                        int(mesh.devices.size), donate_idx, with_counts)
+        self._mesh_cache[key] = h
+        return h
+
+    def dispatch_mesh(self, mesh, batch: np.ndarray, lengths: np.ndarray,
+                      donate: str = "auto", with_counts: bool = True):
+        """Launch the partitioned matcher WITHOUT forcing (the mesh half
+        of the double-buffered pipeline). Pads B up to the mesh size
+        (batch variant; the rules variant shards R and takes B as-is),
+        transfers the staged buffers with their input shardings — each
+        device receives only its own shard — and returns
+        ``(mask_i32 dev[R, Bp], counts dev | None, B, Bp)``
+        (``with_counts=False`` skips the per-rule totals and their
+        cross-device psum — the engine filter path never reads them).
+        The staged device buffers are CONSUMED when donation is on:
+        re-reading them after dispatch raises instead of silently
+        aliasing the verdict bytes."""
+        from .mesh import pad_to_devices
+
+        h = self._mesh_handle(mesh, donate, with_counts)
+        R, B, L = batch.shape
+        Bp = pad_to_devices(B, h.n_devices) if h.variant == "batch" else B
+        if Bp != B:
+            batch = np.concatenate(
+                [batch, np.zeros((R, Bp - B, L), dtype=batch.dtype)],
+                axis=1)
+            lengths = np.concatenate(
+                [lengths, np.full((R, Bp - B), -1, dtype=lengths.dtype)],
+                axis=1)
+        bd = jax.device_put(np.ascontiguousarray(batch, dtype=np.uint8),
+                            h.sh_b)
+        ld = jax.device_put(np.ascontiguousarray(lengths, dtype=np.int32),
+                            h.sh_l)
+        if with_counts:
+            mask_i32, counts = h.fn(h.tables, bd, ld)
+        else:
+            mask_i32, counts = h.fn(h.tables, bd, ld), None
+        return mask_i32, counts, B, Bp
+
+    def match_mesh(self, mesh, batch: np.ndarray, lengths: np.ndarray,
+                   donate: str = "auto"):
+        """Run the partitioned matcher and force: returns
+        ``(mask[R, B] bool numpy, counts[R] numpy, Bp)`` — bit-exact
+        with :meth:`match` and the CPU chain (tier-1 ``mesh`` tests)."""
+        mask_i32, counts, B, Bp = self.dispatch_mesh(
+            mesh, batch, lengths, donate)
+        mask = np.asarray(mask_i32).astype(bool)[:, :B]
+        return mask, np.asarray(counts), Bp
+
+    def donation_info(self, mesh, B: int = 64,
+                      donate: str = "auto") -> dict:
+        """Compile-level donation status for the bench RESULT / tier-1
+        donation test: which staged args are declared donated, whether
+        the lowered module carries the input→output aliases
+        (``tf.aliasing_output``), plus the variant and per-device batch
+        share for a B-row segment."""
+        from .mesh import donation_report, pad_to_devices
+
+        h = self._mesh_handle(mesh, donate)
+        R = len(self.dfas)
+        Bp = pad_to_devices(B, h.n_devices) if h.variant == "batch" else B
+        batch = np.zeros((R, Bp, self.max_len), dtype=np.uint8)
+        lengths = np.full((R, Bp), -1, dtype=np.int32)
+        bd = jax.device_put(batch, h.sh_b)
+        ld = jax.device_put(lengths, h.sh_l)
+        lowered = h.fn.lower(h.tables, bd, ld)
+        names = ["tables", "batch", "lengths"]
+        rep = donation_report(lowered, h.donate_idx, names)
+        rep.update({
+            "variant": h.variant,
+            "devices": h.n_devices,
+            "per_device_batch_share": (
+                Bp // h.n_devices if h.variant == "batch" else Bp),
+            "per_device_rule_share": (
+                R // h.n_devices if h.variant == "rules" else R),
+        })
+        return rep
+
+
+class _MeshHandle:
+    """One mesh's compiled partitioned matcher + resident sharded
+    tables (built once per mesh structure by ``_mesh_handle``)."""
+
+    __slots__ = ("fn", "tables", "sh_b", "sh_l", "variant",
+                 "n_devices", "donate_idx", "with_counts")
+
+    def __init__(self, fn, tables, sh_b, sh_l, variant, n_devices,
+                 donate_idx, with_counts=True):
+        self.fn = fn
+        self.tables = tables
+        self.sh_b = sh_b
+        self.sh_l = sh_l
+        self.variant = variant
+        self.n_devices = n_devices
+        self.donate_idx = donate_idx
+        self.with_counts = with_counts
 
 
 @functools.lru_cache(maxsize=64)

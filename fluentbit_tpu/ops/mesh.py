@@ -1,0 +1,205 @@
+"""Mesh partitioning layer — declarative PartitionSpecs + shared mesh
+helpers for every SPMD plane (grep DFA, sketches, flux kernels).
+
+The device programs in this repo all shard the same way: one 1-D device
+mesh, a batch-like axis split across chips, small lookup tables
+replicated (or sharded over the rule axis when R is large). Before this
+module each plane hand-wrote its specs inline; the partition decisions
+now live in *rules* — ``(regex over the leaf name, PartitionSpec)``
+pairs matched against a named table pytree, the ``match_partition_rules``
+pattern of large-model training codebases (SNIPPETS.md [2]) — so a
+reviewer can read the whole sharding layout of a program in one table,
+and a new table added to a program picks up a spec by name instead of
+by editing three call sites.
+
+Also here:
+
+- ``build_mesh`` / ``mesh_key`` / ``mesh_info`` — the one mesh
+  constructor and cache-key/diagnostics helpers every plane shares
+  (flux_mesh and ops.sketch used to carry private copies).
+- donation helpers — compute the *aliasable* subset of staged input
+  buffers (exact sharded shape+dtype match against the outputs, the
+  same matching ``jax.jit`` itself performs) so donation never degrades
+  into the silent "Some donated buffers were not usable" copy fallback,
+  and report which aliases actually landed in the lowered HLO
+  (``tf.aliasing_output``) for the bench RESULT and the tier-1
+  donation test.
+
+Everything degrades gracefully without jax: ``build_mesh`` returns
+None and the callers stay on their host twins.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import jax
+
+    HAVE_JAX = True
+except Exception:  # pragma: no cover - jax absent: host twins only
+    HAVE_JAX = False
+
+__all__ = [
+    "named_tree_map", "match_partition_rules", "build_mesh", "mesh_key",
+    "mesh_info", "pad_to_devices", "aliasable_donations",
+    "donation_report",
+]
+
+
+def named_tree_map(fn, tree, sep: str = "/"):
+    """``tree_map`` with the leaf's /-joined key path as first argument
+    (the naming layer ``match_partition_rules`` matches against)."""
+    from jax.tree_util import keystr, tree_map_with_path
+
+    def call(path, leaf):
+        name = keystr(path)
+        # keystr renders "['trans_flat']"; flatten to trans_flat/sub
+        name = re.sub(r"\[['\"]?([^'\"\]]*)['\"]?\]", r"\1" + sep, name)
+        return fn(name.rstrip(sep), leaf)
+
+    return tree_map_with_path(call, tree)
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, Any]], tree,
+                          *, scalars_replicate: bool = True):
+    """Pytree of arrays → pytree of PartitionSpec via first-match regex
+    rules over leaf names. Scalars (0-d / size-1 leaves) replicate
+    unconditionally — there is nothing to split. A leaf no rule covers
+    raises: an unsharded table sneaking into a partitioned program is a
+    layout bug, not a default."""
+    from jax.sharding import PartitionSpec as P
+
+    def pick(name, leaf):
+        shape = getattr(leaf, "shape", ())
+        if scalars_replicate and (len(shape) == 0 or int(np.prod(shape)) == 1):
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches leaf {name!r}")
+
+    return named_tree_map(pick, tree)
+
+
+def build_mesh(n_devices: Optional[int] = None, axis: str = "batch"):
+    """A 1-D mesh over the available devices. Under the simulated-mesh
+    lane (``XLA_FLAGS=--xla_force_host_platform_device_count=8``, the
+    tier-1 default — tests/conftest.py) these are 8 virtual CPU
+    devices; on real hardware, the attached chips. Returns None when
+    jax is unavailable or fewer than two devices exist (the mesh path
+    would be pure overhead)."""
+    if not HAVE_JAX:
+        return None
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def mesh_key(mesh) -> tuple:
+    """Structural cache key: equal meshes share a compiled program
+    (id() would recompile per Mesh object)."""
+    return (tuple(mesh.axis_names),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def mesh_info(mesh) -> Dict[str, Any]:
+    """Diagnostics block for RESULT JSON / health surfaces: shape,
+    platform, and whether this is the simulated host-platform mesh."""
+    import os
+
+    if mesh is None:
+        return {"devices": 1, "axis_names": [], "simulated": False,
+                "platform": None}
+    devs = list(mesh.devices.flat)
+    plat = getattr(devs[0], "platform", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    simulated = (plat == "cpu"
+                 and "xla_force_host_platform_device_count" in flags)
+    return {
+        "devices": len(devs),
+        "axis_names": list(mesh.axis_names),
+        "platform": plat,
+        "simulated": simulated,
+    }
+
+
+def pad_to_devices(n: int, n_devices: int) -> int:
+    """Smallest multiple of the device count ≥ n (NamedSharding requires
+    the sharded dimension divisible by the mesh size)."""
+    if n_devices <= 1:
+        return n
+    return ((n + n_devices - 1) // n_devices) * n_devices
+
+
+# -- donation ----------------------------------------------------------
+
+def _sharded_shape(shape, spec, mesh) -> tuple:
+    """Per-device shard shape for an array of ``shape`` under ``spec``
+    (what jax's donation matcher compares — aliasing is decided on the
+    *sharded* avals)."""
+    axes = {a: n for a, n in zip(mesh.axis_names,
+                                 mesh.devices.shape)}
+    out = list(shape)
+    for i, s in enumerate(spec):
+        if s is None:
+            continue
+        names = s if isinstance(s, tuple) else (s,)
+        for nm in names:
+            out[i] //= axes.get(nm, 1)
+    return tuple(out)
+
+
+def aliasable_donations(mesh, in_specs: Sequence[tuple],
+                        out_specs: Sequence[tuple]) -> List[int]:
+    """Indices of donatable inputs whose sharded (shape, dtype) exactly
+    matches an output's — the subset jax can actually alias. Donating
+    anything else is a silent no-op plus a compile-time warning (the
+    "copy fallback" the mesh bench must never hide), so the mesh
+    matcher donates exactly this set.
+
+    ``in_specs``/``out_specs``: sequences of
+    ``(shape, dtype, PartitionSpec, donatable: bool)`` /
+    ``(shape, dtype, PartitionSpec)``.
+    """
+    outs: Dict[tuple, int] = {}
+    for shape, dtype, spec in out_specs:
+        key = (_sharded_shape(shape, spec, mesh), np.dtype(dtype))
+        outs[key] = outs.get(key, 0) + 1
+    donate: List[int] = []
+    for i, (shape, dtype, spec, ok) in enumerate(in_specs):
+        if not ok:
+            continue
+        key = (_sharded_shape(shape, spec, mesh), np.dtype(dtype))
+        if outs.get(key, 0) > 0:
+            outs[key] -= 1
+            donate.append(i)
+    return donate
+
+
+def donation_report(lowered, donate_argnums: Sequence[int],
+                    arg_names: Sequence[str]) -> Dict[str, Any]:
+    """Inspect a ``jax.jit(...).lower(...)`` result for the
+    input→output aliases donation promised. Returns
+    ``{"declared": [...], "held": bool, "alias_count": int}`` where
+    ``held`` means the lowered module carries at least one
+    ``tf.aliasing_output`` annotation per declared arg — the
+    compiled-module check the tier-1 donation test asserts (run-time
+    proof is the donated buffer's ``is_deleted()`` flip)."""
+    txt = lowered.as_text()
+    n_alias = txt.count("tf.aliasing_output")
+    declared = [arg_names[i] if i < len(arg_names) else str(i)
+                for i in donate_argnums]
+    return {
+        "declared": declared,
+        "alias_count": n_alias,
+        "held": n_alias >= len(declared) and bool(declared),
+    }

@@ -330,9 +330,11 @@ def _mix_np(h: np.uint32) -> np.uint32:
 def _mesh_key(mesh) -> tuple:
     """Structural cache key: equal meshes (same axes + devices) share a
     compiled step; keying by id(mesh) would miss every freshly
-    constructed-but-identical Mesh and pin dead meshes forever."""
-    return (tuple(mesh.axis_names),
-            tuple(d.id for d in mesh.devices.flat))
+    constructed-but-identical Mesh and pin dead meshes forever.
+    (Shared helper in ops.mesh — same key the grep/flux caches use.)"""
+    from .mesh import mesh_key
+
+    return mesh_key(mesh)
 
 
 def _pad_to_mesh(mesh, batch, lengths):

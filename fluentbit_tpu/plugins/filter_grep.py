@@ -233,6 +233,8 @@ class GrepFilter(FilterPlugin):
         self._program = None
         self._native_tables = None
         self._native_filter = None
+        self._mesh = None
+        self._mesh_resolved = False
         self.raw_timings = ShardedTimings()
         # per-worker copies of the read-only native tables (multi-input
         # scaling: no cross-thread sharing of the hot arrays)
@@ -363,6 +365,60 @@ class GrepFilter(FilterPlugin):
 
     # -- raw chunk-bytes path (no Python decode) --
 
+    def _grep_mesh(self):
+        """The device mesh the raw path shards across, or None.
+
+        Resolved from ``FBTPU_MESH``: ``off``/``0`` never builds one;
+        ``on``/``1``/``force`` builds it from whatever devices exist
+        (the simulated-mesh lane — 8 virtual CPU devices under
+        ``--xla_force_host_platform_device_count``); ``auto`` (default)
+        engages only when a real accelerator with ≥2 devices attached —
+        on a CPU backend the native fused matcher beats a partitioned
+        lax.scan by orders of magnitude, so auto must never shadow it.
+
+        The resolution only PINS once the attach controller reaches a
+        terminal state (ready/failed): a chunk arriving mid-attach must
+        not permanently disable the mesh lane for the plugin's lifetime
+        — until then every verdict keeps its bit-exact fallback and the
+        next chunk re-probes."""
+        import os as _os
+
+        if self._mesh_resolved:
+            return self._mesh
+        mode = _os.environ.get("FBTPU_MESH", "auto").lower()
+        if self._program is None or mode in ("0", "off"):
+            self._mesh_resolved = True
+            return None
+        from ..ops import device
+        from ..ops import mesh as om
+
+        try:
+            if mode in ("1", "on", "force"):
+                if device.wait():
+                    self._mesh = om.build_mesh()
+                    self._mesh_resolved = True
+                elif device.failed():
+                    log.warning("FBTPU_MESH=%s but device attach "
+                                "failed (%s); unsharded path pinned",
+                                mode, device.status().get("error"))
+                    self._mesh_resolved = True
+                # else: still attaching — re-probe on the next chunk
+            elif device.ready():
+                if device.platform() != "cpu" \
+                        and device.device_count() > 1:
+                    self._mesh = om.build_mesh()
+                self._mesh_resolved = True
+            elif device.failed():
+                self._mesh_resolved = True
+            else:
+                device.attach_async()  # auto mid-attach: probe again
+        except Exception:
+            log.warning("grep mesh build failed; unsharded device "
+                        "path serves", exc_info=True)
+            self._mesh = None
+            self._mesh_resolved = True
+        return self._mesh
+
     def can_filter_raw(self) -> bool:
         """True when matching can run straight off chunk bytes: native
         scanner present, every rule addresses a simple top-level key,
@@ -398,9 +454,13 @@ class GrepFilter(FilterPlugin):
         if not native.available():
             return None
         tm = self.raw_timings
+        # mesh first: when the partitioned pjit plane is engaged
+        # (FBTPU_MESH — real multi-chip attach, or forced for the
+        # simulated lane) it IS the device path, native serves staging
+        mesh = self._grep_mesh()
         # platform check FIRST: on a CPU-backend host try_ready() would
         # needlessly materialize the jax program that will never run
-        use_native = self._native_tables is not None and (
+        use_native = self._native_tables is not None and mesh is None and (
             device.platform() == "cpu" or not self._program.try_ready()
         )
         if use_native and self._native_filter is not None:
@@ -432,7 +492,7 @@ class GrepFilter(FilterPlugin):
         else:
             if n_records is not None and n_records < self.tpu_batch_records:
                 return None  # small batches: decode path is cheaper
-            got = self._jax_match_raw(data, n_records)
+            got = self._jax_match_raw(data, n_records, mesh=mesh)
             if got is None:
                 return None
             mask, offsets, n = got
@@ -465,7 +525,7 @@ class GrepFilter(FilterPlugin):
             setattr(tls, attr, t)
         return t
 
-    def _jax_match_raw(self, data, n_records):
+    def _jax_match_raw(self, data, n_records, mesh=None):
         """Device-kernel raw matching with double-buffered staging.
 
         The chunk's records split into fixed-size segments; host
@@ -475,6 +535,16 @@ class GrepFilter(FilterPlugin):
         and each mask is forced one segment behind. On a real
         accelerator the staging walk hides behind the DFA scan; single-
         segment chunks degrade to the stage-then-match order.
+
+        With ``mesh`` set, each segment launches through the
+        explicitly partitioned pjit matcher instead: the batch axis is
+        padded to the mesh size and sharded across devices, extraction
+        stages STRAIGHT into the [R, Bp, L] transfer matrix
+        (native.stage_field_into — the walk fans out across cores
+        behind FBTPU_STAGE_THREADS, so per-device shards extract in
+        parallel), and the staged buffers are donated to the kernel.
+        The next segment's extraction overlaps the in-flight sharded
+        launch exactly as on one device.
         Returns (mask[R, n], offsets[n+1], n) or None to decline."""
         import os as _os
         import time as _time
@@ -515,12 +585,45 @@ class GrepFilter(FilterPlugin):
         cnts: list = []
         offs_box = [offsets]  # filled by staging when not pre-scanned
 
+        n_dev = mesh.devices.size if mesh is not None else 1
+
         def stages():
             for s, e in bounds:
                 t0 = _time.perf_counter()
                 cnt = e - s
                 span = data if offs_box[0] is None \
                     else data[offs_box[0][s]: offs_box[0][e]]
+                if mesh is not None:
+                    # mesh staging: ONE jit-stable width (the sharded
+                    # program wants one compiled shape, not per-chunk
+                    # L buckets) and extraction lands straight in the
+                    # [R, Bp, L] transfer matrix — no arena copy, the
+                    # native pool splits the walk across cores
+                    Bp = bucket_size(seg if multi else cnt,
+                                     max_len=Lmax, multiple_of=n_dev)
+                    batch = np.empty((R, Bp, Lmax), dtype=np.uint8)
+                    lengths = np.full((R, Bp), -1, dtype=np.int32)
+                    for key, idxs in by_key.items():
+                        r0 = idxs[0]
+                        # single-segment chunks take the boundary
+                        # table straight from the staging walk (it
+                        # computes one anyway) — never re-scan
+                        want_offs = offs_box[0] is None
+                        offs = np.empty(cnt + 1, dtype=np.int64) \
+                            if want_offs else None
+                        count = native.stage_field_into(
+                            span, key, batch[r0], lengths[r0],
+                            n_hint=cnt, offsets_out=offs)
+                        if count is None or count != cnt:
+                            raise _RawDecline
+                        if want_offs:
+                            offs_box[0] = offs
+                        for r in idxs[1:]:
+                            batch[r, :cnt] = batch[r0, :cnt]
+                            lengths[r, :cnt] = lengths[r0, :cnt]
+                    extract_s[0] += _time.perf_counter() - t0
+                    yield batch, lengths, cnt
+                    continue
                 staged = {}
                 max_staged = 1
                 for key in by_key:
@@ -565,11 +668,23 @@ class GrepFilter(FilterPlugin):
             batch, lengths, cnt = item
             lens_parts.append(lengths[:, :cnt])
             cnts.append(cnt)
+            if mesh is not None:
+                # sharded launch: staged buffers transfer with their
+                # shardings and are donated to the kernel; the
+                # counts-free variant skips the per-segment psum the
+                # filter verdict never reads
+                mask_i32, _, _b, _bp = self._program.dispatch_mesh(
+                    mesh, batch, lengths, with_counts=False)
+                return mask_i32
             return self._program.dispatch(batch, lengths)
+
+        def collect(pending):
+            return np.asarray(pending).astype(bool) if mesh is not None \
+                else np.asarray(pending)
 
         t_all = _time.perf_counter()
         try:
-            masks = double_buffered(stages(), dispatch)
+            masks = double_buffered(stages(), dispatch, collect)
         except _RawDecline:
             return None
         wall = _time.perf_counter() - t_all

@@ -764,6 +764,21 @@ static void stage_slice_adapter(const void *ctx, int sx) {
 
 extern "C" {
 
+// How many slices a stage call requesting `nthreads` would ACTUALLY
+// fan out to after the hardware/16-way caps — the introspection probe
+// behind fluentbit_tpu.native.stage_threads_effective(), so the bench
+// RESULT records the real slice count instead of the env request.
+int32_t fbtpu_stage_effective_threads(int32_t nthreads) {
+    unsigned hw = std::thread::hardware_concurrency();
+    if (hw && nthreads > (int32_t)hw
+            && getenv("FBTPU_THREADS_NO_HW_CAP") == nullptr)
+        nthreads = (int32_t)hw;
+    if (nthreads > 16) nthreads = 16;
+    if (nthreads < 2) return 1;
+    int pool = pool_threads_wanted();
+    return nthreads < pool ? nthreads : pool;
+}
+
 // Threaded fbtpu_stage_field. offsets is REQUIRED (n+1 entries filled
 // by the phase-1 scan). nthreads counts total slices including the
 // caller's; the pool is sized on first call and later calls are capped

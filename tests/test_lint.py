@@ -358,6 +358,108 @@ def test_process_batch_suppression():
     assert lint_source(src, "fluentbit_tpu/plugins/filter_x.py") == []
 
 
+# pjit / shard_map coverage (the partitioned mesh plane): decorated and
+# call-arg forms both seed tracing, and host-callback escapes fire —
+# a callback inside a sharded program blocks every device's step
+
+BAD_PJIT_DECORATED = """
+import jax
+import numpy as np
+from jax.experimental.pjit import pjit
+
+@pjit
+def kernel(tables, batch):
+    host = np.asarray(batch)
+    return batch + host.sum()
+"""
+
+BAD_SHARD_MAP_CALLBACK = """
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+def build(mesh, specs):
+    def step(t, batch, lengths):
+        extra = jax.pure_callback(lambda x: x + 1, batch, batch)
+        return extra + t["starts"]
+
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=specs,
+                             out_specs=specs))
+"""
+
+GOOD_MESH_PROGRAM = """
+import re
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+def match_partition_rules(rules, tree):
+    # host-side partition-rules layer: np use is legal here (untraced)
+    def pick(name, leaf):
+        if np.prod(getattr(leaf, "shape", ())) == 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name):
+                return spec
+        raise ValueError(name)
+    return {k: pick(k, v) for k, v in tree.items()}
+
+def build(mesh, tspecs, axis):
+    def step(t, batch, lengths):
+        # pytree-structure membership is static per jit cache entry,
+        # not tracer boolification — must stay quiet
+        if "pair_maps" in t:
+            base = t["pair_maps"]
+        else:
+            base = t["starts"]
+        mask = (batch.sum(axis=2) + base[:, None] > 0) & (lengths >= 0)
+        return mask.astype(jnp.int32)
+
+    return jax.jit(shard_map(step, mesh=mesh,
+                             in_specs=(tspecs, P(None, axis, None),
+                                       P(None, axis)),
+                             out_specs=P(None, axis)))
+"""
+
+
+def test_pjit_decorated_host_sync_fires():
+    got = lint_source(BAD_PJIT_DECORATED, "fluentbit_tpu/ops/fixture.py")
+    assert rules(got) == ["jax-host-sync"]
+
+
+def test_shard_map_arg_callback_fires():
+    got = lint_source(BAD_SHARD_MAP_CALLBACK,
+                      "fluentbit_tpu/ops/fixture.py")
+    assert rules(got) == ["jax-host-sync"]
+    assert "callback" in got[0].message
+
+
+def test_mesh_program_with_partition_rules_quiet():
+    # the partition-rules layer is host code (np/re legal); the
+    # shard_map'd step's dict-membership branch is pytree structure
+    assert lint_source(GOOD_MESH_PROGRAM,
+                       "fluentbit_tpu/ops/fixture.py") == []
+
+
+def test_membership_over_traced_array_param_still_fires():
+    # the pytree-membership exemption is scoped to params the kernel
+    # also string-subscripts (dict pytrees); `"GET" in batch` over a
+    # traced ARRAY iterates the tracer at trace time and must fire
+    src = """
+import jax
+
+@jax.jit
+def kernel(batch, lengths):
+    if "GET" in batch:
+        return lengths
+    return batch
+"""
+    got = lint_source(src, "fluentbit_tpu/ops/fixture.py")
+    assert rules(got) == ["jax-retrace"]
+
+
 # ---------------------------------------------------------------------
 # swallowed-error
 # ---------------------------------------------------------------------

@@ -410,3 +410,102 @@ def test_pool_dispatch_paths_exercised(monkeypatch):
             assert bytes(batch[i][: lengths[i]]) == v.encode()
         else:
             assert lengths[i] == -1
+
+
+def test_stage_field_into_caller_buffer_parity():
+    """The mesh plane's direct-into-matrix stager: staging one
+    rule-row slice of a [R, Bp, L] segment matrix lands bit-identical
+    bytes/lengths to the arena-based stage_field (incl. missing
+    fields, non-string values, overflow -2 rows)."""
+    import numpy as np
+
+    buf = corpus(seed=7, n=600)
+    ref = native.stage_field(buf, b"log", 128)
+    assert ref is not None
+    rb, rl, _, n = ref
+    rb, rl = rb.copy(), rl.copy()  # arena views: next call overwrites
+    R, Bp = 3, 608  # mesh-aligned pad (608 % 8 == 0)
+    batch = np.empty((R, Bp, 128), dtype=np.uint8)
+    lengths = np.full((R, Bp), -1, dtype=np.int32)
+    got = native.stage_field_into(buf, b"log", batch[1], lengths[1],
+                                  n_hint=n)
+    assert got == n
+    assert np.array_equal(lengths[1, :n], rl[:n])
+    for i in range(n):
+        if lengths[1, i] > 0:
+            assert np.array_equal(batch[1, i, :lengths[1, i]],
+                                  rb[i, :rl[i]])
+    assert (lengths[1, n:] == -1).all()  # pad rows untouched
+
+
+def test_stage_field_into_pooled_parity(monkeypatch):
+    """Oversubscribed pool fan-out (FBTPU_STAGE_THREADS>1 behind
+    FBTPU_THREADS_NO_HW_CAP on this box) produces bytes identical to
+    the serial walk — the multi-core lane's correctness half; the
+    throughput half is the bench's staging_mt stage on real cores."""
+    import numpy as np
+
+    monkeypatch.setenv("FBTPU_THREADS_NO_HW_CAP", "1")
+    buf = corpus(seed=9, n=2000)  # >=1024: the pooled path engages
+    b1 = np.empty((2048, 128), dtype=np.uint8)
+    l1 = np.full((2048,), -1, dtype=np.int32)
+    n1 = native.stage_field_into(buf, b"log", b1, l1, threads=1)
+    b4 = np.empty((2048, 128), dtype=np.uint8)
+    l4 = np.full((2048,), -1, dtype=np.int32)
+    n4 = native.stage_field_into(buf, b"log", b4, l4, threads=4)
+    assert n1 == n4 and n1 is not None
+    assert np.array_equal(l1, l4)
+    for i in range(n1):
+        if l1[i] > 0:
+            assert np.array_equal(b1[i, :l1[i]], b4[i, :l1[i]])
+
+
+def test_stage_field_into_rejects_bad_buffers():
+    import numpy as np
+
+    buf = corpus(seed=3, n=100)
+    # too small
+    b = np.empty((10, 64), dtype=np.uint8)
+    ln = np.full((10,), -1, dtype=np.int32)
+    assert native.stage_field_into(buf, b"log", b, ln) is None
+    # wrong dtype
+    b2 = np.empty((128, 64), dtype=np.int32)
+    l2 = np.full((128,), -1, dtype=np.int32)
+    assert native.stage_field_into(buf, b"log", b2, l2) is None
+    # non-contiguous slice (column stride)
+    b3 = np.empty((128, 128), dtype=np.uint8)[:, ::2]
+    l3 = np.full((128,), -1, dtype=np.int32)
+    assert native.stage_field_into(buf, b"log", b3, l3) is None
+    # strided lengths view: the base pointer would corrupt the
+    # skipped elements — must reject, not write
+    b4 = np.empty((128, 64), dtype=np.uint8)
+    l4 = np.full((256,), -1, dtype=np.int32)[::2]
+    assert native.stage_field_into(buf, b"log", b4, l4) is None
+    # undersized / mistyped offsets_out
+    l5 = np.full((128,), -1, dtype=np.int32)
+    o_small = np.empty((10,), dtype=np.int64)
+    assert native.stage_field_into(buf, b"log", b4, l5,
+                                   offsets_out=o_small) is None
+    o_f32 = np.empty((256,), dtype=np.float32)
+    assert native.stage_field_into(buf, b"log", b4, l5,
+                                   offsets_out=o_f32) is None
+    # a correctly-sized offsets_out comes back as the boundary table
+    o_ok = np.empty((256,), dtype=np.int64)
+    n = native.stage_field_into(buf, b"log", b4, l5, offsets_out=o_ok)
+    assert n == native.count_records(buf)
+    ref = native.scan_offsets(buf)
+    assert np.array_equal(o_ok[: n + 1], ref)
+
+
+def test_stage_threads_introspection(monkeypatch):
+    """stage_threads_effective reports the post-cap slice count the
+    pool will really use (the truth the bench RESULT records)."""
+    eff = native.stage_threads_effective(4)
+    if eff is None:
+        pytest.skip("older .so without the probe")
+    import os
+
+    hw = os.cpu_count() or 1
+    assert 1 <= eff <= min(max(hw, 1), 16)
+    assert native.stage_threads_effective(1) == 1
+    assert native.stage_threads() >= 1
