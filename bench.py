@@ -543,6 +543,21 @@ def measure_mesh(raw_chunks, per_point_s: float = 0.6) -> dict:
             os.environ.pop("FBTPU_MESH", None)
         else:
             os.environ["FBTPU_MESH"] = prev
+    # fbtpu-armor failover stats: a real-chip run that silently degraded
+    # to the CPU fallback must be visible IN the RESULT, not only as a
+    # suspiciously CPU-shaped lines/s number — fallback segments,
+    # breaker trips, device losses and the attach retry/generation
+    # history all ride along
+    from fluentbit_tpu.ops import device as _dev
+    from fluentbit_tpu.ops import fault as _fault
+
+    st = _dev.status()
+    out["failover"] = {
+        "lanes": _fault.snapshot(),
+        "attach_attempts": st.get("attempts"),
+        "attach_generation": st.get("generation"),
+        "reattach_count": max(0, (st.get("generation") or 0) - 1),
+    }
     return out
 
 
@@ -890,6 +905,19 @@ def child_main(mode: str) -> None:
             # the report must never drift from the behavior
             result["attach_fail_fast"] = fail_fast
             result["platform_report"] = _pjrt_discovery()
+            # retry-world attach record (fbtpu-armor): the FULL retry
+            # history — every attempt's error and timing, the attempt
+            # count and any pending-retry ETA — not only the first
+            # refusal. 'failed' here means EXHAUSTED; 'attaching' with
+            # an ETA means the bounded backoff loop is still running
+            # and a later attempt could still swap the mesh lane in
+            result["attach_retries"] = {
+                "attempts": st.get("attempts"),
+                "retries_max": st.get("retries_max"),
+                "history": st.get("retry_history"),
+                "next_retry_eta_s": st.get("next_retry_eta_s"),
+                "generation": st.get("generation"),
+            }
 
     def run_kernel_only():
         _progress(stage=f"{mode}:kernel_only")

@@ -47,6 +47,12 @@ Six rule families (see ANALYSIS.md for the full contract):
   also reach the fbtpu-qos tenant admission call (``qos.admit``) —
   an unmetered path silently bypasses every tenant quota
   (analysis.qos).
+- **guarded device dispatch** (`device-unguarded-dispatch`): any
+  public plugin/flux path from which a jit/pjit/shard_map dispatch is
+  reachable must also go through the fbtpu-armor ``DeviceLane``
+  (``lane.run``/``begin``/``finish``) — an unguarded dispatch would
+  stall or drop on device faults instead of failing over bit-exactly
+  (analysis.devlane).
 
 The native C/C++ data plane has its own gate (analysis.native_gate):
 clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
@@ -153,6 +159,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .batch import BatchExactnessRules
     from .deadline import AwaitNoDeadlineRule
     from .decline import DeclineSwallowRule
+    from .devlane import UnguardedDispatchRule
     from .dtype import DtypeNarrowingRule
     from .locks import AwaitUnderLockRule, GuardedByRule
     from .purity import JaxPurityRules
@@ -169,6 +176,7 @@ def _build_rules(guards=None) -> List[Rule]:
         DtypeNarrowingRule(),
         AwaitNoDeadlineRule(),
         UnmeteredIngestRule(),
+        UnguardedDispatchRule(),
     ]
 
 

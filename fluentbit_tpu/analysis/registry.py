@@ -146,9 +146,33 @@ GUARDS: Tuple[GuardEntry, ...] = (
     # -- device attach controller --
     GuardEntry(
         "fluentbit_tpu/ops/device.py", "_lock",
-        ("_state", "_error", "_attach_seconds", "_platform", "_thread"),
+        ("_state", "_error", "_attach_seconds", "_platform", "_thread",
+         "_attempts", "_retry_history", "_next_retry_at", "_generation"),
         writes_only=True, kind="global",
-        note="attach state machine: ready()/failed()/status() are "
-             "lock-free probes by design; transitions serialize",
+        note="attach state machine (retry-world, fbtpu-armor): "
+             "ready()/failed()/generation()/status() are lock-free "
+             "probes by design; transitions and retry bookkeeping "
+             "serialize",
+    ),
+    # -- fbtpu-armor device fault domain --
+    GuardEntry(
+        "fluentbit_tpu/ops/fault.py", "_lock",
+        ("_stats", "_lost", "_ok_since_shrink", "_mesh", "_mesh_key"),
+        writes_only=True,
+        note="device-lane failover state: stats()/current_mesh() "
+             "fast-path reads are benign-staleness probes; mutation "
+             "(launch outcomes, shrink/regrow) serializes",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/ops/fault.py", "_registry_lock",
+        ("_lanes",), kind="global",
+        note="process-global lane registry: created from plugin init "
+             "on any thread, read by health/bench snapshots",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/ops/fault.py", "_listener_lock",
+        ("_listeners",), kind="global",
+        note="fault event listener list: engines register/release on "
+             "start/stop while lanes notify from worker threads",
     ),
 )

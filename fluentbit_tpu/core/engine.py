@@ -205,6 +205,39 @@ class Engine:
         self.m_failpoint_triggered = m.counter(
             "fluentbit", "", "failpoint_triggered_total",
             "Faults triggered by the failpoint plane", ("name",))
+        # fbtpu-armor device fault domain (ops/fault.py): per-lane
+        # failover counters, fed by the fault listener bridge — a mesh
+        # lane silently degrading to the CPU fallback is a metric, not
+        # a mystery CPU-speed bench number
+        self.m_device_fallback = m.counter(
+            "fluentbit", "device", "fallback_segments_total",
+            "Segments completed on the bit-exact CPU fallback after a "
+            "device launch failed, timed out, or was short-circuited",
+            ("lane",))
+        self.m_device_timeouts = m.counter(
+            "fluentbit", "device", "launch_timeouts_total",
+            "Device launches soft-killed past the lane deadline",
+            ("lane",))
+        self.m_device_failures = m.counter(
+            "fluentbit", "device", "launch_failures_total",
+            "Device launches that raised (XlaRuntimeError, injected "
+            "faults, resource exhaustion)", ("lane",))
+        self.m_device_lost = m.counter(
+            "fluentbit", "device", "device_lost_total",
+            "Device-loss events (mesh shrinks to the survivors)",
+            ("lane",))
+        self.m_device_breaker = m.gauge(
+            "fluentbit", "device", "breaker_state",
+            "Per-lane device breaker state (0 closed, 1 half-open, "
+            "2 open)", ("lane",))
+        self.m_device_mesh = m.gauge(
+            "fluentbit", "device", "mesh_devices",
+            "Devices in the lane's current mesh (shrinks on loss, "
+            "regrows on breaker re-close)", ("lane",))
+        self.m_device_reattach = m.counter(
+            "fluentbit", "device", "reattach_total",
+            "Late/re-attach generations (the mesh lane swapped in "
+            "live after earlier refusals)")
 
     # ------------------------------------------------------------------
     # configuration
@@ -536,6 +569,11 @@ class Engine:
         # failpoint trigger → metric bridge (unarmed plane: the listener
         # list is only walked when a fault actually fires)
         _fp.add_listener(self._on_failpoint_trigger)
+        # device fault-domain → metric bridge (fbtpu-armor): healthy
+        # lanes emit nothing, so the hot path pays zero here
+        from ..ops import fault as _fault
+
+        _fault.add_listener(self._on_device_event)
         self._stopping = False
         self._stop_event.clear()
         self._thread = threading.Thread(target=self._run, name="flb-engine", daemon=True)
@@ -789,9 +827,15 @@ class Engine:
             if self.storage is not None:
                 self.storage.close()
         finally:
-            # always release the module-global listener: a teardown
+            # always release the module-global listeners: a teardown
             # error must not pin this engine (and its metrics) forever
             _fp.remove_listener(self._on_failpoint_trigger)
+            try:
+                from ..ops import fault as _fault
+
+                _fault.remove_listener(self._on_device_event)
+            except Exception:
+                log.exception("device fault listener release failed")
 
     def _dump_stuck_shutdown(self) -> None:
         """The engine thread outlived grace+10s at stop(): log it and
@@ -812,6 +856,25 @@ class Engine:
 
     def _on_failpoint_trigger(self, name: str, _action: str) -> None:
         self.m_failpoint_triggered.inc(1, (name,))
+
+    def _on_device_event(self, lane: str, event: str, value) -> None:
+        """fbtpu-armor listener bridge → fluentbit_device_* metrics
+        (ops/fault.py event vocabulary)."""
+        if event == "fallback" or event == "short_circuit":
+            self.m_device_fallback.inc(1, (lane,))
+        elif event == "timeout":
+            self.m_device_timeouts.inc(1, (lane,))
+        elif event == "failure":
+            self.m_device_failures.inc(1, (lane,))
+        elif event == "device_lost":
+            self.m_device_lost.inc(1, (lane,))
+        elif event == "breaker":
+            code = {"closed": 0, "half-open": 1, "open": 2}.get(value, 0)
+            self.m_device_breaker.set(code, (lane,))
+        elif event == "mesh_devices":
+            self.m_device_mesh.set(float(value), (lane,))
+        elif event == "reattach":
+            self.m_device_reattach.inc(1)
 
     @property
     def running(self) -> bool:

@@ -790,9 +790,25 @@ class Guard:
         svc = engine.service
         running = engine.running
         hb_age = max(0.0, now - self.heartbeat) if running else 0.0
+        # fbtpu-armor device fault domain (ops/fault.py): attach
+        # lifecycle + per-lane breaker/failover state. A lane breaker
+        # not closed means the device path is degraded to its bit-exact
+        # CPU fallback — records flow, throughput doesn't, and the
+        # health verdict must say so
+        try:
+            from ..ops import fault as _fault
+
+            device_block = _fault.health_block()
+        except Exception:
+            log.exception("device health block failed")
+            device_block = {"error": "unavailable"}
+        lane_breakers = [
+            ln.get("breaker") for ln in device_block.get("lanes",
+                                                         {}).values()]
         verdict = "ok"
         if (any(s != "closed" for s in breakers.values()) or shed
-                or occupancy >= self._watermark_slots()):
+                or occupancy >= self._watermark_slots()
+                or any(b not in (None, "closed") for b in lane_breakers)):
             verdict = "degraded"
         if running and hb_age > max(svc.guard_stall_after,
                                     5.0 * svc.flush):
@@ -805,6 +821,8 @@ class Guard:
             "inflight_flushes": inflight,
             "shed_chunks": shed,
             "breakers": breakers,
+            # fbtpu-armor: attach retry state + device-lane failover
+            "device": device_block,
             # fbtpu-qos per-tenant state (QOS.md): generation + each
             # tenant's contract, admission counters and queue depth
             "qos": engine.qos.snapshot(),

@@ -97,7 +97,19 @@ SITES: Tuple[str, ...] = (
     "output.worker_flush",       # OutputWorkerPool.submit, before the handoff
     "output.worker_start",       # OutputWorkerPool._worker, before the ready barrier
     "codec.fallback",            # filter_parser batched JSON path: forced decline
-    "device.attach",             # ops.device._attach_worker, before backend init
+    "device.attach",             # ops.device._attach_once, before backend init
+                                 # (fires once per RETRY attempt — fbtpu-armor)
+    "device.dispatch",           # ops.fault.DeviceLane, post-launch boundary:
+                                 # donated staged buffers already consumed, so
+                                 # return() exercises the re-stage-on-retry hazard
+    "device.launch_hang",        # ops.fault.DeviceLane, before the launch — a
+                                 # hang() here is the wedged-launch shape the
+                                 # lane deadline soft-kills to the CPU fallback
+    "mesh.device_lost",          # ops.fault.DeviceLane — return() marks the
+                                 # launch as device loss: mesh shrinks to the
+                                 # survivors, regrows when the breaker re-closes
+    "flux.device_update",        # flux device sketch/count launches (inside the
+                                 # flux lane's watched closure)
     "flux.snapshot",             # FluxState.persist, tmp written+fsynced, before
                                  # the atomic rename (crash → old file intact)
     "s3.upload_part",            # outputs_aws._mp_upload_part (RETRY repro site)

@@ -30,7 +30,7 @@ except Exception:  # pragma: no cover - jax absent: host twins only
     HAVE_JAX = False
 
 __all__ = ["flux_mesh", "segment_counts", "sharded_segment_counts",
-           "host_segment_counts"]
+           "host_segment_counts", "guarded_segment_counts"]
 
 #: compiled-kernel caches, keyed by padded segment count (and mesh
 #: structure for the sharded variant) — a fresh jit per call would
@@ -148,3 +148,27 @@ def sharded_segment_counts(mesh, seg: np.ndarray, valid: np.ndarray,
         ))
     got = np.asarray(fn(jnp.asarray(seg32), jnp.asarray(valid32)))
     return got[:n_seg]
+
+
+def guarded_segment_counts(lane, seg: np.ndarray, valid: np.ndarray,
+                           n_seg: int, axis: str = "flux") -> np.ndarray:
+    """Group counts through the fbtpu-armor flux DeviceLane: the
+    sharded scatter-add/psum launch runs on the lane's watched worker
+    (deadline, breaker, ``flux.device_update`` failpoint), the mesh
+    comes from the lane (shrinks on device loss, regrows on breaker
+    re-close), and any failure resolves to the bit-identical host twin
+    — integer counters, so the result is exact either way."""
+    from .. import failpoints as _fp
+
+    def launch():
+        if _fp.ACTIVE:
+            _fp.fire("flux.device_update")
+        mesh = lane.current_mesh(axis=axis)
+        if mesh is None:  # shrunk below 2 devices: host twin serves
+            return host_segment_counts(seg, valid, n_seg)
+        return sharded_segment_counts(mesh, seg, valid, n_seg)
+
+    def fallback():
+        return host_segment_counts(seg, valid, n_seg)
+
+    return lane.run(launch, fallback)

@@ -55,8 +55,8 @@ from ..ops.batch import assemble, bucket_size
 from ..ops.sketch import (
     CountMin,
     HyperLogLog,
-    sharded_cms_update,
-    sharded_hll_update,
+    sharded_cms_table,
+    sharded_hll_registers,
 )
 from . import kernels
 
@@ -268,6 +268,7 @@ class FluxState:
         self.spec = spec
         self._now = now or time.time
         self._mesh = kernels.flux_mesh() if spec.mesh else None
+        self._lane = None  # fbtpu-armor flux DeviceLane (lazy)
         # processing-time pane machinery (SPTask twin)
         self._groups: Dict[tuple, _FluxGroup] = {}
         self._panes: List[Dict[tuple, _FluxGroup]] = []
@@ -435,8 +436,8 @@ class FluxState:
         n_groups = len(keys)
         if self._mesh is not None:
             ones = np.ones((seg.shape[0],), dtype=np.int32)
-            counts = kernels.sharded_segment_counts(
-                self._mesh, seg, ones, n_groups)
+            counts = kernels.guarded_segment_counts(
+                self._flux_lane(), seg, ones, n_groups)
         elif n_groups == 1:
             counts = np.asarray([n_rows], dtype=np.int32)
         else:
@@ -541,16 +542,103 @@ class FluxState:
 
         return device.ready() and device.platform() not in (None, "cpu")
 
+    def _flux_lane(self):
+        """The flux plane's device fault domain (fbtpu-armor): sketch
+        and count launches run on its watched worker with a deadline
+        and breaker; failures resolve to the bit-identical host twins,
+        and device sketch state re-materializes host-side (FAULTS.md
+        "fbtpu-armor")."""
+        lane = self._lane
+        if lane is None:
+            from ..ops import fault
+
+            lane = self._lane = fault.lane("flux")
+        return lane
+
     def _hll_absorb(self, hll: HyperLogLog, batch: np.ndarray,
                     lengths: np.ndarray) -> None:
-        if self._mesh is not None:
-            sharded_hll_update(hll, self._mesh, batch, lengths)
-        elif self._use_device():
-            hll.update(batch, lengths)
-        else:
+        mesh_on = self._mesh is not None
+        if not mesh_on and not self._use_device():
             # attached backend IS the host CPU (or none): the C twin
             # beats the jit round trip and is bit-identical
             hll.host_update(batch, lengths)
+            return
+        lane = self._flux_lane()
+        regs0 = hll.registers  # pre-launch snapshot: the watched
+        # worker computes from THIS, never from (or into) live sketch
+        # state — an abandoned (soft-killed) launch ends in a discarded
+        # local and can never clobber registers a fallback or later
+        # batch already advanced (commit happens below, caller-side)
+
+        def launch():
+            if _fp.ACTIVE:
+                _fp.fire("flux.device_update")
+            if mesh_on:
+                m = lane.current_mesh(axis="flux")
+                if m is not None:
+                    regs = sharded_hll_registers(hll, m, batch, lengths,
+                                                 registers=regs0)
+                else:  # mesh shrunk below 2 devices: single-device jit
+                    regs = hll.device_registers(batch, lengths,
+                                                wait=True,
+                                                registers=regs0)
+            else:
+                regs = hll.device_registers(batch, lengths,
+                                            registers=regs0)
+            if regs is None:
+                raise RuntimeError("device backend not attached")
+            return getattr(regs, "block_until_ready", lambda: regs)()
+
+        def fallback():
+            # device path failed: re-materialize the sketch from the
+            # pre-launch snapshot, host-pinned (numpy), and absorb
+            # there — bit-identical math
+            hll.registers = np.asarray(regs0)
+            hll.host_update(batch, lengths)
+            return None
+
+        got = lane.run(launch, fallback)
+        if got is not None:
+            hll.registers = got
+
+    def _cms_absorb(self, comp: np.ndarray,
+                    comp_len: np.ndarray) -> None:
+        """Count-min absorb through the flux lane — same
+        compute-without-commit protocol as :meth:`_hll_absorb`."""
+        cms = self.cms
+        mesh_on = self._mesh is not None
+        if not mesh_on and not self._use_device():
+            cms.host_update(comp, comp_len)
+            return
+        lane = self._flux_lane()
+        table0 = cms.table  # snapshot-in/commit-on-finish: see
+        # _hll_absorb — the watched worker never touches live state
+
+        def launch():
+            if _fp.ACTIVE:
+                _fp.fire("flux.device_update")
+            if mesh_on:
+                m = lane.current_mesh(axis="flux")
+                if m is not None:
+                    table = sharded_cms_table(cms, m, comp, comp_len,
+                                              table=table0)
+                else:
+                    table = cms.device_table(comp, comp_len, wait=True,
+                                             table=table0)
+            else:
+                table = cms.device_table(comp, comp_len, table=table0)
+            if table is None:
+                raise RuntimeError("device backend not attached")
+            return getattr(table, "block_until_ready", lambda: table)()
+
+        def fallback():
+            cms.table = np.asarray(table0)
+            cms.host_update(comp, comp_len)
+            return None
+
+        got = lane.run(launch, fallback)
+        if got is not None:
+            cms.table = got
 
     def _topk_absorb(self, key: tuple, batch: np.ndarray,
                      lengths: np.ndarray) -> None:
@@ -589,12 +677,7 @@ class FluxState:
             comp_len = np.concatenate(
                 [comp_len, np.full((Bp - valid.size,), -1,
                                    dtype=np.int32)])
-        if self._mesh is not None:
-            sharded_cms_update(self.cms, self._mesh, comp, comp_len)
-        elif self._use_device():
-            self.cms.update(comp, comp_len)
-        else:
-            self.cms.host_update(comp, comp_len)
+        self._cms_absorb(comp, comp_len)
         # candidate set: a BOUNDED sample of this chunk's values (the
         # CMS holds the counts; candidates only nominate keys for the
         # top-k read). Stride-sampling rows instead of uniquing the

@@ -13,8 +13,18 @@ program routes its first backend touch through here:
 
 Until ``ready()``, callers serve records on their (bit-exact) CPU
 fallback path; when attach completes, compiled device programs
-materialize lazily and the device path swaps in live. A failed attach
-(no jax, broken platform) pins the CPU path permanently.
+materialize lazily and the device path swaps in live.
+
+Attach is RETRIED (fbtpu-armor): a failed backend init no longer pins
+the CPU path for the process lifetime. The worker makes up to
+``FBTPU_ATTACH_RETRIES`` attempts with jittered exponential backoff
+(base ``FBTPU_ATTACH_BACKOFF_S``); ``failed()`` means *exhausted*, not
+"tried once". Each successful attach bumps the attach **generation** —
+mesh-lane consumers key their resolution on it, so an attach that
+succeeds after earlier refusals (or after :func:`reattach_async`) swaps
+the device path in live instead of staying pinned. ``status()`` reports
+the attempt count, per-attempt error history and the next retry ETA,
+which the bench RESULT records on the fail-fast path.
 
 ``FBTPU_ATTACH_WAIT_S`` tunes how long plugin init waits synchronously
 for the device before proceeding on CPU (default 2 s — tests force the
@@ -26,9 +36,10 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 log = logging.getLogger("flb.device")
 
@@ -38,6 +49,15 @@ _error: Optional[str] = None
 _thread: Optional[threading.Thread] = None
 _attach_seconds: Optional[float] = None
 _platform: Optional[str] = None
+_attempts = 0
+_retry_history: List[dict] = []
+_next_retry_at: Optional[float] = None
+_generation = 0  # successful attaches; consumers re-resolve on change
+
+#: History is bounded to the most recent attempts: a permanently-absent
+#: backend re-attached by the fault domain every breaker cooldown would
+#: otherwise grow the list (and every health/status copy) forever.
+_RETRY_HISTORY_MAX = 20
 
 
 def default_wait() -> float:
@@ -47,34 +67,101 @@ def default_wait() -> float:
         return 2.0
 
 
-def _attach_worker() -> None:
-    global _state, _error, _attach_seconds, _platform
-    t0 = time.time()
+def attach_retries() -> int:
+    """Max attach attempts before ``failed()`` (exhausted)."""
     try:
-        from .. import failpoints as _fp
+        return max(1, int(os.environ.get("FBTPU_ATTACH_RETRIES", "3")))
+    except ValueError:
+        return 3
 
-        if _fp.ACTIVE:
-            # delay(ms) simulates the minutes-long axon attach stall;
-            # return(err) pins the CPU fallback path (state=failed)
-            _fp.fire("device.attach")
-        import jax
-        import jax.numpy as jnp
 
-        n = len(jax.devices())  # the (possibly minutes-long) backend init
-        # one trivial dispatch so the runtime is fully warm before the
-        # first real kernel
-        jnp.zeros((8,), dtype=jnp.int32).block_until_ready()
+def attach_backoff() -> float:
+    """Base backoff between attempts (doubles per attempt, ±25%
+    jitter so a fleet of restarting workers never thunders in step)."""
+    try:
+        return max(0.0, float(
+            os.environ.get("FBTPU_ATTACH_BACKOFF_S", "0.5")))
+    except ValueError:
+        return 0.5
+
+
+def _attach_once(attempt: int) -> None:
+    """One backend-init attempt; raises on failure."""
+    global _attach_seconds, _platform
+    t0 = time.time()
+    from .. import failpoints as _fp
+
+    if _fp.ACTIVE:
+        # delay(ms) simulates the minutes-long axon attach stall;
+        # return(err) fails THIS attempt (the retry loop decides
+        # whether the CPU fallback pins)
+        _fp.fire("device.attach")
+    import jax
+    import jax.numpy as jnp
+
+    n = len(jax.devices())  # the (possibly minutes-long) backend init
+    # one trivial dispatch so the runtime is fully warm before the
+    # first real kernel
+    jnp.zeros((8,), dtype=jnp.int32).block_until_ready()
+    global _state, _generation
+    with _lock:
+        _attach_seconds = time.time() - t0
+        _platform = jax.default_backend()
+        _state = "ready"
+        _generation += 1
+        gen = _generation
+    log.info("device backend attached: %d device(s) in %.1fs "
+             "(attempt %d, generation %d)",
+             n, _attach_seconds, attempt, gen)
+    if gen > 1 or attempt > 1:
+        # a late/re-attach: tell the fault domain so lanes can swap
+        # the device path back in and the metric counts the event
+        try:
+            from . import fault as _fault
+
+            _fault.notify("attach", "reattach", gen)
+        except Exception:  # pragma: no cover - listener must not kill attach
+            log.exception("reattach notification failed")
+
+
+def _attach_worker() -> None:
+    global _state, _error, _attempts, _next_retry_at
+    retries = attach_retries()
+    backoff = attach_backoff()
+    for attempt in range(1, retries + 1):
         with _lock:
-            _attach_seconds = time.time() - t0
-            _platform = jax.default_backend()
-            _state = "ready"
-        log.info("device backend attached: %d device(s) in %.1fs",
-                 n, _attach_seconds)
-    except Exception as e:  # pragma: no cover - platform-dependent
-        with _lock:
-            _error = repr(e)
-            _state = "failed"
-        log.warning("device attach failed (CPU path pinned): %r", e)
+            _attempts = attempt
+            _next_retry_at = None
+        t0 = time.time()
+        try:
+            _attach_once(attempt)
+            return
+        except Exception as e:  # pragma: no cover - platform-dependent
+            err = repr(e)
+            with _lock:
+                _error = err
+                _retry_history.append({
+                    "attempt": attempt,
+                    "error": err,
+                    "elapsed_s": round(time.time() - t0, 3),
+                })
+                del _retry_history[:-_RETRY_HISTORY_MAX]
+            if attempt >= retries:
+                break
+            # jittered exponential backoff: base * 2^(attempt-1) ± 25%
+            delay = backoff * (2.0 ** (attempt - 1))
+            delay *= random.uniform(0.75, 1.25)
+            with _lock:
+                _next_retry_at = time.time() + delay
+            log.warning("device attach attempt %d/%d failed (%r); "
+                        "retrying in %.2fs", attempt, retries, e, delay)
+            time.sleep(delay)
+    with _lock:
+        _state = "failed"
+        _next_retry_at = None
+    log.warning("device attach exhausted after %d attempt(s) "
+                "(CPU path pinned until reattach_async): %s",
+                retries, _error)
 
 
 def attach_async() -> None:
@@ -92,12 +179,42 @@ def attach_async() -> None:
         _thread.start()
 
 
+def reattach_async() -> bool:
+    """Re-arm attach after exhaustion (a new retry budget). True when a
+    fresh attempt was started; False when attach is already running /
+    ready. The fault domain calls this when a device-lane breaker
+    half-opens against an exhausted attach — the probe that would
+    otherwise test a dead backend instead re-tests the attach itself."""
+    global _state, _thread
+    with _lock:
+        if _state != "failed":
+            return False
+        _state = "attaching"
+        _thread = threading.Thread(
+            target=_attach_worker, daemon=True,
+            name="flb-device-reattach"
+        )
+        _thread.start()
+    return True
+
+
 def ready() -> bool:
     return _state == "ready"
 
 
 def failed() -> bool:
+    """True when attach EXHAUSTED its retry budget (terminal until
+    :func:`reattach_async`) — a single failed attempt mid-retry-loop
+    still reports attaching."""
     return _state == "failed"
+
+
+def generation() -> int:
+    """Successful-attach counter (0 until the first attach). Mesh-lane
+    resolution is cached per generation: a bump means the device path
+    must be re-probed (the PR-8 "resolution stays open until terminal"
+    rule, extended to re-attach)."""
+    return _generation
 
 
 def wait(timeout: Optional[float] = None) -> bool:
@@ -147,9 +264,22 @@ def shard_map_fn():
 
 
 def status() -> dict:
-    return {
-        "state": _state,
-        "error": _error,
-        "platform": _platform,
-        "attach_seconds": _attach_seconds,
-    }
+    """Attach state for diagnostics and the bench RESULT: retry-world
+    fields (attempt count, per-attempt error history — the most recent
+    ``_RETRY_HISTORY_MAX`` entries — next retry ETA, attach
+    generation) ride along with the original block."""
+    with _lock:
+        eta = None
+        if _next_retry_at is not None:
+            eta = round(max(0.0, _next_retry_at - time.time()), 3)
+        return {
+            "state": _state,
+            "error": _error,
+            "platform": _platform,
+            "attach_seconds": _attach_seconds,
+            "attempts": _attempts,
+            "retries_max": attach_retries(),
+            "retry_history": list(_retry_history),
+            "next_retry_eta_s": eta,
+            "generation": _generation,
+        }

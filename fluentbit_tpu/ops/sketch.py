@@ -88,19 +88,28 @@ class HyperLogLog:
         self.registers = np.zeros((self.m,), dtype=np.int32)
         self._update = None
 
-    def _ensure_device(self, wait: bool = False) -> bool:
-        if self._update is not None:
-            return True
-        from . import device
+    def _device_jit(self, wait: bool = False):
+        """The update jit if the backend is attached (built once),
+        WITHOUT touching register state — safe from the fbtpu-armor
+        watched worker threads (the only race is a benign
+        double-assignment of an equivalent jit)."""
+        if self._update is None:
+            from . import device
 
-        ok = device.wait(max(60.0, device.default_wait())) if wait \
-            else device.ready()
-        if not ok:
-            if not wait:
-                device.attach_async()
+            ok = device.wait(max(60.0, device.default_wait())) if wait \
+                else device.ready()
+            if not ok:
+                if not wait:
+                    device.attach_async()
+                return None
+            self._update = jax.jit(self._update_impl)
+        return self._update
+
+    def _ensure_device(self, wait: bool = False) -> bool:
+        if self._device_jit(wait) is None:
             return False
-        self.registers = jnp.asarray(self.registers)
-        self._update = jax.jit(self._update_impl)
+        if isinstance(self.registers, np.ndarray):
+            self.registers = jnp.asarray(self.registers)
         return True
 
     def _update_impl(self, registers, batch, lengths):
@@ -118,6 +127,23 @@ class HyperLogLog:
         valid = lengths >= 0
         rank = jnp.where(valid, rank, 0)
         return registers.at[idx].max(rank)
+
+    def device_registers(self, batch: np.ndarray, lengths: np.ndarray,
+                         wait: bool = False, registers=None):
+        """Compute the post-update register set on the device WITHOUT
+        committing it or mutating ANY sketch state (None when the
+        backend isn't attached yet). The fbtpu-armor flux lane runs
+        this inside its watched launch from an explicit pre-launch
+        ``registers`` snapshot and commits on the caller thread only
+        after the launch resolves — a soft-killed (abandoned) launch
+        computes into a discarded local and can never clobber
+        registers a fallback or later batch already advanced."""
+        fn = self._device_jit(wait)
+        if fn is None:
+            return None
+        regs = self.registers if registers is None else registers
+        return fn(jnp.asarray(regs), jnp.asarray(batch),
+                  jnp.asarray(lengths))
 
     def update(self, batch: np.ndarray, lengths: np.ndarray) -> None:
         """Absorb a staged [B, L] batch (rows with length<0 ignored).
@@ -200,19 +226,25 @@ class CountMin:
         self._update = None
         self._row_ids = np.arange(depth, dtype=np.uint32)
 
-    def _ensure_device(self, wait: bool = False) -> bool:
-        if self._update is not None:
-            return True
-        from . import device
+    def _device_jit(self, wait: bool = False):
+        """Non-mutating jit accessor (see HyperLogLog._device_jit)."""
+        if self._update is None:
+            from . import device
 
-        ok = device.wait(max(60.0, device.default_wait())) if wait \
-            else device.ready()
-        if not ok:
-            if not wait:
-                device.attach_async()
+            ok = device.wait(max(60.0, device.default_wait())) if wait \
+                else device.ready()
+            if not ok:
+                if not wait:
+                    device.attach_async()
+                return None
+            self._update = jax.jit(self._update_impl)
+        return self._update
+
+    def _ensure_device(self, wait: bool = False) -> bool:
+        if self._device_jit(wait) is None:
             return False
-        self.table = jnp.asarray(self.table, dtype=self._dtype)
-        self._update = jax.jit(self._update_impl)
+        if isinstance(self.table, np.ndarray):
+            self.table = jnp.asarray(self.table, dtype=self._dtype)
         return True
 
     def _hashes(self, batch, lengths):
@@ -231,6 +263,24 @@ class CountMin:
             return tb.at[r, cols[r]].add(valid)
 
         return lax.fori_loop(0, d, body, table)
+
+    def device_table(self, batch: np.ndarray, lengths: np.ndarray,
+                     weights: Optional[np.ndarray] = None,
+                     wait: bool = False, table=None):
+        """Compute the post-update table on the device WITHOUT
+        committing or mutating any sketch state (None until attached)
+        — the same snapshot-in/commit-on-finish protocol as
+        :meth:`HyperLogLog.device_registers`."""
+        fn = self._device_jit(wait)
+        if fn is None:
+            return None
+        if weights is None:
+            weights = np.ones((batch.shape[0],), dtype=np.int32)
+        tbl = self.table if table is None else table
+        return fn(
+            jnp.asarray(tbl, dtype=self._dtype), jnp.asarray(batch),
+            jnp.asarray(lengths), jnp.asarray(weights),
+        )
 
     def update(self, batch: np.ndarray, lengths: np.ndarray,
                weights: Optional[np.ndarray] = None) -> None:
@@ -351,20 +401,24 @@ def _pad_to_mesh(mesh, batch, lengths):
     return batch, lengths
 
 
-def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
-                       lengths: np.ndarray) -> None:
-    """Update over a mesh: each device absorbs its batch shard into a
-    local register set, merged with lax.pmax (union of HLLs)."""
+def sharded_hll_registers(hll: HyperLogLog, mesh, batch: np.ndarray,
+                          lengths: np.ndarray, registers=None):
+    """Mesh update, WITHOUT committing or mutating any sketch state:
+    each device absorbs its batch shard into a local register set,
+    merged with lax.pmax (union of HLLs); returns the merged
+    registers, computed from the explicit ``registers`` snapshot
+    (default: the sketch's current set). The fbtpu-armor flux lane
+    commits the result on the caller thread after the watched launch
+    returns (see :meth:`HyperLogLog.device_registers`)."""
     from jax.sharding import PartitionSpec as P
 
+    from . import device
     from .device import shard_map_fn
 
     shard_map = shard_map_fn()
 
     axis = mesh.axis_names[0]
-    if not hll._ensure_device(wait=True):
-        from . import device
-
+    if not device.wait(max(60.0, device.default_wait())):
         raise RuntimeError(
             f"device backend not attached: {device.status()}"
         )
@@ -386,22 +440,35 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
             out_specs=P(),
         ))
         cache[_mesh_key(mesh)] = fn
-    hll.registers = fn(hll.registers, jnp.asarray(batch), jnp.asarray(lengths))
+    regs = hll.registers if registers is None else registers
+    return fn(jnp.asarray(regs), jnp.asarray(batch),
+              jnp.asarray(lengths))
 
 
-def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
+def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
                        lengths: np.ndarray) -> None:
-    """Count-min over a mesh: local scatter-adds, psum merge."""
+    """Compute-and-commit convenience over
+    :func:`sharded_hll_registers` (bench / unguarded callers)."""
+    merged = sharded_hll_registers(hll, mesh, batch, lengths)
+    hll.registers = merged
+
+
+def sharded_cms_table(cms: CountMin, mesh, batch: np.ndarray,
+                      lengths: np.ndarray, table=None):
+    """Count-min over a mesh, WITHOUT committing or mutating any
+    sketch state: local scatter-adds, psum merge; returns the merged
+    table, computed from the explicit ``table`` snapshot
+    (snapshot-in/commit-on-finish protocol — see
+    :func:`sharded_hll_registers`)."""
     from jax.sharding import PartitionSpec as P
 
+    from . import device
     from .device import shard_map_fn
 
     shard_map = shard_map_fn()
 
     axis = mesh.axis_names[0]
-    if not cms._ensure_device(wait=True):
-        from . import device
-
+    if not device.wait(max(60.0, device.default_wait())):
         raise RuntimeError(
             f"device backend not attached: {device.status()}"
         )
@@ -425,5 +492,14 @@ def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
             out_specs=P(),
         ))
         cache[_mesh_key(mesh)] = fn
-    cms.table = fn(cms.table, jnp.asarray(batch), jnp.asarray(lengths),
-                   jnp.asarray(weights))
+    tbl = cms.table if table is None else table
+    return fn(jnp.asarray(tbl, dtype=cms._dtype), jnp.asarray(batch),
+              jnp.asarray(lengths), jnp.asarray(weights))
+
+
+def sharded_cms_update(cms: CountMin, mesh, batch: np.ndarray,
+                       lengths: np.ndarray) -> None:
+    """Compute-and-commit convenience over
+    :func:`sharded_cms_table`."""
+    merged = sharded_cms_table(cms, mesh, batch, lengths)
+    cms.table = merged

@@ -235,6 +235,8 @@ class GrepFilter(FilterPlugin):
         self._native_filter = None
         self._mesh = None
         self._mesh_resolved = False
+        self._mesh_on = False
+        self._mesh_gen = None
         self.raw_timings = ShardedTimings()
         # per-worker copies of the read-only native tables (multi-input
         # scaling: no cross-thread sharing of the hot arrays)
@@ -313,6 +315,38 @@ class GrepFilter(FilterPlugin):
         # AND/OR rules are all the same kind (enforced in init)
         return ~found if self.rules[0].is_exclude else found
 
+    def _lane(self):
+        """This plugin's device fault domain (fbtpu-armor): every jit/
+        pjit/shard_map launch goes through the process-global "grep"
+        DeviceLane — breaker, launch deadline, bit-exact CPU fallback,
+        mesh shrink/regrow (FAULTS.md "fbtpu-armor")."""
+        ln = getattr(self, "_lane_obj", None)
+        if ln is None:
+            from ..ops import fault
+
+            ln = self._lane_obj = fault.lane("grep")
+        return ln
+
+    def _host_mask(self, batch: np.ndarray, lengths: np.ndarray,
+                   cnt: int) -> np.ndarray:
+        """Bit-exact host twin of the kernel verdict over a staged
+        segment — the DeviceLane fallback. Rows with length < 0
+        (missing -1, overflow -2) stay False, exactly like the kernel;
+        the caller's overflow decode then fixes -2 rows the same way it
+        does after a device launch."""
+        R = len(self.rules)
+        mask = np.zeros((R, cnt), dtype=bool)
+        for r, rule in enumerate(self.rules):
+            ln = lengths[r]
+            row = batch[r]
+            rx = rule.regex
+            for i in range(cnt):
+                li = int(ln[i])
+                if li >= 0:
+                    mask[r, i] = rx.match(bytes(row[i, :li]).decode(
+                        "utf-8", "surrogateescape"))
+        return mask
+
     def _match_matrix_device(self, events: list) -> np.ndarray:
         """Stage field values, run the fused DFA kernel, resolve overflow
         rows on CPU. Returns mask[R, B] bool."""
@@ -340,7 +374,11 @@ class GrepFilter(FilterPlugin):
                 batches[r] = staged
         batch = np.stack([b.batch for b in batches])
         lengths = np.stack([b.lengths for b in batches])
-        mask = self._program.match(batch, lengths)
+        lane = self._lane()
+        mask = lane.run(
+            lambda: np.asarray(self._program.match(batch, lengths)),
+            lambda: self._host_mask(batch, lengths, batch.shape[1]),
+        )
         mask = np.array(mask[:, :B])
         for r, brec in enumerate(batches):
             rule = self.rules[r]
@@ -377,36 +415,52 @@ class GrepFilter(FilterPlugin):
         lax.scan by orders of magnitude, so auto must never shadow it.
 
         The resolution only PINS once the attach controller reaches a
-        terminal state (ready/failed): a chunk arriving mid-attach must
-        not permanently disable the mesh lane for the plugin's lifetime
-        — until then every verdict keeps its bit-exact fallback and the
-        next chunk re-probes."""
+        terminal state (ready/failed-exhausted) — a chunk arriving
+        mid-attach (or mid-RETRY, fbtpu-armor) must not permanently
+        disable the mesh lane for the plugin's lifetime — and it pins
+        per attach GENERATION: an attach that succeeds later (a retry
+        attempt landing after chunks already flowed on CPU, or an
+        ops-driven ``device.reattach_async``) re-opens the resolution
+        and the mesh lane swaps in live. Once engaged, the mesh object
+        itself comes from the "grep" DeviceLane, which shrinks it on
+        device loss and regrows it when the breaker re-closes."""
         import os as _os
 
+        from ..ops import device
+
+        gen = device.generation()
+        if self._mesh_resolved and gen > 0 \
+                and getattr(self, "_mesh_gen", None) != gen:
+            # new attach generation: the old verdict (pinned-off after
+            # a failed attach, or a mesh over the previous backend) is
+            # stale — re-resolve against the live device
+            self._mesh_resolved = False
         if self._mesh_resolved:
+            if getattr(self, "_mesh_on", False):
+                self._mesh = self._lane().current_mesh()
             return self._mesh
         mode = _os.environ.get("FBTPU_MESH", "auto").lower()
         if self._program is None or mode in ("0", "off"):
             self._mesh_resolved = True
+            self._mesh_gen = gen
             return None
-        from ..ops import device
-        from ..ops import mesh as om
-
         try:
             if mode in ("1", "on", "force"):
                 if device.wait():
-                    self._mesh = om.build_mesh()
+                    self._mesh = self._lane().current_mesh()
                     self._mesh_resolved = True
                 elif device.failed():
                     log.warning("FBTPU_MESH=%s but device attach "
-                                "failed (%s); unsharded path pinned",
-                                mode, device.status().get("error"))
+                                "exhausted its retries (%s); unsharded "
+                                "path pinned until a re-attach "
+                                "generation", mode,
+                                device.status().get("error"))
                     self._mesh_resolved = True
-                # else: still attaching — re-probe on the next chunk
+                # else: still attaching/retrying — re-probe next chunk
             elif device.ready():
                 if device.platform() != "cpu" \
                         and device.device_count() > 1:
-                    self._mesh = om.build_mesh()
+                    self._mesh = self._lane().current_mesh()
                 self._mesh_resolved = True
             elif device.failed():
                 self._mesh_resolved = True
@@ -417,6 +471,9 @@ class GrepFilter(FilterPlugin):
                         "path serves", exc_info=True)
             self._mesh = None
             self._mesh_resolved = True
+        if self._mesh_resolved:
+            self._mesh_gen = gen
+            self._mesh_on = self._mesh is not None
         return self._mesh
 
     def can_filter_raw(self) -> bool:
@@ -664,23 +721,46 @@ class GrepFilter(FilterPlugin):
                 extract_s[0] += _time.perf_counter() - t0
                 yield batch, lengths, cnt
 
+        lane = self._lane()
+
         def dispatch(item):
             batch, lengths, cnt = item
             lens_parts.append(lengths[:, :cnt])
             cnts.append(cnt)
             if mesh is not None:
-                # sharded launch: staged buffers transfer with their
-                # shardings and are donated to the kernel; the
-                # counts-free variant skips the per-segment psum the
-                # filter verdict never reads
-                mask_i32, _, _b, _bp = self._program.dispatch_mesh(
-                    mesh, batch, lengths, with_counts=False)
-                return mask_i32
-            return self._program.dispatch(batch, lengths)
+                # sharded launch through the device fault domain: the
+                # launch closure re-stages (fresh device_put + donation)
+                # on EVERY attempt — after a failed launch the donated
+                # lengths buffer is consumed (deleted aval), so a retry
+                # or fallback must read the host arrays, never the
+                # device buffers. The counts-free variant skips the
+                # per-segment psum the filter verdict never reads.
+                # Forcing inside the launch keeps the deadline armed
+                # over the whole execution AND preserves the staging
+                # overlap (the worker forces while the caller stages
+                # the next segment).
+                def launch(b=batch, ln=lengths):
+                    m = lane.current_mesh()
+                    if m is None:
+                        # mesh shrunk below 2 devices: serve unsharded
+                        return np.asarray(self._program.dispatch(b, ln))
+                    m_i32, _, _b2, _bp = self._program.dispatch_mesh(
+                        m, b, ln, with_counts=False)
+                    return np.asarray(m_i32).astype(bool)
+            else:
+                def launch(b=batch, ln=lengths):
+                    return np.asarray(self._program.dispatch(b, ln))
+
+            def fallback(b=batch, ln=lengths, c=cnt):
+                return self._host_mask(b, ln, c)
+
+            return lane.begin(launch, fallback)
 
         def collect(pending):
-            return np.asarray(pending).astype(bool) if mesh is not None \
-                else np.asarray(pending)
+            # nothing is committed until here: the segment's verdict is
+            # the device result OR the bit-exact host fallback, exactly
+            # one of the two (fbtpu-armor)
+            return lane.finish(pending)
 
         t_all = _time.perf_counter()
         try:

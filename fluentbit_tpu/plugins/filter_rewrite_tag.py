@@ -169,9 +169,26 @@ class RewriteTagFilter(FilterPlugin):
             )
             for r in range(R)
         ]
-        mask = self._program.match(
-            np.stack([s.batch for s in staged]),
-            np.stack([s.lengths for s in staged]),
+        batch = np.stack([s.batch for s in staged])
+        lengths = np.stack([s.lengths for s in staged])
+
+        def host_twin():
+            # bit-exact host fallback (fbtpu-armor DeviceLane): the
+            # same per-row regex the overflow fix-up below applies
+            out = np.zeros((R, B), dtype=bool)
+            for r in range(R):
+                rx = self.rules[r].regex
+                for i, v in enumerate(values[r]):
+                    if v is not None:
+                        out[r, i] = rx.match(v)
+            return out
+
+        from ..ops import fault
+
+        lane = fault.lane("grep")  # the DFA plane's fault domain
+        mask = lane.run(
+            lambda: np.asarray(self._program.match(batch, lengths)),
+            host_twin,
         )
         mask = np.array(mask[:, :B])
         for r, s in enumerate(staged):

@@ -1108,3 +1108,124 @@ def test_qos_rule_ignores_nested_closures():
     got = lint_source(NESTED_CLOSURE_METERED, _QOS_PATH)
     assert "qos-unmetered-ingest" not in rules(got), [
         f.message for f in got]
+
+
+# ---------------------------------------------------------------------
+# device-unguarded-dispatch (fbtpu-armor DeviceLane invariant)
+# ---------------------------------------------------------------------
+
+_DEV_PATH = "fluentbit_tpu/plugins/filter_fixture.py"
+
+BAD_UNGUARDED_DISPATCH = """
+class F:
+    def filter_raw(self, data, tag, engine, n_records=None):
+        mask = self._program.dispatch_mesh(self._mesh, data, n_records)
+        return mask
+"""
+
+GOOD_GUARDED_DISPATCH = """
+class F:
+    def filter_raw(self, data, tag, engine, n_records=None):
+        lane = self._lane()
+        return lane.run(
+            lambda: self._program.dispatch_mesh(self._mesh, data,
+                                                n_records),
+            lambda: self._host_mask(data, n_records),
+        )
+"""
+
+
+def test_unguarded_dispatch_fires():
+    got = lint_source(BAD_UNGUARDED_DISPATCH, _DEV_PATH)
+    assert "device-unguarded-dispatch" in rules(got)
+
+
+def test_guarded_dispatch_quiet():
+    assert "device-unguarded-dispatch" not in rules(
+        lint_source(GOOD_GUARDED_DISPATCH, _DEV_PATH))
+
+
+BAD_UNGUARDED_INTERPROC = """
+class F:
+    def filter(self, events, tag, engine):
+        return self._match(events)
+
+    def _match(self, events):
+        return self._program.match(self._batch, self._lengths)
+"""
+
+GOOD_GUARDED_INTERPROC = """
+class F:
+    def filter(self, events, tag, engine):
+        return self._match(events)
+
+    def _match(self, events):
+        lane = self._lane()
+        return lane.run(
+            lambda: self._program.match(self._batch, self._lengths),
+            lambda: self._host(events),
+        )
+"""
+
+
+def test_unguarded_dispatch_interprocedural():
+    got = lint_source(BAD_UNGUARDED_INTERPROC, _DEV_PATH)
+    assert [f.rule for f in got] == ["device-unguarded-dispatch"]
+    # the finding lands on the PUBLIC entry point, not the helper
+    assert got[0].line == 3
+    assert lint_source(GOOD_GUARDED_INTERPROC, _DEV_PATH) == []
+
+
+def test_unguarded_dispatch_sharded_sketch_names():
+    bad = """
+def absorb(state, batch, lengths):
+    sharded_hll_update(state.hll, state.mesh, batch, lengths)
+"""
+    got = lint_source(bad, "fluentbit_tpu/flux/fixture.py")
+    assert "device-unguarded-dispatch" in rules(got)
+    guarded = """
+def absorb(lane, state, batch, lengths):
+    return lane.run(
+        lambda: sharded_hll_update(state.hll, state.mesh, batch,
+                                   lengths),
+        lambda: state.hll.host_update(batch, lengths),
+    )
+"""
+    assert lint_source(guarded, "fluentbit_tpu/flux/fixture.py") == []
+
+
+def test_unguarded_dispatch_scope_and_suppression():
+    # ops/ is the kernel layer the lanes wrap: out of scope
+    assert lint_source(BAD_UNGUARDED_DISPATCH,
+                       "fluentbit_tpu/ops/fixture.py") == []
+    suppressed = BAD_UNGUARDED_DISPATCH.replace(
+        "def filter_raw(self, data, tag, engine, n_records=None):",
+        "def filter_raw(self, data, tag, engine, n_records=None):  "
+        "# fbtpu-lint: allow(device-unguarded-dispatch) bench-only "
+        "diagnostic path, raw failure wanted")
+    assert lint_source(suppressed, _DEV_PATH) == []
+
+
+def test_unguarded_dispatch_plain_match_needs_program_chain():
+    # .match( on a non-program chain (a regex, a dict) is not a device
+    # dispatch — the rule must not fire on everyday string matching
+    benign = """
+class F:
+    def filter(self, events, tag, engine):
+        return [e for e in events if self.regex.match(e.body)]
+"""
+    assert lint_source(benign, _DEV_PATH) == []
+
+
+def test_shipped_device_planes_are_lane_guarded():
+    # the real grep/rewrite_tag/flux device paths must keep their lane
+    # wrapping — stripping DeviceLane from filter_grep would fail THIS,
+    # not just the chaos suite
+    import fluentbit_tpu.flux.kernels as fk
+    import fluentbit_tpu.flux.state as fs
+    import fluentbit_tpu.plugins.filter_grep as fg
+    import fluentbit_tpu.plugins.filter_rewrite_tag as frt
+
+    for mod in (fg, frt, fs, fk):
+        assert "device-unguarded-dispatch" not in rules(
+            lint_paths([mod.__file__])), mod.__name__
