@@ -607,6 +607,189 @@ def measure_staging_mt(raw_chunks) -> dict:
     return out
 
 
+def measure_shrink(seconds: float = 1.2) -> dict:
+    """fbtpu-shrink stage (PERF.md "shrink"): per-pattern DFA shapes
+    before/after the compile-path reduction (Hopcroft + class remerge),
+    compile time, the chosen kernel/stride decision — i.e. whether the
+    unlock actually happened — plus the engine ingest rate with
+    minimization on vs off, and the approximate mode's admit/recheck
+    economics (FP-mask admit rate, recheck cost) on a low-match corpus
+    where a first-pass mask can actually pay."""
+    import random
+
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.core.engine import Engine
+    from fluentbit_tpu.ops.grep import choose_k
+    from fluentbit_tpu.regex.dfa import approx_reduce, compile_dfa
+
+    out = {"patterns": {}}
+    cases = {
+        "apache2": APACHE2,
+        "literal": "ERROR",
+        # synthetic big-S: long counted runs fork subset states the
+        # minimizer collapses
+        "big_s": r"req=[0-9a-f]{24} (GET|POST|PUT) /[a-z]+ "
+                 r"(200|404|50[0-9])$",
+    }
+    for name, pat in cases.items():
+        t0 = time.perf_counter()
+        raw = compile_dfa(pat, minimize=False)
+        t_raw = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        d = compile_dfa(pat)
+        t_min = time.perf_counter() - t0
+        rec = {
+            "s_raw": raw.n_states, "c_raw": raw.n_classes,
+            "s": d.n_states, "c": d.n_classes,
+            "compile_ms_raw": round(t_raw * 1e3, 2),
+            "compile_ms": round(t_min * 1e3, 2),
+            "k_raw": choose_k(raw.n_states, raw.n_classes),
+            "k": choose_k(d.n_states, d.n_classes),
+            "assoc_eligible": d.n_states <= 64,
+        }
+        ap = approx_reduce(d, 64)
+        if ap is not None:
+            rec["approx"] = {
+                "s": ap.n_states, "c": ap.n_classes,
+                "depth": ap.shrink.approx_depth,
+                "k": choose_k(ap.n_states, ap.n_classes),
+                "assoc_eligible": ap.n_states <= 64,
+            }
+        # the native twin's stride/footprint decision (table packing is
+        # pure numpy — no .so needed to report it)
+        try:
+            from fluentbit_tpu.native import GrepTables
+
+            rec["native"] = GrepTables([(b"log", d)]).decisions[0]
+            rec["native_raw"] = GrepTables([(b"log", raw)]).decisions[0]
+            if ap is not None:
+                rec["native_approx"] = GrepTables(
+                    [(b"log", ap)]).decisions[0]
+        except Exception as e:
+            rec["native_error"] = repr(e)
+        out["patterns"][name] = rec
+
+    # engine ingest, minimization on vs off (the always-on stage's
+    # measured win on the real apache2 chain). program_for keys its
+    # cache on the toggle, so each engine compiles its own tables.
+    rng = random.Random(99)
+    n = CHUNK_RECORDS
+
+    def corpus(match_frac: float) -> bytes:
+        buf = bytearray()
+        for i in range(n):
+            if rng.random() < match_frac:
+                line = (f"10.0.0.{i % 256} - frank "
+                        f"[10/Oct/2000:13:55:{i % 60:02d} -0700] "
+                        f'"GET /p{i} HTTP/1.1" 200 {i % 4096} '
+                        f'"http://r" "curl/8"')
+            else:
+                line = f"kernel: oom-killer invoked pid={i}"
+            buf += encode_event({"log": line}, float(i))
+        return bytes(buf)
+
+    def grep_rate(buf, env: dict) -> tuple:
+        prev = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            eng = Engine()
+            f = eng.filter("grep")
+            f.set("regex", f"log {APACHE2}")
+            f.set("tpu_batch_records", "1")
+            ins = eng.input("dummy")
+            for x in eng.inputs + eng.filters:
+                x.configure()
+                x.plugin.init(x, eng)
+            eng.input_log_append(ins, "b", buf)  # warm
+            ins.pool.drain()
+            t0 = time.perf_counter()
+            lines = 0
+            while time.perf_counter() - t0 < seconds:
+                eng.input_log_append(ins, "b", buf)
+                ins.pool.drain()
+                lines += n
+            rate = round(lines / (time.perf_counter() - t0))
+            return rate, eng
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    mixed = corpus(0.75)
+    r_on, _ = grep_rate(mixed, {"FBTPU_DFA_MIN": "1"})
+    r_off, _ = grep_rate(mixed, {"FBTPU_DFA_MIN": "0"})
+    out["ingest_min_on_lines_per_sec"] = r_on
+    out["ingest_min_off_lines_per_sec"] = r_off
+    out["min_speedup"] = round(r_on / r_off, 3) if r_off else None
+
+    # approximate mode on a low-match corpus (the mask's home regime:
+    # most records die in the tiny first-pass table, the exact walk
+    # only sees the admitted few)
+    low = corpus(0.05)
+    r_exact, _ = grep_rate(low, {"FBTPU_DFA_MIN": "1"})
+    r_apx, eng = grep_rate(low, {"FBTPU_DFA_MIN": "1",
+                                 "FBTPU_DFA_APPROX": "64"})
+    label = ("grep",)
+    # single-rule stage: per-(rule, record) admits == union rechecks,
+    # so admit_rate reads directly against the record count
+    admits = eng.m_shrink_approx_admits.get(label)
+    rechecks = eng.m_shrink_approx_rechecks.get(label)
+    fps = eng.m_shrink_approx_fp.get(label)
+    plug = eng.filters[0].plugin
+    records = plug.raw_timings["records"]
+    out["approx"] = {
+        "engaged": plug._approx_tables is not None,
+        "info": plug._approx_info,
+        "ingest_exact_lines_per_sec": r_exact,
+        "ingest_approx_lines_per_sec": r_apx,
+        "speedup": round(r_apx / r_exact, 3) if r_exact else None,
+        "admit_rate": round(admits / records, 4) if records else None,
+        "rechecks": int(rechecks),
+        "fp_rate": round(fps / records, 4) if records else None,
+        "recheck_cost_frac": round(rechecks / records, 4)
+        if records else None,
+    }
+
+    # the KERNEL-side unlock the reduction buys (what the device lane
+    # executes): the jax mask kernel over a pre-staged batch, exact
+    # (k=3 apache2) vs approx-reduced (k=4, assoc-eligible S)
+    try:
+        import numpy as np
+
+        from fluentbit_tpu import native
+        from fluentbit_tpu.ops.grep import GrepProgram
+
+        staged = native.stage_field(mixed, b"log", 512, n_hint=n)
+        if staged is not None:
+            batch, lengths, _, cnt = staged
+            b = np.stack([batch]).copy()
+            ln = np.stack([lengths]).copy()
+            d = compile_dfa(APACHE2)
+            ap = approx_reduce(d, 64)
+
+            def krate(prog) -> int:
+                prog.match(b, ln)  # warm + compile
+                t0 = time.perf_counter()
+                reps = 0
+                while time.perf_counter() - t0 < 1.0:
+                    prog.match(b, ln)
+                    reps += 1
+                return round(reps * cnt / (time.perf_counter() - t0))
+
+            ke = krate(GrepProgram([d], 512))
+            out["approx"]["kernel_exact_lines_per_sec"] = ke
+            if ap is not None:
+                ka = krate(GrepProgram([ap], 512))
+                out["approx"]["kernel_mask_lines_per_sec"] = ka
+                out["approx"]["kernel_mask_speedup"] = \
+                    round(ka / ke, 3) if ke else None
+    except Exception as e:
+        out["approx"]["kernel_error"] = repr(e)
+    return out
+
+
 def check_bit_exact(raw_chunks) -> bool:
     """Device/native raw path vs the pure-Python verdict chain."""
     ok = True
@@ -981,6 +1164,11 @@ def child_main(mode: str) -> None:
             result["flux"] = measure_flux()
         except Exception as e:
             result["flux"] = {"error": repr(e)}
+        _progress(stage="cpu:shrink")
+        try:
+            result["shrink"] = measure_shrink()
+        except Exception as e:
+            result["shrink"] = {"error": repr(e)}
     if ok and mode == "cpu":
         run_kernel_only()
     from fluentbit_tpu import native
@@ -1161,6 +1349,7 @@ def final_line(cpu, dev, dev_err, extras):
         "native_staging": bool((best or {}).get("native_staging", False)),
         "secondary": (cpu or {}).get("secondary"),
         "flux": (cpu or {}).get("flux"),
+        "shrink": (cpu or {}).get("shrink"),
         "host_cpus": os.cpu_count(),
         "chunk_records": CHUNK_RECORDS,
         "wall_seconds": round(time.time() - _T0, 1),

@@ -53,6 +53,12 @@ Six rule families (see ANALYSIS.md for the full contract):
   (``lane.run``/``begin``/``finish``) — an unguarded dispatch would
   stall or drop on device faults instead of failing over bit-exactly
   (analysis.devlane).
+- **minimized kernel DFAs** (`grep-unminimized-dfa`): any path from
+  which a ``GrepProgram``/``GrepTables`` build is reachable must not
+  also reach an unminimized-DFA source (raw ``DFA(...)`` construction,
+  ``compile_dfa(minimize=False)``) — an un-reduced table silently
+  closes the assoc gate and shrinks the stride budget
+  (analysis.shrink; PERF.md "shrink").
 
 The native C/C++ data plane has its own gate (analysis.native_gate):
 clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
@@ -164,6 +170,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .locks import AwaitUnderLockRule, GuardedByRule
     from .purity import JaxPurityRules
     from .qos import UnmeteredIngestRule
+    from .shrink import UnminimizedDfaRule
     from .silent import SwallowedErrorRule
 
     return [
@@ -177,6 +184,7 @@ def _build_rules(guards=None) -> List[Rule]:
         AwaitNoDeadlineRule(),
         UnmeteredIngestRule(),
         UnguardedDispatchRule(),
+        UnminimizedDfaRule(),
     ]
 
 

@@ -238,6 +238,38 @@ class Engine:
             "fluentbit", "device", "reattach_total",
             "Late/re-attach generations (the mesh lane swapped in "
             "live after earlier refusals)")
+        # fbtpu-shrink (PERF.md "shrink"): compile-path DFA reduction
+        # outcomes plus the approximate first-pass mask's runtime
+        # economics — an approx mask that admits nearly everything is
+        # pure overhead, and these counters (not a mystery-slow ingest
+        # number) are how that reads on a dashboard
+        self.m_shrink_states = m.counter(
+            "fluentbit", "grep_shrink", "states_eliminated_total",
+            "DFA states eliminated by the compile-path minimizer "
+            "(Hopcroft + dead-state pruning), summed over compiled "
+            "rules", ("name",))
+        self.m_shrink_classes = m.counter(
+            "fluentbit", "grep_shrink", "classes_eliminated_total",
+            "Byte classes eliminated by the post-minimization class "
+            "remerge, summed over compiled rules", ("name",))
+        self.m_shrink_approx_admits = m.counter(
+            "fluentbit", "grep_shrink", "approx_admits_total",
+            "Per-(rule, record) admissions by the approximate "
+            "first-pass DFA mask (mask selectivity)", ("name",))
+        self.m_shrink_approx_rechecks = m.counter(
+            "fluentbit", "grep_shrink", "approx_rechecks_total",
+            "Records re-walked by the exact DFA (the union of all "
+            "rules' admissions — the recheck cost actually paid)",
+            ("name",))
+        self.m_shrink_approx_fp = m.counter(
+            "fluentbit", "grep_shrink", "approx_false_positives_total",
+            "Approximate-mask admissions the exact recheck rejected "
+            "(the measured FP the budget is enforced against)",
+            ("name",))
+        self.m_shrink_approx_disabled = m.counter(
+            "fluentbit", "grep_shrink", "approx_disabled_total",
+            "Approximate mode self-disabled: measured FP rate "
+            "exceeded tpu_approx_fp_budget", ("name",))
 
     # ------------------------------------------------------------------
     # configuration

@@ -277,7 +277,7 @@ class GrepTables:
     __slots__ = ("n_rules", "keys_cat", "key_offs", "key_of_rule",
                  "trans_cat", "troffs", "cmaps", "starts", "ncls",
                  "cmap2_cat", "cm2offs", "btrans_cat", "btroffs",
-                 "accel_cat", "aoffs")
+                 "accel_cat", "aoffs", "decisions")
 
     def __init__(self, rules):
         """rules: iterable of (field_key: bytes, dfa) pairs."""
@@ -298,6 +298,11 @@ class GrepTables:
         accel_parts = []
         aoffs = []
         accel_len = 0
+        # fbtpu-shrink audit: per-rule (S, C, chosen native k) plus the
+        # compile pass's before-shapes — the native twin of
+        # ops.grep.GrepProgram.decision(), recorded so bench/debug can
+        # see that the reduced tables actually reached the C walker
+        decisions: list = []
         for key, dfa in rules:
             if key not in key_idx:
                 key_idx[key] = len(keys)
@@ -331,6 +336,16 @@ class GrepTables:
                     k += 1
                 if k >= 2 and k % 2 == 1:
                     k -= 1  # even k unlocks the pair-table prepass
+            st = getattr(dfa, "shrink", None)
+            decisions.append({
+                "s": S, "c": C, "k": k,
+                "s_raw": st.s_raw if st is not None else None,
+                "c_raw": st.c_raw if st is not None else None,
+                "minimized": bool(st.minimized) if st is not None
+                else False,
+                "approx_of": st.approx_of if st is not None else None,
+                "table_bytes": int(S * (C ** k) * 2),
+            })
             tk = compose_supersteps(t, k)
             trans_parts.append(np.ascontiguousarray(
                 tk, dtype=np.int16).reshape(-1))
@@ -364,6 +379,7 @@ class GrepTables:
                 aoffs.append(-1)
                 btroffs.append(0)
         self.n_rules = len(key_of_rule)
+        self.decisions = decisions
         self.keys_cat = b"".join(keys)
         offs = [0]
         for k in keys:

@@ -40,10 +40,13 @@ gathers vectorize across the batch dimension.
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+log = logging.getLogger("flb.grep")
 
 try:
     import jax
@@ -114,11 +117,51 @@ class GrepProgram:
         self.segment = max(2, int(segment))
         R = len(self.dfas)
 
+        # fbtpu-shrink: per-DFA stride selection. choose_k re-resolves
+        # here against the MINIMIZED (S, C) — the whole point of the
+        # compile-path reduction is that these numbers shrank. When the
+        # rules disagree on k, the program splits into per-k child
+        # programs (each a plain homogeneous GrepProgram) instead of
+        # pinning the whole fleet to min(k): a literal rule's k=6 no
+        # longer rides at a rich parser's k=3. The split is gated off
+        # the rule-shard regime (large R wants ONE fused table set to
+        # shard over the rule axis — ops/mesh.py) and `FBTPU_PER_DFA_K=0`.
+        self.k_by_rule = [choose_k(d.n_states, d.n_classes)
+                          for d in self.dfas]
+        self._children: Optional[List["GrepProgram"]] = None
+        self._inv_perm: Optional[np.ndarray] = None
+        self._child_idxs: Optional[List[np.ndarray]] = None
+        distinct_ks = sorted(set(self.k_by_rule))
+        min_shard_r = int(_os.environ.get("FBTPU_MESH_RULE_SHARD_R", "64"))
+        if (len(distinct_ks) > 1 and R < min_shard_r
+                and _os.environ.get("FBTPU_PER_DFA_K", "1").lower()
+                not in ("0", "off")):
+            self._child_idxs = [
+                np.asarray([i for i, kk in enumerate(self.k_by_rule)
+                            if kk == k], dtype=np.int64)
+                for k in distinct_ks
+            ]
+            self._children = [
+                GrepProgram([self.dfas[int(i)] for i in idxs], max_len,
+                            kernel=self.kernel, segment=segment)
+                for idxs in self._child_idxs
+            ]
+            perm = np.concatenate(self._child_idxs)
+            self._inv_perm = np.argsort(perm)
+            self.k = distinct_ks[0]
+            self.max_states = max(d.n_states for d in self.dfas)
+            self._np = None
+            self._jit = None
+            self._mat_lock = threading.Lock()
+            self._sharded_cache = {}
+            self._mesh_cache = {}
+            return
+
         # Table prep is pure numpy — cheap and safe at plugin init. The
         # jnp transfers + jit happen in _materialize(), gated on the
         # device-attach controller, so constructing a GrepProgram never
         # blocks on (possibly minutes-long) backend init.
-        self.k = min(choose_k(d.n_states, d.n_classes) for d in self.dfas)
+        self.k = min(self.k_by_rule)
         tables = [compose_table(d.trans, self.k) for d in self.dfas]
         max_flat = max(t.shape[0] * t.shape[1] for t in tables)
         flat = np.zeros((R, max_flat), dtype=np.int32)
@@ -174,6 +217,51 @@ class GrepProgram:
             return "scan"
         return "assoc" if self.max_states <= 64 else "scan"
 
+    # -- fbtpu-shrink decision surface --
+
+    def decision(self) -> dict:
+        """The resolved compile/kernel decisions, per rule: S/C before →
+        after the reduction pass (regex.dfa ShrinkStats), the chosen
+        stride k, the k-group layout, and the scan/assoc resolution —
+        what bench's `shrink` stage records and the unlock tests assert
+        against. ``kernel_resolved`` is None until the program
+        materializes on a backend (the resolution is a trace-time
+        decision)."""
+        rules = []
+        for r, d in enumerate(self.dfas):
+            st = d.shrink
+            rules.append({
+                "pattern": d.pattern,
+                "s_raw": st.s_raw if st else None,
+                "c_raw": st.c_raw if st else None,
+                "s": d.n_states,
+                "c": d.n_classes,
+                "minimized": bool(st.minimized) if st else False,
+                "approx_of": st.approx_of if st else None,
+                "k": self.k_by_rule[r],
+            })
+        if self._children is not None:
+            resolved = {c.kernel_resolved for c in self._children}
+            kernel_resolved = (resolved.pop() if len(resolved) == 1
+                               else "mixed")
+            k_groups = [int(c.k) for c in self._children]
+        else:
+            kernel_resolved = self.kernel_resolved
+            k_groups = [int(self.k)]
+        return {
+            "rules": rules,
+            "k": int(self.k),
+            "k_groups": k_groups,
+            "max_states": int(self.max_states),
+            "assoc_eligible": self.max_states <= 64,
+            "kernel": self.kernel,
+            "kernel_resolved": kernel_resolved,
+        }
+
+    def _merge_rule_axis(self, parts):
+        """Reassemble per-child rule rows into the caller's order."""
+        return jnp.concatenate(list(parts), axis=0)[self._inv_perm]
+
     def _materialize(self) -> None:
         """Transfer tables to the attached backend + build the jit.
 
@@ -200,11 +288,17 @@ class GrepProgram:
             self._impl = impl
             self._jit = jax.jit(impl)
             self._np = None  # tables now live on device; free host copy
+            # the shrink/unlock audit line: S/C before→after, chosen
+            # stride, resolved kernel — what bench + tests assert
+            log.info("grep program materialized: %s", self.decision())
 
     def try_ready(self) -> bool:
         """Non-blocking: True iff the device path is usable now. Kicks
         background attach on first call; until ready, callers run their
         bit-exact CPU fallback."""
+        if self._children is not None:
+            ready = [c.try_ready() for c in self._children]
+            return all(ready)
         if self._jit is not None:
             return True
         from . import device
@@ -388,6 +482,13 @@ class GrepProgram:
         staging pipeline (core.chunk_batch.double_buffered): the caller
         stages the next segment while this one's kernel is in flight,
         then forces with np.asarray one segment behind."""
+        if self._children is not None:
+            # per-k child programs: every child launches (async) before
+            # the merge touches any result, so the k-groups overlap the
+            # same way double-buffered segments do
+            parts = [c.dispatch(batch[idx], lengths[idx])
+                     for c, idx in zip(self._children, self._child_idxs)]
+            return self._merge_rule_axis(parts)
         if self._jit is None:
             from . import device
 
@@ -452,6 +553,16 @@ class GrepProgram:
         (mask[R, B] numpy, counts[R] numpy, matcher-padded batch size)."""
         from .mesh import mesh_key, pad_to_devices
 
+        if self._children is not None:
+            masks, counts, bp = [], [], 0
+            for c, idx in zip(self._children, self._child_idxs):
+                m, ct, bp = c.match_sharded(mesh, batch[idx], lengths[idx])
+                masks.append(m)
+                counts.append(ct)
+            inv = self._inv_perm
+            return (np.concatenate(masks, axis=0)[inv],
+                    np.concatenate(counts, axis=0)[inv], bp)
+
         R, B, L = batch.shape
         Bp = pad_to_devices(B, mesh.devices.size)
         if Bp != B:
@@ -487,18 +598,22 @@ class GrepProgram:
         full batch scan)."""
         import os as _os
 
+        from .mesh import replicated_table_bytes
+
+        if self._children is not None:
+            # k-split programs never rule-shard (the split is gated off
+            # the rule-shard regime in __init__); each child answers
+            # for its own slice and they all land on "batch"
+            return self._children[0].mesh_variant(mesh)
         n_dev = mesh.devices.size
         R = len(self.dfas)
         if R < 2 or R % n_dev != 0:
             return "batch"
         tbl = getattr(self, "_tbl", None)
         if tbl is None:
-            t = self._np
-            table_bytes = sum(v.size * v.itemsize for v in t.values()
-                              if v is not None)
+            table_bytes = replicated_table_bytes(self._np)
         else:
-            table_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
-                              for v in tbl.values())
+            table_bytes = replicated_table_bytes(tbl)
         budget = int(_os.environ.get("FBTPU_MESH_TABLE_BUDGET",
                                      str(64 * 1024 * 1024)))
         min_r = int(_os.environ.get("FBTPU_MESH_RULE_SHARD_R", "64"))
@@ -638,6 +753,35 @@ class GrepProgram:
         aliasing the verdict bytes."""
         from .mesh import pad_to_devices
 
+        if self._children is not None:
+            # per-k children: launch them all first (async), then merge
+            # on the rule axis. Children may pad B differently (the
+            # rules variant is gated off, but keep the contract local):
+            # each part is sliced back to B lazily before the concat.
+            B = batch.shape[1]
+            parts, count_parts, bps = [], [], []
+            for c, idx in zip(self._children, self._child_idxs):
+                m, ct, _b, bp = c.dispatch_mesh(
+                    mesh, batch[idx], lengths[idx], donate, with_counts)
+                parts.append(m)
+                count_parts.append(ct)
+                bps.append(bp)
+            if len(set(bps)) == 1:
+                # the normal case: every child padded B identically
+                # (same mesh, batch variant), so the merged mask keeps
+                # the padded columns and Bp describes it — the same
+                # contract as the unsplit program
+                Bp = bps[0]
+            else:
+                # children disagree (a child crossed into the rules
+                # variant): normalize to the unpadded batch
+                parts = [p[:, :B] for p in parts]
+                Bp = B
+            mask = self._merge_rule_axis(parts)
+            counts = (self._merge_rule_axis(count_parts)
+                      if with_counts else None)
+            return mask, counts, B, Bp
+
         h = self._mesh_handle(mesh, donate, with_counts)
         R, B, L = batch.shape
         Bp = pad_to_devices(B, h.n_devices) if h.variant == "batch" else B
@@ -676,6 +820,11 @@ class GrepProgram:
         (``tf.aliasing_output``), plus the variant and per-device batch
         share for a B-row segment."""
         from .mesh import donation_report, pad_to_devices
+
+        if self._children is not None:
+            rep = self._children[0].donation_info(mesh, B, donate)
+            rep["k_groups"] = [int(c.k) for c in self._children]
+            return rep
 
         h = self._mesh_handle(mesh, donate)
         R = len(self.dfas)
@@ -718,12 +867,18 @@ class _MeshHandle:
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_program(patterns: Tuple[str, ...], max_len: int) -> "GrepProgram":
+def _cached_program(patterns: Tuple[str, ...], max_len: int,
+                    minimize: bool) -> "GrepProgram":
     from ..regex.dfa import compile_dfa
 
-    return GrepProgram([compile_dfa(p) for p in patterns], max_len)
+    return GrepProgram([compile_dfa(p, minimize=minimize)
+                        for p in patterns], max_len)
 
 
 def program_for(patterns: Sequence[str], max_len: int = 512) -> "GrepProgram":
-    """Compiled-program cache keyed by the pattern tuple."""
-    return _cached_program(tuple(patterns), max_len)
+    """Compiled-program cache keyed by the pattern tuple (and the
+    FBTPU_DFA_MIN toggle — the bench's minimization-off differential
+    must never be served a cached minimized program, or vice versa)."""
+    from ..regex.dfa import minimize_enabled
+
+    return _cached_program(tuple(patterns), max_len, minimize_enabled())

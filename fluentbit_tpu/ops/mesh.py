@@ -46,8 +46,28 @@ except Exception:  # pragma: no cover - jax absent: host twins only
 __all__ = [
     "named_tree_map", "match_partition_rules", "build_mesh", "mesh_key",
     "mesh_info", "pad_to_devices", "aliasable_donations",
-    "donation_report",
+    "donation_report", "replicated_table_bytes",
 ]
+
+
+def replicated_table_bytes(tables) -> int:
+    """Total byte footprint of a program's table pytree (numpy dicts
+    with possible None leaves, or device-array pytrees) — the number
+    the batch-vs-rules partition decision weighs against
+    ``FBTPU_MESH_TABLE_BUDGET``. Centralized here (rather than inline
+    per program) so every plane sizes its replication the same way —
+    the fbtpu-shrink pass changes these shapes per DFA, and the mesh
+    variant choice must follow the REAL post-reduction footprint."""
+    total = 0
+    for v in (tables.values() if isinstance(tables, dict) else tables):
+        if v is None:
+            continue
+        shape = getattr(v, "shape", None)
+        if shape is None:
+            continue
+        itemsize = getattr(getattr(v, "dtype", None), "itemsize", 1)
+        total += int(np.prod(shape)) * int(itemsize)
+    return total
 
 
 def named_tree_map(fn, tree, sep: str = "/"):

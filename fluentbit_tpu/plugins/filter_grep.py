@@ -201,6 +201,20 @@ class GrepFilter(FilterPlugin):
         ConfigMapEntry("tpu_max_record_len", "int", default=512,
                        desc="field byte length staged on device; longer "
                             "values resolve on the CPU fallback"),
+        # fbtpu-shrink approximate mode (PERF.md "shrink"): run an
+        # over-approximated (smaller) DFA as a first-pass mask on the
+        # raw path and re-check admitted records exactly — output
+        # stays byte-identical; only the hot table shrinks
+        ConfigMapEntry("tpu_approx", "bool", default=False,
+                       desc="approximate first-pass DFA mask + exact "
+                            "recheck (also FBTPU_DFA_APPROX)"),
+        ConfigMapEntry("tpu_approx_states", "int", default=64,
+                       desc="state budget for the approximate DFA "
+                            "(<=64 also unlocks the assoc kernel)"),
+        ConfigMapEntry("tpu_approx_fp_budget", "double", default=0.5,
+                       desc="measured false-positive budget: approx "
+                            "mode self-disables when the mask's "
+                            "measured FP rate exceeds this fraction"),
     ]
 
     def init(self, instance, engine) -> None:
@@ -283,6 +297,86 @@ class GrepFilter(FilterPlugin):
                     log.warning("grep fused filter table build failed; "
                                 "fused raw path disabled", exc_info=True)
                     self._native_filter = None
+        self._init_approx(instance, engine)
+        self._report_shrink(instance, engine)
+
+    def _init_approx(self, instance, engine) -> None:
+        """fbtpu-shrink approximate mode (opt-in, default off): build
+        the over-approximated mask tables. Rules whose exact DFA
+        already fits the state budget keep their exact tables in the
+        mask set (mask == exact for them — still sound); if NO rule
+        reduces, the mode stays off (pure overhead)."""
+        import os as _os
+
+        self._approx_tables = None
+        self._approx_info = None
+        self._approx_live = True
+        # measured-FP window counters: bumped from parallel ingest
+        # workers without a lock — increments may race and lose (benign
+        # staleness, same stance as ShardedTimings), the budget trip
+        # only needs the order of magnitude
+        self._approx_seen = 0
+        self._approx_fp = 0
+        from ..regex.dfa import approx_env_states
+
+        env_target = approx_env_states(self.tpu_approx_states)
+        if not (self.tpu_approx or env_target is not None):
+            return
+        if self._native_tables is None:
+            return
+        target = env_target if env_target is not None \
+            else self.tpu_approx_states
+        from .. import native as _native
+        from ..regex.dfa import approx_reduce
+
+        try:
+            reduced = [approx_reduce(r.dfa, target) for r in self.rules]
+            if not any(rd is not None for rd in reduced):
+                log.info("grep approx mode requested but every rule DFA "
+                         "already fits %d states; exact path serves",
+                         target)
+                return
+            self._approx_tables = _native.GrepTables(
+                [(r.ra.head.encode("utf-8"),
+                  rd if rd is not None else r.dfa)
+                 for r, rd in zip(self.rules, reduced)])
+            self._approx_info = [
+                None if rd is None else {
+                    "s_exact": rd.shrink.approx_of,
+                    "s": rd.n_states,
+                    "c": rd.n_classes,
+                    "depth": rd.shrink.approx_depth,
+                }
+                for rd in reduced
+            ]
+            log.info("grep approx mask engaged (target %d states): %s",
+                     target, self._approx_info)
+        except Exception:
+            log.warning("grep approximate-mask build failed; exact "
+                        "path serves", exc_info=True)
+            self._approx_tables = None
+
+    def _report_shrink(self, instance, engine) -> None:
+        """fluentbit_grep_shrink_* compile-outcome counters (the
+        runtime admit/recheck/FP counters bump per chunk in
+        _approx_match_raw)."""
+        if engine is None or getattr(engine, "m_shrink_states", None) \
+                is None:
+            return
+        # plugin-name label, matching the per-chunk admit/recheck
+        # counters (_approx_match_raw) so one dashboard family reads
+        label = (self.name,)
+        elim_s = elim_c = 0
+        for r in self.rules:
+            st = getattr(r.dfa, "shrink", None) if r.dfa is not None \
+                else None
+            if st is not None:
+                elim_s += st.states_eliminated
+                elim_c += st.classes_eliminated
+        if elim_s:
+            engine.m_shrink_states.inc(elim_s, label)
+        if elim_c:
+            engine.m_shrink_classes.inc(elim_c, label)
 
     # -- verdicts (bit-exact vs grep.c) --
 
@@ -520,6 +614,37 @@ class GrepFilter(FilterPlugin):
         use_native = self._native_tables is not None and mesh is None and (
             device.platform() == "cpu" or not self._program.try_ready()
         )
+        if use_native and self._approx_tables is not None \
+                and self._approx_live:
+            # fbtpu-shrink approximate mode: reduced-DFA first-pass
+            # mask, then the EXACT tables re-check only the admitted
+            # records — mask-False is definitive (the reduced machine
+            # over-approximates the language), so the final mask is
+            # exactly the exact chain's and every verdict downstream
+            # is byte-identical
+            t0 = _time.perf_counter()
+            got = self._approx_match_raw(data, engine, n_records)
+            if got is not None:
+                mask, offsets, n = got
+                tm.add("kernel_s", _time.perf_counter() - t0)
+                tm.add("records", n)
+                keep = self.keep_mask(mask)
+                n_keep = int(keep.sum())
+                if n_keep == n:
+                    return (n, data)
+                if n_keep == 0:
+                    return (0, b"")
+                t0 = _time.perf_counter()
+                compacted = native.compact(data, offsets[: n + 1], keep)
+                tm.add("compact_s", _time.perf_counter() - t0)
+                if compacted is not None:
+                    return (n_keep, compacted)
+                parts = [
+                    data[offsets[i]: offsets[i + 1]]
+                    for i in np.nonzero(keep)[0]
+                ]
+                return (n_keep, b"".join(parts))
+            # approx mask unavailable this chunk: exact paths serve
         if use_native and self._native_filter is not None:
             # fused path: extraction + prepass DFA + verdict + compaction
             # in ONE native pass; all-kept chunks return the input
@@ -570,6 +695,81 @@ class GrepFilter(FilterPlugin):
             for i in np.nonzero(keep)[0]
         ]
         return (n_keep, b"".join(parts))
+
+    def _approx_match_raw(self, data, engine, n_hint=None):
+        """Approximate mask → exact recheck over chunk bytes.
+
+        Returns the EXACT per-rule match matrix (mask[R, n] bool),
+        offsets and n — or None to fall back to the plain exact paths.
+        Soundness: the reduced DFAs over-approximate their rules'
+        languages (regex.dfa.approx_reduce), so a record the mask
+        rejects for rule r cannot match rule r exactly; only
+        mask-admitted records pay the exact walk. The measured FP rate
+        (admitted-but-exact-false) is tracked against
+        ``tpu_approx_fp_budget``: a mask that stopped paying for
+        itself self-disables instead of taxing every chunk."""
+        from .. import native
+
+        got = native.grep_match(
+            data, self._local_tables("_approx_tables"), n_hint=n_hint)
+        if got is None:
+            return None
+        amask, offsets, n = got
+        union = amask.any(axis=0)
+        n_adm = int(union.sum())
+        mask = np.zeros(amask.shape, dtype=bool)
+        n_true = 0
+        if n_adm == n:
+            # mask admitted everything: recheck the whole chunk via
+            # the plain exact tables (no compaction detour)
+            got2 = native.grep_match(
+                data, self._local_tables("_native_tables"), n_hint=n)
+            if got2 is None:
+                return None
+            mask = got2[0]
+            n_true = int(mask.any(axis=0).sum())
+        elif n_adm:
+            sub = native.compact(data, offsets[: n + 1], union)
+            if sub is None:
+                idx0 = np.nonzero(union)[0]
+                sub = b"".join(data[offsets[i]: offsets[i + 1]]
+                               for i in idx0)
+            got2 = native.grep_match(
+                sub, self._local_tables("_native_tables"), n_hint=n_adm)
+            if got2 is None or got2[2] != n_adm:
+                return None
+            emask = got2[0]
+            mask[:, np.nonzero(union)[0]] = emask
+            n_true = int(emask.any(axis=0).sum())
+        # lock-free window counters (benign-staleness, see _init_approx)
+        self._approx_seen += n
+        self._approx_fp += n_adm - n_true
+        if engine is not None and getattr(
+                engine, "m_shrink_approx_admits", None) is not None:
+            label = (self.name,)
+            # admits are per (rule, record) — mask selectivity;
+            # rechecks are per record (the union the exact walk pays)
+            engine.m_shrink_approx_admits.inc(int(amask.sum()), label)
+            engine.m_shrink_approx_rechecks.inc(n_adm, label)
+            engine.m_shrink_approx_fp.inc(n_adm - n_true, label)
+        if self._approx_seen >= 8192:
+            fp_rate = self._approx_fp / max(self._approx_seen, 1)
+            if fp_rate > self.tpu_approx_fp_budget:
+                self._approx_live = False
+                log.warning(
+                    "grep approx mask disabled: measured FP rate %.3f "
+                    "exceeds tpu_approx_fp_budget %.3f (over %d "
+                    "records)", fp_rate, self.tpu_approx_fp_budget,
+                    self._approx_seen)
+                if engine is not None and getattr(
+                        engine, "m_shrink_approx_disabled", None) \
+                        is not None:
+                    engine.m_shrink_approx_disabled.inc(1, (self.name,))
+            else:
+                # rolling window: decay instead of one-shot judgement
+                self._approx_seen //= 2
+                self._approx_fp //= 2
+        return mask, offsets, n
 
     def _local_tables(self, attr: str):
         """This thread's private copy of a packed native table set (the

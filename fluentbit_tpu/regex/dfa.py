@@ -26,6 +26,8 @@ DFA state ids: 0 = DEAD (absorbing reject), 1 = ACC (absorbing accept),
 
 from __future__ import annotations
 
+import os
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
@@ -146,6 +148,36 @@ def _build(nfa: _NFA, node: Node, start: int) -> int:
     raise TypeError(f"unknown AST node {node!r}")
 
 
+@dataclass(frozen=True)
+class ShrinkStats:
+    """What the compile-path reduction pass did to this DFA (the
+    fbtpu-shrink audit trail GrepProgram/GrepTables/bench report).
+
+    ``s_raw``/``c_raw`` are the subset-construction shape, ``s``/``c``
+    the shipped table's. ``minimized`` False means the pass was
+    explicitly disabled (``FBTPU_DFA_MIN=0`` / ``minimize=False`` — the
+    bench differential and the property tests' unminimized oracle).
+    ``approx_of``/``approx_depth`` are set only on approximate
+    reductions (:func:`approx_reduce`): the exact machine's state count
+    and the prefix depth the collapse kept."""
+
+    s_raw: int
+    c_raw: int
+    s: int
+    c: int
+    minimized: bool
+    approx_of: Optional[int] = None
+    approx_depth: Optional[int] = None
+
+    @property
+    def states_eliminated(self) -> int:
+        return max(self.s_raw - self.s, 0)
+
+    @property
+    def classes_eliminated(self) -> int:
+        return max(self.c_raw - self.c, 0)
+
+
 @dataclass
 class DFA:
     """Compiled table-driven DFA (the kernel input).
@@ -160,6 +192,10 @@ class DFA:
     n_states: int
     n_classes: int
     pattern: str
+    #: reduction audit trail (None only for hand-built tables — the
+    #: grep-unminimized-dfa lint rule pins compile_dfa as the one
+    #: constructor on the kernel path)
+    shrink: Optional[ShrinkStats] = None
 
     @property
     def eol_class(self) -> int:
@@ -211,19 +247,39 @@ def compose_supersteps(trans: np.ndarray, k: int) -> np.ndarray:
     return out
 
 
-def _minimize(trans: np.ndarray, start: int) -> Tuple[np.ndarray, int]:
-    """Moore partition refinement. Subset construction leaves many
-    equivalent states (every optional trailing group of a pattern forks
-    the subsets), which (a) bloats the kernel tables S-fold — the
-    parallel-in-time device kernel does S× work per position — and
-    (b) hides the self-loop structure the native accel scan needs: a
-    `[^ ]*` skeleton state only LOOKS like a self-loop after its clones
-    are merged. Language is unchanged, so all verdict paths stay
-    bit-identical.
+def _renumber(trans: np.ndarray, start: int,
+              part: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Collapse a state partition to a fresh table, keeping the
+    DEAD=0 / ACC=1 absorbing-id contract (first-seen order for the
+    rest, so equal inputs renumber deterministically)."""
+    S, C = trans.shape
+    remap = np.full(int(part.max()) + 1, -1, dtype=np.int64)
+    remap[part[DEAD]] = DEAD
+    remap[part[ACC]] = ACC
+    nxt = 2
+    for b in part:
+        if remap[b] < 0:
+            remap[b] = nxt
+            nxt += 1
+    new_ids = remap[part]
+    new_trans = np.zeros((nxt, C), dtype=np.int32)
+    # one representative per block suffices (blocks are equivalence classes)
+    seen = np.zeros(nxt, dtype=bool)
+    for s in range(S):
+        ns = new_ids[s]
+        if not seen[ns]:
+            seen[ns] = True
+            new_trans[ns] = new_ids[trans[s]]
+    return new_trans, int(new_ids[start])
 
-    Keeps the DEAD=0 / ACC=1 absorbing-id contract: any state from
-    which ACC is unreachable merges into DEAD; ACC (the only accepting
-    state, absorbing) stays a singleton partition."""
+
+def _moore_minimize(trans: np.ndarray, start: int) -> Tuple[np.ndarray, int]:
+    """Moore partition refinement — the simple O(S²·C)-ish fixpoint.
+
+    Kept as the independent minimality ORACLE the property tests check
+    Hopcroft against (two implementations of the coarsest congruence
+    must agree on the block count), and as the reducer approx_reduce's
+    search loop calls where the collapsed machines are already tiny."""
     S, C = trans.shape
     # initial partition: accepting (ACC) vs rest
     part = np.zeros(S, dtype=np.int64)
@@ -239,34 +295,249 @@ def _minimize(trans: np.ndarray, start: int) -> Tuple[np.ndarray, int]:
         if n_new == n_blocks:  # refinement only splits: no growth = fixed point
             break
         part, n_blocks = new, n_new
-    # renumber blocks: DEAD's block -> 0, ACC's block -> 1, rest 2..
-    remap = np.full(int(part.max()) + 1, -1, dtype=np.int64)
-    remap[part[DEAD]] = DEAD
-    remap[part[ACC]] = ACC
-    nxt = 2
-    for b in part:
-        if remap[b] < 0:
-            remap[b] = nxt
-            nxt += 1
-    new_ids = remap[part]
-    n_new = nxt
-    new_trans = np.zeros((n_new, C), dtype=np.int32)
-    # one representative per block suffices (blocks are equivalence classes)
-    seen = np.zeros(n_new, dtype=bool)
-    for s in range(S):
-        ns = new_ids[s]
-        if not seen[ns]:
-            seen[ns] = True
-            new_trans[ns] = new_ids[trans[s]]
-    return new_trans, int(new_ids[start])
+    return _renumber(trans, start, part)
+
+
+def _hopcroft_minimize(trans: np.ndarray, start: int
+                       ) -> Tuple[np.ndarray, int]:
+    """Hopcroft partition refinement over the [S, C] table.
+
+    Subset construction leaves many equivalent states (every optional
+    trailing group of a pattern forks the subsets), which (a) bloats
+    the kernel tables S-fold — the parallel-in-time device kernel does
+    S× work per position — and (b) hides the self-loop structure the
+    native accel scan needs: a `[^ ]*` skeleton state only LOOKS like a
+    self-loop after its clones are merged. Language is unchanged, so
+    all verdict paths stay bit-identical.
+
+    Classic smaller-half worklist (splitters are (block, class) pairs;
+    a split enqueues the smaller fragment), with numpy doing the
+    per-splitter preimage scan — O(C·S log S) splitter work instead of
+    Moore's full-table fixpoint rounds, which is what keeps hot-reload
+    recompiles of big parser DFAs (S≈1k) cheap.
+
+    Keeps the DEAD=0 / ACC=1 contract: any state from which ACC is
+    unreachable is never split from DEAD's block (both die on every
+    suffix), so dead subtrees merge into DEAD; ACC (the only accepting
+    state, absorbing) stays a singleton partition."""
+    S, C = trans.shape
+    block = np.zeros(S, dtype=np.int64)
+    block[ACC] = 1
+    members: Dict[int, np.ndarray] = {
+        0: np.flatnonzero(block == 0),
+        1: np.asarray([ACC], dtype=np.int64),
+    }
+    nb = 2
+    # {ACC} is the smaller half of the initial split for every class
+    work = deque((1, c) for c in range(C))
+    in_work = {(1, c) for c in range(C)}
+    while work:
+        key = work.popleft()
+        in_work.discard(key)
+        a, c = key
+        in_a = np.zeros(S, dtype=bool)
+        in_a[members[a]] = True
+        x = in_a[trans[:, c]]  # states whose c-step lands in block a
+        for b in np.unique(block[x]):
+            bm = members[int(b)]
+            sel = x[bm]
+            if sel.all() or not sel.any():
+                continue
+            b1, b2 = bm[sel], bm[~sel]
+            if len(b1) <= len(b2):
+                small, large = b1, b2
+            else:
+                small, large = b2, b1
+            new_id = nb
+            nb += 1
+            block[small] = new_id
+            members[int(b)] = large
+            members[new_id] = small
+            for cc in range(C):
+                if (int(b), cc) in in_work:
+                    # pending splitter stays valid for the shrunk block;
+                    # the new fragment must also be processed
+                    work.append((new_id, cc))
+                    in_work.add((new_id, cc))
+                elif (new_id, cc) not in in_work:
+                    # smaller-half rule: either fragment refines the
+                    # same, and new_id IS the smaller half by
+                    # construction — the cheaper preimage scan
+                    work.append((new_id, cc))
+                    in_work.add((new_id, cc))
+    return _renumber(trans, start, block)
+
+
+def _prune_unreachable(trans: np.ndarray, start: int
+                       ) -> Tuple[np.ndarray, int]:
+    """Drop states unreachable from {start, DEAD, ACC} (dead-state
+    pruning). Subset construction never emits them, but the approximate
+    collapse does — a state whose every predecessor was redirected to
+    ACC would otherwise survive minimization as its own block."""
+    S, C = trans.shape
+    reach = np.zeros(S, dtype=bool)
+    reach[[DEAD, ACC, start]] = True
+    frontier = np.asarray([start], dtype=np.int64)
+    while frontier.size:
+        nxt = np.unique(trans[frontier].reshape(-1))
+        frontier = nxt[~reach[nxt]]
+        reach[frontier] = True
+    if reach.all():
+        return trans, start
+    remap = np.full(S, -1, dtype=np.int64)
+    keep = np.flatnonzero(reach)
+    remap[keep] = np.arange(len(keep))
+    # DEAD/ACC sit at indices 0/1 of `keep` (reach pinned them), so the
+    # id contract survives renumbering
+    return remap[trans[keep]].astype(np.int32), int(remap[start])
+
+
+def _remerge_classes(trans: np.ndarray, class_map: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Byte-class remerge after state minimization: classes whose
+    transition COLUMNS became identical under the smaller state set
+    collapse into one, and classes no byte/EOL maps to (the BOS column
+    — consumed when the start state folded BOS in) drop entirely.
+    Shrinks C, which compounds through every C^k super-step table (the
+    stride budget is S × C^(k+1)).
+
+    ``class_map`` is the 257-entry byte→class table; returns
+    (trans[S, C'], class_map', C')."""
+    used = np.unique(class_map)
+    remap = np.full(trans.shape[1], -1, dtype=np.int64)
+    col_ids: Dict[bytes, int] = {}
+    rep_cols: List[int] = []
+    for c in used:
+        key = trans[:, c].tobytes()
+        new_id = col_ids.setdefault(key, len(rep_cols))
+        if new_id == len(rep_cols):
+            rep_cols.append(int(c))
+        remap[c] = new_id
+    new_trans = np.ascontiguousarray(trans[:, rep_cols], dtype=np.int32)
+    new_map = remap[class_map].astype(np.uint8)
+    return new_trans, new_map, len(rep_cols)
+
+
+def _shrink_tables(trans: np.ndarray, start: int, class_map: np.ndarray
+                   ) -> Tuple[np.ndarray, int, np.ndarray, int]:
+    """The full reduction pass: prune → Hopcroft → class remerge."""
+    trans, start = _prune_unreachable(trans, start)
+    trans, start = _hopcroft_minimize(trans, start)
+    trans, class_map, n_classes = _remerge_classes(trans, class_map)
+    return trans, start, class_map, n_classes
+
+
+def minimize_enabled() -> bool:
+    """The FBTPU_DFA_MIN kill switch (default on). Exists for the
+    bench's minimization-on/off differential and for pinning the
+    unminimized oracle in tests — production paths never set it."""
+    return os.environ.get("FBTPU_DFA_MIN", "1").lower() not in (
+        "0", "off", "false")
+
+
+def approx_env_states(default: int = 64) -> Optional[int]:
+    """Parse the ``FBTPU_DFA_APPROX`` opt-in: unset/``0``/``off`` →
+    None (approximate mode stays off — the default), a bare truthy
+    value (``1``/``on``) → the caller's default state target, an
+    integer > 1 → that state target."""
+    v = os.environ.get("FBTPU_DFA_APPROX", "").strip().lower()
+    if v in ("", "0", "off", "false"):
+        return None
+    try:
+        n = int(v)
+        return n if n > 1 else default
+    except ValueError:
+        return default
+
+
+def approx_reduce(dfa: DFA, max_states: int = 64) -> Optional[DFA]:
+    """Over-approximate reduction (arXiv 1710.08647's self-loop/collapse
+    shape): states deeper than a prefix depth d collapse into the
+    absorbing ACC, then the collapsed machine is pruned, exact-minimized
+    and class-remerged. Every transition is redirected *toward* accept
+    and never away, so L(exact) ⊆ L(approx) — a False from the reduced
+    machine is definitive, which is what makes it sound as a first-pass
+    mask in front of an exact recheck (the filter_parser(regex)
+    mask→recheck shape).
+
+    Binary-searches the largest d whose reduced machine fits
+    ``max_states`` (more prefix retained = fewer false admits). Returns
+    None when the exact DFA already fits (approximation would only add
+    false positives) or when even d=1 cannot fit the budget."""
+    if dfa.n_states <= max_states:
+        return None
+    trans = dfa.trans
+    S, C = trans.shape
+    # BFS depth from start over the byte/EOL classes
+    depth = np.full(S, np.iinfo(np.int64).max, dtype=np.int64)
+    depth[dfa.start] = 0
+    frontier = np.asarray([dfa.start], dtype=np.int64)
+    d = 0
+    while frontier.size:
+        d += 1
+        nxt = np.unique(trans[frontier].reshape(-1))
+        frontier = nxt[depth[nxt] > d]
+        depth[frontier] = d
+    max_depth = int(depth[depth < np.iinfo(np.int64).max].max())
+
+    def collapse(dcap: int):
+        part = np.arange(S, dtype=np.int64)
+        deep = depth > dcap
+        deep[[DEAD, ACC]] = False  # DEAD→ACC would admit everything
+        part[deep] = ACC
+        t = part[trans].astype(np.int32)
+        st = int(part[dfa.start])
+        t, st = _prune_unreachable(t, st)
+        t, st = _moore_minimize(t, st)  # collapsed machines are tiny
+        t, cmap, n_cls = _remerge_classes(t, dfa.class_map)
+        return t, st, cmap, n_cls
+
+    lo, hi, best = 1, max_depth, None
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        t, st, cmap, n_cls = collapse(mid)
+        if t.shape[0] <= max_states:
+            best = (mid, t, st, cmap, n_cls)
+            lo = mid + 1
+        else:
+            hi = mid - 1
+    if best is None:
+        return None
+    dcap, t, st, cmap, n_cls = best
+    base = dfa.shrink
+    return DFA(
+        trans=t,
+        class_map=cmap,
+        start=st,
+        n_states=t.shape[0],
+        n_classes=n_cls,
+        pattern=dfa.pattern,
+        shrink=ShrinkStats(
+            s_raw=base.s_raw if base else dfa.n_states,
+            c_raw=base.c_raw if base else dfa.n_classes,
+            s=t.shape[0],
+            c=n_cls,
+            minimized=True,
+            approx_of=dfa.n_states,
+            approx_depth=dcap,
+        ),
+    )
 
 
 def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,
-                max_states: int = 4096) -> DFA:
+                max_states: int = 4096,
+                minimize: Optional[bool] = None) -> DFA:
     """Compile a pattern (str or ParsedRegex) to a scan DFA.
 
     Raises UnsupportedRegex for non-DFA-expressible constructs; callers
     fall back to the CPU engine (the same split the north star requires).
+
+    Every DFA leaving here has passed the fbtpu-shrink reduction pass —
+    unreachable-state pruning, Hopcroft minimization, byte-class
+    remerging — unless ``minimize=False`` (or ``FBTPU_DFA_MIN=0``)
+    explicitly pins the raw subset table for a differential (bench's
+    on/off stage, the property tests' oracle). The language is
+    unchanged either way; only table shape differs.
     """
     if isinstance(pattern, ParsedRegex):
         parsed = pattern
@@ -405,8 +676,13 @@ def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,
             table[sid][cid] = get_id(move(states, sym))
 
     trans = np.asarray(table, dtype=np.int32)
-    trans, start_id = _minimize(trans, start_id)
     class_map = sym_class[:257].astype(np.uint8)
+    s_raw, c_raw = trans.shape[0], n_classes
+    if minimize is None:
+        minimize = minimize_enabled()
+    if minimize:
+        trans, start_id, class_map, n_classes = _shrink_tables(
+            trans, start_id, class_map)
     return DFA(
         trans=trans,
         class_map=class_map,
@@ -414,4 +690,6 @@ def compile_dfa(pattern, ignorecase: bool = False, dot_all: bool = False,
         n_states=trans.shape[0],
         n_classes=n_classes,
         pattern=parsed.pattern,
+        shrink=ShrinkStats(s_raw=s_raw, c_raw=c_raw, s=trans.shape[0],
+                           c=n_classes, minimized=bool(minimize)),
     )

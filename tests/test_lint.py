@@ -1229,3 +1229,105 @@ def test_shipped_device_planes_are_lane_guarded():
     for mod in (fg, frt, fs, fk):
         assert "device-unguarded-dispatch" not in rules(
             lint_paths([mod.__file__])), mod.__name__
+
+
+# ---------------------------------------------------------------------
+# grep-unminimized-dfa (fbtpu-shrink minimizer invariant)
+# ---------------------------------------------------------------------
+
+_SHRINK_PATH = "fluentbit_tpu/plugins/filter_fixture.py"
+
+BAD_RAW_DFA_TO_TABLES = """
+import numpy as np
+
+
+class F:
+    def init(self, instance, engine):
+        dfa = DFA(trans=np.zeros((2, 2), np.int32),
+                  class_map=np.zeros(257, np.uint8),
+                  start=0, n_states=2, n_classes=2, pattern="x")
+        self._tables = GrepTables([(b"log", dfa)])
+"""
+
+BAD_UNMINIMIZED_COMPILE = """
+class F:
+    def init(self, instance, engine):
+        self._program = GrepProgram(
+            [compile_dfa(p, minimize=False) for p in self.patterns], 512)
+"""
+
+GOOD_MINIMIZED_COMPILE = """
+class F:
+    def init(self, instance, engine):
+        self._program = GrepProgram(
+            [compile_dfa(p) for p in self.patterns], 512)
+        self._tables = GrepTables(
+            [(b"log", compile_dfa(p)) for p in self.patterns])
+"""
+
+
+def test_unminimized_dfa_raw_construction_fires():
+    got = lint_source(BAD_RAW_DFA_TO_TABLES, _SHRINK_PATH)
+    assert "grep-unminimized-dfa" in rules(got)
+
+
+def test_unminimized_dfa_minimize_false_fires():
+    got = lint_source(BAD_UNMINIMIZED_COMPILE, _SHRINK_PATH)
+    assert "grep-unminimized-dfa" in rules(got)
+
+
+def test_minimized_compile_quiet():
+    assert "grep-unminimized-dfa" not in rules(
+        lint_source(GOOD_MINIMIZED_COMPILE, _SHRINK_PATH))
+
+
+def test_unminimized_dfa_interprocedural():
+    # the source hides in a same-module helper; the sink lives in the
+    # caller — the closure still connects them
+    bad = """
+class F:
+    def init(self, instance, engine):
+        self._tables = GrepTables(self._rules())
+
+    def _rules(self):
+        return [(b"log", compile_dfa("x", minimize=False))]
+"""
+    got = lint_source(bad, _SHRINK_PATH)
+    assert "grep-unminimized-dfa" in rules(got)
+
+
+def test_unminimized_dfa_scope_and_suppression():
+    # regex/ is the definition site (the minimizer builds raw tables)
+    assert lint_source(BAD_RAW_DFA_TO_TABLES,
+                       "fluentbit_tpu/regex/fixture.py") == []
+    suppressed = BAD_UNMINIMIZED_COMPILE.replace(
+        "[compile_dfa(p, minimize=False) for p in self.patterns], 512)",
+        "[compile_dfa(p, minimize=False)  "
+        "# fbtpu-lint: allow(grep-unminimized-dfa) differential\n"
+        "             for p in self.patterns], 512)")
+    assert "grep-unminimized-dfa" not in rules(
+        lint_source(suppressed, _SHRINK_PATH))
+
+
+def test_unminimized_dfa_source_without_sink_quiet():
+    # compiling an unminimized DFA for a NON-kernel purpose (a property
+    # test oracle, a doc example) is not the bug class
+    benign = """
+def oracle(pattern):
+    return compile_dfa(pattern, minimize=False)
+"""
+    assert "grep-unminimized-dfa" not in rules(
+        lint_source(benign, _SHRINK_PATH))
+
+
+def test_shipped_kernel_paths_use_minimized_dfas():
+    # the real program/table builders must stay on the compile_dfa
+    # default path — wiring minimize=False into filter_grep would fail
+    # THIS, not just a bench round three PRs later
+    import fluentbit_tpu.ops.grep as og
+    import fluentbit_tpu.plugins.filter_grep as fg
+    import fluentbit_tpu.plugins.filter_parser as fp
+
+    for mod in (og, fg, fp):
+        assert "grep-unminimized-dfa" not in rules(
+            lint_paths([mod.__file__])), mod.__name__
