@@ -59,6 +59,14 @@ Six rule families (see ANALYSIS.md for the full contract):
   ``compile_dfa(minimize=False)``) — an un-reduced table silently
   closes the assoc gate and shrinks the stride budget
   (analysis.shrink; PERF.md "shrink").
+- **launch graph / transfer budget** (`device-multi-launch-chain`,
+  `device-undonated-buffer`, `device-host-roundtrip`,
+  `device-sync-in-staging-loop`, `stage-redundant-copy`): the
+  fbtpu-xray interprocedural walk from every plugin/flux chain entry
+  to every device launch site — launches per staged segment, PCIe
+  byte crossings, the donate set, host scatters
+  (analysis.launchgraph; budget gated by analysis/launch_budget.json,
+  rendered by ``--graph json|dot``).
 
 The native C/C++ data plane has its own gate (analysis.native_gate):
 clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
@@ -167,6 +175,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .decline import DeclineSwallowRule
     from .devlane import UnguardedDispatchRule
     from .dtype import DtypeNarrowingRule
+    from .launchgraph import LaunchGraphRules
     from .locks import AwaitUnderLockRule, GuardedByRule
     from .purity import JaxPurityRules
     from .qos import UnmeteredIngestRule
@@ -185,6 +194,7 @@ def _build_rules(guards=None) -> List[Rule]:
         UnmeteredIngestRule(),
         UnguardedDispatchRule(),
         UnminimizedDfaRule(),
+        LaunchGraphRules(),
     ]
 
 

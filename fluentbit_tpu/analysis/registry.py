@@ -25,10 +25,37 @@ future caller.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Tuple
+import os
 
-__all__ = ["GuardEntry", "GUARDS"]
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+__all__ = ["GuardEntry", "GUARDS", "LAUNCH_ENTRIES", "BUDGET_PARAMS",
+           "budget_path"]
+
+# -- fbtpu-xray (analysis/launchgraph.py) declarative plumbing ---------
+
+#: Chain entry points the launch-graph walker roots at: the batched
+#: plugin fast path, the raw grep path, and the flux absorb commit.
+LAUNCH_ENTRIES: Tuple[str, ...] = ("process_batch", "filter_raw",
+                                   "absorb_batch")
+
+#: Canonical evaluation point for the symbolic transfer-byte algebra —
+#: the committed analysis/launch_budget.json is evaluated here (2
+#: double-buffered staging slots, the default FBTPU_SEGMENT_RECORDS,
+#: the grep max_len default, the simulated 8-device mesh, one flux
+#: group, HLL p=12 registers, the CMS 4×16384 table — the FluxSpec
+#: defaults).
+BUDGET_PARAMS: Dict[str, int] = {
+    "R": 2, "seg": 4096, "L": 512, "n_dev": 8, "G": 1,
+    "M_hll": 1 << 12, "M_cms": 4 * 16384,
+}
+
+
+def budget_path() -> str:
+    """Path of the committed launch/transfer budget baseline."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "launch_budget.json")
 
 
 @dataclass(frozen=True)

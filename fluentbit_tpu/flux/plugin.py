@@ -33,6 +33,8 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
+import numpy as np
+
 from ..codec.events import encode_event, now_event_time
 from ..core.config import ConfigMapEntry
 from ..core.plugin import FilterPlugin, FilterResult, registry
@@ -269,21 +271,21 @@ class FluxFilter(FilterPlugin):
             n = native.count_records(data) if n is None else n
             if n is None:
                 return None
-        for i, f in enumerate(sfields):
-            got = native.stage_field(data, f.encode("utf-8"),
-                                     spec.max_len, n_hint=n)
-            if got is None:
+        if sfields and n is None:
+            n = native.count_records(data)
+            if n is None:
                 return None
-            b, ln, _offs, n2 = got
-            if n is not None and n2 != n:
+        for f in sfields:
+            # stage straight into caller-owned column buffers: no
+            # arena round-trip, so multi-column specs keep every
+            # column live without the copy-out of all but the last
+            b = np.empty((n, spec.max_len), dtype=np.uint8)
+            ln = np.full((n,), -1, dtype=np.int32)
+            n2 = native.stage_field_into(data, f.encode("utf-8"),
+                                         b, ln, n_hint=n)
+            if n2 is None or n2 != n:
                 return None
-            n = n2
-            if i < len(sfields) - 1:
-                # arena reuse: the NEXT stage_field call overwrites
-                # these views — copy every column but the last
-                strcols[f] = (b[:n2].copy(), ln[:n2].copy())
-            else:
-                strcols[f] = (b[:n2], ln[:n2])
+            strcols[f] = (b, ln)
         numcols = {}
         for f in spec.numeric:
             got = native.stage_field_f64(data, f.encode("utf-8"),

@@ -635,6 +635,10 @@ class GrepFilter(FilterPlugin):
                 if n_keep == 0:
                     return (0, b"")
                 t0 = _time.perf_counter()
+                # by design: this compact sits on the host-native
+                # approx branch (no device launch reachable when
+                # use_native holds) — no verdict crossed PCIe here
+                # fbtpu-lint: allow(device-host-roundtrip)
                 compacted = native.compact(data, offsets[: n + 1], keep)
                 tm.add("compact_s", _time.perf_counter() - t0)
                 if compacted is not None:
@@ -729,6 +733,11 @@ class GrepFilter(FilterPlugin):
             mask = got2[0]
             n_true = int(mask.any(axis=0).sum())
         elif n_adm:
+            # by design: the approx-mask exact-recheck gather runs
+            # entirely on host bytes (use_native implies no device
+            # launch this chunk) — compacting the admitted records is
+            # what makes the reduced DFA pay for itself
+            # fbtpu-lint: allow(device-host-roundtrip)
             sub = native.compact(data, offsets[: n + 1], union)
             if sub is None:
                 idx0 = np.nonzero(union)[0]
@@ -884,25 +893,27 @@ class GrepFilter(FilterPlugin):
                 staged = {}
                 max_staged = 1
                 for key in by_key:
-                    got = native.stage_field(span, key, Lmax, None,
-                                             n_hint=cnt)
-                    if got is None:
+                    # stage straight into a caller-owned [cnt, Lmax]
+                    # matrix: no arena round-trip, so multi-key rule
+                    # sets keep ONE copy per key (the L-bucketed slice
+                    # into the segment batch below) instead of two
+                    want_offs = offs_box[0] is None
+                    offs = np.empty(cnt + 1, dtype=np.int64) \
+                        if want_offs else None
+                    wide = np.empty((cnt, Lmax), dtype=np.uint8)
+                    wlen = np.full((cnt,), -1, dtype=np.int32)
+                    count = native.stage_field_into(
+                        span, key, wide, wlen, n_hint=cnt,
+                        offsets_out=offs)
+                    if count is None or count != cnt:
                         raise _RawDecline
-                    b, ln, offs, count = got
-                    if count != cnt:
-                        raise _RawDecline
-                    if offs_box[0] is None:
+                    if want_offs:
                         # single-segment: the staging walk's boundary
                         # table serves overflow decode + compaction
                         # (same values whichever key discovered them)
                         offs_box[0] = offs
-                    if len(by_key) > 1:
-                        # stage_field returns views of a per-thread
-                        # arena the NEXT call overwrites — multi-key
-                        # rule sets copy each key's staging out first
-                        b, ln = b.copy(), ln.copy()
-                    staged[key] = (b, ln)
-                    mx = int(ln[:cnt].max()) if cnt else 0
+                    staged[key] = (wide, wlen)
+                    mx = int(wlen[:cnt].max()) if cnt else 0
                     max_staged = max(max_staged, mx)
                 # scan-length bucketing: the DFA scan is sequential in
                 # L, so clamp to the longest staged value (rounded to a

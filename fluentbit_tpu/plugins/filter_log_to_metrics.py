@@ -195,6 +195,7 @@ class LogToMetricsFilter(FilterPlugin):
                         "log_to_metrics native table build failed; "
                         "batched fast path disabled", exc_info=True)
                     self._batch_tables = None
+        self._report_shrink(engine)
 
         self.emitter = None
         self._dirty = False
@@ -218,6 +219,29 @@ class LogToMetricsFilter(FilterPlugin):
                     lambda _engine: self._emit_snapshot() if self._dirty
                     else None
                 )
+
+    def _report_shrink(self, engine) -> None:
+        """fluentbit_grep_shrink_* compile-outcome counters for the
+        selector-rule DFAs — compiled through the same reducer as
+        filter_grep's (FlbRegex → compile_dfa), so their savings land
+        in the same dashboard family, labelled by plugin (PERF.md
+        "shrink"); table bytes are accounted in the fbtpu-xray budget
+        report (ANALYSIS.md "fbtpu-xray")."""
+        if engine is None or getattr(engine, "m_shrink_states", None) \
+                is None:
+            return
+        label = (self.name,)
+        elim_s = elim_c = 0
+        for r in self.rules:
+            st = getattr(r.dfa, "shrink", None) if r.dfa is not None \
+                else None
+            if st is not None:
+                elim_s += st.states_eliminated
+                elim_c += st.classes_eliminated
+        if elim_s:
+            engine.m_shrink_states.inc(elim_s, label)
+        if elim_c:
+            engine.m_shrink_classes.inc(elim_c, label)
 
     # -- per-record helpers --
 
