@@ -181,6 +181,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .qos import UnmeteredIngestRule
     from .shrink import UnminimizedDfaRule
     from .silent import SwallowedErrorRule
+    from .speccheck import SpecCheckRules
 
     return [
         GuardedByRule(guards),
@@ -195,6 +196,7 @@ def _build_rules(guards=None) -> List[Rule]:
         UnguardedDispatchRule(),
         UnminimizedDfaRule(),
         LaunchGraphRules(),
+        SpecCheckRules(),
     ]
 
 

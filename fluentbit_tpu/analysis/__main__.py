@@ -207,6 +207,7 @@ def main(argv=None) -> int:
         from .batch import BatchExactnessRules
         from .launchgraph import LaunchGraphRules
         from .native_gate import NATIVE_RULES
+        from .speccheck import SpecCheckRules
 
         for r in RULES:
             if isinstance(r, BatchExactnessRules):
@@ -215,6 +216,9 @@ def main(argv=None) -> int:
             elif isinstance(r, LaunchGraphRules):
                 for n in r.RULE_NAMES:
                     print(f"{n}: (launch-graph pack) {r.description}")
+            elif isinstance(r, SpecCheckRules):
+                for n in r.RULE_NAMES:
+                    print(f"{n}: (speccheck pack) {r.description}")
             elif r.name == "jax-purity":
                 for n in ("jax-host-sync", "jax-side-effect",
                           "jax-retrace"):

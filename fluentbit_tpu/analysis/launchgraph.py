@@ -946,6 +946,15 @@ def build_launch_graph(root: Optional[str] = None,
         name: table_bytes(pats, n_dev=env["n_dev"])
         for name, pats in EXAMPLE_TABLES.items()
     }
+    try:
+        # fbtpu-speccheck: predicted per-leaf PartitionSpecs + donation
+        # set of every shipped device program (kernel deps may be
+        # absent on a lint-only host — the graph still builds)
+        from .speccheck import shardings_snapshot
+
+        shardings = shardings_snapshot()
+    except Exception:  # pragma: no cover - jax-less host
+        shardings = {}
     return {
         "version": 1,
         "params": env,
@@ -953,6 +962,7 @@ def build_launch_graph(root: Optional[str] = None,
         "donation": donation_crosscheck(n_dev=env["n_dev"], R=env["R"],
                                         L=env["L"]),
         "tables": tables,
+        "shardings": shardings,
     }
 
 
@@ -976,7 +986,8 @@ def budget_snapshot(graph: Dict[str, Any]) -> Dict[str, Any]:
             "scatter_passes": chain["scatter_passes"],
         }
     return {"params": {k: int(v) for k, v in graph["params"].items()},
-            "chains": chains}
+            "chains": chains,
+            "shardings": graph.get("shardings", {})}
 
 
 def compare_budget(current: Dict[str, Any],
@@ -1015,7 +1026,65 @@ def compare_budget(current: Dict[str, Any],
         if cid not in current.get("chains", {}):
             notes.append(f"{cid}: chain no longer reaches the device "
                          f"plane; regenerate launch_budget.json")
+    _compare_shardings(current, baseline, regressions, notes)
     return regressions, notes
+
+
+def _compare_shardings(current: Dict[str, Any], baseline: Dict[str, Any],
+                       regressions: List[str], notes: List[str]) -> None:
+    """fbtpu-speccheck leaf-spec regression: a table/input/output leaf
+    whose predicted PartitionSpec (or a program's predicted donation
+    set) differs from the committed snapshot fails the gate — a
+    sharding refactor must re-baseline deliberately (--write-budget).
+    A baseline written before the shardings block existed gates
+    nothing (old synthetic baselines in tests stay valid); a current
+    snapshot can also be empty on a kernel-less host — skip then too,
+    never fail on missing machinery."""
+    base_sh = baseline.get("shardings")
+    cur_sh = current.get("shardings")
+    if not base_sh or not cur_sh:
+        return
+    for pname, cur in cur_sh.items():
+        base = base_sh.get(pname)
+        if base is None:
+            regressions.append(
+                f"{pname}: new device program not in "
+                f"launch_budget.json shardings — baseline its "
+                f"predicted specs deliberately (--write-budget)")
+            continue
+        for group in ("tables", "inputs", "outputs"):
+            bleaves = base.get(group, {})
+            for leaf, spec in cur.get(group, {}).items():
+                if leaf not in bleaves:
+                    regressions.append(
+                        f"{pname}: {group} leaf `{leaf}` not in the "
+                        f"committed shardings snapshot — re-baseline "
+                        f"(--write-budget)")
+                elif bleaves[leaf] != spec:
+                    regressions.append(
+                        f"{pname}: {group} leaf `{leaf}` sharding "
+                        f"changed {bleaves[leaf]!r} → {spec!r}: a "
+                        f"layout change re-shards resident state at "
+                        f"the next dispatch — re-baseline "
+                        f"deliberately (--write-budget)")
+            for leaf in bleaves:
+                if leaf not in cur.get(group, {}):
+                    notes.append(
+                        f"{pname}: {group} leaf `{leaf}` left the "
+                        f"program; regenerate launch_budget.json")
+        if base.get("donate_predicted") is not None \
+                and base["donate_predicted"] != cur.get(
+                    "donate_predicted"):
+            regressions.append(
+                f"{pname}: predicted donation set changed "
+                f"{base['donate_predicted']!r} → "
+                f"{cur.get('donate_predicted')!r} — an input stopped "
+                f"(or started) aliasing its output; re-baseline "
+                f"deliberately (--write-budget)")
+    for pname in base_sh:
+        if pname not in cur_sh:
+            notes.append(f"{pname}: program left the shipped set; "
+                         f"regenerate launch_budget.json")
 
 
 def graph_to_dot(graph: Dict[str, Any]) -> str:

@@ -1,0 +1,1201 @@
+"""fbtpu-speccheck: abstract interpretation of the device plane's
+sharding/shape/dtype contract.
+
+ROADMAP item 1 collapses the filter stack into one fused shard_map
+program and item 2 scales it to a 2-D mesh; both refactors fail in
+ways that surface only at trace/lower time on an attached mesh — or as
+a silent perf cliff the bench device path has never been able to
+catch: a table leaf falling through to full replication, an axis the
+mesh size does not divide, a donation that quietly stops aliasing, an
+implicit reshard inside a fused body. This module proves the sharding
+contract of every shipped device program statically, at lint time.
+
+The lattice is ``(shape, dtype, PartitionSpec)`` triples: shapes are
+symbolic dims (``"Bp"``, ``"R"``, ints) evaluated at the canonical
+``registry.BUDGET_PARAMS`` point, dtypes are numpy names, and specs
+are per-dim axis entries (axis name / ``None`` / unknown). Programs
+are declared as :class:`ProgramSpec` records — the jit/pjit/shard_map
+programs the PR-11 launch graph discovers (grep single-device + mesh
+variants, the flux sketch/window kernels) — whose table pytrees
+resolve their specs through the SAME declarative partition-rules
+registry the builders consume (``ops.mesh.PARTITION_RULES``), so the
+static prediction and the built program cannot drift apart by
+construction. The tier-1 crosscheck (tests/test_speccheck.py) then
+pins the abstraction to ground truth: every shipped program is lowered
+on the simulated 8-device mesh and the predicted per-leaf
+PartitionSpecs / donation set must equal the compiled module's actual
+shardings and ``donation_report``.
+
+Six rules (suppress with ``# fbtpu-lint: allow(<rule>)`` +
+justification):
+
+- ``shard-unmatched-leaf`` — a table-pytree leaf no explicit rule
+  matches: ``match_partition_rules`` raises at trace time for the
+  no-match case, and a catch-all match silently replicates — an error
+  when the replicated per-device footprint exceeds
+  ``REPLICATE_BUDGET``.
+- ``shard-shadowed-rule`` — a partition rule that can never fire:
+  every leaf it matches first-matches an earlier rule, or it matches
+  no leaf at all (the dead-rule case ``match_partition_rules`` now
+  also rejects at runtime). Plus a literal-tuple check for an earlier
+  catch-all/duplicate pattern shadowing a later rule at any
+  ``match_partition_rules`` call site.
+- ``shard-indivisible-axis`` — a sharded dim not provably divisible by
+  the mesh axis size. Discharged by an int dim the canonical axis size
+  divides, a dim expression with the axis size as a literal factor, or
+  a per-program discharge claim verified against the source: a
+  ``pad_to_devices`` / ``bucket_size(..., multiple_of=)`` call in the
+  named function (``("pad", fn)``), or a ``% ... == 0`` guard
+  (``("guard", fn)`` — the 2-D ``R % n_dev`` case of ROADMAP item 2).
+  A claim whose function no longer pads/guards is itself a finding.
+- ``donation-aval-mismatch`` — a declared donated input whose abstract
+  *sharded* (shape, dtype) aval matches no output aval: jax would fall
+  back to a silent copy. This reproduces ``ops.mesh.
+  aliasable_donations`` symbolically, without building a mesh.
+- ``shard-implicit-reshard`` — an op inside a shard_map body combining
+  operands whose inferred shardings disagree on a named mesh axis (the
+  body-level interpreter propagates specs from literal ``in_specs``
+  through element-wise ops, reductions, and collectives; ``psum``/
+  ``pmax``-style merges clear the axis).
+- ``jit-dynamic-shape-retrace`` — a parameter of a jit-boundary
+  callable reaching a shape-constructor position (``jnp.zeros(n)``,
+  ``reshape``, ``broadcast_to`` …) without ``static_argnums``/
+  ``static_argnames``: a Python-value-derived dim at a jit boundary
+  either retraces per distinct value or dies as a tracer. The
+  sanctioned pattern — a closure-captured dim keyed into a
+  compiled-fn cache (``flux.kernels.segment_counts``) — does not
+  fire. Extends the purity pass's ``jax-retrace`` rule to shapes.
+
+The per-program ``shardings`` block (:func:`shardings_snapshot`) rides
+the launch graph (``--graph json``) and the committed
+``analysis/launch_budget.json`` (``--write-budget``), and
+``launch-budget-regression`` flags any leaf whose spec changed — the
+fusion PR's sharding refactor is then diffable. See ANALYSIS.md
+"speccheck pack".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from . import Finding, Module, Rule
+
+__all__ = [
+    "Aval", "ProgramSpec", "SpecCheckRules", "REPLICATE_BUDGET",
+    "eval_dim", "leaf_spec", "sharded_shape", "predict_donations",
+    "dim_divisible", "program_env", "shipped_programs",
+    "program_shardings", "shardings_snapshot",
+]
+
+#: Implicit (catch-all / fallback) full replication above this
+#: per-device byte footprint is an error. An explicit replicate rule is
+#: always fine — the decision is declared and reviewable.
+REPLICATE_BUDGET = 1 << 20
+
+#: Patterns that match anything: a leaf landing on one of these is
+#: implicitly replicated, not explicitly placed.
+_CATCH_ALL = frozenset({"", ".*", ".+", "^.*$", "^.+$"})
+
+_SEVERITY = {
+    "shard-unmatched-leaf": "error",
+    "shard-shadowed-rule": "warning",
+    "shard-indivisible-axis": "error",
+    "donation-aval-mismatch": "error",
+    "shard-implicit-reshard": "error",
+    "jit-dynamic-shape-retrace": "warning",
+}
+
+
+# ----------------------------------------------------------------------
+# the lattice
+# ----------------------------------------------------------------------
+
+@dataclass
+class Aval:
+    """One abstract buffer: symbolic shape, dtype, PartitionSpec.
+
+    ``spec`` entries are per-dim: an axis name, a tuple of axis names,
+    or None (unsharded); a trailing-short spec leaves the remaining
+    dims unsharded (PartitionSpec semantics). ``spec=None`` means the
+    spec is RESOLVED through the program's partition-rule table by leaf
+    name — the table-pytree case."""
+
+    name: str
+    shape: Tuple[Any, ...]
+    dtype: str
+    spec: Optional[Tuple[Any, ...]] = None
+    donatable: bool = False
+
+
+@dataclass
+class ProgramSpec:
+    """One device program's declared contract, evaluated at the
+    canonical ``registry.BUDGET_PARAMS`` point (plus ``env``
+    overrides — e.g. the rule-sharded grep variant models ``R=8``, the
+    smallest R its own ``R % n_dev == 0`` gate admits on the canonical
+    8-device mesh)."""
+
+    name: str
+    #: module path suffix findings anchor to (posix separators)
+    module: str
+    #: function/method name in that module findings anchor at (and
+    #: where discharge claims default-verify)
+    entry: str
+    #: ((mesh axis name, size symbol), ...) — () for single-device jit
+    axes: Tuple[Tuple[str, str], ...]
+    #: key into ops.mesh.PARTITION_RULES for spec=None leaves
+    rules_key: Optional[str]
+    tables: Tuple[Aval, ...]
+    inputs: Tuple[Aval, ...]
+    outputs: Tuple[Aval, ...]
+    #: input names the program declares donated (donate_argnums)
+    donate: Tuple[str, ...] = ()
+    #: dim symbol -> ("pad" | "guard", function name): the divisibility
+    #: proof for that symbol, verified against the module source
+    discharge: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: canonical-env overrides for this program
+    env: Dict[str, int] = field(default_factory=dict)
+
+
+def program_env(prog: Optional[ProgramSpec] = None) -> Dict[str, int]:
+    """The canonical symbolic-evaluation point: the launch-graph env
+    (``BUDGET_PARAMS`` + derived padded batch) plus the program's own
+    overrides."""
+    from .launchgraph import canonical_env
+
+    env = canonical_env()
+    if prog is not None:
+        env.update(prog.env)
+    return env
+
+
+def eval_dim(dim: Any, env: Dict[str, int]) -> int:
+    """A symbolic dim ("Bp", "8*n_dev", int) at the canonical env."""
+    if isinstance(dim, (int, np.integer)):
+        return int(dim)
+    return int(eval(str(dim), {"__builtins__": {}}, dict(env)))  # noqa: S307
+
+
+def _bound_rules(prog: ProgramSpec) -> Tuple[Tuple[str, Tuple], ...]:
+    """The program's partition-rule rows with the axis placeholder
+    bound to its first mesh axis — pure data (no jax import; lint must
+    run without a backend)."""
+    if not prog.rules_key:
+        return ()
+    from ..ops.mesh import AXIS, PARTITION_RULES
+
+    axis = prog.axes[0][0] if prog.axes else None
+    rows = PARTITION_RULES.get(prog.rules_key, ())
+    return tuple(
+        (rx, tuple(axis if t == AXIS else t for t in tmpl))
+        for rx, tmpl in rows
+    )
+
+
+def leaf_spec(rules: Sequence[Tuple[str, Tuple]],
+              name: str) -> Tuple[Optional[Tuple], Optional[int]]:
+    """First-match resolution (the ``match_partition_rules``
+    semantics) → (spec, rule index); (None, None) when nothing
+    matches."""
+    for i, (rx, spec) in enumerate(rules):
+        if re.search(rx, name) is not None:
+            return spec, i
+    return None, None
+
+
+def _resolved_spec(prog: ProgramSpec, aval: Aval,
+                   rules: Sequence[Tuple[str, Tuple]]) -> Optional[Tuple]:
+    if aval.spec is not None:
+        return aval.spec
+    spec, _ = leaf_spec(rules, aval.name)
+    return spec
+
+
+def sharded_shape(shape: Tuple[Any, ...], spec: Optional[Tuple],
+                  axes: Tuple[Tuple[str, str], ...],
+                  env: Dict[str, int]) -> Tuple[int, ...]:
+    """Per-device shard shape — the symbolic twin of
+    ``ops.mesh._sharded_shape`` (what jax's donation matcher compares)."""
+    out = [eval_dim(d, env) for d in shape]
+    sizes = {a: eval_dim(s, env) for a, s in axes}
+    if spec:
+        for i, ent in enumerate(spec[:len(out)]):
+            if ent is None:
+                continue
+            for ax in (ent if isinstance(ent, tuple) else (ent,)):
+                out[i] //= max(1, sizes.get(ax, 1))
+    return tuple(out)
+
+
+def predict_donations(prog: ProgramSpec,
+                      env: Optional[Dict[str, int]] = None) -> List[str]:
+    """The statically-aliasable donated-input set: donatable inputs
+    whose sharded (shape, dtype) exactly matches an unclaimed output
+    aval — ``ops.mesh.aliasable_donations`` reproduced symbolically,
+    no mesh required."""
+    env = env or program_env(prog)
+    rules = _bound_rules(prog)
+    outs: Dict[tuple, int] = {}
+    for o in prog.outputs:
+        key = (sharded_shape(o.shape, _resolved_spec(prog, o, rules),
+                             prog.axes, env), np.dtype(o.dtype).name)
+        outs[key] = outs.get(key, 0) + 1
+    donated: List[str] = []
+    for a in prog.inputs:
+        if not a.donatable:
+            continue
+        key = (sharded_shape(a.shape, _resolved_spec(prog, a, rules),
+                             prog.axes, env), np.dtype(a.dtype).name)
+        if outs.get(key, 0) > 0:
+            outs[key] -= 1
+            donated.append(a.name)
+    return donated
+
+
+def dim_divisible(dim: Any, size_sym: str,
+                  env: Dict[str, int]) -> Optional[bool]:
+    """Static divisibility of a sharded dim by a mesh-axis size.
+
+    Returns True (proven), False (proven indivisible — a concrete dim
+    the canonical axis size does not divide), or None (unknown: a
+    symbolic dim with no structural proof — the caller then requires a
+    verified discharge claim). A symbolic dim is NEVER accepted on
+    canonical-value luck: ``"B"`` evaluating to 4096 today proves
+    nothing about tomorrow's segment."""
+    if isinstance(dim, (int, np.integer)):
+        return int(dim) % max(1, eval_dim(size_sym, env)) == 0
+    expr = str(dim).replace(" ", "")
+    if expr == size_sym:
+        return True
+    # a literal product with the axis size as a top-level factor
+    if "*" in expr and size_sym in expr.split("*"):
+        return True
+    return None
+
+
+# ----------------------------------------------------------------------
+# discharge verification (source-level proofs)
+# ----------------------------------------------------------------------
+
+_PAD_FNS = frozenset({"pad_to_devices", "_pad_to_mesh"})
+
+
+def _terminal(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _find_def(module: Module, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _verify_discharge(module: Module, claim: Tuple[str, str]) -> bool:
+    """A discharge claim holds iff the named function still carries the
+    proof: a pad helper call (``pad_to_devices`` /
+    ``bucket_size(..., multiple_of=)``) for ``"pad"`` claims, a
+    ``% ... == 0``-style modulo guard for ``"guard"`` claims."""
+    kind, fn_name = claim
+    fn = _find_def(module, fn_name)
+    if fn is None:
+        return False
+    for sub in ast.walk(fn):
+        if kind == "pad" and isinstance(sub, ast.Call):
+            t = _terminal(sub.func)
+            if t in _PAD_FNS:
+                return True
+            if t == "bucket_size" and any(kw.arg == "multiple_of"
+                                          for kw in sub.keywords):
+                return True
+        elif kind == "guard" and isinstance(sub, ast.Compare):
+            sides = [sub.left] + list(sub.comparators)
+            has_mod = any(isinstance(s, ast.BinOp)
+                          and isinstance(s.op, ast.Mod) for s in sides)
+            against_zero = any(isinstance(s, ast.Constant)
+                               and s.value == 0 for s in sides)
+            if has_mod and against_zero:
+                return True
+    return False
+
+
+# ----------------------------------------------------------------------
+# the shipped-program registry (canonical BUDGET_PARAMS evaluation)
+# ----------------------------------------------------------------------
+
+_GREP_MODULE = "fluentbit_tpu/ops/grep.py"
+_SKETCH_MODULE = "fluentbit_tpu/ops/sketch.py"
+_KERNELS_MODULE = "fluentbit_tpu/flux/kernels.py"
+
+_programs_cache: Optional[Tuple[ProgramSpec, ...]] = None
+
+
+def _grep_table_leaves(env: Dict[str, int]) -> Tuple[Aval, ...]:
+    """The grep table pytree's leaves from a REAL canonical build
+    (R copies of the apache2 worked example — one stride class, so the
+    program never splits into per-k children), with the rule dim
+    re-symbolized to ``"R"`` so both mesh variants share the leaves."""
+    from .launchgraph import APACHE2
+    from ..ops.grep import GrepProgram
+    from ..regex.dfa import compile_dfa
+
+    g = GrepProgram([compile_dfa(APACHE2)] * env["R"], max_len=env["L"])
+    if g._np is None:  # pragma: no cover - homogeneous k never splits
+        raise RuntimeError("canonical grep program split into children")
+    return tuple(
+        Aval(nm, ("R",) + tuple(int(s) for s in arr.shape[1:]),
+             str(arr.dtype))
+        for nm, arr in sorted(g._np.items()) if arr is not None
+    )
+
+
+def _build_shipped() -> Tuple[ProgramSpec, ...]:
+    env = program_env()
+    leaves = _grep_table_leaves(env)
+    rep = tuple(Aval(a.name, a.shape, a.dtype, spec=())
+                for a in leaves)
+
+    from ..ops.sketch import CountMin, HyperLogLog
+
+    hll = HyperLogLog(p=12)  # M_hll = 1 << 12, the FluxSpec default
+    cms = CountMin()         # 4 × 16384 — M_cms
+    hll_shape = tuple(int(s) for s in np.asarray(hll.registers).shape)
+    hll_dtype = str(np.asarray(hll.registers).dtype)
+    cms_shape = tuple(int(s) for s in np.asarray(cms.table).shape)
+    cms_dtype = str(np.asarray(cms.table).dtype)
+
+    from ..flux.kernels import _pad_segments
+
+    n_pad = _pad_segments(env["G"])
+
+    grep_jit = ProgramSpec(
+        name="grep.jit", module=_GREP_MODULE, entry="_materialize",
+        axes=(), rules_key=None, tables=rep,
+        inputs=(Aval("batch", ("R", "B", "L"), "uint8", ()),
+                Aval("lengths", ("R", "B"), "int32", ())),
+        outputs=(Aval("mask", ("R", "B"), "bool", ()),),
+    )
+    grep_batch = ProgramSpec(
+        name="grep.mesh[batch]", module=_GREP_MODULE,
+        entry="dispatch_mesh",
+        axes=(("batch", "n_dev"),), rules_key="grep-batch",
+        tables=leaves,
+        inputs=(Aval("batch", ("R", "Bp", "L"), "uint8",
+                     (None, "batch", None), donatable=True),
+                Aval("lengths", ("R", "Bp"), "int32",
+                     (None, "batch"), donatable=True)),
+        outputs=(Aval("mask", ("R", "Bp"), "int32", (None, "batch")),
+                 Aval("counts", ("R",), "int32", ())),
+        donate=("lengths",),
+        discharge={"Bp": ("pad", "dispatch_mesh")},
+    )
+    grep_rules = ProgramSpec(
+        name="grep.mesh[rules]", module=_GREP_MODULE,
+        entry="dispatch_mesh",
+        axes=(("batch", "n_dev"),), rules_key="grep-rules",
+        tables=leaves,
+        inputs=(Aval("batch", ("R", "Bp", "L"), "uint8",
+                     ("batch", None, None), donatable=True),
+                Aval("lengths", ("R", "Bp"), "int32",
+                     ("batch", None), donatable=True)),
+        outputs=(Aval("mask", ("R", "Bp"), "int32", ("batch", None)),
+                 Aval("counts", ("R",), "int32", ("batch",))),
+        donate=("lengths",),
+        discharge={"R": ("guard", "mesh_variant"),
+                   "Bp": ("pad", "dispatch_mesh")},
+        # the smallest R the variant's own R % n_dev == 0 gate admits
+        env={"R": env["n_dev"]},
+    )
+    flux_hll = ProgramSpec(
+        name="flux.hll", module=_SKETCH_MODULE,
+        entry="build_sharded_hll",
+        axes=(("flux", "n_dev"),), rules_key="flux-hll",
+        tables=(Aval("registers", hll_shape, hll_dtype),),
+        inputs=(Aval("batch", ("Bp", "L"), "uint8", ("flux", None)),
+                Aval("lengths", ("Bp",), "int32", ("flux",))),
+        outputs=(Aval("registers_out", hll_shape, hll_dtype, ()),),
+        discharge={"Bp": ("pad", "_pad_to_mesh")},
+    )
+    flux_cms = ProgramSpec(
+        name="flux.cms", module=_SKETCH_MODULE,
+        entry="build_sharded_cms",
+        axes=(("flux", "n_dev"),), rules_key="flux-cms",
+        tables=(Aval("table", cms_shape, cms_dtype),),
+        inputs=(Aval("batch", ("Bp", "L"), "uint8", ("flux", None)),
+                Aval("lengths", ("Bp",), "int32", ("flux",)),
+                Aval("weights", ("Bp",), "int32", ("flux",))),
+        outputs=(Aval("table_out", cms_shape, cms_dtype, ()),),
+        discharge={"Bp": ("pad", "_pad_to_mesh")},
+    )
+    flux_counts = ProgramSpec(
+        name="flux.counts", module=_KERNELS_MODULE,
+        entry="build_sharded_counts",
+        axes=(("flux", "n_dev"),), rules_key="flux-counts",
+        tables=(),
+        inputs=(Aval("seg", ("Bp",), "int32"),
+                Aval("valid", ("Bp",), "int32")),
+        outputs=(Aval("counts", (n_pad,), "int32", ()),),
+        discharge={"Bp": ("pad", "sharded_segment_counts")},
+    )
+    return (grep_jit, grep_batch, grep_rules, flux_hll, flux_cms,
+            flux_counts)
+
+
+def shipped_programs(refresh: bool = False) -> Tuple[ProgramSpec, ...]:
+    """The canonical shipped-program registry, built lazily (the grep
+    leaves come from a real DFA compile). Returns () when the kernel
+    deps are unavailable — the rest of the lint gate must still run on
+    a jax-less host."""
+    global _programs_cache
+    if _programs_cache is not None and not refresh:
+        return _programs_cache
+    try:
+        progs = _build_shipped()
+    except Exception:
+        progs = ()
+    _programs_cache = progs
+    return progs
+
+
+# ----------------------------------------------------------------------
+# the shardings snapshot (launch-budget plumbing)
+# ----------------------------------------------------------------------
+
+def _spec_json(spec: Optional[Tuple]) -> Optional[List]:
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def program_shardings(prog: ProgramSpec) -> Dict[str, Any]:
+    """One program's predicted layout, JSON-shaped for the budget
+    file: per-leaf specs (tables through the rule registry, inputs/
+    outputs as declared) plus the predicted donation set."""
+    env = program_env(prog)
+    rules = _bound_rules(prog)
+
+    def js(aval: Aval) -> Optional[List]:
+        return _spec_json(_resolved_spec(prog, aval, rules))
+
+    return {
+        "module": prog.module,
+        "axes": {a: eval_dim(s, env) for a, s in prog.axes},
+        "tables": {a.name: js(a) for a in prog.tables},
+        "inputs": {a.name: js(a) for a in prog.inputs},
+        "outputs": {a.name: js(a) for a in prog.outputs},
+        "donate": list(prog.donate),
+        "donate_predicted": predict_donations(prog, env),
+    }
+
+
+def shardings_snapshot() -> Dict[str, Any]:
+    """Every shipped program's predicted shardings — the block
+    ``--graph json`` emits and ``--write-budget`` commits, gated by
+    ``launch-budget-regression`` (a leaf whose spec changes fails until
+    the budget file says so)."""
+    return {p.name: program_shardings(p) for p in shipped_programs()}
+
+
+# ----------------------------------------------------------------------
+# the shard_map body interpreter (shard-implicit-reshard)
+# ----------------------------------------------------------------------
+
+#: entirely-unknown abstract value
+_UNKNOWN = None
+
+#: unknown single-dim entry (vs None = known-unsharded)
+class _TopDim:
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug only
+        return "TOP"
+
+
+TOP = _TopDim()
+
+_COLLECTIVES = frozenset({"psum", "pmax", "pmin", "pmean"})
+_REDUCTIONS = frozenset({"sum", "max", "min", "prod", "mean", "any",
+                         "all", "count_nonzero"})
+_PASSTHROUGH_METHODS = frozenset({"astype", "clip", "copy", "round"})
+_PASSTHROUGH_LIKE = frozenset({"zeros_like", "ones_like", "full_like",
+                               "empty_like"})
+
+
+class _SV:
+    """Abstract sharding value: ``dims`` is a per-dim tuple of
+    axis-name / None / TOP, or the value is wholly unknown (use
+    ``_UNKNOWN`` i.e. None instead of an _SV)."""
+
+    __slots__ = ("dims",)
+
+    def __init__(self, dims: Tuple[Any, ...]):
+        self.dims = dims
+
+
+class _BodyInterp:
+    """Best-effort abstract interpreter over one shard_map body:
+    parameters seeded from literal ``in_specs``, element-wise ops
+    combine operand specs (a definite named-axis disagreement on the
+    same dim is the finding), reductions drop dims, collectives clear
+    the merged axis. Anything unresolvable degrades to unknown — the
+    rule only reports conflicts it can prove."""
+
+    def __init__(self):
+        self.conflicts: List[Tuple[ast.AST, str, str]] = []
+        self._flagged: Set[int] = set()
+
+    def run(self, fn: ast.AST, params: List[Optional[_SV]]) -> None:
+        names = [a.arg for a in fn.args.args]
+        env: Dict[str, Optional[_SV]] = {}
+        for nm, sv in zip(names, params):
+            env[nm] = sv
+        if isinstance(fn, ast.Lambda):
+            self._expr(fn.body, env)
+            return
+        self._stmts(fn.body, env)
+
+    # -- statements ----------------------------------------------------
+
+    def _stmts(self, stmts: List[ast.stmt],
+               env: Dict[str, Optional[_SV]]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                val = self._expr(stmt.value, env)
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        env[tgt.id] = val
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        for e in tgt.elts:
+                            if isinstance(e, ast.Name):
+                                env[e.id] = _UNKNOWN
+            elif isinstance(stmt, ast.AugAssign):
+                val = self._expr(stmt.value, env)
+                if isinstance(stmt.target, ast.Name):
+                    cur = env.get(stmt.target.id)
+                    env[stmt.target.id] = self._combine(cur, val, stmt)
+            elif isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self._expr(stmt.value, env)
+            elif isinstance(stmt, ast.If):
+                self._expr(stmt.test, env)
+                self._stmts(stmt.body, env)
+                self._stmts(stmt.orelse, env)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter, env)
+                if isinstance(stmt.target, ast.Name):
+                    env[stmt.target.id] = _UNKNOWN
+                self._stmts(stmt.body, env)
+            elif isinstance(stmt, ast.While):
+                self._expr(stmt.test, env)
+                self._stmts(stmt.body, env)
+            elif isinstance(stmt, ast.Expr):
+                self._expr(stmt.value, env)
+            # nested defs/classes run under their own spec context
+
+    # -- expressions ---------------------------------------------------
+
+    def _expr(self, node: Optional[ast.AST],
+              env: Dict[str, Optional[_SV]]) -> Optional[_SV]:
+        if node is None:
+            return _UNKNOWN
+        if isinstance(node, ast.Constant):
+            return _SV(())
+        if isinstance(node, ast.Name):
+            return env.get(node.id, _UNKNOWN)
+        if isinstance(node, ast.BinOp):
+            return self._combine(self._expr(node.left, env),
+                                 self._expr(node.right, env), node)
+        if isinstance(node, ast.UnaryOp):
+            return self._expr(node.operand, env)
+        if isinstance(node, ast.Compare):
+            out = self._expr(node.left, env)
+            for c in node.comparators:
+                out = self._combine(out, self._expr(c, env), node)
+            return out
+        if isinstance(node, ast.BoolOp):
+            out = _UNKNOWN
+            for v in node.values:
+                out = self._combine(out, self._expr(v, env), node)
+            return out
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test, env)
+            return self._combine(self._expr(node.body, env),
+                                 self._expr(node.orelse, env), node)
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, env)
+        if isinstance(node, ast.Attribute):
+            self._expr(node.value, env)
+            return _UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._expr(e, env)
+            return _UNKNOWN
+        return _UNKNOWN
+
+    def _call(self, call: ast.Call,
+              env: Dict[str, Optional[_SV]]) -> Optional[_SV]:
+        t = _terminal(call.func)
+        args = [self._expr(a, env) for a in call.args]
+        for kw in call.keywords:
+            if kw.arg not in ("axis", "axis_name"):
+                self._expr(kw.value, env)
+        if t in _COLLECTIVES:
+            base = args[0] if args else _UNKNOWN
+            if base is _UNKNOWN:
+                return _UNKNOWN
+            axis_name = None
+            for kw in call.keywords:
+                if kw.arg == "axis_name" and isinstance(kw.value,
+                                                        ast.Constant):
+                    axis_name = kw.value.value
+            dims = tuple(
+                None if (isinstance(d, str)
+                         and (axis_name is None or d == axis_name))
+                else d
+                for d in base.dims)
+            return _SV(dims)
+        if t in _REDUCTIONS:
+            base = args[0] if args else _UNKNOWN
+            if isinstance(call.func, ast.Attribute) and not call.args:
+                base = self._expr(call.func.value, env)
+            axis_kw = next((kw.value for kw in call.keywords
+                            if kw.arg == "axis"), None)
+            if base is _UNKNOWN:
+                return _UNKNOWN
+            if axis_kw is None:
+                return _SV(())
+            if isinstance(axis_kw, ast.Constant) \
+                    and isinstance(axis_kw.value, int):
+                k = axis_kw.value
+                n = len(base.dims)
+                if -n <= k < n:
+                    k %= n
+                    return _SV(base.dims[:k] + base.dims[k + 1:])
+            return _UNKNOWN
+        if t == "where" and len(args) == 3:
+            out = self._combine(args[1], args[2], call)
+            return self._combine(out, args[0], call)
+        if t in _PASSTHROUGH_METHODS \
+                and isinstance(call.func, ast.Attribute):
+            return self._expr(call.func.value, env)
+        if t in _PASSTHROUGH_LIKE and args:
+            return args[0]
+        # x.at[idx].add(v) and friends: result layout is the base array
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("add", "set", "max", "min",
+                                       "mul") \
+                and isinstance(call.func.value, ast.Subscript) \
+                and isinstance(call.func.value.value, ast.Attribute) \
+                and call.func.value.value.attr == "at":
+            return self._expr(call.func.value.value.value, env)
+        return _UNKNOWN
+
+    def _subscript(self, node: ast.Subscript,
+                   env: Dict[str, Optional[_SV]]) -> Optional[_SV]:
+        base = self._expr(node.value, env)
+        self._index_exprs(node.slice, env)
+        if base is _UNKNOWN:
+            return _UNKNOWN
+        elts = node.slice.elts if isinstance(node.slice, ast.Tuple) \
+            else [node.slice]
+        dims: List[Any] = []
+        src = list(base.dims)
+        for e in elts:
+            if isinstance(e, ast.Constant) and e.value is None:
+                dims.append(None)
+            elif isinstance(e, ast.Slice):
+                if src:
+                    dims.append(src.pop(0))
+            elif isinstance(e, type(Ellipsis)) or (
+                    isinstance(e, ast.Constant)
+                    and e.value is Ellipsis):
+                keep = len(src) - sum(
+                    1 for r in elts[elts.index(e) + 1:]
+                    if not (isinstance(r, ast.Constant)
+                            and r.value is None))
+                while len(src) > max(0, len(src) - keep):
+                    dims.append(src.pop(0))
+            else:
+                if src:
+                    src.pop(0)  # integer/fancy index drops the dim
+        dims.extend(src)
+        return _SV(tuple(dims))
+
+    def _index_exprs(self, node: ast.AST,
+                     env: Dict[str, Optional[_SV]]) -> None:
+        for e in (node.elts if isinstance(node, ast.Tuple) else [node]):
+            if isinstance(e, ast.Slice):
+                for part in (e.lower, e.upper, e.step):
+                    if part is not None:
+                        self._expr(part, env)
+            elif not isinstance(e, ast.Constant):
+                self._expr(e, env)
+
+    def _combine(self, a: Optional[_SV], b: Optional[_SV],
+                 node: ast.AST) -> Optional[_SV]:
+        if a is _UNKNOWN or b is _UNKNOWN:
+            return _UNKNOWN
+        if len(a.dims) != len(b.dims):
+            # rank mismatch = numpy broadcasting; a spec is left-
+            # anchored, so alignment is ambiguous — stay sound, give up
+            return _UNKNOWN
+        out: List[Any] = []
+        for da, db in zip(a.dims, b.dims):
+            if isinstance(da, str) and isinstance(db, str) and da != db:
+                if node.lineno not in self._flagged:
+                    self._flagged.add(node.lineno)
+                    self.conflicts.append((node, da, db))
+                out.append(TOP)
+            elif isinstance(da, str):
+                out.append(da)
+            elif isinstance(db, str):
+                out.append(db)
+            elif da is TOP or db is TOP:
+                out.append(TOP)
+            else:
+                out.append(None)
+        return _SV(tuple(out))
+
+
+def _parse_spec_literal(node: ast.AST) -> Optional[Tuple]:
+    """A literal ``P(...)``/``PartitionSpec(...)`` call → spec tuple
+    (axis strings / None / TOP for unresolvable entries); None for
+    anything else (unknown spec)."""
+    if not (isinstance(node, ast.Call)
+            and _terminal(node.func) in ("P", "PartitionSpec")):
+        return None
+    out: List[Any] = []
+    for a in node.args:
+        if isinstance(a, ast.Constant) and (a.value is None
+                                            or isinstance(a.value, str)):
+            out.append(a.value)
+        else:
+            out.append(TOP)
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# jit-boundary shape scan (jit-dynamic-shape-retrace)
+# ----------------------------------------------------------------------
+
+#: shape-constructor terminals → (positional shape args, shape kwargs)
+_SHAPE_CTORS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "zeros": ((0,), ("shape",)),
+    "ones": ((0,), ("shape",)),
+    "empty": ((0,), ("shape",)),
+    "full": ((0,), ("shape",)),
+    "arange": ((0, 1, 2), ()),
+    "eye": ((0, 1), ()),
+    "linspace": ((2,), ("num",)),
+    "broadcast_to": ((1,), ("shape",)),
+    "tile": ((1,), ("reps",)),
+    "reshape": ((1, 2, 3), ("newshape", "shape")),
+}
+
+#: method form: x.reshape(...) — every argument is a shape
+_SHAPE_METHODS = frozenset({"reshape"})
+
+_JIT_NAMES = frozenset({"jit", "pjit"})
+
+
+def _all_defs(module: Module) -> Dict[str, List[ast.AST]]:
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _nearest_def(defs: Dict[str, List[ast.AST]], name: str,
+                 line: int) -> Optional[ast.AST]:
+    cands = defs.get(name)
+    if not cands:
+        return None
+    return min(cands, key=lambda d: abs(d.lineno - line))
+
+
+class _ShapeScan:
+    """Which parameters of each function reach a shape-constructor
+    position — directly or through a call into another local def
+    (positional mapping, recursion memoized and cycle-guarded)."""
+
+    def __init__(self, defs: Dict[str, List[ast.AST]]):
+        self.defs = defs
+        self._memo: Dict[int, Set[str]] = {}
+        self._stack: Set[int] = set()
+
+    def params(self, fn: ast.AST) -> List[str]:
+        return [a.arg for a in fn.args.args if a.arg != "self"]
+
+    def shape_params(self, fn: ast.AST) -> Set[str]:
+        key = id(fn)
+        if key in self._memo:
+            return self._memo[key]
+        if key in self._stack:
+            return set()
+        self._stack.add(key)
+        try:
+            params = set(self.params(fn))
+            hits: Set[str] = set()
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                t = _terminal(sub.func)
+                for tree in self._shape_arg_trees(sub, t):
+                    for n in ast.walk(tree):
+                        if isinstance(n, ast.Name) and n.id in params:
+                            hits.add(n.id)
+                # transitive: a param forwarded into a callee's shape
+                # position is a shape param here too
+                callee = None
+                if isinstance(sub.func, ast.Name):
+                    callee = _nearest_def(self.defs, sub.func.id,
+                                          sub.lineno)
+                elif isinstance(sub.func, ast.Attribute):
+                    callee = _nearest_def(self.defs, sub.func.attr,
+                                          sub.lineno)
+                if callee is None or t in _SHAPE_CTORS:
+                    continue
+                cp = self.params(callee)
+                ch = self.shape_params(callee)
+                for pos, arg in enumerate(sub.args):
+                    if isinstance(arg, ast.Name) and arg.id in params \
+                            and pos < len(cp) and cp[pos] in ch:
+                        hits.add(arg.id)
+                for kw in sub.keywords:
+                    if isinstance(kw.value, ast.Name) \
+                            and kw.value.id in params \
+                            and kw.arg in ch:
+                        hits.add(kw.value.id)
+            self._memo[key] = hits
+            return hits
+        finally:
+            self._stack.discard(key)
+
+    def _shape_arg_trees(self, call: ast.Call,
+                         t: Optional[str]) -> List[ast.AST]:
+        trees: List[ast.AST] = []
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _SHAPE_METHODS:
+            return list(call.args)
+        if t not in _SHAPE_CTORS:
+            return trees
+        pos, kws = _SHAPE_CTORS[t]
+        for i in pos:
+            if i < len(call.args):
+                trees.append(call.args[i])
+        for kw in call.keywords:
+            if kw.arg in kws:
+                trees.append(kw.value)
+        return trees
+
+
+def _static_names(call: ast.Call, params: List[str]) -> Set[str]:
+    """Parameter names covered by static_argnums/static_argnames."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = []
+            if isinstance(kw.value, ast.Constant):
+                vals = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)]
+            for v in vals:
+                if isinstance(v, int) and 0 <= v < len(params):
+                    out.add(params[v])
+        elif kw.arg == "static_argnames":
+            vals = []
+            if isinstance(kw.value, ast.Constant):
+                vals = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)]
+            out |= {v for v in vals if isinstance(v, str)}
+    return out
+
+
+# ----------------------------------------------------------------------
+# the rule pack
+# ----------------------------------------------------------------------
+
+class SpecCheckRules(Rule):
+    name = "speccheck"  # umbrella; findings carry precise rule names
+    description = ("fbtpu-speccheck abstract sharding/shape/dtype "
+                   "interpreter: unmatched/shadowed partition rules, "
+                   "axis divisibility proofs, symbolic donation-aval "
+                   "matching, shard_map-body reshard conflicts, "
+                   "jit-boundary dynamic shapes")
+
+    RULE_NAMES = ("shard-unmatched-leaf", "shard-shadowed-rule",
+                  "shard-indivisible-axis", "donation-aval-mismatch",
+                  "shard-implicit-reshard", "jit-dynamic-shape-retrace")
+
+    def __init__(self, programs: Optional[Sequence[ProgramSpec]] = None):
+        #: None → the shipped registry (lazy); tests inject synthetic
+        #: ProgramSpecs here, the GuardedByRule(guards) pattern
+        self._programs = programs
+
+    def programs(self) -> Sequence[ProgramSpec]:
+        if self._programs is not None:
+            return self._programs
+        return shipped_programs()
+
+    def check(self, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        flagged: Set[Tuple[int, str, str]] = set()
+
+        def emit(line: int, col: int, rule: str, message: str) -> None:
+            if (line, rule, message) in flagged \
+                    or module.allowed(rule, line):
+                return
+            flagged.add((line, rule, message))
+            out.append(Finding(module.path, line, col, rule, message,
+                               _SEVERITY[rule]))
+
+        src = module.source
+        if "match_partition_rules" in src:
+            self._literal_rule_tables(module, emit)
+        if "shard_map" in src:
+            self._shard_bodies(module, emit)
+        if "jit" in src:
+            self._jit_shapes(module, emit)
+        for prog in self.programs():
+            if module.path.endswith(prog.module):
+                self._check_program(prog, module, emit)
+        out.sort(key=lambda f: (f.line, f.col, f.rule))
+        return out
+
+    # -- registry-driven program checks -------------------------------
+
+    def _check_program(self, prog: ProgramSpec, module: Module,
+                       emit) -> None:
+        env = program_env(prog)
+        rules = _bound_rules(prog)
+        entry = _find_def(module, prog.entry)
+        line = entry.lineno if entry is not None else 1
+
+        ruled = [a for a in list(prog.tables) + list(prog.inputs)
+                 if a.spec is None]
+        # 1. unmatched / implicitly replicated leaves
+        first_match: Dict[str, Optional[int]] = {}
+        for aval in ruled:
+            spec, idx = leaf_spec(rules, aval.name)
+            first_match[aval.name] = idx
+            nbytes = int(np.prod([eval_dim(d, env)
+                                  for d in aval.shape]) or 1) \
+                * np.dtype(aval.dtype).itemsize
+            if idx is None:
+                emit(line, 0, "shard-unmatched-leaf",
+                     f"[{prog.name}] leaf `{aval.name}` matches no "
+                     f"partition rule in {prog.rules_key!r}: "
+                     f"match_partition_rules raises at trace time — "
+                     f"name the leaf explicitly in "
+                     f"ops.mesh.PARTITION_RULES")
+            elif rules[idx][0] in _CATCH_ALL \
+                    and nbytes > REPLICATE_BUDGET:
+                emit(line, 0, "shard-unmatched-leaf",
+                     f"[{prog.name}] leaf `{aval.name}` "
+                     f"({nbytes} B) rides the catch-all rule "
+                     f"{rules[idx][0]!r}: implicit full replication "
+                     f"above the {REPLICATE_BUDGET} B budget — give "
+                     f"it an explicit rule (replicate deliberately or "
+                     f"shard it)")
+
+        # 2. shadowed / dead rules over the real leaf set
+        if ruled and rules:
+            for j, (rx, _spec) in enumerate(rules):
+                matching = [a.name for a in ruled
+                            if re.search(rx, a.name) is not None]
+                if not matching:
+                    emit(line, 0, "shard-shadowed-rule",
+                         f"[{prog.name}] partition rule {rx!r} "
+                         f"matches no leaf of the program's table "
+                         f"pytree (dead rule): a renamed leaf lost "
+                         f"its spec silently")
+                elif all(first_match.get(nm) is not None
+                         and first_match[nm] < j for nm in matching):
+                    shadow = rules[max(first_match[nm]
+                                       for nm in matching)][0]
+                    emit(line, 0, "shard-shadowed-rule",
+                         f"[{prog.name}] partition rule {rx!r} can "
+                         f"never fire: every leaf it matches "
+                         f"({', '.join(matching)}) first-matches the "
+                         f"earlier rule {shadow!r}")
+
+        # 3. axis divisibility obligations
+        axis_sizes = dict(prog.axes)
+        for aval in (tuple(ruled) + tuple(a for a in prog.inputs
+                                          if a.spec is not None)
+                     + prog.outputs):
+            spec = _resolved_spec(prog, aval, rules)
+            if not spec:
+                continue
+            for i, ent in enumerate(spec[:len(aval.shape)]):
+                if ent is None:
+                    continue
+                for ax in (ent if isinstance(ent, tuple) else (ent,)):
+                    size_sym = axis_sizes.get(ax)
+                    if size_sym is None:
+                        continue
+                    dim = aval.shape[i]
+                    ok = dim_divisible(dim, size_sym, env)
+                    if ok is True:
+                        continue
+                    claim = prog.discharge.get(str(dim))
+                    if ok is None and claim is not None \
+                            and _verify_discharge(module, claim):
+                        continue
+                    why = (f"discharge claim {claim!r} no longer "
+                           f"verifies in this module"
+                           if claim is not None else
+                           f"no pad_to_devices/bucket_size("
+                           f"multiple_of=) or %-guard proof covers it")
+                    emit(line, 0, "shard-indivisible-axis",
+                         f"[{prog.name}] dim {dim!r} of "
+                         f"`{aval.name}` is sharded over mesh axis "
+                         f"{ax!r} (size {size_sym}="
+                         f"{eval_dim(size_sym, env)}) but is not "
+                         f"provably divisible: {why} — NamedSharding "
+                         f"rejects the shape at trace time")
+
+        # 4. donation aval matching
+        predicted = predict_donations(prog, env)
+        in_names = {a.name for a in prog.inputs}
+        for nm in prog.donate:
+            if nm not in in_names:
+                emit(line, 0, "donation-aval-mismatch",
+                     f"[{prog.name}] donate entry `{nm}` names no "
+                     f"input of the program")
+            elif nm not in predicted:
+                emit(line, 0, "donation-aval-mismatch",
+                     f"[{prog.name}] donated input `{nm}`'s sharded "
+                     f"aval matches no output aval: jax falls back to "
+                     f"a silent copy (\"donated buffer was not "
+                     f"usable\") — donate exactly the aliasable set "
+                     f"(ops.mesh.aliasable_donations)")
+
+    # -- literal rule-table scan (shard-shadowed-rule, source level) --
+
+    def _literal_rule_tables(self, module: Module, emit) -> None:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and _terminal(node.func) == "match_partition_rules"
+                    and node.args):
+                continue
+            rules_arg = node.args[0]
+            if not isinstance(rules_arg, (ast.Tuple, ast.List)):
+                continue
+            pats: List[Tuple[str, ast.AST]] = []
+            for elt in rules_arg.elts:
+                if isinstance(elt, (ast.Tuple, ast.List)) and elt.elts \
+                        and isinstance(elt.elts[0], ast.Constant) \
+                        and isinstance(elt.elts[0].value, str):
+                    pats.append((elt.elts[0].value, elt))
+            for j in range(1, len(pats)):
+                later, lnode = pats[j]
+                for i in range(j):
+                    earlier, _ = pats[i]
+                    if earlier in _CATCH_ALL or earlier == later:
+                        emit(lnode.lineno, lnode.col_offset,
+                             "shard-shadowed-rule",
+                             f"partition rule {later!r} can never "
+                             f"fire: the earlier rule {earlier!r} "
+                             f"matches every leaf first "
+                             f"(first-match semantics)")
+                        break
+
+    # -- shard_map body interpretation (shard-implicit-reshard) -------
+
+    def _shard_bodies(self, module: Module, emit) -> None:
+        defs = _all_defs(module)
+        seen: Set[Tuple[int, int]] = set()
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and _terminal(node.func) == "shard_map"):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen or not node.args:
+                continue
+            seen.add(key)
+            target = node.args[0]
+            fn: Optional[ast.AST] = None
+            if isinstance(target, ast.Lambda):
+                fn = target
+            elif isinstance(target, ast.Name):
+                fn = _nearest_def(defs, target.id, node.lineno)
+            if fn is None:
+                continue
+            in_specs = next((kw.value for kw in node.keywords
+                             if kw.arg == "in_specs"), None)
+            spec_nodes = (list(in_specs.elts)
+                          if isinstance(in_specs, (ast.Tuple, ast.List))
+                          else [in_specs] if in_specs is not None
+                          else [])
+            params: List[Optional[_SV]] = []
+            for sn in spec_nodes:
+                spec = _parse_spec_literal(sn)
+                params.append(_SV(spec) if spec is not None
+                              else _UNKNOWN)
+            interp = _BodyInterp()
+            try:
+                interp.run(fn, params)
+            except RecursionError:  # pragma: no cover - deep bodies
+                continue
+            for cnode, da, db in interp.conflicts:
+                emit(cnode.lineno, cnode.col_offset,
+                     "shard-implicit-reshard",
+                     f"op combines operands sharded over different "
+                     f"mesh axes on the same dim ({da!r} vs {db!r}) "
+                     f"inside a shard_map body: the compiler inserts "
+                     f"an implicit all-to-all reshard per launch — "
+                     f"merge explicitly (psum/pmax/all_gather) or fix "
+                     f"the in_specs")
+
+    # -- jit boundary scan (jit-dynamic-shape-retrace) ----------------
+
+    def _jit_shapes(self, module: Module, emit) -> None:
+        defs = _all_defs(module)
+        scan = _ShapeScan(defs)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and _terminal(node.func) in _JIT_NAMES
+                    and node.args):
+                continue
+            target = node.args[0]
+            fn: Optional[ast.AST] = None
+            if isinstance(target, ast.Lambda):
+                fn = target
+            elif isinstance(target, ast.Name):
+                fn = _nearest_def(defs, target.id, node.lineno)
+            elif isinstance(target, ast.Attribute):
+                fn = _nearest_def(defs, target.attr, node.lineno)
+            if fn is None:
+                continue
+            params = scan.params(fn)
+            hot = scan.shape_params(fn) - _static_names(node, params)
+            if not hot:
+                continue
+            what = ", ".join(f"`{p}`" for p in sorted(hot))
+            fname = getattr(fn, "name", "<lambda>")
+            emit(node.lineno, node.col_offset,
+                 "jit-dynamic-shape-retrace",
+                 f"parameter(s) {what} of jitted `{fname}` reach a "
+                 f"shape-constructor position without static_argnums/"
+                 f"static_argnames: a Python-value-derived dim at the "
+                 f"jit boundary retraces per distinct value (or dies "
+                 f"as a tracer) — mark it static, or close over it "
+                 f"and key a compiled-fn cache by the dim "
+                 f"(flux.kernels.segment_counts)")

@@ -768,6 +768,24 @@ class Engine:
                 await asyncio.gather(*pending, return_exceptions=True)
             self._started.clear()
 
+    def _collector_delay(self, ins: InputInstance,
+                         interval: float) -> float:
+        """Collector pacing: a DEFER-paused input sleeps for the qos
+        bucket's predicted refill time (Qos.defer_hint on the dropped
+        append's size) instead of spin-polling every interval while the
+        pause flag stays set. Capped at 30s so a starved tenant still
+        re-checks (resume_paused may clear the pause for other reasons
+        — config reload, quota raise); never below the configured
+        interval."""
+        if not getattr(ins, "paused_by_qos", False):
+            return interval
+        try:
+            cost = int(getattr(ins, "_qos_defer_cost", 0)) or 1
+            hint = float(self.qos.defer_hint(ins, cost))
+        except Exception:
+            return interval
+        return max(interval, min(hint, 30.0))
+
     async def _collector(self, ins: InputInstance) -> None:
         """Interval collector (flb_input_set_collector_time)."""
         interval = ins.plugin.collect_interval or 1.0
@@ -779,7 +797,7 @@ class Engine:
                     ins.plugin.collect(self)
             except Exception:
                 log.exception("input %s collect failed", ins.display_name)
-            await asyncio.sleep(interval)
+            await asyncio.sleep(self._collector_delay(ins, interval))
 
     def _collector_thread(self, ins: InputInstance) -> None:
         """Threaded-input collector loop (reference
@@ -794,7 +812,8 @@ class Engine:
                     ins.plugin.collect(self)
             except Exception:
                 log.exception("input %s collect failed", ins.display_name)
-            if self._stop_event.wait(interval):  # instant stop wakeup
+            if self._stop_event.wait(  # instant stop wakeup
+                    self._collector_delay(ins, interval)):
                 break
         if ins.removed:
             # hot reload removed this input: this thread owns the

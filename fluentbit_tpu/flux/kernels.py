@@ -30,7 +30,8 @@ except Exception:  # pragma: no cover - jax absent: host twins only
     HAVE_JAX = False
 
 __all__ = ["flux_mesh", "segment_counts", "sharded_segment_counts",
-           "host_segment_counts", "guarded_segment_counts"]
+           "host_segment_counts", "guarded_segment_counts",
+           "build_sharded_counts"]
 
 #: compiled-kernel caches, keyed by padded segment count (and mesh
 #: structure for the sharded variant) — a fresh jit per call would
@@ -105,6 +106,35 @@ def _mesh_key(mesh) -> tuple:
     return mesh_key(mesh)
 
 
+def build_sharded_counts(mesh, n_pad: int):
+    """Compile the mesh group-count program for an ``n_pad``-slot
+    segment table: the ``seg``/``valid`` batch columns ride the
+    declarative ``flux-counts`` partition rules (batch-axis sharded),
+    each device scatter-adds its shard locally, and the merge is
+    ``lax.psum`` over the mesh axis. Factored out of the dispatch
+    wrapper so the fbtpu-speccheck static==dynamic crosscheck can
+    ``lower()`` the exact shipped program on the simulated mesh."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.device import shard_map_fn
+    from ..ops.mesh import rule_spec
+
+    shard_map = shard_map_fn()
+    axis = mesh.axis_names[0]
+
+    def step(s, v):
+        local = _counts_impl(s, v, n_pad)
+        return lax.psum(local, axis_name=axis)
+
+    return jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(rule_spec("flux-counts", axis, "seg"),
+                  rule_spec("flux-counts", axis, "valid")),
+        out_specs=P(),
+    ))
+
+
 def sharded_segment_counts(mesh, seg: np.ndarray, valid: np.ndarray,
                            n_seg: int) -> np.ndarray:
     """Group counts over a mesh: the batch axis is sharded across
@@ -114,19 +144,16 @@ def sharded_segment_counts(mesh, seg: np.ndarray, valid: np.ndarray,
     counters)."""
     if not HAVE_JAX or mesh is None:
         return host_segment_counts(seg, valid, n_seg)
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
-
-    from ..ops.device import shard_map_fn
-
-    shard_map = shard_map_fn()
+    from ..ops.mesh import pad_to_devices
 
     n_dev = mesh.devices.size
     B = seg.shape[0]
-    Bp = ((B + n_dev - 1) // n_dev) * n_dev
+    # pad_to_devices: the divisibility proof fbtpu-speccheck keys the
+    # sharded batch axis on (pad rows are invalid → contribute zero)
+    Bp = pad_to_devices(B, n_dev)
     seg32 = seg.astype(np.int32)
     valid32 = valid.astype(np.int32)
-    if Bp != B:  # pad rows are invalid → contribute zero everywhere
+    if Bp != B:
         seg32 = np.concatenate(
             [seg32, np.zeros((Bp - B,), dtype=np.int32)])
         valid32 = np.concatenate(
@@ -135,17 +162,7 @@ def sharded_segment_counts(mesh, seg: np.ndarray, valid: np.ndarray,
     key = (_mesh_key(mesh), n_pad)
     fn = _shard_cache.get(key)
     if fn is None:
-        axis = mesh.axis_names[0]
-
-        def step(s, v):
-            local = _counts_impl(s, v, n_pad)
-            return lax.psum(local, axis_name=axis)
-
-        fn = _shard_cache[key] = jax.jit(shard_map(
-            step, mesh=mesh,
-            in_specs=(P(axis), P(axis)),
-            out_specs=P(),
-        ))
+        fn = _shard_cache[key] = build_sharded_counts(mesh, n_pad)
     got = np.asarray(fn(jnp.asarray(seg32), jnp.asarray(valid32)))
     return got[:n_seg]
 

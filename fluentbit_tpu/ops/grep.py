@@ -642,7 +642,7 @@ class GrepProgram:
         from . import device
         from .device import shard_map_fn
         from .mesh import (aliasable_donations, match_partition_rules,
-                           mesh_key)
+                           mesh_key, partition_rules)
 
         if self._jit is None:
             if not device.wait(60.0):
@@ -658,17 +658,17 @@ class GrepProgram:
         axis = mesh.axis_names[0]
         variant = self.mesh_variant(mesh)
         R = len(self.dfas)
-        # the whole sharding layout of the program, in one table: the
-        # table pytree's specs by leaf name, then batch/lengths/outputs
+        # the whole sharding layout of the program lives in the
+        # declarative registry (ops.mesh.PARTITION_RULES) — every table
+        # leaf named explicitly, the same tables fbtpu-speccheck
+        # evaluates statically; only the staged-input/output specs are
+        # per-variant here
         if variant == "rules":
-            table_rules = (
-                (r"trans_flat|class_maps|pair_maps", P(axis, None)),
-                (r".*", P(axis)),
-            )
+            table_rules = partition_rules("grep-rules", axis)
             spec_b, spec_l = P(axis, None, None), P(axis, None)
             spec_mask, spec_counts = P(axis, None), P(axis)
         else:
-            table_rules = ((r".*", P()),)
+            table_rules = partition_rules("grep-batch", axis)
             spec_b, spec_l = P(None, axis, None), P(None, axis)
             spec_mask, spec_counts = P(None, axis), P()
         tspecs = match_partition_rules(table_rules, self._tbl)

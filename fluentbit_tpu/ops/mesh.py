@@ -32,6 +32,7 @@ None and the callers stay on their host twins.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -47,7 +48,78 @@ __all__ = [
     "named_tree_map", "match_partition_rules", "build_mesh", "mesh_key",
     "mesh_info", "pad_to_devices", "aliasable_donations",
     "donation_report", "replicated_table_bytes",
+    "AXIS", "PARTITION_RULES", "partition_rules", "rule_spec",
 ]
+
+# -- the declarative partition-rules registry --------------------------
+#
+# One table per device program, naming EVERY leaf of its table pytree
+# explicitly — anchored regexes, no catch-alls. The programs consume
+# these through ``partition_rules``/``rule_spec`` (which bind the axis
+# placeholder to the live mesh axis), and the fbtpu-speccheck abstract
+# interpreter (analysis/speccheck.py) evaluates the same tables
+# symbolically at lint time: a leaf that falls through to the implicit
+# replicate fallback, a rule an earlier rule shadows, or a sharded dim
+# with no divisibility proof is a finding BEFORE anything traces on a
+# mesh. Spec templates are plain tuples (axis token / axis name / None
+# per dim) so the registry imports without jax.
+
+#: Placeholder resolved to the program's mesh axis name at build time.
+AXIS = "@axis"
+
+PARTITION_RULES: Dict[str, Tuple[Tuple[str, Tuple[Any, ...]], ...]] = {
+    # grep DFA plane, batch variant: B shards across devices, every
+    # table leaf replicated (the post-shrink matrices are small
+    # relative to per-device memory — mesh_variant gates the flip)
+    "grep-batch": (
+        (r"^(trans_flat|class_maps|pair_maps|C|Ck|eol_cls|starts)$",
+         ()),
+    ),
+    # grep rule-sharded variant: each device holds 1/n of the rules —
+    # 2-D table leaves split on the rule axis, per-rule vectors too
+    "grep-rules": (
+        (r"^(trans_flat|class_maps|pair_maps)$", (AXIS, None)),
+        (r"^(C|Ck|eol_cls|starts)$", (AXIS,)),
+    ),
+    # flux sketch state leaves: replicated snapshots — every device
+    # absorbs its batch shard into a full local copy, merged by
+    # pmax (HLL union) / psum (count-min sum) inside the program
+    "flux-hll": ((r"^registers$", ()),),
+    "flux-cms": ((r"^table$", ()),),
+    # flux window/segment-count columns: batch-axis sharded inputs,
+    # replicated counts out of the psum merge
+    "flux-counts": ((r"^(seg|valid)$", (AXIS,)),),
+}
+
+
+def partition_rules(key: str, axis: str):
+    """The ``(regex, PartitionSpec)`` rows of one registry table with
+    the axis placeholder bound — what ``match_partition_rules`` and the
+    program builders consume. Unknown keys raise: a renamed table must
+    not silently build an unsharded program."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        rows = PARTITION_RULES[key]
+    except KeyError:
+        raise KeyError(
+            f"unknown partition-rule table {key!r}; known: "
+            f"{sorted(PARTITION_RULES)}") from None
+    return tuple(
+        (regex, P(*(axis if t == AXIS else t for t in tmpl)))
+        for regex, tmpl in rows
+    )
+
+
+def rule_spec(key: str, axis: str, name: str):
+    """The PartitionSpec a registry table assigns to the leaf ``name``
+    (first-match, same semantics as ``match_partition_rules``) — the
+    single-leaf convenience the flux kernel builders use."""
+    for regex, spec in partition_rules(key, axis):
+        if re.search(regex, name) is not None:
+            return spec
+    raise ValueError(
+        f"partition-rule table {key!r} has no rule for leaf {name!r}")
 
 
 def replicated_table_bytes(tables) -> int:
@@ -85,24 +157,47 @@ def named_tree_map(fn, tree, sep: str = "/"):
 
 
 def match_partition_rules(rules: Sequence[Tuple[str, Any]], tree,
-                          *, scalars_replicate: bool = True):
+                          *, scalars_replicate: bool = True,
+                          dead_rules: str = "raise"):
     """Pytree of arrays → pytree of PartitionSpec via first-match regex
     rules over leaf names. Scalars (0-d / size-1 leaves) replicate
     unconditionally — there is nothing to split. A leaf no rule covers
     raises: an unsharded table sneaking into a partitioned program is a
-    layout bug, not a default."""
+    layout bug, not a default.
+
+    A rule that fires on NO leaf across the whole pytree is equally a
+    layout bug — a renamed table leaf silently reverts to whatever the
+    later rules (or the unmatched-leaf error) decide while its spec
+    rots in the table. ``dead_rules`` controls the response: ``"raise"``
+    (default), ``"warn"``, or ``"ignore"`` (for rule tables shared by
+    programs whose pytrees are legitimate subsets, e.g. an optional
+    leaf). The fbtpu-speccheck lint rule ``shard-shadowed-rule`` makes
+    the same check statically, before anything traces."""
     from jax.sharding import PartitionSpec as P
+
+    used: set = set()
 
     def pick(name, leaf):
         shape = getattr(leaf, "shape", ())
         if scalars_replicate and (len(shape) == 0 or int(np.prod(shape)) == 1):
             return P()
-        for rule, spec in rules:
+        for i, (rule, spec) in enumerate(rules):
             if re.search(rule, name) is not None:
+                used.add(i)
                 return spec
         raise ValueError(f"no partition rule matches leaf {name!r}")
 
-    return named_tree_map(pick, tree)
+    out = named_tree_map(pick, tree)
+    if dead_rules != "ignore":
+        dead = [rules[i][0] for i in range(len(rules)) if i not in used]
+        if dead:
+            msg = (f"partition rule(s) matched no leaf: {dead!r} — "
+                   f"a renamed table leaf no longer picks up its spec "
+                   f"(dead_rules='ignore' if the subset is deliberate)")
+            if dead_rules == "raise":
+                raise ValueError(msg)
+            warnings.warn(msg, stacklevel=2)
+    return out
 
 
 def build_mesh(n_devices: Optional[int] = None, axis: str = "batch"):

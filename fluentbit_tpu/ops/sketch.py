@@ -388,9 +388,16 @@ def _mesh_key(mesh) -> tuple:
 
 
 def _pad_to_mesh(mesh, batch, lengths):
+    """Pad the batch axis up to the mesh size through the one shared
+    helper (``ops.mesh.pad_to_devices``) — the call fbtpu-speccheck
+    recognizes as discharging the B-divisibility obligation of the
+    sharded in_specs below. Pad rows carry length -1 (invalid), so they
+    contribute nothing to any sketch."""
+    from .mesh import pad_to_devices
+
     n_dev = mesh.devices.size
     B = batch.shape[0]
-    Bp = ((B + n_dev - 1) // n_dev) * n_dev
+    Bp = pad_to_devices(B, n_dev)
     if Bp != B:
         batch = np.concatenate(
             [batch, np.zeros((Bp - B, batch.shape[1]), dtype=batch.dtype)]
@@ -401,23 +408,44 @@ def _pad_to_mesh(mesh, batch, lengths):
     return batch, lengths
 
 
+def build_sharded_hll(hll: HyperLogLog, mesh):
+    """Compile the mesh HLL-update program: each device absorbs its
+    batch shard into a full local register set (the ``registers`` state
+    leaf rides the declarative ``flux-hll`` partition rule — an
+    explicit replicate, not the implicit fallback), merged with
+    lax.pmax (union of HLLs). Factored out of the dispatch wrapper so
+    the fbtpu-speccheck static==dynamic crosscheck can ``lower()`` the
+    exact shipped program on the simulated mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from .device import shard_map_fn
+    from .mesh import rule_spec
+
+    shard_map = shard_map_fn()
+    axis = mesh.axis_names[0]
+    regs_spec = rule_spec("flux-hll", axis, "registers")
+
+    def step(regs, b, ln):
+        local = hll._update_impl(regs, b, ln)
+        return lax.pmax(local, axis_name=axis)
+
+    return jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(regs_spec, P(axis, None), P(axis)),
+        out_specs=regs_spec,
+    ))
+
+
 def sharded_hll_registers(hll: HyperLogLog, mesh, batch: np.ndarray,
                           lengths: np.ndarray, registers=None):
     """Mesh update, WITHOUT committing or mutating any sketch state:
-    each device absorbs its batch shard into a local register set,
-    merged with lax.pmax (union of HLLs); returns the merged
+    runs the :func:`build_sharded_hll` program and returns the merged
     registers, computed from the explicit ``registers`` snapshot
     (default: the sketch's current set). The fbtpu-armor flux lane
     commits the result on the caller thread after the watched launch
     returns (see :meth:`HyperLogLog.device_registers`)."""
-    from jax.sharding import PartitionSpec as P
-
     from . import device
-    from .device import shard_map_fn
 
-    shard_map = shard_map_fn()
-
-    axis = mesh.axis_names[0]
     if not device.wait(max(60.0, device.default_wait())):
         raise RuntimeError(
             f"device backend not attached: {device.status()}"
@@ -430,15 +458,7 @@ def sharded_hll_registers(hll: HyperLogLog, mesh, batch: np.ndarray,
         cache = hll._sharded_cache = {}
     fn = cache.get(_mesh_key(mesh))
     if fn is None:
-        def step(regs, b, ln):
-            local = hll._update_impl(regs, b, ln)
-            return lax.pmax(local, axis_name=axis)
-
-        fn = jax.jit(shard_map(
-            step, mesh=mesh,
-            in_specs=(P(), P(axis, None), P(axis)),
-            out_specs=P(),
-        ))
+        fn = build_sharded_hll(hll, mesh)
         cache[_mesh_key(mesh)] = fn
     regs = hll.registers if registers is None else registers
     return fn(jnp.asarray(regs), jnp.asarray(batch),
@@ -453,21 +473,44 @@ def sharded_hll_update(hll: HyperLogLog, mesh, batch: np.ndarray,
     hll.registers = merged
 
 
+def build_sharded_cms(cms: CountMin, mesh):
+    """Compile the mesh count-min program: local scatter-adds over the
+    batch shard, psum merge (the ``table`` state leaf rides the
+    declarative ``flux-cms`` partition rule). Factored out of the
+    dispatch wrapper for the fbtpu-speccheck lowering crosscheck, like
+    :func:`build_sharded_hll`."""
+    from jax.sharding import PartitionSpec as P
+
+    from .device import shard_map_fn
+    from .mesh import rule_spec
+
+    shard_map = shard_map_fn()
+    axis = mesh.axis_names[0]
+    table_spec = rule_spec("flux-cms", axis, "table")
+
+    def step(table, b, ln, w):
+        # + 0*sum(w): ties the accumulator to the sharded batch so
+        # the fori_loop carry's varying annotation stays consistent
+        zero = jnp.zeros_like(table) + (0 * w.sum()).astype(table.dtype)
+        local = cms._update_impl(zero, b, ln, w)
+        return table + lax.psum(local, axis_name=axis)
+
+    return jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(table_spec, P(axis, None), P(axis), P(axis)),
+        out_specs=table_spec,
+    ))
+
+
 def sharded_cms_table(cms: CountMin, mesh, batch: np.ndarray,
                       lengths: np.ndarray, table=None):
     """Count-min over a mesh, WITHOUT committing or mutating any
-    sketch state: local scatter-adds, psum merge; returns the merged
-    table, computed from the explicit ``table`` snapshot
-    (snapshot-in/commit-on-finish protocol — see
+    sketch state: runs the :func:`build_sharded_cms` program and
+    returns the merged table, computed from the explicit ``table``
+    snapshot (snapshot-in/commit-on-finish protocol — see
     :func:`sharded_hll_registers`)."""
-    from jax.sharding import PartitionSpec as P
-
     from . import device
-    from .device import shard_map_fn
 
-    shard_map = shard_map_fn()
-
-    axis = mesh.axis_names[0]
     if not device.wait(max(60.0, device.default_wait())):
         raise RuntimeError(
             f"device backend not attached: {device.status()}"
@@ -479,18 +522,7 @@ def sharded_cms_table(cms: CountMin, mesh, batch: np.ndarray,
         cache = cms._sharded_cache = {}
     fn = cache.get(_mesh_key(mesh))
     if fn is None:
-        def step(table, b, ln, w):
-            # + 0*sum(w): ties the accumulator to the sharded batch so
-            # the fori_loop carry's varying annotation stays consistent
-            zero = jnp.zeros_like(table) + (0 * w.sum()).astype(table.dtype)
-            local = cms._update_impl(zero, b, ln, w)
-            return table + lax.psum(local, axis_name=axis)
-
-        fn = jax.jit(shard_map(
-            step, mesh=mesh,
-            in_specs=(P(), P(axis, None), P(axis), P(axis)),
-            out_specs=P(),
-        ))
+        fn = build_sharded_cms(cms, mesh)
         cache[_mesh_key(mesh)] = fn
     tbl = cms.table if table is None else table
     return fn(jnp.asarray(tbl, dtype=cms._dtype), jnp.asarray(batch),

@@ -80,6 +80,9 @@ def test_list_rules():
                  "device-multi-launch-chain", "device-undonated-buffer",
                  "device-host-roundtrip", "device-sync-in-staging-loop",
                  "stage-redundant-copy",
+                 "shard-unmatched-leaf", "shard-shadowed-rule",
+                 "shard-indivisible-axis", "donation-aval-mismatch",
+                 "shard-implicit-reshard", "jit-dynamic-shape-retrace",
                  "codec-balance", "codec-bounds", "codec-leak"):
         assert name in proc.stdout
 
