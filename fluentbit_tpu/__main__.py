@@ -238,6 +238,36 @@ def main(argv=None) -> int:
                         override, len(slots))
                     override = None
                     reload_argv = argv
+            if not override and ctx.engine.service.hot_reload_diff:
+                # diff-mode reload (core/reload_diff.py): apply only
+                # the file's delta through one ReloadTxn generation
+                # swap — untouched inputs keep tail offsets / sockets,
+                # in-flight chunks drain normally. Anything the
+                # transaction model can't express falls through to
+                # the validated full-restart path below.
+                from fluentbit_tpu.core.reload_diff import (
+                    ReloadDiffUnsupported, reload_from_file)
+
+                try:
+                    gen, _summary = reload_from_file(
+                        ctx.engine, config_path, env=env)
+                except ReloadDiffUnsupported as e:
+                    log.info("reload diff: %s; falling back to full "
+                             "restart", e)
+                except Exception as e:  # noqa: BLE001
+                    log.error("reload diff failed (%s); falling back "
+                              "to full restart", e)
+                else:
+                    if gen is not None:
+                        log.info("configuration reloaded in place "
+                                 "(generation %d)", gen)
+                    # keep the local counter in sync: the txn bumps
+                    # engine.reload_count itself, and a LATER full
+                    # restart seeds the new engine from `reloads`
+                    reloads = ctx.engine.reload_count
+                    reload_req.clear()
+                    stop_evt.clear()
+                    continue  # old engine still running, now current
             log.info("reloading configuration %s", override or config_path)
             reload_req.clear()
             stop_evt.clear()

@@ -177,6 +177,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .dtype import DtypeNarrowingRule
     from .launchgraph import LaunchGraphRules
     from .locks import AwaitUnderLockRule, GuardedByRule
+    from .locksmith import LocksmithRules
     from .purity import JaxPurityRules
     from .qos import UnmeteredIngestRule
     from .shrink import UnminimizedDfaRule
@@ -197,6 +198,7 @@ def _build_rules(guards=None) -> List[Rule]:
         UnminimizedDfaRule(),
         LaunchGraphRules(),
         SpecCheckRules(),
+        LocksmithRules(guards),
     ]
 
 

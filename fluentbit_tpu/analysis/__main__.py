@@ -153,6 +153,70 @@ def _write_budget() -> str:
     return path
 
 
+def _lock_findings(current_findings):
+    """The fbtpu-locksmith ``--all`` leg: cross-module lock-order
+    cycles from the whole-program graph (the per-module rule pass only
+    sees intra-module cycles), a missing committed baseline, and stale
+    baseline entries (debt that no longer exists must leave the file —
+    a stale key could otherwise mask a future regression with the same
+    message)."""
+    from .locksmith import LocksmithRules, graph_cycle_findings
+    from .registry import lock_baseline_path
+
+    lpath = lock_baseline_path()
+    rel = _canon(lpath)
+    findings = list(graph_cycle_findings())
+    if not os.path.isfile(lpath):
+        return findings + [Finding(
+            rel, 1, 0, "lock-baseline-stale",
+            "analysis/lock_baseline.json is missing: the concurrency "
+            "gate has no baseline — regenerate it with "
+            "--write-lock-baseline", "error")]
+    keys = _load_baseline(lpath)
+    names = set(LocksmithRules.RULE_NAMES)
+    live = {(_canon(f.path), f.rule, f.message)
+            for f in list(current_findings) + findings
+            if f.rule in names}
+    for key in sorted(keys - live):
+        findings.append(Finding(
+            rel, 1, 0, "lock-baseline-stale",
+            f"baseline entry no longer matches any finding (fixed "
+            f"debt? remove it): {key[1]} @ {key[0]}: {key[2]}",
+            "warning"))
+    return findings
+
+
+def _write_lock_baseline() -> str:
+    """Regenerate analysis/lock_baseline.json: the locksmith rule
+    findings on the shipped tree (justified debt, see ANALYSIS.md)
+    plus the order-graph node/edge counts the tests pin."""
+    from .locksmith import LocksmithRules, build_lock_graph, \
+        graph_cycle_findings
+    from .registry import lock_baseline_path
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    names = set(LocksmithRules.RULE_NAMES)
+    findings = [f for f in lint_paths([pkg]) if f.rule in names]
+    findings.extend(graph_cycle_findings())
+    graph = build_lock_graph()
+    payload = {
+        "version": 1,
+        "findings": [
+            {"path": _canon(f.path), "rule": f.rule,
+             "message": f.message, "severity": f.severity}
+            for f in findings
+        ],
+        "graph": {"nodes": len(graph["nodes"]),
+                  "edges": len(graph["edges"]),
+                  "cycles": len(graph["cycles"])},
+    }
+    path = lock_baseline_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
 def _write_baseline(path: str, findings) -> None:
     payload = {
         "version": 1,
@@ -187,10 +251,13 @@ def main(argv=None) -> int:
     ap.add_argument("--changed", action="store_true",
                     help="lint only the .py files changed vs HEAD "
                          "(fast pre-commit; Python rules only)")
-    ap.add_argument("--graph", metavar="MODE", choices=("json", "dot"),
+    ap.add_argument("--graph", metavar="MODE",
+                    choices=("json", "dot", "lock", "lock-dot"),
                     help="emit the fbtpu-xray device launch graph "
                          "(json: graph + budget snapshot + regression "
-                         "diff; dot: graphviz) and exit")
+                         "diff; dot: graphviz) or the fbtpu-locksmith "
+                         "lock acquisition-order graph (lock: json; "
+                         "lock-dot: graphviz) and exit")
     ap.add_argument("--baseline", metavar="FILE",
                     help="subtract findings recorded in FILE; exit 0 "
                          "when nothing new")
@@ -199,6 +266,9 @@ def main(argv=None) -> int:
     ap.add_argument("--write-budget", action="store_true",
                     help="regenerate analysis/launch_budget.json and "
                          "exit")
+    ap.add_argument("--write-lock-baseline", action="store_true",
+                    help="regenerate analysis/lock_baseline.json and "
+                         "exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule set and exit")
     args = ap.parse_args(argv)
@@ -206,11 +276,15 @@ def main(argv=None) -> int:
     if args.list_rules:
         from .batch import BatchExactnessRules
         from .launchgraph import LaunchGraphRules
+        from .locksmith import LocksmithRules
         from .native_gate import NATIVE_RULES
         from .speccheck import SpecCheckRules
 
         for r in RULES:
-            if isinstance(r, BatchExactnessRules):
+            if isinstance(r, LocksmithRules):
+                for n in r.RULE_NAMES:
+                    print(f"{n}: (locksmith pack) {r.description}")
+            elif isinstance(r, BatchExactnessRules):
                 for n in r.RULE_NAMES:
                     print(f"{n}: (batch-exactness pack) {r.description}")
             elif isinstance(r, LaunchGraphRules):
@@ -228,6 +302,16 @@ def main(argv=None) -> int:
         for n in NATIVE_RULES:
             print(f"{n}: native C gate (analysis.native_gate; "
                   f"--all/--native)")
+        return 0
+
+    if args.graph in ("lock", "lock-dot"):
+        from .locksmith import build_lock_graph, lock_graph_to_dot
+
+        lgraph = build_lock_graph()
+        if args.graph == "lock-dot":
+            print(lock_graph_to_dot(lgraph))
+        else:
+            print(json.dumps(lgraph, indent=2, sort_keys=True))
         return 0
 
     if args.graph:
@@ -255,6 +339,11 @@ def main(argv=None) -> int:
     if args.write_budget:
         path = _write_budget()
         print(f"fbtpu-lint: launch/transfer budget written to {path}")
+        return 0
+
+    if args.write_lock_baseline:
+        path = _write_lock_baseline()
+        print(f"fbtpu-lint: lock baseline written to {path}")
         return 0
 
     findings: list = []
@@ -292,6 +381,7 @@ def main(argv=None) -> int:
         bf, bnotes = _budget_findings()
         findings.extend(bf)
         notes = list(notes) + list(bnotes)
+        findings.extend(_lock_findings(findings))
 
     if args.write_baseline:
         _write_baseline(args.write_baseline, findings)
@@ -312,11 +402,15 @@ def main(argv=None) -> int:
         # the committed launch/transfer budget is an implicit baseline:
         # its recorded findings are ROADMAP item 1's known debt, gated
         # by the budget numbers rather than re-reported on every run
-        from .registry import budget_path
+        # (the lock baseline plays the same role for the locksmith
+        # pack — stale entries surface as lock-baseline-stale in --all)
+        from .registry import budget_path, lock_baseline_path
 
-        if os.path.isfile(budget_path()):
-            keys = _load_baseline(budget_path())
-            findings, baselined = _subtract(findings, keys)
+        for bpath in (budget_path(), lock_baseline_path()):
+            if os.path.isfile(bpath):
+                keys = _load_baseline(bpath)
+                findings, hit = _subtract(findings, keys)
+                baselined += hit
 
     if args.as_json:
         if args.run_all or args.native_only:

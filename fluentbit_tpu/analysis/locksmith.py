@@ -1,0 +1,1286 @@
+"""fbtpu-locksmith: interprocedural lock-order & lockset analyzer for
+the threaded control plane.
+
+The paper's engine is one event loop; production growth added a
+threaded control plane — collector threads and library callers append
+under ``Engine._ingest_lock``, reload transactions serialize on
+``_reload_lock``, the guard watchdog and QoS dispatch take their own
+plane locks, DeviceLane workers and the fault registry run on worker
+threads.  PR 7 needed six review rounds of hand-found races
+(stop-vs-commit, retired-output reap, COW list swaps) to converge; this
+pack catches that bug class mechanically at ``--all`` time.
+
+Two cooperating analyses, both walking calls interprocedurally with
+the same summary-fixpoint machinery:
+
+**Lock acquisition-order graph.**  Every ``with <lock>:`` /
+``.acquire()`` site contributes a node (a *canonical* lock id such as
+``Engine._ingest_lock`` or ``device._lock`` — the same strings
+``core.lockorder.make_lock`` records, so the tier-1 witness crosscheck
+joins the static and dynamic worlds on them).  A site executed while
+other locks are held contributes ``held -> acquired`` edges; calls
+propagate the transitive acquire-set of the callee into the caller's
+held context.  Cycles are reported as ``lock-order-cycle`` with a
+witness site per edge.  Calls that cross the plugin boundary
+(``self.plugin.*`` callbacks, ``sp.do``) cannot be resolved
+name-by-name, so they contribute a declared *effect set* — the locks
+any plugin callback may take (``PLUGIN_EFFECT``); metric instrument
+calls (``self.m_*.inc``) contribute ``MetricsRegistry._lock``.
+
+**Eraser-style lockset pass** against the guarded-by registry
+(analysis/registry.py).  The lexical rule (analysis/locks.py) already
+enforces ``with <lock>:`` around plain attribute *stores* and *reads*;
+its blind spot is mutations that present the attribute in ``Load``
+context — ``x.attr.pop(...)``, ``x.attr[k] = v``, ``del x.attr[k]`` —
+which is exactly where ``writes_only`` entries leak.  Locksmith owns
+that layer: ``guarded-field-unlocked`` fires on a Load-context
+mutation of a registered ``writes_only`` field when the owning lock is
+provably not held — neither lexically nor on every interprocedural
+path into the function (a must-hold entry-lockset fixpoint over
+observed call sites).  ``guarded-by-missing`` is the registry-gap
+detector: a field mutated from ≥2 functions with *inconsistent*
+locking (the classic Eraser signal: lockset intersection empty while
+some site did lock) and no registry entry; its ``global`` arm flags a
+module-level cache rebound via ``global`` in a module that owns a lock
+but never registered the cache.  ``atomicity-check-then-act`` finds
+the PR-7 stop/commit race shape: a guarded read whose lock is released
+and re-acquired around a dependent write.  ``lock-held-across-dispatch``
+extends PR 1's await-under-lock to the device/flush boundary: an
+engine lock held (directly or through resolved calls) across a
+DeviceLane launch or an output flush.  ``cow-swap-aliasing`` enforces
+the copy-on-write discipline on the engine instance lists: readers
+iterate ``engine.inputs``/``filters``/``outputs`` lock-free, so the
+lists are replaced, never mutated in place.
+
+Suppress any rule with ``# fbtpu-lint: allow(<rule>)`` + justification
+(``guarded-field-unlocked`` also honors ``allow(guarded-by)`` — same
+contract, different layer).  Shipped debt gates through the committed
+``analysis/lock_baseline.json`` (the PR-3 ``(path, rule, message)``
+key scheme); every entry is justified in ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from . import Finding, Module, Rule
+from .registry import GUARDS, GuardEntry
+
+__all__ = [
+    "LocksmithRules", "build_lock_graph", "lock_graph_to_dot",
+    "static_order_edges", "graph_cycle_findings", "collect_modules",
+]
+
+#: Planes the order graph is built over (the threaded control plane:
+#: engine/guard/qos/scheduler, the device attach controller and the
+#: fault domain).  codec/ and native/ loader locks are deliberately
+#: out of scope: leaf double-checked singletons, never nested.
+ORDER_SCOPES = ("fluentbit_tpu/core/", "fluentbit_tpu/ops/",
+                "fluentbit_tpu/flux/")
+
+#: The lockset pass additionally covers the analyzer's own caches.
+LOCKSET_SCOPES = ORDER_SCOPES + ("fluentbit_tpu/analysis/",)
+
+#: Canonical lock ids constructed reentrant (RLock): a self-edge
+#: through these is a re-entry, not a deadlock.
+REENTRANT = frozenset({
+    "Engine._ingest_lock", "InputInstance.ingest_lock",
+    "MetricsRegistry._lock",
+})
+
+#: Lock attribute names unique to one home class/module: resolves
+#: ``engine._ingest_lock`` / ``ins.ingest_lock`` seen from any module
+#: without needing the receiver.  Keep in sync with the
+#: ``core.lockorder.make_lock`` construction names — the tier-1
+#: witness crosscheck fails on drift.
+LOCK_HOMES = {
+    "_ingest_lock": "Engine",
+    "_reload_lock": "Engine",
+    "_event_queue_lock": "Engine",
+    "ingest_lock": "InputInstance",
+    "_registry_lock": "fault",
+    "_listener_lock": "fault",
+}
+
+#: Receiver variable name -> class, for ``<recv>._lock`` and
+#: ``<recv>.method()`` resolution (the tree's naming conventions).
+RECEIVER_CLASSES = {
+    "engine": "Engine", "guard": "Guard", "qos": "Qos",
+    "br": "CircuitBreaker", "breaker": "CircuitBreaker",
+    "bucket": "TokenBucket", "lane": "DeviceLane",
+    "metrics": "MetricsRegistry", "registry": "MetricsRegistry",
+    "ins": "InputInstance", "src": "InputInstance",
+    "inp": "InputInstance", "out": "OutputInstance",
+}
+
+#: Classes whose ``self._lock`` IS another class's lock (the metric
+#: instruments share ``registry._lock``, core/metrics.py).
+CLASS_CANON = {
+    "_Metric": "MetricsRegistry", "Counter": "MetricsRegistry",
+    "Gauge": "MetricsRegistry", "Histogram": "MetricsRegistry",
+}
+
+#: In-place mutator method names (present the receiver in Load ctx —
+#: the lexical rule's blind spot).
+MUTATORS = frozenset({
+    "append", "extend", "add", "remove", "discard", "pop", "popleft",
+    "clear", "update", "setdefault", "insert", "appendleft",
+})
+
+#: Locks a plugin callback (pause/resume/flush/cb_collect, ``sp.do``)
+#: may transitively take.  Deliberately EXCLUDES ``Engine._ingest_lock``:
+#: plugin callbacks never re-enter the engine append path holding it
+#: (the parallel raw path takes only the input's own lock).
+PLUGIN_EFFECT = frozenset({
+    "InputInstance.ingest_lock", "Qos._lock", "TokenBucket._lock",
+    "MetricsRegistry._lock", "DeviceLane._lock", "CircuitBreaker._lock",
+    "fault._listener_lock", "fault._registry_lock", "device._lock",
+})
+
+#: ``self.m_*.inc/set/observe/set_max`` -> the metrics registry lock.
+METRIC_TERMINALS = frozenset({"inc", "set", "observe", "set_max"})
+METRIC_EFFECT = frozenset({"MetricsRegistry._lock"})
+
+#: Engine locks that must never be held across a device dispatch or
+#: an output flush (the watched-worker handoff can block on a device).
+ENGINE_DISPATCH_LOCKS = frozenset({"Engine._ingest_lock",
+                                   "Engine._reload_lock"})
+
+#: COW instance lists: replaced, never mutated in place.  ``self.*``
+#: counts only inside the classes that own the live lists (the plugin
+#: Registry's same-named dicts are import-time state, not COW).
+COW_ATTRS = frozenset({"inputs", "filters", "outputs"})
+COW_SELF_CLASSES = frozenset({"Engine", "ReloadTxn"})
+
+_SEVERITY = {
+    "lock-order-cycle": "error",
+    "guarded-field-unlocked": "error",
+    "guarded-by-missing": "warning",
+    "atomicity-check-then-act": "warning",
+    "lock-held-across-dispatch": "warning",
+    "cow-swap-aliasing": "error",
+}
+
+_CTOR_NAMES = frozenset({"__init__", "__new__"})
+
+
+def _canon_path(path: str) -> str:
+    p = path.replace(os.sep, "/")
+    i = p.rfind("fluentbit_tpu/")
+    return p[i:] if i >= 0 else p
+
+
+def _module_stem(path: str) -> str:
+    p = _canon_path(path)
+    base = os.path.basename(p)
+    if base == "__init__.py":
+        parent = os.path.dirname(p)
+        return os.path.basename(parent) or "module"
+    return base[:-3] if base.endswith(".py") else base
+
+
+def _chain_names(node: ast.AST) -> List[str]:
+    """Names along an Attribute/Call chain, root first:
+    ``self.qos.admit(x)`` -> ``["self", "qos", "admit"]``."""
+    names: List[str] = []
+    while True:
+        if isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Name):
+            names.append(node.id)
+            break
+        else:
+            break
+    return list(reversed(names))
+
+
+def _walk_no_nested(body: List[ast.stmt]):
+    """Walk statements/expressions without descending into nested
+    function/lambda bodies (those get their own scope/scan)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                stack.append(child)
+
+
+def _terminal(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class _WithRec:
+    """One ``with <lock>:`` block, for the check-then-act pairing."""
+
+    __slots__ = ("locks", "line", "end_line", "loads", "stores",
+                 "bound", "refs")
+
+    def __init__(self, locks: FrozenSet[str], line: int, end_line: int):
+        self.locks = locks
+        self.line = line
+        self.end_line = end_line
+        self.loads: Set[str] = set()     # registered attrs read
+        self.stores: Set[str] = set()    # registered attrs written/mutated
+        self.bound: Set[str] = set()     # local names assigned inside
+        self.refs: Set[str] = set()      # local names read inside
+
+
+class _FnInfo:
+    """Per-function summary: everything the fixpoints consume."""
+
+    __slots__ = ("key", "mod", "cls", "name", "is_ctor", "lineno",
+                 "acquires", "edges", "calls", "dispatches", "mutations",
+                 "withrecs", "global_decls", "exit_lines")
+
+    def __init__(self, key, mod, cls, name, lineno):
+        self.key = key
+        self.mod = mod
+        self.cls = cls                       # canonical class or None
+        self.name = name
+        self.is_ctor = name in _CTOR_NAMES
+        self.lineno = lineno
+        #: canonical locks acquired directly in this body
+        self.acquires: Set[str] = set()
+        #: (held_lock, acquired_lock, line) — direct nesting
+        self.edges: List[Tuple[str, str, int]] = []
+        #: (callee_ref, frozenset(held), line); refs are
+        #: ("local", key) / ("method", cls, name) / ("func", name) /
+        #: ("effect", frozenset(locks), label)
+        self.calls: List[Tuple[tuple, FrozenSet[str], int]] = []
+        #: (line, frozenset(held), what) — lane launch / output flush
+        self.dispatches: List[Tuple[int, FrozenSet[str], str]] = []
+        #: (mutkind, scope, attr, recv_root, line, frozenset(held))
+        #: mutkind: "store" (lexical rule's territory) | "loadmut"
+        self.mutations: List[
+            Tuple[str, str, str, str, int, FrozenSet[str]]] = []
+        self.withrecs: List[_WithRec] = []
+        self.global_decls: Set[str] = set()
+        #: lines of return/raise statements (an exit between two with
+        #: blocks means they sit in alternative branches, not in a
+        #: released-and-reacquired sequence)
+        self.exit_lines: Set[int] = set()
+
+
+class _ModInfo:
+    __slots__ = ("module", "canon", "stem", "tree", "fns", "classes",
+                 "funcs", "has_lock_with", "top_lock_globals",
+                 "top_globals", "registered")
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.canon = _canon_path(module.path)
+        self.stem = _module_stem(module.path)
+        self.tree = ast.parse(module.source)
+        self.fns: Dict[tuple, _FnInfo] = {}
+        self.classes: Dict[str, Dict[str, tuple]] = {}
+        self.funcs: Dict[str, tuple] = {}
+        self.has_lock_with = False
+        #: module-level names bound to a lock CONSTRUCTION
+        #: (threading.Lock()/RLock()/make_lock(...))
+        self.top_lock_globals: Set[str] = set()
+        #: every module-level bound name (the global-arm universe —
+        #: a bare-name mutation inside a function is a *global*
+        #: mutation only if the name actually lives at module level)
+        self.top_globals: Set[str] = set()
+        self.registered: Set[str] = set()
+        for stmt in self.tree.body:
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target] if isinstance(stmt, ast.AnnAssign) \
+                else []
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                self.top_globals.add(t.id)
+                v = stmt.value
+                if isinstance(v, ast.Call) and _terminal(v.func) \
+                        in ("Lock", "RLock", "make_lock"):
+                    self.top_lock_globals.add(t.id)
+
+
+class _FnScan:
+    """Lexical walk of one function body: tracks the held lock set
+    through ``with`` nesting, records acquisition edges, call sites
+    with held context, dispatch sites, and mutations."""
+
+    def __init__(self, analyzer: "_Analyzer", mod: _ModInfo,
+                 info: _FnInfo, aliases: Dict[str, FrozenSet[str]],
+                 plugin_aliases: Set[str], local_defs: Dict[str, tuple]):
+        self.a = analyzer
+        self.mod = mod
+        self.info = info
+        self.aliases = dict(aliases)
+        self.plugin_aliases = set(plugin_aliases)
+        self.local_defs = dict(local_defs)
+        self.local_names: Set[str] = set()
+
+    # -- lock canonicalization ----------------------------------------
+
+    def canon_lock(self, expr: ast.AST) -> FrozenSet[str]:
+        if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+            attr = expr.attr
+            if attr in LOCK_HOMES:
+                return frozenset({f"{LOCK_HOMES[attr]}.{attr}"})
+            recv = _chain_names(expr.value)
+            if recv:
+                t = recv[-1]
+                if t == "self" and self.info.cls:
+                    return frozenset({f"{self.info.cls}.{attr}"})
+                if t in RECEIVER_CLASSES:
+                    return frozenset({f"{RECEIVER_CLASSES[t]}.{attr}"})
+            return frozenset()
+        if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+            if expr.id in self.aliases:
+                return self.aliases[expr.id]
+            if expr.id in LOCK_HOMES:
+                return frozenset({f"{LOCK_HOMES[expr.id]}.{expr.id}"})
+            if expr.id.startswith("_"):
+                return frozenset({f"{self.mod.stem}.{expr.id}"})
+        return frozenset()
+
+    def _lock_refs_in(self, expr: ast.AST) -> FrozenSet[str]:
+        out: Set[str] = set()
+        for n in ast.walk(expr):
+            if isinstance(n, (ast.Attribute, ast.Name)):
+                out |= self.canon_lock(n)
+        return frozenset(out)
+
+    # -- prepasses -----------------------------------------------------
+
+    def prepass(self, body: List[ast.stmt]) -> None:
+        """Alias + plugin-alias discovery (function-scoped, flow
+        insensitive: an if/else alias carries both candidates)."""
+        for node in _walk_no_nested(body):
+            if isinstance(node, ast.Global):
+                self.info.global_decls.update(node.names)
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if not targets:
+                continue
+            refs = self._lock_refs_in(node.value)
+            if refs:
+                for t in targets:
+                    self.aliases[t] = self.aliases.get(
+                        t, frozenset()) | refs
+            chain = {n for sub in ast.walk(node.value)
+                     for n in ([sub.attr] if isinstance(sub, ast.Attribute)
+                               else [sub.id] if isinstance(sub, ast.Name)
+                               else [])}
+            if "plugin" in chain:
+                self.plugin_aliases.update(targets)
+        # plain-name Store targets (locals unless declared global)
+        for node in _walk_no_nested(body):
+            if isinstance(node, ast.Name) and \
+                    not isinstance(node.ctx, ast.Load) and \
+                    node.id not in self.info.global_decls:
+                self.local_names.add(node.id)
+
+    # -- statement walk ------------------------------------------------
+
+    def run(self, body: List[ast.stmt]) -> None:
+        self.prepass(body)
+        self._stmts(body, frozenset())
+
+    def _stmts(self, body: List[ast.stmt], held: FrozenSet[str]) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: FrozenSet[str]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            locks: Set[str] = set()
+            for item in stmt.items:
+                self._expr(item.context_expr, new_held)
+                canon = self.canon_lock(item.context_expr)
+                if canon:
+                    self.mod.has_lock_with = True
+                    self.info.acquires |= canon
+                    for h in new_held:
+                        for b in canon:
+                            if b != h:
+                                self.info.edges.append(
+                                    (h, b, stmt.lineno))
+                            elif b not in REENTRANT:
+                                self.a.self_deadlocks.append(
+                                    (self.mod, stmt.lineno, b,
+                                     f"{self.info.name}()"))
+                    new_held = new_held | canon
+                    locks |= canon
+            if locks:
+                rec = _WithRec(frozenset(locks), stmt.lineno,
+                               getattr(stmt, "end_lineno", stmt.lineno))
+                self._fill_withrec(rec, stmt.body)
+                self.info.withrecs.append(rec)
+            self._stmts(stmt.body, new_held)
+        elif isinstance(stmt, ast.If):
+            self._expr(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, held)
+            self._target(stmt.target, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.While):
+            self._expr(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self._stmts(h.body, held)
+            self._stmts(stmt.orelse, held)
+            self._stmts(stmt.finalbody, held)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.a.scan_function(
+                self.mod, stmt, self.info.cls,
+                qual=f"{self.info.name}.{stmt.name}",
+                aliases=self.aliases,
+                plugin_aliases=self.plugin_aliases)
+            self.local_defs[stmt.name] = (
+                self.mod.canon, self.info.cls,
+                f"{self.info.name}.{stmt.name}")
+        elif isinstance(stmt, ast.Assign):
+            self._expr(stmt.value, held)
+            for t in stmt.targets:
+                self._target(t, held)
+        elif isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, held)
+            self._target(stmt.target, held)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+            self._target(stmt.target, held)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._target(t, held, deleting=True)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if isinstance(stmt, ast.Return):
+                self.info.exit_lines.add(stmt.lineno)
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+        elif isinstance(stmt, ast.Assert):
+            self._expr(stmt.test, held)
+        elif isinstance(stmt, ast.Raise):
+            self.info.exit_lines.add(stmt.lineno)
+            if stmt.exc is not None:
+                self._expr(stmt.exc, held)
+        elif isinstance(stmt, ast.Global):
+            self.info.global_decls.update(stmt.names)
+        # Pass/Break/Continue/Import/Nonlocal: nothing to track
+
+    def _target(self, t: ast.AST, held: FrozenSet[str],
+                deleting: bool = False) -> None:
+        """Assignment/del target: classify the mutation."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, held, deleting)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value, held, deleting)
+        elif isinstance(t, ast.Attribute):
+            recv = _chain_names(t.value)
+            root = recv[-1] if recv else ""
+            self._mutation("store", "attr", t.attr, root,
+                           t.lineno, held)
+        elif isinstance(t, ast.Subscript):
+            self._expr(t.slice, held)
+            base = t.value
+            if isinstance(base, ast.Attribute):
+                recv = _chain_names(base.value)
+                self._mutation("loadmut", "attr", base.attr,
+                               recv[-1] if recv else "",
+                               t.lineno, held)
+            elif isinstance(base, ast.Name) and self._is_global(base.id):
+                self._mutation("loadmut", "global", base.id, "",
+                               t.lineno, held)
+            else:
+                self._expr(base, held)
+        elif isinstance(t, ast.Name):
+            if t.id in self.info.global_decls:
+                self._mutation("store", "global", t.id, "",
+                               t.lineno, held)
+
+    def _is_global(self, name: str) -> bool:
+        """A bare-name mutation is a *module-global* mutation only if
+        the name is declared ``global`` here or bound at module level
+        (locals shadow: a local rebinding hides the module name)."""
+        return name in self.info.global_decls or (
+            name in self.mod.top_globals and
+            name not in self.local_names)
+
+    def _mutation(self, mutkind: str, scope: str, name: str,
+                  recv_root: str, line: int,
+                  held: FrozenSet[str]) -> None:
+        self.info.mutations.append(
+            (mutkind, scope, name, recv_root, line, held))
+
+    def _expr(self, expr: ast.AST, held: FrozenSet[str]) -> None:
+        """Expression walk: record call sites / dispatches / mutator
+        calls with the current held set."""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            t = _terminal(node.func)
+            if t is None:
+                continue
+            chain = _chain_names(node.func)
+            line = node.lineno
+            if t == "acquire" and isinstance(node.func, ast.Attribute):
+                canon = self.canon_lock(node.func.value)
+                if canon:
+                    self.info.acquires |= canon
+                    for h in held:
+                        for b in canon:
+                            if b != h:
+                                self.info.edges.append((h, b, line))
+                            elif b not in REENTRANT:
+                                self.a.self_deadlocks.append(
+                                    (self.mod, line, b,
+                                     f"{self.info.name}()"))
+                    continue
+            # dispatch boundary: DeviceLane launch / output flush
+            if t in ("run", "begin") and any(
+                    "lane" in n.lower() for n in chain[:-1]):
+                self.info.dispatches.append((line, held, f"lane.{t}"))
+            elif t == "flush" and any(
+                    n == "out" or n.startswith("out")
+                    for n in chain[:-1]):
+                self.info.dispatches.append((line, held, "output.flush"))
+            # mutator-method call: x.attr.pop(...) — Load-ctx mutation
+            if t in MUTATORS and isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                if isinstance(base, ast.Attribute):
+                    recv = _chain_names(base.value)
+                    self._mutation("loadmut", "attr", base.attr,
+                                   recv[-1] if recv else "",
+                                   line, held)
+                elif isinstance(base, ast.Name) and \
+                        self._is_global(base.id):
+                    self._mutation("loadmut", "global", base.id, "",
+                                   line, held)
+            # callee resolution
+            ref = self._callee_ref(node.func, chain)
+            if ref is not None:
+                self.info.calls.append((ref, held, line))
+
+    def _callee_ref(self, func: ast.AST,
+                    chain: List[str]) -> Optional[tuple]:
+        t = _terminal(func)
+        # metric instruments: self.m_foo.inc(...) et al.
+        if t in METRIC_TERMINALS and any(
+                n.startswith("m_") for n in chain[:-1]):
+            return ("effect", METRIC_EFFECT, "metric")
+        # plugin boundary: unresolvable by name -> declared effect set
+        if len(chain) > 1 and (
+                "plugin" in chain[:-1] or "sp" in chain[:-1]
+                or chain[0] in self.plugin_aliases):
+            return ("effect", PLUGIN_EFFECT, "plugin")
+        if isinstance(func, ast.Name):
+            if func.id in self.local_defs:
+                return ("local", self.local_defs[func.id])
+            if func.id in self.plugin_aliases:
+                return ("effect", PLUGIN_EFFECT, "plugin")
+            return ("func", func.id)
+        if isinstance(func, ast.Attribute) and len(chain) >= 2:
+            prev = chain[-2]
+            if prev == "self" and self.info.cls:
+                return ("method", self.info.cls, t)
+            if prev in RECEIVER_CLASSES:
+                return ("method", RECEIVER_CLASSES[prev], t)
+        return None
+
+    # -- check-then-act bookkeeping -----------------------------------
+
+    def _fill_withrec(self, rec: _WithRec,
+                      body: List[ast.stmt]) -> None:
+        registered = self.mod.registered
+        wrapper = ast.Module(body=body, type_ignores=[])
+        for node in ast.walk(wrapper):
+            if isinstance(node, ast.Attribute):
+                if node.attr in registered:
+                    if isinstance(node.ctx, ast.Load):
+                        rec.loads.add(node.attr)
+                    else:
+                        rec.stores.add(node.attr)
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    rec.refs.add(node.id)
+                else:
+                    rec.bound.add(node.id)
+            elif isinstance(node, ast.Call):
+                t = _terminal(node.func)
+                if t in MUTATORS and isinstance(node.func, ast.Attribute):
+                    base = node.func.value
+                    if isinstance(base, ast.Attribute) and \
+                            base.attr in registered:
+                        rec.stores.add(base.attr)
+            elif isinstance(node, ast.Subscript) and \
+                    not isinstance(node.ctx, ast.Load):
+                if isinstance(node.value, ast.Attribute) and \
+                        node.value.attr in registered:
+                    rec.stores.add(node.value.attr)
+
+
+class _Analyzer:
+    """Whole-program (or single-module) lock analysis over a module
+    set: builds per-function summaries, runs the acquire-set /
+    dispatch / must-hold fixpoints, generates the order graph, and
+    emits findings."""
+
+    def __init__(self, modules: Iterable[Module],
+                 guards: Tuple[GuardEntry, ...] = GUARDS):
+        self.guards = guards
+        self.mods: List[_ModInfo] = []
+        self.fns: Dict[tuple, _FnInfo] = {}
+        #: canonical class name -> {method -> fn key}
+        self.class_index: Dict[str, Dict[str, tuple]] = {}
+        self.self_deadlocks: List[Tuple[_ModInfo, int, str, str]] = []
+        for m in modules:
+            mi = _ModInfo(m)
+            mi.registered = {
+                a for e in guards if mi.canon.endswith(e.module)
+                for a in e.attrs
+            }
+            self.mods.append(mi)
+            self._scan_module(mi)
+        self._fix_acquires()
+        self._fix_dispatches()
+        self._fix_must_entry()
+        self._find_call_self_deadlocks()
+
+    # -- scanning ------------------------------------------------------
+
+    def _scan_module(self, mi: _ModInfo) -> None:
+        for stmt in mi.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = self.scan_function(mi, stmt, None, stmt.name)
+                mi.funcs[stmt.name] = key
+            elif isinstance(stmt, ast.ClassDef):
+                canon = CLASS_CANON.get(stmt.name, stmt.name)
+                methods = self.class_index.setdefault(canon, {})
+                mi.classes.setdefault(canon, {})
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        key = self.scan_function(
+                            mi, sub, canon, f"{stmt.name}.{sub.name}")
+                        methods[sub.name] = key
+                        mi.classes[canon][sub.name] = key
+
+    def scan_function(self, mi: _ModInfo, node, cls: Optional[str],
+                      qual: str, aliases=None,
+                      plugin_aliases=None) -> tuple:
+        key = (mi.canon, cls, qual)
+        info = _FnInfo(key, mi, cls, node.name, node.lineno)
+        mi.fns[key] = info
+        self.fns[key] = info
+        scan = _FnScan(self, mi, info, aliases or {},
+                       plugin_aliases or set(), {})
+        args = node.args
+        for a in (list(getattr(args, "posonlyargs", [])) + args.args
+                  + args.kwonlyargs + [args.vararg, args.kwarg]):
+            if a is not None:
+                scan.local_names.add(a.arg)
+        scan.run(node.body)
+        return key
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve(self, ref: tuple, fn: _FnInfo) -> Optional[tuple]:
+        kind = ref[0]
+        if kind == "local":
+            return ref[1] if ref[1] in self.fns else None
+        if kind == "method":
+            _, cls, name = ref
+            return self.class_index.get(cls, {}).get(name)
+        if kind == "func":
+            return fn.mod.funcs.get(ref[1])
+        return None
+
+    # -- fixpoints -----------------------------------------------------
+
+    def _fix_acquires(self) -> None:
+        self.AC: Dict[tuple, Set[str]] = {
+            k: set(f.acquires) for k, f in self.fns.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, f in self.fns.items():
+                s = self.AC[k]
+                before = len(s)
+                for ref, _held, _line in f.calls:
+                    if ref[0] == "effect":
+                        s |= ref[1]
+                    else:
+                        g = self.resolve(ref, f)
+                        if g is not None:
+                            s |= self.AC[g]
+                if len(s) != before:
+                    changed = True
+
+    def _fix_dispatches(self) -> None:
+        """dispatches*(f): does f (transitively, via RESOLVED calls
+        only — not effect sets) reach a dispatch boundary?"""
+        self.DISP: Dict[tuple, bool] = {
+            k: bool(f.dispatches) for k, f in self.fns.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, f in self.fns.items():
+                if self.DISP[k]:
+                    continue
+                for ref, _held, _line in f.calls:
+                    if ref[0] == "effect":
+                        continue
+                    g = self.resolve(ref, f)
+                    if g is not None and self.DISP[g]:
+                        self.DISP[k] = True
+                        changed = True
+                        break
+
+    def _fix_must_entry(self) -> None:
+        """must_entry(f): locks held on EVERY observed interprocedural
+        path into f.  Public names are roots (empty set: anyone may
+        call them bare); private names intersect over observed call
+        sites.  Private with no observed site -> empty (conservative)."""
+        callers: Dict[tuple, List[Tuple[tuple, FrozenSet[str]]]] = {}
+        for k, f in self.fns.items():
+            for ref, held, _line in f.calls:
+                if ref[0] == "effect":
+                    continue
+                g = self.resolve(ref, f)
+                if g is not None:
+                    callers.setdefault(g, []).append((k, held))
+        TOP = None  # lattice top: unknown-yet
+        self.ME: Dict[tuple, Optional[FrozenSet[str]]] = {}
+        for k, f in self.fns.items():
+            leaf = f.name.split(".")[-1]
+            if not leaf.startswith("_") or leaf.startswith("__") or \
+                    k not in callers:
+                self.ME[k] = frozenset()
+            else:
+                self.ME[k] = TOP
+        changed = True
+        while changed:
+            changed = False
+            for k in self.fns:
+                if self.ME[k] == frozenset():
+                    continue
+                acc: Optional[FrozenSet[str]] = TOP
+                for caller, held in callers.get(k, ()):
+                    me = self.ME.get(caller)
+                    site = held | me if me is not None else None
+                    if site is None:
+                        continue  # unknown caller: no constraint yet
+                    acc = site if acc is None else (acc & site)
+                if acc is not None and acc != self.ME[k]:
+                    self.ME[k] = acc
+                    changed = True
+        for k, v in self.ME.items():
+            if v is None:
+                self.ME[k] = frozenset()
+
+    def must_held(self, f: _FnInfo,
+                  held: FrozenSet[str]) -> FrozenSet[str]:
+        return held | self.ME.get(f.key, frozenset())
+
+    def _find_call_self_deadlocks(self) -> None:
+        """Interprocedural self-reacquire: a call made while holding a
+        non-reentrant lock whose (transitive) callee may acquire that
+        same lock.  The lexical case is caught at scan time; this pass
+        closes the gap where the re-acquire hides behind a call."""
+        for f in self.fns.values():
+            for ref, held, line in f.calls:
+                if not held:
+                    continue
+                if ref[0] == "effect":
+                    acq, via = ref[1], ref[2]
+                else:
+                    g = self.resolve(ref, f)
+                    if g is None:
+                        continue
+                    acq, via = self.AC[g], self.fns[g].name
+                for h in held:
+                    if h in acq and h not in REENTRANT:
+                        self.self_deadlocks.append(
+                            (f.mod, line, h,
+                             f"{f.name}() via {via}()"))
+
+    # -- order graph ---------------------------------------------------
+
+    def order_edges(self) -> Dict[Tuple[str, str],
+                                  List[Tuple[str, int, str]]]:
+        """(held, acquired) -> witness list [(module, line, via)]."""
+        edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+
+        def add(a, b, mod, line, via):
+            edges.setdefault((a, b), []).append((mod, line, via))
+
+        for f in self.fns.values():
+            for a, b, line in f.edges:
+                add(a, b, f.mod.canon, line, f.name)
+            for ref, held, line in f.calls:
+                if not held:
+                    continue
+                if ref[0] == "effect":
+                    acq, via = ref[1], ref[2]
+                else:
+                    g = self.resolve(ref, f)
+                    if g is None:
+                        continue
+                    acq = self.AC[g]
+                    via = self.fns[g].name
+                for h in held:
+                    for b in acq:
+                        if b != h:
+                            add(h, b, f.mod.canon, line, via)
+        return edges
+
+    def order_nodes(self) -> Set[str]:
+        nodes: Set[str] = set()
+        for f in self.fns.values():
+            nodes |= f.acquires
+        for (a, b) in self.order_edges():
+            nodes.add(a)
+            nodes.add(b)
+        return nodes
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles via SCC decomposition (each non-trivial
+        SCC reported once, as a deterministic closed walk)."""
+        edges = self.order_edges()
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        sccs = _tarjan(adj)
+        out: List[List[str]] = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            comp_sorted = sorted(comp)
+            # deterministic closed walk: follow in-component edges
+            walk = [comp_sorted[0]]
+            cur = comp_sorted[0]
+            seen = {cur}
+            while True:
+                nxts = sorted(n for n in adj.get(cur, ())
+                              if n in comp and n not in seen)
+                back = [n for n in adj.get(cur, ()) if n == walk[0]]
+                if nxts:
+                    cur = nxts[0]
+                    seen.add(cur)
+                    walk.append(cur)
+                elif back or len(seen) == len(comp):
+                    break
+                else:
+                    break
+            walk.append(walk[0])
+            out.append(walk)
+        return out
+
+    # -- findings ------------------------------------------------------
+
+    def findings(self, cycle_mode: str = "all",
+                 only_cycles: bool = False) -> List[Finding]:
+        """``cycle_mode``: which order cycles to report — "all",
+        "intra" (single-module), or "cross" (spanning modules, the
+        whole-program complement of the per-module rule pass)."""
+        out: List[Finding] = []
+        flagged: Set[Tuple[str, int, str]] = set()
+
+        def emit(mod: _ModInfo, line: int, rule: str, msg: str,
+                 also_allow: Tuple[str, ...] = ()) -> None:
+            if (mod.canon, line, rule) in flagged:
+                return
+            for r in (rule,) + also_allow:
+                if mod.module.allowed(r, line):
+                    return
+            flagged.add((mod.canon, line, rule))
+            out.append(Finding(mod.module.path, line, 0, rule, msg,
+                               _SEVERITY[rule]))
+
+        self._cycle_findings(out, emit, cycle_mode)
+        if not only_cycles:
+            self._lockset_findings(emit)
+            self._missing_findings(emit)
+            self._atomicity_findings(emit)
+            self._dispatch_findings(emit)
+            self._cow_findings(emit)
+        out.sort(key=lambda f: (f.path, f.line, f.rule))
+        return out
+
+    def _mod_by_canon(self, canon: str) -> Optional[_ModInfo]:
+        for m in self.mods:
+            if m.canon == canon:
+                return m
+        return None
+
+    def _cycle_findings(self, out, emit, cycle_mode: str) -> None:
+        edges = self.order_edges()
+        if cycle_mode != "cross":
+            # self-deadlocks are reported from the holding module
+            for mod, line, lock, fname in self.self_deadlocks:
+                emit(mod, line, "lock-order-cycle",
+                     f"non-reentrant lock {lock} re-acquired while "
+                     f"already held in {fname} — self-deadlock")
+        for walk in self.cycles():
+            wit = []
+            mods_involved = set()
+            for a, b in zip(walk, walk[1:]):
+                w = edges.get((a, b))
+                if w:
+                    m, ln, via = w[0]
+                    wit.append(f"{a} -> {b} ({m.split('/')[-1]}:{ln} "
+                               f"via {via})")
+                    mods_involved.add(m)
+                else:
+                    wit.append(f"{a} -> {b}")
+            first = None
+            for a, b in zip(walk, walk[1:]):
+                if edges.get((a, b)):
+                    first = edges[(a, b)][0]
+                    break
+            if first is None:
+                continue
+            mod = self._mod_by_canon(first[0])
+            if mod is None:
+                continue
+            intra = len(mods_involved) <= 1
+            if cycle_mode == "all" or \
+                    (cycle_mode == "cross") != intra:
+                emit(mod, first[1], "lock-order-cycle",
+                     "lock acquisition order cycle: " + "; ".join(wit))
+
+    def _entry_for(self, mod: _ModInfo, name: str,
+                   kind: str) -> Optional[GuardEntry]:
+        for e in self.guards:
+            if mod.canon.endswith(e.module) and e.kind == kind and \
+                    name in e.attrs:
+                return e
+        return None
+
+    def _lockset_findings(self, emit) -> None:
+        """guarded-field-unlocked: Load-context mutation of a
+        registered writes_only field, owning lock not held lexically
+        nor on every interprocedural path in."""
+        for f in self.fns.values():
+            if f.is_ctor:
+                continue
+            for mutkind, scope, name, _root, line, held in f.mutations:
+                if mutkind != "loadmut":
+                    continue
+                kind = "attr" if scope == "attr" else "global"
+                e = self._entry_for(f.mod, name, kind)
+                if e is None or not e.writes_only:
+                    continue
+                names_held = {h.split(".")[-1]
+                              for h in self.must_held(f, held)}
+                if e.lock not in names_held:
+                    what = "global" if kind == "global" else "field"
+                    emit(f.mod, line, "guarded-field-unlocked",
+                         f"{what} {name!r} mutated in place without "
+                         f"holding {e.lock!r} (registered "
+                         f"writes_only; in-place mutation IS a write)"
+                         + (f" — {e.note}" if e.note else ""),
+                         also_allow=("guarded-by",))
+
+    def _missing_findings(self, emit) -> None:
+        """guarded-by-missing: Eraser registry-gap detection."""
+        for mi in self.mods:
+            # attr arm: inconsistent locking across >=2 functions
+            per_attr: Dict[str, List[tuple]] = {}
+            for f in mi.fns.values():
+                if f.is_ctor:
+                    continue
+                for mutkind, scope, name, root, line, held in f.mutations:
+                    if scope != "attr" or root not in ("self",) + \
+                            tuple(RECEIVER_CLASSES):
+                        continue
+                    if name in mi.registered or name in COW_ATTRS or \
+                            "lock" in name.lower() or \
+                            name.startswith("m_") or \
+                            name.startswith("__"):
+                        continue
+                    names_held = frozenset(
+                        h.split(".")[-1]
+                        for h in self.must_held(f, held))
+                    per_attr.setdefault(name, []).append(
+                        (f.key, line, names_held))
+            if mi.has_lock_with:
+                for name, sites in sorted(per_attr.items()):
+                    fns = {k for k, _l, _h in sites}
+                    if len(fns) < 2:
+                        continue
+                    locked = [h for _k, _l, h in sites if h]
+                    inter = frozenset.intersection(
+                        *[h for _k, _l, h in sites])
+                    if locked and not inter:
+                        k, line, h = min(
+                            (s for s in sites if not s[2]),
+                            default=sites[0], key=lambda s: s[1])
+                        emit(mi, line, "guarded-by-missing",
+                             f"field {name!r} mutated from "
+                             f"{len(fns)} functions with inconsistent "
+                             f"locking (lockset intersection empty) "
+                             f"and no guarded-by registry entry")
+            # global arm: module owns a lock, a function rebinds an
+            # unregistered module global
+            if not mi.top_lock_globals:
+                continue
+            for f in mi.fns.values():
+                for mutkind, scope, name, _root, line, held in \
+                        f.mutations:
+                    if scope != "global" or name in mi.registered or \
+                            "lock" in name.lower():
+                        continue
+                    emit(mi, line, "guarded-by-missing",
+                         f"module global {name!r} rebound/mutated in "
+                         f"{f.name}() but absent from the guarded-by "
+                         f"registry (module owns "
+                         f"{sorted(mi.top_lock_globals)[0]!r})")
+
+    def _atomicity_findings(self, emit) -> None:
+        """atomicity-check-then-act: guarded read, lock released, then
+        a dependent guarded write under a fresh acquisition."""
+        for f in self.fns.values():
+            recs = f.withrecs
+            for i, a in enumerate(recs):
+                for b in recs[i + 1:]:
+                    if b.line <= a.end_line:
+                        continue  # nested, not sequential
+                    if any(a.end_line < ln < b.line
+                           for ln in f.exit_lines):
+                        continue  # alternative branches, not a
+                        # release-then-reacquire sequence
+                    if not (a.locks & b.locks):
+                        continue
+                    fields = a.loads & b.stores
+                    if not fields:
+                        continue
+                    if not (a.bound & b.refs):
+                        continue  # no dataflow from check to act
+                    if b.loads:
+                        # the act re-reads guarded state under the
+                        # re-acquired lock: a validated double-check
+                        # (the current_mesh pattern), not a blind
+                        # write from stale values
+                        continue
+                    lock = sorted(a.locks & b.locks)[0]
+                    emit(f.mod, b.line, "atomicity-check-then-act",
+                         f"check-then-act on {sorted(fields)[0]!r}: "
+                         f"read under {lock} at line {a.line}, "
+                         f"dependent write re-acquires it here — the "
+                         f"state may have changed between the blocks")
+
+    def _dispatch_findings(self, emit) -> None:
+        for f in self.fns.values():
+            for line, held, what in f.dispatches:
+                bad = held & ENGINE_DISPATCH_LOCKS
+                if bad:
+                    emit(f.mod, line, "lock-held-across-dispatch",
+                         f"{sorted(bad)[0]} held across {what} — the "
+                         f"device/flush boundary can block; release "
+                         f"before dispatching")
+            for ref, held, line in f.calls:
+                if ref[0] == "effect":
+                    continue
+                bad = held & ENGINE_DISPATCH_LOCKS
+                if not bad:
+                    continue
+                g = self.resolve(ref, f)
+                if g is not None and self.DISP[g]:
+                    emit(f.mod, line, "lock-held-across-dispatch",
+                         f"{sorted(bad)[0]} held across call to "
+                         f"{self.fns[g].name}() which reaches a "
+                         f"device/flush dispatch boundary")
+
+    def _cow_findings(self, emit) -> None:
+        for f in self.fns.values():
+            if f.is_ctor:
+                continue
+            for mutkind, scope, name, root, line, held in f.mutations:
+                if mutkind != "loadmut" or scope != "attr":
+                    continue
+                cow_recv = root == "engine" or (
+                    root == "self" and f.cls in COW_SELF_CLASSES)
+                if name in COW_ATTRS and cow_recv:
+                    emit(f.mod, line, "cow-swap-aliasing",
+                         f"COW list {name!r} mutated in place — "
+                         f"lock-free readers iterate a stale alias; "
+                         f"build a new list and replace the "
+                         f"reference instead")
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+    return sccs
+
+
+# -- whole-program entry points ---------------------------------------
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def collect_modules(root: Optional[str] = None,
+                    scopes: Tuple[str, ...] = ORDER_SCOPES
+                    ) -> List[Module]:
+    """Every scoped source module under the package root."""
+    root = root or _package_root()
+    mods: List[Module] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            canon = _canon_path(path)
+            if not any(canon.startswith(s) for s in scopes):
+                continue
+            with open(path, "r", encoding="utf-8") as fh:
+                mods.append(Module(path, fh.read()))
+    return mods
+
+
+def build_lock_graph(root: Optional[str] = None) -> Dict:
+    """The whole-program lock acquisition-order graph (the ``--graph
+    lock`` payload and the witness crosscheck's static side)."""
+    a = _Analyzer(collect_modules(root))
+    edges = a.order_edges()
+    return {
+        "version": 1,
+        "nodes": sorted(a.order_nodes()),
+        "edges": [
+            {
+                "from": e[0], "to": e[1],
+                "witness": [
+                    {"module": m, "line": ln, "via": via}
+                    for m, ln, via in sorted(set(w))[:4]
+                ],
+            }
+            for e, w in sorted(edges.items())
+        ],
+        "cycles": a.cycles(),
+    }
+
+
+def static_order_edges(root: Optional[str] = None
+                       ) -> Set[Tuple[str, str]]:
+    """The static edge set the dynamic witness must be a subset of."""
+    g = build_lock_graph(root)
+    return {(e["from"], e["to"]) for e in g["edges"]}
+
+
+def graph_cycle_findings(root: Optional[str] = None) -> List[Finding]:
+    """Whole-program CROSS-module cycle findings — the complement of
+    the per-module rule pass (which sees intra-module cycles only),
+    for ``--all``."""
+    a = _Analyzer(collect_modules(root))
+    return a.findings(cycle_mode="cross", only_cycles=True)
+
+
+def lock_graph_to_dot(graph: Dict) -> str:
+    lines = ["digraph lock_order {", "  rankdir=LR;",
+             '  node [shape=box, fontname="monospace"];']
+    cyc_nodes = {n for walk in graph.get("cycles", []) for n in walk}
+    for n in graph["nodes"]:
+        style = ', style=filled, fillcolor="#ffcccc"' \
+            if n in cyc_nodes else ""
+        lines.append(f'  "{n}" [label="{n}"{style}];')
+    for e in graph["edges"]:
+        w = e["witness"][0] if e["witness"] else None
+        label = f'{w["module"].split("/")[-1]}:{w["line"]}' if w else ""
+        lines.append(f'  "{e["from"]}" -> "{e["to"]}" '
+                     f'[label="{label}", fontsize=8];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+class LocksmithRules(Rule):
+    """The concurrency pack: per-module lockset + intra-module order
+    analysis (whole-program cycles ride ``--all`` via
+    :func:`graph_cycle_findings`)."""
+
+    RULE_NAMES = (
+        "lock-order-cycle", "guarded-field-unlocked",
+        "guarded-by-missing", "atomicity-check-then-act",
+        "lock-held-across-dispatch", "cow-swap-aliasing",
+    )
+    name = RULE_NAMES
+    description = ("interprocedural lock-order & Eraser-lockset "
+                   "analysis over the threaded control plane")
+
+    def __init__(self, guards: Optional[Tuple[GuardEntry, ...]] = None):
+        self.guards = tuple(guards) if guards is not None else GUARDS
+
+    def check(self, module: Module) -> List[Finding]:
+        canon = _canon_path(module.path)
+        if canon.startswith("fluentbit_tpu/") and not any(
+                canon.startswith(s) for s in LOCKSET_SCOPES):
+            return []
+        try:
+            a = _Analyzer([module], self.guards)
+        except SyntaxError:
+            return []
+        # per-module pass: cycles here are intra-module by construction
+        return a.findings(cycle_mode="all")

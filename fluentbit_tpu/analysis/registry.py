@@ -31,7 +31,7 @@ from dataclasses import dataclass
 from typing import Dict, Tuple
 
 __all__ = ["GuardEntry", "GUARDS", "LAUNCH_ENTRIES", "BUDGET_PARAMS",
-           "budget_path"]
+           "budget_path", "lock_baseline_path"]
 
 # -- fbtpu-xray (analysis/launchgraph.py) declarative plumbing ---------
 
@@ -56,6 +56,12 @@ def budget_path() -> str:
     """Path of the committed launch/transfer budget baseline."""
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "launch_budget.json")
+
+
+def lock_baseline_path() -> str:
+    """Path of the committed fbtpu-locksmith findings baseline."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lock_baseline.json")
 
 
 @dataclass(frozen=True)
@@ -107,6 +113,16 @@ GUARDS: Tuple[GuardEntry, ...] = (
         writes_only=True,
         note="same flag, defining module (InputInstance.set_paused)",
     ),
+    GuardEntry(
+        "fluentbit_tpu/core/engine.py", "_ingest_lock",
+        ("traces", "_retired_names", "_retired_outputs"),
+        writes_only=True,
+        note="hot-reload/trace bookkeeping (fbtpu-locksmith): trace "
+             "installs, retired-name tombstones and the retired-output "
+             "reap list are mutated by reload commits, trace admin "
+             "calls, the reap timer and stop, racing each other; "
+             "reads are lock-free probes by design",
+    ),
     # -- fbtpu-guard: flights/breakers/shed touched from the engine
     #    loop, flush_now callers, and sync-fallback flushes --
     GuardEntry(
@@ -151,12 +167,42 @@ GUARDS: Tuple[GuardEntry, ...] = (
         note="per-input chunk pools drained by the reload swap race "
              "parallel raw-path appends without the input's lock",
     ),
+    GuardEntry(
+        "fluentbit_tpu/core/qos.py", "_ingest_lock",
+        ("traces", "_retired_names", "_retired_outputs"),
+        writes_only=True,
+        note="the reload transaction mutates the same engine "
+             "hot-reload bookkeeping from the committing thread "
+             "(same discipline as core/engine.py's own entry)",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/qos.py", "_lock", ("_graded",),
+        writes_only=True,
+        note="priority-grading flag: the dispatch hot path reads it "
+             "lock-free (benign staleness of one flush cycle); "
+             "recomputation serializes with tenant changes",
+    ),
     # -- metrics: counters incremented from every thread family --
     GuardEntry(
         "fluentbit_tpu/core/metrics.py", "_lock",
         ("_values", "_counts", "_sums", "_metrics"),
         note="cmetrics state: ingest threads, the engine loop, output "
              "workers and the admin server all touch the same registry",
+    ),
+    # -- shared sqlite handle registry --
+    GuardEntry(
+        "fluentbit_tpu/core/sqldb.py", "_lock", ("_open_dbs",),
+        kind="global",
+        note="shared-handle registry: open_db/close run from any "
+             "plugin thread; every access serializes on the module "
+             "lock (fbtpu-locksmith registry gap)",
+    ),
+    # -- lock-order witness recorder (fbtpu-locksmith ground truth) --
+    GuardEntry(
+        "fluentbit_tpu/core/lockorder.py", "_edges_guard", ("_edges",),
+        kind="global",
+        note="witness edge set: every acquiring thread records into "
+             "it; snapshot/reset serialize on the guard",
     ),
     # -- native loaders: double-checked module singletons --
     GuardEntry(
@@ -201,5 +247,14 @@ GUARDS: Tuple[GuardEntry, ...] = (
         ("_listeners",), kind="global",
         note="fault event listener list: engines register/release on "
              "start/stop while lanes notify from worker threads",
+    ),
+    # -- analyzer caches (fbtpu-locksmith lockset scope) --
+    GuardEntry(
+        "fluentbit_tpu/analysis/speccheck.py", "_cache_lock",
+        ("_programs_cache",), writes_only=True, kind="global",
+        note="shipped-programs cache: double-checked build — the "
+             "lock-free settled fast path is documented, the "
+             "build/store transition must serialize (speccheck runs "
+             "from tests and the CLI concurrently)",
     ),
 )

@@ -78,6 +78,7 @@ from __future__ import annotations
 
 import ast
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -338,6 +339,7 @@ _SKETCH_MODULE = "fluentbit_tpu/ops/sketch.py"
 _KERNELS_MODULE = "fluentbit_tpu/flux/kernels.py"
 
 _programs_cache: Optional[Tuple[ProgramSpec, ...]] = None
+_cache_lock = threading.Lock()
 
 
 def _grep_table_leaves(env: Dict[str, int]) -> Tuple[Aval, ...]:
@@ -463,7 +465,8 @@ def shipped_programs(refresh: bool = False) -> Tuple[ProgramSpec, ...]:
         progs = _build_shipped()
     except Exception:
         progs = ()
-    _programs_cache = progs
+    with _cache_lock:
+        _programs_cache = progs
     return progs
 
 

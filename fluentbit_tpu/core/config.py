@@ -223,6 +223,10 @@ class ServiceConfig:
     http_listen: str = "0.0.0.0"
     http_port: int = 2020
     hot_reload: bool = False
+    # SIGHUP applies the config-file diff through a ReloadTxn
+    # generation swap (core/reload_diff.py) instead of a full
+    # stop/start; unsupported edits fall back to the restart path
+    hot_reload_diff: bool = False
     scheduler_base: float = 5.0      # retry backoff base (flb_scheduler.h:29)
     scheduler_cap: float = 2000.0    # retry backoff cap  (flb_scheduler.h:30)
     retry_limit: int = 1             # default per-output retries
@@ -273,6 +277,7 @@ class ServiceConfig:
         "http_listen": ("http_listen", str),
         "http_port": ("http_port", int),
         "hot_reload": ("hot_reload", parse_bool),
+        "hot_reload_diff": ("hot_reload_diff", parse_bool),
         "scheduler.base": ("scheduler_base", parse_time),
         "scheduler.cap": ("scheduler_cap", parse_time),
         "retry_limit": ("retry_limit", int),

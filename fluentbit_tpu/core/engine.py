@@ -29,6 +29,7 @@ from .. import failpoints as _fp
 from ..codec.chunk import Chunk, EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES
 from ..codec.events import LogEvent, decode_events, reencode_event
 from .config import ServiceConfig
+from .lockorder import make_lock
 from .metrics import MetricsRegistry
 from .plugin import (
     FilterInstance,
@@ -69,6 +70,29 @@ class Task:
         self.processed: Dict[str, bytes] = {}
 
 
+class _RawTail:
+    """Continuation returned by ``_ingest_raw`` when a filter declines
+    mid-chain AFTER an earlier stateful filter's side effects are out.
+    The caller finishes the remaining filters per-record via
+    ``_finish_raw_tail`` — outside the raw-path lock scope, because the
+    tail re-enters the decode path's ``self._ingest_lock`` and taking
+    that while still holding ``ins.ingest_lock`` would invert the
+    canonical lock order (fbtpu-locksmith)."""
+
+    __slots__ = ("tag", "data", "remaining", "n", "n_records", "deltas",
+                 "in_bytes")
+
+    def __init__(self, tag, data, remaining, n, n_records, deltas,
+                 in_bytes):
+        self.tag = tag
+        self.data = data
+        self.remaining = remaining  # the declining filter onward
+        self.n = n
+        self.n_records = n_records
+        self.deltas = deltas
+        self.in_bytes = in_bytes
+
+
 class Engine:
     """The pipeline runtime for one configuration context."""
 
@@ -93,7 +117,8 @@ class Engine:
         self._started = threading.Event()
         self._stopping = False
         self._stop_event = threading.Event()  # wakes threaded collectors
-        self._ingest_lock = threading.RLock()
+        self._ingest_lock = make_lock("Engine._ingest_lock",
+                                      reentrant=True)
         self._pending_flushes: set = set()
         # scheduler-owned retries (flb_engine_dispatch_retry,
         # src/flb_engine_dispatch.c:36-99): a retry is a loop timer +
@@ -105,7 +130,7 @@ class Engine:
         from .bucket_queue import BucketQueue
 
         self._event_queue = BucketQueue()
-        self._event_queue_lock = threading.Lock()
+        self._event_queue_lock = make_lock("Engine._event_queue_lock")
         # task id map, default 2048 slots (flb_task_map, flb_task.c:542
         # + FLB_CONFIG_DEFAULT_TASK_MAP_SIZE): dispatch pauses when full
         self._task_map: Dict[int, Task] = {}
@@ -132,7 +157,7 @@ class Engine:
         # ReloadTxn.commit): two concurrent commits would each write
         # back instance lists derived from their own pre-build
         # snapshot, silently dropping the other's changes
-        self._reload_lock = threading.Lock()
+        self._reload_lock = make_lock("Engine._reload_lock")
         self.admin_server = None
         self.reload_callback = None  # wired by the CLI for /api/v2/reload
 
@@ -297,7 +322,10 @@ class Engine:
         construction sequence cannot drift between them."""
         ins = create(name)
         self._number_instance(ins, peers)
-        for k, v in props.items():
+        # props is a dict (builder API) or a properties ITEM LIST
+        # (hot-reload *_items staging: repeated keys + declared order)
+        items = props.items() if hasattr(props, "items") else props
+        for k, v in items:
             ins.set(k, v)
         return ins
 
@@ -313,7 +341,10 @@ class Engine:
     def input(self, name: str, **props) -> InputInstance:
         ins = self._make_instance(self.registry.create_input, name,
                                   props, self.inputs)
-        self.inputs.append(ins)
+        # COW swap: collectors iterate engine.inputs lock-free, so the
+        # builder publishes a fresh list instead of mutating the alias
+        with self._ingest_lock:
+            self.inputs = self.inputs + [ins]
         return ins
 
     def filter(self, name: str, **props) -> FilterInstance:
@@ -328,13 +359,18 @@ class Engine:
         while pos > 0 and getattr(self.filters[pos - 1],
                                   "_flux_sql_hidden", False):
             pos -= 1
-        self.filters.insert(pos, ins)
+        # COW swap (see input()): ingest walks engine.filters lock-free
+        with self._ingest_lock:
+            self.filters = self.filters[:pos] + [ins] + self.filters[pos:]
         return ins
 
     def output(self, name: str, **props) -> OutputInstance:
         ins = self._make_instance(self.registry.create_output, name,
                                   props, self.outputs)
-        self.outputs.append(ins)
+        # COW swap (see input()): the router reads engine.outputs
+        # lock-free while dispatching
+        with self._ingest_lock:
+            self.outputs = self.outputs + [ins]
         return ins
 
     def custom(self, name: str, **props):
@@ -442,13 +478,16 @@ class Engine:
         emitter = self.hidden_input(
             "emitter", owner=target, alias=f"trace_emitter_{target.name}"
         )
-        self.traces[target.name] = {
-            "input": target,
-            "output_tag": output_tag,
-            "emitter": emitter.plugin,
-            "emitter_instance": emitter,
-            "count": 0,
-        }
+        # trace installs race the reap timer / reload commits mutating
+        # the same dict from other threads
+        with self._ingest_lock:
+            self.traces[target.name] = {
+                "input": target,
+                "output_tag": output_tag,
+                "emitter": emitter.plugin,
+                "emitter_instance": emitter,
+                "count": 0,
+            }
         return True
 
     def disable_trace(self, input_name: str) -> bool:
@@ -458,13 +497,13 @@ class Engine:
                 if ctx["input"].display_name == input_name:
                     key = name
                     break
-        ctx = self.traces.pop(key, None)
-        if ctx is None:
-            return False
-        # drop the hidden emitter too — repeated enable/disable cycles
-        # must not accumulate dead inputs (COW swap: concurrent
-        # iterators keep their snapshot)
         with self._ingest_lock:
+            ctx = self.traces.pop(key, None)
+            if ctx is None:
+                return False
+            # drop the hidden emitter too — repeated enable/disable
+            # cycles must not accumulate dead inputs (COW swap:
+            # concurrent iterators keep their snapshot)
             self.inputs = [i for i in self.inputs
                            if i is not ctx["emitter_instance"]]
             emitter_ins = ctx["emitter_instance"]
@@ -1048,13 +1087,36 @@ class Engine:
             )
         )
         if raw_ok:
+            # stateful chains are pinned to the global lock even when
+            # every filter is thread_safe_raw: a stateful hook's side
+            # effects (emitter re-emits) re-enter input_log_append,
+            # which takes self._ingest_lock — under ins.ingest_lock
+            # that re-entry would invert the canonical
+            # Engine._ingest_lock -> InputInstance.ingest_lock order
+            # (fbtpu-locksmith lock-order-cycle)
             parallel = all(
                 getattr(f.plugin, "thread_safe_raw", False)
+                and not getattr(f.plugin, "stateful_batch", False)
                 for f in matching
             )
-            lock = ins.ingest_lock if parallel else self._ingest_lock
-            with lock:
-                got = self._ingest_raw(ins, tag, data, matching, n_records)
+            # two lexical branches, not a lock alias: the locksmith
+            # order-graph walk resolves `with self._X:` scopes, not
+            # conditionally-bound aliases
+            if parallel:
+                with ins.ingest_lock:
+                    got = self._ingest_raw(ins, tag, data, matching,
+                                           n_records)
+            else:
+                with self._ingest_lock:
+                    got = self._ingest_raw(ins, tag, data, matching,
+                                           n_records)
+            if isinstance(got, _RawTail):
+                # a mid-chain decline after committed side effects:
+                # finish per-record OUTSIDE the raw-path lock scope —
+                # the tail takes self._ingest_lock itself, and taking
+                # it while still holding ins.ingest_lock would be the
+                # inversion the order graph forbids
+                got = self._finish_raw_tail(ins, got)
             if got is not None:
                 return got
 
@@ -1230,9 +1292,13 @@ class Engine:
         return n_records
 
     def _ingest_raw(self, ins, tag: str, data: bytes, matching,
-                    n_records: Optional[int]) -> Optional[int]:
-        """Append without Python decode; None → caller falls back to the
-        decode path (native unavailable / a filter declined)."""
+                    n_records: Optional[int]):
+        """Append without Python decode. Returns the appended record
+        count, None (caller falls back to the decode path: native
+        unavailable / a pure-prefix filter decline), or a ``_RawTail``
+        continuation (decline AFTER committed side effects — the caller
+        runs it via ``_finish_raw_tail`` once the raw-path lock is
+        released)."""
         from ..codec import events as _events
 
         from .chunk_batch import RawChunk
@@ -1288,23 +1354,15 @@ class Engine:
                     return None  # pure prefix: decode path re-runs it
                 # an upstream stateful filter already emitted records /
                 # bumped metrics — re-running the whole chain on the
-                # decode path would double those side effects. Finish
-                # the REMAINING filters per-record on the current bytes
-                # instead (same code the decode path runs: bit-exact).
-                tail = self._raw_tail_decoded(data, tag, matching[fi:],
-                                              ins)
-                if tail is None:
-                    break  # undecodable mid-chain output: append as-is
-                n2, data, n_in = tail
-                if n_records is None and not deltas:
-                    # the first matching filter declined before any
-                    # count was discovered: the tail's decode IS the
-                    # append's input count (m_in_records accounting)
-                    n_records = n_in
-                # the tail's per-filter drop/add metrics were counted
-                # inside _run_filters — no deltas entry here
-                n = n2
-                break
+                # decode path would double those side effects. Hand the
+                # caller a continuation: the REMAINING filters finish
+                # per-record (same code the decode path runs:
+                # bit-exact) via _finish_raw_tail, AFTER the raw-path
+                # lock is released — the tail takes self._ingest_lock
+                # itself, and nesting that under ins.ingest_lock would
+                # invert the canonical order (fbtpu-locksmith)
+                return _RawTail(tag, data, matching[fi:], n, n_records,
+                                deltas, in_bytes)
             if len(got) == 3:
                 n2, data, n_in = got
                 if n is None:
@@ -1333,6 +1391,14 @@ class Engine:
             n = _events.fast_count_records(data)
             if n is None:
                 return None
+        return self._finish_raw_append(ins, tag, data, n, n_records,
+                                       deltas, in_bytes)
+
+    def _finish_raw_append(self, ins, tag: str, data, n, n_records,
+                           deltas, in_bytes: int) -> int:
+        """The raw path's commit epilogue: deferred filter metric
+        deltas, ingest accounting, pool append. Shared by the straight
+        -through chain and the decline-after-commit tail continuation."""
         if n_records is None:
             n_records = deltas[0][1] if deltas else n
         for name, before, after in deltas:
@@ -1349,6 +1415,35 @@ class Engine:
             if self.storage is not None and ins.storage_type == "filesystem":
                 self.storage.write_through(chunk, data)
         return n
+
+    def _finish_raw_tail(self, ins, cont: "_RawTail") -> int:
+        """Run a _RawTail continuation: decode-path finish of the
+        remaining filters, then the shared commit epilogue. MUST be
+        called with no raw-path lock held (see _RawTail)."""
+        tail = self._raw_tail_decoded(cont.data, cont.tag,
+                                      cont.remaining, ins)
+        n, data, n_records = cont.n, cont.data, cont.n_records
+        if tail is not None:
+            n2, data, n_in = tail
+            if n_records is None and not cont.deltas:
+                # the first matching filter declined before any count
+                # was discovered: the tail's decode IS the append's
+                # input count (m_in_records accounting)
+                n_records = n_in
+            # the tail's per-filter drop/add metrics were counted
+            # inside _run_filters — no deltas entry here
+            n = n2
+        # tail None → undecodable mid-chain output (a filter contract
+        # violation): append the current bytes as-is rather than losing
+        # the chunk
+        if tail is None and n is None:
+            from ..codec import events as _events
+            n = _events.fast_count_records(data)
+            if n is None:
+                return None  # decode-path fallback (pre-split parity)
+        return self._finish_raw_append(ins, cont.tag, data, n,
+                                       n_records, cont.deltas,
+                                       cont.in_bytes)
 
     def _raw_tail_decoded(self, data, tag: str, remaining, ins):
         """Finish a raw chain per-record after a mid-chain decline once
@@ -1369,9 +1464,10 @@ class Engine:
                           "filters skipped for this append")
             return None
         n_in = len(events)
-        # stateful chains always run under the global ingest lock
-        # (stateful filters are never thread_safe_raw), so the RLock
-        # re-enters; the save/restore mirrors input_log_append's
+        # runs via _finish_raw_tail with NO raw-path lock held (a
+        # stateful chain's raw pass released self._ingest_lock before
+        # the continuation fired); the save/restore mirrors
+        # input_log_append's
         with self._ingest_lock:
             prev_src = self._ingest_src
             self._ingest_src = ins

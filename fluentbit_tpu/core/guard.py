@@ -69,6 +69,8 @@ import logging
 import threading
 import time
 from collections import deque
+
+from .lockorder import make_lock
 from typing import Callable, Dict, List, Optional, Tuple
 
 log = logging.getLogger("flb.guard")
@@ -149,7 +151,7 @@ class CircuitBreaker:
         self.probes = max(1, int(probes))
         self.on_transition = on_transition
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
         self._state = STATE_CLOSED
         self._consecutive = 0
         self._outcomes: deque = deque(maxlen=self.window)
@@ -356,7 +358,7 @@ class Guard:
 
     def __init__(self, engine):
         self.engine = engine
-        self._lock = threading.Lock()
+        self._lock = make_lock("Guard._lock")
         self._flights: Dict[tuple, FlightRecord] = {}
         self._abandoned: List[FlightRecord] = []
         self._shed: List = []  # chunks parked off the dispatch path

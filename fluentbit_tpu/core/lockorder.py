@@ -1,0 +1,135 @@
+"""fbtpu-locksmith ground truth: the lock-order witness recorder.
+
+The static lock-acquisition-order graph (analysis/locksmith.py) is a
+model; this module keeps it honest the same way the launch counters
+keep fbtpu-xray honest and the live spec probe keeps fbtpu-speccheck
+honest.  Every named lock in the threaded control plane is constructed
+through :func:`make_lock`.  In normal operation that returns a plain
+``threading.Lock``/``RLock`` — zero overhead, nothing recorded.  With
+``FBTPU_LOCK_WITNESS`` set in the environment *at construction time*,
+the lock is wrapped: each acquire records, for the acquiring thread,
+one ``(held, acquired)`` edge per lock already held, into a process
+-global edge set.
+
+The tier-1 crosscheck (tests/test_locksmith.py) then drives
+representative workloads — append/flush/reload/housekeeping/stop —
+under the witness and asserts **static ⊇ dynamic**: every edge the
+process actually exercised exists in the static graph, and the static
+graph is acyclic.  A dynamically observed edge missing from the static
+model means the analyzer's call-walk lost a path — the test fails
+loudly instead of the model silently rotting.
+
+Names handed to :func:`make_lock` are the analyzer's canonical node
+ids (``Engine._ingest_lock``, ``InputInstance.ingest_lock``,
+``device._lock`` …) — the two sides join on these strings, so renaming
+a lock means updating both the construction site and the analyzer's
+``LOCK_HOMES`` table (the crosscheck catches a drift).
+
+Re-entrant re-acquisition of the same named lock records no edge: an
+RLock re-entry is not an ordering constraint.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Set, Tuple
+
+__all__ = ["make_lock", "witness_enabled", "witness_edges",
+           "witness_reset"]
+
+#: (held_name, acquired_name) edges observed since the last reset.
+_edges: Set[Tuple[str, str]] = set()
+_edges_guard = threading.Lock()
+_tls = threading.local()
+
+
+def witness_enabled() -> bool:
+    """True when locks constructed NOW would record edges."""
+    return bool(os.environ.get("FBTPU_LOCK_WITNESS"))
+
+
+def witness_edges() -> List[Tuple[str, str]]:
+    """Sorted snapshot of every recorded acquisition edge."""
+    with _edges_guard:
+        return sorted(_edges)
+
+
+def witness_reset() -> None:
+    with _edges_guard:
+        _edges.clear()
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class _WitnessLock:
+    """A named threading lock that records acquisition-order edges.
+
+    Mirrors the subset of the ``threading.Lock``/``RLock`` surface the
+    engine uses (``with``, ``acquire``/``release``, ``locked``).  The
+    held-name stack is thread-local; the edge set is process-global so
+    one tier-1 run accumulates every thread family's orderings.
+    """
+
+    __slots__ = ("name", "reentrant", "_inner")
+
+    def __init__(self, name: str, reentrant: bool):
+        self.name = name
+        self.reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            stack = _held_stack()
+            if self.name not in stack:
+                # re-entry of the same named lock is not an ordering
+                # constraint; a FIRST acquire under other held locks is
+                new = {(held, self.name) for held in stack
+                       if held != self.name}
+                if new:
+                    with _edges_guard:
+                        _edges.update(new)
+            stack.append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        # remove the most recent entry for this name (lock scopes nest)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.name:
+                del stack[i]
+                break
+        self._inner.release()
+
+    def locked(self) -> bool:
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<WitnessLock {self.name} reentrant={self.reentrant}>"
+
+
+def make_lock(name: str, reentrant: bool = False):
+    """Construct the named control-plane lock.
+
+    Plain ``threading`` primitive unless ``FBTPU_LOCK_WITNESS`` is set
+    in the environment when the lock is CONSTRUCTED (engines built
+    before the flag flips stay unwitnessed — tests set the env before
+    building their engine).
+    """
+    if witness_enabled():
+        return _WitnessLock(name, reentrant)
+    return threading.RLock() if reentrant else threading.Lock()

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from .lockorder import make_lock
+
 DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
@@ -148,7 +150,8 @@ class MetricsRegistry:
     """A cmt context."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_lock("MetricsRegistry._lock",
+                               reentrant=True)
         self._metrics: Dict[str, _Metric] = {}
 
     def _add(self, metric: _Metric) -> None:

@@ -16,6 +16,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Type
 
 from .config import ConfigMapEntry, Properties, apply_config_map
+from .lockorder import make_lock
 from .router import Route
 from ..codec.chunk import Chunk, ChunkPool, EVENT_TYPE_LOGS
 
@@ -212,7 +213,8 @@ class InputInstance(Instance):
         # the engine-global lock when the filter chain allows (reference:
         # per-input chunk maps, src/flb_input_log.c:1524). RLock — the
         # global-lock paths nest it around their pool touches.
-        self.ingest_lock = threading.RLock()
+        self.ingest_lock = make_lock("InputInstance.ingest_lock",
+                                     reentrant=True)
 
     def set_paused(self, paused: bool) -> bool:
         """Atomically flip the backpressure flag and fire the plugin's

@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import failpoints as _fp
 from .bucket_queue import QOS_CLASS_COUNT, DeficitFairQueue
+from .lockorder import make_lock
 from .scheduler import TokenBucket
 
 log = logging.getLogger("flb.qos")
@@ -106,7 +107,7 @@ class Qos:
     def __init__(self, engine, clock=time.monotonic):
         self.engine = engine
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("Qos._lock")
         self._tenants: Dict[str, Tenant] = {}
         # True once tenants span MORE than one priority class: the
         # guard's shed-by-priority pass only engages then — a
@@ -490,6 +491,22 @@ class ReloadTxn:
         self._add_outputs.append((name, props))
         return self
 
+    def add_input_items(self, name: str, items):
+        """Stage an input from a properties ITEM LIST — repeated keys
+        (a tail input's several Path rules) and declaration order are
+        semantic; the config-file diff driver (core/reload_diff.py)
+        stages through these instead of the ``**props`` dict forms."""
+        self._add_inputs.append((name, list(items)))
+        return self
+
+    def add_filter_items(self, name: str, items):
+        self._add_filters.append((name, list(items)))
+        return self
+
+    def add_output_items(self, name: str, items):
+        self._add_outputs.append((name, list(items)))
+        return self
+
     def remove_input(self, name: str):
         self._remove["input"].add(name)
         return self
@@ -509,6 +526,14 @@ class ReloadTxn:
         DFA-recompile-mid-stream shape); the new instance takes the
         old one's chain position."""
         self._replace_filters.append((target, name or "", props))
+        return self
+
+    def replace_filter_items(self, target: str, items,
+                             name: Optional[str] = None):
+        """`replace_filter` from a properties ITEM LIST (see
+        `add_input_items`); an empty list means "recompile the same
+        configuration" exactly like the no-props dict form."""
+        self._replace_filters.append((target, name or "", list(items)))
         return self
 
     def add_parser(self, name: str, **props):
@@ -573,9 +598,10 @@ class ReloadTxn:
         # route_names / metric series would re-bind to it). Recording
         # early is safe across an abort — a spuriously retired name
         # only makes numbering skip it, never collide
-        for ins in rm_inputs + rm_filters + rm_outputs:
-            engine._retired_names.setdefault(
-                type(ins).__name__, set()).add(ins.name)
+        with engine._ingest_lock:
+            for ins in rm_inputs + rm_filters + rm_outputs:
+                engine._retired_names.setdefault(
+                    type(ins).__name__, set()).add(ins.name)
         replaced_ids: set = set()
         for target, _n, _p in self._replace_filters:
             hit = [f for f in cur_filters if self._matches(f, target)]
@@ -657,9 +683,13 @@ class ReloadTxn:
                 built.append(ins)
                 # the properties ITEM LIST, not a dict: repeated keys
                 # (a grep filter's several Regex rules) and declaration
-                # order are semantic
-                items = list(props.items()) if props \
-                    else old.properties.items()
+                # order are semantic. replace_filter_items stages the
+                # list directly; the dict form converts here
+                if hasattr(props, "items"):
+                    items = list(props.items()) if props \
+                        else old.properties.items()
+                else:
+                    items = props or old.properties.items()
                 for k, v in items:
                     ins.set(k, v)
                 engine._init_instance(ins)
@@ -813,10 +843,11 @@ class ReloadTxn:
         # chunk-trace taps hold their target instance (and its pool)
         # alive through engine.traces; a stale entry also blocks
         # re-enabling the trace on a same-named replacement input
-        for ins in rm_inputs:
-            ctx = engine.traces.get(ins.name)
-            if ctx is not None and ctx["input"] is ins:
-                engine.traces.pop(ins.name, None)
+        with engine._ingest_lock:
+            for ins in rm_inputs:
+                ctx = engine.traces.get(ins.name)
+                if ctx is not None and ctx["input"] is ins:
+                    engine.traces.pop(ins.name, None)
         for ins in rm_inputs:
             thread = getattr(ins, "collector_thread", None)
             if thread is not None and (

@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Optional
 
+from .lockorder import make_lock
+
 
 class TokenBucket:
     """Classic token bucket: ``rate`` tokens/second refill up to
@@ -40,7 +42,7 @@ class TokenBucket:
         self.tokens = self.capacity
         self.clock = clock
         self.updated = clock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("TokenBucket._lock")
 
     def _refill(self, now: float) -> None:
         if now > self.updated:

@@ -174,7 +174,10 @@ def attach_flux(engine, task) -> bool:
     ins.configure()
     ins.plugin.init(ins, engine)
     ins._initialized = True
-    engine.filters.append(ins)
+    # COW swap: ingest iterates engine.filters lock-free — publish a
+    # fresh list instead of mutating the shared alias
+    with engine._ingest_lock:
+        engine.filters = engine.filters + [ins]
     task.flux = FluxBinding(query, state)
     log.info(
         "stream task %s resolved against flux state (%s); NOTE: "

@@ -41,9 +41,11 @@ import threading
 import time
 from typing import List, Optional
 
+from ..core.lockorder import make_lock
+
 log = logging.getLogger("flb.device")
 
-_lock = threading.Lock()
+_lock = make_lock("device._lock")
 _state = "unattached"  # unattached | attaching | ready | failed
 _error: Optional[str] = None
 _thread: Optional[threading.Thread] = None

@@ -68,6 +68,8 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..core.lockorder import make_lock
+
 log = logging.getLogger("flb.device.fault")
 
 __all__ = [
@@ -135,7 +137,7 @@ def _regrow_after() -> int:
 
 # -- listener bridge (the engine wires fluentbit_device_* here) --------
 
-_listener_lock = threading.Lock()
+_listener_lock = make_lock("fault._listener_lock")
 _listeners: List[Callable[[str, str, object], None]] = []
 
 
@@ -214,7 +216,7 @@ class DeviceLane:
             else _breaker_cooldown(),
             on_transition=self._on_transition,
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("DeviceLane._lock")
         self._stats = {
             "launches": 0, "ok": 0, "failures": 0, "timeouts": 0,
             "fallback_segments": 0, "short_circuits": 0,
@@ -434,7 +436,7 @@ class DeviceLane:
 
 # -- the process-global lane registry ----------------------------------
 
-_registry_lock = threading.Lock()
+_registry_lock = make_lock("fault._registry_lock")
 _lanes: Dict[str, DeviceLane] = {}
 
 

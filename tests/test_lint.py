@@ -34,21 +34,26 @@ def test_package_tree_clean():
     # is the one sanctioned baseline: its recorded launch-graph debt
     # (ROADMAP item 1) is subtracted exactly — anything else fails, and
     # a stale baseline entry that no longer matches the tree fails too
+    # ... and since the locksmith pack, analysis/lock_baseline.json is
+    # the second sanctioned baseline — both are subtracted EXACTLY
     import json
 
     from fluentbit_tpu.analysis.__main__ import _canon
-    from fluentbit_tpu.analysis.registry import budget_path
+    from fluentbit_tpu.analysis.registry import budget_path, \
+        lock_baseline_path
 
-    with open(budget_path(), "r", encoding="utf-8") as fh:
-        recorded = {(d["path"], d["rule"], d["message"])
-                    for d in json.load(fh)["findings"]}
+    recorded = set()
+    for bpath in (budget_path(), lock_baseline_path()):
+        with open(bpath, "r", encoding="utf-8") as fh:
+            recorded |= {(d["path"], d["rule"], d["message"])
+                         for d in json.load(fh)["findings"]}
     findings = lint_paths([PKG])
     keys = {(_canon(f.path), f.rule, f.message) for f in findings}
     fresh = [f for f in findings
              if (_canon(f.path), f.rule, f.message) not in recorded]
     assert not fresh, "\n".join(f.render() for f in fresh)
     stale = recorded - keys
-    assert not stale, f"stale launch_budget.json entries: {stale}"
+    assert not stale, f"stale baseline entries: {stale}"
 
 
 def test_cli_exit_codes(tmp_path):
@@ -83,7 +88,10 @@ def test_list_rules():
                  "shard-unmatched-leaf", "shard-shadowed-rule",
                  "shard-indivisible-axis", "donation-aval-mismatch",
                  "shard-implicit-reshard", "jit-dynamic-shape-retrace",
-                 "codec-balance", "codec-bounds", "codec-leak"):
+                 "codec-balance", "codec-bounds", "codec-leak",
+                 "lock-order-cycle", "guarded-field-unlocked",
+                 "guarded-by-missing", "atomicity-check-then-act",
+                 "lock-held-across-dispatch", "cow-swap-aliasing"):
         assert name in proc.stdout
 
 

@@ -1,0 +1,591 @@
+"""fbtpu-locksmith: the interprocedural lock-order & lockset analyzer
+(analysis/locksmith.py) — red/green fixtures per rule, shipped-tree
+graph pins, baseline round-trip, and the static ⊇ dynamic witness
+crosscheck that keeps the model honest (core/lockorder.py).
+
+Fixture paths live OUTSIDE the package scopes ("fixtures/mod.py") so
+the scope gate analyzes them as test snippets; registry-dependent
+rules get a purpose-built GuardEntry tuple for that path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluentbit_tpu.analysis import lint_source
+from fluentbit_tpu.analysis.locksmith import (
+    build_lock_graph, graph_cycle_findings, static_order_edges)
+from fluentbit_tpu.analysis.registry import GuardEntry, lock_baseline_path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "fluentbit_tpu")
+FIX = "fixtures/mod.py"
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def smith(findings):
+    from fluentbit_tpu.analysis.locksmith import LocksmithRules
+    names = set(LocksmithRules.RULE_NAMES)
+    return [f for f in findings if f.rule in names]
+
+
+# ---------------------------------------------------------------------
+# lock-order-cycle: interprocedural acquisition-order inversions
+# ---------------------------------------------------------------------
+
+CYCLE_BAD = """
+class Foo:
+    def alpha(self):
+        with self._lock_a:
+            self._helper()
+
+    def _helper(self):
+        with self._lock_b:
+            pass
+
+    def beta(self):
+        with self._lock_b:
+            with self._lock_a:
+                pass
+"""
+
+CYCLE_GOOD = """
+class Foo:
+    def alpha(self):
+        with self._lock_a:
+            self._helper()
+
+    def _helper(self):
+        with self._lock_b:
+            pass
+
+    def beta(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+"""
+
+
+def test_lock_order_cycle_interprocedural():
+    got = smith(lint_source(CYCLE_BAD, FIX))
+    assert rules(got) == ["lock-order-cycle"]
+    # the witness path names both sides of the inversion
+    assert "Foo._lock_a" in got[0].message
+    assert "Foo._lock_b" in got[0].message
+    assert smith(lint_source(CYCLE_GOOD, FIX)) == []
+
+
+# the PR-15 shipped-tree inversion, reduced: the raw append path held
+# the input's lock while its decline continuation re-entered the
+# decode path's global lock; the collector tick nests the opposite way
+INVERSION_BAD = """
+class Engine:
+    def input_log_append(self, ins, data):
+        with ins.ingest_lock:
+            got = self._ingest_raw(ins, data)
+        return got
+
+    def _ingest_raw(self, ins, data):
+        if data is None:
+            return self._raw_tail(data)
+        return 1
+
+    def _raw_tail(self, data):
+        with self._ingest_lock:
+            return 0
+
+    def _tick(self, ins):
+        with self._ingest_lock:
+            with ins.ingest_lock:
+                pass
+"""
+
+INVERSION_GOOD = """
+class Engine:
+    def input_log_append(self, ins, data):
+        with ins.ingest_lock:
+            got = self._ingest_raw(ins, data)
+        if got is None:
+            got = self._raw_tail(data)
+        return got
+
+    def _ingest_raw(self, ins, data):
+        if data is None:
+            return None
+        return 1
+
+    def _raw_tail(self, data):
+        with self._ingest_lock:
+            return 0
+
+    def _tick(self, ins):
+        with self._ingest_lock:
+            with ins.ingest_lock:
+                pass
+"""
+
+
+def test_raw_path_inversion_regression():
+    """Red on the pre-fix engine shape (ingest_lock held across the
+    tail continuation), green on the continuation-after-release
+    restructure the PR ships."""
+    got = smith(lint_source(INVERSION_BAD, FIX))
+    assert "lock-order-cycle" in rules(got)
+    assert any("InputInstance.ingest_lock" in f.message
+               and "Engine._ingest_lock" in f.message for f in got)
+    assert smith(lint_source(INVERSION_GOOD, FIX)) == []
+
+
+SELF_DEADLOCK = """
+class Qos:
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+
+
+def test_self_deadlock_on_nonreentrant_lock():
+    got = smith(lint_source(SELF_DEADLOCK, FIX))
+    assert rules(got) == ["lock-order-cycle"]
+    assert "Qos._lock" in got[0].message
+
+
+def test_reentrant_reacquire_is_clean():
+    # Engine._ingest_lock is in the analyzer's REENTRANT set
+    src = SELF_DEADLOCK.replace("Qos", "Engine").replace(
+        "_lock", "_ingest_lock")
+    assert smith(lint_source(src, FIX)) == []
+
+
+# ---------------------------------------------------------------------
+# guarded-field-unlocked: writes_only registry entries, in-place
+# mutation IS a write
+# ---------------------------------------------------------------------
+
+FIELD_GUARDS = (GuardEntry(FIX, "_lock", ("_items",), writes_only=True),)
+
+FIELD_BAD = """
+class Foo:
+    def probe(self):
+        return len(self._items)
+
+    def bad(self, x):
+        self._items.append(x)
+"""
+
+FIELD_GOOD = """
+class Foo:
+    def probe(self):
+        return len(self._items)
+
+    def good(self, x):
+        with self._lock:
+            self._items.append(x)
+"""
+
+FIELD_ALLOWED = """
+class Foo:
+    def bad(self, x):
+        # fbtpu-lint: allow(guarded-field-unlocked) test justification
+        self._items.append(x)
+"""
+
+
+def test_guarded_field_unlocked_red_green():
+    got = smith(lint_source(FIELD_BAD, FIX, FIELD_GUARDS))
+    assert rules(got) == ["guarded-field-unlocked"]
+    assert smith(lint_source(FIELD_GOOD, FIX, FIELD_GUARDS)) == []
+    assert smith(lint_source(FIELD_ALLOWED, FIX, FIELD_GUARDS)) == []
+
+
+# ---------------------------------------------------------------------
+# guarded-by-missing: the Eraser-style lockset arm (attrs) and the
+# module-global arm
+# ---------------------------------------------------------------------
+
+ERASER_BAD = """
+class Foo:
+    def __init__(self):
+        self._curr = 0
+
+    def bump(self):
+        with self._lock:
+            self._curr += 1
+
+    def reset(self):
+        self._curr = 0
+"""
+
+ERASER_GOOD = """
+class Foo:
+    def __init__(self):
+        self._curr = 0
+
+    def bump(self):
+        with self._lock:
+            self._curr += 1
+
+    def reset(self):
+        with self._lock:
+            self._curr = 0
+"""
+
+# interprocedural: the unlocked-looking helper is ONLY called with the
+# lock already held — must-hold propagation keeps it quiet
+ERASER_HELPER_GOOD = """
+class Foo:
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def shrink(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._curr += 1
+"""
+
+
+def test_eraser_lockset_red_green():
+    got = smith(lint_source(ERASER_BAD, FIX))
+    assert rules(got) == ["guarded-by-missing"]
+    assert "_curr" in got[0].message
+    assert smith(lint_source(ERASER_GOOD, FIX)) == []
+
+
+def test_eraser_must_hold_interprocedural():
+    assert smith(lint_source(ERASER_HELPER_GOOD, FIX)) == []
+
+
+GLOBAL_BAD = """
+import threading
+
+_lock = threading.Lock()
+_cache = {}
+
+
+def put(k, v):
+    _cache[k] = v
+
+
+def get(k):
+    with _lock:
+        return _cache.get(k)
+"""
+
+GLOBAL_GOOD = """
+import threading
+
+_lock = threading.Lock()
+_cache = {}
+
+
+def put(k, v):
+    with _lock:
+        _cache[k] = v
+
+
+def get(k):
+    with _lock:
+        return _cache.get(k)
+"""
+
+GLOBAL_LOCALS_ONLY = """
+import threading
+
+_lock = threading.Lock()
+
+
+def tally(xs):
+    counts = {}
+    for x in xs:
+        counts[x] = counts.get(x, 0) + 1
+    with _lock:
+        return len(counts)
+"""
+
+
+GLOBAL_GUARDS = (GuardEntry(FIX, "_lock", ("_cache",),
+                            writes_only=True, kind="global"),)
+
+
+def test_global_lockset_red_green():
+    # unregistered global mutated in a lock-owning module: the module
+    # owes the registry an entry — even if this mutation site happens
+    # to hold the lock, nothing binds future call paths to it
+    got = smith(lint_source(GLOBAL_BAD, FIX))
+    assert rules(got) == ["guarded-by-missing"]
+    assert "_cache" in got[0].message
+    # registered + mutated under the registered lock: clean
+    assert smith(lint_source(GLOBAL_GOOD, FIX, GLOBAL_GUARDS)) == []
+    # registered + mutated OFF the lock: the lockset rule takes over
+    got = smith(lint_source(GLOBAL_BAD, FIX, GLOBAL_GUARDS))
+    assert rules(got) == ["guarded-field-unlocked"]
+
+
+def test_global_arm_ignores_locals():
+    # a local dict mutated inside a module that owns a lock is not a
+    # shared-state violation (the shadowing gate)
+    assert smith(lint_source(GLOBAL_LOCALS_ONLY, FIX)) == []
+
+
+# ---------------------------------------------------------------------
+# atomicity-check-then-act
+# ---------------------------------------------------------------------
+
+ATOM_GUARDS = (GuardEntry(FIX, "_lock", ("_state",)),)
+
+ATOM_BAD = """
+class Foo:
+    def flip(self):
+        with self._lock:
+            cur = self._state
+        with self._lock:
+            self._state = cur + 1
+"""
+
+ATOM_DOUBLE_CHECK = """
+class Foo:
+    def flip(self):
+        with self._lock:
+            cur = self._state
+        new = cur + 1
+        with self._lock:
+            if self._state == cur:
+                self._state = new
+"""
+
+ATOM_BRANCHES = """
+class Foo:
+    def flip(self, fast):
+        with self._lock:
+            cur = self._state
+        if fast:
+            return cur
+        with self._lock:
+            self._state = cur + 1
+            return cur
+"""
+
+
+def test_atomicity_red():
+    got = smith(lint_source(ATOM_BAD, FIX, ATOM_GUARDS))
+    assert rules(got) == ["atomicity-check-then-act"]
+
+
+def test_atomicity_validated_double_check_is_green():
+    # the act re-reads guarded state under the re-acquired lock (the
+    # ops/fault.py current_mesh shape): a correct double-check
+    assert smith(lint_source(ATOM_DOUBLE_CHECK, FIX, ATOM_GUARDS)) == []
+
+
+def test_atomicity_alternative_branches_are_green():
+    # a return between the two blocks means they are alternatives,
+    # not a released-and-reacquired sequence
+    assert smith(lint_source(ATOM_BRANCHES, FIX, ATOM_GUARDS)) == []
+
+
+# ---------------------------------------------------------------------
+# lock-held-across-dispatch
+# ---------------------------------------------------------------------
+
+DISPATCH_BAD = """
+class Engine:
+    def flush(self, lane, fn, batch):
+        with self._ingest_lock:
+            lane.run(fn, batch)
+"""
+
+DISPATCH_BAD_INTERPROC = """
+class Engine:
+    def flush(self, lane, fn, batch):
+        with self._ingest_lock:
+            self._go(lane, fn, batch)
+
+    def _go(self, lane, fn, batch):
+        lane.run(fn, batch)
+"""
+
+DISPATCH_GOOD = """
+class Engine:
+    def flush(self, lane, fn, batch):
+        with self._ingest_lock:
+            staged = list(batch)
+        lane.run(fn, staged)
+"""
+
+
+def test_dispatch_under_ingest_lock():
+    got = smith(lint_source(DISPATCH_BAD, FIX))
+    assert rules(got) == ["lock-held-across-dispatch"]
+    got = smith(lint_source(DISPATCH_BAD_INTERPROC, FIX))
+    assert rules(got) == ["lock-held-across-dispatch"]
+    assert smith(lint_source(DISPATCH_GOOD, FIX)) == []
+
+
+# ---------------------------------------------------------------------
+# cow-swap-aliasing
+# ---------------------------------------------------------------------
+
+COW_BAD = """
+class Engine:
+    def add(self, ins):
+        self.filters.append(ins)
+"""
+
+COW_GOOD = """
+class Engine:
+    def add(self, ins):
+        with self._ingest_lock:
+            self.filters = self.filters + [ins]
+"""
+
+COW_OTHER_CLASS = """
+class Registry:
+    def register(self, name, plugin):
+        self.inputs[name] = plugin
+"""
+
+
+def test_cow_swap_red_green():
+    got = smith(lint_source(COW_BAD, FIX))
+    assert rules(got) == ["cow-swap-aliasing"]
+    assert smith(lint_source(COW_GOOD, FIX)) == []
+    # a same-named dict on a NON-COW class (the plugin-type registry)
+    # is not the engine's reader-snapshot contract
+    assert smith(lint_source(COW_OTHER_CLASS, FIX)) == []
+
+
+# ---------------------------------------------------------------------
+# the shipped tree: graph pins, acyclicity, baseline round-trip
+# ---------------------------------------------------------------------
+
+def test_shipped_graph_is_acyclic_and_pinned():
+    graph = build_lock_graph()
+    assert graph["cycles"] == []
+    assert list(graph_cycle_findings()) == []
+    # the committed baseline records the same shape the live walk sees
+    with open(lock_baseline_path(), "r", encoding="utf-8") as fh:
+        recorded = json.load(fh)
+    assert recorded["graph"]["nodes"] == len(graph["nodes"])
+    assert recorded["graph"]["edges"] == len(graph["edges"])
+    assert recorded["graph"]["cycles"] == 0
+    # structure pins: the canonical engine-plane orderings must exist
+    edges = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert ("Engine._ingest_lock", "InputInstance.ingest_lock") in edges
+    assert ("Engine._reload_lock", "Engine._ingest_lock") in edges
+    # ... and the inversions this PR fixed must NOT
+    assert ("InputInstance.ingest_lock", "Engine._ingest_lock") not in edges
+    assert ("Engine._ingest_lock", "Engine._reload_lock") not in edges
+
+
+def test_baseline_stale_entry_detection(tmp_path, monkeypatch):
+    from fluentbit_tpu.analysis.__main__ import _lock_findings
+
+    # a pristine baseline yields nothing on a clean tree
+    assert [f for f in _lock_findings([])
+            if f.rule == "lock-baseline-stale"] == []
+    # a baseline entry matching no live finding is flagged stale
+    fake = tmp_path / "lock_baseline.json"
+    with open(lock_baseline_path(), "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    payload["findings"].append({
+        "path": "fluentbit_tpu/core/engine.py", "rule": "cow-swap-aliasing",
+        "message": "long-fixed debt", "severity": "error"})
+    fake.write_text(json.dumps(payload))
+    monkeypatch.setattr(
+        "fluentbit_tpu.analysis.registry.lock_baseline_path",
+        lambda: str(fake))
+    got = [f for f in _lock_findings([]) if f.rule == "lock-baseline-stale"]
+    assert len(got) == 1 and "long-fixed debt" in got[0].message
+
+
+def test_missing_baseline_is_an_error(monkeypatch, tmp_path):
+    from fluentbit_tpu.analysis.__main__ import _lock_findings
+
+    monkeypatch.setattr(
+        "fluentbit_tpu.analysis.registry.lock_baseline_path",
+        lambda: str(tmp_path / "nope.json"))
+    got = _lock_findings([])
+    assert any(f.rule == "lock-baseline-stale" and f.severity == "error"
+               for f in got)
+
+
+def test_graph_cli_renders():
+    for mode in ("lock", "lock-dot"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "fluentbit_tpu.analysis",
+             "--graph", mode],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        if mode == "lock":
+            g = json.loads(proc.stdout)
+            assert g["cycles"] == [] and g["nodes"]
+        else:
+            assert proc.stdout.startswith("digraph lock_order")
+
+
+# ---------------------------------------------------------------------
+# ground truth: static ⊇ dynamic (the witness recorder)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_static_graph_covers_witnessed_edges(monkeypatch):
+    """Drive a representative workload under FBTPU_LOCK_WITNESS and
+    assert every dynamically recorded acquisition edge exists in the
+    static order graph. A missing edge means the analyzer's call walk
+    lost a path — this test fails loudly instead of the model rotting."""
+    import fluentbit_tpu as flb
+    from fluentbit_tpu.core import lockorder
+
+    monkeypatch.setenv("FBTPU_LOCK_WITNESS", "1")
+    lockorder.witness_reset()
+
+    ctx = flb.create(flush="40ms", grace="1")
+    in_ffd = ctx.input("lib", tag="t")
+    ctx.filter("grep", match="t", regex="log keep")
+    got = []
+    ctx.output("lib", match="t", callback=lambda d, t: got.append(d))
+    ctx.start()
+    try:
+        for k in range(12):
+            ctx.push(in_ffd, json.dumps(
+                [k, {"log": f"keep-{k}", "k": k}]))
+        ctx.flush_now()
+        # a reload commit exercises _reload_lock → _ingest_lock →
+        # per-input locks
+        txn = ctx.engine.reload_txn()
+        txn.replace_filter("grep.0")
+        assert txn.commit() == 1
+        for k in range(12, 18):
+            ctx.push(in_ffd, json.dumps(
+                [k, {"log": f"keep-{k}", "k": k}]))
+        ctx.flush_now()
+        deadline = time.time() + 8.0
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctx.stop()
+
+    dynamic = set(lockorder.witness_edges())
+    assert dynamic, "witness recorded nothing — recorder not engaged?"
+    static = set(static_order_edges())
+    missing = dynamic - static
+    assert not missing, (
+        f"dynamic edges missing from the static order graph: "
+        f"{sorted(missing)}")
+    # and the static graph itself stays acyclic (cheap re-assert here
+    # so THIS test's failure output carries both halves of the story)
+    assert build_lock_graph()["cycles"] == []

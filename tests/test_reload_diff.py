@@ -1,0 +1,150 @@
+"""core/reload_diff.py — the SIGHUP diff driver: apply a config-file
+edit as one ReloadTxn generation swap instead of a full restart."""
+
+import os
+import textwrap
+
+import pytest
+
+import fluentbit_tpu as flb
+from fluentbit_tpu.config_format import apply_to_context, load_config_file
+from fluentbit_tpu.core.reload_diff import (
+    ReloadDiffUnsupported, reload_from_file)
+
+BASE = """\
+[SERVICE]
+    Flush 0.04
+    Grace 1
+
+[INPUT]
+    Name dummy
+    Tag t
+
+[FILTER]
+    Name grep
+    Match t
+    Regex log keep
+
+[OUTPUT]
+    Name null
+    Match t
+"""
+
+
+def write(tmp_path, body, name="flb.conf"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+@pytest.fixture()
+def running(tmp_path):
+    path = write(tmp_path, BASE)
+    ctx = flb.create()
+    cf = load_config_file(path, env={})
+    apply_to_context(ctx, cf, os.path.dirname(path))
+    ctx.start()
+    try:
+        yield ctx, path, tmp_path
+    finally:
+        ctx.stop()
+
+
+def test_unchanged_file_commits_nothing(running):
+    ctx, path, _ = running
+    gen, summary = reload_from_file(ctx.engine, path)
+    assert gen is None
+    assert not any(summary.values())
+    assert ctx.engine.reload_count == 0
+
+
+def test_filter_edit_is_in_place_replace(running):
+    ctx, path, tmp = running
+    old_input = ctx.engine.inputs[0]
+    edited = write(tmp, BASE.replace("log keep", "log drop"), "e.conf")
+    gen, summary = reload_from_file(ctx.engine, edited)
+    assert gen == 1
+    assert summary["replace_filters"] == 1
+    assert summary["rm_filters"] == summary["add_filters"] == 0
+    # untouched instances carry over — the input keeps its identity
+    # (tail offsets / sockets in the real plugins)
+    assert ctx.engine.inputs[0] is old_input
+    assert ctx.engine.filters[0].properties.get("regex") == "log drop"
+    # applying the same file again is a no-op
+    gen2, summary2 = reload_from_file(ctx.engine, edited)
+    assert gen2 is None and not any(summary2.values())
+
+
+def test_structural_filter_change_degrades_to_remove_add(running):
+    ctx, path, tmp = running
+    edited = write(tmp, BASE + textwrap.dedent("""\
+
+        [FILTER]
+            Name record_modifier
+            Match t
+            Record site a
+        """), "e.conf")
+    gen, summary = reload_from_file(ctx.engine, edited)
+    assert gen == 1
+    assert summary["rm_filters"] == 1
+    assert summary["add_filters"] == 2
+    assert summary["replace_filters"] == 0
+    assert [f.plugin.name for f in ctx.engine.filters] == \
+        ["grep", "record_modifier"]
+
+
+def test_input_output_multiset_add_remove(running):
+    ctx, path, tmp = running
+    edited = write(tmp, BASE.replace(
+        "[OUTPUT]\n    Name null\n    Match t",
+        "[OUTPUT]\n    Name null\n    Match t\n\n"
+        "[OUTPUT]\n    Name counter\n    Match t"), "e.conf")
+    gen, summary = reload_from_file(ctx.engine, edited)
+    assert gen == 1
+    assert summary["add_outputs"] == 1 and summary["rm_outputs"] == 0
+    assert sorted(o.plugin.name for o in ctx.engine.outputs) == \
+        ["counter", "null"]
+    # removing it again matches the original declaration back up
+    gen, summary = reload_from_file(ctx.engine, path)
+    assert gen == 2
+    assert summary["rm_outputs"] == 1 and summary["add_outputs"] == 0
+
+
+def test_parser_sections_are_add_only(running):
+    ctx, path, tmp = running
+    with_parser = BASE + textwrap.dedent("""\
+
+        [PARSER]
+            Name simple
+            Format regex
+            Regex ^(?<word>[a-z]+)$
+        """)
+    edited = write(tmp, with_parser, "e.conf")
+    gen, summary = reload_from_file(ctx.engine, edited)
+    assert gen == 1 and summary["add_parsers"] == 1
+    assert "simple" in ctx.engine.parsers
+    # unchanged parser definition does not re-commit (FlbRegex carries
+    # no __eq__; the fingerprint comparison must see through it)
+    gen2, summary2 = reload_from_file(ctx.engine, edited)
+    assert gen2 is None and not any(summary2.values())
+    # a parser ABSENT from the file is left alone (parsers_file model)
+    gen3, _ = reload_from_file(ctx.engine, path)
+    assert gen3 is None
+    assert "simple" in ctx.engine.parsers
+
+
+def test_unsupported_sections_fall_back(running):
+    ctx, path, tmp = running
+    edited = write(tmp, BASE + "\n[CUSTOM]\n    Name calyptia\n", "e.conf")
+    with pytest.raises(ReloadDiffUnsupported):
+        reload_from_file(ctx.engine, edited)
+    # nothing committed, pipeline untouched
+    assert ctx.engine.reload_count == 0
+    assert len(ctx.engine.filters) == 1
+
+
+def test_hot_reload_diff_service_key():
+    ctx = flb.create()
+    assert ctx.engine.service.hot_reload_diff is False
+    ctx.service_set(hot_reload_diff="on")
+    assert ctx.engine.service.hot_reload_diff is True
