@@ -790,6 +790,117 @@ def measure_shrink(seconds: float = 1.2) -> dict:
     return out
 
 
+def measure_memscope(seconds: float = 1.2) -> dict:
+    """fbtpu-memscope stage: what the copy census + offset sidecars buy
+    at runtime. Three lanes: (1) bytes-copied-per-record through chunk
+    append → write-through → crash replay under the FBTPU_COPY_WITNESS
+    recorder, against the pre-census pipeline reconstructed from the
+    census's eliminated-pass ledger; (2) backlog replay lines/s with
+    the mmap offset-sidecar fast path vs the Python decode walk over
+    the SAME on-disk backlog (bit-exactness is tier-1's contract, the
+    bench measures the speed it pays for); (3) the sidecar hit/trust
+    rates replay actually achieved."""
+    import shutil
+    import tempfile
+
+    from fluentbit_tpu.analysis.memscope import ELIMINATED, WITNESS_SHAPES
+    from fluentbit_tpu.codec.chunk import Chunk
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.core import copywitness
+    from fluentbit_tpu.core.storage import Storage
+
+    out = {}
+    n = CHUNK_RECORDS
+    data = b"".join(encode_event({"log": f"bench line {i}", "n": i},
+                                 float(i))
+                    for i in range(n))
+    rec_bytes = len(data) / n
+
+    # lane 1: witnessed copies per record through the shipped pipeline
+    prev = os.environ.get("FBTPU_COPY_WITNESS")
+    os.environ["FBTPU_COPY_WITNESS"] = "1"
+    copywitness.refresh()
+    copywitness.witness_reset()
+    tmp = tempfile.mkdtemp(prefix="fbtpu-memscope-")
+    try:
+        st = Storage(tmp, checksum=True)
+        c = Chunk("bench", in_name="bench.0")
+        c.append(data, n)
+        st.write_through(c, data)
+        st.finalize(c)
+        st.close()
+        recovered = Storage(tmp, checksum=True).scan_backlog()
+        counts = copywitness.witness_counts()
+        kinds = {s: k for s, (_x, k, _note) in WITNESS_SHAPES.items()}
+        copied = sum(b for s, (_e, b) in counts.items()
+                     if kinds.get(s) == "copy")
+        walked = sum(b for s, (_e, b) in counts.items()
+                     if kinds.get(s) == "walk")
+        after = copied / n
+        # every eliminated pass re-copied each ingested byte once —
+        # the ledger is what the same workload cost before the census
+        eliminated = len(ELIMINATED) * rec_bytes
+        out["records"] = n
+        out["recovered_records"] = sum(ch.records for ch in recovered)
+        out["bytes_copied_per_record"] = round(after, 1)
+        out["bytes_copied_per_record_before_census"] = round(
+            after + eliminated, 1)
+        out["eliminated_copy_passes"] = len(ELIMINATED)
+        out["bytes_walked_per_record"] = round(walked / n, 1)
+        out["witness_sites_hit"] = sorted(counts)
+    finally:
+        if prev is None:
+            os.environ.pop("FBTPU_COPY_WITNESS", None)
+        else:
+            os.environ["FBTPU_COPY_WITNESS"] = prev
+        copywitness.refresh()
+        copywitness.witness_reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # lane 2: replay rate, sidecar fast path vs decode walk, over one
+    # multi-chunk backlog (scan_backlog leaves healthy files in place,
+    # so the same directory replays repeatedly)
+    tmp = tempfile.mkdtemp(prefix="fbtpu-memscope-replay-")
+    try:
+        st = Storage(tmp, checksum=True)
+        n_chunks = 4
+        for k in range(n_chunks):
+            c = Chunk("bench", in_name=f"bench.{k}")
+            c.append(data, n)
+            st.write_through(c, data)
+            st.finalize(c)
+        st.close()
+
+        def replay_rate(sidecars: bool):
+            reps = 0
+            lines = 0
+            last = None
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                last = Storage(tmp, checksum=True)
+                last.sidecars = sidecars
+                lines += sum(ch.records for ch in last.scan_backlog())
+                reps += 1
+            return round(lines / (time.perf_counter() - t0)), last
+
+        mmap_lps, st_fast = replay_rate(True)
+        decode_lps, _ = replay_rate(False)
+        out["replay_mmap_lines_per_sec"] = mmap_lps
+        out["replay_decode_lines_per_sec"] = decode_lps
+        out["replay_speedup"] = (round(mmap_lps / decode_lps, 2)
+                                 if decode_lps else None)
+        hits = st_fast.replay_sidecar_hits
+        walks = st_fast.replay_decode_walks
+        out["sidecar_hit_rate"] = (round(hits / (hits + walks), 3)
+                                   if hits + walks else None)
+        out["sidecar_trusted_rate"] = (
+            round(st_fast.replay_sidecar_trusted / hits, 3)
+            if hits else None)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def check_bit_exact(raw_chunks) -> bool:
     """Device/native raw path vs the pure-Python verdict chain."""
     ok = True
@@ -1169,6 +1280,11 @@ def child_main(mode: str) -> None:
             result["shrink"] = measure_shrink()
         except Exception as e:
             result["shrink"] = {"error": repr(e)}
+        _progress(stage="cpu:memscope")
+        try:
+            result["memscope"] = measure_memscope()
+        except Exception as e:
+            result["memscope"] = {"error": repr(e)}
     if ok and mode == "cpu":
         run_kernel_only()
     from fluentbit_tpu import native
@@ -1331,6 +1447,9 @@ def final_line(cpu, dev, dev_err, extras):
         if (kernel_src or {}).get("kernel_lines_per_sec") else None,
         "staging_lines_per_sec": (best or {}).get(
             "staging_lines_per_sec"),
+        # fbtpu-memscope: copy-census runtime payoff (bytes-copied per
+        # record, mmap-sidecar replay vs decode-walk rate, hit rates)
+        "memscope": (cpu or {}).get("memscope"),
         "unfiltered_ingest_lines_per_sec": (best or {}).get(
             "unfiltered_lines_per_sec"),
         "breakdown": (best or {}).get("breakdown"),

@@ -67,6 +67,15 @@ Six rule families (see ANALYSIS.md for the full contract):
   byte crossings, the donate set, host scatters
   (analysis.launchgraph; budget gated by analysis/launch_budget.json,
   rendered by ``--graph json|dot``).
+- **host-memory pack** (`host-redundant-copy`,
+  `host-decode-then-restage`, `host-mutable-view-escape`,
+  `mmap-lifetime-escape`): the fbtpu-memscope copy census — a walk
+  from every ingest entry counting the materialization passes and
+  byte walks each record pays, cross-referenced against the
+  ``core.copywitness`` instrumentation sites' declared per-record
+  byte budgets, plus escape rules for mutable staging-arena views and
+  views that outlive their mmap (analysis.memscope; census gated by
+  analysis/copy_budget.json).
 
 The native C/C++ data plane has its own gate (analysis.native_gate):
 clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
@@ -178,6 +187,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .launchgraph import LaunchGraphRules
     from .locks import AwaitUnderLockRule, GuardedByRule
     from .locksmith import LocksmithRules
+    from .memscope import MemscopeRules
     from .purity import JaxPurityRules
     from .qos import UnmeteredIngestRule
     from .shrink import UnminimizedDfaRule
@@ -199,6 +209,7 @@ def _build_rules(guards=None) -> List[Rule]:
         LaunchGraphRules(),
         SpecCheckRules(),
         LocksmithRules(guards),
+        MemscopeRules(),
     ]
 
 

@@ -25,6 +25,14 @@ Modes:
 - ``--write-budget``  regenerate ``analysis/launch_budget.json`` (the
                       launch-graph findings baseline + the gated
                       budget snapshot) and exit 0
+- ``--write-copy-budget``  regenerate ``analysis/copy_budget.json``
+                      (the fbtpu-memscope findings baseline + the
+                      host copy census + the eliminated-pass ledger)
+                      and exit 0
+- ``--write-baselines``  refresh ALL committed baselines (launch
+                      budget, lock baseline, copy budget) in one
+                      atomic pass and exit 0 — the one command to run
+                      after deliberately changing any gated plane
 
 Baseline entries match on (path, rule, message) — line-insensitive, so
 reformatting never churns the file. Every suppression in code uses
@@ -37,7 +45,9 @@ suppression for reviewed exceptions.
 multi-launch reality — ROADMAP item 1's debt) are subtracted
 automatically, so the default invocation stays a zero-findings gate
 while the debt remains visible, diffable, and gated (see ANALYSIS.md
-"fbtpu-xray").
+"fbtpu-xray"). ``analysis/lock_baseline.json`` and
+``analysis/copy_budget.json`` play the same role for the locksmith
+and memscope packs.
 """
 
 from __future__ import annotations
@@ -217,6 +227,74 @@ def _write_lock_baseline() -> str:
     return path
 
 
+def _copy_findings(current_findings):
+    """The fbtpu-memscope ``--all`` leg: compare the live host copy
+    census against the committed ``analysis/copy_budget.json`` —
+    growth in copy/walk passes per ingest entry, a new entry or
+    witness site, or an unbudgeted ``copywitness.count`` site is an
+    error finding; improvements come back as notes. A missing budget
+    file and stale baseline entries surface too (the gate must never
+    silently lose its baseline, and fixed debt must leave the file)."""
+    from .memscope import (MemscopeRules, build_copy_census,
+                           census_snapshot, compare_copy_budget)
+    from .registry import copy_budget_path
+
+    cpath = copy_budget_path()
+    rel = _canon(cpath)
+    if not os.path.isfile(cpath):
+        return [Finding(rel, 1, 0, "copy-budget-regression",
+                        "analysis/copy_budget.json is missing: the "
+                        "host copy-census gate has no baseline — "
+                        "regenerate it with --write-copy-budget")], []
+    with open(cpath, "r", encoding="utf-8") as fh:
+        baseline = json.load(fh)
+    current = census_snapshot(build_copy_census())
+    regressions, notes = compare_copy_budget(current,
+                                             baseline.get("census", {}))
+    findings = [Finding(rel, 1, 0, "copy-budget-regression", msg)
+                for msg in regressions]
+    keys = _load_baseline(cpath)
+    names = set(MemscopeRules.RULE_NAMES)
+    live = {(_canon(f.path), f.rule, f.message)
+            for f in current_findings if f.rule in names}
+    for key in sorted(keys - live):
+        findings.append(Finding(
+            rel, 1, 0, "copy-baseline-stale",
+            f"baseline entry no longer matches any finding (fixed "
+            f"debt? remove it): {key[1]} @ {key[0]}: {key[2]}",
+            "warning"))
+    return findings, notes
+
+
+def _write_copy_budget() -> str:
+    """Regenerate analysis/copy_budget.json: the memscope rule
+    findings on the shipped tree (justified debt), the regression-
+    gated census snapshot, and the eliminated-pass ledger that keeps
+    the zero-copy work's wins reviewable in the diff."""
+    from .memscope import (ELIMINATED, MemscopeRules, build_copy_census,
+                           census_snapshot)
+    from .registry import copy_budget_path
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    names = set(MemscopeRules.RULE_NAMES)
+    findings = [f for f in lint_paths([pkg]) if f.rule in names]
+    payload = {
+        "version": 1,
+        "findings": [
+            {"path": _canon(f.path), "rule": f.rule,
+             "message": f.message, "severity": f.severity}
+            for f in findings
+        ],
+        "census": census_snapshot(build_copy_census()),
+        "eliminated": list(ELIMINATED),
+    }
+    path = copy_budget_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
 def _write_baseline(path: str, findings) -> None:
     payload = {
         "version": 1,
@@ -269,6 +347,12 @@ def main(argv=None) -> int:
     ap.add_argument("--write-lock-baseline", action="store_true",
                     help="regenerate analysis/lock_baseline.json and "
                          "exit")
+    ap.add_argument("--write-copy-budget", action="store_true",
+                    help="regenerate analysis/copy_budget.json and "
+                         "exit")
+    ap.add_argument("--write-baselines", action="store_true",
+                    help="refresh launch budget, lock baseline AND "
+                         "copy budget in one pass, then exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule set and exit")
     args = ap.parse_args(argv)
@@ -277,6 +361,7 @@ def main(argv=None) -> int:
         from .batch import BatchExactnessRules
         from .launchgraph import LaunchGraphRules
         from .locksmith import LocksmithRules
+        from .memscope import MemscopeRules
         from .native_gate import NATIVE_RULES
         from .speccheck import SpecCheckRules
 
@@ -284,6 +369,9 @@ def main(argv=None) -> int:
             if isinstance(r, LocksmithRules):
                 for n in r.RULE_NAMES:
                     print(f"{n}: (locksmith pack) {r.description}")
+            elif isinstance(r, MemscopeRules):
+                for n in r.RULE_NAMES:
+                    print(f"{n}: (memscope pack) {r.description}")
             elif isinstance(r, BatchExactnessRules):
                 for n in r.RULE_NAMES:
                     print(f"{n}: (batch-exactness pack) {r.description}")
@@ -346,6 +434,19 @@ def main(argv=None) -> int:
         print(f"fbtpu-lint: lock baseline written to {path}")
         return 0
 
+    if args.write_copy_budget:
+        path = _write_copy_budget()
+        print(f"fbtpu-lint: copy budget written to {path}")
+        return 0
+
+    if args.write_baselines:
+        for writer, label in ((_write_budget, "launch/transfer budget"),
+                              (_write_lock_baseline, "lock baseline"),
+                              (_write_copy_budget, "copy budget")):
+            path = writer()
+            print(f"fbtpu-lint: {label} written to {path}")
+        return 0
+
     findings: list = []
     notes: list = []
 
@@ -382,6 +483,9 @@ def main(argv=None) -> int:
         findings.extend(bf)
         notes = list(notes) + list(bnotes)
         findings.extend(_lock_findings(findings))
+        cf, cnotes = _copy_findings(findings)
+        findings.extend(cf)
+        notes = list(notes) + list(cnotes)
 
     if args.write_baseline:
         _write_baseline(args.write_baseline, findings)
@@ -404,9 +508,11 @@ def main(argv=None) -> int:
         # by the budget numbers rather than re-reported on every run
         # (the lock baseline plays the same role for the locksmith
         # pack — stale entries surface as lock-baseline-stale in --all)
-        from .registry import budget_path, lock_baseline_path
+        from .registry import budget_path, copy_budget_path, \
+            lock_baseline_path
 
-        for bpath in (budget_path(), lock_baseline_path()):
+        for bpath in (budget_path(), lock_baseline_path(),
+                      copy_budget_path()):
             if os.path.isfile(bpath):
                 keys = _load_baseline(bpath)
                 findings, hit = _subtract(findings, keys)

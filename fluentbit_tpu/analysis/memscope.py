@@ -1,0 +1,891 @@
+"""fbtpu-memscope: the host copy-census and buffer-escape analyzer.
+
+The zero-copy ingest work (sidecar offset tables, mmap replay, the
+``_buf_arg`` ctypes pass-through) only stays zero-copy if the tree can
+*see* every host pass over record bytes. This module makes the host
+memory plane reviewable the way fbtpu-xray made the PCIe plane
+reviewable: it walks, from every ingest entry
+(``input_log_append`` / ``input_event_append`` / ``_ingest_raw`` and
+the backlog replay root ``_read_chunk_file``), the same-module call
+closure and counts the **materialization passes** (``bytes()`` /
+``bytearray()`` / ``b"".join`` / ``.copy()`` / re-encode) and **byte
+walks** (msgpack ``Unpacker`` decode, ``native.scan_offsets`` /
+``count_records``) each record pays, and it cross-references the
+``core.copywitness`` instrumentation sites against a declared symbolic
+byte budget evaluated at ``COPY_PARAMS`` (``registry.BUDGET_PARAMS``
+plus the canonical record payload ``N``).
+
+The census is kept honest two ways:
+
+- **statically**: every ``copywitness.count("<site>", ...)`` call in
+  the census modules must have a budget entry in ``WITNESS_SHAPES``
+  (an unbudgeted site is a ``copy-budget-regression``), and every
+  budget entry must still exist in source (stale entries surface too);
+- **dynamically**: the ``FBTPU_COPY_WITNESS=1`` runtime witness
+  accumulates (events, bytes) per site, and the tier-1 crosscheck
+  asserts the static census is a superset of whatever the witness
+  observed (``witness_crosscheck``).
+
+On top of the census, four rules (suppress with
+``# fbtpu-lint: allow(<rule>)`` + justification; shipped debt is
+baselined in ``analysis/copy_budget.json`` under the
+``(path, rule, message)`` key scheme):
+
+- ``host-redundant-copy`` — the same pure expression is materialized
+  twice (``bytes(x)`` … ``bytes(x)``) in one function with no rebind
+  between: the second pass re-copies identical bytes.
+- ``host-decode-then-restage`` — a value decoded from msgpack bytes
+  (``Unpacker`` / ``unpackb``) flows into a re-encode
+  (``packb`` / ``pack_event``) in the same function: the record was
+  walked, heap-objectified, and re-serialized when a raw-byte slice
+  (offset sidecar) carries it through untouched.
+- ``host-mutable-view-escape`` — a view over the per-thread staging
+  arena (``native.stage_field`` result, ``np.frombuffer`` /
+  ``memoryview`` over an ``_arena`` / ``_tls`` buffer) escapes the
+  function by return or attribute store without a ``bytes()``
+  materialization: the next stage call rewrites those bytes under the
+  caller.
+- ``mmap-lifetime-escape`` — a view derived from ``mmap.mmap`` escapes
+  by return / attribute store / container append without ``bytes()``:
+  the buffer outlives the map and faults (or silently mutates) after
+  close.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from . import Finding, Module, Rule
+from .registry import BUDGET_PARAMS
+
+__all__ = [
+    "MemscopeRules", "build_copy_census", "census_snapshot",
+    "compare_copy_budget", "canonical_copy_env", "witness_crosscheck",
+    "COPY_PARAMS", "WITNESS_SHAPES", "INGEST_ENTRIES", "ELIMINATED",
+]
+
+#: Host-memory modules in census scope (also the rule scope): the
+#: ingest/persistence data plane plus the ctypes boundary.
+SCOPES = ("fluentbit_tpu/core/", "fluentbit_tpu/codec/",
+          "fluentbit_tpu/native/")
+
+#: Modules the census walks (ingest entries + witness sites live here).
+CENSUS_MODULES = ("core/engine.py", "core/storage.py", "codec/chunk.py")
+
+#: Copy-census walk roots: the three ingest entries plus the backlog
+#: replay root (crash recovery re-pays host copies too).
+INGEST_ENTRIES = ("input_log_append", "input_event_append",
+                  "_ingest_raw", "_read_chunk_file")
+
+#: registry.BUDGET_PARAMS plus the canonical record payload bytes the
+#: symbolic per-record costs evaluate at. Kept memscope-local: the
+#: launch-budget gate compares its own params and must not see N.
+COPY_PARAMS: Dict[str, int] = dict(BUDGET_PARAMS, N=256)
+
+#: Symbolic per-record byte cost of every copywitness site, split by
+#: kind: a "copy" materializes record bytes into a new buffer, a
+#: "walk" traverses them in place. The census cross-references this
+#: table against the ``copywitness.count`` calls actually in source.
+WITNESS_SHAPES: Dict[str, Tuple[str, str, str]] = {
+    "engine.cond.materialize": (
+        "N", "copy",
+        "conditional-routing payload handed to the route splitter as "
+        "one contiguous buffer (only when the pool returned parts)"),
+    "engine.decoded.materialize": (
+        "N", "copy",
+        "decoded-ingest payload materialized once before "
+        "write-through + routing (was twice before the census)"),
+    "chunk.buf.materialize": (
+        "N", "copy",
+        "chunk.buf setter adopting a non-bytes payload (bytes "
+        "payloads are adopted copy-free)"),
+    "chunk.append.materialize": (
+        "N", "copy",
+        "chunk.append normalizing a non-bytes record (bytes records "
+        "are appended copy-free)"),
+    "storage.write.offset_scan": (
+        "N", "walk",
+        "native.scan_offsets pass building the sidecar offset table "
+        "at write-through time (callers that already know the record "
+        "ends skip it)"),
+    "storage.replay.decode_walk": (
+        "N", "walk",
+        "full msgpack Unpacker walk of a replayed chunk — the "
+        "fallback the sidecar fast path eliminates"),
+    "storage.replay.validate_walk": (
+        "N", "walk",
+        "native.count_records validation of a non-FINAL sidecar "
+        "before its offsets are trusted (C walk, no heap objects)"),
+    "storage.replay.materialize": (
+        "N", "copy",
+        "mmap replay materializing the covered payload span into "
+        "adoptable bytes before the map closes"),
+}
+
+#: The shipped copy passes this PR eliminated — the ledger the
+#: committed copy_budget.json carries so the diff stays reviewable.
+#: Each entry: (pass, where, bytes_per_record saved, how).
+ELIMINATED: Tuple[Dict[str, str], ...] = (
+    {"pass": "engine.decoded.double-materialize",
+     "where": "core/engine.py input_log_append (decoded branch)",
+     "bytes_per_record": "N",
+     "how": "payload is materialized once and shared by write-through "
+            "and routing instead of bytes(out) twice"},
+    {"pass": "engine.cond.double-materialize",
+     "where": "core/engine.py input_log_append (cond-routing branch)",
+     "bytes_per_record": "N",
+     "how": "conditional-routing buffer is materialized once; the "
+            "route splitter slices raw bytes by sidecar offsets "
+            "instead of re-packing decoded records"},
+    {"pass": "storage.replay.double-copy",
+     "where": "core/storage.py _read_chunk_file",
+     "bytes_per_record": "N",
+     "how": "replay adopts the payload bytes directly (chunk.buf "
+            "setter no longer re-copies what the reader just built); "
+            "untorn files skip the tail slice entirely"},
+    {"pass": "native.ctypes.pre-copy",
+     "where": "native/__init__.py _buf_arg",
+     "bytes_per_record": "N",
+     "how": "memoryview/mmap buffers cross the ctypes boundary "
+            "zero-copy via np.frombuffer instead of bytes(buf) before "
+            "every native call"},
+)
+
+_SEVERITY = {
+    "host-redundant-copy": "warning",
+    "host-decode-then-restage": "warning",
+    "host-mutable-view-escape": "error",
+    "mmap-lifetime-escape": "error",
+}
+
+#: Materialization terminals (each is one copy pass over its argument).
+COPY_BUILTINS = frozenset({"bytes", "bytearray"})
+ENCODE_CALLS = frozenset({"packb", "pack_event", "pack_events"})
+DECODE_CALLS = frozenset({"unpackb", "Unpacker", "decode_events"})
+NATIVE_WALKS = frozenset({"scan_offsets", "count_records"})
+
+#: Arena-view taint: names whose chains mention these fragments hold
+#: buffers the next native call rewrites.
+ARENA_FRAGS = ("arena", "_tls")
+ARENA_STAGERS = frozenset({"stage_field", "_arena"})
+
+
+def _terminal(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _chain_names(node) -> Set[str]:
+    out: Set[str] = set()
+    while True:
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            break
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    return out
+
+
+def _walk_no_nested(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that stays out of nested defs/lambdas (they run later,
+    under their own context)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _target_names(targets) -> Set[str]:
+    names: Set[str] = set()
+    for tgt in targets:
+        if isinstance(tgt, ast.Name):
+            names.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                if isinstance(e, ast.Name):
+                    names.add(e.id)
+    return names
+
+
+def _is_pure_load(node: ast.AST) -> bool:
+    """Name / attribute / constant-subscript chains — expressions whose
+    second materialization is provably the same bytes (no call can have
+    changed what they evaluate to between two adjacent reads)."""
+    if isinstance(node, ast.Name):
+        return True
+    if isinstance(node, ast.Attribute):
+        return _is_pure_load(node.value)
+    if isinstance(node, ast.Subscript):
+        return _is_pure_load(node.value)
+    return False
+
+
+def _is_witness_call(call: ast.Call) -> Optional[str]:
+    """``copywitness.count("<site>", ...)`` / ``_cw.count(...)`` → the
+    literal site id, else None."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "count"):
+        return None
+    chain = _chain_names(f.value)
+    if not ({"_cw", "copywitness"} & chain):
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+# ---------------------------------------------------------------------
+# the copy-census walker (xray's _EntryWalk mold, host-memory terminals)
+# ---------------------------------------------------------------------
+
+class _Site:
+    __slots__ = ("line", "col", "kind", "what", "in_loop")
+
+    def __init__(self, line, col, kind, what, in_loop):
+        self.line, self.col = line, col
+        self.kind, self.what = kind, what
+        self.in_loop = in_loop
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"line": self.line, "kind": self.kind, "what": self.what,
+                "in_loop": self.in_loop}
+
+
+class _CopyWalk:
+    """One ingest entry's same-module closure walk: max-path copy/walk
+    pass counts + site collection. Methods of the owning class and
+    module-level functions inline by name (cycle-guarded,
+    depth-capped — the launchgraph discipline)."""
+
+    def __init__(self, methods: Dict[str, ast.FunctionDef],
+                 functions: Dict[str, ast.FunctionDef]):
+        self.methods = methods
+        self.functions = functions
+        self.sites: Dict[Tuple[int, int], _Site] = {}
+        self._inlining: Set[str] = set()
+
+    def run(self, fn: ast.FunctionDef) -> Tuple[int, int]:
+        return self._fn_body(fn, in_loop=False, depth=0)
+
+    def _fn_body(self, fn: ast.FunctionDef, in_loop: bool,
+                 depth: int) -> Tuple[int, int]:
+        return self._stmts(fn.body, in_loop, depth)[0:2]
+
+    # right-to-left suffix counting: a branch that returns does not
+    # chain into the statements after the if (launchgraph's _stmts,
+    # carrying (copies, walks) pairs)
+
+    def _stmts(self, stmts: List[ast.stmt], in_loop: bool,
+               depth: int) -> Tuple[int, int, bool]:
+        c_suf = w_suf = 0
+        terminated = False
+        for stmt in reversed(stmts):
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                val = stmt.value if isinstance(stmt, ast.Return) \
+                    else getattr(stmt, "exc", None)
+                c_suf, w_suf = self._expr(val, in_loop, depth) \
+                    if val is not None else (0, 0)
+                terminated = True
+            elif isinstance(stmt, ast.If):
+                tc, tw = self._expr(stmt.test, in_loop, depth)
+                bc, bw, bt = self._stmts(stmt.body, in_loop, depth)
+                ec, ew, et = self._stmts(stmt.orelse, in_loop, depth)
+                tb_c = bc if bt else bc + c_suf
+                tb_w = bw if bt else bw + w_suf
+                te_c = ec if et else ec + c_suf
+                te_w = ew if et else ew + w_suf
+                # max over alternatives, coupled by total cost
+                if tb_c + tb_w >= te_c + te_w:
+                    c_suf, w_suf = tc + tb_c, tw + tb_w
+                else:
+                    c_suf, w_suf = tc + te_c, tw + te_w
+                terminated = terminated or (bt and et)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                it = getattr(stmt, "iter", None) or stmt.test
+                ic, iw = self._expr(it, in_loop, depth)
+                bc, bw, _ = self._stmts(stmt.body, True, depth)
+                oc, ow, _ = self._stmts(getattr(stmt, "orelse", []),
+                                        in_loop, depth)
+                c_suf += ic + bc + oc
+                w_suf += iw + bw + ow
+            elif isinstance(stmt, ast.Try):
+                bc, bw, _ = self._stmts(stmt.body, in_loop, depth)
+                hc = hw = 0
+                for handler in stmt.handlers:
+                    cc, cw, _ = self._stmts(handler.body, in_loop, depth)
+                    if cc + cw > hc + hw:
+                        hc, hw = cc, cw
+                oc, ow, _ = self._stmts(stmt.orelse, in_loop, depth)
+                fc, fw, _ = self._stmts(stmt.finalbody, in_loop, depth)
+                c_suf += bc + hc + oc + fc
+                w_suf += bw + hw + ow + fw
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                wc = ww = 0
+                for i in stmt.items:
+                    cc, cw = self._expr(i.context_expr, in_loop, depth)
+                    wc, ww = wc + cc, ww + cw
+                bc, bw, bt = self._stmts(stmt.body, in_loop, depth)
+                c_suf += wc + bc
+                w_suf += ww + bw
+                terminated = terminated or bt
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue  # runs later, under its own call context
+            else:
+                cc, cw = self._expr(stmt, in_loop, depth)
+                c_suf += cc
+                w_suf += cw
+        return c_suf, w_suf, terminated
+
+    def _expr(self, node: Optional[ast.AST], in_loop: bool,
+              depth: int) -> Tuple[int, int]:
+        if node is None:
+            return 0, 0
+        copies = walks = 0
+        for sub in _walk_no_nested(node):
+            if isinstance(sub, ast.Call):
+                c, w = self._call(sub, in_loop, depth)
+                copies += c
+                walks += w
+        return copies, walks
+
+    def _call(self, call: ast.Call, in_loop: bool,
+              depth: int) -> Tuple[int, int]:
+        t = _terminal(call.func)
+        if _is_witness_call(call) is not None:
+            return 0, 0  # instrumentation, not a pass of its own
+        if t in COPY_BUILTINS and call.args:
+            self._site(call, "copy", t, in_loop)
+            return 1, 0
+        if t == "join" and isinstance(call.func, ast.Attribute):
+            self._site(call, "copy", "join", in_loop)
+            return 1, 0
+        if t == "copy" and isinstance(call.func, ast.Attribute) \
+                and not call.args:
+            self._site(call, "copy", ".copy()", in_loop)
+            return 1, 0
+        if t in ENCODE_CALLS:
+            self._site(call, "copy", t, in_loop)
+            return 1, 0
+        if t in DECODE_CALLS or t in NATIVE_WALKS:
+            self._site(call, "walk", t, in_loop)
+            return 0, 1
+        target = self._callee(call)
+        if target is not None:
+            ic, iw = self._inline(target, in_loop, depth)
+            for a in call.args:
+                c, w = self._expr(a, in_loop, depth)
+                ic, iw = ic + c, iw + w
+            return ic, iw
+        c = w = 0
+        for a in call.args:
+            cc, cw = self._expr(a, in_loop, depth)
+            c, w = c + cc, w + cw
+        return c, w
+
+    def _callee(self, call: ast.Call) -> Optional[ast.FunctionDef]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            return self.methods.get(f.attr)
+        if isinstance(f, ast.Name):
+            return self.functions.get(f.id)
+        return None
+
+    def _inline(self, fn: ast.FunctionDef, in_loop: bool,
+                depth: int) -> Tuple[int, int]:
+        if depth >= 6 or fn.name in self._inlining:
+            return 0, 0
+        self._inlining.add(fn.name)
+        try:
+            return self._fn_body(fn, in_loop, depth + 1)
+        finally:
+            self._inlining.discard(fn.name)
+
+    def _site(self, call: ast.Call, kind: str, what: str,
+              in_loop: bool) -> None:
+        key = (call.lineno, call.col_offset)
+        if key not in self.sites:
+            self.sites[key] = _Site(call.lineno, call.col_offset, kind,
+                                    what, in_loop)
+
+
+class _ModuleScan:
+    """All ingest entries + witness sites of one module."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.classes: List[ast.ClassDef] = []
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+
+    def chains(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for cls in self.classes:
+            methods = {
+                n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for entry in INGEST_ENTRIES:
+                fn = methods.get(entry)
+                if fn is None:
+                    continue
+                walk = _CopyWalk(methods, self.functions)
+                copies, walks = walk.run(fn)
+                out.append({
+                    "module": self.module.path,
+                    "cls": cls.name,
+                    "entry": entry,
+                    "line": fn.lineno,
+                    "copy_passes": copies,
+                    "walk_passes": walks,
+                    "sites": [s.as_dict() for s in
+                              sorted(walk.sites.values(),
+                                     key=lambda s: (s.line, s.col))],
+                })
+        return out
+
+    def witness_sites(self) -> Dict[str, int]:
+        """site id → first line of its ``copywitness.count`` call."""
+        out: Dict[str, int] = {}
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, ast.Call):
+                site = _is_witness_call(node)
+                if site is not None and site not in out:
+                    out[site] = node.lineno
+        return out
+
+
+# ---------------------------------------------------------------------
+# the four rules
+# ---------------------------------------------------------------------
+
+class MemscopeRules(Rule):
+    name = "memscope"  # umbrella; findings carry precise rules
+    description = ("fbtpu-memscope host-memory rules: redundant "
+                   "materializations, decode-then-restage round-trips, "
+                   "arena-view and mmap-view lifetime escapes")
+
+    RULE_NAMES = ("host-redundant-copy", "host-decode-then-restage",
+                  "host-mutable-view-escape", "mmap-lifetime-escape")
+
+    def check(self, module: Module) -> List[Finding]:
+        if not any(s in module.path for s in SCOPES):
+            return []
+        out: List[Finding] = []
+        flagged: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, col: int, rule: str, message: str) -> None:
+            if (line, rule) in flagged or module.allowed(rule, line):
+                return
+            flagged.add((line, rule))
+            out.append(Finding(module.path, line, col, rule, message,
+                               _SEVERITY[rule]))
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._redundant_copy(node, emit)
+                self._decode_restage(node, emit)
+                self._view_escape(node, emit)
+        out.sort(key=lambda f: (f.line, f.col, f.rule))
+        return out
+
+    # -- host-redundant-copy ------------------------------------------
+
+    def _redundant_copy(self, fn, emit) -> None:
+        hits: Dict[str, List[ast.Call]] = {}
+        for sub in _walk_no_nested(fn):
+            if isinstance(sub, ast.Call) \
+                    and _terminal(sub.func) in COPY_BUILTINS \
+                    and len(sub.args) == 1 \
+                    and _is_pure_load(sub.args[0]):
+                hits.setdefault(ast.dump(sub.args[0]), []).append(sub)
+        if not any(len(v) > 1 for v in hits.values()):
+            return
+        # sibling If arms are alternatives, not repeats
+        arms: List[Tuple[Set[int], Set[int]]] = []
+        for sub in _walk_no_nested(fn):
+            if isinstance(sub, ast.If):
+                body = {id(n) for s in sub.body for n in ast.walk(s)}
+                els = {id(n) for s in sub.orelse for n in ast.walk(s)}
+                arms.append((body, els))
+        assigns = sorted(
+            (s for s in _walk_no_nested(fn)
+             if isinstance(s, (ast.Assign, ast.AugAssign))),
+            key=lambda s: s.lineno)
+        for key, calls in hits.items():
+            if len(calls) < 2:
+                continue
+            calls.sort(key=lambda c: (c.lineno, c.col_offset))
+            first, second = calls[0], calls[1]
+            if any((id(first) in b and id(second) in e)
+                   or (id(first) in e and id(second) in b)
+                   for b, e in arms):
+                continue
+            names = {n.id for n in ast.walk(first.args[0])
+                     if isinstance(n, ast.Name)}
+            rebound = False
+            for a in assigns:
+                if first.lineno < a.lineno <= second.lineno:
+                    tgts = _target_names(
+                        a.targets if isinstance(a, ast.Assign)
+                        else [a.target])
+                    if tgts & names:
+                        rebound = True
+                        break
+            if rebound:
+                continue
+            src = ast.unparse(first.args[0]) \
+                if hasattr(ast, "unparse") else "the same buffer"
+            emit(second.lineno, second.col_offset, "host-redundant-copy",
+                 f"`{_terminal(second.func)}({src})` re-materializes "
+                 f"bytes already copied at line {first.lineno} with no "
+                 f"rebind between — hoist the first materialization and "
+                 f"share it")
+
+    # -- host-decode-then-restage -------------------------------------
+
+    def _decode_restage(self, fn, emit) -> None:
+        tainted: Set[str] = set()
+        stmts = sorted(
+            (s for s in _walk_no_nested(fn)
+             if isinstance(s, (ast.Assign, ast.For))),
+            key=lambda s: s.lineno)
+        for s in stmts:
+            if isinstance(s, ast.Assign):
+                val = s.value
+                if isinstance(val, ast.Call):
+                    t = _terminal(val.func)
+                    inner = (_terminal(val.args[0].func)
+                             if val.args and isinstance(val.args[0],
+                                                        ast.Call)
+                             else None)
+                    if t in DECODE_CALLS or inner in DECODE_CALLS:
+                        tainted |= _target_names(s.targets)
+                elif isinstance(val, ast.Name) and val.id in tainted:
+                    tainted |= _target_names(s.targets)
+            else:  # for rec in <tainted unpacker>:
+                it_names = {n.id for n in ast.walk(s.iter)
+                            if isinstance(n, ast.Name)}
+                has_decode = any(
+                    isinstance(n, ast.Call)
+                    and _terminal(n.func) in DECODE_CALLS
+                    for n in ast.walk(s.iter))
+                if (it_names & tainted) or has_decode:
+                    tainted |= _target_names([s.target])
+        if not tainted:
+            return
+        for sub in _walk_no_nested(fn):
+            if not (isinstance(sub, ast.Call)
+                    and _terminal(sub.func) in ENCODE_CALLS):
+                continue
+            for arg in sub.args:
+                names = {n.id for n in ast.walk(arg)
+                         if isinstance(n, ast.Name)}
+                if names & tainted:
+                    emit(sub.lineno, sub.col_offset,
+                         "host-decode-then-restage",
+                         f"`{_terminal(sub.func)}` re-encodes "
+                         f"`{sorted(names & tainted)[0]}`, which was "
+                         f"decoded from msgpack bytes in this function: "
+                         f"the record round-trips through heap objects "
+                         f"— slice the raw bytes by record offsets "
+                         f"(the sidecar table) instead")
+                    break
+
+    # -- host-mutable-view-escape + mmap-lifetime-escape --------------
+
+    @staticmethod
+    def _classify(val, arena: Set[str],
+                  mmapped: Set[str]) -> Tuple[bool, bool]:
+        """(aliases the staging arena, aliases an mmap) for a value
+        expression — bytes()/tobytes() materializations break taint."""
+        if isinstance(val, ast.Name):
+            return val.id in arena, val.id in mmapped
+        if isinstance(val, ast.Subscript):
+            return MemscopeRules._classify(val.value, arena, mmapped)
+        if isinstance(val, (ast.Tuple, ast.List)):
+            is_a = is_m = False
+            for e in val.elts:
+                ca, cm = MemscopeRules._classify(e, arena, mmapped)
+                is_a, is_m = is_a or ca, is_m or cm
+            return is_a, is_m
+        if isinstance(val, ast.Call):
+            t = _terminal(val.func)
+            if t in ("bytes", "tobytes"):
+                return False, False
+            if t in ARENA_STAGERS:
+                return True, False
+            if t in ("memoryview", "frombuffer"):
+                is_a = is_m = False
+                for arg in val.args:
+                    chain = _chain_names(arg)
+                    if any(frag in c for frag in ARENA_FRAGS
+                           for c in chain):
+                        is_a = True
+                    ca, cm = MemscopeRules._classify(arg, arena, mmapped)
+                    is_a, is_m = is_a or ca, is_m or cm
+                return is_a, is_m
+            if t == "mmap":
+                return False, True
+        return False, False
+
+    def _view_escape(self, fn, emit) -> None:
+        arena: Set[str] = set()
+        mmapped: Set[str] = set()
+        for s in sorted((s for s in _walk_no_nested(fn)
+                         if isinstance(s, ast.Assign)),
+                        key=lambda s: s.lineno):
+            names = _target_names(s.targets)
+            if not names:
+                continue
+            is_a, is_m = self._classify(s.value, arena, mmapped)
+            if is_a:
+                arena |= names
+            if is_m:
+                mmapped |= names
+        for sub in _walk_no_nested(fn):
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                self._escape_sink(sub.value, sub, "return", arena,
+                                  mmapped, emit)
+            elif isinstance(sub, ast.Assign):
+                if any(isinstance(t, ast.Attribute) for t in sub.targets):
+                    self._escape_sink(sub.value, sub, "attribute store",
+                                      arena, mmapped, emit)
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "append" and sub.args:
+                self._escape_sink(sub.args[0], sub, "container append",
+                                  arena, mmapped, emit)
+
+    def _escape_sink(self, value, node, how, arena, mmapped,
+                     emit) -> None:
+        is_a, is_m = self._classify(value, arena, mmapped)
+        base = value
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        label = base.id if isinstance(base, ast.Name) else "the view"
+        if is_m:
+            emit(node.lineno, node.col_offset, "mmap-lifetime-escape",
+                 f"{how} of `{label}` leaks a view into an mmap'd "
+                 f"chunk file out of the function that owns the map — "
+                 f"the buffer faults (or silently changes) after the "
+                 f"map closes; materialize with bytes() first")
+        elif is_a:
+            emit(node.lineno, node.col_offset, "host-mutable-view-escape",
+                 f"{how} of `{label}` leaks a mutable view of the "
+                 f"per-thread staging arena — the next stage call "
+                 f"rewrites these bytes under the caller; materialize "
+                 f"with bytes() or stage into a caller buffer "
+                 f"(stage_field_into)")
+
+
+# ---------------------------------------------------------------------
+# the census / budget API
+# ---------------------------------------------------------------------
+
+def _package_root() -> str:
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _eval_bytes(expr: str, env: Dict[str, int]) -> int:
+    return int(eval(expr, {"__builtins__": {}}, dict(env)))  # noqa: S307
+
+
+def canonical_copy_env(params: Optional[Dict[str, int]] = None
+                       ) -> Dict[str, int]:
+    """``COPY_PARAMS`` (+ overrides): the canonical evaluation point
+    for the per-record copy costs — the committed copy_budget.json is
+    evaluated here, so the gate compares like with like."""
+    env = dict(COPY_PARAMS)
+    if params:
+        env.update(params)
+    return env
+
+
+def build_copy_census(root: Optional[str] = None,
+                      params: Optional[Dict[str, int]] = None
+                      ) -> Dict[str, Any]:
+    """Scan the census modules and emit the host copy census: per
+    ingest entry the max-path copy/walk pass counts with sites, and
+    per copywitness site its symbolic + canonical per-record cost.
+    Sites present in source with no ``WITNESS_SHAPES`` budget carry
+    ``"unbudgeted": True`` (the gate turns them into regressions);
+    budget entries no longer in source surface as stale."""
+    import os
+
+    pkg = root or _package_root()
+    env = canonical_copy_env(params)
+    chains: Dict[str, Any] = {}
+    found_sites: Dict[str, Tuple[str, int]] = {}
+    for rel in CENSUS_MODULES:
+        path = os.path.join(pkg, *rel.split("/"))
+        if not os.path.isfile(path):
+            continue
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        mod_rel = "fluentbit_tpu/" + rel
+        module = Module(mod_rel, source)
+        scan = _ModuleScan(module)
+        for chain in scan.chains():
+            cid = f"{chain['module']}::{chain['cls']}.{chain['entry']}"
+            chains[cid] = chain
+        for site, line in scan.witness_sites().items():
+            found_sites.setdefault(site, (mod_rel, line))
+    sites: Dict[str, Any] = {}
+    for site, (mod_rel, line) in sorted(found_sites.items()):
+        shape = WITNESS_SHAPES.get(site)
+        if shape is None:
+            sites[site] = {"module": mod_rel, "line": line,
+                           "unbudgeted": True}
+            continue
+        expr, kind, note = shape
+        sites[site] = {
+            "module": mod_rel, "line": line, "kind": kind,
+            "bytes_per_record": expr,
+            "bytes_canonical": _eval_bytes(expr, env),
+            "note": note,
+        }
+    stale = sorted(set(WITNESS_SHAPES) - set(found_sites))
+    return {
+        "version": 1,
+        "params": env,
+        "chains": dict(sorted(chains.items())),
+        "witness_sites": sites,
+        "stale_shapes": stale,
+    }
+
+
+def census_snapshot(census: Dict[str, Any]) -> Dict[str, Any]:
+    """The regression-gated subset of the census: per-entry pass counts
+    and per-site canonical per-record bytes. The committed
+    ``analysis/copy_budget.json`` holds this snapshot — the zero-copy
+    work lands by SHRINKING it, and any PR that grows a number here
+    fails the gate until the budget file says so."""
+    chains = {
+        cid: {"copy_passes": c["copy_passes"],
+              "walk_passes": c["walk_passes"]}
+        for cid, c in census["chains"].items()
+    }
+    sites = {}
+    for site, d in census["witness_sites"].items():
+        sites[site] = {
+            "kind": d.get("kind", "?"),
+            "bytes_per_record": int(d.get("bytes_canonical", -1)),
+        }
+    return {"params": {k: int(v) for k, v in census["params"].items()},
+            "chains": chains, "witness_sites": sites}
+
+
+def compare_copy_budget(current: Dict[str, Any],
+                        baseline: Dict[str, Any]
+                        ) -> Tuple[List[str], List[str]]:
+    """Compare a census snapshot against the committed baseline →
+    (regressions, notes). Growth in copy/walk passes per ingest entry,
+    a new entry or witness site the baseline has never seen, or a
+    per-record byte cost that grew is a regression; improvements are
+    notes (regenerate the budget file to claim them)."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    base_chains = baseline.get("chains", {})
+    for cid, cur in current.get("chains", {}).items():
+        base = base_chains.get(cid)
+        if base is None:
+            regressions.append(
+                f"{cid}: new ingest entry not in copy_budget.json "
+                f"({cur['copy_passes']} copy pass(es)/record) — "
+                f"baseline it deliberately (--write-copy-budget)")
+            continue
+        for key in ("copy_passes", "walk_passes"):
+            b, c = int(base.get(key, 0)), int(cur.get(key, 0))
+            if c > b:
+                regressions.append(
+                    f"{cid}: {key} grew {b} → {c} (the copy budget "
+                    f"gates this — zero-copy PRs shrink it, nothing "
+                    f"grows it silently)")
+            elif c < b:
+                notes.append(
+                    f"{cid}: {key} improved {b} → {c}; regenerate "
+                    f"copy_budget.json (--write-copy-budget) to claim "
+                    f"it")
+    for cid in base_chains:
+        if cid not in current.get("chains", {}):
+            notes.append(f"{cid}: ingest entry gone; regenerate "
+                         f"copy_budget.json")
+    base_sites = baseline.get("witness_sites", {})
+    for site, cur in current.get("witness_sites", {}).items():
+        if int(cur.get("bytes_per_record", -1)) < 0:
+            regressions.append(
+                f"witness site `{site}` has no WITNESS_SHAPES budget "
+                f"entry — every copywitness.count site must declare "
+                f"its symbolic per-record cost")
+            continue
+        base = base_sites.get(site)
+        if base is None:
+            regressions.append(
+                f"witness site `{site}` is new — baseline its "
+                f"per-record cost deliberately (--write-copy-budget)")
+            continue
+        b = int(base.get("bytes_per_record", 0))
+        c = int(cur.get("bytes_per_record", 0))
+        if c > b:
+            regressions.append(
+                f"witness site `{site}`: per-record bytes grew "
+                f"{b} → {c}")
+        elif c < b:
+            notes.append(f"witness site `{site}`: per-record bytes "
+                         f"improved {b} → {c}; regenerate "
+                         f"copy_budget.json")
+    for site in base_sites:
+        if site not in current.get("witness_sites", {}):
+            notes.append(f"witness site `{site}` left the source; "
+                         f"regenerate copy_budget.json")
+    return regressions, notes
+
+
+def witness_crosscheck(counts: Dict[str, Tuple[int, int]],
+                       census: Optional[Dict[str, Any]] = None
+                       ) -> List[str]:
+    """Static-census ⊇ dynamic-witness check: every site the
+    ``FBTPU_COPY_WITNESS`` runtime observed must be a budgeted census
+    site — a copy the static plane cannot see is exactly the bug class
+    this analyzer exists for. Returns violation messages (empty =
+    consistent)."""
+    census = census or build_copy_census()
+    sites = census["witness_sites"]
+    out: List[str] = []
+    for site, (events, nbytes) in sorted(counts.items()):
+        d = sites.get(site)
+        if d is None:
+            out.append(
+                f"dynamic witness site `{site}` ({events} events, "
+                f"{nbytes} bytes) is not in the static census — "
+                f"instrumented copy with no copywitness.count call in "
+                f"a census module?")
+        elif d.get("unbudgeted"):
+            out.append(
+                f"dynamic witness site `{site}` has no WITNESS_SHAPES "
+                f"budget entry")
+    return out

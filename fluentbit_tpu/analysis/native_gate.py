@@ -6,7 +6,7 @@ msgpack/JSON byte walking) and the ctypes data plane
 whose bug classes (out-of-bounds cursor reads over hostile bytes,
 container headers whose declared lengths drift from what gets emitted,
 error paths leaking allocations) the sanitizer tests only catch when a
-test vector happens to hit them. This module runs three layers, each
+test vector happens to hit them. This module runs four layers, each
 degrading to a note (never a silent pass) when its tool is missing:
 
 1. **clang-tidy** with the repo profile (``.clang-tidy`` at the root):
@@ -28,6 +28,15 @@ degrading to a note (never a silent pass) when its tool is missing:
    - ``codec-leak``: a function that ``PyMem_Malloc``s must free on its
      error paths (function-level heuristic: an alloc with no
      ``PyMem_Free``/``free`` anywhere in the function).
+
+4. **untrusted-bytes bounds checker** (``untrusted-bounds``, also over
+   ``clang.cindex``, fbtpu-memscope's native layer): every function
+   whose byte-pointer parameters carry wire/chunk bytes is an
+   untrusted scope — dereferences and cursor advances there must be
+   dominated by a bounds check against the span end, and the check
+   must be the overflow-safe subtraction form (``len <= end - p``),
+   never the addition form (``p + len <= end``, which wraps on
+   adversarial lengths).
 
 Suppressions use the same syntax as the Python side, in C comments on
 the flagged line or the line above::
@@ -57,11 +66,11 @@ from . import Finding
 __all__ = [
     "native_sources", "run_native_gate", "run_gcc_analyzer",
     "run_clang_tidy", "run_codec_checker", "check_codec_file",
-    "NATIVE_RULES",
+    "run_bounds_checker", "check_bounds_file", "NATIVE_RULES",
 ]
 
 NATIVE_RULES = ("clang-tidy", "gcc-analyzer", "codec-balance",
-                "codec-bounds", "codec-leak")
+                "codec-bounds", "codec-leak", "untrusted-bounds")
 
 _DIAG_RE = re.compile(
     r"^(?P<path>[^:\s][^:]*):(?P<line>\d+):(?P<col>\d+):\s+"
@@ -525,6 +534,215 @@ def run_codec_checker(root: Optional[str] = None, cache: bool = True
 
 
 # ---------------------------------------------------------------------
+# layer 4: untrusted-bytes bounds checker (clang.cindex, both sources)
+# ---------------------------------------------------------------------
+
+#: helpers that perform (and signal) their own bounds checking — a
+#: function that routes every read through one of these, checking its
+#: failure return, is dominated by a guard even with no inline `end`
+#: comparison of its own
+_BOUNDS_HELPERS = frozenset({
+    "need", "skip_obj", "read_array_hdr", "read_map_hdr",
+    "read_str_hdr", "mp_skip_span", "mp_skip_n", "mp_str_hdr",
+    "utf8_valid", "decode_obj", "jt_value",
+})
+
+_CMP_OPS = frozenset({"<", "<=", ">", ">=", "==", "!="})
+
+#: 64-bit-wide integer type words: `ptr + n` with one of these can wrap
+#: before a `<= end` comparison sees it (the overflow-prone idiom)
+_WIDE_WORDS = ("long long", "int64_t", "Py_ssize_t", "ssize_t",
+               "ptrdiff_t", "size_t", "uint64_t")
+
+
+def _endish(s: str) -> bool:
+    return s == "end" or s.endswith("_end")
+
+
+def _lenish(s: str) -> bool:
+    return ("len" in s or s in ("n", "size", "cap", "avail", "left",
+                                "remaining", "count"))
+
+
+def _collect_vars(ci, fn):
+    """(byte-pointer names incl. params, byte-pointer PARAM names,
+    64-bit-wide integer names) declared in/for this function."""
+    byteptrs, params, wide = set(), set(), set()
+    for n in fn.walk_preorder():
+        if n.kind not in (ci.CursorKind.PARM_DECL,
+                          ci.CursorKind.VAR_DECL):
+            continue
+        ts = n.type.spelling.replace("const", "").strip()
+        if "*" in ts and any(b in ts for b in
+                             ("uint8_t", "unsigned char", "char")):
+            byteptrs.add(n.spelling)
+            if n.kind == ci.CursorKind.PARM_DECL:
+                params.add(n.spelling)
+        elif "*" not in ts and any(w in ts for w in _WIDE_WORDS):
+            wide.add(n.spelling)
+    return byteptrs, params, wide
+
+
+def _check_untrusted(ci, fn, emit) -> None:
+    """Every load through a pointer derived from an untrusted byte
+    buffer must be dominated by a bounds check; pointer+offset bounds
+    comparisons must use the overflow-safe subtraction form when the
+    offset is 64-bit."""
+    byteptrs, params, wide = _collect_vars(ci, fn)
+    if not params:
+        return  # no untrusted-buffer parameter: out of scope
+    spell = [t.spelling for t in fn.get_tokens()]
+    lines = {i: t.location.line for i, t in enumerate(fn.get_tokens())}
+    typeish = {"uint8_t", "char", "unsigned", "const", "void", "int8_t"}
+    deref = False
+    for i in range(len(spell) - 1):
+        a, b = spell[i], spell[i + 1]
+        if (a in byteptrs and b in ("[", "++")) \
+                or (a == "++" and b in byteptrs):
+            deref = True
+            break
+        # `*p` load — but not the `uint8_t *p` declaration form
+        if a == "*" and b in byteptrs \
+                and (i == 0 or spell[i - 1] not in typeish):
+            deref = True
+            break
+    guarded = any(
+        (a in _CMP_OPS and (_endish(b) or _lenish(b)))
+        or ((_endish(a) or _lenish(a)) and b in _CMP_OPS)
+        or (_endish(a) and b == "-") or (a == "-" and _endish(b))
+        for a, b in zip(spell, spell[1:]))
+    helper = any(s in _BOUNDS_HELPERS and s != fn.spelling
+                 for s in spell)
+    if deref and not (guarded or helper):
+        emit("untrusted-bounds", fn.location.line, fn.location.column,
+             f"`{fn.spelling}` dereferences a pointer derived from an "
+             f"untrusted byte buffer with no bounds check in scope (no "
+             f"`end` comparison, no length comparison, no bounds-"
+             f"checking helper call) — hostile chunk bytes read past "
+             f"the buffer")
+    # overflow-prone idiom: `p + n <cmp> end` / `end <cmp> p + n` with a
+    # 64-bit n — the addition wraps before the comparison runs; the
+    # safe form is `n > end - p` (what need() does)
+    for i in range(len(spell) - 4):
+        a, op1, b, op2, c = spell[i:i + 5]
+        wrap = ((a in byteptrs and op1 == "+" and b in wide
+                 and op2 in _CMP_OPS and _endish(c))
+                or (_endish(a) and op1 in _CMP_OPS and b in byteptrs
+                    and op2 == "+" and c in wide))
+        if wrap:
+            emit("untrusted-bounds", lines.get(i, fn.location.line), 0,
+                 f"`{fn.spelling}` bounds-checks with pointer+offset "
+                 f"(`{a} {op1} {b} {op2} {c}`) where the offset is "
+                 f"64-bit: the addition can wrap before the comparison "
+                 f"— use the subtraction form `off > end - p` instead")
+
+
+#: analysis-only shim for the SSE2 intrinsics the scanner kernels use:
+#: libclang ships without its own resource headers here, and gcc's
+#: emmintrin.h leans on gcc-only builtins clang cannot parse. The shim
+#: pre-claims the gcc header's include guard and declares just enough
+#: (the vector type + the 5 intrinsics in use) for a faithful AST —
+#: the bounds analysis never looks inside the intrinsics anyway.
+_SSE_SHIM = """
+#define _EMMINTRIN_H_INCLUDED 1
+#define _XMMINTRIN_H_INCLUDED 1
+typedef long long __m128i __attribute__((vector_size(16)));
+static inline __m128i _mm_set1_epi8(char a) { __m128i r = {0, 0}; (void)a; return r; }
+static inline __m128i _mm_loadu_si128(const __m128i *p) { return *p; }
+static inline __m128i _mm_cmpeq_epi8(__m128i a, __m128i b) { (void)b; return a; }
+static inline __m128i _mm_or_si128(__m128i a, __m128i b) { (void)b; return a; }
+static inline int _mm_movemask_epi8(__m128i a) { (void)a; return 0; }
+"""
+
+
+def check_bounds_file(path: str, root: Optional[str] = None,
+                      lang: str = "c", extra_args: Sequence[str] = ()
+                      ) -> Tuple[List[Finding], List[str]]:
+    """Run the untrusted-bytes bounds checks over one source file
+    (separated from the gate wrapper so fixture tests can feed
+    known-bad snippets)."""
+    root = root or repo_root()
+    ci = _load_cindex()
+    if ci is None:
+        return [], ["bounds-checker: clang.cindex/libclang unavailable "
+                    "— layer skipped"]
+    args: List[str] = list(extra_args)
+    unsaved = None
+    if lang == "c++":
+        args += ["-std=c++17"]
+        shim = os.path.join(os.path.dirname(path), "_fbtpu_sse_shim.h")
+        args += ["-include", shim]
+        unsaved = [(shim, _SSE_SHIM)]
+    inc = _py_include()
+    if inc:
+        args += ["-I", inc]
+    gccinc = _gcc_builtin_include()
+    if gccinc:
+        args += ["-isystem", gccinc]
+    try:
+        tu = ci.Index.create().parse(path, args=args,
+                                     unsaved_files=unsaved)
+    except Exception as e:
+        return [], [f"bounds-checker: parse failed for {path}: {e}"]
+    errs = [d for d in tu.diagnostics
+            if d.severity >= ci.Diagnostic.Error]
+    if errs:
+        return [], [f"bounds-checker: {len(errs)} parse errors in "
+                    f"{path} (first: {errs[0]}) — layer skipped"]
+    rel = _rel(root, path) if os.path.isabs(path) else path
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        lines = []
+    findings: List[Finding] = []
+
+    def emit(rule: str, line: int, col: int, msg: str) -> None:
+        if not _c_allowed(lines, rule, line):
+            findings.append(Finding(rel, line, col, rule, msg, "error"))
+
+    main_file = os.path.basename(path)
+    # preorder walk, not get_children(): the C++ plane wraps its entry
+    # points in extern "C" linkage blocks the top level doesn't show
+    for fn in tu.cursor.walk_preorder():
+        if fn.kind not in (ci.CursorKind.FUNCTION_DECL,
+                           ci.CursorKind.CXX_METHOD) \
+                or not fn.is_definition():
+            continue
+        if not fn.location.file or \
+                os.path.basename(fn.location.file.name) != main_file:
+            continue
+        _check_untrusted(ci, fn, emit)
+    return findings, [f"bounds-checker: {os.path.basename(path)} "
+                      f"analyzed"]
+
+
+def run_bounds_checker(root: Optional[str] = None, cache: bool = True
+                       ) -> Tuple[List[Finding], List[str]]:
+    root = root or repo_root()
+    findings: List[Finding] = []
+    notes: List[str] = []
+    for src, lang in native_sources(root):
+        digest = _digest([open(src, encoding="utf-8",
+                               errors="replace").read(), lang,
+                          "bounds-v1"])
+        name = "bounds-" + os.path.basename(src)
+        if cache:
+            hit = _cache_load(root, name, digest)
+            if hit is not None:
+                findings.extend(Finding(**d) for d in hit)
+                notes.append(f"bounds-checker: "
+                             f"{os.path.basename(src)} (cached)")
+                continue
+        got, ns = check_bounds_file(src, root, lang)
+        if not any("skipped" in n or "failed" in n for n in ns):
+            _cache_store(root, name, digest, got)
+        findings.extend(got)
+        notes.extend(ns)
+    return findings, notes
+
+
+# ---------------------------------------------------------------------
 # the gate
 # ---------------------------------------------------------------------
 
@@ -535,7 +753,8 @@ def run_native_gate(root: Optional[str] = None, cache: bool = True
     root = root or repo_root()
     findings: List[Finding] = []
     notes: List[str] = []
-    for runner in (run_clang_tidy, run_gcc_analyzer, run_codec_checker):
+    for runner in (run_clang_tidy, run_gcc_analyzer, run_codec_checker,
+                   run_bounds_checker):
         got, ns = runner(root, cache=cache)
         findings.extend(got)
         notes.extend(ns)

@@ -31,7 +31,7 @@ from dataclasses import dataclass
 from typing import Dict, Tuple
 
 __all__ = ["GuardEntry", "GUARDS", "LAUNCH_ENTRIES", "BUDGET_PARAMS",
-           "budget_path", "lock_baseline_path"]
+           "budget_path", "lock_baseline_path", "copy_budget_path"]
 
 # -- fbtpu-xray (analysis/launchgraph.py) declarative plumbing ---------
 
@@ -62,6 +62,12 @@ def lock_baseline_path() -> str:
     """Path of the committed fbtpu-locksmith findings baseline."""
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "lock_baseline.json")
+
+
+def copy_budget_path() -> str:
+    """Path of the committed fbtpu-memscope copy budget baseline."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "copy_budget.json")
 
 
 @dataclass(frozen=True)
@@ -203,6 +209,20 @@ GUARDS: Tuple[GuardEntry, ...] = (
         kind="global",
         note="witness edge set: every acquiring thread records into "
              "it; snapshot/reset serialize on the guard",
+    ),
+    # -- host-copy witness recorder (fbtpu-memscope ground truth) --
+    GuardEntry(
+        "fluentbit_tpu/core/copywitness.py", "_counts_guard",
+        ("_counts",), kind="global",
+        note="copy-witness accumulator: every ingest/replay thread "
+             "records into it; snapshot/reset serialize on the guard",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/copywitness.py", "_counts_guard",
+        ("_enabled",), writes_only=True, kind="global",
+        note="witness enable flag: the ingest hot path reads it "
+             "lock-free by design (one falsy load when disabled); the "
+             "refresh() flip serializes",
     ),
     # -- native loaders: double-checked module singletons --
     GuardEntry(

@@ -16,6 +16,7 @@ import itertools
 import time
 from typing import Dict, Iterator, List, Optional
 
+from ..core import copywitness as _cw
 from .events import LogEvent, decode_events
 
 # Reference: chunks are locked once above ~2MB so flushes stay bounded.
@@ -88,12 +89,21 @@ class Chunk:
 
     @buf.setter
     def buf(self, payload) -> None:
+        # bytes(bytes_obj) adopts without copying — only non-bytes
+        # payloads (replay handing a bytearray, tests) materialize
+        if _cw.witness_enabled() and not isinstance(payload, bytes):
+            _cw.count("chunk.buf.materialize", len(payload))
         self._parts = [bytes(payload)]
         self._size = len(self._parts[0])
 
     def append(self, data: bytes, n_records: int) -> None:
         if self.locked:
             raise RuntimeError("append to locked chunk")
+        # the ONE owned copy of the ingest path: appended spans may be
+        # views of reused arenas (native.grep_filter) or caller buffers,
+        # so the chunk must own its bytes; bytes-in adopts copy-free
+        if _cw.witness_enabled() and not isinstance(data, bytes):
+            _cw.count("chunk.append.materialize", len(data))
         self._parts.append(bytes(data))
         self._size += len(data)
         self.records += n_records

@@ -205,7 +205,7 @@ CORE_INSTANCE_KEYS = {
     "flush_timeout",  # fbtpu-guard per-output flush deadline (outputs)
     # fbtpu-qos tenant membership + contract (inputs; core/qos.py)
     "tenant", "tenant.weight", "tenant.priority", "tenant.rate",
-    "tenant.burst", "tenant.overflow",
+    "tenant.burst", "tenant.overflow", "tenant.storage_limit",
     "net.keepalive", "net.keepalive_idle_timeout",
     "net.keepalive_max_recycle", "net.max_worker_connections",
 }

@@ -1,0 +1,76 @@
+"""fbtpu-memscope ground truth: the host-copy witness recorder.
+
+The static copy census (analysis/memscope.py) is a model of where the
+ingest path materializes bytes; this module keeps it honest the same
+way core/lockorder.py keeps the lock-order graph honest. Every
+instrumented materialization site on the ingest→staging path calls
+:func:`count` with its canonical site id and the byte count. In normal
+operation that is a single falsy-global check — nothing recorded. With
+``FBTPU_COPY_WITNESS`` set in the environment at import/enable time,
+each call accumulates (events, bytes) per site into a process-global
+table.
+
+The tier-1 crosscheck (tests/test_memscope.py) drives representative
+ingest workloads under the witness and asserts **static ⊇ dynamic**:
+every site the process actually exercised exists in the committed
+census (analysis/copy_budget.json), and each site's observed
+bytes-copied-per-ingested-byte does not exceed the census's claimed
+multiplicity. A dynamic site missing from the static census means the
+analyzer's walk lost a copy — the test fails loudly instead of the
+model silently rotting.
+
+Site ids are the census's canonical node ids
+(``engine.decoded.materialize``, ``storage.replay.materialize`` …) —
+the two sides join on these strings, so adding a materialization to
+the ingest path means adding both the :func:`count` call and the
+census site in the same PR (the crosscheck catches a drift).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Tuple
+
+__all__ = ["count", "witness_enabled", "witness_counts",
+           "witness_reset", "refresh"]
+
+#: site id -> (events, bytes) accumulated since the last reset.
+_counts: Dict[str, Tuple[int, int]] = {}
+_counts_guard = threading.Lock()
+
+# read once and cached in a module global so the hot-path cost of a
+# disabled witness is one falsy load; tests flip it via refresh()
+_enabled = bool(os.environ.get("FBTPU_COPY_WITNESS"))
+
+
+def refresh() -> None:
+    """Re-read ``FBTPU_COPY_WITNESS`` (tests set the env after import)."""
+    global _enabled
+    with _counts_guard:
+        _enabled = bool(os.environ.get("FBTPU_COPY_WITNESS"))
+
+
+def witness_enabled() -> bool:
+    return _enabled
+
+
+def count(site: str, nbytes: int) -> None:
+    """Record one materialization event at ``site`` (no-op unless the
+    witness is enabled)."""
+    if not _enabled:
+        return
+    with _counts_guard:
+        ev, by = _counts.get(site, (0, 0))
+        _counts[site] = (ev + 1, by + int(nbytes))
+
+
+def witness_counts() -> Dict[str, Tuple[int, int]]:
+    """Snapshot of site -> (events, bytes) since the last reset."""
+    with _counts_guard:
+        return dict(_counts)
+
+
+def witness_reset() -> None:
+    with _counts_guard:
+        _counts.clear()

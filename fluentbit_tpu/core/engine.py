@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional
 from .. import failpoints as _fp
 from ..codec.chunk import Chunk, EVENT_TYPE_LOGS, EVENT_TYPE_METRICS, EVENT_TYPE_TRACES
 from ..codec.events import LogEvent, decode_events, reencode_event
+from . import copywitness as _cw
 from .config import ServiceConfig
 from .lockorder import make_lock
 from .metrics import MetricsRegistry
@@ -1194,6 +1195,7 @@ class Engine:
             # lands in its own chunk carrying that route bitmask
             groups: Dict[int, bytearray] = {}
             counts: Dict[int, int] = {}
+            ends: Dict[int, list] = {}  # record END offsets per group
             # tag is constant for the append: resolve the matching
             # candidates once, per-record work is condition eval only
             candidates = [
@@ -1213,12 +1215,22 @@ class Engine:
                     continue
                 raw = ev.raw if ev.raw is not None \
                     else reencode_event(ev)
-                groups.setdefault(mask, bytearray()).extend(raw)
+                buf = groups.setdefault(mask, bytearray())
+                buf.extend(raw)
+                ends.setdefault(mask, []).append(len(buf))
                 counts[mask] = counts.get(mask, 0) + 1
             with ins.ingest_lock:
                 for mask, buf in groups.items():
+                    # ONE materialization per group: the pool append
+                    # adopts the same bytes object write_through
+                    # persists (this branch used to call bytes(buf)
+                    # twice — memscope host-redundant-copy)
+                    payload = bytes(buf)
+                    if _cw.witness_enabled():
+                        _cw.count("engine.cond.materialize",
+                                  len(payload))
                     chunk = ins.pool.append(
-                        tag, bytes(buf), counts[mask],
+                        tag, payload, counts[mask],
                         routes_mask=mask)
                     if chunk.route_names is None:
                         # persisted form: NAMES, not bit positions
@@ -1229,18 +1241,24 @@ class Engine:
                             for i, o in enumerate(self.outputs)
                             if (mask >> i) & 1
                         )
-                    if self.storage is not None and \
-                            ins.storage_type == "filesystem":
-                        self.storage.write_through(chunk, bytes(buf))
+                    self._persist(ins, chunk, payload,
+                                  offsets=ends[mask])
             return len(events)
 
         out = bytearray()
+        rec_ends = []  # per-event END offsets: the sidecar gets them free
         for ev in events:
             out += ev.raw if ev.raw is not None else reencode_event(ev)
+            rec_ends.append(len(out))
+        # ONE materialization: pool append + write-through share the
+        # same bytes object (this used to be two full bytes(out) copies
+        # of every decoded append — memscope host-redundant-copy)
+        payload = bytes(out)
+        if _cw.witness_enabled():
+            _cw.count("engine.decoded.materialize", len(payload))
         with ins.ingest_lock:
-            chunk = ins.pool.append(tag, bytes(out), len(events))
-            if self.storage is not None and ins.storage_type == "filesystem":
-                self.storage.write_through(chunk, bytes(out))
+            chunk = ins.pool.append(tag, payload, len(events))
+            self._persist(ins, chunk, payload, offsets=rec_ends)
         return len(events)
 
     def input_event_append(self, ins: InputInstance, tag: Optional[str],
@@ -1287,8 +1305,7 @@ class Engine:
                 self.m_in_records.inc(n_records, (ins.display_name,))
                 self.m_in_bytes.inc(in_bytes, (ins.display_name,))
                 chunk = ins.pool.append(tag, data, n_records, event_type)
-                if self.storage is not None and ins.storage_type == "filesystem":
-                    self.storage.write_through(chunk, data)
+                self._persist(ins, chunk, data)
         return n_records
 
     def _ingest_raw(self, ins, tag: str, data: bytes, matching,
@@ -1394,6 +1411,20 @@ class Engine:
         return self._finish_raw_append(ins, tag, data, n, n_records,
                                        deltas, in_bytes)
 
+    def _persist(self, ins, chunk, data, offsets=None) -> None:
+        """Write-through behind the tenant storage quota
+        (``Qos.admit_storage``): over ``tenant.storage_limit`` the
+        append's persistence is SHED — the chunk stays memory-buffered
+        and delivery proceeds, only crash durability for the shed bytes
+        is given up (``fluentbit_storage_quota_shed_bytes_total``)."""
+        if self.storage is None or ins.storage_type != "filesystem":
+            return
+        from .qos import SHED
+
+        if self.qos.admit_storage(ins, chunk, len(data)) == SHED:
+            return
+        self.storage.write_through(chunk, data, offsets=offsets)
+
     def _finish_raw_append(self, ins, tag: str, data, n, n_records,
                            deltas, in_bytes: int) -> int:
         """The raw path's commit epilogue: deferred filter metric
@@ -1412,8 +1443,7 @@ class Engine:
             return 0
         with ins.ingest_lock:  # no-op re-entry on the parallel path
             chunk = ins.pool.append(tag, data, n)
-            if self.storage is not None and ins.storage_type == "filesystem":
-                self.storage.write_through(chunk, data)
+            self._persist(ins, chunk, data)
         return n
 
     def _finish_raw_tail(self, ins, cont: "_RawTail") -> int:
@@ -1768,6 +1798,7 @@ class Engine:
         if not routes:
             if self.storage is not None:
                 self.storage.delete(chunk)
+                self.qos.release_storage(chunk)
             return ABSORBED
         # load shedding (fbtpu-guard): above the occupancy watermark,
         # chunks spill to filesystem storage in priority order — the
@@ -2110,6 +2141,7 @@ class Engine:
             self.m_latency.observe(time.time() - chunk.created, (name,))
             if self._task_unref(task) and self.storage is not None:
                 self.storage.delete(chunk)  # every route delivered
+                self.qos.release_storage(chunk)
             return None
         if result == FlushResult.RETRY:
             attempts = task.retries.get(out.name, 0) + 1
@@ -2133,6 +2165,7 @@ class Engine:
                 log.exception("DLQ quarantine failed")
         if self._task_unref(task) and self.storage is not None:
             self.storage.delete(chunk)  # dlq copy (if any) is separate
+            self.qos.release_storage(chunk)
         return None
 
     # ------------------------------------------------------------------

@@ -669,10 +669,16 @@ class Guard:
         chunk.routes_mask = 0
         storage = self.engine.storage
         if storage is not None and not storage.is_tracked(chunk):
-            try:  # durability: a memory chunk spills to disk
+            try:  # durability: a memory chunk spills to disk — the
+                # tenant storage quota applies here too (an over-quota
+                # tenant's shed chunks park in memory only)
+                from .qos import SHED
+
                 data = chunk.get_bytes()
-                storage.write_through(chunk, data)
-                storage.finalize(chunk)
+                if self.engine.qos.admit_storage(
+                        None, chunk, len(data)) != SHED:
+                    storage.write_through(chunk, data)
+                    storage.finalize(chunk)
             except Exception:
                 log.exception("guard: shed write-through failed; chunk "
                               "parked in memory only")

@@ -273,6 +273,12 @@ class InputInstance(Instance):
         burst = self.properties.get("tenant.burst")
         if burst is not None:
             params["burst"] = float(parse_size(burst))
+        sl = self.properties.get("tenant.storage_limit")
+        if sl is not None:
+            # cap on the tenant's LIVE filesystem footprint (bytes of
+            # stream chunk files); over it, write-through is shed and
+            # the chunk stays memory-only (Qos.admit_storage)
+            params["storage_limit"] = int(parse_size(sl))
         ovf = self.properties.get("tenant.overflow")
         if ovf is not None:
             ovf = str(ovf).lower()

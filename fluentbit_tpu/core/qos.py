@@ -76,18 +76,22 @@ class Tenant:
     optional ingest quota, overflow policy."""
 
     __slots__ = ("name", "weight", "priority", "bucket", "overflow",
-                 "rate", "burst")
+                 "rate", "burst", "storage_limit")
 
     def __init__(self, name: str, weight: float, priority: int,
                  rate: Optional[float] = None,
                  burst: Optional[float] = None,
-                 overflow: str = "defer", clock=time.monotonic):
+                 overflow: str = "defer", clock=time.monotonic,
+                 storage_limit: Optional[int] = None):
         self.name = name
         self.weight = float(weight)
         self.priority = min(max(int(priority), 0), QOS_CLASS_COUNT - 1)
         self.rate = rate
         self.burst = burst
         self.overflow = overflow
+        # cap on the tenant's LIVE filesystem footprint in bytes (sum
+        # of stream chunk payloads currently on disk); None = unmetered
+        self.storage_limit = storage_limit
         self.bucket = (TokenBucket(rate, burst, clock=clock)
                        if rate else None)
 
@@ -120,6 +124,18 @@ class Qos:
         self._queue = DeficitFairQueue(
             quantum=float(svc.qos_quantum),
             weight_floor=svc.qos_weight_floor)
+        # per-tenant LIVE filesystem footprint (stream chunk payload
+        # bytes currently on disk) + the per-chunk charge ledger that
+        # refunds it when delivery deletes the backing file. Only
+        # tenants that declare tenant.storage_limit are tracked — the
+        # unconfigured pipeline pays nothing here.
+        self._storage_used: Dict[str, int] = {}
+        self._storage_chunk: Dict[int, Tuple[str, int]] = {}
+        # chunks whose persistence was shed once stay shed: admitting
+        # a LATER append after a refund would persist a file missing
+        # its leading records — replay would silently resurrect a
+        # hole-y chunk after a crash
+        self._storage_shed_chunks: set = set()
 
         m = engine.metrics
         self.m_admitted = m.counter(
@@ -147,6 +163,14 @@ class Qos:
         self.m_priority_shed = m.counter(
             "fluentbit", "qos", "priority_shed_chunks_total",
             "Chunks spilled by shed-by-priority pressure", ("tenant",))
+        self.m_storage_used = m.gauge(
+            "fluentbit", "storage_quota", "used_bytes",
+            "Live filesystem footprint charged to the tenant storage "
+            "quota", ("tenant",))
+        self.m_storage_shed = m.counter(
+            "fluentbit", "storage_quota", "shed_bytes_total",
+            "Write-through bytes shed by the tenant storage quota "
+            "(chunk kept memory-only)", ("tenant",))
         self.m_generation = m.gauge(
             "fluentbit", "qos", "reload_generation",
             "Current hot-reload configuration generation")
@@ -177,7 +201,8 @@ class Qos:
                     rate=params.get("rate"),
                     burst=params.get("burst"),
                     overflow=params.get("overflow", "defer"),
-                    clock=self.clock)
+                    clock=self.clock,
+                    storage_limit=params.get("storage_limit"))
                 self._tenants[name] = t
                 self._graded = len({x.priority for x in
                                     self._tenants.values()}) > 1
@@ -192,6 +217,9 @@ class Qos:
                              QOS_CLASS_COUNT - 1)
         if "overflow" in params:
             t.overflow = params["overflow"]
+        if "storage_limit" in params:
+            t.storage_limit = (None if params["storage_limit"] is None
+                               else int(params["storage_limit"]))
         if ("rate" in params or "burst" in params) and (
                 params.get("rate", t.rate) != t.rate
                 or params.get("burst", t.burst) != t.burst):
@@ -294,6 +322,63 @@ class Qos:
         t = self.tenant_for_input(ins)
         if t.bucket is not None and self.enabled:
             t.bucket.give_back(n_bytes)
+
+    def admit_storage(self, ins, chunk, n_bytes: int) -> int:
+        """Meter one write-through append against the tenant's
+        filesystem-footprint quota (``tenant.storage_limit``). Returns
+        :data:`ADMIT` or :data:`SHED` — never :data:`DEFER`: skipping
+        persistence is not backpressure, the chunk stays buffered in
+        memory and delivery proceeds; only crash durability for the
+        shed bytes is given up (counted per tenant in
+        ``fluentbit_storage_quota_shed_bytes_total``).
+
+        ``ins`` may be None (guard spill of an already-dispatched
+        chunk) — the chunk's stamped tenant resolves instead. Tenants
+        with no declared limit are never tracked, so the unconfigured
+        pipeline pays one attribute probe per append."""
+        if ins is not None:
+            t = self.tenant_for_input(ins)
+        else:
+            t = self.tenant(chunk.qos_tenant or DEFAULT_TENANT)
+        limit = t.storage_limit
+        if limit is None or not self.enabled:
+            return ADMIT
+        with self._lock:
+            used = self._storage_used.get(t.name, 0)
+            if chunk.id in self._storage_shed_chunks or \
+                    used + n_bytes > limit:
+                over = True
+                self._storage_shed_chunks.add(chunk.id)
+            else:
+                over = False
+                self._storage_used[t.name] = used + n_bytes
+                name, charged = self._storage_chunk.get(
+                    chunk.id, (t.name, 0))
+                self._storage_chunk[chunk.id] = (name,
+                                                 charged + n_bytes)
+        if over:
+            self.m_storage_shed.inc(n_bytes, (t.name,))
+            return SHED
+        self.m_storage_used.set(used + n_bytes, (t.name,))
+        return ADMIT
+
+    def release_storage(self, chunk) -> None:
+        """Refund a chunk's storage-quota charge once its backing file
+        is deleted (delivery complete / quarantined away). Chunks that
+        were never charged — unmetered tenants, recovered backlog files
+        — are a no-op."""
+        with self._lock:
+            self._storage_shed_chunks.discard(chunk.id)
+            got = self._storage_chunk.pop(chunk.id, None)
+            if got is None:
+                return
+            name, charged = got
+            used = max(0, self._storage_used.get(name, 0) - charged)
+            if used:
+                self._storage_used[name] = used
+            else:
+                self._storage_used.pop(name, None)
+        self.m_storage_used.set(used, (name,))
 
     def defer_hint(self, ins, n_bytes: int) -> float:
         """Seconds until a deferred append of ``n_bytes`` could be
@@ -407,6 +492,7 @@ class Qos:
         with self._lock:
             tenants = list(self._tenants.values())
             pending = self._queue.pending()
+            storage_used = dict(self._storage_used)
         depth: Dict[str, int] = {}
         for (_cls, name), (n, _cost) in pending.items():
             depth[name] = depth.get(name, 0) + n
@@ -418,6 +504,8 @@ class Qos:
                 "rate": t.rate,
                 "overflow": t.overflow,
                 "queued_chunks": depth.get(t.name, 0),
+                "storage_limit": t.storage_limit,
+                "storage_used_bytes": storage_used.get(t.name, 0),
                 "admitted_bytes": self.m_admitted.get((t.name,)),
                 "deferred": self.m_deferred.get((t.name,)),
                 "shed_bytes": self.m_shed_in.get((t.name,)),

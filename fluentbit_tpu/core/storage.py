@@ -29,6 +29,7 @@ payload — hard-errored chunks and corruption alike — in one place).
 from __future__ import annotations
 
 import logging
+import mmap as _mmap
 import os
 import struct
 import zlib
@@ -43,6 +44,8 @@ from ..codec.chunk import (
     EVENT_TYPE_TRACES,
 )
 from .. import failpoints as _fp
+from . import copywitness as _cw
+from . import sidecar as _sidecar
 
 log = logging.getLogger("flb.storage")
 
@@ -87,6 +90,14 @@ def _prio_byte(chunk) -> int:
 class Storage:
     """Filesystem backend for chunk persistence + DLQ."""
 
+    # class-level defaults: tests (and tooling) build bare readers via
+    # Storage.__new__ to call _read_chunk_file directly — they replay
+    # on the decode walk with zeroed counters instead of crashing
+    sidecars = False
+    replay_sidecar_hits = 0
+    replay_sidecar_trusted = 0
+    replay_decode_walks = 0
+
     def __init__(self, path: str, checksum: bool = True):
         self.root = os.path.abspath(path)
         self.checksum = checksum
@@ -97,6 +108,14 @@ class Storage:
         # chunk id → (open file handle or None, path)
         self._files: Dict[int, Tuple[Optional[object], str]] = {}
         self._quarantined: set = set()  # chunk ids already in the DLQ
+        # fbtpu-memscope offset sidecars: chunk id → incremental writer
+        # (None = the table went incomplete and was abandoned)
+        self.sidecars = not os.environ.get("FBTPU_NO_SIDECAR")
+        self._sidecars: Dict[int, Optional[_sidecar.SidecarWriter]] = {}
+        # replay accounting (bench memscope stage reads these)
+        self.replay_sidecar_hits = 0     # mmap fast-path replays
+        self.replay_sidecar_trusted = 0  # ... of which skipped ALL walks
+        self.replay_decode_walks = 0     # Python decode-walk replays
 
     # -- write path --
 
@@ -107,8 +126,17 @@ class Storage:
         # suffix keeps new files from colliding with recovered ones
         return os.path.join(d, f"{chunk.id}-{os.urandom(4).hex()}.flb")
 
-    def write_through(self, chunk: Chunk, data: bytes) -> None:
-        """Persist an append immediately (crash-safe up to this write)."""
+    def write_through(self, chunk: Chunk, data,
+                      offsets=None) -> None:
+        """Persist an append immediately (crash-safe up to this write).
+
+        ``offsets``: the appended span's record END offsets (relative
+        to the span) when the caller already knows them — the decode
+        path tracks them while joining re-encoded events, so the
+        sidecar costs no extra walk there. Without them the native
+        scanner discovers the table in C; if neither is possible the
+        chunk's sidecar is abandoned and replay falls back to the
+        decode walk (bit-exact either way)."""
         if _fp.ACTIVE:
             # partial(n): torn write — persist only the first n bytes of
             # this append (recovery truncates at the last full record)
@@ -128,6 +156,9 @@ class Storage:
             f.write(tag)
             self._files[chunk.id] = (f, path)
             entry = self._files[chunk.id]
+            if self.sidecars:
+                self._sidecars[chunk.id] = _sidecar.SidecarWriter(
+                    _sidecar.sidecar_path(path))
         f = entry[0]
         f.write(data)
         if _fp.ACTIVE:
@@ -135,6 +166,30 @@ class Storage:
             # append — the exact window write-through exists to bound
             _fp.fire("storage.flush")
         f.flush()
+        # sidecar AFTER the data flush: replay tolerates the table
+        # being behind the payload (tail walk) or ahead of it (entries
+        # past the flushed bytes are dropped), so either crash window
+        # between the two flushes recovers bit-exactly
+        writer = self._sidecars.get(chunk.id)
+        if writer is not None and not writer.dead:
+            writer.append_ends(len(data), self._span_ends(data, offsets))
+
+    @staticmethod
+    def _span_ends(data, offsets):
+        """Record END offsets of one appended span: the caller's table
+        when known, else the native scanner's (None abandons the
+        sidecar — an unscannable span means the table can never again
+        be complete)."""
+        if offsets is not None:
+            return offsets
+        from .. import native
+
+        offs = native.scan_offsets(data)
+        if offs is None:
+            return None
+        if _cw.witness_enabled():
+            _cw.count("storage.write.offset_scan", len(data))
+        return offs[1:]
 
     def finalize(self, chunk: Chunk) -> None:
         """Stamp the CRC + finalized state (called at drain time)."""
@@ -157,6 +212,11 @@ class Storage:
         f.write(_mask_bytes(chunk))
         f.close()
         self._files[chunk.id] = (None, path)
+        writer = self._sidecars.pop(chunk.id, None)
+        if writer is not None:
+            # stamped together with the chunk CRC: a FINAL pair with
+            # matching CRCs is what replay may trust outright
+            writer.finalize()
 
     def is_tracked(self, chunk: Chunk) -> bool:
         """True when the chunk has a backing stream file (it will be
@@ -174,8 +234,15 @@ class Storage:
                 f.close()
             except OSError:
                 pass
+        writer = self._sidecars.pop(chunk.id, None)
+        if writer is not None:
+            writer.close()
         try:
             os.unlink(path)
+        except OSError:
+            pass
+        try:
+            os.unlink(_sidecar.sidecar_path(path))
         except OSError:
             pass
 
@@ -198,6 +265,14 @@ class Storage:
             f.write(_mask_bytes(chunk))
             f.write(tag)
             f.write(payload)
+        if self.sidecars:
+            # DLQ files are read back by dlq_chunks / re-ingest
+            # tooling: give them a finalized sidecar so inspection of
+            # a large quarantine does not pay the decode walk
+            writer = _sidecar.SidecarWriter(_sidecar.sidecar_path(path))
+            writer.append_ends(len(payload),
+                               self._span_ends(payload, None))
+            writer.finalize()
         return path
 
     # -- read path (backlog) --
@@ -218,7 +293,33 @@ class Storage:
                     route_names = tuple(
                         f.read(rlen).decode("utf-8").split("\n"))
             tag = f.read(tag_len).decode("utf-8")
-            payload = f.read()
+            got = self._replay_mmap(f, path, state, crc)
+            if got is not None:
+                payload, records = got
+                self.replay_sidecar_hits += 1
+            else:
+                payload, records = self._replay_decode(f, state, crc)
+                self.replay_decode_walks += 1
+        chunk = Chunk(tag, _TYPE_NAMES.get(tcode, EVENT_TYPE_LOGS),
+                      os.path.basename(os.path.dirname(path)))
+        # payload is already an immutable bytes object: the buf setter
+        # adopts it without re-materializing (the replay path used to
+        # copy every recovered byte twice more here — bytearray(payload)
+        # through the bytes() in the setter)
+        chunk.buf = payload
+        chunk.records = records
+        chunk.locked = True
+        chunk.route_names = route_names
+        # QoS class survives a restart (shed-by-priority + readmission
+        # order stay correct for recovered spill); 0 = unstamped
+        chunk.priority = prio - 1 if prio else None
+        return chunk
+
+    def _replay_decode(self, f, state: int, crc: int):
+        """The decode-walk replay: read the payload, CRC-check, walk
+        every record in Python to count + find the torn tail. The
+        semantic reference the mmap fast path must match bit-exactly."""
+        payload = f.read()
         if state == STATE_FINAL and self.checksum and crc:
             if _fp.ACTIVE:
                 # return(err) forces the corrupt-chunk path for a chunk
@@ -236,17 +337,88 @@ class Storage:
         records = 0
         for _ in u:
             records += 1
-        payload = payload[: u.tell()]
-        chunk = Chunk(tag, _TYPE_NAMES.get(tcode, EVENT_TYPE_LOGS),
-                      os.path.basename(os.path.dirname(path)))
-        chunk.buf = bytearray(payload)
-        chunk.records = records
-        chunk.locked = True
-        chunk.route_names = route_names
-        # QoS class survives a restart (shed-by-priority + readmission
-        # order stay correct for recovered spill); 0 = unstamped
-        chunk.priority = prio - 1 if prio else None
-        return chunk
+        if _cw.witness_enabled():
+            _cw.count("storage.replay.decode_walk", len(payload))
+        if u.tell() != len(payload):
+            # slice ONLY the torn case: clean recoveries keep the one
+            # f.read() materialization (memscope host-redundant-copy)
+            payload = payload[: u.tell()]
+        return payload, records
+
+    def _replay_mmap(self, f, path: str, state: int, crc: int):
+        """Offset-sidecar fast path: map the chunk file read-only and
+        take the record table from the sidecar instead of walking the
+        payload in Python. Returns (payload bytes, records) or None to
+        fall back to the decode walk.
+
+        Trust ladder: a FINAL chunk + FINAL sidecar with both CRCs
+        valid is believed outright (no walk at all). Anything torn or
+        un-finalized is VALIDATED: the covered region must re-count in
+        C to exactly the sidecar's record count (the C walk rejects
+        everything the Python walk rejects, so a validated prefix
+        decodes identically), and the uncovered tail — normally empty
+        or one partial append — is walked in Python. Any disagreement
+        abandons the fast path entirely; corruption that the decode
+        walk would surface as an error (CRC mismatch) raises the same
+        error here, so quarantine behaviour is preserved."""
+        if not self.sidecars:
+            return None
+        payload_off = f.tell()
+        plen = os.fstat(f.fileno()).st_size - payload_off
+        if plen <= 0:
+            return None
+        sc = _sidecar.read_sidecar(_sidecar.sidecar_path(path), plen)
+        if sc is None:
+            return None
+        _sstate, ends, trusted = sc
+        if not len(ends):
+            return None
+        try:
+            mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        except (ValueError, OSError):
+            return None
+        view = memoryview(mm)[payload_off:]
+        try:
+            if state == STATE_FINAL and self.checksum and crc:
+                if _fp.ACTIVE:
+                    _fp.fire("storage.crc_verify")
+                if zlib.crc32(view) & 0xFFFFFFFF != crc:
+                    raise ValueError("crc mismatch")
+            else:
+                trusted = False  # an open payload may be torn anywhere
+            covered = int(ends[-1])
+            records = int(len(ends))
+            if trusted and covered == plen:
+                # both CRCs vouch for both files: no walk of any kind
+                self.replay_sidecar_trusted += 1
+                end = covered
+            else:
+                from .. import native
+
+                n = native.count_records(view[:covered])
+                if n is None or n != records:
+                    return None  # table lies → decode walk decides
+                if _cw.witness_enabled():
+                    _cw.count("storage.replay.validate_walk", covered)
+                end = covered
+                if covered < plen:
+                    # the data flush outran the sidecar flush: the tail
+                    # holds whole appends the table never saw — walk
+                    # just those bytes (usually one append, not 2MB)
+                    from ..codec.msgpack import Unpacker
+
+                    tail = bytes(view[covered:])
+                    u = Unpacker(tail)
+                    for _ in u:
+                        records += 1
+                    end = covered + u.tell()
+            payload = bytes(view[:end])
+            if _cw.witness_enabled():
+                _cw.count("storage.replay.materialize", end)
+            return payload, records
+        finally:
+            view.release()
+            mm.close()
 
     def scan_backlog(self) -> List[Chunk]:
         """Recover chunks left on disk by a previous run; corrupt files
@@ -273,12 +445,14 @@ class Storage:
                     except OSError:
                         log.exception("storage: cannot quarantine %s",
                                       path)
+                    self._drop_sidecar(path)
                     continue
                 if chunk.records == 0:
                     try:
                         os.unlink(path)
                     except OSError:
                         pass
+                    self._drop_sidecar(path)
                     continue
                 # track so delivery deletes the file
                 self._files[chunk.id] = (None, path)
@@ -302,6 +476,16 @@ class Storage:
                     continue
         return out
 
+    @staticmethod
+    def _drop_sidecar(path: str) -> None:
+        """Remove the offset table of a chunk file that is gone (empty
+        recovery / quarantine rename): an orphaned table next to
+        nothing would be adopted by no replay and confuse operators."""
+        try:
+            os.unlink(_sidecar.sidecar_path(path))
+        except OSError:
+            pass
+
     def close(self) -> None:
         for f, _ in list(self._files.values()):
             if f is not None:
@@ -309,3 +493,7 @@ class Storage:
                     f.close()
                 except OSError:
                     pass
+        for writer in list(self._sidecars.values()):
+            if writer is not None:
+                writer.close()
+        self._sidecars.clear()

@@ -187,23 +187,46 @@ def available() -> bool:
     return _load() is not None
 
 
-def count_records(buf: bytes) -> Optional[int]:
+def _buf_arg(buf):
+    """(arg, length, keepalive) presenting any C-contiguous bytes-like
+    object to a ``c_char_p`` parameter WITHOUT copying. ``bytes`` goes
+    straight through ctypes; memoryview / mmap / bytearray / uint8
+    ndarray views travel as a raw pointer into the existing buffer
+    (``c_char_p`` rejects non-bytes and ``from_buffer`` fails on
+    read-only mmaps, so the pointer is taken through a zero-copy
+    ``np.frombuffer`` view). The keepalive object must stay referenced
+    for the duration of the native call — callers hold it in a local.
+
+    This is what lets the mmap replay path (core/storage.py) hand
+    chunk-file pages straight to the C walker: the bytes are untrusted
+    and possibly crash-torn, which is exactly the load the
+    untrusted-bytes bounds gate on the native side proves safe
+    (analysis/native_gate.py, rule untrusted-bytes-bounds)."""
+    if isinstance(buf, bytes):
+        return buf, len(buf), None
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    return arr.ctypes.data_as(ctypes.c_char_p), arr.size, arr
+
+
+def count_records(buf) -> Optional[int]:
     lib = _load()
     if lib is None:
         return None
-    n = lib.fbtpu_count_records(buf, len(buf))
+    p, blen, _keep = _buf_arg(buf)
+    n = lib.fbtpu_count_records(p, blen)
     return None if n < 0 else int(n)
 
 
-def scan_offsets(buf: bytes) -> Optional[np.ndarray]:
+def scan_offsets(buf) -> Optional[np.ndarray]:
     lib = _load()
     if lib is None:
         return None
+    p, blen, _keep = _buf_arg(buf)
     # worst case: 1-byte records
-    cap = len(buf) + 1
+    cap = blen + 1
     offsets = np.empty(cap + 1, dtype=np.int64)
     n = lib.fbtpu_scan_offsets(
-        buf, len(buf),
+        p, blen,
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
     )
     if n < 0:
@@ -211,19 +234,20 @@ def scan_offsets(buf: bytes) -> Optional[np.ndarray]:
     return offsets[: n + 1]
 
 
-def compact(buf: bytes, offsets: np.ndarray,
+def compact(buf, offsets: np.ndarray,
             keep: np.ndarray) -> Optional[bytes]:
     """Order-preserving copy of the records with keep[i] True straight
     from the source buffer (the raw grep path's survivor re-emit)."""
     lib = _load()
     if lib is None:
         return None
+    p, blen, _keep_ref = _buf_arg(buf)
     n = len(keep)
-    out = np.empty(len(buf), dtype=np.uint8)
+    out = np.empty(blen, dtype=np.uint8)
     keep_u8 = np.ascontiguousarray(keep, dtype=np.uint8)
     offs = np.ascontiguousarray(offsets, dtype=np.int64)
     w = lib.fbtpu_compact(
-        buf, len(buf),
+        p, blen,
         offs.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
         keep_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n,
@@ -425,7 +449,7 @@ class GrepTables:
         return new
 
 
-def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
+def grep_match(buf, tables: GrepTables, n_hint: Optional[int] = None
                ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
     """One-pass field-extract + DFA match over chunk bytes. Returns
     (mask[R, n] bool, offsets[n+1] i64, n) or None (native unavailable /
@@ -436,6 +460,7 @@ def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
     est = n_hint if n_hint is not None else count_records(buf)
     if est is None:
         return None
+    p, blen, _keep = _buf_arg(buf)
     R = tables.n_rules
     cap = max(est, 1)  # match/offsets sized to the capacity granted to C
     match = np.empty((R, cap), dtype=np.uint8)
@@ -443,7 +468,7 @@ def grep_match(buf: bytes, tables: GrepTables, n_hint: Optional[int] = None
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_longlong)
     n = getattr(lib, "fbtpu_grep_match_v2")(
-        buf, len(buf),
+        p, blen,
         tables.keys_cat,
         tables.key_offs.ctypes.data_as(i64p),
         len(tables.key_offs) - 1,
@@ -509,18 +534,31 @@ def grep_filter(buf, tables: "GrepFilterTables",
     lib = _load()
     if lib is None or getattr(lib, "fbtpu_grep_filter", None) is None:
         return None
-    if not isinstance(buf, (bytes, bytearray)):
-        buf = bytes(buf)
+    # non-bytes buffers (bytearray / memoryview / mmap view) travel as
+    # a raw pointer — the walker reads them in place (the memscope
+    # host-redundant-copy fix: this path used to materialize a bytes()
+    # copy of every bytearray chunk before the call)
+    p, blen, _keep = _buf_arg(buf)
     # no counting pre-pass: the walk discovers the record count, so an
     # unknown count just means sizing scratch to the 3-bytes-per-record
     # floor (array [ts, body] is at least 3 bytes)
-    cap = max(n_hint if n_hint is not None else len(buf) // 3 + 1, 1)
-    out = _arena(len(buf))
+    cap = max(n_hint if n_hint is not None else blen // 3 + 1, 1)
+    out = _arena(blen)
+    if _keep is not None:
+        # a chained filter may hand back THIS thread's arena view from
+        # a previous call: the walker writes survivors into the arena
+        # while reading, so an aliased input must be materialized (the
+        # one case the zero-copy pointer path cannot serve)
+        p_addr = ctypes.cast(p, ctypes.c_void_p).value or 0
+        o_addr = out.ctypes.data
+        if o_addr <= p_addr < o_addr + out.size:
+            buf = bytes(buf)
+            p, blen, _keep = _buf_arg(buf)
     info = np.zeros(3, dtype=np.int64)
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_longlong)
     w = lib.fbtpu_grep_filter(
-        bytes(buf) if isinstance(buf, bytearray) else buf, len(buf),
+        p, blen,
         tables.keys_cat,
         tables.key_offs.ctypes.data_as(i64p),
         len(tables.key_offs) - 1,
@@ -549,6 +587,10 @@ def grep_filter(buf, tables: "GrepFilterTables",
         return n, n_keep, buf
     if n_keep == 0:
         return n, 0, b""
+    # the arena view IS the documented contract (docstring: consume
+    # before this thread's next grep_filter call); the engine copies it
+    # into the chunk store
+    # fbtpu-lint: allow(host-mutable-view-escape)
     return n, n_keep, memoryview(out)[:w]
 
 
@@ -589,7 +631,7 @@ def stage_threads_effective(requested: Optional[int] = None) -> Optional[int]:
 
 
 def stage_field_into(
-    buf: bytes, key: bytes, out_batch: np.ndarray,
+    buf, key: bytes, out_batch: np.ndarray,
     out_lengths: np.ndarray, n_hint: Optional[int] = None,
     threads: Optional[int] = None,
     offsets_out: Optional[np.ndarray] = None,
@@ -634,18 +676,22 @@ def stage_field_into(
     p_b = out_batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     p_l = out_lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
     p_o = offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+    # mmap replay staging: buf may be a read-only view of chunk-file
+    # pages — the extraction walks them in place, no host copy between
+    # the page cache and the caller's transfer matrix
+    p, blen, _keep = _buf_arg(buf)
     mt_fn = getattr(lib, "fbtpu_stage_field_mt", None)
     if mt_fn is not None:
-        n = mt_fn(buf, len(buf), key, len(key), p_b, p_l, est, L, p_o,
+        n = mt_fn(p, blen, key, len(key), p_b, p_l, est, L, p_o,
                   threads if threads is not None else _stage_threads())
     else:
-        n = lib.fbtpu_stage_field(buf, len(buf), key, len(key), p_b, p_l,
+        n = lib.fbtpu_stage_field(p, blen, key, len(key), p_b, p_l,
                                   est, L, p_o)
     return None if n < 0 else int(n)
 
 
 def stage_field(
-    buf: bytes, key: bytes, max_len: int, pad_to: Optional[int] = None,
+    buf, key: bytes, max_len: int, pad_to: Optional[int] = None,
     n_hint: Optional[int] = None, threads: Optional[int] = None,
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]:
     """Fill the staging matrix for one top-level string field straight
@@ -682,12 +728,13 @@ def stage_field(
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
         )
     batch, lengths, offsets, p_b, p_l, p_o = arena
+    p, blen, _keep = _buf_arg(buf)
     mt_fn = getattr(lib, "fbtpu_stage_field_mt", None)
     if mt_fn is not None:
-        n = mt_fn(buf, len(buf), key, len(key), p_b, p_l, est, max_len,
+        n = mt_fn(p, blen, key, len(key), p_b, p_l, est, max_len,
                   p_o, threads if threads is not None else _stage_threads())
     else:
-        n = lib.fbtpu_stage_field(buf, len(buf), key, len(key), p_b, p_l,
+        n = lib.fbtpu_stage_field(p, blen, key, len(key), p_b, p_l,
                                   est, max_len, p_o)
     if n < 0:
         return None

@@ -726,7 +726,7 @@ static int mp_canonical(const uint8_t *p, const uint8_t *end, int depth,
         for (i = 0; i < n; i++) {
             long long klen;
             const uint8_t *kstr = mp_str_hdr(q, end, &klen);
-            if (!kstr || kstr + klen > end) return JT_FALLBACK;
+            if (!kstr || klen > end - kstr) return JT_FALLBACK;
             int rc = mp_canonical(q, end, depth + 1, &q);
             if (rc) return rc;
             if (i < 16) {
@@ -768,7 +768,7 @@ static int mp_canonical(const uint8_t *p, const uint8_t *end, int depth,
             || b == 0xDB) {                                /* str */
         long long slen;
         const uint8_t *s = mp_str_hdr(p, end, &slen);
-        if (!s || s + slen > end) return JT_FALLBACK;
+        if (!s || slen > end - s) return JT_FALLBACK;
         if (b == 0xD9 && slen < 32) return JT_FALLBACK;
         if (b == 0xDA && slen <= 0xFF) return JT_FALLBACK;
         if (b == 0xDB && slen <= 0xFFFF) return JT_FALLBACK;
@@ -1307,7 +1307,7 @@ static int transcode_record(const uint8_t *rec, const uint8_t *end,
             const uint8_t *kstr = mp_str_hdr(kv, end, &klen);
             const uint8_t *val;
             int match = 0;
-            if (kstr && kstr + klen <= end) {
+            if (kstr && klen <= end - kstr) {
                 val = kstr + klen;
                 match = (klen == keylen && memcmp(kstr, key, klen) == 0);
             } else {
@@ -1318,7 +1318,7 @@ static int transcode_record(const uint8_t *rec, const uint8_t *end,
                 if (val >= end) return JT_FALLBACK;
                 long long sl;
                 const uint8_t *s = mp_str_hdr(val, end, &sl);
-                if (s && s + sl <= end) {
+                if (s && sl <= end - s) {
                     vstr = s;
                     vlen = sl;
                     hit_kind = 1;

@@ -534,3 +534,140 @@ def test_fuzz_wasi_module_instantiation():
                 TypeError, ZeroDivisionError, MemoryError,
                 OverflowError):
             pass
+
+
+# --------------------------------------- offset sidecar / mmap replay
+
+def test_fuzz_sidecar_parser(tmp_path):
+    """read_sidecar walks operator-disk binary files that a crash can
+    tear anywhere: every mutation must yield None or a VALID table
+    (strictly increasing, positive, clamped to the payload) — never a
+    crash, never an out-of-range entry the mmap replay would stage."""
+    from fluentbit_tpu.core.sidecar import SidecarWriter, read_sidecar
+
+    rng = random.Random(0x0FF5)
+    p = str(tmp_path / "seed.offs")
+    w = SidecarWriter(p)
+    w.append_ends(300, [100, 200, 300])
+    w.finalize()
+    with open(p, "rb") as f:
+        seed = f.read()
+    path = str(tmp_path / "fuzz.offs")
+    for i in range(SEED_ROUNDS):
+        blob = _mutate(rng, seed)
+        with open(path, "wb") as f:
+            f.write(blob)
+        got = read_sidecar(path, 300)
+        if got is not None:
+            state, ends, trusted = got
+            assert state in (0, 1)
+            prev = 0
+            for e in ends.tolist():
+                assert 0 < e <= 300 and e > prev
+                prev = e
+    for i in range(SEED_ROUNDS // 2):
+        with open(path, "wb") as f:
+            f.write(rng.randbytes(rng.randrange(64)))
+        read_sidecar(path, 300)  # None or valid; must not raise
+
+
+def _sidecar_seed_store(root, finalize=True):
+    """One persisted chunk (+sidecar) under ``root``; returns the chunk
+    file path."""
+    import glob as g
+
+    from fluentbit_tpu.codec.chunk import Chunk
+    from fluentbit_tpu.codec.events import encode_event
+    from fluentbit_tpu.core.storage import Storage
+
+    st = Storage(str(root), checksum=True)
+    c = Chunk("app.log", in_name="lib.0")
+    data = b"".join(encode_event({"m": i, "pad": "y" * 24}, float(i))
+                    for i in range(6))
+    c.append(data, 6)
+    st.write_through(c, data)
+    if finalize:
+        st.finalize(c)
+    st.close()
+    (chunk_path,) = g.glob(str(root / "streams" / "*" / "*.flb"))
+    return chunk_path
+
+
+def _replay_outcome(root, sidecars):
+    """(recovered (tag, payload, records) list, quarantine count) for
+    one scan — the whole observable result of a backlog replay."""
+    import glob as g
+
+    from fluentbit_tpu.core.storage import Storage
+
+    st = Storage(str(root), checksum=True)
+    st.sidecars = sidecars
+    got = st.scan_backlog()
+    recovered = sorted((c.tag, bytes(c.buf), c.records) for c in got)
+    quarantined = len(g.glob(str(root / "dlq" / "*.corrupt")))
+    return recovered, quarantined
+
+
+@pytest.mark.parametrize("finalize", [True, False])
+def test_fuzz_sidecar_mutations_never_change_replay(tmp_path, finalize):
+    """The sidecar may only ACCELERATE replay, never change it: under
+    arbitrary sidecar corruption the mmap fast path must yield exactly
+    the decode walk's outcome (same payload bytes, same record counts,
+    same quarantine verdicts)."""
+    import os
+    import shutil
+
+    from fluentbit_tpu.core.sidecar import sidecar_path
+
+    rng = random.Random(0x51DE + finalize)
+    src = tmp_path / "seed"
+    chunk_path = _sidecar_seed_store(src, finalize=finalize)
+    sc_rel = os.path.relpath(sidecar_path(chunk_path), src)
+    with open(sidecar_path(chunk_path), "rb") as f:
+        seed = f.read()
+    for i in range(60):
+        blob = _mutate(rng, seed)
+        a, b = tmp_path / f"a{i}", tmp_path / f"b{i}"
+        shutil.copytree(src, a)
+        shutil.copytree(src, b)
+        for d in (a, b):
+            with open(os.path.join(d, sc_rel), "wb") as f:
+                f.write(blob)
+        fast = _replay_outcome(a, sidecars=True)
+        slow = _replay_outcome(b, sidecars=False)
+        assert fast == slow, f"sidecar mutation {i} changed replay"
+        shutil.rmtree(a)
+        shutil.rmtree(b)
+
+
+@pytest.mark.parametrize("finalize", [True, False])
+def test_fuzz_chunk_mutations_replay_differential(tmp_path, finalize):
+    """Truncated / bit-flipped CHUNK files (intact sidecar): the mmap
+    staging path must recover or quarantine IDENTICALLY to the decode
+    walk — corruption the walk rejects (CRC, torn records) must never
+    slip through the fast path."""
+    import os
+    import shutil
+
+    rng = random.Random(0xC4A2 + finalize)
+    src = tmp_path / "seed"
+    chunk_path = _sidecar_seed_store(src, finalize=finalize)
+    ck_rel = os.path.relpath(chunk_path, src)
+    with open(chunk_path, "rb") as f:
+        seed = f.read()
+    for i in range(60):
+        if i % 3 == 0 and len(seed) > 2:  # plain torn-tail truncation
+            blob = seed[: rng.randrange(1, len(seed))]
+        else:
+            blob = _mutate(rng, seed)
+        a, b = tmp_path / f"a{i}", tmp_path / f"b{i}"
+        shutil.copytree(src, a)
+        shutil.copytree(src, b)
+        for d in (a, b):
+            with open(os.path.join(d, ck_rel), "wb") as f:
+                f.write(blob)
+        fast = _replay_outcome(a, sidecars=True)
+        slow = _replay_outcome(b, sidecars=False)
+        assert fast == slow, f"chunk mutation {i} changed replay"
+        shutil.rmtree(a)
+        shutil.rmtree(b)

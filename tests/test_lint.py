@@ -35,15 +35,17 @@ def test_package_tree_clean():
     # (ROADMAP item 1) is subtracted exactly — anything else fails, and
     # a stale baseline entry that no longer matches the tree fails too
     # ... and since the locksmith pack, analysis/lock_baseline.json is
-    # the second sanctioned baseline — both are subtracted EXACTLY
+    # the second sanctioned baseline, and since the memscope pack,
+    # analysis/copy_budget.json the third — all are subtracted EXACTLY
     import json
 
     from fluentbit_tpu.analysis.__main__ import _canon
     from fluentbit_tpu.analysis.registry import budget_path, \
-        lock_baseline_path
+        copy_budget_path, lock_baseline_path
 
     recorded = set()
-    for bpath in (budget_path(), lock_baseline_path()):
+    for bpath in (budget_path(), lock_baseline_path(),
+                  copy_budget_path()):
         with open(bpath, "r", encoding="utf-8") as fh:
             recorded |= {(d["path"], d["rule"], d["message"])
                          for d in json.load(fh)["findings"]}
@@ -89,9 +91,12 @@ def test_list_rules():
                  "shard-indivisible-axis", "donation-aval-mismatch",
                  "shard-implicit-reshard", "jit-dynamic-shape-retrace",
                  "codec-balance", "codec-bounds", "codec-leak",
+                 "untrusted-bounds",
                  "lock-order-cycle", "guarded-field-unlocked",
                  "guarded-by-missing", "atomicity-check-then-act",
-                 "lock-held-across-dispatch", "cow-swap-aliasing"):
+                 "lock-held-across-dispatch", "cow-swap-aliasing",
+                 "host-redundant-copy", "host-decode-then-restage",
+                 "host-mutable-view-escape", "mmap-lifetime-escape"):
         assert name in proc.stdout
 
 

@@ -1,0 +1,399 @@
+"""fbtpu-memscope: host copy-census rules, the committed copy-budget
+gate, the FBTPU_COPY_WITNESS runtime crosscheck (tier-1 static ⊇
+dynamic), and the offset-sidecar replay differential (bit-exact vs the
+decode walk).
+
+Reference: ANALYSIS.md "Host-memory pack"; analysis/memscope.py;
+core/copywitness.py; core/sidecar.py.
+"""
+
+import copy
+import glob
+import json
+import os
+import textwrap
+
+import pytest
+
+from fluentbit_tpu.analysis import lint_source
+from fluentbit_tpu.analysis.memscope import (
+    ELIMINATED, INGEST_ENTRIES, WITNESS_SHAPES, MemscopeRules,
+    build_copy_census, census_snapshot, compare_copy_budget,
+    witness_crosscheck)
+from fluentbit_tpu.analysis.registry import copy_budget_path
+from fluentbit_tpu.codec.chunk import Chunk
+from fluentbit_tpu.codec.events import encode_event
+from fluentbit_tpu.core import copywitness, sidecar
+from fluentbit_tpu.core.storage import Storage
+
+# a census-scope module path: the memscope rules key off SCOPES
+MOD = "fluentbit_tpu/core/engine.py"
+
+
+def memscope_findings(src, path=MOD):
+    """Lint a fixture and keep only the memscope pack's findings (the
+    same source also runs under the guard/locksmith rules)."""
+    src = textwrap.dedent(src)
+    return [f for f in lint_source(src, path)
+            if f.rule in MemscopeRules.RULE_NAMES]
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# --------------------------------------------------- host-redundant-copy
+
+def test_redundant_copy_fires():
+    fs = memscope_findings("""
+        def f(data):
+            a = bytes(data)
+            b = bytes(data)
+            return a, b
+    """)
+    assert rules_of(fs) == ["host-redundant-copy"]
+    assert fs[0].severity == "warning"
+
+
+def test_redundant_copy_quiet_on_rebind_between():
+    fs = memscope_findings("""
+        def f(data):
+            a = bytes(data)
+            data = transform(data)
+            b = bytes(data)
+            return a, b
+    """)
+    assert fs == []
+
+
+def test_redundant_copy_quiet_on_sibling_if_arms():
+    # exclusive arms materialize at most once per execution
+    fs = memscope_findings("""
+        def f(data, cond):
+            if cond:
+                a = bytes(data)
+            else:
+                a = bytes(data)
+            return a
+    """)
+    assert fs == []
+
+
+def test_redundant_copy_suppressed_by_allow():
+    fs = memscope_findings("""
+        def f(data):
+            a = bytes(data)
+            # fbtpu-lint: allow(host-redundant-copy)
+            b = bytes(data)
+            return a, b
+    """)
+    assert fs == []
+
+
+# ----------------------------------------------- host-decode-then-restage
+
+def test_decode_restage_fires_on_unpackb_to_packb():
+    fs = memscope_findings("""
+        def f(raw):
+            recs = unpackb(raw)
+            return packb(recs)
+    """)
+    assert rules_of(fs) == ["host-decode-then-restage"]
+    assert fs[0].severity == "warning"
+
+
+def test_decode_restage_fires_on_unpacker_loop():
+    fs = memscope_findings("""
+        def f(raw):
+            out = []
+            for rec in Unpacker(raw):
+                out.append(packb(rec))
+            return out
+    """)
+    assert rules_of(fs) == ["host-decode-then-restage"]
+
+
+def test_decode_restage_quiet_without_taint():
+    fs = memscope_findings("""
+        def f(raw, other):
+            recs = unpackb(raw)
+            use(recs)
+            return packb(other)
+    """)
+    assert fs == []
+
+
+# ----------------------------------------------- host-mutable-view-escape
+
+def test_view_escape_fires_on_arena_view_return():
+    fs = memscope_findings("""
+        def f():
+            view = memoryview(_tls.arena)[:64]
+            return view
+    """)
+    assert rules_of(fs) == ["host-mutable-view-escape"]
+    assert fs[0].severity == "error"
+
+
+def test_view_escape_quiet_when_materialized():
+    fs = memscope_findings("""
+        def f():
+            view = memoryview(_tls.arena)[:64]
+            return bytes(view)
+    """)
+    assert fs == []
+
+
+def test_view_escape_fires_on_stage_field_attr_store():
+    fs = memscope_findings("""
+        def f(self, data):
+            out = stage_field(data)
+            self.cache = out
+    """)
+    assert rules_of(fs) == ["host-mutable-view-escape"]
+
+
+# -------------------------------------------------- mmap-lifetime-escape
+
+def test_mmap_escape_fires_on_view_attr_store():
+    fs = memscope_findings("""
+        def f(self, fd):
+            mm = mmap.mmap(fd, 0)
+            view = memoryview(mm)
+            self.cache = view[10:20]
+    """)
+    assert rules_of(fs) == ["mmap-lifetime-escape"]
+    assert fs[0].severity == "error"
+
+
+def test_mmap_escape_quiet_when_bytes_taken():
+    fs = memscope_findings("""
+        def f(self, fd):
+            mm = mmap.mmap(fd, 0)
+            view = memoryview(mm)
+            try:
+                self.cache = bytes(view[10:20])
+            finally:
+                view.release()
+                mm.close()
+    """)
+    assert fs == []
+
+
+# -------------------------------------------------------------- census
+
+def test_census_covers_every_ingest_entry():
+    census = build_copy_census()
+    entries = {cid.rsplit(".", 1)[-1] for cid in census["chains"]}
+    assert entries == set(INGEST_ENTRIES)
+
+
+def test_census_sites_all_budgeted_and_fresh():
+    census = build_copy_census()
+    # every instrumented site in source carries a WITNESS_SHAPES budget
+    assert not [s for s, d in census["witness_sites"].items()
+                if d.get("unbudgeted")]
+    # every budget entry still exists in source
+    assert census["stale_shapes"] == []
+    # and the two sides are exactly the same site set
+    assert set(census["witness_sites"]) == set(WITNESS_SHAPES)
+
+
+def test_committed_copy_budget_is_fresh():
+    """analysis/copy_budget.json must match the source of truth — the
+    same contract test_lint.py applies to the launch budget."""
+    with open(copy_budget_path(), "r", encoding="utf-8") as fh:
+        committed = json.load(fh)
+    assert committed["census"] == census_snapshot(build_copy_census())
+    # the zero-copy work the census paid for stays on the books
+    assert committed["eliminated"] == list(ELIMINATED)
+    assert len(committed["eliminated"]) >= 2
+
+
+# ------------------------------------------------------- budget compare
+
+def _snapshot():
+    return census_snapshot(build_copy_census())
+
+
+def test_compare_flags_copy_pass_growth():
+    cur, base = _snapshot(), _snapshot()
+    cid = next(iter(cur["chains"]))
+    cur["chains"][cid]["copy_passes"] += 1
+    regressions, notes = compare_copy_budget(cur, base)
+    assert any("copy_passes grew" in r for r in regressions)
+
+
+def test_compare_notes_improvement():
+    cur, base = _snapshot(), _snapshot()
+    cid = max(cur["chains"],
+              key=lambda c: cur["chains"][c]["copy_passes"])
+    cur["chains"][cid]["copy_passes"] -= 1
+    regressions, notes = compare_copy_budget(cur, base)
+    assert regressions == []
+    assert any("improved" in n for n in notes)
+
+
+def test_compare_flags_new_site_and_unbudgeted_site():
+    cur, base = _snapshot(), _snapshot()
+    cur["witness_sites"]["engine.new.materialize"] = {
+        "kind": "copy", "bytes_per_record": 256}
+    cur["witness_sites"]["engine.mystery.materialize"] = {
+        "kind": "copy", "bytes_per_record": -1}  # unbudgeted marker
+    regressions, _ = compare_copy_budget(cur, base)
+    assert any("engine.new.materialize" in r and "new" in r
+               for r in regressions)
+    assert any("engine.mystery.materialize" in r for r in regressions)
+
+
+def test_compare_notes_vanished_entries():
+    cur, base = _snapshot(), _snapshot()
+    gone_chain = next(iter(cur["chains"]))
+    gone_site = next(iter(cur["witness_sites"]))
+    del cur["chains"][gone_chain]
+    del cur["witness_sites"][gone_site]
+    regressions, notes = compare_copy_budget(cur, base)
+    assert regressions == []
+    assert any(gone_chain in n for n in notes)
+    assert any(gone_site in n for n in notes)
+
+
+def test_identical_snapshots_compare_clean():
+    cur = _snapshot()
+    assert compare_copy_budget(cur, copy.deepcopy(cur)) == ([], [])
+
+
+# ------------------------------------- runtime witness (tier-1 crosscheck)
+
+def _witness_on():
+    os.environ["FBTPU_COPY_WITNESS"] = "1"
+    copywitness.refresh()
+    copywitness.witness_reset()
+
+
+def _witness_off():
+    os.environ.pop("FBTPU_COPY_WITNESS", None)
+    copywitness.refresh()
+    copywitness.witness_reset()
+
+
+def test_witness_disabled_records_nothing():
+    _witness_off()
+    copywitness.count("chunk.append.materialize", 64)
+    assert copywitness.witness_counts() == {}
+
+
+def test_witness_crosscheck_static_superset_of_dynamic(tmp_path):
+    """Tier-1: drive a representative ingest + crash-recovery workload
+    under FBTPU_COPY_WITNESS and assert every copy the runtime actually
+    performed is a budgeted site in the static census."""
+    _witness_on()
+    try:
+        st = Storage(str(tmp_path), checksum=True)
+        c = Chunk("app.log", in_name="lib.0")
+        data = encode_event({"m": 1}, 1.0) + encode_event({"m": 2}, 2.0)
+        # a non-bytes span exercises the chunk-owned-copy site
+        c.append(bytearray(data), 2)
+        st.write_through(c, data)
+        st.finalize(c)
+        st.close()
+        # recovery: the sidecar fast path materializes the payload once
+        got = Storage(str(tmp_path), checksum=True).scan_backlog()
+        assert len(got) == 1 and got[0].records == 2
+        counts = copywitness.witness_counts()
+        assert counts, "workload exercised no instrumented site"
+        assert witness_crosscheck(counts) == []
+    finally:
+        _witness_off()
+
+
+def test_witness_crosscheck_flags_unknown_site():
+    msgs = witness_crosscheck({"engine.rogue.materialize": (3, 768)})
+    assert len(msgs) == 1 and "engine.rogue.materialize" in msgs[0]
+
+
+# -------------------------------------- sidecar replay vs decode replay
+
+def _write_chunk(tmp_path, n_events=3, finalize=True):
+    st = Storage(str(tmp_path), checksum=True)
+    c = Chunk("app.log", in_name="lib.0")
+    data = b"".join(encode_event({"m": i, "pad": "x" * 40}, float(i))
+                    for i in range(n_events))
+    c.append(data, n_events)
+    st.write_through(c, data)
+    if finalize:
+        st.finalize(c)
+    st.close()
+    (path,) = glob.glob(str(tmp_path / "streams" / "*" / "*.flb"))
+    return path
+
+
+def _replay(tmp_path, sidecars=True):
+    st = Storage(str(tmp_path), checksum=True)
+    if not sidecars:
+        st.sidecars = False
+    got = st.scan_backlog()
+    return st, got
+
+
+def test_sidecar_written_next_to_chunk(tmp_path):
+    path = _write_chunk(tmp_path)
+    assert os.path.exists(sidecar.sidecar_path(path))
+
+
+def test_no_sidecar_env_disables_writer(tmp_path, monkeypatch):
+    monkeypatch.setenv("FBTPU_NO_SIDECAR", "1")
+    path = _write_chunk(tmp_path)
+    assert not os.path.exists(sidecar.sidecar_path(path))
+
+
+def test_sidecar_replay_bit_exact_vs_decode(tmp_path):
+    _write_chunk(tmp_path, n_events=5)
+    fast_st, fast = _replay(tmp_path, sidecars=True)
+    slow_st, slow = _replay(tmp_path, sidecars=False)
+    assert fast_st.replay_sidecar_hits == 1
+    assert fast_st.replay_decode_walks == 0
+    # FINAL chunk + FINAL sidecar with both CRCs valid: believed
+    # outright, no walk of any kind
+    assert fast_st.replay_sidecar_trusted == 1
+    assert slow_st.replay_decode_walks == 1
+    assert len(fast) == len(slow) == 1
+    assert fast[0].buf == slow[0].buf
+    assert fast[0].records == slow[0].records == 5
+    assert fast[0].tag == slow[0].tag
+
+
+def test_unfinalized_replay_validates_and_stays_bit_exact(tmp_path):
+    """An open (crash) chunk is never trusted outright: the covered
+    region re-counts in C, and the result still matches the walk."""
+    _write_chunk(tmp_path, n_events=4, finalize=False)
+    fast_st, fast = _replay(tmp_path, sidecars=True)
+    slow_st, slow = _replay(tmp_path, sidecars=False)
+    assert fast_st.replay_sidecar_hits == 1
+    assert fast_st.replay_sidecar_trusted == 0
+    assert fast[0].buf == slow[0].buf
+    assert fast[0].records == slow[0].records == 4
+
+
+def test_torn_tail_replay_bit_exact(tmp_path):
+    """Truncate mid-record (torn final write): the sidecar path must
+    quarantine the torn tail exactly like the decode walk does."""
+    path = _write_chunk(tmp_path, n_events=4, finalize=False)
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        f.truncate(f.tell() - 7)  # tear the last record
+    fast_st, fast = _replay(tmp_path, sidecars=True)
+    slow_st, slow = _replay(tmp_path, sidecars=False)
+    assert fast[0].buf == slow[0].buf
+    assert fast[0].records == slow[0].records == 3
+    # the torn fragment itself never survives into the payload
+    assert fast[0].decode()[-1].body["m"] == 2
+
+
+def test_dropped_sidecar_falls_back_to_decode(tmp_path):
+    path = _write_chunk(tmp_path)
+    Storage._drop_sidecar(path)
+    st, got = _replay(tmp_path, sidecars=True)
+    assert st.replay_sidecar_hits == 0
+    assert st.replay_decode_walks == 1
+    assert got[0].records == 3

@@ -1341,7 +1341,8 @@ def test_quota_resume_honors_mem_buf_limit():
     assert ins.paused_by_qos
     clk.t += 10.0                      # bucket fully refilled
     if ins.pool.pending_bytes < 150:   # top the pool over the limit
-        ins.pool.append("t", b"z" * (150 - ins.pool.pending_bytes), 1)
+        with ins.ingest_lock:
+            ins.pool.append("t", b"z" * (150 - ins.pool.pending_bytes), 1)
     ctx.engine.qos.resume_paused(ctx.engine.inputs)
     assert ins.paused                  # buffer still over: stays paused
     with ins.ingest_lock:
@@ -1393,3 +1394,84 @@ def test_output_less_reload_does_not_rotate_conditional_chunks():
         assert c3 is not c1
     finally:
         ctx.stop()
+
+
+# ---------------------------------------------------------------------
+# per-tenant storage quotas (tenant.storage_limit → SHED write-through)
+# ---------------------------------------------------------------------
+
+
+def test_storage_quota_admit_shed_latch_and_refund():
+    from fluentbit_tpu.core.qos import ADMIT, SHED
+
+    ctx = flb.create(flush="1000")
+    q = ctx.engine.qos
+    q.tenant("cap", storage_limit=100)
+    c1 = Chunk("t", in_name="i")
+    c1.qos_tenant = "cap"
+    assert q.admit_storage(None, c1, 60) == ADMIT
+    assert q.m_storage_used.get(("cap",)) == 60
+    # 60 + 60 > 100: the append's persistence is shed, not deferred
+    assert q.admit_storage(None, c1, 60) == SHED
+    assert q.m_storage_shed.get(("cap",)) == 60
+    # per-chunk latch: once shed always shed, even under the limit —
+    # a persisted file missing its leading records must never exist
+    assert q.admit_storage(None, c1, 10) == SHED
+    # a FRESH chunk under the limit still admits
+    c2 = Chunk("t", in_name="i")
+    c2.qos_tenant = "cap"
+    assert q.admit_storage(None, c2, 40) == ADMIT
+    assert q.m_storage_used.get(("cap",)) == 100
+    # delivery deletes c1's backing file: its charge refunds
+    q.release_storage(c1)
+    assert q.m_storage_used.get(("cap",)) == 40
+    snap = q.snapshot()["tenants"]["cap"]
+    assert snap["storage_limit"] == 100
+    assert snap["storage_used_bytes"] == 40
+
+
+def test_storage_quota_unmetered_tenant_untracked():
+    from fluentbit_tpu.core.qos import ADMIT
+
+    ctx = flb.create(flush="1000")
+    q = ctx.engine.qos
+    c = Chunk("t", in_name="i")  # default tenant, no storage_limit
+    assert q.admit_storage(None, c, 1 << 20) == ADMIT
+    # no charge ledger entry: release is a no-op, nothing was tracked
+    q.release_storage(c)
+    assert q._storage_used == {}
+    assert q._storage_chunk == {}
+
+
+def test_storage_quota_sheds_write_through_over_limit(tmp_path):
+    """Engine-level: appends past tenant.storage_limit stay memory-
+    buffered — the on-disk stream file holds only the admitted prefix
+    and the shed bytes are counted per tenant."""
+    import glob as _glob
+
+    from fluentbit_tpu.core.storage import Storage
+
+    ctx = flb.create(flush="1000")
+    data = encode_event({"pad": "x" * 48}, None)
+    limit = int(2.5 * len(data))  # 2 appends fit, the 3rd overflows
+    in_ffd = ctx.input("lib", tag="t", tenant="cap", **{
+        "storage.type": "filesystem",
+        "tenant.storage_limit": str(limit)})
+    ctx.output("null", match="t")
+    _init_pipeline(ctx.engine)
+    ctx.engine.storage = Storage(str(tmp_path / "st"), checksum=True)
+    ins = ctx._handles[in_ffd]
+    for _ in range(5):
+        assert ctx.engine.input_log_append(ins, "t", data, 1) == 1
+    q = ctx.engine.qos
+    assert q.m_storage_used.get(("cap",)) == 2 * len(data)
+    assert q.m_storage_shed.get(("cap",)) == 3 * len(data)
+    # every append was still ACCEPTED into the memory chunk: only
+    # crash durability for the shed bytes was given up
+    with ins.ingest_lock:
+        (chunk,) = ins.pool.drain()
+    assert chunk.records == 5
+    (path,) = _glob.glob(str(tmp_path / "st" / "streams" / "*" / "*.flb"))
+    with open(path, "rb") as f:
+        blob = f.read()
+    assert blob.endswith(data * 2) and not blob.endswith(data * 3)
