@@ -42,6 +42,9 @@ import time
 TARGET = 50e6  # north-star lines/sec (BASELINE.md)
 CHUNK_RECORDS = 8192
 N_CHUNKS = 8
+# kernel_only calibration: one timed assoc rep above this on the CPU
+# backend skips the measured window (reason recorded in RESULT json)
+_ASSOC_PROBE_BUDGET_S = 0.75
 
 APACHE2 = (
     r'^(?<host>[^ ]*) [^ ]* (?<user>[^ ]*) \[(?<time>[^\]]*)\] '
@@ -950,9 +953,30 @@ def kernel_only(raw_chunks) -> dict:
     scan_rate = rate(program_for((APACHE2,), 512))
     out["kernel_scan_lines_per_sec"] = scan_rate
     try:
-        assoc_rate = rate(GrepProgram([compile_dfa(APACHE2)], 512,
-                                      kernel="assoc"))
-        out["kernel_assoc_lines_per_sec"] = assoc_rate
+        assoc_prog = GrepProgram([compile_dfa(APACHE2)], 512,
+                                 kernel="assoc")
+        # Calibration probe before committing the 2 s window: the
+        # assoc kernel's compose tree is O(n_states^2) per character
+        # and known-pathological on the CPU backend for the apache2
+        # DFA — a full measured window there burns bench deadline to
+        # report a rate the variant chooser would discard anyway. One
+        # timed rep decides; the skip and its reason land IN the
+        # RESULT json (same rule as the device-fallback diagnosis).
+        from fluentbit_tpu.ops import device as _dev
+        assoc_prog.match(b, ln)  # warm + compile (outside the probe)
+        t0 = time.perf_counter()
+        assoc_prog.match(b, ln)
+        probe_s = time.perf_counter() - t0
+        if (_dev.platform() in (None, "cpu")
+                and probe_s > _ASSOC_PROBE_BUDGET_S):
+            assoc_rate = 0
+            out["kernel_assoc_skipped"] = (
+                f"cpu probe: {probe_s:.2f}s/rep > "
+                f"{_ASSOC_PROBE_BUDGET_S:.2f}s budget — pathological "
+                f"assoc variant on CPU, measured window skipped")
+        else:
+            assoc_rate = rate(assoc_prog)
+            out["kernel_assoc_lines_per_sec"] = assoc_rate
     except Exception as e:
         assoc_rate = 0
         out["kernel_assoc_error"] = repr(e)
@@ -1441,6 +1465,8 @@ def final_line(cpu, dev, dev_err, extras):
             "kernel_scan_lines_per_sec"),
         "kernel_assoc_lines_per_sec": (kernel_src or {}).get(
             "kernel_assoc_lines_per_sec"),
+        "kernel_assoc_skipped": (kernel_src or {}).get(
+            "kernel_assoc_skipped"),
         "kernel_best_variant": (kernel_src or {}).get("kernel_best_variant"),
         "kernel_measured_on": (
             "device" if (kernel_src is dev and dev_attached) else "cpu")

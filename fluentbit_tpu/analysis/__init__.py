@@ -76,6 +76,19 @@ Six rule families (see ANALYSIS.md for the full contract):
   byte budgets, plus escape rules for mutable staging-arena views and
   views that outlive their mmap (analysis.memscope; census gated by
   analysis/copy_budget.json).
+- **fusion pack** (`fusable-unfused-boundary`,
+  `fusion-blocked-by-host-compact`, `cross-launch-restage`,
+  `fused-effect-violation`, `fusion-plan-regression`): the
+  fbtpu-fuseplan planner classifies every boundary between consecutive
+  device launches of a chain as FUSABLE or BLOCKED (host compact,
+  intervening host effect, speccheck aval incompatibility, donation
+  break), prices the planned fused program, and gates it against
+  analysis/fusion_plan.json (analysis.fuseplan; rendered by
+  ``--graph fusion|fusion-dot``).
+- **stale suppressions** (`stale-suppression`): an
+  ``allow(<rule>)`` comment whose named rules no longer match any
+  finding on the covered line — fixed code, stale waiver
+  (analysis.suppress).
 
 The native C/C++ data plane has its own gate (analysis.native_gate):
 clang-tidy with the repo profile (.clang-tidy), the gcc ``-fanalyzer``
@@ -184,6 +197,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .decline import DeclineSwallowRule
     from .devlane import UnguardedDispatchRule
     from .dtype import DtypeNarrowingRule
+    from .fuseplan import FuseplanRules
     from .launchgraph import LaunchGraphRules
     from .locks import AwaitUnderLockRule, GuardedByRule
     from .locksmith import LocksmithRules
@@ -193,6 +207,7 @@ def _build_rules(guards=None) -> List[Rule]:
     from .shrink import UnminimizedDfaRule
     from .silent import SwallowedErrorRule
     from .speccheck import SpecCheckRules
+    from .suppress import StaleSuppressionRule
 
     return [
         GuardedByRule(guards),
@@ -210,6 +225,11 @@ def _build_rules(guards=None) -> List[Rule]:
         SpecCheckRules(),
         LocksmithRules(guards),
         MemscopeRules(),
+        FuseplanRules(),
+        # last: the stale-suppression audit re-runs the packs above on
+        # a suppression-disabled clone to prove a comment still earns
+        # its keep
+        StaleSuppressionRule(),
     ]
 
 

@@ -29,10 +29,15 @@ Modes:
                       (the fbtpu-memscope findings baseline + the
                       host copy census + the eliminated-pass ledger)
                       and exit 0
+- ``--write-fusion-plan``  regenerate ``analysis/fusion_plan.json``
+                      (the fbtpu-fuseplan findings baseline + the
+                      gated boundary-verdict / planned-program
+                      snapshot) and exit 0
 - ``--write-baselines``  refresh ALL committed baselines (launch
-                      budget, lock baseline, copy budget) in one
-                      atomic pass and exit 0 — the one command to run
-                      after deliberately changing any gated plane
+                      budget, lock baseline, copy budget, fusion
+                      plan) in one atomic pass and exit 0 — the one
+                      command to run after deliberately changing any
+                      gated plane
 
 Baseline entries match on (path, rule, message) — line-insensitive, so
 reformatting never churns the file. Every suppression in code uses
@@ -45,9 +50,9 @@ suppression for reviewed exceptions.
 multi-launch reality — ROADMAP item 1's debt) are subtracted
 automatically, so the default invocation stays a zero-findings gate
 while the debt remains visible, diffable, and gated (see ANALYSIS.md
-"fbtpu-xray"). ``analysis/lock_baseline.json`` and
-``analysis/copy_budget.json`` play the same role for the locksmith
-and memscope packs.
+"fbtpu-xray"). ``analysis/lock_baseline.json``,
+``analysis/copy_budget.json`` and ``analysis/fusion_plan.json`` play
+the same role for the locksmith, memscope and fuseplan packs.
 """
 
 from __future__ import annotations
@@ -295,6 +300,73 @@ def _write_copy_budget() -> str:
     return path
 
 
+def _fusion_findings(current_findings):
+    """The fbtpu-fuseplan ``--all`` leg: compare the live fusion plan
+    against the committed ``analysis/fusion_plan.json`` — boundary
+    growth, planned-launch/byte growth, an unplanned chain, or a
+    FUSABLE verdict turning BLOCKED is an error finding; shrinkage
+    comes back as a note. A missing plan file and stale baseline
+    entries surface too (same contract as the other three gates)."""
+    from .fuseplan import (FuseplanRules, build_fusion_plan,
+                           compare_fusion_plan, plan_snapshot)
+    from .registry import fusion_plan_path
+
+    fpath = fusion_plan_path()
+    rel = _canon(fpath)
+    if not os.path.isfile(fpath):
+        return [Finding(rel, 1, 0, "fusion-plan-regression",
+                        "analysis/fusion_plan.json is missing: the "
+                        "fusion-plan gate has no baseline — "
+                        "regenerate it with --write-fusion-plan")], []
+    with open(fpath, "r", encoding="utf-8") as fh:
+        baseline = json.load(fh)
+    current = plan_snapshot(build_fusion_plan())
+    regressions, notes = compare_fusion_plan(current,
+                                             baseline.get("plan", {}))
+    findings = [Finding(rel, 1, 0, "fusion-plan-regression", msg)
+                for msg in regressions]
+    keys = _load_baseline(fpath)
+    names = set(FuseplanRules.RULE_NAMES)
+    live = {(_canon(f.path), f.rule, f.message)
+            for f in current_findings if f.rule in names}
+    for key in sorted(keys - live):
+        findings.append(Finding(
+            rel, 1, 0, "fusion-plan-regression",
+            f"baseline entry no longer matches any finding (fixed "
+            f"debt? remove it): {key[1]} @ {key[0]}: {key[2]}",
+            "warning"))
+    return findings, notes
+
+
+def _write_fusion_plan() -> str:
+    """Regenerate analysis/fusion_plan.json: the fuseplan rule
+    findings on the shipped tree (open boundaries are planned debt)
+    plus the regression-gated boundary-verdict / planned-program
+    snapshot. stale-suppression findings are deliberately NOT
+    baselined — a stale waiver must fail the gate until removed."""
+    from .fuseplan import (FuseplanRules, build_fusion_plan,
+                           plan_snapshot)
+    from .registry import fusion_plan_path
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    names = set(FuseplanRules.RULE_NAMES)
+    findings = [f for f in lint_paths([pkg]) if f.rule in names]
+    payload = {
+        "version": 1,
+        "findings": [
+            {"path": _canon(f.path), "rule": f.rule,
+             "message": f.message, "severity": f.severity}
+            for f in findings
+        ],
+        "plan": plan_snapshot(build_fusion_plan()),
+    }
+    path = fusion_plan_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
 def _write_baseline(path: str, findings) -> None:
     payload = {
         "version": 1,
@@ -330,12 +402,15 @@ def main(argv=None) -> int:
                     help="lint only the .py files changed vs HEAD "
                          "(fast pre-commit; Python rules only)")
     ap.add_argument("--graph", metavar="MODE",
-                    choices=("json", "dot", "lock", "lock-dot"),
+                    choices=("json", "dot", "lock", "lock-dot",
+                             "fusion", "fusion-dot"),
                     help="emit the fbtpu-xray device launch graph "
                          "(json: graph + budget snapshot + regression "
-                         "diff; dot: graphviz) or the fbtpu-locksmith "
+                         "diff; dot: graphviz), the fbtpu-locksmith "
                          "lock acquisition-order graph (lock: json; "
-                         "lock-dot: graphviz) and exit")
+                         "lock-dot: graphviz), or the fbtpu-fuseplan "
+                         "boundary plan (fusion: json + regression "
+                         "diff; fusion-dot: graphviz) and exit")
     ap.add_argument("--baseline", metavar="FILE",
                     help="subtract findings recorded in FILE; exit 0 "
                          "when nothing new")
@@ -350,15 +425,20 @@ def main(argv=None) -> int:
     ap.add_argument("--write-copy-budget", action="store_true",
                     help="regenerate analysis/copy_budget.json and "
                          "exit")
+    ap.add_argument("--write-fusion-plan", action="store_true",
+                    help="regenerate analysis/fusion_plan.json and "
+                         "exit")
     ap.add_argument("--write-baselines", action="store_true",
-                    help="refresh launch budget, lock baseline AND "
-                         "copy budget in one pass, then exit")
+                    help="refresh launch budget, lock baseline, copy "
+                         "budget AND fusion plan in one pass, then "
+                         "exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule set and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         from .batch import BatchExactnessRules
+        from .fuseplan import FuseplanRules
         from .launchgraph import LaunchGraphRules
         from .locksmith import LocksmithRules
         from .memscope import MemscopeRules
@@ -366,7 +446,10 @@ def main(argv=None) -> int:
         from .speccheck import SpecCheckRules
 
         for r in RULES:
-            if isinstance(r, LocksmithRules):
+            if isinstance(r, FuseplanRules):
+                for n in r.RULE_NAMES:
+                    print(f"{n}: (fuseplan pack) {r.description}")
+            elif isinstance(r, LocksmithRules):
                 for n in r.RULE_NAMES:
                     print(f"{n}: (locksmith pack) {r.description}")
             elif isinstance(r, MemscopeRules):
@@ -400,6 +483,28 @@ def main(argv=None) -> int:
             print(lock_graph_to_dot(lgraph))
         else:
             print(json.dumps(lgraph, indent=2, sort_keys=True))
+        return 0
+
+    if args.graph in ("fusion", "fusion-dot"):
+        from .fuseplan import (build_fusion_plan, compare_fusion_plan,
+                               fusion_plan_to_dot, plan_snapshot)
+        from .registry import fusion_plan_path
+
+        fplan = build_fusion_plan()
+        if args.graph == "fusion-dot":
+            print(fusion_plan_to_dot(fplan))
+            return 0
+        snapshot = plan_snapshot(fplan)
+        regressions, fnotes = [], []
+        if os.path.isfile(fusion_plan_path()):
+            with open(fusion_plan_path(), "r", encoding="utf-8") as fh:
+                baseline = json.load(fh)
+            regressions, fnotes = compare_fusion_plan(
+                snapshot, baseline.get("plan", {}))
+        fplan["plan"] = snapshot
+        fplan["plan_regressions"] = regressions
+        fplan["plan_notes"] = fnotes
+        print(json.dumps(fplan, indent=2, sort_keys=True))
         return 0
 
     if args.graph:
@@ -439,10 +544,16 @@ def main(argv=None) -> int:
         print(f"fbtpu-lint: copy budget written to {path}")
         return 0
 
+    if args.write_fusion_plan:
+        path = _write_fusion_plan()
+        print(f"fbtpu-lint: fusion plan written to {path}")
+        return 0
+
     if args.write_baselines:
         for writer, label in ((_write_budget, "launch/transfer budget"),
                               (_write_lock_baseline, "lock baseline"),
-                              (_write_copy_budget, "copy budget")):
+                              (_write_copy_budget, "copy budget"),
+                              (_write_fusion_plan, "fusion plan")):
             path = writer()
             print(f"fbtpu-lint: {label} written to {path}")
         return 0
@@ -486,6 +597,9 @@ def main(argv=None) -> int:
         cf, cnotes = _copy_findings(findings)
         findings.extend(cf)
         notes = list(notes) + list(cnotes)
+        ff, fnotes = _fusion_findings(findings)
+        findings.extend(ff)
+        notes = list(notes) + list(fnotes)
 
     if args.write_baseline:
         _write_baseline(args.write_baseline, findings)
@@ -509,10 +623,10 @@ def main(argv=None) -> int:
         # (the lock baseline plays the same role for the locksmith
         # pack — stale entries surface as lock-baseline-stale in --all)
         from .registry import budget_path, copy_budget_path, \
-            lock_baseline_path
+            fusion_plan_path, lock_baseline_path
 
         for bpath in (budget_path(), lock_baseline_path(),
-                      copy_budget_path()):
+                      copy_budget_path(), fusion_plan_path()):
             if os.path.isfile(bpath):
                 keys = _load_baseline(bpath)
                 findings, hit = _subtract(findings, keys)

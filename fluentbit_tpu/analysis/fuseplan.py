@@ -1,0 +1,553 @@
+"""fbtpu-fuseplan: the device-chain fusion planner and cross-launch
+effect analyzer.
+
+fbtpu-xray (analysis/launchgraph.py) made launches-per-segment visible
+and gated; this module makes the *next move* reviewable: for every
+device chain it reconstructs the launch sequence with the same
+per-chain walker and classifies each **boundary between consecutive
+launches** as FUSABLE or BLOCKED, with the pinpointed reason a fusion
+PR must clear first:
+
+- a host ``compact`` scatter between the launches (the verdict came
+  home just to re-index bytes the next launch re-uploads) — BLOCKED,
+  ``fusion-blocked-by-host-compact``;
+- an intervening host mutation or effect — a metrics ``.inc()``/
+  ``.observe()``, a qos ``admit``/``shed`` call, a lock acquisition
+  (``.acquire()`` / ``with <lock>``) — a merged program would reorder
+  it across the launch it used to follow, so the region is proposed
+  but unsound: ``fused-effect-violation`` (error). The failpoint
+  plane's ``fire`` is whitelisted: disarmed sites are inert by the
+  tier-1 ``test_disabled_plane_adds_no_work`` contract;
+- dtype/shape/PartitionSpec incompatibility of the two programs'
+  shared input avals at the canonical ``BUDGET_PARAMS`` point
+  (fbtpu-speccheck's lattice — a fused program stages each shared
+  buffer once, so the two sides must agree on its aval exactly);
+- re-staging of bytes already resident on device (an ``asarray``/
+  ``stage_field`` between the launches over a buffer the producer
+  already uploaded): not blocking — it is the cost the merge deletes —
+  but reported as ``cross-launch-restage``;
+- donation aliasing a merged program would preserve or break: a
+  producer-donated input the consumer still re-reads with a different
+  aval cannot alias in the merged program — BLOCKED,
+  ``donation-break``.
+
+A boundary with no blocking reason is FUSABLE and reports
+``fusable-unfused-boundary`` — the planner then prices the *planned*
+fused program (FUSABLE runs merged into one launch; shared h2d
+buffers staged once) and the committed ``analysis/fusion_plan.json``
+gates it the same way ``launch_budget.json`` gates the measured
+graph: boundaries may only disappear, planned launches and planned
+un-donated bytes may only shrink, a FUSABLE verdict may not silently
+turn BLOCKED (``fusion-plan-regression``).
+
+The first finding this planner produced is cashed in the same PR: the
+flux 3-launch sketch/window chain (counts, per-field HLL, count-min)
+is now ONE ``shard_map`` program (``flux/kernels.build_fused_absorb``)
+— the shipped tree's plan therefore holds zero open boundaries, and
+the file's job is to keep it that way.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import Finding, Module, Rule
+from .launchgraph import (SCATTER_NAMES, SCOPES, TRANSFER_SHAPES,
+                          _chain_names, _eval_bytes, _ModuleScan,
+                          _terminal, canonical_env)
+
+__all__ = [
+    "FuseplanRules", "build_fusion_plan", "plan_snapshot",
+    "compare_fusion_plan", "fusion_plan_to_dot", "classify_boundaries",
+]
+
+#: launch-site kind → shipped-program name in the fbtpu-speccheck
+#: registry (the aval lattice the boundary compatibility check reads).
+KIND_TO_PROGRAM = {
+    "flux-segment-counts": "flux.counts",
+    "flux-hll": "flux.hll",
+    "flux-cms": "flux.cms",
+    "flux-fused": "flux.fused",
+    "grep-mesh": "grep.mesh[batch]",
+    "grep-jit": "grep.jit",
+}
+
+#: Host-effect terminals a merged program would reorder: counter
+#: bumps, qos admission verdicts, lock acquisitions.
+_METRIC_EFFECTS = frozenset({"inc", "observe"})
+_QOS_EFFECTS = frozenset({"admit", "shed"})
+#: Inert-when-disarmed planes (failpoints) — never an effect hazard.
+_EFFECT_WHITELIST = frozenset({"fire"})
+
+#: Between-launch staging terminals (the restage detector).
+_RESTAGE_NAMES = frozenset({"asarray", "ascontiguousarray",
+                            "stage_field", "stage_field_into"})
+
+_SEVERITY = {
+    "fusable-unfused-boundary": "warning",
+    "fusion-blocked-by-host-compact": "warning",
+    "cross-launch-restage": "warning",
+    "fused-effect-violation": "error",
+    "fusion-plan-regression": "error",
+}
+
+
+# ----------------------------------------------------------------------
+# boundary classification
+# ----------------------------------------------------------------------
+
+def _call_at(module: Module, line: int, what: str) -> Optional[ast.Call]:
+    """The launch call a site row points at: same line, terminal name
+    matching the site's ``what`` tail (``lane.run`` → ``run``,
+    dispatch names verbatim); falls back to the first call on the
+    line (sites serialize without their column)."""
+    tail = what.split(".")[-1].lstrip("<")
+    fallback = None
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and node.lineno == line:
+            if fallback is None:
+                fallback = node
+            if _terminal(node.func) == tail:
+                return node
+    return fallback
+
+
+def _arg_names(call: Optional[ast.Call]) -> Set[str]:
+    """Name ids staged through a launch call (args + keywords,
+    closures included — the lane idiom hands buffer-capturing defs)."""
+    if call is None:
+        return set()
+    out: Set[str] = set()
+    for arg in list(call.args) + [k.value for k in call.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+    return out
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    chain = " ".join(_chain_names(expr)).lower()
+    return "lock" in chain or "mutex" in chain
+
+
+def _scan_between(module: Module, lo: int, hi: int
+                  ) -> Dict[str, List[Tuple[int, Any]]]:
+    """Host activity on lines strictly between two launch sites:
+    compacts, effects (metric/qos/lock), restage calls with the names
+    they touch. Line-windowed rather than path-sensitive — the same
+    approximation the launch walker itself makes for site ordering."""
+    compacts: List[Tuple[int, Any]] = []
+    effects: List[Tuple[int, Any]] = []
+    restages: List[Tuple[int, Any]] = []
+    for node in ast.walk(module.tree):
+        ln = getattr(node, "lineno", None)
+        if ln is None or not (lo < ln < hi):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            if any(_is_lockish(i.context_expr) for i in node.items):
+                effects.append((ln, "lock held (`with`)"))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        t = _terminal(node.func)
+        if t in _EFFECT_WHITELIST:
+            continue
+        if t in SCATTER_NAMES:
+            compacts.append((ln, t))
+        elif t in _METRIC_EFFECTS:
+            effects.append((ln, f"metric `.{t}()`"))
+        elif t in _QOS_EFFECTS \
+                and "qos" in " ".join(_chain_names(node.func)).lower():
+            effects.append((ln, f"qos `.{t}()`"))
+        elif t == "acquire":
+            effects.append((ln, "lock `.acquire()`"))
+        elif t in _RESTAGE_NAMES:
+            names = {s.id for a in node.args for s in ast.walk(a)
+                     if isinstance(s, ast.Name)}
+            restages.append((ln, names))
+    return {"compacts": compacts, "effects": effects,
+            "restages": restages}
+
+
+def _program_avals(kind: str) -> Optional[Dict[str, Any]]:
+    """The speccheck-lattice view of a launch kind: per-leaf
+    (sharded shape, dtype, resolved spec) for inputs/outputs plus the
+    declared donation set, at the program's canonical env. None when
+    the kind has no shipped program or the registry cannot build
+    (kernel-less host) — compatibility is then unknown, never a
+    blocker."""
+    name = KIND_TO_PROGRAM.get(kind)
+    if name is None:
+        return None
+    try:
+        from .speccheck import (_bound_rules, _resolved_spec,
+                                program_env, sharded_shape,
+                                shipped_programs)
+
+        progs = {p.name: p for p in shipped_programs()}
+        prog = progs.get(name)
+        if prog is None:
+            return None
+        env = program_env(prog)
+        rules = _bound_rules(prog)
+
+        def leaf(a):
+            spec = _resolved_spec(prog, a, rules)
+            return (sharded_shape(a.shape, spec, prog.axes, env),
+                    str(a.dtype), tuple(spec or ()))
+
+        return {
+            "inputs": {a.name: leaf(a) for a in prog.inputs},
+            "outputs": {a.name: leaf(a) for a in prog.outputs},
+            "donate": tuple(prog.donate),
+        }
+    except Exception:  # pragma: no cover - jax-less host
+        return None
+
+
+def classify_boundaries(module: Module, chain: Dict[str, Any]
+                        ) -> List[Dict[str, Any]]:
+    """Every boundary between consecutive launch sites of one chain →
+    verdict + reasons + the host activity evidence."""
+    sites = sorted(chain["sites"], key=lambda s: (s["line"],))
+    out: List[Dict[str, Any]] = []
+    for prod, cons in zip(sites, sites[1:]):
+        lo, hi = prod["line"], cons["line"]
+        seen = _scan_between(module, min(lo, hi), max(lo, hi))
+        staged = _arg_names(_call_at(module, prod["line"],
+                                     prod["what"]))
+        reasons: List[Dict[str, Any]] = []
+        for ln, what in seen["compacts"]:
+            reasons.append({"kind": "host-compact", "line": ln,
+                            "detail": f"host `{what}(...)` scatter "
+                                      f"between the launches"})
+        for ln, what in seen["effects"]:
+            reasons.append({"kind": "host-effect", "line": ln,
+                            "detail": what})
+        restage_hits = []
+        for ln, names in seen["restages"]:
+            shared = sorted(names & staged)
+            if shared:
+                restage_hits.append({"line": ln, "buffers": shared})
+        pa = _program_avals(prod["kind"])
+        ca = _program_avals(cons["kind"])
+        aval_compat: Optional[bool] = None
+        donation: Dict[str, Any] = {"preserved": [], "broken": []}
+        if pa is not None and ca is not None:
+            aval_compat = True
+            for nm in sorted(set(pa["inputs"]) & set(ca["inputs"])):
+                if pa["inputs"][nm] != ca["inputs"][nm]:
+                    aval_compat = False
+                    reasons.append({
+                        "kind": "aval-incompatible", "line": hi,
+                        "detail": f"shared input `{nm}` differs at the "
+                                  f"canonical point: "
+                                  f"{pa['inputs'][nm]!r} vs "
+                                  f"{ca['inputs'][nm]!r}"})
+            for nm in pa["donate"]:
+                if nm in ca["inputs"] and nm in pa["inputs"]:
+                    if pa["inputs"][nm] == ca["inputs"][nm]:
+                        donation["preserved"].append(nm)
+                    else:
+                        donation["broken"].append(nm)
+                        reasons.append({
+                            "kind": "donation-break", "line": hi,
+                            "detail": f"producer donates `{nm}` but "
+                                      f"the consumer re-reads it with "
+                                      f"a different aval — the merged "
+                                      f"program cannot alias it"})
+        blocking = [r for r in reasons
+                    if r["kind"] in ("host-compact", "host-effect",
+                                     "aval-incompatible",
+                                     "donation-break")]
+        out.append({
+            "producer": {"line": prod["line"], "kind": prod["kind"],
+                         "what": prod["what"]},
+            "consumer": {"line": cons["line"], "kind": cons["kind"],
+                         "what": cons["what"]},
+            "verdict": "BLOCKED" if blocking else "FUSABLE",
+            "reasons": reasons,
+            "restages": restage_hits,
+            "aval_compat": aval_compat,
+            "donation": donation,
+        })
+    return out
+
+
+# ----------------------------------------------------------------------
+# the planned fused program (symbolic pricing)
+# ----------------------------------------------------------------------
+
+def _planned_program(sites: List[Dict[str, Any]],
+                     boundaries: List[Dict[str, Any]],
+                     env: Dict[str, int]) -> Dict[str, Any]:
+    """Merge FUSABLE runs into planned launches and price each: shared
+    h2d buffers (same name + symbolic bytes) stage ONCE in the merged
+    program; a buffer donated by any member stays donated."""
+    groups: List[List[Dict[str, Any]]] = []
+    if sites:
+        cur = [sites[0]]
+        for b, site in zip(boundaries, sites[1:]):
+            if b["verdict"] == "FUSABLE":
+                cur.append(site)
+            else:
+                groups.append(cur)
+                cur = [site]
+        groups.append(cur)
+    h2d: List[Dict[str, Any]] = []
+    seen: Set[Tuple[str, str]] = set()
+    for grp in groups:
+        for site in grp:
+            shapes = TRANSFER_SHAPES.get(site["kind"])
+            if shapes is None:
+                continue
+            for name, expr, dtype, donated in shapes["h2d"]:
+                key = (name, expr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                h2d.append({"buffer": name, "bytes": expr,
+                            "dtype": dtype, "donated": donated})
+    undonated = sum(_eval_bytes(r["bytes"], env) for r in h2d
+                    if not r["donated"])
+    return {
+        "launches_per_segment": len(groups),
+        "h2d": h2d,
+        "h2d_bytes_canonical": sum(_eval_bytes(r["bytes"], env)
+                                   for r in h2d),
+        "undonated_h2d_bytes_canonical": undonated,
+    }
+
+
+# ----------------------------------------------------------------------
+# the plan, its committed snapshot, and the regression gate
+# ----------------------------------------------------------------------
+
+def build_fusion_plan(root: Optional[str] = None,
+                      params: Optional[Dict[str, int]] = None
+                      ) -> Dict[str, Any]:
+    """Scan the shipped device planes (the launch walker's scopes) and
+    emit the per-chain fusion plan: boundary verdicts + the priced
+    planned fused program."""
+    import os
+
+    from . import iter_py_files
+    from .launchgraph import _package_root
+
+    pkg = root or _package_root()
+    env = canonical_env(params)
+    chains: Dict[str, Any] = {}
+    scopes = [os.path.join(pkg, "plugins"), os.path.join(pkg, "flux")]
+    for scope in scopes:
+        if not os.path.isdir(scope):
+            continue
+        for path in iter_py_files([scope]):
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            rel = os.path.relpath(path, os.path.dirname(pkg))
+            module = Module(rel, source)
+            if module.tree is None:
+                continue
+            for chain in _ModuleScan(module).chains():
+                if chain["launches_per_segment"] == 0:
+                    continue
+                cid = f"{chain['module']}::{chain['cls']}." \
+                      f"{chain['entry']}"
+                sites = sorted(chain["sites"],
+                               key=lambda s: (s["line"],))
+                bounds = classify_boundaries(module, chain)
+                chains[cid] = {
+                    "launches_per_segment":
+                        chain["launches_per_segment"],
+                    "sites": [{"line": s["line"], "kind": s["kind"],
+                               "what": s["what"]} for s in sites],
+                    "boundaries": bounds,
+                    "planned": _planned_program(sites, bounds, env),
+                }
+    return {"version": 1, "params": env,
+            "chains": dict(sorted(chains.items()))}
+
+
+def plan_snapshot(plan: Dict[str, Any]) -> Dict[str, Any]:
+    """The regression-gated subset: per chain the boundary verdict
+    vector and the planned fused program's launch count + un-donated
+    h2d bytes. ``analysis/fusion_plan.json`` commits this — the fourth
+    implicit baseline next to the launch, lock, and copy files."""
+    chains = {}
+    for cid, chain in plan["chains"].items():
+        chains[cid] = {
+            "boundaries": len(chain["boundaries"]),
+            "blocked": sum(1 for b in chain["boundaries"]
+                           if b["verdict"] == "BLOCKED"),
+            "verdicts": [b["verdict"] for b in chain["boundaries"]],
+            "planned_launches_per_segment":
+                chain["planned"]["launches_per_segment"],
+            "planned_undonated_h2d_bytes":
+                chain["planned"]["undonated_h2d_bytes_canonical"],
+        }
+    return {"params": {k: int(v) for k, v in plan["params"].items()},
+            "chains": chains}
+
+
+def compare_fusion_plan(current: Dict[str, Any],
+                        baseline: Dict[str, Any]
+                        ) -> Tuple[List[str], List[str]]:
+    """Current plan snapshot vs the committed one → (regressions,
+    notes). Boundary growth, planned-launch growth, planned-byte
+    growth, a chain the plan has never seen, or a FUSABLE verdict
+    turning BLOCKED is a regression; shrinkage is a note (regenerate
+    the plan file to claim it)."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    base_chains = baseline.get("chains", {})
+    gate_keys = ("boundaries", "blocked", "planned_launches_per_segment",
+                 "planned_undonated_h2d_bytes")
+    for cid, cur in current.get("chains", {}).items():
+        base = base_chains.get(cid)
+        if base is None:
+            regressions.append(
+                f"{cid}: new device chain not in fusion_plan.json "
+                f"({cur['boundaries']} boundary(ies)) — plan it "
+                f"deliberately (--write-fusion-plan)")
+            continue
+        for key in gate_keys:
+            b, c = int(base.get(key, 0)), int(cur.get(key, 0))
+            if c > b:
+                regressions.append(
+                    f"{cid}: {key} grew {b} → {c} — a fusion plan "
+                    f"only shrinks; re-plan deliberately "
+                    f"(--write-fusion-plan)")
+            elif c < b:
+                notes.append(
+                    f"{cid}: {key} improved {b} → {c}; regenerate "
+                    f"fusion_plan.json (--write-fusion-plan) to "
+                    f"claim it")
+        bv = base.get("verdicts", [])
+        cv = cur.get("verdicts", [])
+        for i, (old, new) in enumerate(zip(bv, cv)):
+            if old == "FUSABLE" and new == "BLOCKED":
+                regressions.append(
+                    f"{cid}: boundary {i} verdict regressed FUSABLE → "
+                    f"BLOCKED — new host work landed between launches "
+                    f"the plan had cleared for merging")
+    for cid in base_chains:
+        if cid not in current.get("chains", {}):
+            notes.append(f"{cid}: chain left the device plane (fused "
+                         f"or removed); regenerate fusion_plan.json")
+    return regressions, notes
+
+
+def fusion_plan_to_dot(plan: Dict[str, Any]) -> str:
+    """Graphviz rendering: launch sites chained by boundary edges,
+    green = FUSABLE (merge them), red = BLOCKED (labelled with the
+    first reason)."""
+    lines = ["digraph fuseplan {", "  rankdir=LR;",
+             '  node [shape=box, fontname="monospace"];']
+    for cid, chain in plan["chains"].items():
+        prev = None
+        for site in chain["sites"]:
+            sid = f'"{cid}#L{site["line"]}"'
+            lines.append(
+                f'  {sid} [label="{site["what"]}\\n{site["kind"]}"];')
+            prev = prev  # keep flake quiet; edges below
+        for b in chain["boundaries"]:
+            src = f'"{cid}#L{b["producer"]["line"]}"'
+            dst = f'"{cid}#L{b["consumer"]["line"]}"'
+            if b["verdict"] == "FUSABLE":
+                lines.append(f'  {src} -> {dst} [color=green, '
+                             f'label="FUSABLE"];')
+            else:
+                why = b["reasons"][0]["kind"] if b["reasons"] else "?"
+                lines.append(f'  {src} -> {dst} [color=red, '
+                             f'label="BLOCKED\\n{why}"];')
+        planned = chain["planned"]["launches_per_segment"]
+        lines.append(
+            f'  "{cid}" [label="{cid}\\nplanned: {planned} '
+            f'launch(es)/segment", style=bold];')
+        if chain["sites"]:
+            first = f'"{cid}#L{chain["sites"][0]["line"]}"'
+            lines.append(f'  "{cid}" -> {first} [style=dotted];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+# the rule pack
+# ----------------------------------------------------------------------
+
+class FuseplanRules(Rule):
+    name = "fuseplan"  # umbrella; findings carry precise rules
+    description = ("fbtpu-fuseplan rules: boundary-level fusion "
+                   "verdicts between consecutive device launches — "
+                   "fusable-but-unfused boundaries, host-compact "
+                   "blockers, cross-launch restages, host effects "
+                   "inside proposed fused regions, and fusion-plan "
+                   "regressions against analysis/fusion_plan.json")
+
+    RULE_NAMES = ("fusable-unfused-boundary",
+                  "fusion-blocked-by-host-compact",
+                  "cross-launch-restage", "fused-effect-violation",
+                  "fusion-plan-regression")
+
+    def check(self, module: Module) -> List[Finding]:
+        if not any(s in module.path for s in SCOPES):
+            return []
+        out: List[Finding] = []
+        scan = _ModuleScan(module)
+        flagged: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, rule: str, message: str) -> None:
+            if (line, rule) in flagged or module.allowed(rule, line):
+                return
+            flagged.add((line, rule))
+            out.append(Finding(module.path, line, 0, rule, message,
+                               _SEVERITY[rule]))
+
+        for chain in scan.chains():
+            if chain["launches_per_segment"] < 2:
+                continue
+            ent = f"{chain['cls']}.{chain['entry']}"
+            for b in classify_boundaries(module, chain):
+                pk, ck = b["producer"]["kind"], b["consumer"]["kind"]
+                if b["verdict"] == "FUSABLE":
+                    emit(b["consumer"]["line"],
+                         "fusable-unfused-boundary",
+                         f"`{ent}`: the {pk} launch at line "
+                         f"{b['producer']['line']} and this {ck} "
+                         f"launch have no blocking host work between "
+                         f"them — one merged program would stage the "
+                         f"shared buffers once and pay one dispatch "
+                         f"(see ANALYSIS.md \"Fusion pack\")")
+                compact_blocked = False
+                for r in b["reasons"]:
+                    if r["kind"] == "host-compact":
+                        compact_blocked = True
+                        emit(r["line"], "fusion-blocked-by-host-compact",
+                             f"`{ent}`: {r['detail']} — the "
+                             f"{pk}→{ck} boundary cannot fuse until "
+                             f"the scatter moves out (device-side "
+                             f"compaction or verdict-on-device)")
+                effect_reasons = [r for r in b["reasons"]
+                                  if r["kind"] == "host-effect"]
+                only_effects = effect_reasons and not compact_blocked \
+                    and not any(r["kind"] in ("aval-incompatible",
+                                              "donation-break")
+                                for r in b["reasons"])
+                if only_effects:
+                    for r in effect_reasons:
+                        emit(r["line"], "fused-effect-violation",
+                             f"`{ent}`: {r['detail']} sits inside the "
+                             f"proposed {pk}+{ck} fused region — a "
+                             f"merged program would reorder this "
+                             f"effect across the launch it follows; "
+                             f"hoist it before or after the region")
+                for hit in b["restages"]:
+                    bufs = ", ".join(f"`{n}`" for n in hit["buffers"])
+                    emit(hit["line"], "cross-launch-restage",
+                         f"`{ent}`: {bufs} re-staged between the "
+                         f"{pk} launch and the {ck} launch — those "
+                         f"bytes are already device-resident; the "
+                         f"fused program (or a device-side handle) "
+                         f"deletes this upload")
+        return out

@@ -100,6 +100,7 @@ KIND_BY_NAME = {
     "device_registers": "flux-hll",
     "sharded_cms_table": "flux-cms", "sharded_cms_update": "flux-cms",
     "device_table": "flux-cms",
+    "sharded_fused_absorb": "flux-fused", "fused_absorb": "flux-fused",
 }
 DISPATCH_NAMES = frozenset(KIND_BY_NAME) - GUARDED_LAUNCH_FNS
 
@@ -166,6 +167,24 @@ TRANSFER_SHAPES: Dict[str, Dict[str, List[Tuple[str, str, str, bool]]]] = {
                 ("lengths", "4*B", "int32", False),
                 ("table", "8*M_cms", "int64", False)],
         "d2h": [("table", "8*M_cms", "int64", False)],
+    },
+    # the ONE-launch fused flux absorb (counts + HLL stack + count-min
+    # — the cashed fbtpu-fuseplan merge): everything the three unfused
+    # programs staged, once, with the freshly-stacked [Gp, m] register
+    # snapshot the only donated input (it aliases its output exactly;
+    # the table snapshot must survive for the host-twin fallback)
+    "flux-fused": {
+        "h2d": [("seg", "4*Bp", "int32", False),
+                ("valid", "4*Bp", "int32", False),
+                ("batch", "Bp*L", "uint8", False),
+                ("lengths", "4*Bp", "int32", False),
+                ("registers", "Gp*M_hll", "uint8", True),
+                ("comp", "Bp*L", "uint8", False),
+                ("comp_len", "4*Bp", "int32", False),
+                ("table", "8*M_cms", "int64", False)],
+        "d2h": [("counts", "4*Gp", "int32", False),
+                ("registers", "Gp*M_hll", "uint8", False),
+                ("table", "8*M_cms", "int64", False)],
     },
 }
 
@@ -282,9 +301,11 @@ def _closure_kind(defs: List[ast.AST]) -> Tuple[str, bool]:
                     kinds.append(KIND_BY_NAME[t])
                 elif _is_program_call(sub):
                     kinds.append("grep-jit")
-    # mesh beats the unsharded fallback branch inside the same closure
-    for pref in ("grep-mesh", "flux-segment-counts", "flux-hll",
-                 "flux-cms", "grep-jit"):
+    # mesh beats the unsharded fallback branch inside the same closure;
+    # the fused absorb beats its constituent kinds (a closure that
+    # dispatches the fused program IS one fused launch)
+    for pref in ("flux-fused", "grep-mesh", "flux-segment-counts",
+                 "flux-hll", "flux-cms", "grep-jit"):
         if pref in kinds:
             return pref, True
     return "device", True
@@ -810,6 +831,13 @@ def canonical_env(params: Optional[Dict[str, int]] = None
     env.setdefault("B", env["seg"])
     env.setdefault("Bp", bucket_size(env["seg"], max_len=env["L"],
                                      multiple_of=env["n_dev"]))
+    # the fused absorb's padded segment table (flux/kernels:
+    # _pad_segments(G) — power of two, floor 8): the [Gp, m] register
+    # stack and counts table ride the fused launch at this size
+    gp = 8
+    while gp < env["G"]:
+        gp *= 2
+    env.setdefault("Gp", gp)
     return env
 
 

@@ -31,7 +31,8 @@ from dataclasses import dataclass
 from typing import Dict, Tuple
 
 __all__ = ["GuardEntry", "GUARDS", "LAUNCH_ENTRIES", "BUDGET_PARAMS",
-           "budget_path", "lock_baseline_path", "copy_budget_path"]
+           "budget_path", "lock_baseline_path", "copy_budget_path",
+           "fusion_plan_path"]
 
 # -- fbtpu-xray (analysis/launchgraph.py) declarative plumbing ---------
 
@@ -68,6 +69,12 @@ def copy_budget_path() -> str:
     """Path of the committed fbtpu-memscope copy budget baseline."""
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "copy_budget.json")
+
+
+def fusion_plan_path() -> str:
+    """Path of the committed fbtpu-fuseplan fusion plan baseline."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fusion_plan.json")
 
 
 @dataclass(frozen=True)

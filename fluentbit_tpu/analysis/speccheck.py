@@ -449,8 +449,35 @@ def _build_shipped() -> Tuple[ProgramSpec, ...]:
         outputs=(Aval("counts", (n_pad,), "int32", ()),),
         discharge={"Bp": ("pad", "sharded_segment_counts")},
     )
+    flux_fused = ProgramSpec(
+        # the 3-launch sketch/window chain merged into one program —
+        # the first fusion the fuseplan analyzer cashed. Modeled at
+        # F=1 string fields (the canonical single-distinct config);
+        # registers is the [Gp, m] per-group stack, donated on
+        # accelerator platforms only (the CPU path keeps the snapshot
+        # for the lane fallback).
+        name="flux.fused", module=_KERNELS_MODULE,
+        entry="build_fused_absorb",
+        axes=(("flux", "n_dev"),), rules_key="flux-fused",
+        tables=(),
+        inputs=(Aval("seg", ("Bp",), "int32"),
+                Aval("valid", ("Bp",), "int32"),
+                Aval("batch", ("Bp", "L"), "uint8"),
+                Aval("lengths", ("Bp",), "int32"),
+                Aval("registers", ("Gp", hll_shape[0]), hll_dtype,
+                     donatable=True),
+                Aval("comp", ("Bp", "L"), "uint8"),
+                Aval("comp_len", ("Bp",), "int32"),
+                Aval("table", cms_shape, cms_dtype)),
+        outputs=(Aval("counts", ("Gp",), "int32", ()),
+                 Aval("registers_out", ("Gp", hll_shape[0]),
+                      hll_dtype, ()),
+                 Aval("table_out", cms_shape, cms_dtype, ())),
+        donate=("registers",),
+        discharge={"Bp": ("pad", "_fused_call")},
+    )
     return (grep_jit, grep_batch, grep_rules, flux_hll, flux_cms,
-            flux_counts)
+            flux_counts, flux_fused)
 
 
 def shipped_programs(refresh: bool = False) -> Tuple[ProgramSpec, ...]:

@@ -1,0 +1,121 @@
+"""Unused-suppression audit: ``# fbtpu-lint: allow(<rule>)`` comments
+that no longer suppress any live finding.
+
+Every suppression in the tree is a reviewed exception with an inline
+justification. When the flagged code is later fixed or deleted, the
+comment tends to stay — and a stale ``allow`` is a loaded gun: it
+pre-approves the *next* violation of that rule on that line. This rule
+re-runs the whole rule set over the module with suppressions disabled,
+diffs the result against the suppressed run, and flags any comment
+whose named rules stopped matching a finding on the line it covers
+(``stale-suppression``, warning).
+
+Attribution is conservative: a rule may accept its comment away from
+the flagged line (``extra_lines`` — except-handler bodies, multi-line
+constructs), so a suppressed finding that cannot be pinned to any
+specific comment keeps EVERY comment naming its rule alive rather
+than guessing. Wildcard ``allow(*)`` comments are exempt (they are
+deliberate blanket waivers, reviewed as such).
+"""
+
+from __future__ import annotations
+
+import io
+import tokenize
+from typing import Dict, List, Set, Tuple
+
+from . import _ALLOW_RE, Finding, Module, Rule
+
+__all__ = ["StaleSuppressionRule"]
+
+
+def _allow_comments(module: Module) -> List[Tuple[int, Set[str]]]:
+    """(line, rule names) of every real ``allow(...)`` COMMENT token —
+    tokenized, not regexed over raw lines, so the many docstrings that
+    *mention* the suppression syntax never look like waivers."""
+    out: List[Tuple[int, Set[str]]] = []
+    try:
+        toks = tokenize.generate_tokens(
+            io.StringIO(module.source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ALLOW_RE.search(tok.string)
+            if m:
+                names = {p.strip() for p in m.group(1).split(",")
+                         if p.strip()}
+                if names:
+                    out.append((tok.start[0], names))
+    except (tokenize.TokenError, IndentationError):
+        return []
+    return out
+
+
+class StaleSuppressionRule(Rule):
+    name = "stale-suppression"
+    description = ("an `# fbtpu-lint: allow(<rule>)` comment whose "
+                   "named rules no longer match any finding on the "
+                   "covered line — fixed code, stale waiver: remove "
+                   "the comment (it pre-approves the next violation)")
+    severity = "warning"
+
+    def check(self, module: Module) -> List[Finding]:
+        comments = [(ln, names) for ln, names in _allow_comments(module)
+                    if "*" not in names]
+        if not comments:
+            return []
+        suppressed = self._suppressed(module)
+        by_rule: Dict[str, List[Finding]] = {}
+        for f in suppressed:
+            by_rule.setdefault(f.rule, []).append(f)
+
+        def attributable(f: Finding) -> bool:
+            return any(f.rule in names and f.line in (cl, cl + 1)
+                       for cl, names in comments)
+
+        out: List[Finding] = []
+        for line, names in comments:
+            live = False
+            for rule_name in names:
+                hits = by_rule.get(rule_name, [])
+                if any(f.line in (line, line + 1) for f in hits):
+                    live = True
+                elif any(not attributable(f) for f in hits):
+                    # a suppressed finding of this rule floats free of
+                    # every comment (extra_lines acceptance) — keep
+                    # all its comments rather than flag a live one
+                    live = True
+            if not live:
+                listed = ", ".join(sorted(names))
+                out.append(Finding(
+                    module.path, line, 0, self.name,
+                    f"allow({listed}) suppresses nothing: no live "
+                    f"{listed} finding on line {line} or {line + 1} — "
+                    f"the code it waived is gone; remove the comment",
+                    self.severity))
+        return out
+
+    def _suppressed(self, module: Module) -> List[Finding]:
+        """Findings that exist only because a suppression hides them:
+        re-run every other rule on a clone whose ``allowed()`` always
+        says no, and subtract the suppressed run. A pack that cannot
+        run here (missing kernel deps) cannot prove staleness and is
+        skipped — never a false positive from a half-run."""
+        from . import RULES
+
+        clone = Module(module.path, module.source)
+        clone.allowed = (  # type: ignore[method-assign]
+            lambda rule, line, extra_lines=(): False)
+        out: List[Finding] = []
+        for rule in RULES:
+            if isinstance(rule, StaleSuppressionRule):
+                continue
+            try:
+                unsuppressed = rule.check(clone)
+                live = rule.check(module)
+            except Exception:  # pragma: no cover - degraded host
+                continue
+            live_keys = {(f.rule, f.line, f.message) for f in live}
+            out.extend(f for f in unsuppressed
+                       if (f.rule, f.line, f.message) not in live_keys)
+        return out

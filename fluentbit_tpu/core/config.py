@@ -206,6 +206,7 @@ CORE_INSTANCE_KEYS = {
     # fbtpu-qos tenant membership + contract (inputs; core/qos.py)
     "tenant", "tenant.weight", "tenant.priority", "tenant.rate",
     "tenant.burst", "tenant.overflow", "tenant.storage_limit",
+    "tenant.flush_concurrency",
     "net.keepalive", "net.keepalive_idle_timeout",
     "net.keepalive_max_recycle", "net.max_worker_connections",
 }

@@ -1980,6 +1980,14 @@ class Engine:
             sem = out.flush_semaphore
             if sem is not None:
                 await sem.acquire()
+            # fbtpu-qos tenant.flush_concurrency: cap the tenant's
+            # concurrent flushes ACROSS outputs, acquired after the
+            # output slot (uniform order, no cross-wait cycle). Held
+            # by reference: a reload that swaps the tenant's semaphore
+            # never strands this release.
+            tsem = self.qos.flush_slot(chunk)
+            if tsem is not None:
+                await tsem.acquire()
             # the deadline clock starts HERE, once the attempt actually
             # executes: time parked in the flush-semaphore queue behind
             # a saturated-but-healthy output must not count (the slot
@@ -2033,6 +2041,8 @@ class Engine:
                                       out.display_name)
                         result = FlushResult.ERROR
             finally:
+                if tsem is not None:
+                    tsem.release()
                 if sem is not None:
                     sem.release()
             return self._handle_flush_result(task, out, result)

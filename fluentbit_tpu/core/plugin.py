@@ -279,6 +279,16 @@ class InputInstance(Instance):
             # stream chunk files); over it, write-through is shed and
             # the chunk stays memory-only (Qos.admit_storage)
             params["storage_limit"] = int(parse_size(sl))
+        fc = self.properties.get("tenant.flush_concurrency")
+        if fc is not None:
+            # cap on the tenant's concurrent flush attempts across all
+            # outputs (QOS.md); enforced next to the per-output worker
+            # semaphore in engine._flush_body
+            fc = int(fc)
+            if fc < 1:
+                raise ValueError(
+                    f"tenant.flush_concurrency must be >= 1, got {fc}")
+            params["flush_concurrency"] = fc
         ovf = self.properties.get("tenant.overflow")
         if ovf is not None:
             ovf = str(ovf).lower()

@@ -76,13 +76,15 @@ class Tenant:
     optional ingest quota, overflow policy."""
 
     __slots__ = ("name", "weight", "priority", "bucket", "overflow",
-                 "rate", "burst", "storage_limit")
+                 "rate", "burst", "storage_limit", "flush_concurrency",
+                 "flush_semaphore")
 
     def __init__(self, name: str, weight: float, priority: int,
                  rate: Optional[float] = None,
                  burst: Optional[float] = None,
                  overflow: str = "defer", clock=time.monotonic,
-                 storage_limit: Optional[int] = None):
+                 storage_limit: Optional[int] = None,
+                 flush_concurrency: Optional[int] = None):
         self.name = name
         self.weight = float(weight)
         self.priority = min(max(int(priority), 0), QOS_CLASS_COUNT - 1)
@@ -94,6 +96,17 @@ class Tenant:
         self.storage_limit = storage_limit
         self.bucket = (TokenBucket(rate, burst, clock=clock)
                        if rate else None)
+        # cap on the tenant's CONCURRENT flush attempts across all
+        # outputs (None = uncapped): one noisy tenant cannot occupy
+        # every output worker slot while quieter tenants queue
+        self.flush_concurrency = flush_concurrency
+        self.flush_semaphore = self._make_flush_semaphore()
+
+    def _make_flush_semaphore(self):
+        import asyncio
+
+        return (asyncio.Semaphore(self.flush_concurrency)
+                if self.flush_concurrency else None)
 
 
 class Qos:
@@ -202,7 +215,8 @@ class Qos:
                     burst=params.get("burst"),
                     overflow=params.get("overflow", "defer"),
                     clock=self.clock,
-                    storage_limit=params.get("storage_limit"))
+                    storage_limit=params.get("storage_limit"),
+                    flush_concurrency=params.get("flush_concurrency"))
                 self._tenants[name] = t
                 self._graded = len({x.priority for x in
                                     self._tenants.values()}) > 1
@@ -220,6 +234,15 @@ class Qos:
         if "storage_limit" in params:
             t.storage_limit = (None if params["storage_limit"] is None
                                else int(params["storage_limit"]))
+        if "flush_concurrency" in params \
+                and params["flush_concurrency"] != t.flush_concurrency:
+            # rebuild like the bucket: in-flight flushes release the
+            # OLD semaphore they acquired (held by reference in the
+            # attempt's finally), new attempts queue on the new cap
+            t.flush_concurrency = (
+                None if params["flush_concurrency"] is None
+                else int(params["flush_concurrency"]))
+            t.flush_semaphore = t._make_flush_semaphore()
         if ("rate" in params or "burst" in params) and (
                 params.get("rate", t.rate) != t.rate
                 or params.get("burst", t.burst) != t.burst):
@@ -241,6 +264,17 @@ class Qos:
         """True when tenants span more than one priority class — the
         precondition for shed-by-priority (guard.maybe_shed)."""
         return self._graded
+
+    def flush_slot(self, chunk):
+        """The chunk's tenant flush-concurrency semaphore, or None
+        when the tenant is uncapped/undeclared. Read at every flush
+        attempt (engine._flush_body) so a reload that re-declares
+        ``tenant.flush_concurrency`` takes effect on the next
+        attempt, not the next restart."""
+        name = getattr(chunk, "qos_tenant", None) or DEFAULT_TENANT
+        with self._lock:
+            t = self._tenants.get(name)
+        return None if t is None else t.flush_semaphore
 
     def tenant_for_input(self, ins) -> Tenant:
         """Resolve (and cache on the instance) the input's tenant."""

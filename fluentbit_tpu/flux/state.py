@@ -52,12 +52,7 @@ import numpy as np
 
 from .. import failpoints as _fp
 from ..ops.batch import assemble, bucket_size
-from ..ops.sketch import (
-    CountMin,
-    HyperLogLog,
-    sharded_cms_table,
-    sharded_hll_registers,
-)
+from ..ops.sketch import CountMin, HyperLogLog
 from . import kernels
 
 __all__ = ["WindowSpec", "FluxSpec", "FluxState", "SNAPSHOT_VERSION"]
@@ -314,21 +309,6 @@ class FluxState:
         self.batches_total += 1
         if n <= 0:
             return 0
-        spec = self.spec
-        if not spec.group_by and not spec.numeric \
-                and not spec.topk_field and spec.distinct \
-                and self._mesh is None:
-            # single-sketch ingest-rate shape (the bench gate): one
-            # global group, straight into the register update
-            g = self._groups.get(())
-            if g is None:
-                g = self._groups[()] = _FluxGroup(spec)
-            g.count += n
-            for f in spec.distinct:
-                b, ln = strcols[f]
-                self._hll_absorb(g.hlls[f], b, ln)
-            self.records_total += n
-            return n
         self._absorb_rows(self._groups, n, strcols, numcols)
         self.records_total += n
         return n
@@ -430,20 +410,19 @@ class FluxState:
 
     # -- the shared core ----------------------------------------------
 
+    #: fused-absorb group ceiling: the [Gp, m] register stack scales
+    #: with the padded group count, so a pathological high-cardinality
+    #: GROUP BY batch (thousands of groups in ONE chunk) absorbs
+    #: through the bit-identical host twins instead of staging a
+    #: multi-hundred-MB snapshot stack
+    _FUSED_MAX_GROUPS = 512
+
     def _absorb_rows(self, pane: Dict[tuple, _FluxGroup], n_rows: int,
                      strcols, numcols) -> None:
         seg, keys = self._group_rows(n_rows, strcols)
         n_groups = len(keys)
-        if self._mesh is not None:
-            ones = np.ones((seg.shape[0],), dtype=np.int32)
-            counts = kernels.guarded_segment_counts(
-                self._flux_lane(), seg, ones, n_groups)
-        elif n_groups == 1:
-            counts = np.asarray([n_rows], dtype=np.int32)
-        else:
-            ones = np.ones((seg.shape[0],), dtype=np.int32)
-            counts = kernels.host_segment_counts(seg, ones, n_groups)
         single = n_groups == 1
+        order = bounds = None
         if not single:
             # one stable sort instead of a per-group full-batch scan
             # (O(N log N), not O(groups × rows) — GROUP BY a
@@ -453,47 +432,172 @@ class FluxState:
             order = np.argsort(seg, kind="stable")
             bounds = np.searchsorted(seg[order],
                                      np.arange(n_groups + 1))
-        for gid, key in enumerate(keys):
+
+        def gslice(gid, b, ln):
+            if single:
+                return b, ln
+            gidx = order[bounds[gid]:bounds[gid + 1]]
+            return b[gidx], ln[gidx]
+
+        groups: List[_FluxGroup] = []
+        for key in keys:
             g = pane.get(key)
             if g is None:
                 g = pane[key] = _FluxGroup(self.spec)
-            g.count += int(counts[gid])
-            gidx = None if single else order[bounds[gid]:bounds[gid + 1]]
-            for f in self.spec.numeric:
-                vals, kinds = numcols[f]
-                if gidx is not None:
-                    vals, kinds = vals[gidx], kinds[gidx]
-                self._update_col(g.cols[f], vals, kinds)
+            groups.append(g)
+        # top-k composites: host-built per group (prefix + value) in
+        # group order, absorbed below as ONE concatenated batch; the
+        # candidate nomination reads the host rows and is independent
+        # of how (or whether) the sketch update launches
+        comp = comp_len = None
+        if self.spec.topk_field:
+            tb, tl = strcols[self.spec.topk_field]
+            parts = []
+            for gid, key in enumerate(keys):
+                gb, gl = gslice(gid, tb, tl)
+                built = self._topk_composites(key, gb, gl)
+                if built is None:
+                    continue
+                c, cl, plen = built
+                self._topk_nominate(key, c, cl, plen)
+                parts.append((c, cl))
+            if parts:
+                W = self.spec.max_len
+                comp = np.concatenate([c for c, _ in parts])
+                comp_len = np.concatenate([cl for _, cl in parts])
+                Bc = bucket_size(comp.shape[0], max_len=W)
+                if Bc > comp.shape[0]:
+                    comp = np.concatenate(
+                        [comp, np.zeros((Bc - comp.shape[0], W),
+                                        dtype=np.uint8)])
+                    comp_len = np.concatenate(
+                        [comp_len, np.full((Bc - comp_len.shape[0],),
+                                           -1, dtype=np.int32)])
+        fuse = (self._mesh is not None or self._use_device()) \
+            and n_groups <= self._FUSED_MAX_GROUPS
+        if fuse:
+            # ONE device launch for the whole absorb — counts + every
+            # group's HLL registers + the count-min table in a single
+            # fused program (the cashed fbtpu-fuseplan merge)
+            counts = self._fused_absorb(groups, seg, strcols, comp,
+                                        comp_len, gslice)
+        else:
+            if single:
+                counts = np.asarray([n_rows], dtype=np.int32)
+            else:
+                ones = np.ones((seg.shape[0],), dtype=np.int32)
+                counts = kernels.host_segment_counts(seg, ones,
+                                                     n_groups)
             for f in self.spec.distinct:
                 b, ln = strcols[f]
-                if gidx is not None:
-                    b, ln = self._pad_rows(b[gidx], ln[gidx])
-                self._hll_absorb(g.hlls[f], b, ln)
-            if self.spec.topk_field:
-                b, ln = strcols[self.spec.topk_field]
-                if gidx is not None:
-                    b, ln = b[gidx], ln[gidx]
-                self._topk_absorb(key, b, ln)
+                for gid, g in enumerate(groups):
+                    gb, gl = gslice(gid, b, ln)
+                    g.hlls[f].host_update(gb, gl)
+            if comp is not None:
+                self.cms.host_update(comp, comp_len)
+        for gid, g in enumerate(groups):
+            g.count += int(counts[gid])
+            for f in self.spec.numeric:
+                vals, kinds = numcols[f]
+                if not single:
+                    gidx = order[bounds[gid]:bounds[gid + 1]]
+                    vals, kinds = vals[gidx], kinds[gidx]
+                self._update_col(g.cols[f], vals, kinds)
 
-    def _pad_rows(self, b: np.ndarray, ln: np.ndarray):
-        """Pad a per-group slice to a bucketed row count (missing-row
-        padding, a no-op in every kernel) when the update will hit a
-        jitted path — variable per-group shapes would otherwise compile
-        a fresh XLA program per distinct group size inside the ingest
-        lock (the same motivation as _topk_absorb's bucket padding).
-        The host C twin takes any shape; skip the copy there."""
-        if self._mesh is None and not self._use_device():
-            return b, ln
-        Bp = bucket_size(b.shape[0], max_len=b.shape[1] or 1)
-        if Bp <= b.shape[0]:
-            return b, ln
-        return (
-            np.concatenate(
-                [b, np.zeros((Bp - b.shape[0], b.shape[1]),
-                             dtype=b.dtype)]),
-            np.concatenate(
-                [ln, np.full((Bp - ln.shape[0],), -1, dtype=ln.dtype)]),
-        )
+    def _fused_absorb(self, groups: List[_FluxGroup], seg: np.ndarray,
+                      strcols, comp, comp_len, gslice) -> np.ndarray:
+        """Dispatch the fused absorb program through the flux lane —
+        the snapshot-in/commit-on-finish protocol of
+        :meth:`_hll_absorb`, for the whole fused region at once: the
+        launch computes counts, the per-group register stacks and the
+        count-min table from explicit pre-launch snapshots, and the
+        caller commits after ``lane.run`` resolves.  Any failure
+        resolves to the bit-identical host twins re-materialized from
+        the same snapshots."""
+        spec = self.spec
+        lane = self._flux_lane()
+        mesh_on = self._mesh is not None
+        n_groups = len(groups)
+        fields = list(spec.distinct)
+        regs0 = [[g.hlls[f].registers for g in groups]
+                 for f in fields]
+        table0 = self.cms.table if comp is not None else None
+        n_dev = self._mesh.devices.size if mesh_on else 1
+        B = seg.shape[0]
+        # bucket the batch axis so jit sees a small set of stable
+        # shapes (pad rows: segment 0 with valid 0, lengths -1 — every
+        # kernel treats them as no-ops)
+        Bp = bucket_size(B, max_len=spec.max_len or 1,
+                         multiple_of=n_dev)
+        seg32 = seg.astype(np.int32)
+        valid = np.ones((B,), dtype=np.int32)
+        if Bp > B:
+            seg32 = np.concatenate(
+                [seg32, np.zeros((Bp - B,), dtype=np.int32)])
+            valid = np.concatenate(
+                [valid, np.zeros((Bp - B,), dtype=np.int32)])
+        fcols = []
+        for f in fields:
+            b, ln = strcols[f]
+            if Bp > b.shape[0]:
+                b = np.concatenate(
+                    [b, np.zeros((Bp - b.shape[0], b.shape[1]),
+                                 dtype=b.dtype)])
+                ln = np.concatenate(
+                    [ln, np.full((Bp - ln.shape[0],), -1,
+                                 dtype=ln.dtype)])
+            fcols.append((b, ln))
+
+        def _wait(x):
+            return getattr(x, "block_until_ready", lambda: x)()
+
+        def launch():
+            if _fp.ACTIVE:
+                _fp.fire("flux.device_update")
+            m = lane.current_mesh(axis="flux") if mesh_on else None
+            if m is not None:
+                got = kernels.sharded_fused_absorb(
+                    m, seg32, valid, fcols, regs0, comp, comp_len,
+                    table0, hll_p=spec.hll_p, cms=self.cms,
+                    n_seg=n_groups)
+            else:  # mesh shrunk below 2 devices (or none): plain jit
+                got = kernels.fused_absorb(
+                    seg32, valid, fcols, regs0, comp, comp_len,
+                    table0, hll_p=spec.hll_p, cms=self.cms,
+                    n_seg=n_groups)
+            counts, regs_out, table_out = got
+            return (_wait(counts),
+                    tuple(_wait(r) for r in regs_out),
+                    _wait(table_out) if table_out is not None
+                    else None)
+
+        def fallback():
+            # device path failed: re-materialize EVERY sketch from its
+            # pre-launch snapshot, host-pinned (numpy), and absorb
+            # there — bit-identical math (the old-or-new contract of
+            # _hll_absorb/_cms_absorb, for the whole fused region)
+            ones = np.ones((seg.shape[0],), dtype=np.int32)
+            counts = kernels.host_segment_counts(seg, ones, n_groups)
+            for fi, f in enumerate(fields):
+                b, ln = strcols[f]
+                for gid, g in enumerate(groups):
+                    hll = g.hlls[f]
+                    hll.registers = np.asarray(regs0[fi][gid])
+                    gb, gl = gslice(gid, b, ln)
+                    hll.host_update(gb, gl)
+            if comp is not None:
+                self.cms.table = np.asarray(table0)
+                self.cms.host_update(comp, comp_len)
+            return counts, None, None
+
+        counts, regs_out, table_out = lane.run(launch, fallback)
+        if regs_out is not None:
+            for fi, f in enumerate(fields):
+                for gid, g in enumerate(groups):
+                    g.hlls[f].registers = regs_out[fi][gid]
+        if table_out is not None:
+            self.cms.table = table_out
+        return np.asarray(counts)
 
     @staticmethod
     def _update_col(st: _ColStat, vals: np.ndarray,
@@ -555,98 +659,18 @@ class FluxState:
             lane = self._lane = fault.lane("flux")
         return lane
 
-    def _hll_absorb(self, hll: HyperLogLog, batch: np.ndarray,
-                    lengths: np.ndarray) -> None:
-        mesh_on = self._mesh is not None
-        if not mesh_on and not self._use_device():
-            # attached backend IS the host CPU (or none): the C twin
-            # beats the jit round trip and is bit-identical
-            hll.host_update(batch, lengths)
-            return
-        lane = self._flux_lane()
-        regs0 = hll.registers  # pre-launch snapshot: the watched
-        # worker computes from THIS, never from (or into) live sketch
-        # state — an abandoned (soft-killed) launch ends in a discarded
-        # local and can never clobber registers a fallback or later
-        # batch already advanced (commit happens below, caller-side)
-
-        def launch():
-            if _fp.ACTIVE:
-                _fp.fire("flux.device_update")
-            if mesh_on:
-                m = lane.current_mesh(axis="flux")
-                if m is not None:
-                    regs = sharded_hll_registers(hll, m, batch, lengths,
-                                                 registers=regs0)
-                else:  # mesh shrunk below 2 devices: single-device jit
-                    regs = hll.device_registers(batch, lengths,
-                                                wait=True,
-                                                registers=regs0)
-            else:
-                regs = hll.device_registers(batch, lengths,
-                                            registers=regs0)
-            if regs is None:
-                raise RuntimeError("device backend not attached")
-            return getattr(regs, "block_until_ready", lambda: regs)()
-
-        def fallback():
-            # device path failed: re-materialize the sketch from the
-            # pre-launch snapshot, host-pinned (numpy), and absorb
-            # there — bit-identical math
-            hll.registers = np.asarray(regs0)
-            hll.host_update(batch, lengths)
-            return None
-
-        got = lane.run(launch, fallback)
-        if got is not None:
-            hll.registers = got
-
-    def _cms_absorb(self, comp: np.ndarray,
-                    comp_len: np.ndarray) -> None:
-        """Count-min absorb through the flux lane — same
-        compute-without-commit protocol as :meth:`_hll_absorb`."""
-        cms = self.cms
-        mesh_on = self._mesh is not None
-        if not mesh_on and not self._use_device():
-            cms.host_update(comp, comp_len)
-            return
-        lane = self._flux_lane()
-        table0 = cms.table  # snapshot-in/commit-on-finish: see
-        # _hll_absorb — the watched worker never touches live state
-
-        def launch():
-            if _fp.ACTIVE:
-                _fp.fire("flux.device_update")
-            if mesh_on:
-                m = lane.current_mesh(axis="flux")
-                if m is not None:
-                    table = sharded_cms_table(cms, m, comp, comp_len,
-                                              table=table0)
-                else:
-                    table = cms.device_table(comp, comp_len, wait=True,
-                                             table=table0)
-            else:
-                table = cms.device_table(comp, comp_len, table=table0)
-            if table is None:
-                raise RuntimeError("device backend not attached")
-            return getattr(table, "block_until_ready", lambda: table)()
-
-        def fallback():
-            cms.table = np.asarray(table0)
-            cms.host_update(comp, comp_len)
-            return None
-
-        got = lane.run(launch, fallback)
-        if got is not None:
-            cms.table = got
-
-    def _topk_absorb(self, key: tuple, batch: np.ndarray,
-                     lengths: np.ndarray) -> None:
+    def _topk_composites(self, key: tuple, batch: np.ndarray,
+                         lengths: np.ndarray):
+        """Build one group's top-k composite rows (``prefix + value``)
+        host-side — ``(comp, comp_len, plen)`` over the group's VALID
+        rows, or None when the group contributes nothing.  The sketch
+        update itself happens once for the whole batch (fused launch or
+        host twin) on the concatenation of every group's rows."""
         prefix = self._group_prefix(key)
         W = self.spec.max_len
         valid = np.nonzero(lengths >= 0)[0]
         if valid.size == 0:
-            return
+            return None
         plen = len(prefix)
         if plen > W:
             # the group prefix alone exceeds the composite width: no
@@ -654,7 +678,7 @@ class FluxState:
             # earlier groups committed (a partial absorb = the
             # batch-exactness violation). Skip identically on both
             # paths — this group simply has no top-k.
-            return
+            return None
         comp = np.zeros((valid.size, W), dtype=np.uint8)
         comp_len = np.full((valid.size,), -1, dtype=np.int32)
         if plen:
@@ -670,20 +694,16 @@ class FluxState:
         # by length so only the staged device batch needs the zeroing
         pad = np.arange(W)[None, :] >= np.clip(comp_len, 0, None)[:, None]
         comp[pad] = 0
-        Bp = bucket_size(valid.size, max_len=W)
-        if Bp > valid.size:
-            comp = np.concatenate(
-                [comp, np.zeros((Bp - valid.size, W), dtype=np.uint8)])
-            comp_len = np.concatenate(
-                [comp_len, np.full((Bp - valid.size,), -1,
-                                   dtype=np.int32)])
-        self._cms_absorb(comp, comp_len)
-        # candidate set: a BOUNDED sample of this chunk's values (the
-        # CMS holds the counts; candidates only nominate keys for the
-        # top-k read). Stride-sampling rows instead of uniquing the
-        # whole chunk caps per-chunk work at O(limit) — hot keys appear
-        # in most chunks, so they enter the set with high probability,
-        # and the estimates themselves always come from the sketch.
+        return comp, comp_len, plen
+
+    def _topk_nominate(self, key: tuple, comp: np.ndarray,
+                       comp_len: np.ndarray, plen: int) -> None:
+        """Candidate set: a BOUNDED sample of this chunk's values (the
+        CMS holds the counts; candidates only nominate keys for the
+        top-k read). Stride-sampling rows instead of uniquing the
+        whole chunk caps per-chunk work at O(limit) — hot keys appear
+        in most chunks, so they enter the set with high probability,
+        and the estimates themselves always come from the sketch."""
         cand = self._candidates.pop(key, None)
         if cand is None:
             cand = {}
@@ -697,7 +717,7 @@ class FluxState:
             for stale in list(self._candidates)[
                     : len(self._candidates) - _MAX_CANDIDATE_GROUPS]:
                 del self._candidates[stale]
-        ok = np.nonzero(comp_len[:valid.size] >= 0)[0]
+        ok = np.nonzero(comp_len >= 0)[0]
         limit = max(64, 8 * self.spec.topk)
         if ok.size > limit:
             ok = ok[:: max(1, int(ok.size) // limit)][:limit]

@@ -89,6 +89,17 @@ PARTITION_RULES: Dict[str, Tuple[Tuple[str, Tuple[Any, ...]], ...]] = {
     # flux window/segment-count columns: batch-axis sharded inputs,
     # replicated counts out of the psum merge
     "flux-counts": ((r"^(seg|valid)$", (AXIS,)),),
+    # ONE-launch fused flux absorb (counts + per-group HLL stack +
+    # count-min in a single program — the fbtpu-fuseplan cashed merge):
+    # every batch-axis column shards, all sketch state replicates (the
+    # merges are pmax over the [Gp, m] register stack and psum over the
+    # count-min table / segment counts, same exactness as the unfused
+    # programs)
+    "flux-fused": (
+        (r"^(seg|valid|lengths|comp_len)$", (AXIS,)),
+        (r"^(batch|comp)$", (AXIS, None)),
+        (r"^(registers|table)$", ()),
+    ),
 }
 
 

@@ -73,6 +73,28 @@ def _mix(h):
     return h
 
 
+def hll_index_rank(batch, lengths, p: int):
+    """Per-row HLL (register index, rank) over a staged batch — the
+    hash/rank half of :meth:`HyperLogLog._update_impl`, factored out so
+    the fused flux absorb program (flux/kernels.build_fused_absorb) can
+    scatter into a *per-group* [Gp, m] register stack with the exact
+    same math. Invalid rows (length < 0) get rank 0, which every
+    scatter-max treats as a no-op."""
+    h = _fnv1a_scan(batch, lengths)
+    idx = (h >> np.uint32(32 - p)).astype(jnp.int32)
+    rest = h << np.uint32(p)
+    # clz via bit-smear + popcount (integer-exact, TPU-friendly)
+    x = rest
+    for s in (1, 2, 4, 8, 16):
+        x = x | (x >> np.uint32(s))
+    nlz = 32 - lax.population_count(x).astype(jnp.int32)
+    # rank = leading zeros of the remaining (32-p) bits + 1; rest==0
+    # (nlz 32) saturates at the max rank for a (32-p)-bit suffix
+    rank = jnp.minimum(nlz + 1, 32 - p + 1)
+    valid = lengths >= 0
+    return idx, jnp.where(valid, rank, 0)
+
+
 class HyperLogLog:
     """HLL over 32-bit hashes; registers jnp int32 [2^p]."""
 
@@ -113,19 +135,7 @@ class HyperLogLog:
         return True
 
     def _update_impl(self, registers, batch, lengths):
-        h = _fnv1a_scan(batch, lengths)
-        idx = (h >> np.uint32(32 - self.p)).astype(jnp.int32)
-        rest = h << np.uint32(self.p)
-        # clz via bit-smear + popcount (integer-exact, TPU-friendly)
-        x = rest
-        for s in (1, 2, 4, 8, 16):
-            x = x | (x >> np.uint32(s))
-        nlz = 32 - lax.population_count(x).astype(jnp.int32)
-        # rank = leading zeros of the remaining (32-p) bits + 1; rest==0
-        # (nlz 32) saturates at the max rank for a (32-p)-bit suffix
-        rank = jnp.minimum(nlz + 1, 32 - self.p + 1)
-        valid = lengths >= 0
-        rank = jnp.where(valid, rank, 0)
+        idx, rank = hll_index_rank(batch, lengths, self.p)
         return registers.at[idx].max(rank)
 
     def device_registers(self, batch: np.ndarray, lengths: np.ndarray,

@@ -1,0 +1,539 @@
+"""fbtpu-fuseplan: boundary classification, the committed fusion
+plan, and the cashed flux 3→1 fusion.
+
+Three layers, mirroring the module:
+
+- **rule fixtures** — every fuseplan rule fires on a known-bad
+  snippet, stays quiet on the known-good twin, and honors
+  ``# fbtpu-lint: allow(...)`` (plus the stale-suppression audit that
+  polices those comments themselves);
+- **the plan file** — ``analysis/fusion_plan.json`` round-trips
+  against a live ``build_fusion_plan()`` and ``compare_fusion_plan``
+  flags exactly the changes that are regressions (growth, unplanned
+  chains, FUSABLE→BLOCKED) vs notes (shrinkage);
+- **the cashed finding** — the fused flux absorb is bit-exact vs the
+  pure-host chain across batch sizes and segmentation, and the plan's
+  *predicted* launches/segment matches the DeviceLane's *measured*
+  launch counter on the simulated 8-device mesh (static == dynamic).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import fluentbit_tpu  # noqa: F401  (registers plugins)
+from fluentbit_tpu.analysis import Module, lint_source
+from fluentbit_tpu.analysis.__main__ import _fusion_findings
+from fluentbit_tpu.analysis.fuseplan import (FuseplanRules,
+                                             build_fusion_plan,
+                                             classify_boundaries,
+                                             compare_fusion_plan,
+                                             fusion_plan_to_dot,
+                                             plan_snapshot)
+from fluentbit_tpu.analysis.launchgraph import _ModuleScan
+from fluentbit_tpu.analysis.registry import fusion_plan_path
+from fluentbit_tpu.codec.events import decode_events, encode_event
+from fluentbit_tpu.flux.state import FluxSpec, FluxState
+from fluentbit_tpu.ops import fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "fluentbit_tpu")
+
+FIX = "fluentbit_tpu/flux/fixture.py"
+
+
+def fuse_rules(findings):
+    names = set(FuseplanRules.RULE_NAMES)
+    return sorted({f.rule for f in findings if f.rule in names})
+
+
+# ---------------------------------------------------------------------
+# rule fixtures: fusable-unfused-boundary
+# ---------------------------------------------------------------------
+
+FUSABLE = """
+class FluxState:
+    def absorb_batch(self, mesh, seg, valid, batch, lengths, registers):
+        counts = sharded_segment_counts(mesh, seg, valid)
+        regs = sharded_hll_update(mesh, batch, lengths, registers)
+        return counts, regs
+"""
+
+
+def test_fusable_boundary_fires():
+    got = lint_source(FUSABLE, FIX)
+    assert "fusable-unfused-boundary" in fuse_rules(got)
+    f = [x for x in got if x.rule == "fusable-unfused-boundary"][0]
+    assert f.severity == "warning"
+    assert "flux-segment-counts" in f.message
+    assert "flux-hll" in f.message
+
+
+def test_single_launch_chain_has_no_boundary():
+    src = """
+class FluxState:
+    def absorb_batch(self, mesh, seg, valid):
+        return sharded_segment_counts(mesh, seg, valid)
+"""
+    assert fuse_rules(lint_source(src, FIX)) == []
+
+
+def test_fusable_boundary_suppression():
+    src = FUSABLE.replace(
+        "        regs = sharded_hll_update",
+        "        # fbtpu-lint: allow(fusable-unfused-boundary)\n"
+        "        regs = sharded_hll_update")
+    assert "fusable-unfused-boundary" not in fuse_rules(
+        lint_source(src, FIX))
+
+
+def test_scope_gate_outside_device_planes():
+    # the same two-launch chain outside plugins//flux/ is not fuseplan
+    # territory (core host code dispatches nothing)
+    assert fuse_rules(lint_source(
+        FUSABLE, "fluentbit_tpu/core/fixture.py")) == []
+
+
+# ---------------------------------------------------------------------
+# fusion-blocked-by-host-compact
+# ---------------------------------------------------------------------
+
+COMPACT_BLOCKED = """
+class FluxState:
+    def absorb_batch(self, mesh, seg, valid, batch, lengths, registers):
+        counts = sharded_segment_counts(mesh, seg, valid)
+        batch = native.compact(batch, counts)
+        regs = sharded_hll_update(mesh, batch, lengths, registers)
+        return counts, regs
+"""
+
+
+def test_host_compact_blocks_and_fires():
+    got = lint_source(COMPACT_BLOCKED, FIX)
+    r = fuse_rules(got)
+    assert "fusion-blocked-by-host-compact" in r
+    # a BLOCKED boundary is not also proposed as fusable
+    assert "fusable-unfused-boundary" not in r
+    f = [x for x in got
+         if x.rule == "fusion-blocked-by-host-compact"][0]
+    assert "compact" in f.message
+
+
+def test_host_compact_suppression():
+    src = COMPACT_BLOCKED.replace(
+        "        batch = native.compact",
+        "        # fbtpu-lint: allow(fusion-blocked-by-host-compact)\n"
+        "        batch = native.compact")
+    assert "fusion-blocked-by-host-compact" not in fuse_rules(
+        lint_source(src, FIX))
+
+
+# ---------------------------------------------------------------------
+# fused-effect-violation
+# ---------------------------------------------------------------------
+
+EFFECT_INSIDE = """
+class FluxState:
+    def absorb_batch(self, mesh, seg, valid, batch, lengths, registers):
+        counts = sharded_segment_counts(mesh, seg, valid)
+        self.metrics.launches.inc()
+        regs = sharded_hll_update(mesh, batch, lengths, registers)
+        return counts, regs
+"""
+
+
+def test_effect_inside_proposed_region_fires():
+    got = lint_source(EFFECT_INSIDE, FIX)
+    assert "fused-effect-violation" in fuse_rules(got)
+    f = [x for x in got if x.rule == "fused-effect-violation"][0]
+    assert f.severity == "error"
+    assert "reorder" in f.message
+
+
+def test_lock_acquire_is_an_effect():
+    src = EFFECT_INSIDE.replace("self.metrics.launches.inc()",
+                                "self._ingest_lock.acquire()")
+    got = lint_source(src, FIX)
+    assert "fused-effect-violation" in fuse_rules(got)
+
+
+def test_failpoint_fire_is_whitelisted():
+    # the failpoint plane is inert when disarmed (tier-1
+    # test_disabled_plane_adds_no_work) — never an effect hazard
+    src = EFFECT_INSIDE.replace("self.metrics.launches.inc()",
+                                '_fp.fire("flux.device_update")')
+    r = fuse_rules(lint_source(src, FIX))
+    assert "fused-effect-violation" not in r
+    assert "fusable-unfused-boundary" in r
+
+
+def test_effect_violation_suppression():
+    src = EFFECT_INSIDE.replace(
+        "        self.metrics.launches.inc()",
+        "        # fbtpu-lint: allow(fused-effect-violation)\n"
+        "        self.metrics.launches.inc()")
+    assert "fused-effect-violation" not in fuse_rules(
+        lint_source(src, FIX))
+
+
+# ---------------------------------------------------------------------
+# cross-launch-restage
+# ---------------------------------------------------------------------
+
+RESTAGE = """
+class FluxState:
+    def absorb_batch(self, mesh, seg, valid, batch, lengths, registers):
+        counts = sharded_segment_counts(mesh, seg, valid, batch)
+        batch2 = np.asarray(batch)
+        regs = sharded_hll_update(mesh, batch2, lengths, registers)
+        return counts, regs
+"""
+
+
+def test_cross_launch_restage_fires():
+    got = lint_source(RESTAGE, FIX)
+    assert "cross-launch-restage" in fuse_rules(got)
+    f = [x for x in got if x.rule == "cross-launch-restage"][0]
+    assert "`batch`" in f.message
+    assert "device-resident" in f.message
+
+
+def test_restage_of_unstaged_buffer_quiet():
+    # asarray over a name the producer never staged is host prep, not
+    # a re-upload of device-resident bytes
+    src = RESTAGE.replace("np.asarray(batch)", "np.asarray(lengths2)")
+    assert "cross-launch-restage" not in fuse_rules(
+        lint_source(src, FIX))
+
+
+def test_restage_does_not_block_fusion():
+    got = lint_source(RESTAGE, FIX)
+    r = fuse_rules(got)
+    # the restage is the cost the merge deletes — the boundary stays
+    # FUSABLE and both findings ride together
+    assert "fusable-unfused-boundary" in r
+    assert "cross-launch-restage" in r
+
+
+def test_restage_suppression():
+    src = RESTAGE.replace(
+        "        batch2 = np.asarray(batch)",
+        "        # fbtpu-lint: allow(cross-launch-restage)\n"
+        "        batch2 = np.asarray(batch)")
+    assert "cross-launch-restage" not in fuse_rules(
+        lint_source(src, FIX))
+
+
+# ---------------------------------------------------------------------
+# boundary classification detail (the planner's raw verdicts)
+# ---------------------------------------------------------------------
+
+def _classify(src):
+    module = Module(FIX, src)
+    chains = _ModuleScan(module).chains()
+    assert len(chains) == 1
+    return classify_boundaries(module, chains[0])
+
+
+def test_classify_fusable_boundary_shape():
+    bounds = _classify(FUSABLE)
+    assert len(bounds) == 1
+    b = bounds[0]
+    assert b["verdict"] == "FUSABLE"
+    assert b["producer"]["kind"] == "flux-segment-counts"
+    assert b["consumer"]["kind"] == "flux-hll"
+    assert b["reasons"] == []
+    # both sides have shipped programs; no shared input clashes
+    assert b["aval_compat"] is True
+
+
+def test_classify_blocked_reasons_pinpointed():
+    bounds = _classify(COMPACT_BLOCKED)
+    assert bounds[0]["verdict"] == "BLOCKED"
+    kinds = {r["kind"] for r in bounds[0]["reasons"]}
+    assert kinds == {"host-compact"}
+    (reason,) = bounds[0]["reasons"]
+    assert reason["line"] == COMPACT_BLOCKED.splitlines().index(
+        "        batch = native.compact(batch, counts)") + 1
+
+
+def test_planned_program_merges_fusable_run():
+    module = Module(FIX, FUSABLE)
+    chain = _ModuleScan(module).chains()[0]
+    from fluentbit_tpu.analysis.fuseplan import _planned_program
+    from fluentbit_tpu.analysis.launchgraph import canonical_env
+    sites = sorted(chain["sites"], key=lambda s: (s["line"],))
+    bounds = classify_boundaries(module, chain)
+    planned = _planned_program(sites, bounds, canonical_env())
+    assert planned["launches_per_segment"] == 1
+    # counts + hll stage disjoint buffers; a blocked twin stays at 2
+    bounds_blocked = _classify(COMPACT_BLOCKED)
+    module2 = Module(FIX, COMPACT_BLOCKED)
+    chain2 = _ModuleScan(module2).chains()[0]
+    sites2 = sorted(chain2["sites"], key=lambda s: (s["line"],))
+    planned2 = _planned_program(sites2, bounds_blocked,
+                                canonical_env())
+    assert planned2["launches_per_segment"] == 2
+
+
+# ---------------------------------------------------------------------
+# the committed plan: round-trip + the regression gate
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def live_plan():
+    return build_fusion_plan(PKG)
+
+
+def test_committed_plan_round_trips(live_plan):
+    with open(fusion_plan_path(), "r", encoding="utf-8") as fh:
+        committed = json.load(fh)
+    assert committed["plan"] == plan_snapshot(live_plan)
+
+
+def test_shipped_tree_has_no_open_boundaries(live_plan):
+    # the cashed finding: the flux 3-launch chain is ONE fused program
+    # now, so the shipped plan holds zero boundaries anywhere
+    snap = plan_snapshot(live_plan)
+    flux = snap["chains"][
+        "fluentbit_tpu/flux/state.py::FluxState.absorb_batch"]
+    assert flux["boundaries"] == 0
+    assert flux["planned_launches_per_segment"] == 1
+    for chain in snap["chains"].values():
+        assert chain["blocked"] == 0
+        assert chain["verdicts"] == []
+
+
+def _base_snap():
+    return {"chains": {"m.py::C.e": {
+        "boundaries": 2, "blocked": 1,
+        "verdicts": ["FUSABLE", "BLOCKED"],
+        "planned_launches_per_segment": 2,
+        "planned_undonated_h2d_bytes": 100}}}
+
+
+def test_compare_identical_is_clean():
+    assert compare_fusion_plan(_base_snap(), _base_snap()) == ([], [])
+
+
+def test_compare_flags_growth_as_regression():
+    cur = _base_snap()
+    cur["chains"]["m.py::C.e"]["planned_undonated_h2d_bytes"] = 160
+    regs, notes = compare_fusion_plan(cur, _base_snap())
+    assert any("planned_undonated_h2d_bytes grew 100 → 160" in r
+               for r in regs)
+    assert notes == []
+
+
+def test_compare_flags_new_chain_as_regression():
+    cur = _base_snap()
+    cur["chains"]["new.py::D.e"] = dict(
+        cur["chains"]["m.py::C.e"])
+    regs, _ = compare_fusion_plan(cur, _base_snap())
+    assert any("new device chain" in r for r in regs)
+
+
+def test_compare_flags_verdict_flip_as_regression():
+    cur = _base_snap()
+    cur["chains"]["m.py::C.e"]["verdicts"] = ["BLOCKED", "BLOCKED"]
+    cur["chains"]["m.py::C.e"]["blocked"] = 2
+    regs, _ = compare_fusion_plan(cur, _base_snap())
+    assert any("FUSABLE → BLOCKED" in r for r in regs)
+
+
+def test_compare_notes_shrinkage_and_departed_chain():
+    cur = {"chains": {}}
+    regs, notes = compare_fusion_plan(cur, _base_snap())
+    assert regs == []
+    assert any("left the device plane" in n for n in notes)
+    cur = _base_snap()
+    cur["chains"]["m.py::C.e"]["planned_launches_per_segment"] = 1
+    regs, notes = compare_fusion_plan(cur, _base_snap())
+    assert regs == []
+    assert any("improved 2 → 1" in n for n in notes)
+
+
+def test_missing_plan_file_is_an_error(monkeypatch, tmp_path):
+    import fluentbit_tpu.analysis.registry as registry
+    monkeypatch.setattr(registry, "fusion_plan_path",
+                        lambda: str(tmp_path / "nope.json"))
+    findings, notes = _fusion_findings([])
+    assert len(findings) == 1
+    assert findings[0].rule == "fusion-plan-regression"
+    assert "missing" in findings[0].message
+    assert "--write-fusion-plan" in findings[0].message
+
+
+def test_stale_baseline_entry_detected(monkeypatch, tmp_path,
+                                       live_plan):
+    # a baselined finding that no finding matches anymore must surface
+    # (fixed debt the file still pretends exists)
+    import fluentbit_tpu.analysis.registry as registry
+    fake = tmp_path / "fusion_plan.json"
+    fake.write_text(json.dumps({
+        "version": 1,
+        "findings": [{"path": "fluentbit_tpu/flux/state.py",
+                      "rule": "fusable-unfused-boundary",
+                      "message": "long gone"}],
+        "plan": plan_snapshot(live_plan)}))
+    monkeypatch.setattr(registry, "fusion_plan_path",
+                        lambda: str(fake))
+    findings, _ = _fusion_findings([])
+    assert len(findings) == 1
+    assert findings[0].severity == "warning"
+    assert "no longer matches any finding" in findings[0].message
+
+
+def test_dot_rendering_colors_verdicts():
+    module = Module(FIX, COMPACT_BLOCKED)
+    chain = _ModuleScan(module).chains()[0]
+    sites = sorted(chain["sites"], key=lambda s: (s["line"],))
+    bounds = classify_boundaries(module, chain)
+    from fluentbit_tpu.analysis.fuseplan import _planned_program
+    from fluentbit_tpu.analysis.launchgraph import canonical_env
+    plan = {"version": 1, "params": canonical_env(), "chains": {
+        "fixture::FluxState.absorb_batch": {
+            "launches_per_segment": 2,
+            "sites": [{"line": s["line"], "kind": s["kind"],
+                       "what": s["what"]} for s in sites],
+            "boundaries": bounds,
+            "planned": _planned_program(sites, bounds,
+                                        canonical_env())}}}
+    dot = fusion_plan_to_dot(plan)
+    assert "digraph fuseplan" in dot
+    assert "color=red" in dot and "host-compact" in dot
+    # the green twin
+    module = Module(FIX, FUSABLE)
+    chain = _ModuleScan(module).chains()[0]
+    sites = sorted(chain["sites"], key=lambda s: (s["line"],))
+    bounds = classify_boundaries(module, chain)
+    plan["chains"]["fixture::FluxState.absorb_batch"].update(
+        sites=[{"line": s["line"], "kind": s["kind"],
+                "what": s["what"]} for s in sites],
+        boundaries=bounds)
+    assert "color=green" in fusion_plan_to_dot(plan)
+
+
+# ---------------------------------------------------------------------
+# stale-suppression (the audit that polices allow-comments)
+# ---------------------------------------------------------------------
+
+def test_stale_suppression_fires_on_dead_waiver():
+    src = """
+def flush(x):
+    send(x)  # fbtpu-lint: allow(swallowed-error)
+"""
+    got = lint_source(src, "fluentbit_tpu/plugins/out_x.py")
+    assert [f.rule for f in got] == ["stale-suppression"]
+    assert "suppresses nothing" in got[0].message
+
+
+def test_live_suppression_not_stale():
+    src = """
+def flush(x):
+    try:
+        send(x)
+    except Exception:
+        pass  # fbtpu-lint: allow(swallowed-error)
+"""
+    assert lint_source(src, "fluentbit_tpu/plugins/out_x.py") == []
+
+
+def test_wildcard_waiver_exempt():
+    src = """
+def flush(x):
+    send(x)  # fbtpu-lint: allow(*)
+"""
+    assert lint_source(src, "fluentbit_tpu/plugins/out_x.py") == []
+
+
+def test_docstring_mention_is_not_a_waiver():
+    src = '''
+def helper():
+    """Docs may say `# fbtpu-lint: allow(swallowed-error)` freely."""
+    return 1
+'''
+    assert lint_source(src, "fluentbit_tpu/plugins/out_x.py") == []
+
+
+# ---------------------------------------------------------------------
+# the cashed fusion: bit-exact vs the host chain, static == dynamic
+# ---------------------------------------------------------------------
+
+def _need_mesh():
+    if len(__import__("jax").devices()) < 8:
+        pytest.skip("need the simulated 8-device mesh")
+
+
+def _bodies(n):
+    return [{"tenant": ["a", "b", "c"][i % 3], "user": f"u{i % 7}",
+             "size": i * 3 % 13} for i in range(n)]
+
+
+def _absorb_split(state, bodies, seg_size):
+    """Absorb in segments of ``seg_size`` records (None = one batch) —
+    uneven tails included, exactly how the engine's segmented staging
+    would feed the state."""
+    if seg_size is None:
+        seg_size = max(len(bodies), 1)
+    for i in range(0, len(bodies), seg_size):
+        part = bodies[i:i + seg_size]
+        buf = bytearray()
+        for j, b in enumerate(part):
+            buf += encode_event(b, 1000.0 + i + j)
+        state.absorb_events(decode_events(bytes(buf)))
+
+
+def _fingerprint(state):
+    out = []
+    for key, g in state.live_groups():
+        hlls = {f: np.asarray(h.registers).tobytes()
+                for f, h in g.hlls.items()}
+        out.append((key, g.count, hlls))
+    cms = (np.asarray(state.cms.table).tobytes()
+           if state.cms is not None else None)
+    return out, cms, state.records_total
+
+
+@pytest.mark.parametrize("n", [0, 1, 8, 17, 42])
+@pytest.mark.parametrize("seg", [None, 128, 1])
+def test_fused_absorb_bit_exact_vs_host_chain(n, seg):
+    _need_mesh()
+    spec = dict(group_by=("tenant",), distinct=("user",),
+                topk_field="user")
+    host = FluxState(FluxSpec("t", **spec))
+    fused = FluxState(FluxSpec("t", **spec, mesh=True))
+    assert fused._mesh is not None
+    bodies = _bodies(n)
+    _absorb_split(host, bodies, seg)
+    _absorb_split(fused, bodies, seg)
+    assert _fingerprint(host) == _fingerprint(fused)
+
+
+def test_static_launch_count_matches_lane_counter(live_plan):
+    """The plan's symbolic launches/segment IS the DeviceLane's
+    measured counter: N absorbs on the fused mesh state move the
+    ``flux`` lane's launch count by exactly N × planned."""
+    _need_mesh()
+    snap = plan_snapshot(live_plan)
+    planned = snap["chains"][
+        "fluentbit_tpu/flux/state.py::FluxState.absorb_batch"][
+        "planned_launches_per_segment"]
+    assert planned == 1
+    state = FluxState(FluxSpec("t", group_by=("tenant",),
+                               distinct=("user",), topk_field="user",
+                               mesh=True))
+    lane = fault.lane("flux")
+    before = lane.stats()["launches"]
+    n_batches = 3
+    for k in range(n_batches):
+        _absorb_split(state, _bodies(17), None)
+    after = lane.stats()["launches"]
+    assert after - before == n_batches * planned
+    # and those launches were healthy device launches, not fallbacks
+    assert lane.stats()["failures"] == 0 or \
+        lane.stats()["ok"] >= before + n_batches

@@ -424,13 +424,14 @@ def test_shipped_grep_chain(graph):
 
 
 def test_shipped_flux_chain(graph):
+    # post-fuseplan: the counts→hll→cms chain is one fused shard_map
+    # program — a single launch, no per-group loop (the per-group HLL
+    # and CMS absorbs now ride a masked [Gp, ...] lane inside it)
     ch = _chain(graph, "flux/state.py::FluxState.absorb_batch")
-    assert ch["launches_per_segment"] == 3
+    assert ch["launches_per_segment"] == 1
     kinds = sorted(s["kind"] for s in ch["sites"])
-    assert kinds == ["flux-cms", "flux-hll", "flux-segment-counts"]
-    per_group = {s["kind"]: s["in_loop"] for s in ch["sites"]}
-    assert per_group["flux-hll"] and per_group["flux-cms"]
-    assert not per_group["flux-segment-counts"]
+    assert kinds == ["flux-fused"]
+    assert not ch["sites"][0]["in_loop"]
 
 
 def test_shipped_host_only_entries(graph):
@@ -456,8 +457,11 @@ def test_shipped_transfer_budget_numbers(graph):
     donated = {t["buffer"]: t["donated"] for t in grep["h2d"]}
     assert donated == {"batch": False, "lengths": True}
     flux = _chain(graph, "FluxState.absorb_batch")["transfers"]
-    assert flux["undonated_h2d_bytes_canonical"] == 4804608
-    assert flux["d2h_bytes_canonical"] == 528388
+    # fused program: seg/valid/lengths/comp_len 4*Bp i32 each, batch +
+    # comp Bp*L u8, cms table 8*M_cms — registers are donated; d2h
+    # returns counts [Gp] + registers [Gp, M_hll] + table
+    assert flux["undonated_h2d_bytes_canonical"] == 4784128
+    assert flux["d2h_bytes_canonical"] == 557088
 
 
 def test_shipped_donation_crosscheck(graph):

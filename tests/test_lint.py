@@ -35,17 +35,19 @@ def test_package_tree_clean():
     # (ROADMAP item 1) is subtracted exactly — anything else fails, and
     # a stale baseline entry that no longer matches the tree fails too
     # ... and since the locksmith pack, analysis/lock_baseline.json is
-    # the second sanctioned baseline, and since the memscope pack,
-    # analysis/copy_budget.json the third — all are subtracted EXACTLY
+    # the second sanctioned baseline, since the memscope pack,
+    # analysis/copy_budget.json the third, and since the fuseplan
+    # pack, analysis/fusion_plan.json the fourth — all are subtracted
+    # EXACTLY
     import json
 
     from fluentbit_tpu.analysis.__main__ import _canon
     from fluentbit_tpu.analysis.registry import budget_path, \
-        copy_budget_path, lock_baseline_path
+        copy_budget_path, fusion_plan_path, lock_baseline_path
 
     recorded = set()
     for bpath in (budget_path(), lock_baseline_path(),
-                  copy_budget_path()):
+                  copy_budget_path(), fusion_plan_path()):
         with open(bpath, "r", encoding="utf-8") as fh:
             recorded |= {(d["path"], d["rule"], d["message"])
                          for d in json.load(fh)["findings"]}
@@ -96,7 +98,11 @@ def test_list_rules():
                  "guarded-by-missing", "atomicity-check-then-act",
                  "lock-held-across-dispatch", "cow-swap-aliasing",
                  "host-redundant-copy", "host-decode-then-restage",
-                 "host-mutable-view-escape", "mmap-lifetime-escape"):
+                 "host-mutable-view-escape", "mmap-lifetime-escape",
+                 "fusable-unfused-boundary",
+                 "fusion-blocked-by-host-compact",
+                 "cross-launch-restage", "fused-effect-violation",
+                 "fusion-plan-regression", "stale-suppression"):
         assert name in proc.stdout
 
 

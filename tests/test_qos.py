@@ -1475,3 +1475,88 @@ def test_storage_quota_sheds_write_through_over_limit(tmp_path):
     with open(path, "rb") as f:
         blob = f.read()
     assert blob.endswith(data * 2) and not blob.endswith(data * 3)
+
+
+# ---------------------------------------------------------------------
+# tenant.flush_concurrency (carried ROADMAP satellite)
+# ---------------------------------------------------------------------
+
+def test_tenant_flush_concurrency_contract():
+    """Declaration parses, binds at start, re-declaration rebuilds the
+    semaphore (like the token bucket), and Qos.flush_slot resolves via
+    the chunk's stamped tenant."""
+    ctx = flb.create(flush="1s", grace="1")
+    ctx.input("lib", tag="t", **{"tenant": "gold",
+                                 "tenant.flush_concurrency": "2"})
+    ctx.output("null", match="t")
+    ctx.start()
+    try:
+        q = ctx.engine.qos
+        t = q.tenant("gold")
+        assert t.flush_concurrency == 2
+        assert t.flush_semaphore is not None
+        assert t.flush_semaphore._value == 2
+
+        class _C:
+            qos_tenant = "gold"
+
+        assert q.flush_slot(_C()) is t.flush_semaphore
+        # undeclared tenant / default: uncapped
+        class _D:
+            qos_tenant = None
+
+        assert q.flush_slot(_D()) is None
+        # re-declaration rebuilds; same value is a no-op
+        old = t.flush_semaphore
+        q.tenant("gold", flush_concurrency=2)
+        assert t.flush_semaphore is old
+        q.tenant("gold", flush_concurrency=3)
+        assert t.flush_semaphore is not old
+        assert t.flush_semaphore._value == 3
+    finally:
+        ctx.stop()
+
+
+def test_tenant_flush_concurrency_rejects_non_positive():
+    ctx = flb.create(flush="1s")
+    ctx.input("lib", tag="t", **{"tenant": "gold",
+                                 "tenant.flush_concurrency": "0"})
+    ctx.output("null", match="t")
+    with pytest.raises(ValueError, match="flush_concurrency"):
+        ctx.start()
+
+
+def test_tenant_flush_concurrency_caps_parallel_attempts():
+    """Two outputs flush one tenant's chunk concurrently; a cap of 1
+    must serialize them (the second attempt queues on the tenant
+    semaphore while the first holds the slot)."""
+    import asyncio
+
+    from fluentbit_tpu.core.plugin import FlushResult
+
+    ctx = flb.create(flush="30ms", grace="2")
+    in_ffd = ctx.input("lib", tag="t", **{
+        "tenant": "gold", "tenant.flush_concurrency": "1"})
+    ctx.output("null", match="t")
+    ctx.output("null", match="t")
+    ctx.start()
+    peak = {"cur": 0, "max": 0, "done": 0}
+
+    async def slow_flush(data, tag, engine):
+        peak["cur"] += 1
+        peak["max"] = max(peak["max"], peak["cur"])
+        await asyncio.sleep(0.08)
+        peak["cur"] -= 1
+        peak["done"] += 1
+        return FlushResult.OK
+
+    try:
+        for out in ctx.engine.outputs:
+            out.plugin.flush = slow_flush
+        ctx.push(in_ffd, '{"seq": 1}')
+        ctx.flush_now()
+        wait_for(lambda: peak["done"] >= 2)
+        assert peak["max"] == 1, (
+            f"tenant cap 1 but {peak['max']} concurrent flushes")
+    finally:
+        ctx.stop()

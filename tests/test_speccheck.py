@@ -667,12 +667,16 @@ def test_shardings_snapshot_shape():
         pytest.skip("shipped-program registry unavailable (no jax)")
     assert set(snap) == {"grep.jit", "grep.mesh[batch]",
                         "grep.mesh[rules]", "flux.hll", "flux.cms",
-                        "flux.counts"}
+                        "flux.counts", "flux.fused"}
     gr = snap["grep.mesh[rules]"]
     assert gr["tables"]["trans_flat"] == ["batch", None]
     assert gr["donate_predicted"] == ["lengths"]
     assert snap["flux.hll"]["tables"]["registers"] == []
     assert snap["flux.counts"]["inputs"]["seg"] == ["flux"]
+    fu = snap["flux.fused"]
+    assert fu["inputs"]["seg"] == ["flux"]
+    assert fu["inputs"]["registers"] == []
+    assert fu["donate_predicted"] == ["registers"]
 
 
 def _sharding_budgets():
