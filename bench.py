@@ -793,6 +793,65 @@ def measure_shrink(seconds: float = 1.2) -> dict:
     return out
 
 
+def measure_forward(n_records: int = 4000) -> dict:
+    """fbtpu-relay stage: the fluent-forward loopback hop — lib input
+    → armored forward output → forward input → null sink, two engines
+    in one process over 127.0.0.1 with require_ack_response on, so the
+    measured rate is end-to-end ACK-VERIFIED delivery (frame + gzip-free
+    PackedForward + ack round-trip), and the ack p50 is the per-chunk
+    acknowledgement latency the effectively-once ledger sits behind."""
+    import json as _json
+
+    import fluentbit_tpu as flb
+
+    out = {}
+    rx = flb.create(flush="100ms", grace="1")
+    rx.input("forward", listen="127.0.0.1", port="0")
+    rx.output("null", match="*")
+    rx.start()
+    try:
+        rx_plug = rx.engine.inputs[0].plugin
+        deadline = time.time() + 10
+        while rx_plug.bound_port is None and time.time() < deadline:
+            time.sleep(0.01)
+        if rx_plug.bound_port is None:
+            return {"error": "forward input never bound"}
+        tx = flb.create(flush="100ms", grace="1")
+        ffd = tx.input("lib", tag="bench.fwd")
+        tx.output("forward", match="bench.*", host="127.0.0.1",
+                  port=str(rx_plug.bound_port),
+                  require_ack_response="true", ack_timeout="5")
+        tx.start()
+        try:
+            fwd = next(o.plugin for o in tx.engine.outputs
+                       if o.plugin.name == "forward")
+            t0 = time.perf_counter()
+            for i in range(n_records):
+                tx.push(ffd, _json.dumps({"seq": i, "log": "x" * 64}))
+            tx.flush_now()
+            e = tx.engine
+            stop_at = time.time() + 30
+            while time.time() < stop_at:
+                if not e._backlog and not e._task_map \
+                        and not e._pending_flushes \
+                        and not e._pending_retries:
+                    break
+                time.sleep(0.01)
+            dt = time.perf_counter() - t0
+            out["forward_lines_per_sec"] = \
+                round(n_records / dt) if dt else 0
+            p50 = fwd.ack_p50()
+            out["forward_ack_p50_ms"] = \
+                round(p50 * 1e3, 3) if p50 is not None else None
+            out["forward_chunks_acked"] = fwd.n_acks_waited
+            out["forward_acks_lost"] = fwd.n_acks_lost
+        finally:
+            tx.stop()
+    finally:
+        rx.stop()
+    return out
+
+
 def measure_memscope(seconds: float = 1.2) -> dict:
     """fbtpu-memscope stage: what the copy census + offset sidecars buy
     at runtime. Three lanes: (1) bytes-copied-per-record through chunk
@@ -1309,6 +1368,11 @@ def child_main(mode: str) -> None:
             result["memscope"] = measure_memscope()
         except Exception as e:
             result["memscope"] = {"error": repr(e)}
+        _progress(stage="cpu:forward")
+        try:
+            result["forward"] = measure_forward()
+        except Exception as e:
+            result["forward"] = {"error": repr(e)}
     if ok and mode == "cpu":
         run_kernel_only()
     from fluentbit_tpu import native
@@ -1493,6 +1557,8 @@ def final_line(cpu, dev, dev_err, extras):
                                   "pooled_lines_per_sec"),
         "native_staging": bool((best or {}).get("native_staging", False)),
         "secondary": (cpu or {}).get("secondary"),
+        # fbtpu-relay: loopback forward-hop lines/s + ack p50
+        "forward": (cpu or {}).get("forward"),
         "flux": (cpu or {}).get("flux"),
         "shrink": (cpu or {}).get("shrink"),
         "host_cpus": os.cpu_count(),

@@ -275,6 +275,20 @@ GUARDS: Tuple[GuardEntry, ...] = (
         note="fault event listener list: engines register/release on "
              "start/stop while lanes notify from worker threads",
     ),
+    # -- fbtpu-relay: forward fan-in dedup ledger + partition spool --
+    GuardEntry(
+        "fluentbit_tpu/core/relay.py", "_lock",
+        ("_seen", "dedup_hits"),
+        note="dedup ledger map + hit counter: the server's event loop "
+             "absorbs while health snapshots and the soak audit read; "
+             "seen/record/GC must serialize (a torn check-then-record "
+             "IS a double-absorb)",
+    ),
+    GuardEntry(
+        "fluentbit_tpu/core/relay.py", "_lock", ("_seq",),
+        note="spool sequence counter: concurrent degrades must never "
+             "mint the same file name (replay order is the name order)",
+    ),
     # -- analyzer caches (fbtpu-locksmith lockset scope) --
     GuardEntry(
         "fluentbit_tpu/analysis/speccheck.py", "_cache_lock",

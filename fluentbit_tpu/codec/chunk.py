@@ -148,6 +148,13 @@ class ChunkPool:
         self._active: Dict[tuple, Chunk] = {}
         self._ready: List[Chunk] = []
         self.total_bytes = 0
+        # fan-in QoS stamp: (tenant, priority) the NEXT appends belong
+        # to. The forward server sets it around input_log_append so a
+        # relayed chunk carries the edge tenant named on the wire, not
+        # the aggregator input's own tenant — and it joins the chunk
+        # key, so records of different remote tenants never merge into
+        # one chunk (a chunk has exactly one qos_tenant slot).
+        self.stamp = None
 
     def append(self, tag: str, data: bytes, n_records: int,
                event_type: str = EVENT_TYPE_LOGS,
@@ -156,13 +163,15 @@ class ChunkPool:
         # groups must never merge into a chunk with different routes
         # (reference split_and_append_route_payloads,
         # src/flb_input_log.c:1495)
-        key = (event_type, tag, routes_mask)
+        key = (event_type, tag, routes_mask, self.stamp)
         chunk = self._active.get(key)
         if chunk is None or chunk.locked:
             if chunk is not None and chunk.locked:
                 self._ready.append(chunk)
             chunk = Chunk(tag, event_type, self.in_name)
             chunk.routes_mask = routes_mask
+            if self.stamp is not None:
+                chunk.qos_tenant, chunk.priority = self.stamp
             self._active[key] = chunk
         chunk.append(data, n_records)
         self.total_bytes += len(data)

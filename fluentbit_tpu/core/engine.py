@@ -33,6 +33,7 @@ from .config import ServiceConfig
 from .lockorder import make_lock
 from .metrics import MetricsRegistry
 from .plugin import (
+    FLUSH_CHUNK,
     FilterInstance,
     FilterResult,
     FlushResult,
@@ -2001,6 +2002,10 @@ class Engine:
                 rec.started = time.time()
                 rec.begun = True
                 _guard.CANCEL_EVENT.set(rec.cancel_event)
+            # expose the chunk to the plugin the same way the cancel
+            # event is exposed: outputs that relay pipeline metadata
+            # (out_forward's tenant/priority wire stamps) read it here
+            FLUSH_CHUNK.set(chunk)
             try:
                 # test formatter hook (src/flb_engine_dispatch.c:101-137)
                 if out.test_formatter is not None:
@@ -2030,7 +2035,8 @@ class Engine:
                                 rec.worker = True
                             result = await out.worker_pool.submit(
                                 self._worker_flush(out.plugin, data,
-                                                   chunk.tag, rec))
+                                                   chunk.tag, rec,
+                                                   chunk))
                         else:
                             result = await out.plugin.flush(
                                 data, chunk.tag, self)
@@ -2060,16 +2066,19 @@ class Engine:
             await asyncio.sleep(delay)
             delay = await attempt()
 
-    async def _worker_flush(self, plugin, data: bytes, tag: str, rec):
+    async def _worker_flush(self, plugin, data: bytes, tag: str, rec,
+                            chunk=None):
         """Worker-pool submission wrapper: re-exposes the guard's
-        cooperative cancel flag on the worker loop (contextvars do not
-        cross ``run_coroutine_threadsafe``) and marks completion, so
-        the watchdog can tell a soft-kill that landed late from a
-        worker thread wedged in sync code (the leaked-thread counter)."""
+        cooperative cancel flag AND the flush-chunk contextvar on the
+        worker loop (contextvars do not cross
+        ``run_coroutine_threadsafe``) and marks completion, so the
+        watchdog can tell a soft-kill that landed late from a worker
+        thread wedged in sync code (the leaked-thread counter)."""
         if rec is not None:
             from . import guard as _guard
 
             _guard.CANCEL_EVENT.set(rec.cancel_event)
+        FLUSH_CHUNK.set(chunk)
         try:
             return await plugin.flush(data, tag, self)
         finally:

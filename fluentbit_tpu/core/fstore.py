@@ -28,9 +28,25 @@ class FStoreFile:
         with open(self.path, "rb") as f:
             return f.read()
 
-    def set_meta(self, meta: dict) -> None:
-        with open(self.meta_path, "w", encoding="utf-8") as f:
+    def set_meta(self, meta: dict, durable: bool = False) -> None:
+        """Write the JSON metadata sidecar.
+
+        ``durable=True`` takes the tmp + fsync + rename path: the meta
+        file is then either the old version or the new one, never a
+        torn half-write. The forward dedup ledger requires this — a
+        SIGKILL mid-write would otherwise void the whole absorbed-set
+        and turn every in-flight redelivery into a double-absorb.
+        """
+        if not durable:
+            with open(self.meta_path, "w", encoding="utf-8") as f:
+                json.dump(meta, f)
+            return
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
             json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.meta_path)
 
     def meta(self) -> dict:
         try:

@@ -821,6 +821,20 @@ class Guard:
         if running and hb_age > max(svc.guard_stall_after,
                                     5.0 * svc.flush):
             verdict = "stalled"
+        # fbtpu-relay forward fan-in state: ack/dedup/backpressure
+        # counters per forward plugin instance (FAULTS.md "fbtpu-relay")
+        forward_block = {}
+        for inst in list(engine.inputs) + list(engine.outputs):
+            plugin = getattr(inst, "plugin", None)
+            hb = getattr(plugin, "health_block", None)
+            if getattr(plugin, "name", "") != "forward" or hb is None:
+                continue
+            try:
+                forward_block[inst.display_name] = hb()
+            except Exception:
+                log.exception("forward health block failed")
+                forward_block[inst.display_name] = {
+                    "error": "unavailable"}
         return {
             "status": verdict,
             "heartbeat_age": round(hb_age, 3),
@@ -831,6 +845,8 @@ class Guard:
             "breakers": breakers,
             # fbtpu-armor: attach retry state + device-lane failover
             "device": device_block,
+            # fbtpu-relay: forward hop ack/dedup/backpressure state
+            "forward": forward_block,
             # fbtpu-qos per-tenant state (QOS.md): generation + each
             # tenant's contract, admission counters and queue depth
             "qos": engine.qos.snapshot(),

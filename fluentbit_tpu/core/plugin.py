@@ -10,6 +10,7 @@ registry replaces the cmake plugin gating (cmake/plugins_options.cmake).
 
 from __future__ import annotations
 
+import contextvars
 import enum
 import logging
 import threading
@@ -21,6 +22,17 @@ from .router import Route
 from ..codec.chunk import Chunk, ChunkPool, EVENT_TYPE_LOGS
 
 log = logging.getLogger("flb")
+
+# The chunk whose payload the CURRENT flush attempt is delivering,
+# exposed to output plugins the same way the guard's cooperative-cancel
+# event is (core/guard.py CANCEL_EVENT): set by the engine around
+# plugin.flush, re-set on worker loops (contextvars do not cross
+# run_coroutine_threadsafe). Outputs that relay pipeline metadata —
+# out_forward propagating the chunk's tenant/priority stamps across the
+# fan-in hop — read it instead of growing the flush() signature that
+# every registered output implements.
+FLUSH_CHUNK: "contextvars.ContextVar[Optional[Chunk]]" = \
+    contextvars.ContextVar("flb_flush_chunk", default=None)
 
 
 class FlushResult(enum.Enum):

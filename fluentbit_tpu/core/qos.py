@@ -315,6 +315,38 @@ class Qos:
         self.m_deferred.inc(1, (t.name,))
         return DEFER
 
+    def admit_stamped(self, name: str, n_bytes: int) -> int:
+        """Meter one append against a tenant resolved BY NAME — the
+        fan-in path (plugins/net_forward.ForwardInput), where the
+        tenant identity arrives as a wire stamp on the forward option
+        map, not from the local input instance. Same verdicts as
+        :meth:`admit`; the caller turns DEFER into a delayed/withheld
+        ack (the forward hop's backpressure signal) rather than an
+        input pause. Charges the same per-tenant buckets and counters,
+        so a tenant's quota holds fleet-wide: edge-local ingest and
+        relayed ingest drain one budget."""
+        t = self.tenant(name)
+        if _fp.ACTIVE:
+            _fp.fire("qos.admit")
+        if t.bucket is None or not self.enabled:
+            self.m_admitted.inc(n_bytes, (t.name,))
+            return ADMIT
+        if t.bucket.try_take(n_bytes):
+            self.m_admitted.inc(n_bytes, (t.name,))
+            return ADMIT
+        if t.overflow == "shed":
+            self.m_shed_in.inc(n_bytes, (t.name,))
+            return SHED
+        self.m_deferred.inc(1, (t.name,))
+        return DEFER
+
+    def stamped_defer_hint(self, name: str, n_bytes: int) -> float:
+        """:meth:`defer_hint` for a by-name (wire-stamped) tenant."""
+        t = self.tenant(name)
+        if t.bucket is None:
+            return 0.0
+        return t.bucket.delay_for(n_bytes)
+
     def resume_paused(self, inputs) -> None:
         """Un-pause inputs paused by quota DEFER once their tenant's
         bucket can admit an append the size of the one that deferred
@@ -367,13 +399,20 @@ class Qos:
         ``fluentbit_storage_quota_shed_bytes_total``).
 
         ``ins`` may be None (guard spill of an already-dispatched
-        chunk) — the chunk's stamped tenant resolves instead. Tenants
-        with no declared limit are never tracked, so the unconfigured
-        pipeline pays one attribute probe per append."""
-        if ins is not None:
+        chunk) — the chunk's stamped tenant resolves instead. A stamp
+        already on the chunk ALWAYS wins over the input's tenant: a
+        relayed chunk (forward fan-in) belongs to the edge tenant named
+        on the wire, not to the aggregator input that received it, so
+        its storage footprint lands on the right fleet-wide quota.
+        Tenants with no declared limit are never tracked, so the
+        unconfigured pipeline pays one attribute probe per append."""
+        stamped = getattr(chunk, "qos_tenant", None)
+        if stamped is not None:
+            t = self.tenant(stamped)
+        elif ins is not None:
             t = self.tenant_for_input(ins)
         else:
-            t = self.tenant(chunk.qos_tenant or DEFAULT_TENANT)
+            t = self.tenant(DEFAULT_TENANT)
         limit = t.storage_limit
         if limit is None or not self.enabled:
             return ADMIT

@@ -1,0 +1,260 @@
+"""fbtpu-relay — durable state for the fluent-forward fan-in hop.
+
+Two small persistent structures give the fbtpu→fbtpu network hop its
+effectively-once + partition-degrade semantics (FAULTS.md "fbtpu-relay"):
+
+- :class:`DedupLedger` — the aggregator side. The forward client stamps
+  every flush with a *stable* chunk-id (a content digest, so a resend of
+  the same chunk carries the same id); the ledger records each id the
+  FIRST time its chunk is absorbed into engine/flux state, persisted in
+  an fstore meta sidecar (the PR-4 S3 multipart ``{digest: staged-at}``
+  idempotency pattern) with a retry-window TTL. Ack-lost redelivery,
+  mid-backoff interleavings and post-crash-restart redelivery all hit
+  :meth:`seen` and are acked WITHOUT re-absorbing — the flux plane's
+  HLL/CMS sketches are not idempotent, so "absorbed ≤ once" is the
+  whole trust story for the shared analytical plane.
+
+- :class:`ForwardSpool` — the edge side. When every upstream aggregator
+  is down (a partition), the forward client degrades gracefully: the
+  already-packed entry stream is spooled to an fstore stream together
+  with a record-offset sidecar (core/sidecar.py), and on heal the spool
+  replays via ``mmap`` — the sidecar supplies the record count, so
+  replay never re-walks the msgpack payload. The spooled chunk keeps
+  its stable chunk-id in the meta sidecar: a replay that races a
+  pre-partition delivery dedups at the ledger like any other resend.
+
+Both structures keep their mutable state under a named ``make_lock``
+(core/lockorder.py) and are registered in the guarded-by registry
+(analysis/registry.py) — new callers that touch the maps off-lock fail
+the fbtpu-locksmith lint gate.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .fstore import FStore, FStoreFile
+from .lockorder import make_lock
+from .sidecar import SIDECAR_SUFFIX, SidecarWriter, read_sidecar
+
+__all__ = ["DedupLedger", "ForwardSpool", "stable_chunk_id"]
+
+
+def stable_chunk_id(tag: str, blob: bytes) -> str:
+    """The forward hop's stable chunk-id: a digest of (tag, entry
+    stream) — computed over the UNCOMPRESSED packed entries, so the id
+    survives compression settings, reconnects, backoff resends and even
+    an edge restart replaying the same storage chunk. Identity follows
+    the bytes, which is exactly what the dedup ledger needs."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(tag.encode("utf-8", "replace"))
+    h.update(b"\x00")
+    h.update(blob)
+    return h.hexdigest()[:32]
+
+
+class DedupLedger:
+    """Durable chunk-id ledger with a retry-window TTL.
+
+    ``meta`` layout (the fstore JSON sidecar)::
+
+        {"absorbed": {"<chunk-id>": [<absorbed-at>, <absorb-count>]}}
+
+    ``absorb-count`` exists for the soak contract: :meth:`record` is
+    called only when a chunk's records actually entered engine/flux
+    state, so a count above 1 IS a double-absorb — ``verify_contract``'s
+    "absorbed ≤ once" clause audits exactly this map. Entries expire
+    after ``ttl`` seconds (the retry window: a peer that still resends
+    after the window is misconfigured, and unbounded ledgers would leak).
+
+    ``root=None`` keeps the ledger in memory only (no storage path
+    configured): in-process redelivery still dedups, crash-restart
+    redelivery does not — the same durability the chunks themselves
+    have without filesystem storage.
+    """
+
+    STREAM = "forward-dedup"
+
+    def __init__(self, root: Optional[str], ttl: float = 300.0,
+                 clock=time.time):
+        self.ttl = float(ttl)
+        self.clock = clock
+        self._lock = make_lock("DedupLedger._lock")
+        self._file: Optional[FStoreFile] = None
+        self._seen: Dict[str, List[float]] = {}  # id -> [ts, count]
+        self.dedup_hits = 0
+        if root:
+            self._file = FStore(root).stream(self.STREAM).create("ledger")
+            now = self.clock()
+            absorbed = self._file.meta().get("absorbed") or {}
+            for cid, rec in absorbed.items():
+                try:
+                    ts, count = float(rec[0]), int(rec[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if now - ts <= self.ttl:
+                    self._seen[str(cid)] = [ts, count]
+
+    @staticmethod
+    def _gc(seen: Dict[str, List[float]], now: float,
+            ttl: float) -> None:
+        # callers pass the map while holding self._lock (the guarded-by
+        # registry keys on the attribute access, which stays lexically
+        # under the with)
+        if not seen:
+            return
+        dead = [cid for cid, rec in seen.items() if now - rec[0] > ttl]
+        for cid in dead:
+            del seen[cid]
+
+    def seen(self, chunk_id: str) -> bool:
+        """True when this chunk-id was absorbed inside the TTL window —
+        the caller acks WITHOUT absorbing (a redelivery)."""
+        now = self.clock()
+        with self._lock:
+            self._gc(self._seen, now, self.ttl)
+            hit = chunk_id in self._seen
+            if hit:
+                self.dedup_hits += 1
+        return hit
+
+    def record(self, chunk_id: str) -> None:
+        """Record one ABSORB of ``chunk_id`` and persist durably before
+        the caller acks: an ack whose absorb-record died with the
+        process would turn the next redelivery into a double-absorb."""
+        now = self.clock()
+        with self._lock:
+            self._gc(self._seen, now, self.ttl)
+            rec = self._seen.get(chunk_id)
+            if rec is None:
+                self._seen[chunk_id] = [now, 1]
+            else:
+                rec[1] += 1  # a double-absorb: kept visible, never hidden
+            snap = {cid: [rec[0], rec[1]]
+                    for cid, rec in self._seen.items()}
+        if self._file is not None:
+            self._file.set_meta({"absorbed": snap}, durable=True)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+    def snapshot(self) -> Dict[str, int]:
+        """chunk-id → absorb count (the health block / soak audit)."""
+        with self._lock:
+            return {cid: rec[1] for cid, rec in self._seen.items()}
+
+
+class ForwardSpool:
+    """Partition-time buffer for the forward client.
+
+    One spooled chunk = one fstore file holding the packed entry stream,
+    a ``.offs`` record-offset sidecar (core/sidecar.py) and a JSON meta
+    sidecar carrying the wire envelope (tag, stable chunk-id, record
+    count, tenant/priority stamps, the engine chunk id whose storage
+    quota charge the spool inherits). Files are named by a
+    monotonically increasing sequence so replay preserves spool order.
+    """
+
+    STREAM = "forward-spool"
+
+    def __init__(self, root: str):
+        self._stream = FStore(root).stream(self.STREAM)
+        self._lock = make_lock("ForwardSpool._lock")
+        seq = 0
+        for f in self._stream.files():
+            name = f.name.split(".", 1)[0]
+            if name.isdigit():
+                seq = max(seq, int(name) + 1)
+        self._seq = seq
+
+    def put(self, tag: str, blob: bytes, ends: List[int], meta: dict
+            ) -> FStoreFile:
+        """Spool one packed entry stream + its offset table + envelope.
+        The payload is flushed before the sidecars (the torn-file
+        contract replay already honors: either file may be ahead)."""
+        with self._lock:
+            name = "%012d" % self._seq
+            self._seq += 1
+        f = self._stream.create(name)
+        with open(f.path, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        w = SidecarWriter(f.path + SIDECAR_SUFFIX)
+        w.append_ends(len(blob), ends)
+        w.finalize()
+        f.set_meta(dict(meta, n=len(ends)), durable=True)
+        return f
+
+    def pending(self) -> List[FStoreFile]:
+        """Spooled chunks in replay (spool) order."""
+        return [f for f in self._stream.files()
+                if not f.name.endswith(SIDECAR_SUFFIX)]
+
+    def pending_bytes(self) -> int:
+        return sum(f.size for f in self.pending())
+
+    @staticmethod
+    def load(f: FStoreFile) -> Optional[Tuple[bytes, int, dict]]:
+        """mmap one spooled chunk for replay: ``(payload, n, meta)``.
+
+        The record count comes from the ``.offs`` sidecar table (no
+        msgpack re-walk) when it validates, else from the meta envelope;
+        a spool file with neither is dropped by the caller (it cannot
+        be framed). The payload is materialized only at the socket
+        write — the validation path stays on the mapping."""
+        meta = f.meta()
+        try:
+            with open(f.path, "rb") as fh:
+                size = os.fstat(fh.fileno()).st_size
+                if size == 0:
+                    return None
+                mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        except (OSError, ValueError):
+            return None
+        try:
+            n = None
+            got = read_sidecar(f.path + SIDECAR_SUFFIX, size)
+            if got is not None and got[1].size:
+                n = int(got[1].size)
+            if n is None:
+                n = int(meta.get("n") or 0)
+            if n <= 0:
+                return None
+            return bytes(mm), n, meta
+        finally:
+            mm.close()
+
+    @staticmethod
+    def drop(f: FStoreFile) -> None:
+        """Delete a delivered (acked) spool chunk + its sidecars."""
+        try:
+            os.unlink(f.path + SIDECAR_SUFFIX)
+        except OSError:
+            pass
+        f.delete()
+
+
+def load_ledger_counts(storage_root: str) -> Dict[str, int]:
+    """Parse a ledger meta sidecar back into ``{chunk-id: absorbs}`` —
+    the soak parent's audit input (no live process required)."""
+    path = os.path.join(storage_root, DedupLedger.STREAM, "ledger.meta")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            absorbed = json.load(fh).get("absorbed") or {}
+    except (OSError, ValueError):
+        return {}
+    out: Dict[str, int] = {}
+    for cid, rec in absorbed.items():
+        try:
+            out[str(cid)] = int(rec[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+    return out

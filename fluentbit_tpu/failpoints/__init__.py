@@ -114,6 +114,22 @@ SITES: Tuple[str, ...] = (
                                  # the atomic rename (crash → old file intact)
     "s3.upload_part",            # outputs_aws._mp_upload_part (RETRY repro site)
     "s3.complete",               # outputs_aws._mp_complete
+    "forward.handshake",         # out_forward._handshake, before HELO read — a
+                                 # return() here is an aggregator that accepts
+                                 # the dial but never completes auth
+    "forward.conn_reset",        # out_forward._send_chunk, before the frame
+                                 # write: connection torn mid-stream (RST shape)
+    "forward.partial_write",     # out_forward._send_chunk — partial(n) truncates
+                                 # the frame after n bytes then tears the
+                                 # connection: the receiver sees a torn msgpack
+                                 # tail it must discard without absorbing
+    "forward.dup_delivery",      # out_forward._send_chunk, after the ack: the
+                                 # SAME frame is written again (network dup /
+                                 # ambiguous-ack resend) — the aggregator's
+                                 # dedup ledger must absorb it zero times
+    "forward.ack_drop",          # in_forward._dispatch, absorb recorded, before
+                                 # the ack write: the classic lost-ack window —
+                                 # the edge resends, the ledger dedups
 )
 
 

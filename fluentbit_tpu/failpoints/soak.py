@@ -39,6 +39,23 @@ input 0 on a quota'd tenant (``t0``); pushes its token bucket defers
 return -1 and are deliberately NOT acked, so the contract audits that
 quota-deferral never loses an *admitted* record.
 
+fbtpu-relay extensions (FAULTS.md "fbtpu-relay"): two new child modes
+build a multi-process forward fan-in topology. ``aggregator`` runs a
+forward *input* + windowless flux filter + soak sink and, once its
+stop-file appears and the engine quiesces, dumps a deterministic
+``flux.json`` (rows sorted by group key; exact count / integer sums /
+min / max per column; HLL estimate + register digest per distinct
+column). ``edge`` runs lib inputs + an armored forward *output*
+(upstream HA file, require_ack_response, gzip, fstore spool) and pushes
+integer-valued records so flux sums are order-exact; it exits only
+when the engine is quiet AND the partition spool has fully replayed.
+``run_relay_scenario`` drives the tentpole proof: baseline (no faults)
+vs faulted (35%-class network faults on the edge, an ack-black-hole
+aggregator SIGKILLed mid-run, a partition healed by starting the
+surviving aggregator late) must produce byte-identical flux dumps, a
+dedup ledger with every chunk absorbed exactly once, and acked ⊆
+delivered — zero lost, zero double-absorbed.
+
 Used by ``tests/test_failpoints.py``: a short deterministic matrix in
 tier-1 and the full matrix behind the ``soak``/``slow`` markers.
 """
@@ -57,6 +74,17 @@ from . import FailpointError, fire
 DELIVERED_LOG = "delivered.log"
 INGESTED_LOG = "ingested.log"
 STORAGE_DIR = "storage"
+FLUX_DUMP = "flux.json"
+
+#: the edge fault cocktail for the relay tentpole: connect/ack/write
+#: faults well above the 35% floor the ISSUE demands, plus duplicate
+#: deliveries to prove the dedup ledger (percentages are per-site).
+DEFAULT_EDGE_FAULTS = (
+    "forward.conn_reset=35%return;"
+    "forward.partial_write=20%partial(40);"
+    "forward.dup_delivery=25%return;"
+    "forward.handshake=15%return"
+)
 
 
 def _append_line(path: str, text: str) -> None:
@@ -111,8 +139,15 @@ def child_main(argv: Optional[Sequence[str]] = None) -> int:
 
     ap = argparse.ArgumentParser(prog="fbtpu-soak-child")
     ap.add_argument("--workdir", required=True)
-    ap.add_argument("--mode", choices=("ingest", "recover"),
+    ap.add_argument("--mode",
+                    choices=("ingest", "recover", "aggregator", "edge"),
                     default="ingest")
+    ap.add_argument("--port", type=int, default=0,
+                    help="aggregator: forward-input listen port")
+    ap.add_argument("--upstream", default="",
+                    help="edge: upstream HA definition file")
+    ap.add_argument("--stop-file", default="",
+                    help="aggregator: run until this file exists")
     ap.add_argument("--records", type=int, default=20)
     ap.add_argument("--tags", type=int, default=1,
                     help="round-robin records over N tags (N chunks)")
@@ -137,6 +172,11 @@ def child_main(argv: Optional[Sequence[str]] = None) -> int:
     os.makedirs(args.workdir, exist_ok=True)
     delivered = os.path.join(args.workdir, DELIVERED_LOG)
     ingested = os.path.join(args.workdir, INGESTED_LOG)
+
+    if args.mode == "aggregator":
+        return _aggregator_main(flb, args, delivered)
+    if args.mode == "edge":
+        return _edge_main(flb, args, ingested)
 
     ctx = flb.create(flush=args.flush, grace="2", **{
         "storage.path": os.path.join(args.workdir, STORAGE_DIR),
@@ -209,18 +249,161 @@ def child_main(argv: Optional[Sequence[str]] = None) -> int:
     return 0
 
 
+# ----------------------------------------------------- relay children
+
+
+def _engine_quiet(e) -> bool:
+    return (not e._backlog and not e._task_map
+            and not e._pending_flushes and not e._pending_retries)
+
+
+def _flux_dump(state) -> dict:
+    """Render live flux state into a canonical, comparable form.
+
+    Everything in the dump is order-independent math (exact counts,
+    integer-valued sums, min/max, HLL register max-merges), so two runs
+    that absorbed the same record multiset — regardless of chunking,
+    resend interleaving or replay order — serialize byte-identically.
+    A double-absorb or a lost record perturbs count/sum/registers and
+    the comparison fails. Rows sort by group key; HLL registers are
+    reported as (estimate, sha256-of-registers) so the dump stays small
+    while still pinning every register bit.
+    """
+    import hashlib
+
+    import numpy as np
+
+    rows = {}
+    for key, g in state.live_groups():
+        k = "|".join(
+            x.decode("utf-8", "replace")
+            if isinstance(x, (bytes, bytearray)) else str(x)
+            for x in key)
+        cols = {}
+        for f, st in sorted(g.cols.items()):
+            cols[f] = [st.sum, st.min_value(), st.max_value()]
+        hlls = {}
+        for f, h in sorted(g.hlls.items()):
+            regs = np.asarray(h.registers)
+            hlls[f] = [float(h.estimate()),
+                       hashlib.sha256(regs.tobytes()).hexdigest()]
+        rows[k] = {"count": g.count, "cols": cols, "hlls": hlls}
+    return rows
+
+
+def _aggregator_main(flb, args, delivered: str) -> int:
+    """Forward fan-in aggregator: forward input → windowless flux →
+    soak sink. Runs until the stop-file appears, settles until the
+    engine is quiet, then dumps ``flux.json`` and exits."""
+    ctx = flb.create(flush=args.flush, grace="2", **{
+        "storage.path": os.path.join(args.workdir, STORAGE_DIR),
+        "storage.checksum": "on",
+        "scheduler.base": "0.05", "scheduler.cap": "0.1",
+    })
+    ctx.input("forward", listen="127.0.0.1", port=str(args.port),
+              shared_key="soak", **{"storage.type": "filesystem"})
+    # windowless flux: a running (never-closing) pane, so the dump is a
+    # pure function of the absorbed record multiset — no pane-boundary
+    # nondeterminism between the baseline and the faulted run
+    ctx.filter("flux", match="soak.*", group_by="k",
+               distinct_field="d", aggregate_field="v")
+    ctx.output("soak_sink", match="soak.*", path=delivered,
+               run_id=args.run_id)
+    ctx.start()
+    try:
+        while args.stop_file and not os.path.exists(args.stop_file):
+            time.sleep(0.05)
+        deadline = time.time() + args.settle
+        e = ctx.engine
+        while time.time() < deadline:
+            if _engine_quiet(e):
+                break
+            time.sleep(0.05)
+        flux = next(f.plugin for f in e.filters
+                    if f.plugin.name == "flux")
+        dump = json.dumps(_flux_dump(flux.state), sort_keys=True,
+                          separators=(",", ":"))
+        _append_line(os.path.join(args.workdir, FLUX_DUMP), dump)
+    finally:
+        ctx.stop()
+    return 0
+
+
+def _edge_main(flb, args, ingested: str) -> int:
+    """Edge relay: lib inputs → armored forward output (upstream HA,
+    ack-verified, gzip-compressed, fstore spool for partitions).
+
+    Record values are INTEGERS so the aggregator's float64 column sums
+    are exact and therefore order-independent — the property the
+    bit-identical flux comparison rests on. Acks a seq into
+    ``ingested.log`` only after the push was admitted; exits only when
+    the engine is quiet AND the partition spool has drained.
+    """
+    ctx = flb.create(flush=args.flush, grace="2", **{
+        "storage.path": os.path.join(args.workdir, STORAGE_DIR),
+        "storage.checksum": "on",
+        "scheduler.base": "0.05", "scheduler.cap": "0.2",
+    })
+    in_ffd = [ctx.input("lib", tag=f"soak.{i}",
+                        **{"storage.type": "filesystem"})
+              for i in range(max(1, args.tags))]
+    ctx.output("forward", match="soak.*", upstream=args.upstream,
+               shared_key="soak", require_ack_response="true",
+               ack_timeout="1", compress="gzip",
+               storage_spool=os.path.join(args.workdir, "spool"))
+    ctx.start()
+    try:
+        for seq in range(args.records):
+            ffd = in_ffd[seq % len(in_ffd)]
+            got = ctx.push(ffd, json.dumps({
+                "seq": seq,
+                "k": "g%d" % (seq % 3),
+                "d": "u%d" % (seq % 7),
+                "v": (seq * 7) % 101,
+            }))
+            if got:
+                _append_line(ingested, str(seq))
+        ctx.flush_now()
+        fwd = next(o.plugin for o in ctx.engine.outputs
+                   if o.plugin.name == "forward")
+        deadline = time.time() + args.settle
+        e = ctx.engine
+        drained = False
+        while time.time() < deadline:
+            spool = getattr(fwd, "_spool", None)
+            if _engine_quiet(e) and (spool is None
+                                     or not spool.pending()):
+                drained = True
+                break
+            time.sleep(0.05)
+        if not drained:
+            # a silent exit-0 here would let the parent read "all
+            # delivered" off a still-loaded spool — fail loudly instead
+            spool = getattr(fwd, "_spool", None)
+            print("edge drain deadline: engine_quiet=%s spool=%d"
+                  % (_engine_quiet(e),
+                     len(spool.pending()) if spool else 0),
+                  file=sys.stderr)
+            return 3
+    finally:
+        ctx.stop()
+    return 0
+
+
 # ---------------------------------------------------------------- parent
 
 
 class SoakOutcome:
     """What one scenario produced, parsed back from the soak logs."""
 
-    def __init__(self, workdir: str):
+    def __init__(self, workdir: str, ingested_from: Optional[str] = None):
         self.workdir = workdir
         self.acked: List[int] = []
         self.deliveries: Dict[str, List[int]] = {}  # run id → seqs
         self.exit_codes: List[int] = []
-        ing = os.path.join(workdir, INGESTED_LOG)
+        # relay topology: acks live in the EDGE workdir, deliveries in
+        # the aggregator's — ingested_from points at the former
+        ing = ingested_from or os.path.join(workdir, INGESTED_LOG)
         if os.path.exists(ing):
             with open(ing, encoding="utf-8") as f:
                 self.acked = [int(s) for s in f.read().split()]
@@ -253,15 +436,13 @@ class SoakOutcome:
         return sorted(out)
 
 
-def run_child(workdir: str, mode: str, *, failpoints: str = "",
-              seed: int = 0, records: int = 20, tags: int = 1,
-              flush: str = "200ms", run_id: str = "0",
-              final_flush: bool = False, settle: float = 2.0,
-              reloads: int = 0, flood_rate: str = "",
-              timeout: float = 60.0) -> int:
-    """Spawn one child run; returns its exit code (negative = signal,
-    matching ``subprocess`` convention — a crash failpoint shows up as
-    ``-SIGKILL``)."""
+def _child_invocation(workdir: str, mode: str, *, failpoints: str,
+                      seed: int, records: int, tags: int, flush: str,
+                      run_id: str, final_flush: bool, settle: float,
+                      reloads: int, flood_rate: str, port: int,
+                      upstream: str, stop_file: str):
+    """(cmd, env, cwd) for one soak child — shared by the blocking
+    ``run_child`` and the concurrent ``spawn_child``."""
     env = dict(os.environ)
     env["FBTPU_FAILPOINTS"] = failpoints
     env["FBTPU_FAILPOINTS_SEED"] = str(seed)
@@ -277,10 +458,35 @@ def run_child(workdir: str, mode: str, *, failpoints: str = "",
         cmd += ["--flood-rate", flood_rate]
     if final_flush:
         cmd.append("--final-flush")
+    if port:
+        cmd += ["--port", str(port)]
+    if upstream:
+        cmd += ["--upstream", upstream]
+    if stop_file:
+        cmd += ["--stop-file", stop_file]
+    cwd = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return cmd, env, cwd
+
+
+def run_child(workdir: str, mode: str, *, failpoints: str = "",
+              seed: int = 0, records: int = 20, tags: int = 1,
+              flush: str = "200ms", run_id: str = "0",
+              final_flush: bool = False, settle: float = 2.0,
+              reloads: int = 0, flood_rate: str = "",
+              port: int = 0, upstream: str = "", stop_file: str = "",
+              timeout: float = 60.0) -> int:
+    """Spawn one child run; returns its exit code (negative = signal,
+    matching ``subprocess`` convention — a crash failpoint shows up as
+    ``-SIGKILL``)."""
+    cmd, env, cwd = _child_invocation(
+        workdir, mode, failpoints=failpoints, seed=seed,
+        records=records, tags=tags, flush=flush, run_id=run_id,
+        final_flush=final_flush, settle=settle, reloads=reloads,
+        flood_rate=flood_rate, port=port, upstream=upstream,
+        stop_file=stop_file)
     proc = subprocess.run(cmd, env=env, timeout=timeout,
-                          capture_output=True, text=True,
-                          cwd=os.path.dirname(os.path.dirname(
-                              os.path.dirname(os.path.abspath(__file__)))))
+                          capture_output=True, text=True, cwd=cwd)
     if proc.returncode not in (0, -9, 137):
         raise RuntimeError(
             f"soak child ({mode}) exited {proc.returncode}:\n"
@@ -288,17 +494,48 @@ def run_child(workdir: str, mode: str, *, failpoints: str = "",
     return proc.returncode
 
 
+def spawn_child(workdir: str, mode: str, *, failpoints: str = "",
+                seed: int = 0, records: int = 20, tags: int = 1,
+                flush: str = "200ms", run_id: str = "0",
+                settle: float = 2.0, port: int = 0, upstream: str = "",
+                stop_file: str = "") -> "subprocess.Popen":
+    """Start one soak child WITHOUT waiting — the relay topology runs
+    aggregators and the edge concurrently. stdout/stderr land in
+    ``<workdir>/child.log`` for post-mortems."""
+    cmd, env, cwd = _child_invocation(
+        workdir, mode, failpoints=failpoints, seed=seed,
+        records=records, tags=tags, flush=flush, run_id=run_id,
+        final_flush=False, settle=settle, reloads=0, flood_rate="",
+        port=port, upstream=upstream, stop_file=stop_file)
+    os.makedirs(workdir, exist_ok=True)
+    logf = open(os.path.join(workdir, "child.log"), "ab")
+    try:
+        return subprocess.Popen(cmd, env=env, cwd=cwd, stdout=logf,
+                                stderr=subprocess.STDOUT)
+    finally:
+        logf.close()  # the child holds its own fd after fork
+
+
 def verify_contract(outcome: SoakOutcome, *, restarts: int,
                     allowed_missing: Sequence[int] = (),
                     quarantined: Sequence[int] = (),
-                    declared_retries: int = 0) -> None:
+                    declared_retries: int = 0,
+                    absorbed: Optional[Dict[str, int]] = None) -> None:
     """Assert the durability contract over a finished scenario.
 
     ``allowed_missing``: seqs the scenario declares lossy (the torn /
     unflushed final write). ``quarantined``: seqs whose chunk the
     harness corrupted on disk — they must NOT be delivered and their
-    chunk must be in the DLQ.
+    chunk must be in the DLQ. ``absorbed``: a dedup-ledger audit map
+    (chunk-id → absorb count, from ``relay.load_ledger_counts``) —
+    effectively-once means every count is exactly 1: the ledger only
+    records ABSORBS, so any count above 1 is a double-absorb into the
+    non-idempotent flux sketch plane.
     """
+    if absorbed is not None:
+        over_abs = {cid: c for cid, c in absorbed.items() if c > 1}
+        assert not over_abs, (
+            f"chunks absorbed more than once (ledger audit): {over_abs}")
     delivered = outcome.delivered_all()
     got = set(delivered)
     acked = set(outcome.acked)
@@ -331,6 +568,176 @@ def verify_contract(outcome: SoakOutcome, *, restarts: int,
             assert in_runs >= 2, (
                 f"seq {s} duplicated within a single run with no "
                 f"declared retries")
+
+
+# ----------------------------------------------------- relay scenario
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(port: int, timeout: float = 15.0) -> bool:
+    """Poll until a listener accepts on 127.0.0.1:port."""
+    import socket
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.5).close()
+            return True
+        except OSError:
+            time.sleep(0.05)
+    return False
+
+
+def _write_upstream(path: str, ports: Sequence[int]) -> None:
+    lines = ["[UPSTREAM]", "    name relay-soak", ""]
+    for i, p in enumerate(ports):
+        lines += ["[NODE]", f"    name agg{i}", "    host 127.0.0.1",
+                  f"    port {p}", ""]
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines))
+
+
+def _read_flux_dump(workdir: str) -> bytes:
+    with open(os.path.join(workdir, FLUX_DUMP), "rb") as f:
+        return f.read()
+
+
+def run_relay_scenario(workdir: str, *, records: int = 60,
+                       tags: int = 2, seed: int = 1,
+                       edge_faults: str = DEFAULT_EDGE_FAULTS,
+                       settle: float = 30.0,
+                       partition_secs: float = 1.5) -> dict:
+    """The fbtpu-relay tentpole proof (FAULTS.md "fbtpu-relay").
+
+    Baseline: one aggregator, no faults — dump flux.json. Faulted: the
+    edge fans over TWO upstreams; aggregator A is an ack black hole
+    (``forward.ack_drop=return`` at 100%: it absorbs every chunk into
+    its own engine but never acks, so the edge must treat every send as
+    lost) and is SIGKILLed mid-run — its absorbs die with it; B does
+    not exist yet (a full partition: the edge degrades to the fstore
+    spool). B starts ``partition_secs`` later (the heal) and the spool
+    replays — under connect resets, torn writes, duplicate deliveries
+    and handshake faults on every edge socket.
+
+    Asserts the whole contract: edge exits clean (spool drained), B's
+    flux dump is byte-identical to the baseline's, B's dedup ledger
+    shows every chunk absorbed exactly once, and acked ⊆ delivered
+    with no sequence delivered twice. Returns the artifacts for
+    further inspection.
+    """
+    from ..core.relay import load_ledger_counts
+
+    os.makedirs(workdir, exist_ok=True)
+
+    # ---- baseline: single aggregator, fault-free
+    base_agg = os.path.join(workdir, "base-agg")
+    base_edge = os.path.join(workdir, "base-edge")
+    os.makedirs(base_agg, exist_ok=True)
+    os.makedirs(base_edge, exist_ok=True)
+    p0 = _free_port()
+    stop0 = os.path.join(base_agg, "stop")
+    up0 = os.path.join(base_edge, "upstream.conf")
+    _write_upstream(up0, [p0])
+    agg0 = spawn_child(base_agg, "aggregator", port=p0,
+                       stop_file=stop0, run_id="base", settle=settle)
+    try:
+        assert _wait_port(p0), "baseline aggregator never listened"
+        rc = run_child(base_edge, "edge", upstream=up0,
+                       records=records, tags=tags, run_id="base",
+                       settle=settle, timeout=settle + 60)
+        assert rc == 0, f"baseline edge exited {rc}"
+    finally:
+        _append_line(stop0, "stop")
+        try:
+            agg0.wait(timeout=settle + 30)
+        except subprocess.TimeoutExpired:
+            agg0.kill()
+            raise
+    assert agg0.returncode == 0, \
+        f"baseline aggregator exited {agg0.returncode}"
+    baseline = _read_flux_dump(base_agg)
+
+    # ---- faulted: black-hole A (SIGKILLed), late B, armored edge
+    f_agg_a = os.path.join(workdir, "fault-agg-a")
+    f_agg_b = os.path.join(workdir, "fault-agg-b")
+    f_edge = os.path.join(workdir, "fault-edge")
+    for d in (f_agg_a, f_agg_b, f_edge):
+        os.makedirs(d, exist_ok=True)
+    pa, pb = _free_port(), _free_port()
+    stop_b = os.path.join(f_agg_b, "stop")
+    up1 = os.path.join(f_edge, "upstream.conf")
+    _write_upstream(up1, [pa, pb])
+    agg_a = spawn_child(f_agg_a, "aggregator", port=pa,
+                        stop_file=os.path.join(f_agg_a, "stop"),
+                        failpoints="forward.ack_drop=return",
+                        seed=seed, run_id="fault", settle=1.0)
+    agg_b = None
+    edge = None
+    try:
+        assert _wait_port(pa), "black-hole aggregator never listened"
+        # the faulted edge gets extra drain allowance: the partition
+        # spool replays through breaker cooldowns and armed fault sites
+        edge = spawn_child(f_edge, "edge", upstream=up1,
+                           records=records, tags=tags,
+                           failpoints=edge_faults, seed=seed,
+                           run_id="fault", settle=settle + 30)
+        # let the edge burn acks against A, then hard-kill it: every
+        # chunk A absorbed dies unacked — the edge must redeliver all
+        # of them to B without double-absorbing any
+        time.sleep(partition_secs)
+        agg_a.kill()
+        agg_a.wait(timeout=30)
+        # the heal: B appears; the edge's breaker probes find it and
+        # the partition spool replays in order
+        agg_b = spawn_child(f_agg_b, "aggregator", port=pb,
+                            stop_file=stop_b, run_id="fault",
+                            settle=settle)
+        assert _wait_port(pb), "surviving aggregator never listened"
+        rc = edge.wait(timeout=settle + 120)
+        assert rc == 0, (
+            f"faulted edge exited {rc} — see {f_edge}/child.log")
+        edge = None
+    finally:
+        if edge is not None:
+            edge.kill()
+        if agg_a.returncode is None:
+            agg_a.kill()
+        if agg_b is not None:
+            _append_line(stop_b, "stop")
+            try:
+                agg_b.wait(timeout=settle + 60)
+            except subprocess.TimeoutExpired:
+                agg_b.kill()
+                raise
+    assert agg_b.returncode == 0, \
+        f"surviving aggregator exited {agg_b.returncode}"
+    faulted = _read_flux_dump(f_agg_b)
+
+    # ---- the contract
+    assert faulted == baseline, (
+        "flux state diverged under faults:\n"
+        f"  baseline: {baseline.decode()}\n"
+        f"  faulted:  {faulted.decode()}")
+    ledger = load_ledger_counts(os.path.join(f_agg_b, STORAGE_DIR))
+    assert ledger, "surviving aggregator's dedup ledger is empty"
+    outcome = SoakOutcome(
+        f_agg_b,
+        ingested_from=os.path.join(f_edge, INGESTED_LOG))
+    verify_contract(outcome, restarts=0, absorbed=ledger)
+    assert len(set(outcome.acked)) == records, (
+        f"edge admitted {len(set(outcome.acked))}/{records} records")
+    return {"baseline": baseline, "faulted": faulted,
+            "ledger": ledger, "outcome": outcome}
 
 
 if __name__ == "__main__":  # pragma: no cover - exercised via subprocess

@@ -14,6 +14,43 @@ Wire formats accepted by the server:
 When ``option.chunk`` is present the server replies ``{"ack": chunk}``
 (at-least-once). The client sends PackedForward, optionally gzip'd,
 with ``require_ack_response`` waiting for the matching ack.
+
+fbtpu-relay hardening (FAULTS.md "fbtpu-relay") on top of the base
+protocol:
+
+- **Effectively-once absorption.** The client's chunk-id is a CONTENT
+  digest (core/relay.stable_chunk_id) — stable across reconnect
+  resends, backoff interleavings, and post-crash storage replays of
+  the same chunk. The server keeps a durable
+  :class:`~..core.relay.DedupLedger`: a redelivered id inside the
+  retry window is acked WITHOUT re-absorbing, so the aggregator's flux
+  sketches (duplicate-sensitive counts/sums) see every edge chunk at
+  most once. The ledger record is persisted BEFORE the ack leaves —
+  the lost-ack window (``forward.ack_drop``) can only ever produce a
+  dedup hit, never a double-absorb. The one deliberate trade: two
+  legitimately byte-identical (tag, entries) chunks inside the TTL
+  dedup to one absorb — with real per-record timestamps in the stream
+  that requires a digest collision in practice, and it is the price of
+  ids that survive an edge crash (a random id would not).
+
+- **Wire QoS stamps.** The client copies the flushed chunk's
+  tenant/priority (core/plugin.FLUSH_CHUNK) into the option map; the
+  server restores them onto the aggregator-side chunk (ChunkPool.stamp)
+  and meters the REMOTE tenant's token bucket (qos.admit_stamped), so
+  per-tenant quotas and QoS classes hold fleet-wide across the hop.
+
+- **Backpressure instead of blind acks.** A DEFER verdict (tenant
+  over quota, or local buffer pressure) delays the ack up to
+  ``defer_ack_window``; exhausted, the ack is WITHHELD — the peer's
+  ack timeout turns into RETRY + backoff, pausing the stream without
+  losing a byte (resends dedup at the ledger).
+
+- **Armored client.** Per-upstream circuit breakers (core/guard.py,
+  visible in /api/v1/health), UpstreamHA failover mid-stream, full-
+  jitter backoff between attempts; when EVERY upstream refuses (a
+  partition), the already-packed entry stream degrades to an fstore
+  spool under the tenant's storage quota and replays via the mmap +
+  offset-sidecar path on heal, carrying the SAME chunk-id.
 """
 
 from __future__ import annotations
@@ -24,16 +61,28 @@ import hashlib
 import logging
 import os
 import socket
+import time
+from types import SimpleNamespace
 from typing import Optional
 
 from ..codec.events import encode_event
 from ..codec.msgpack import EventTime, OutOfData, Unpacker, packb
 from ..core.config import ConfigMapEntry
 from ..core.guard import io_deadline
-from ..core.plugin import FlushResult, InputPlugin, OutputPlugin, registry
+from ..core.plugin import FLUSH_CHUNK, FlushResult, InputPlugin, \
+    OutputPlugin, registry
+from ..core.relay import DedupLedger, ForwardSpool, stable_chunk_id
+from ..core.scheduler import backoff_full_jitter
 from ..core.upstream import close_quietly
+from .. import failpoints as _fp
 
 log = logging.getLogger("flb.forward")
+
+#: wire-stamp hygiene: the tenant name is attacker-adjacent input
+#: (any peer with the shared key can send one) — bound it before it
+#: becomes a metric label / quota bucket key
+_TENANT_MAX_LEN = 128
+_PRIORITY_MAX = 7
 
 
 def _entries_to_events(entries) -> tuple:
@@ -51,6 +100,24 @@ def _entries_to_events(entries) -> tuple:
     return bytes(out), n
 
 
+def _wire_stamp(option) -> tuple:
+    """(tenant, priority) from a forward option map, validated: the
+    stamp crosses a trust boundary, so an oversized/typed-wrong value
+    degrades to unstamped rather than poisoning quota keys."""
+    if not isinstance(option, dict):
+        return None, None
+    tenant = option.get("tenant")
+    if not isinstance(tenant, str) or not tenant \
+            or len(tenant) > _TENANT_MAX_LEN:
+        tenant = None
+    priority = option.get("priority")
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        priority = None
+    else:
+        priority = min(max(priority, 0), _PRIORITY_MAX)
+    return tenant, priority
+
+
 @registry.register
 class ForwardInput(InputPlugin):
     name = "forward"
@@ -62,10 +129,47 @@ class ForwardInput(InputPlugin):
         ConfigMapEntry("shared_key", "str"),
         ConfigMapEntry("self_hostname", "str", default="fluentbit-tpu"),
         ConfigMapEntry("tag_prefix", "str"),
+        ConfigMapEntry("dedup", "bool", default=True,
+                       desc="effectively-once absorption: dedup "
+                            "redelivered chunk ids against the durable "
+                            "ledger before they reach engine/flux state"),
+        ConfigMapEntry("dedup_ttl", "time", default="300",
+                       desc="retry window: how long an absorbed "
+                            "chunk-id stays in the dedup ledger"),
+        ConfigMapEntry("defer_ack_window", "time", default="5",
+                       desc="max time an ack is delayed while the "
+                            "append defers (tenant quota / buffer "
+                            "pressure); exhausted, the ack is withheld "
+                            "and the peer's own timeout backpressures"),
     ]
 
     def init(self, instance, engine) -> None:
         self.bound_port: Optional[int] = None
+        self._ledger: Optional[DedupLedger] = None
+        if self.dedup:
+            root = getattr(engine.service, "storage_path", None)
+            self._ledger = DedupLedger(root, ttl=self.dedup_ttl)
+        # plain ints mirror the exported counters for /api/v1/health
+        # (the metrics registry has no read-back API)
+        self.n_absorbed = 0
+        self.n_deferred_acks = 0
+        self.n_withheld_acks = 0
+        self.n_shed_remote = 0
+        m = engine.metrics
+        self._m_dedup = m.counter(
+            "fluentbit", "forward", "dedup_hits_total",
+            "Redelivered chunk ids absorbed zero times (acked from "
+            "the dedup ledger)", ("instance",))
+        self._m_absorbed = m.counter(
+            "fluentbit", "forward", "absorbed_chunks_total",
+            "Forward chunks absorbed into engine state", ("instance",))
+        self._m_deferred = m.counter(
+            "fluentbit", "forward", "deferred_acks_total",
+            "Acks delayed by quota/buffer backpressure", ("instance",))
+        self._m_withheld = m.counter(
+            "fluentbit", "forward", "withheld_acks_total",
+            "Acks withheld after the defer window (peer retries)",
+            ("instance",))
 
     async def start_server(self, engine) -> None:
         async def handle(reader, writer):
@@ -156,12 +260,131 @@ class ForwardInput(InputPlugin):
                 return
             option = msg[3] if len(msg) > 3 and isinstance(msg[3], dict) else None
             buf, n = _entries_to_events([[msg[1], msg[2]]])
+        ack_ref = option.get("chunk") if option else None
+        cid = self._chunk_key(ack_ref)
         if n:
-            engine.input_log_append(self.instance, tag, buf, n)
-        chunk_id = option.get("chunk") if option else None
-        if chunk_id is not None:
-            writer.write(packb({"ack": chunk_id}))
+            if cid is not None and self._ledger is not None \
+                    and self._ledger.seen(cid):
+                # redelivery inside the retry window: lost ack,
+                # ambiguous-ack resend, or post-crash replay — acked,
+                # absorbed zero times
+                self._m_dedup.inc(1, (self.instance.display_name,))
+            else:
+                tenant, priority = _wire_stamp(option)
+                absorbed = await self._absorb(engine, tag, buf, n,
+                                              tenant, priority, cid)
+                if not absorbed:
+                    # backpressure: NO ack — the peer's ack timeout
+                    # turns into RETRY+backoff, pausing the stream;
+                    # the resend dedups if a later pass absorbed it
+                    self.n_withheld_acks += 1
+                    self._m_withheld.inc(
+                        1, (self.instance.display_name,))
+                    return
+        if ack_ref is not None:
+            if _fp.ACTIVE:
+                try:
+                    # absorb recorded, ack not yet written: the classic
+                    # lost-ack window — the edge resends, the ledger
+                    # dedups (connection stays up: a dropped ack is not
+                    # a dropped link)
+                    _fp.fire("forward.ack_drop")
+                except _fp.FailpointError:
+                    return
+            writer.write(packb({"ack": ack_ref}))
             await writer.drain()
+
+    @staticmethod
+    def _chunk_key(ack_ref) -> Optional[str]:
+        """Ledger key for a wire ``chunk`` option (str or bytes)."""
+        if ack_ref is None:
+            return None
+        if isinstance(ack_ref, (bytes, memoryview)):
+            return bytes(ack_ref).decode("latin-1")
+        return str(ack_ref)
+
+    async def _absorb(self, engine, tag: str, buf: bytes, n: int,
+                      tenant, priority, cid: Optional[str]) -> bool:
+        """Absorb one decoded chunk into engine state effectively once.
+
+        Meters the wire-stamped tenant (fleet-wide quota), stamps the
+        aggregator-side chunk, and converts DEFER verdicts into delayed
+        acks bounded by ``defer_ack_window``. Returns False when the
+        window exhausts — the caller withholds the ack entirely.
+        """
+        ins = self.instance
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.defer_ack_window
+        led = self._ledger if cid is not None else None
+        deferred = False
+        while True:
+            if led is not None and led.seen(cid):
+                # a concurrent delivery of the same chunk absorbed it
+                # while this one slept in the defer loop — just ack
+                self._m_dedup.inc(1, (ins.display_name,))
+                return True
+            rc = None
+            if tenant is not None:
+                verdict = engine.qos.admit_stamped(tenant, len(buf))
+                if verdict == 2:  # SHED: consumed by the tenant's
+                    # declared overflow policy — acked, not absorbed
+                    # (the edge must not resend policy-shed bytes)
+                    self.n_shed_remote += 1
+                    return True
+                if verdict == 1:  # DEFER
+                    rc = -1
+            if rc is None:
+                stamped = tenant is not None
+                if stamped:
+                    # the stamp joins the pool key and lands on the
+                    # chunk; qos_exempt skips the LOCAL tenant's bucket
+                    # (the remote tenant was already metered above) —
+                    # input_log_append is synchronous, so no other
+                    # dispatch interleaves while these are set
+                    ins.pool.stamp = (tenant, priority)
+                    ins.qos_exempt = True
+                try:
+                    rc = engine.input_log_append(ins, tag, buf, n)
+                finally:
+                    if stamped:
+                        ins.pool.stamp = None
+                        ins.qos_exempt = False
+            if rc >= 0:
+                if led is not None:
+                    # durable BEFORE the ack leaves: an ack whose
+                    # absorb-record died with the process would turn
+                    # the peer's next resend into a double-absorb
+                    led.record(cid)
+                self.n_absorbed += 1
+                self._m_absorbed.inc(1, (ins.display_name,))
+                return True
+            # rc == -1: backpressure (remote-tenant DEFER or local
+            # buffer/quota pause) — delay the ack and retry
+            if not deferred:
+                deferred = True
+                self.n_deferred_acks += 1
+                self._m_deferred.inc(1, (ins.display_name,))
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False
+            if tenant is not None:
+                hint = engine.qos.stamped_defer_hint(tenant, len(buf))
+            else:
+                hint = 0.05
+            await asyncio.sleep(min(max(hint, 0.02), 0.25, remaining))
+
+    def health_block(self) -> dict:
+        out = {
+            "role": "server",
+            "absorbed": self.n_absorbed,
+            "deferred_acks": self.n_deferred_acks,
+            "withheld_acks": self.n_withheld_acks,
+            "shed_remote": self.n_shed_remote,
+        }
+        if self._ledger is not None:
+            out["dedup_hits"] = self._ledger.dedup_hits
+            out["dedup_entries"] = self._ledger.size()
+        return out
 
 
 @registry.register
@@ -180,9 +403,16 @@ class ForwardOutput(OutputPlugin):
         ConfigMapEntry("upstream", "str",
                        desc="upstream HA definition file: weighted "
                             "[NODE] sections with failover"),
+        ConfigMapEntry("storage_spool", "str",
+                       desc="partition-degrade spool directory: when "
+                            "every upstream refuses, packed chunks "
+                            "buffer here (under the tenant storage "
+                            "quota) and replay on heal via the mmap + "
+                            "offset-sidecar path"),
     ]
 
     def init(self, instance, engine) -> None:
+        self._engine = engine
         self._reader = None
         self._writer = None
         # one connection per output instance: concurrent flush coroutines
@@ -191,10 +421,73 @@ class ForwardOutput(OutputPlugin):
         # upstream HA (flb_upstream_ha.c): weighted nodes + failover
         self._ha = None
         self._node = None
+        self._cur_breaker = None
+        self._cur_target = None
         if self.upstream:
             from ..core.upstream import parse_upstream_file
 
             self._ha = parse_upstream_file(self.upstream)
+        self._spool: Optional[ForwardSpool] = None
+        if self.storage_spool:
+            self._spool = ForwardSpool(self.storage_spool)
+        self._replay_task = None
+        self._replay_failures = 0
+        self._quota_seq = 0
+        # ids already sent once (bounded): a re-entry means the engine
+        # is retrying a chunk the wire already saw — a RESEND, counted
+        # distinctly from first sends so dashboards can tell loss-driven
+        # retries from volume
+        self._sent_ids: dict = {}
+        self._ack_rtts: list = []
+        self.n_acks_waited = 0
+        self.n_acks_lost = 0
+        self.n_resends = 0
+        self.n_spooled = 0
+        self.n_replayed = 0
+        self._iname = instance.display_name
+        m = engine.metrics
+        self._m_waited = m.counter(
+            "fluentbit", "forward", "acks_waited_total",
+            "Forward flushes that waited for a chunk ack", ("instance",))
+        self._m_lost = m.counter(
+            "fluentbit", "forward", "acks_lost_total",
+            "Acks that timed out or mismatched (flush retried)",
+            ("instance",))
+        self._m_resends = m.counter(
+            "fluentbit", "forward", "resends_total",
+            "Chunks re-sent with an already-used chunk id", ("instance",))
+        self._m_spooled = m.counter(
+            "fluentbit", "forward", "spooled_chunks_total",
+            "Chunks degraded to the partition spool", ("instance",))
+        self._m_replayed = m.counter(
+            "fluentbit", "forward", "replayed_chunks_total",
+            "Spooled chunks replayed and acked after heal", ("instance",))
+        self._m_rtt = m.histogram(
+            "fluentbit", "forward", "ack_rtt_seconds",
+            "Send → ack round-trip per chunk", ("instance",))
+        self._m_breaker = m.gauge(
+            "fluentbit", "forward", "breaker_state",
+            "Per-upstream breaker state (0 closed / 1 half-open / "
+            "2 open)", ("upstream",))
+
+    def exit(self) -> None:
+        if self._replay_task is not None:
+            try:
+                self._replay_task.cancel()
+            except RuntimeError:
+                pass  # loop already closed at engine teardown
+            self._replay_task = None
+        if self._writer is not None:
+            close_quietly(self._writer)
+            self._reader = self._writer = None
+
+    # -- connection -----------------------------------------------------
+
+    def _breaker_for(self, host: str, port: int):
+        name = f"forward:{host}:{port}"
+        br = self._engine.guard.breaker(name)
+        self._m_breaker.set(br.state_code(), (name,))
+        return br
 
     async def _connect(self):
         if self._writer is not None and not self._writer.is_closing():
@@ -202,23 +495,53 @@ class ForwardOutput(OutputPlugin):
         from ..core.tls import open_connection
 
         host, port = self.host, self.port
+        self._node = None
         if self._ha is not None:
             self._node = self._ha.pick()
             host, port = self._node.host, self._node.port
-        try:
-            self._reader, self._writer = await open_connection(
-                self.instance, host, port, timeout=10
-            )
-        except (OSError, asyncio.TimeoutError):
-            if self._ha is not None and self._node is not None:
-                self._ha.mark_down(self._node)
-            raise
-        if self._ha is not None and self._node is not None:
-            self._ha.mark_up(self._node)
+        self._cur_target = f"forward:{host}:{port}"
+        brk = self._breaker_for(host, port)
+        self._cur_breaker = brk
+        if not brk.allow():
+            # a breaker refusal is not fresh evidence of failure —
+            # don't let the error path re-arm the cooldown forever
+            self._cur_breaker = None
+            raise ConnectionError(
+                f"forward: breaker open for {host}:{port}")
+        self._reader, self._writer = await open_connection(
+            self.instance, host, port, timeout=10
+        )
         if self.shared_key:
             await self._handshake()
 
+    def _conn_failed(self) -> None:
+        """Error-path bookkeeping: tear the socket, mark the node down
+        (HA failover on the next pick), record breaker evidence."""
+        if self._writer is not None:
+            close_quietly(self._writer)
+        self._reader = self._writer = None
+        if self._ha is not None and self._node is not None:
+            self._ha.mark_down(self._node)
+        if self._cur_breaker is not None:
+            self._cur_breaker.record_failure()
+            self._m_breaker.set(self._cur_breaker.state_code(),
+                                (self._cur_target,))
+            self._cur_breaker = None
+
+    def _conn_ok(self) -> None:
+        if self._ha is not None and self._node is not None:
+            self._ha.mark_up(self._node)
+        if self._cur_breaker is not None:
+            self._cur_breaker.record_ok()
+            self._m_breaker.set(self._cur_breaker.state_code(),
+                                (self._cur_target,))
+            self._cur_breaker = None
+
     async def _handshake(self) -> None:
+        if _fp.ACTIVE:
+            # an aggregator that accepts the dial but never finishes
+            # auth — the failure shape of a half-up peer
+            _fp.fire("forward.handshake")
         u = Unpacker()
         helo = await self._read_msg(u)
         if not (isinstance(helo, list) and helo and helo[0] == "HELO"):
@@ -247,12 +570,19 @@ class ForwardOutput(OutputPlugin):
                     raise ConnectionError("forward: peer closed")
                 u.feed(data)
 
+    # -- framing --------------------------------------------------------
+
     def _packed_entries(self, data: bytes) -> tuple:
-        """V2 events buffer → forward-format entry stream + count."""
+        """V2 events buffer → (entry stream, count, record END offsets).
+
+        The END offsets feed the spool's record-offset sidecar
+        (core/sidecar.py) so a partition-degraded chunk replays without
+        re-walking its msgpack payload."""
         from ..codec.events import iter_events
 
         out = bytearray()
         n = 0
+        ends = []
         for ev in iter_events(data):
             ts = ev.timestamp
             if self.time_as_integer:
@@ -260,50 +590,259 @@ class ForwardOutput(OutputPlugin):
             elif isinstance(ts, float):
                 ts = EventTime.from_float(ts)
             out += packb([ts, ev.body])
+            ends.append(len(out))
             n += 1
-        return bytes(out), n
+        return bytes(out), n, ends
+
+    def _frame(self, tag: str, blob: bytes, n: int,
+               chunk_id: Optional[str], tenant, priority) -> bytes:
+        option = {"size": n, "fluent_signal": 1}
+        payload = blob
+        if (self.compress or "").lower() == "gzip":
+            payload = gzip.compress(blob)
+            option["compressed"] = "gzip"
+        if tenant is not None:
+            option["tenant"] = tenant
+        if priority is not None:
+            option["priority"] = int(priority)
+        if chunk_id is not None:
+            option["chunk"] = chunk_id
+        return packb([tag, payload, option])
+
+    def _note_sent(self, chunk_id: str) -> bool:
+        """True on FIRST send of this id; False marks a resend. LRU-
+        bounded — eviction only ever under-counts resends."""
+        if chunk_id in self._sent_ids:
+            self._sent_ids[chunk_id] = True
+            return False
+        if len(self._sent_ids) >= 4096:
+            for k in list(self._sent_ids)[:256]:
+                del self._sent_ids[k]
+        self._sent_ids[chunk_id] = True
+        return True
+
+    # -- delivery -------------------------------------------------------
 
     async def flush(self, data: bytes, tag: str, engine) -> FlushResult:
+        chunk = FLUSH_CHUNK.get()
         async with self._lock:
-            return await self._flush_locked(data, tag)
+            return await self._flush_locked(data, tag, chunk)
 
-    async def _flush_locked(self, data: bytes, tag: str) -> FlushResult:
+    async def _flush_locked(self, data: bytes, tag: str,
+                            chunk) -> FlushResult:
+        blob, n, ends = self._packed_entries(data)
+        if n == 0:
+            return FlushResult.OK
+        tenant = getattr(chunk, "qos_tenant", None) \
+            if chunk is not None else None
+        priority = getattr(chunk, "priority", None) \
+            if chunk is not None else None
+        chunk_id = None
+        if self.require_ack_response:
+            chunk_id = stable_chunk_id(tag, blob)
+            if not self._note_sent(chunk_id):
+                self.n_resends += 1
+                self._m_resends.inc(1, (self._iname,))
+        wire = self._frame(tag, blob, n, chunk_id, tenant, priority)
+        budget = max(2, len(self._ha.nodes)) if self._ha is not None \
+            else 2
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                await self._connect()
+                await self._send_chunk(wire, chunk_id)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                self._conn_failed()
+                if attempt >= budget:
+                    break
+                # full-jitter backoff between in-flush failover
+                # attempts (core/scheduler.py) — resend-not-duplicate:
+                # the retry reuses the SAME chunk_id, so a delivery
+                # whose ack was lost dedups at the aggregator
+                await asyncio.sleep(
+                    backoff_full_jitter(0.05, 0.5, attempt))
+                continue
+            self._conn_ok()
+            return FlushResult.OK
+        return self._degrade(tag, blob, ends, n, chunk_id,
+                             tenant, priority)
+
+    async def _send_chunk(self, wire: bytes,
+                          chunk_id: Optional[str]) -> None:
+        if _fp.ACTIVE:
+            # connection torn mid-stream, before any frame byte (RST)
+            _fp.fire("forward.conn_reset")
+            d = _fp.fire("forward.partial_write")
+            if d and d[0] == "partial":
+                # frame truncated after n bytes, then the link dies:
+                # the receiver must discard the torn msgpack tail
+                # without absorbing
+                self._writer.write(wire[: max(1, int(d[1]))])
+                await io_deadline(self._writer.drain())
+                raise ConnectionError("forward: injected partial write")
+        self._writer.write(wire)
+        await io_deadline(self._writer.drain())
+        if chunk_id is None:
+            return
+        u = Unpacker()
+        self.n_acks_waited += 1
+        self._m_waited.inc(1, (self._iname,))
+        t0 = time.monotonic()
         try:
-            await self._connect()
-            blob, n = self._packed_entries(data)
-            if n == 0:
-                return FlushResult.OK
-            option = {"size": n, "fluent_signal": 1}
-            if (self.compress or "").lower() == "gzip":
-                blob = gzip.compress(blob)
-                option["compressed"] = "gzip"
-            chunk_id = None
-            if self.require_ack_response:
-                chunk_id = os.urandom(16).hex()
-                option["chunk"] = chunk_id
-            self._writer.write(packb([tag, blob, option]))
-            await io_deadline(self._writer.drain())
-            if chunk_id is not None:
-                u = Unpacker()
-                try:
-                    ack = await asyncio.wait_for(
-                        self._read_msg(u), timeout=self.ack_timeout
-                    )
-                except asyncio.TimeoutError:
-                    self._writer = None
-                    if self._ha is not None and self._node is not None:
-                        # TCP-alive-but-hung node: failover like a
-                        # connect error, or weight keeps re-picking it
-                        self._ha.mark_down(self._node)
-                    return FlushResult.RETRY
-                if not (isinstance(ack, dict) and ack.get("ack") == chunk_id):
-                    self._writer = None
-                    if self._ha is not None and self._node is not None:
-                        self._ha.mark_down(self._node)
-                    return FlushResult.RETRY
-        except (ConnectionError, OSError):
-            self._writer = None
-            if self._ha is not None and self._node is not None:
-                self._ha.mark_down(self._node)  # fail over next flush
+            ack = await asyncio.wait_for(
+                self._read_msg(u), timeout=self.ack_timeout
+            )
+        except asyncio.TimeoutError:
+            # TCP-alive-but-hung peer: surfaced as a connection error
+            # so the caller fails over exactly like a dial failure
+            self.n_acks_lost += 1
+            self._m_lost.inc(1, (self._iname,))
+            raise
+        if not (isinstance(ack, dict) and ack.get("ack") == chunk_id):
+            self.n_acks_lost += 1
+            self._m_lost.inc(1, (self._iname,))
+            raise ConnectionError("forward: ack mismatch")
+        rtt = time.monotonic() - t0
+        self._ack_rtts.append(rtt)
+        if len(self._ack_rtts) > 256:
+            del self._ack_rtts[:128]
+        self._m_rtt.observe(rtt, (self._iname,))
+        if _fp.ACTIVE:
+            try:
+                _fp.fire("forward.dup_delivery")
+            except _fp.FailpointError:
+                # ambiguous-ack shape: the SAME frame delivered again
+                # after a successful ack — the aggregator's ledger must
+                # absorb it zero times (its ack is consumed here so it
+                # cannot be mistaken for the next chunk's)
+                self.n_resends += 1
+                self._m_resends.inc(1, (self._iname,))
+                self._writer.write(wire)
+                await io_deadline(self._writer.drain())
+                await asyncio.wait_for(
+                    self._read_msg(u), timeout=self.ack_timeout
+                )
+
+    # -- partition degrade + heal replay --------------------------------
+
+    def _degrade(self, tag: str, blob: bytes, ends, n: int,
+                 chunk_id: Optional[str], tenant, priority
+                 ) -> FlushResult:
+        """Every upstream refused within this flush's budget. With a
+        spool configured, buffer the packed chunk on disk — gated by
+        the tenant's storage quota — and hand delivery to the heal
+        replay; otherwise RETRY through the engine's backoff."""
+        if self._spool is None:
             return FlushResult.RETRY
+        self._quota_seq += 1
+        quota_id = f"fwd-spool:{self._iname}:{self._quota_seq}"
+        shim = SimpleNamespace(id=quota_id, qos_tenant=tenant,
+                               priority=priority)
+        verdict = self._engine.qos.admit_storage(None, shim, len(blob))
+        if verdict == 2:  # SHED: quota says no disk — the chunk stays
+            # in memory and the engine's retry loop keeps ownership
+            self._engine.qos.release_storage(shim)
+            return FlushResult.RETRY
+        self._spool.put(tag, blob, ends, {
+            "tag": tag, "chunk": chunk_id, "tenant": tenant,
+            "priority": priority, "quota_id": quota_id,
+        })
+        self.n_spooled += 1
+        self._m_spooled.inc(1, (self._iname,))
+        self._ensure_replay()
         return FlushResult.OK
+
+    def _ensure_replay(self) -> None:
+        if self._replay_task is None or self._replay_task.done():
+            self._replay_task = asyncio.get_running_loop().create_task(
+                self._replay_spool())
+
+    async def _replay_spool(self) -> None:
+        """Heal replay: drain the partition spool in spool order, each
+        chunk mmap'd + framed from its sidecars (ForwardSpool.load) and
+        sent with its ORIGINAL chunk-id — a replay that races a
+        pre-partition delivery dedups at the aggregator's ledger."""
+        spool = self._spool
+        while True:
+            files = spool.pending()
+            if not files:
+                self._replay_failures = 0
+                return
+            progressed = False
+            for f in files:
+                got = spool.load(f)
+                if got is None:
+                    # unframeable husk (torn payload + no usable
+                    # sidecar): nothing can be replayed from it
+                    spool.drop(f)
+                    continue
+                blob, n, meta = got
+                cid = meta.get("chunk")
+                wire = self._frame(meta.get("tag") or "", blob, n, cid,
+                                   meta.get("tenant"),
+                                   meta.get("priority"))
+                if cid is not None and not self._note_sent(cid):
+                    self.n_resends += 1
+                    self._m_resends.inc(1, (self._iname,))
+                async with self._lock:
+                    try:
+                        await self._connect()
+                        await self._send_chunk(wire, cid)
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        self._conn_failed()
+                        break
+                    self._conn_ok()
+                qid = meta.get("quota_id")
+                if qid:
+                    self._engine.qos.release_storage(
+                        SimpleNamespace(id=qid))
+                spool.drop(f)
+                self.n_replayed += 1
+                self._m_replayed.inc(1, (self._iname,))
+                progressed = True
+            if progressed:
+                # ANY drained chunk this round counts: a mid-list
+                # failure after progress must not inflate the backoff
+                self._replay_failures = 0
+            else:
+                self._replay_failures += 1
+                # replay is the heal path — cap the idle gap low so a
+                # flaky-but-up upstream still drains the spool quickly
+                await asyncio.sleep(backoff_full_jitter(
+                    0.1, 1.0, self._replay_failures))
+
+    # -- health ---------------------------------------------------------
+
+    def ack_p50(self) -> Optional[float]:
+        if not self._ack_rtts:
+            return None
+        s = sorted(self._ack_rtts)
+        return s[len(s) // 2]
+
+    def health_block(self) -> dict:
+        out = {
+            "role": "client",
+            "acks_waited": self.n_acks_waited,
+            "acks_lost": self.n_acks_lost,
+            "resends": self.n_resends,
+            "spooled": self.n_spooled,
+            "replayed": self.n_replayed,
+        }
+        upstreams = {}
+        if self._ha is not None:
+            for node in self._ha.nodes:
+                upstreams[f"{node.host}:{node.port}"] = \
+                    node.breaker.state_name()
+        else:
+            br = self._engine.guard.breaker(
+                f"forward:{self.host}:{self.port}")
+            upstreams[f"{self.host}:{self.port}"] = br.state_name()
+        out["upstreams"] = upstreams
+        if self._spool is not None:
+            out["spool_pending"] = len(self._spool.pending())
+        p50 = self.ack_p50()
+        if p50 is not None:
+            out["ack_p50_ms"] = round(p50 * 1000.0, 3)
+        return out
